@@ -1,0 +1,28 @@
+"""mxtpu: a TPU-native deep-learning framework with MXNet's capabilities.
+
+A ground-up re-design of the reference (Apache MXNet ~1.3, /root/reference) for
+TPU/XLA: the dependency-scheduling engine becomes PJRT async dispatch, the NNVM
+graph executor becomes a jit-compile cache, the CUDA/cuDNN operator library becomes
+XLA lowerings + Pallas kernels, and the NCCL/parameter-server KVStore becomes XLA
+collectives over the device mesh. See SURVEY.md at the repo root for the layer map.
+
+Use ``import mxtpu as mx`` — the namespace mirrors ``import mxnet as mx``.
+"""
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# float32 inputs get true-f32 matmuls (3-pass bf16 on the MXU); bfloat16 inputs —
+# the TPU fast path every model should use — are unaffected. Without this, JAX's
+# default matmul precision silently downcasts f32 contractions to one-pass bf16,
+# which breaks reference-parity numerics (MXNet computes f32 in f32).
+_jax.config.update("jax_default_matmul_precision", "float32")
+
+from . import base
+from .base import Context, MXNetError, cpu, current_context, gpu, num_gpus, tpu
+from . import autograd
+from . import random
+from . import ndarray
+from . import ndarray as nd  # mx.nd alias
+from .ndarray import NDArray
+from . import ops

@@ -1,0 +1,337 @@
+"""Imperative autograd for eager NDArray code.
+
+Reference design: ``src/imperative/imperative.cc`` (RecordOp :183 tapes each op as an
+nnvm node carrying AGInfo; Backward :270 builds a gradient graph with
+``nnvm::pass::Gradient`` and executes it imperatively) and the Python surface
+``python/mxnet/autograd.py:93-509``.
+
+TPU-native re-design: instead of an nnvm graph + per-op FGradient registry, the tape
+records each invoked op as ``(pure_fn, input snapshots)`` and backward computes per-node
+cotangents with ``jax.vjp`` — XLA builds the transposed computation, so no hand-written
+gradient kernels are needed. Residuals are traded for recompute (forward is re-traced
+inside vjp), which is usually HBM-bandwidth-favourable on TPU; the *fast* training path
+is ``HybridBlock.hybridize()`` where the whole step is one jitted ``jax.grad``
+(mxtpu/cached_op.py).
+
+Dataflow is tracked with (node, output-index) *entries*, the analog of
+``nnvm::NodeEntry``: an NDArray points at the entry that produced its current value, so
+in-place mutation (``x += y`` while recording) simply rebinds the array to a new entry
+and old entries stay valid — the reference achieves the same with engine var versioning
+(include/mxnet/engine.h:45-62). Recorded snapshots are immutable ``jax.Array`` values.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Sequence
+
+import jax
+
+from .base import MXNetError
+
+__all__ = [
+    "record", "pause", "train_mode", "predict_mode", "is_recording", "is_training",
+    "set_recording", "set_training", "mark_variables", "backward", "grad", "Function",
+]
+
+
+class _AGState(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_STATE = _AGState()
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+def set_recording(flag: bool) -> bool:
+    prev, _STATE.recording = _STATE.recording, flag
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    prev, _STATE.training = _STATE.training, flag
+    return prev
+
+
+class _Scope:
+    def __init__(self, recording=None, training=None):
+        self._rec, self._train = recording, training
+
+    def __enter__(self):
+        if self._rec is not None:
+            self._prev_rec = set_recording(self._rec)
+        if self._train is not None:
+            self._prev_train = set_training(self._train)
+        return self
+
+    def __exit__(self, *a):
+        if self._rec is not None:
+            set_recording(self._prev_rec)
+        if self._train is not None:
+            set_training(self._prev_train)
+
+
+def record(train_mode: bool = True):  # noqa: A002 - mirror reference name
+    """Scope enabling taping (ref: python/mxnet/autograd.py:record)."""
+    return _Scope(recording=True, training=train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _Scope(recording=False, training=train_mode)
+
+
+def train_mode():
+    return _Scope(training=True)
+
+
+def predict_mode():
+    return _Scope(training=False)
+
+
+class _Entry:
+    """A dataflow edge: (producer node, output index) — nnvm::NodeEntry analog.
+
+    ``array`` is the NDArray that held this value when the entry was live; kept so
+    backward can write leaf gradients into attached grad buffers.
+    """
+
+    __slots__ = ("node", "index", "array")
+
+    def __init__(self, node, index, array):
+        self.node = node
+        self.index = index
+        self.array = array
+
+
+class _Node:
+    """One taped op invocation. ``fn(*in_data) -> out_data(s)`` is pure and
+    jax-traceable; non-differentiable inputs/attrs are closed over."""
+
+    __slots__ = ("fn", "in_entries", "in_data", "out_entries", "name", "vjp",
+                 "primals_out")
+
+    def __init__(self, fn, in_entries, in_data, name="", vjp=None, primals_out=None):
+        self.fn = fn
+        self.in_entries = in_entries
+        self.in_data = in_data
+        self.out_entries = []
+        self.name = name
+        # optional precomputed (primals_out, vjp_fn) from jax.vjp at forward time —
+        # used by CachedOp so training does not recompute the forward in backward
+        self.vjp = vjp
+        self.primals_out = primals_out
+
+
+def _entry_of(x) -> _Entry:
+    e = getattr(x, "_ag_entry", None)
+    if e is None:
+        e = _Entry(None, 0, x)
+        x._ag_entry = e
+    return e
+
+
+def record_op(fn: Callable, inputs: Sequence, outputs: Sequence, name: str = "",
+              vjp=None, primals_out=None) -> None:
+    """Tape an op call (ref: Imperative::RecordOp, src/imperative/imperative.cc:183)."""
+    node = _Node(fn, [_entry_of(x) for x in inputs], [x._data for x in inputs], name,
+                 vjp=vjp, primals_out=primals_out)
+    for i, o in enumerate(outputs):
+        e = _Entry(node, i, o)
+        o._ag_entry = e
+        node.out_entries.append(e)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to leaves (ref: autograd.py:mark_variables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._ag_entry = None
+        v._grad = g
+        v._grad_req = req
+
+
+def _topo_nodes(head_entries) -> List[_Node]:
+    order: List[_Node] = []
+    seen = set()
+
+    def visit(node):
+        if node is None or id(node) in seen:
+            return
+        seen.add(id(node))
+        for e in node.in_entries:
+            visit(e.node)
+        order.append(node)
+
+    for e in head_entries:
+        visit(e.node)
+    return order
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # noqa: A002
+    """Reverse-mode through the tape (ref: Imperative::Backward,
+    src/imperative/imperative.cc:270-519). Gradients land in ``x.grad`` for every
+    array with an attached grad buffer (``attach_grad``/``mark_variables``)."""
+    from .ndarray import NDArray  # late import (cycle)
+    import jax.numpy as jnp
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    head_entries = []
+    cots = {}  # id(_Entry) -> accumulated cotangent (jax array)
+    for h, hg in zip(heads, head_grads):
+        e = getattr(h, "_ag_entry", None)
+        if e is None or e.node is None:
+            if getattr(h, "_grad_req", "null") == "null":
+                raise MXNetError(
+                    "head array is not part of a recorded computation "
+                    "(run inside autograd.record())"
+                )
+            continue
+        head_entries.append(e)
+        g = hg._data if hg is not None else jnp.ones(h.shape, dtype=h._data.dtype)
+        cots[id(e)] = cots.get(id(e), 0) + g
+
+    order = _topo_nodes(head_entries)
+    leaf_entries = {}
+    for node in reversed(order):
+        # All consumers of this node's outputs are later in topo order, so output
+        # cotangents are fully accumulated by the time we visit it (the tape analog
+        # of the engine's dependency wait-counters).
+        any_set = any(id(e) in cots for e in node.out_entries)
+        if not any_set:
+            continue
+        if node.vjp is not None:
+            primals_out, vjp_fn = node.primals_out, node.vjp
+        else:
+            primals_out, vjp_fn = jax.vjp(node.fn, *node.in_data)
+        num_out = len(node.out_entries)
+        primals_list = [primals_out] if num_out == 1 else list(primals_out)
+        out_cots = []
+        for i, e in enumerate(node.out_entries):
+            c = cots.pop(id(e), None)
+            if c is None:
+                c = jnp.zeros(primals_list[i].shape, dtype=primals_list[i].dtype)
+            else:
+                c = jnp.asarray(c, dtype=primals_list[i].dtype)
+            out_cots.append(c)
+        in_cots = vjp_fn(out_cots[0] if num_out == 1 else tuple(out_cots))
+        for e, c in zip(node.in_entries, in_cots):
+            if c is None:
+                continue
+            cots[id(e)] = cots.get(id(e), 0) + c
+            if e.node is None:
+                leaf_entries[id(e)] = e
+
+    # write accumulated cotangents into attached grad buffers
+    for eid, e in leaf_entries.items():
+        x = e.array
+        req = getattr(x, "_grad_req", "null")
+        if req != "null" and getattr(x, "_grad", None) is not None and eid in cots:
+            if req == "add":
+                x._grad._set_data(x._grad._data + cots[eid])
+            else:
+                x._grad._set_data(jnp.asarray(cots[eid], dtype=x._data.dtype))
+
+    if not retain_graph:
+        # free the tape (ref: AGInfo::Clear) so snapshots can be GC'd
+        for node in order:
+            node.in_data = None
+            node.fn = None
+            for e in node.out_entries:
+                if getattr(e.array, "_ag_entry", None) is e:
+                    e.array._ag_entry = None
+            node.in_entries = []
+            node.out_entries = []
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):  # noqa: A002
+    """Functional gradient interface (ref: python/mxnet/autograd.py:grad)."""
+    from .ndarray import NDArray, array as _array
+    import jax.numpy as jnp
+
+    if isinstance(variables, NDArray):
+        variables = [variables]
+        single = True
+    else:
+        single = False
+    saved = [(getattr(v, "_grad", None), getattr(v, "_grad_req", "null")) for v in variables]
+    for v in variables:
+        if getattr(v, "_ag_entry", None) is None:
+            raise MXNetError("variables passed to grad() must be used in the recorded graph")
+        v._grad = _array(jnp.zeros(v.shape, v._data.dtype))
+        v._grad_req = "add"
+        # mark the entry array so backward writes into the buffer
+        v._ag_entry.array = v
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph), train_mode=train_mode)
+        out = [v._grad for v in variables]
+    finally:
+        for v, (g, r) in zip(variables, saved):
+            v._grad, v._grad_req = g, r
+    return out[0] if single else out
+
+
+class Function:
+    """Custom differentiable function (ref: python/mxnet/autograd.py:Function,
+    src/c_api/c_api_function.cc). Subclass and implement ``forward``/``backward``."""
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def backward(self, *out_grads):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray, array as _array
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        out_list = [outputs] if single else list(outputs)
+        if is_recording():
+            func = self
+            out_data = [o._data for o in out_list]
+
+            @jax.custom_vjp
+            def fwd(*in_data):
+                return out_data[0] if single else tuple(out_data)
+
+            def fwd_fwd(*in_data):
+                return fwd(*in_data), None
+
+            def fwd_bwd(_, g):
+                gs = [g] if single else list(g)
+                with pause():
+                    in_gs = func.backward(*[_array(x) for x in gs])
+                if isinstance(in_gs, NDArray):
+                    in_gs = [in_gs]
+                return tuple(x._data for x in in_gs)
+
+            fwd.defvjp(fwd_fwd, fwd_bwd)
+            record_op(fwd, list(inputs), out_list, name=type(self).__name__)
+        return out_list[0] if single else out_list

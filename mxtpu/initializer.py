@@ -1,0 +1,252 @@
+"""Weight initializers (ref: python/mxnet/initializer.py — registry + Xavier/MSRA/
+Orthogonal/Bilinear/LSTMBias/… and the InitDesc-pattern dispatch by name)."""
+from __future__ import annotations
+
+import json
+import math
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .random import next_key
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+class InitDesc(str):
+    """Parameter name + attrs hint (ref: initializer.py:InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    """Base initializer with the reference's name-pattern dispatch
+    (initializer.py:Initializer.__call__): *weight → _init_weight, *bias → zeros,
+    *gamma → ones, *beta/ *moving_mean → zeros, *moving_var → ones."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr: NDArray):
+        if not isinstance(desc, str):
+            raise TypeError("desc must be str/InitDesc")
+        init = getattr(desc, "attrs", {}).get("__init__", "")
+        if init:
+            create(init)._init_weight(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_zero(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_one(desc, arr)
+        elif name.endswith("beta"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        elif name.endswith("min") or name.endswith("max"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # -- primitive fills --------------------------------------------------
+    def _init_zero(self, _, arr):
+        arr._set_data(jnp.zeros(arr.shape, arr._data.dtype))
+
+    def _init_one(self, _, arr):
+        arr._set_data(jnp.ones(arr.shape, arr._data.dtype))
+
+    def _init_weight(self, _, arr):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _init_default(self, desc, arr):
+        self._init_weight(desc, arr)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self._kwargs == other._kwargs
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, _, arr):
+        self._init_zero(_, arr)
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, _, arr):
+        self._init_one(_, arr)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        arr._set_data(jnp.full(arr.shape, self.value, arr._data.dtype))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        arr._set_data(jax.random.uniform(next_key(), arr.shape, jnp.float32,
+                                         -self.scale, self.scale).astype(arr._data.dtype))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        arr._set_data((jax.random.normal(next_key(), arr.shape) * self.sigma)
+                      .astype(arr._data.dtype))
+
+
+@register
+class Xavier(Initializer):
+    """Ref: initializer.py:Xavier (factor_type in/out/avg × uniform/gaussian)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        if len(shape) < 2:
+            # fall back to uniform for 1-D params routed here
+            arr._set_data(jax.random.uniform(next_key(), shape, jnp.float32, -0.07, 0.07)
+                          .astype(arr._data.dtype))
+            return
+        hw_scale = 1.0
+        for s in shape[2:]:
+            hw_scale *= s
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        if self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            factor = (fan_in + fan_out) / 2.0
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            d = jax.random.uniform(next_key(), shape, jnp.float32, -scale, scale)
+        else:
+            d = jax.random.normal(next_key(), shape) * scale
+        arr._set_data(d.astype(arr._data.dtype))
+
+
+@register
+class MSRAPrelu(Xavier):
+    """Ref: initializer.py:MSRAPrelu."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(next_key(), (nout, nin), jnp.float32, -1, 1)
+        else:
+            tmp = jax.random.normal(next_key(), (nout, nin))
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        arr._set_data((self.scale * q).reshape(arr.shape).astype(arr._data.dtype))
+
+
+@register
+class Bilinear(Initializer):
+    """Upsampling deconv kernel init (ref: initializer.py:Bilinear)."""
+
+    def _init_weight(self, _, arr):
+        shape = arr.shape
+        weight = _np.zeros(int(_np.prod(shape)), dtype=_np.float32)
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(weight.size):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._set_data(jnp.asarray(weight.reshape(shape)).astype(arr._data.dtype))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 (ref: initializer.py:LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = _np.zeros(arr.shape, _np.float32)
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr._set_data(jnp.asarray(b).astype(arr._data.dtype))
+
+    _init_default = _init_weight
+
+
+class Mixed:
+    """Pattern → initializer mapping (ref: initializer.py:Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise MXNetError("Parameter %s did not match any pattern" % name)
+
+
+def create(init, **kwargs) -> Initializer:
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, str):
+        val = init
+        if val.startswith("["):  # dumps() format
+            name, kw = json.loads(val)
+            return _REGISTRY[name](**kw)
+        return _REGISTRY[val.lower()](**kwargs)
+    raise MXNetError("cannot create initializer from %r" % (init,))

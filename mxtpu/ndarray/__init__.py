@@ -1,0 +1,33 @@
+"""The ``mx.nd`` namespace: NDArray + the full imperative op surface.
+
+The reference generates this namespace at import time from the C op registry
+(python/mxnet/ndarray/register.py:143-157); here it is populated from
+mxtpu.ops.REGISTRY after the op modules register themselves.
+"""
+import sys as _sys
+
+from .ndarray import NDArray, array, from_jax, waitall, _apply  # noqa: F401
+
+# importing ops populates the registry and attaches NDArray methods
+from .. import ops as _ops  # noqa: E402
+
+_mod = _sys.modules[__name__]
+for _name, _op in _ops.REGISTRY.items():
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _op.wrapper)
+
+# creation helpers registered wrap=False already return NDArrays
+from ..ops.init_ops import arange, empty, eye, full, linspace, ones, zeros  # noqa: E402,F401
+from .utils import load, save  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+from .sparse import CSRNDArray, RowSparseNDArray  # noqa: E402,F401
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    """Ref: mx.nd.concatenate (deprecated alias of concat with axis kwarg)."""
+    return _ops.REGISTRY["Concat"].wrapper(*arrays, dim=axis)
+
+
+def imdecode(buf, **kwargs):  # pragma: no cover - thin shim
+    from ..image import imdecode as _imdecode
+    return _imdecode(buf, **kwargs)

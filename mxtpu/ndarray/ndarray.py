@@ -1,0 +1,490 @@
+"""NDArray: the framework's imperative tensor, backed by an immutable ``jax.Array``.
+
+Reference: ``include/mxnet/ndarray.h:82`` (value-semantics tensor = shared storage
+chunk + engine variable + autograd entry) and the Python surface
+``python/mxnet/ndarray/ndarray.py``.
+
+TPU-native re-design:
+
+* The reference pairs each NDArray with an *engine variable* so the dependency
+  scheduler can order reads/writes (engine.h:45). Here the payload is an immutable
+  ``jax.Array`` on a PJRT stream — PJRT already executes enqueued work asynchronously
+  and in order, so "mutation" is value replacement (``_set_data``) and the version
+  counter is kept only for observability. Frontend threads never block, matching the
+  reference's push-and-return semantics (SURVEY §1): blocking happens only at
+  ``wait_to_read``/``asnumpy`` (ref: MXNDArrayWaitToRead, src/c_api/c_api.cc:273).
+* Deferred exceptions (src/engine/threaded_engine.cc:472): XLA raises asynchronous
+  execution errors at the first sync point; ``wait_to_read`` surfaces them the same
+  way the reference rethrows captured var exceptions.
+* Autograd linkage is an entry on the tape (mxtpu/autograd.py) instead of AGInfo
+  on an nnvm node.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .. import autograd
+from ..base import Context, MXNetError, current_context, np_dtype
+
+__all__ = ["NDArray", "array", "_apply", "from_jax", "waitall"]
+
+
+_BFLOAT16 = jnp.bfloat16
+
+
+def _as_jax_dtype(dtype):
+    name = np_dtype(dtype)
+    return {"bfloat16": _BFLOAT16}.get(name, name)
+
+
+def _apply(fn, args, kwargs=None, name="", num_outputs=None):
+    """Invoke a jnp-level pure function on NDArray/scalar args, taping if recording.
+
+    The imperative dispatch path (ref: Imperative::Invoke,
+    src/imperative/imperative.cc:87 → PushFCompute → engine). Here "push to engine"
+    is simply calling into jax: PJRT enqueues the computation asynchronously.
+    """
+    kwargs = kwargs or {}
+    nd_idx = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+    nd_keys = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
+    inputs = [args[i] for i in nd_idx] + [kwargs[k] for k in nd_keys]
+
+    if nd_idx or nd_keys:
+        def pure_fn(*in_data):
+            a = list(args)
+            kw = dict(kwargs)
+            for j, i in enumerate(nd_idx):
+                a[i] = in_data[j]
+            for j, k in enumerate(nd_keys):
+                kw[k] = in_data[len(nd_idx) + j]
+            return fn(*a, **kw)
+    else:
+        def pure_fn():
+            return fn(*args, **kwargs)
+
+    out_data = pure_fn(*[x._data for x in inputs])
+    if isinstance(out_data, (tuple, list)):
+        outputs = [NDArray(d) for d in out_data]
+        if autograd.is_recording():
+            autograd.record_op(pure_fn, inputs, outputs, name=name)
+        return outputs
+    out = NDArray(out_data)
+    if autograd.is_recording():
+        autograd.record_op(pure_fn, inputs, [out], name=name)
+    return out
+
+
+class NDArray:
+    """Multi-dimensional array with MXNet NDArray semantics on a PJRT device."""
+
+    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_ag_entry", "_version",
+                 "__weakref__")
+
+    # make `ndarray op numpy_array` use our reflected ops, not numpy's
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx: Context = None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        if ctx is not None:
+            dev = ctx.jax_device()
+            if data.device != dev:
+                data = jax.device_put(data, dev)
+        self._data = data
+        self._ctx = ctx
+        self._grad = None
+        self._grad_req = "null"
+        self._ag_entry = None
+        self._version = 0
+
+    # ------------------------------------------------------------------ core
+    def _set_data(self, new_data):
+        """Replace the payload (the mutation primitive). Bumps the version like
+        the reference's engine var (include/mxnet/engine.h:45-62)."""
+        self._data = new_data
+        self._version += 1
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        # jax dtypes are numpy dtypes (ml_dtypes registers bfloat16), so str()
+        # and == comparisons behave like the reference's numpy dtype surface
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        dev = self._data.device
+        plat = getattr(dev, "platform", "cpu")
+        if plat == "cpu":
+            return Context("cpu", dev.id)
+        return Context("tpu", dev.id)
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def T(self):
+        return _apply(jnp.transpose, (self,), name="transpose")
+
+    @property
+    def grad(self):
+        return self._grad
+
+    # ------------------------------------------------------------- sync points
+    def wait_to_read(self):
+        """Block until the value is computed (ref: MXNDArrayWaitToRead →
+        ThreadedEngine::WaitForVar, src/engine/threaded_engine.cc:375). Deferred
+        async errors surface here."""
+        self._data.block_until_ready()
+        return self
+
+    def asnumpy(self) -> _np.ndarray:
+        d = self._data
+        if d.dtype == _BFLOAT16:
+            return _np.asarray(d.astype(jnp.float32))
+        return _np.asarray(d)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 0:
+            return False
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise MXNetError("The truth value of an NDArray with multiple elements is ambiguous.")
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        return "%s\n<NDArray %s @%s>" % (
+            str(self.asnumpy()), "x".join(map(str, self.shape)), self.context)
+
+    # ------------------------------------------------------------ conversions
+    def astype(self, dtype, copy=True):
+        jd = _as_jax_dtype(dtype)
+        if not copy and self._data.dtype == jnp.dtype(jd):
+            return self
+        return _apply(lambda x: x.astype(jd), (self,), name="cast")
+
+    def copy(self):
+        return NDArray(self._data + 0 if self._data.dtype != jnp.bool_ else self._data)
+
+    def copyto(self, other):
+        """Copy into another NDArray or Context (ref: CopyFromTo,
+        src/ndarray/ndarray.cc:1184)."""
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device()))
+        if isinstance(other, NDArray):
+            d = self._data
+            if d.shape != other.shape:
+                raise MXNetError("copyto shape mismatch: %s vs %s" % (self.shape, other.shape))
+            dev = other._data.device
+            d = d.astype(other._data.dtype)
+            if d.device != dev:
+                d = jax.device_put(d, dev)
+            other._set_data(d)
+            return other
+        raise TypeError("copyto does not support type " + str(type(other)))
+
+    def as_in_context(self, ctx: Context):
+        if ctx == self.context:
+            return self
+        return self.copyto(ctx)
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from .sparse import cast_storage  # late: sparse built on dense
+        return cast_storage(self, stype)
+
+    def to_jax(self):
+        """Escape hatch: the underlying jax.Array (TPU-native; replaces the
+        reference's dlpack bridge, include/mxnet/ndarray.h / mx.nd.to_dlpack)."""
+        return self._data
+
+    def __dlpack__(self, *a, **kw):
+        return self._data.__dlpack__(*a, **kw)
+
+    # --------------------------------------------------------------- autograd
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate a grad buffer; marks this array as an autograd leaf
+        (ref: python/mxnet/ndarray/ndarray.py:attach_grad)."""
+        self._ag_entry = None  # detach from any recorded history
+        self._grad = NDArray(jnp.zeros(self.shape, self._data.dtype))
+        self._grad_req = grad_req
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    def detach(self):
+        out = NDArray(self._data)
+        return out
+
+    # ---------------------------------------------------------------- indexing
+    def __getitem__(self, key):
+        key = _clean_index(key)
+        return _apply(lambda x: x[key], (self,), name="slice")
+
+    def __setitem__(self, key, value):
+        if autograd.is_recording():
+            raise MXNetError("Inplace assignment is not supported when recording "
+                             "(ref: mxnet inplace-under-autograd restriction)")
+        key = _clean_index(key)
+        if isinstance(value, NDArray):
+            v = value._data
+        elif isinstance(value, (int, float, bool)):
+            v = value
+        else:
+            v = jnp.asarray(value)
+        if key == slice(None) and not isinstance(v, (int, float, bool)) \
+                and tuple(getattr(v, "shape", ())) == self.shape:
+            self._set_data(jnp.asarray(v, dtype=self._data.dtype))
+        else:
+            self._set_data(self._data.at[key].set(v))
+
+    # ------------------------------------------------------------- arithmetic
+    def _binop(self, other, fn, name):
+        if isinstance(other, (NDArray, int, float, bool, _np.number)):
+            return _apply(fn, (self, other), name=name)
+        if isinstance(other, _np.ndarray):
+            return _apply(fn, (self, NDArray(other)), name=name)
+        return NotImplemented
+
+    def _rbinop(self, other, fn, name):
+        if isinstance(other, (int, float, bool, _np.number)):
+            return _apply(fn, (other, self), name=name)
+        if isinstance(other, _np.ndarray):
+            return _apply(fn, (NDArray(other), self), name=name)
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binop(o, jnp.add, "broadcast_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, jnp.subtract, "broadcast_sub")
+
+    def __rsub__(self, o):
+        return self._rbinop(o, jnp.subtract, "broadcast_sub")
+
+    def __mul__(self, o):
+        return self._binop(o, jnp.multiply, "broadcast_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, jnp.divide, "broadcast_div")
+
+    def __rtruediv__(self, o):
+        return self._rbinop(o, jnp.divide, "broadcast_div")
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __mod__(self, o):
+        return self._binop(o, jnp.mod, "broadcast_mod")
+
+    def __rmod__(self, o):
+        return self._rbinop(o, jnp.mod, "broadcast_mod")
+
+    def __pow__(self, o):
+        return self._binop(o, jnp.power, "broadcast_power")
+
+    def __rpow__(self, o):
+        return self._rbinop(o, jnp.power, "broadcast_power")
+
+    def __neg__(self):
+        return _apply(jnp.negative, (self,), name="negative")
+
+    def __abs__(self):
+        return _apply(jnp.abs, (self,), name="abs")
+
+    def __matmul__(self, o):
+        return self._binop(o, jnp.matmul, "matmul")
+
+    def __eq__(self, o):
+        if isinstance(o, (NDArray, int, float, bool, _np.number, _np.ndarray)):
+            return self._binop(o, lambda a, b: jnp.equal(a, b).astype(jnp.float32), "broadcast_equal")
+        return NotImplemented
+
+    def __ne__(self, o):
+        if isinstance(o, (NDArray, int, float, bool, _np.number, _np.ndarray)):
+            return self._binop(o, lambda a, b: jnp.not_equal(a, b).astype(jnp.float32),
+                               "broadcast_not_equal")
+        return NotImplemented
+
+    def __gt__(self, o):
+        return self._binop(o, lambda a, b: jnp.greater(a, b).astype(jnp.float32), "broadcast_greater")
+
+    def __ge__(self, o):
+        return self._binop(o, lambda a, b: jnp.greater_equal(a, b).astype(jnp.float32),
+                           "broadcast_greater_equal")
+
+    def __lt__(self, o):
+        return self._binop(o, lambda a, b: jnp.less(a, b).astype(jnp.float32), "broadcast_lesser")
+
+    def __le__(self, o):
+        return self._binop(o, lambda a, b: jnp.less_equal(a, b).astype(jnp.float32),
+                           "broadcast_lesser_equal")
+
+    __hash__ = object.__hash__
+
+    # in-place ops rebind the payload; while recording they tape like ordinary ops
+    # (functionally equivalent to the reference's kWriteInplace + var version bump)
+    def __iadd__(self, o):
+        res = self.__add__(o)
+        self._ag_entry = res._ag_entry
+        self._set_data(res._data)
+        return self
+
+    def __isub__(self, o):
+        res = self.__sub__(o)
+        self._ag_entry = res._ag_entry
+        self._set_data(res._data)
+        return self
+
+    def __imul__(self, o):
+        res = self.__mul__(o)
+        self._ag_entry = res._ag_entry
+        self._set_data(res._data)
+        return self
+
+    def __itruediv__(self, o):
+        res = self.__truediv__(o)
+        self._ag_entry = res._ag_entry
+        self._set_data(res._data)
+        return self
+
+    # ------------------------------------------------------------ shape ops
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        if kwargs.get("shape") is not None:
+            shape = tuple(kwargs["shape"])
+        shape = tuple(-1 if s in (-1, 0) and s == -1 else s for s in shape)
+        # MXNet 0 means "copy this dim" (ndarray.py reshape special codes 0/-1)
+        new_shape = []
+        for i, s in enumerate(shape):
+            if s == 0:
+                new_shape.append(self.shape[i])
+            else:
+                new_shape.append(s)
+        return _apply(lambda x: jnp.reshape(x, tuple(new_shape)), (self,), name="reshape")
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def expand_dims(self, axis):
+        return _apply(lambda x: jnp.expand_dims(x, axis), (self,), name="expand_dims")
+
+    def squeeze(self, axis=None):
+        return _apply(lambda x: jnp.squeeze(x, axis), (self,), name="squeeze")
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        axes = axes if axes else None
+        return _apply(lambda x: jnp.transpose(x, axes), (self,), name="transpose")
+
+    def swapaxes(self, dim1, dim2):
+        return _apply(lambda x: jnp.swapaxes(x, dim1, dim2), (self,), name="swapaxes")
+
+    def flatten(self):
+        n = self.shape[0] if self.ndim > 0 else 1
+        return _apply(lambda x: jnp.reshape(x, (n, -1)), (self,), name="flatten")
+
+    def broadcast_to(self, shape):
+        return _apply(lambda x: jnp.broadcast_to(x, tuple(shape)), (self,), name="broadcast_to")
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def zeros_like(self):
+        return NDArray(jnp.zeros_like(self._data))
+
+    def ones_like(self):
+        return NDArray(jnp.ones_like(self._data))
+
+
+def _clean_index(key):
+    """Normalize an index: NDArray → jax array, tuples recursively."""
+    if isinstance(key, NDArray):
+        return key._data
+    if isinstance(key, tuple):
+        return tuple(_clean_index(k) for k in key)
+    if isinstance(key, list):
+        return jnp.asarray(key)
+    return key
+
+
+def array(source_array, ctx: Context = None, dtype=None) -> NDArray:
+    """Create an NDArray from any array-like (ref: mx.nd.array)."""
+    if isinstance(source_array, NDArray):
+        d = source_array._data
+    elif isinstance(source_array, jax.Array):
+        d = source_array
+    else:
+        d = jnp.asarray(source_array)
+    if dtype is not None:
+        d = d.astype(_as_jax_dtype(dtype))
+    elif not isinstance(source_array, (NDArray, jax.Array)) and \
+            _np.asarray(source_array).dtype == _np.float64:
+        d = d.astype(jnp.float32)  # MXNet defaults python floats to float32
+    return NDArray(d, ctx=ctx)
+
+
+def from_jax(x) -> NDArray:
+    return NDArray(x)
+
+
+def waitall():
+    """Block until all enqueued work completes (ref: MXNDArrayWaitAll →
+    Engine::WaitForAll)."""
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass

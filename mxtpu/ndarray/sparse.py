@@ -1,0 +1,238 @@
+"""Sparse NDArrays: row_sparse and csr storage types.
+
+Reference: include/mxnet/ndarray.h:61-66 (kDefaultStorage/kRowSparseStorage/
+kCSRStorage), python/mxnet/ndarray/sparse.py, cast_storage
+(src/operator/tensor/cast_storage-inl.h), sparse dot (dot-inl.h).
+
+TPU-native re-design (SURVEY §7 hard part 2): TPUs have no native sparse memory
+format, so sparse arrays are pairs of *dense* arrays — ``row_sparse`` = (indices
+(nnz,), values (nnz, *row_shape)) and ``csr`` = (indptr, indices, data) — and sparse
+ops are gather/scatter/segment-sum HLO. This matches how the reference's kvstore uses
+row_sparse (pull rows by id) while staying jit-friendly: all shapes static per nnz.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, array
+
+
+class BaseSparseNDArray(NDArray):
+    """Common behavior for sparse storage types."""
+
+    __slots__ = ("_aux",)
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def __repr__(self):
+        return "\n<%s %s @%s>" % (type(self).__name__,
+                                  "x".join(map(str, self.shape)), self.context)
+
+    def todense(self) -> NDArray:
+        raise NotImplementedError
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return self.todense()
+        return cast_storage(self.todense(), stype)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """(indices, values) pair: values[i] is the dense row at row id indices[i]
+    (ref: python/mxnet/ndarray/sparse.py:RowSparseNDArray)."""
+
+    def __init__(self, values, indices, shape):
+        # _data holds values; indices kept as aux (int32 sorted unique row ids)
+        v = values._data if isinstance(values, NDArray) else jnp.asarray(values)
+        idx = indices._data if isinstance(indices, NDArray) else jnp.asarray(indices)
+        super().__init__(v)
+        self._aux = {"indices": idx.astype(jnp.int32), "shape": tuple(shape)}
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def shape(self):
+        return self._aux["shape"]
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._aux["indices"])
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._data)
+
+    def todense(self) -> NDArray:
+        dense = jnp.zeros(self.shape, self._data.dtype)
+        dense = dense.at[self._aux["indices"]].add(self._data)
+        return NDArray(dense)
+
+    def retain(self, row_ids):
+        """Keep only the given rows (ref: sparse_retain op,
+        src/operator/tensor/sparse_retain-inl.h)."""
+        rid = row_ids._data.astype(jnp.int32) if isinstance(row_ids, NDArray) \
+            else jnp.asarray(row_ids, jnp.int32)
+        mask = jnp.isin(self._aux["indices"], rid)
+        keep = _np.asarray(mask)
+        idx = _np.asarray(self._aux["indices"])[keep]
+        vals = _np.asarray(self._data)[keep]
+        return RowSparseNDArray(jnp.asarray(vals), jnp.asarray(idx), self.shape)
+
+    def copyto(self, other):
+        if isinstance(other, RowSparseNDArray):
+            other._set_data(self._data)
+            other._aux = dict(self._aux)
+            return other
+        return self.todense().copyto(other)
+
+    def _serialize_parts(self):
+        return [("indices", _np.asarray(self._aux["indices"])),
+                ("values", _np.asarray(self._data))]
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (ref: python/mxnet/ndarray/sparse.py:CSRNDArray)."""
+
+    def __init__(self, data, indptr, indices, shape):
+        d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        super().__init__(d)
+        ip = indptr._data if isinstance(indptr, NDArray) else jnp.asarray(indptr)
+        idx = indices._data if isinstance(indices, NDArray) else jnp.asarray(indices)
+        self._aux = {"indptr": ip.astype(jnp.int32), "indices": idx.astype(jnp.int32),
+                     "shape": tuple(shape)}
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def shape(self):
+        return self._aux["shape"]
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._aux["indices"])
+
+    @property
+    def indptr(self) -> NDArray:
+        return NDArray(self._aux["indptr"])
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._data)
+
+    def todense(self) -> NDArray:
+        m, n = self.shape
+        indptr = self._aux["indptr"]
+        indices = self._aux["indices"]
+        nnz = self._data.shape[0]
+        # row id per nnz element via searchsorted on indptr
+        rows = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+        dense = jnp.zeros((m, n), self._data.dtype)
+        dense = dense.at[rows, indices].add(self._data)
+        return NDArray(dense)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            return self.todense()[key]
+        return self.todense()[key]
+
+    def copyto(self, other):
+        if isinstance(other, CSRNDArray):
+            other._set_data(self._data)
+            other._aux = dict(self._aux)
+            return other
+        return self.todense().copyto(other)
+
+    def _serialize_parts(self):
+        return [("indptr", _np.asarray(self._aux["indptr"])),
+                ("indices", _np.asarray(self._aux["indices"])),
+                ("data", _np.asarray(self._data))]
+
+
+def _deserialize_parts(stype, shape, parts):
+    if stype == "row_sparse":
+        return RowSparseNDArray(parts["values"], parts["indices"], shape)
+    if stype == "csr":
+        return CSRNDArray(parts["data"], parts["indptr"], parts["indices"], shape)
+    raise MXNetError("unknown stype " + stype)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray from (data, indices) or a dense source
+    (ref: mx.nd.sparse.row_sparse_array)."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = array(data, dtype=dtype)
+        return RowSparseNDArray(data, array(indices), shape)
+    dense = array(arg1, dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray (ref: mx.nd.sparse.csr_matrix)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(array(data, dtype=dtype), array(indptr), array(indices), shape)
+    dense = array(arg1, dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def cast_storage(arr, stype):
+    """dense ↔ row_sparse ↔ csr conversion (ref: cast_storage-inl.h; op
+    `cast_storage`). Host-side nnz discovery (dynamic shapes are not jit-friendly;
+    conversion is a data-prep step, as in the reference's IO path)."""
+    if arr.stype == stype:
+        return arr
+    if isinstance(arr, BaseSparseNDArray):
+        arr = arr.todense()
+        if stype == "default":
+            return arr
+    a = _np.asarray(arr.asnumpy())
+    if stype == "row_sparse":
+        if a.ndim < 1:
+            raise MXNetError("row_sparse requires ndim>=1")
+        row_nz = _np.nonzero(a.reshape(a.shape[0], -1).any(axis=1))[0]
+        vals = a[row_nz]
+        return RowSparseNDArray(jnp.asarray(vals), jnp.asarray(row_nz), a.shape)
+    if stype == "csr":
+        if a.ndim != 2:
+            raise MXNetError("csr requires 2D")
+        rows, cols = _np.nonzero(a)
+        data = a[rows, cols]
+        indptr = _np.zeros(a.shape[0] + 1, _np.int32)
+        _np.add.at(indptr, rows + 1, 1)
+        indptr = _np.cumsum(indptr).astype(_np.int32)
+        return CSRNDArray(jnp.asarray(data), jnp.asarray(indptr), jnp.asarray(cols), a.shape)
+    if stype == "default":
+        return arr
+    raise MXNetError("unknown stype " + stype)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    if stype == "row_sparse":
+        rs = shape[1:] if len(shape) > 1 else ()
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(rs), dtype),
+                                jnp.zeros((0,), jnp.int32), shape)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype), jnp.zeros((shape[0] + 1,), jnp.int32),
+                          jnp.zeros((0,), jnp.int32), shape)
+    from ..ops.init_ops import zeros as dzeros
+    return dzeros(shape, ctx=ctx, dtype=dtype)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (ref: src/operator/tensor/dot-inl.h sparse paths):
+    csr × dense and row_sparse gradients fall back to dense HLO einsum after
+    materialization of the sparse operand's rows."""
+    from ..ops.matrix import dot as dense_dot
+    l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return dense_dot(l, r, transpose_a=transpose_a, transpose_b=transpose_b)

@@ -1,0 +1,114 @@
+"""NDArray serialization (ref: src/ndarray/ndarray.cc:1574-1776 Save/Load with magic
+number + versioned blobs; python surface mx.nd.save/load).
+
+Format (TPU build): a single file, magic ``MXTPU001`` + JSON header (names, shapes,
+dtypes, storage types, byte offsets) + raw little-endian buffers. Dense and sparse
+(row_sparse/csr as index+value buffers) supported, mirroring the reference's
+sparse-aware format. Legacy MXNet files are not binary-compatible (the reference's
+format embeds mshadow TBlob headers), but the API is identical.
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, array
+
+_MAGIC = b"MXTPU001"
+
+
+def _to_bytes(arr: NDArray):
+    a = arr.asnumpy() if str(arr.dtype) != "bfloat16" else None
+    if a is None:
+        import jax.numpy as jnp
+        a = _np.asarray(arr._data.astype(jnp.float32))
+        return a.tobytes(), "bfloat16", a.shape
+    return a.tobytes(), str(_np.dtype(a.dtype).name), a.shape
+
+
+def save(fname: str, data) -> None:
+    """Save NDArrays (list or dict) to file (ref: mx.nd.save → MXNDArraySave)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    elif isinstance(data, (list, tuple)):
+        names = [""] * len(data)
+        arrays = list(data)
+    else:
+        raise MXNetError("save expects NDArray, list, or dict")
+
+    entries = []
+    blobs = []
+    offset = 0
+    for name, arr in zip(names, arrays):
+        from .sparse import BaseSparseNDArray
+        if isinstance(arr, BaseSparseNDArray):
+            parts = arr._serialize_parts()
+            part_entries = []
+            for pname, pa in parts:
+                b = pa.tobytes()
+                part_entries.append({"part": pname, "dtype": str(pa.dtype),
+                                     "shape": list(pa.shape), "offset": offset,
+                                     "nbytes": len(b)})
+                blobs.append(b)
+                offset += len(b)
+            entries.append({"name": name, "stype": arr.stype,
+                            "shape": list(arr.shape), "parts": part_entries})
+        else:
+            b, dt, shape = _to_bytes(arr)
+            entries.append({"name": name, "stype": "default", "dtype": dt,
+                            "shape": list(shape), "offset": offset, "nbytes": len(b)})
+            blobs.append(b)
+            offset += len(b)
+
+    header = json.dumps({"entries": entries, "named": isinstance(data, dict)}).encode()
+    with open(fname, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<Q", len(header)))
+        f.write(header)
+        for b in blobs:
+            f.write(b)
+
+
+def load(fname: str):
+    """Load NDArrays (ref: mx.nd.load → MXNDArrayLoad). Returns list or dict."""
+    with open(fname, "rb") as f:
+        magic = f.read(8)
+        if magic != _MAGIC:
+            raise MXNetError("invalid NDArray file %s (bad magic)" % fname)
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen).decode())
+        payload = f.read()
+
+    def read_dense(e):
+        dt = e["dtype"]
+        np_dt = _np.float32 if dt == "bfloat16" else _np.dtype(dt)
+        a = _np.frombuffer(payload, dtype=np_dt, count=_np.prod(e["shape"], dtype=int) if e["shape"] else 1,
+                           offset=e["offset"]).reshape(e["shape"])
+        nd = array(a)
+        if dt == "bfloat16":
+            nd = nd.astype("bfloat16")
+        return nd
+
+    out = []
+    for e in header["entries"]:
+        if e["stype"] == "default":
+            out.append((e["name"], read_dense(e)))
+        else:
+            from .sparse import _deserialize_parts
+            parts = {}
+            for pe in e["parts"]:
+                a = _np.frombuffer(payload, dtype=_np.dtype(pe["dtype"]),
+                                   count=_np.prod(pe["shape"], dtype=int) if pe["shape"] else 1,
+                                   offset=pe["offset"]).reshape(pe["shape"])
+                parts[pe["part"]] = a
+            out.append((e["name"], _deserialize_parts(e["stype"], tuple(e["shape"]), parts)))
+
+    if header["named"]:
+        return {k: v for k, v in out}
+    return [v for _, v in out]

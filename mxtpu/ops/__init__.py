@@ -1,0 +1,24 @@
+"""Operator library: registry + op families.
+
+The TPU-native replacement for src/operator/ (~110k LoC of kernel triples in the
+reference): op *definitions* here, kernels from XLA/Pallas lowering (SURVEY §2.2 "→
+TPU"). Importing this package populates the registry and attaches NDArray methods,
+playing the role of the reference's import-time Python codegen from the C op registry
+(python/mxnet/ndarray/register.py:143-157).
+"""
+from . import elemwise  # noqa: F401
+from . import reduce  # noqa: F401
+from . import matrix  # noqa: F401
+from . import init_ops  # noqa: F401
+from . import nn  # noqa: F401
+from .registry import REGISTRY, attach_methods, get_op, invoke, list_ops, register
+
+# families registered after the core five (import order only matters for aliases)
+from . import random_ops  # noqa: F401
+from . import linalg_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401
+from . import contrib_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import control_flow  # noqa: F401
+
+attach_methods()

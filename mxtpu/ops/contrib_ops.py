@@ -1,0 +1,250 @@
+"""Contrib op family (ref: src/operator/contrib/*): detection/bbox ops, resize/pool
+variants, transformer helper, quadratic, fft. Implemented as XLA lowerings; the
+reference's hand CUDA kernels (nms, roi_align, deformable conv) become vectorized
+gather/scatter HLO."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("_contrib_div_sqrt_dim", aliases=("div_sqrt_dim",))
+def div_sqrt_dim(data):
+    """Scale by 1/sqrt(last dim) — the attention-score helper
+    (ref: src/operator/contrib/transformer.cc)."""
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], dtype=data.dtype))
+
+
+@register("quadratic", aliases=("_contrib_quadratic",))
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """Ref: src/operator/contrib/quadratic_op.cc (the tutorial op)."""
+    return a * data * data + b * data + c
+
+
+@register("_contrib_arange_like")
+def contrib_arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    from .init_ops import arange_like
+    return arange_like(data, start=start, step=step, repeat=repeat, axis=axis)
+
+
+# ----------------------------------------------------------- resize / pooling
+@register("_contrib_BilinearResize2D", aliases=("BilinearResize2D",))
+def BilinearResize2D(data, height=1, width=1, scale_height=None, scale_width=None,
+                     **_ig):
+    """Ref: src/operator/contrib/bilinear_resize.cc."""
+    n, c, h, w = data.shape
+    if scale_height is not None:
+        height, width = int(h * scale_height), int(w * scale_width)
+    return jax.image.resize(data, (n, c, height, width), method="bilinear")
+
+
+@register("_contrib_AdaptiveAvgPooling2D", aliases=("AdaptiveAvgPooling2D",))
+def AdaptiveAvgPooling2D(data, output_size=None, **_ig):
+    """Ref: src/operator/contrib/adaptive_avg_pooling.cc."""
+    n, c, h, w = data.shape
+    if output_size is None:
+        oh = ow = 1
+    elif isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    # decompose into resize-style mean pooling (exact when divisible)
+    if h % oh == 0 and w % ow == 0:
+        x = data.reshape(n, c, oh, h // oh, ow, w // ow)
+        return x.mean(axis=(3, 5))
+    return jax.image.resize(data, (n, c, oh, ow), method="linear")
+
+
+# ------------------------------------------------------------------ boxes
+@register("_contrib_box_iou", aliases=("box_iou",))
+def box_iou(lhs, rhs, format="corner"):  # noqa: A002
+    """Pairwise IoU (ref: src/operator/contrib/bounding_box.cc box_iou)."""
+    def to_corner(b):
+        if format == "center":
+            x, y, w, h = jnp.split(b, 4, axis=-1)
+            return jnp.concatenate([x - w / 2, y - h / 2, x + w / 2, y + h / 2], -1)
+        return b
+
+    a = to_corner(lhs)[..., :, None, :]
+    b = to_corner(rhs)[..., None, :, :]
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:], b[..., 2:])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1])
+    area_b = (b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1])
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+@register("_contrib_box_nms", aliases=("box_nms",))
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+            score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    """Greedy NMS via a fixed-iteration lax loop (ref: bounding_box.cc BoxNMS).
+    Suppressed boxes get score -1, matching the reference's output convention."""
+    def nms_one(boxes):
+        scores = boxes[:, score_index]
+        coords = boxes[:, coord_start:coord_start + 4]
+        n = boxes.shape[0]
+        order = jnp.argsort(-scores)
+        coords_s = coords[order]
+        valid = scores[order] > valid_thresh
+        if topk > 0:
+            valid = valid & (jnp.arange(n) < topk)
+
+        tl = jnp.maximum(coords_s[:, None, :2], coords_s[None, :, :2])
+        br = jnp.minimum(coords_s[:, None, 2:], coords_s[None, :, 2:])
+        wh = jnp.maximum(br - tl, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        area = (coords_s[:, 2] - coords_s[:, 0]) * (coords_s[:, 3] - coords_s[:, 1])
+        iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-12)
+
+        def body(i, keep):
+            sup = (iou[i] > overlap_thresh) & (jnp.arange(n) > i) & keep[i]
+            return keep & ~sup
+
+        keep = jax.lax.fori_loop(0, n, body, valid)
+        new_scores = jnp.where(keep, scores[order], -1.0)
+        out = boxes[order].at[:, score_index].set(new_scores)
+        return out
+
+    if data.ndim == 2:
+        return nms_one(data)
+    return jax.vmap(nms_one)(data)
+
+
+@register("_contrib_ROIAlign", aliases=("ROIAlign",))
+def ROIAlign(data, rois, pooled_size=(7, 7), spatial_scale=1.0, sample_ratio=-1,
+             position_sensitive=False):
+    """ROI Align (ref: src/operator/contrib/roi_align.cc) via bilinear gather."""
+    ph, pw = pooled_size if not isinstance(pooled_size, int) else (pooled_size, pooled_size)
+    n, c, h, w = data.shape
+    sr = 2 if sample_ratio <= 0 else sample_ratio
+
+    def one_roi(roi):
+        batch_id = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
+            roi[3] * spatial_scale, roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w, bin_h = rw / pw, rh / ph
+        # sample grid (ph*sr, pw*sr)
+        ys = y1 + (jnp.arange(ph * sr) + 0.5) * bin_h / sr
+        xs = x1 + (jnp.arange(pw * sr) + 0.5) * bin_w / sr
+        yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+        img = data[batch_id]  # (c, h, w)
+
+        y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = jnp.clip(yy, 0, h - 1) - y0
+        wx = jnp.clip(xx, 0, w - 1) - x0
+        y0, x0, y1i, x1i = y0.astype(jnp.int32), x0.astype(jnp.int32), \
+            y1i.astype(jnp.int32), x1i.astype(jnp.int32)
+        v = (img[:, y0, x0] * (1 - wy) * (1 - wx) + img[:, y1i, x0] * wy * (1 - wx)
+             + img[:, y0, x1i] * (1 - wy) * wx + img[:, y1i, x1i] * wy * wx)
+        v = v.reshape(c, ph, sr, pw, sr).mean(axis=(2, 4))
+        return v
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("ROIPooling")
+def ROIPooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """Max ROI pooling (ref: src/operator/roi_pooling.cc), via ROIAlign-style
+    sampling with max reduction."""
+    ph, pw = pooled_size if not isinstance(pooled_size, int) else (pooled_size, pooled_size)
+    n, c, h, w = data.shape
+
+    def one_roi(roi):
+        batch_id = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        img = data[batch_id]
+        ys = jnp.clip(y1 + (jnp.arange(ph * 2) * rh) // (ph * 2), 0, h - 1)
+        xs = jnp.clip(x1 + (jnp.arange(pw * 2) * rw) // (pw * 2), 0, w - 1)
+        v = img[:, ys[:, None], xs[None, :]]
+        return v.reshape(c, ph, 2, pw, 2).max(axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_fft", aliases=("fft",))
+def fft(data, compute_size=128):
+    """Ref: src/operator/contrib/fft.cc (cuFFT). Real→interleaved-complex layout."""
+    f = jnp.fft.fft(data, axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (-1,)).astype(jnp.float32)
+
+
+@register("_contrib_ifft", aliases=("ifft",))
+def ifft(data, compute_size=128):
+    c = data.reshape(data.shape[:-1] + (-1, 2))
+    z = c[..., 0] + 1j * c[..., 1]
+    return jnp.real(jnp.fft.ifft(z, axis=-1)).astype(jnp.float32) * z.shape[-1]
+
+
+@register("_contrib_count_sketch", aliases=("count_sketch",))
+def count_sketch(data, h, s, out_dim=16, processing_batch_size=32):
+    """Count sketch projection (ref: src/operator/contrib/count_sketch.cc)."""
+    hh = h.astype(jnp.int32).reshape(-1)
+    ss = s.reshape(-1)
+    proj = jnp.zeros(data.shape[:-1] + (out_dim,), data.dtype)
+    vals = data * ss
+    return proj.at[..., hh % out_dim].add(vals)
+
+
+@register("GridGenerator")
+def GridGenerator(data, transform_type="affine", target_shape=(0, 0)):
+    """Ref: src/operator/grid_generator.cc."""
+    h, w = target_shape
+    if transform_type == "affine":
+        n = data.shape[0]
+        theta = data.reshape(n, 2, 3)
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+        yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+        grid = jnp.stack([xx, yy, jnp.ones_like(xx)], axis=0).reshape(3, -1)
+        out = jnp.matmul(theta, grid)  # (n, 2, h*w)
+        return out.reshape(n, 2, h, w)
+    return data  # warp type passes flow through
+
+
+@register("BilinearSampler")
+def BilinearSampler(data, grid, cudnn_off=None):
+    """Bilinear sampling by normalized grid (ref: src/operator/bilinear_sampler.cc)."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1) * (w - 1) / 2
+    gy = (grid[:, 1] + 1) * (h - 1) / 2
+
+    def sample_one(img, x, y):
+        x0 = jnp.clip(jnp.floor(x), 0, w - 1)
+        y0 = jnp.clip(jnp.floor(y), 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        wx = jnp.clip(x, 0, w - 1) - x0
+        wy = jnp.clip(y, 0, h - 1) - y0
+        x0i, y0i, x1i, y1i = x0.astype(jnp.int32), y0.astype(jnp.int32), \
+            x1.astype(jnp.int32), y1.astype(jnp.int32)
+        v = (img[:, y0i, x0i] * (1 - wy) * (1 - wx) + img[:, y1i, x0i] * wy * (1 - wx)
+             + img[:, y0i, x1i] * (1 - wy) * wx + img[:, y1i, x1i] * wy * wx)
+        in_bound = (x >= 0) & (x <= w - 1) & (y >= 0) & (y <= h - 1)
+        return v * in_bound.astype(v.dtype)
+
+    return jax.vmap(sample_one)(data, gx, gy)
+
+
+@register("SpatialTransformer")
+def SpatialTransformer(data, loc, target_shape=(0, 0), transform_type="affine",
+                       sampler_type="bilinear", cudnn_off=None):
+    """Ref: src/operator/spatial_transformer.cc = GridGenerator + BilinearSampler."""
+    from .registry import get_op
+    g = get_op("GridGenerator").fn(loc, transform_type="affine", target_shape=target_shape)
+    return get_op("BilinearSampler").fn(data, g)

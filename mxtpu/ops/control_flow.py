@@ -1,0 +1,130 @@
+"""Functional control-flow ops: foreach / while_loop / cond.
+
+Reference: src/operator/control_flow.cc:476-532 — subgraphs executed as CachedOps
+with state threading. TPU-native: these map *directly* onto XLA's structured control
+flow (lax.scan / lax.while_loop / lax.cond), which is the whole point of functional
+control flow on a compiler backend — the reference had to interpret the subgraph per
+iteration; XLA compiles the body once.
+
+The Python surface mirrors mxnet.ndarray.contrib.foreach/while_loop/cond: body
+functions take and return NDArrays.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import autograd
+from ..ndarray.ndarray import NDArray, _apply
+from .registry import register
+
+
+def _wrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda d: NDArray(d), tree, is_leaf=lambda x: isinstance(x, jax.Array))
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x._data if isinstance(x, NDArray) else jnp.asarray(x), tree,
+        is_leaf=lambda x: isinstance(x, NDArray) or not isinstance(x, (list, tuple, dict)))
+
+
+@register("foreach", aliases=("_foreach",), wrap=False)
+def foreach(body, data, init_states):
+    """Scan `body(x_t, states) -> (out_t, new_states)` over axis 0 of data
+    (ref: control_flow.cc `_foreach`). Lowered to one lax.scan."""
+    single_data = isinstance(data, NDArray)
+    data_t = data._data if single_data else [d._data for d in data]
+    single_state = isinstance(init_states, NDArray)
+    states_t = init_states._data if single_state else [s._data for s in init_states]
+
+    def scan_body(carry, x):
+        x_nd = NDArray(x) if single_data else [NDArray(v) for v in x]
+        c_nd = NDArray(carry) if single_state else [NDArray(v) for v in carry]
+        with autograd.pause():
+            out, new_states = body(x_nd, c_nd)
+        out_t = out._data if isinstance(out, NDArray) else [o._data for o in out]
+        ns_t = new_states._data if isinstance(new_states, NDArray) \
+            else [s._data for s in new_states]
+        return ns_t, out_t
+
+    def fn(*flat_in):
+        k = 1 if single_data else len(data_t)
+        d = flat_in[0] if single_data else list(flat_in[:k])
+        s = flat_in[k] if single_state else list(flat_in[k:])
+        final, outs = lax.scan(scan_body, s, d)
+        flat_outs = [outs] if not isinstance(outs, (list, tuple)) else list(outs)
+        flat_final = [final] if not isinstance(final, (list, tuple)) else list(final)
+        return tuple(flat_outs + flat_final)
+
+    inputs = ([data] if single_data else list(data)) + \
+        ([init_states] if single_state else list(init_states))
+    results = _apply(fn, tuple(inputs), name="foreach")
+    # probe structure with one eager step to split outputs vs states
+    n_states = 1 if single_state else len(states_t)
+    n_outs = len(results) - n_states
+    outs = results[0] if n_outs == 1 else results[:n_outs]
+    finals = results[n_outs] if n_states == 1 else results[n_outs:]
+    return outs, finals
+
+
+@register("while_loop", aliases=("_while_loop",), wrap=False)
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Ref: control_flow.cc `_while_loop`. Stacked per-step outputs are not
+    supported in the XLA lowering (dynamic trip count); state threading is.
+    Returns ([], final_loop_vars) to match the mxnet.ndarray.contrib signature."""
+    single = isinstance(loop_vars, NDArray)
+    vars_list = [loop_vars] if single else list(loop_vars)
+
+    def fn(*flat):
+        def c(v):
+            nd = [NDArray(x) for x in v]
+            with autograd.pause():
+                r = cond(*nd)
+            r = r._data if isinstance(r, NDArray) else r
+            return jnp.reshape(r.astype(jnp.bool_), ())
+
+        def b(v):
+            nd = [NDArray(x) for x in v]
+            with autograd.pause():
+                out = func(*nd)
+            if isinstance(out, NDArray):
+                out = [out]
+            return tuple(o._data if isinstance(o, NDArray) else o for o in out)
+
+        return lax.while_loop(c, b, tuple(flat))
+
+    res = _apply(fn, tuple(vars_list), name="while_loop")
+    return [], (res[0] if single else res)
+
+
+@register("cond", aliases=("_cond",), wrap=False)
+def cond(pred, then_func, else_func, inputs=None):
+    """Ref: control_flow.cc `_cond`. Both branches are traced and compiled;
+    XLA executes one (lax.cond)."""
+    if inputs is None:
+        inputs = []
+    if isinstance(inputs, NDArray):
+        inputs = [inputs]
+    pred_nd = pred if isinstance(pred, NDArray) else NDArray(jnp.asarray(pred))
+
+    def fn(p, *flat):
+        def t(v):
+            with autograd.pause():
+                out = then_func(*[NDArray(x) for x in v])
+            out_l = out if isinstance(out, (list, tuple)) else [out]
+            return tuple(o._data for o in out_l)
+
+        def e(v):
+            with autograd.pause():
+                out = else_func(*[NDArray(x) for x in v])
+            out_l = out if isinstance(out, (list, tuple)) else [out]
+            return tuple(o._data for o in out_l)
+
+        return lax.cond(jnp.reshape(p.astype(jnp.bool_), ()), t, e, flat)
+
+    res = _apply(fn, tuple([pred_nd] + list(inputs)), name="cond")
+    return res if isinstance(res, list) and len(res) > 1 else \
+        (res[0] if isinstance(res, list) else res)

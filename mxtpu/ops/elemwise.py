@@ -1,0 +1,187 @@
+"""Elementwise unary/binary/scalar/logic op families.
+
+Reference: src/operator/tensor/elemwise_unary_op_basic.cc, elemwise_binary_op*.cc,
+elemwise_binary_broadcast_op*.cc, elemwise_binary_scalar_op*.cc and the scalar-math
+functor zoo in src/operator/mshadow_op.h. Each reference op is an (-inl.h, .cc, .cu)
+kernel triple; here each is a one-line XLA lowering — fusion is the compiler's job
+(the reference needed hand-bulked engine segments for the same effect,
+src/executor/graph_executor.cc:1187).
+
+MXNet distinguishes ``elemwise_*`` (same-shape) from ``broadcast_*`` (numpy broadcast);
+both map to the same XLA HLO here, and the scalar variants (``_plus_scalar`` …) are the
+same lowering with a python scalar operand.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax
+
+from .registry import register
+
+_f32 = jnp.float32
+
+
+def _u(name, fn, aliases=(), as_method=True):
+    """Register a unary op."""
+    return register(name, aliases=aliases, as_method=as_method)(fn)
+
+
+# ---------------------------------------------------------------- unary math
+abs_ = _u("abs", lambda x: jnp.abs(x))
+sign = _u("sign", lambda x: jnp.sign(x))
+rint = _u("rint", lambda x: jnp.rint(x))
+round_ = _u("round", lambda x: jnp.round(x))
+ceil = _u("ceil", lambda x: jnp.ceil(x))
+floor = _u("floor", lambda x: jnp.floor(x))
+trunc = _u("trunc", lambda x: jnp.trunc(x))
+fix = _u("fix", lambda x: jnp.fix(x))
+square = _u("square", lambda x: jnp.square(x))
+sqrt = _u("sqrt", lambda x: jnp.sqrt(x))
+rsqrt = _u("rsqrt", lambda x: jax.lax.rsqrt(x))
+cbrt = _u("cbrt", lambda x: jnp.cbrt(x))
+rcbrt = _u("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+exp = _u("exp", lambda x: jnp.exp(x))
+log = _u("log", lambda x: jnp.log(x))
+log10 = _u("log10", lambda x: jnp.log10(x))
+log2 = _u("log2", lambda x: jnp.log2(x))
+log1p = _u("log1p", lambda x: jnp.log1p(x))
+expm1 = _u("expm1", lambda x: jnp.expm1(x))
+gamma = _u("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+gammaln = _u("gammaln", lambda x: jax.scipy.special.gammaln(x))
+erf = _u("erf", lambda x: jax.scipy.special.erf(x))
+erfinv = _u("erfinv", lambda x: jax.scipy.special.erfinv(x))
+sin = _u("sin", lambda x: jnp.sin(x))
+cos = _u("cos", lambda x: jnp.cos(x))
+tan = _u("tan", lambda x: jnp.tan(x))
+arcsin = _u("arcsin", lambda x: jnp.arcsin(x))
+arccos = _u("arccos", lambda x: jnp.arccos(x))
+arctan = _u("arctan", lambda x: jnp.arctan(x))
+sinh = _u("sinh", lambda x: jnp.sinh(x))
+cosh = _u("cosh", lambda x: jnp.cosh(x))
+tanh = _u("tanh", lambda x: jnp.tanh(x))
+arcsinh = _u("arcsinh", lambda x: jnp.arcsinh(x))
+arccosh = _u("arccosh", lambda x: jnp.arccosh(x))
+arctanh = _u("arctanh", lambda x: jnp.arctanh(x))
+degrees = _u("degrees", lambda x: jnp.degrees(x))
+radians = _u("radians", lambda x: jnp.radians(x))
+reciprocal = _u("reciprocal", lambda x: 1.0 / x)
+negative = _u("negative", lambda x: jnp.negative(x))
+logical_not = _u("logical_not", lambda x: jnp.logical_not(x).astype(_f32))
+relu = _u("relu", lambda x: jnp.maximum(x, 0))
+sigmoid = _u("sigmoid", lambda x: jax.nn.sigmoid(x))
+softsign = _u("softsign", lambda x: x / (1.0 + jnp.abs(x)))
+identity = _u("identity", lambda x: x, aliases=("_copy",), as_method=False)
+
+
+@register("BlockGrad", aliases=("stop_gradient",), as_method=True)
+def BlockGrad(x):
+    """Stop gradient flow (ref: src/operator/tensor/elemwise_unary_op_basic.cc
+    BlockGrad; MakeLoss sibling)."""
+    return jax.lax.stop_gradient(x)
+
+
+@register("make_loss", aliases=("MakeLoss",))
+def make_loss(x, grad_scale=1.0, **_ignored):
+    """Head marker whose gradient is ``grad_scale`` (ref: src/operator/make_loss.cc)."""
+    @jax.custom_vjp
+    def _loss(v):
+        return v
+
+    def _fwd(v):
+        return v, None
+
+    def _bwd(_, g):
+        return (jnp.full_like(g, grad_scale),)
+
+    _loss.defvjp(_fwd, _bwd)
+    return _loss(x)
+
+
+# ---------------------------------------------------------------- binary
+def _b(name, fn, aliases=(), as_method=False):
+    return register(name, aliases=aliases, as_method=as_method)(fn)
+
+
+broadcast_add = _b("broadcast_add", lambda a, b: jnp.add(a, b),
+                   aliases=("elemwise_add", "_plus_scalar", "_add"))
+broadcast_sub = _b("broadcast_sub", lambda a, b: jnp.subtract(a, b),
+                   aliases=("elemwise_sub", "_minus_scalar", "_sub"))
+broadcast_mul = _b("broadcast_mul", lambda a, b: jnp.multiply(a, b),
+                   aliases=("elemwise_mul", "_mul_scalar", "_mul"))
+broadcast_div = _b("broadcast_div", lambda a, b: jnp.divide(a, b),
+                   aliases=("elemwise_div", "_div_scalar", "_div"))
+broadcast_mod = _b("broadcast_mod", lambda a, b: jnp.mod(a, b), aliases=("_mod_scalar",))
+broadcast_power = _b("broadcast_power", lambda a, b: jnp.power(a, b),
+                     aliases=("_power_scalar", "_power"))
+broadcast_maximum = _b("broadcast_maximum", lambda a, b: jnp.maximum(a, b),
+                       aliases=("_maximum_scalar", "_maximum", "maximum"))
+broadcast_minimum = _b("broadcast_minimum", lambda a, b: jnp.minimum(a, b),
+                       aliases=("_minimum_scalar", "_minimum", "minimum"))
+broadcast_hypot = _b("broadcast_hypot", lambda a, b: jnp.hypot(a, b))
+_rminus_scalar = _b("_rminus_scalar", lambda a, b: jnp.subtract(b, a))
+_rdiv_scalar = _b("_rdiv_scalar", lambda a, b: jnp.divide(b, a))
+_rpower_scalar = _b("_rpower_scalar", lambda a, b: jnp.power(b, a))
+arctan2 = _b("arctan2", lambda a, b: jnp.arctan2(a, b), aliases=("_arctan2",))
+ldexp = _b("ldexp", lambda a, b: a * jnp.power(2.0, b))
+
+broadcast_equal = _b("broadcast_equal", lambda a, b: jnp.equal(a, b).astype(_f32),
+                     aliases=("_equal", "_equal_scalar"))
+broadcast_not_equal = _b("broadcast_not_equal", lambda a, b: jnp.not_equal(a, b).astype(_f32),
+                         aliases=("_not_equal", "_not_equal_scalar"))
+broadcast_greater = _b("broadcast_greater", lambda a, b: jnp.greater(a, b).astype(_f32),
+                       aliases=("_greater", "_greater_scalar"))
+broadcast_greater_equal = _b("broadcast_greater_equal",
+                             lambda a, b: jnp.greater_equal(a, b).astype(_f32),
+                             aliases=("_greater_equal", "_greater_equal_scalar"))
+broadcast_lesser = _b("broadcast_lesser", lambda a, b: jnp.less(a, b).astype(_f32),
+                      aliases=("_lesser", "_lesser_scalar"))
+broadcast_lesser_equal = _b("broadcast_lesser_equal",
+                            lambda a, b: jnp.less_equal(a, b).astype(_f32),
+                            aliases=("_lesser_equal", "_lesser_equal_scalar"))
+broadcast_logical_and = _b("broadcast_logical_and",
+                           lambda a, b: jnp.logical_and(a, b).astype(_f32),
+                           aliases=("_logical_and",))
+broadcast_logical_or = _b("broadcast_logical_or",
+                          lambda a, b: jnp.logical_or(a, b).astype(_f32),
+                          aliases=("_logical_or",))
+broadcast_logical_xor = _b("broadcast_logical_xor",
+                           lambda a, b: jnp.logical_xor(a, b).astype(_f32),
+                           aliases=("_logical_xor",))
+
+
+@register("smooth_l1")
+def smooth_l1(x, scalar=1.0):
+    """Huber-like smooth L1 (ref: src/operator/tensor/elemwise_binary_scalar_op_extended.cc
+    smooth_l1; mshadow_op.h smooth_l1_loss)."""
+    s2 = scalar * scalar
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0 / s2, 0.5 * s2 * jnp.square(x), ax - 0.5 / s2)
+
+
+@register("clip", as_method=True)
+def clip(x, a_min=None, a_max=None):
+    """Clamp (ref: src/operator/tensor/matrix_op.cc clip). Gradient is zero outside
+    the interval, matching the reference's clip backward."""
+    return jnp.clip(x, a_min, a_max)
+
+
+@register("elemwise_sum", aliases=("add_n", "ElementWiseSum"))
+def elemwise_sum(*args):
+    """Sum of N arrays in one fused HLO (ref: src/ndarray/ndarray.cc:1280
+    ElementwiseSum; the engine bulked these — XLA fuses them)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register("where")
+def where(condition, x, y):
+    """Select by condition (ref: src/operator/tensor/control_flow_op.cc where)."""
+    return jnp.where(condition.astype(bool) if condition.dtype != jnp.bool_ else condition, x, y)
+
+
+@register("cast", aliases=("Cast",), as_method=False)
+def cast(x, dtype="float32"):
+    from ..ndarray.ndarray import _as_jax_dtype
+    return x.astype(_as_jax_dtype(dtype))

@@ -1,0 +1,89 @@
+"""Array-creation ops (ref: src/operator/tensor/init_op.cc — zeros/ones/full/arange/
+linspace/eye and the *_like family). These take no NDArray inputs, so they return fresh
+arrays with no tape linkage."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import Context
+from ..ndarray.ndarray import NDArray, _as_jax_dtype
+from .registry import register
+
+
+def _place(data, ctx):
+    if ctx is not None:
+        import jax
+        data = jax.device_put(data, Context(ctx).jax_device() if not isinstance(ctx, Context) else ctx.jax_device())
+    return NDArray(data)
+
+
+@register("zeros", aliases=("_zeros",), wrap=False)
+def zeros(shape, ctx=None, dtype="float32", stype=None, **_ig):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _place(jnp.zeros(tuple(shape), _as_jax_dtype(dtype)), ctx)
+
+
+@register("ones", aliases=("_ones",), wrap=False)
+def ones(shape, ctx=None, dtype="float32", **_ig):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _place(jnp.ones(tuple(shape), _as_jax_dtype(dtype)), ctx)
+
+
+@register("full", aliases=("_full",), wrap=False)
+def full(shape, val=0.0, ctx=None, dtype="float32", **_ig):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _place(jnp.full(tuple(shape), val, _as_jax_dtype(dtype)), ctx)
+
+
+@register("empty", wrap=False)
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+@register("arange", aliases=("_arange",), wrap=False)
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32", **_ig):
+    arr = jnp.arange(start, stop, step, dtype=_as_jax_dtype(dtype))
+    if repeat > 1:
+        arr = jnp.repeat(arr, repeat)
+    return _place(arr, ctx)
+
+
+@register("linspace", wrap=False)
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32"):
+    return _place(jnp.linspace(start, stop, num, endpoint=endpoint,
+                               dtype=_as_jax_dtype(dtype)), ctx)
+
+
+@register("eye", aliases=("_eye",), wrap=False)
+def eye(N, M=0, k=0, ctx=None, dtype="float32", **_ig):
+    return _place(jnp.eye(N, M if M else None, k=k, dtype=_as_jax_dtype(dtype)), ctx)
+
+
+@register("zeros_like", as_method=False)
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like", as_method=False)
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register("full_like")
+def full_like(x, fill_value=0.0):
+    return jnp.full_like(x, fill_value)
+
+
+@register("arange_like")
+def arange_like(x, start=0.0, step=1.0, repeat=1, axis=None):
+    if axis is None:
+        n = x.size
+        shape = x.shape
+    else:
+        n = x.shape[axis]
+        shape = (n,)
+    arr = jnp.arange(start, start + step * n, step, dtype=jnp.float32)[:n]
+    return jnp.reshape(arr, shape) if axis is None else arr

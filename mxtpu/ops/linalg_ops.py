@@ -1,0 +1,111 @@
+"""Linear-algebra op family (ref: src/operator/tensor/la_op.cc + c_lapack_api.h —
+LAPACK-on-CPU/cuSOLVER-on-GPU in the reference; here XLA's native decompositions,
+which lower to MXU matmuls + host offload where required)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("linalg_gemm")
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0,
+                axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("linalg_gemm2")
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf")
+def linalg_potrf(A):
+    """Cholesky (ref: la_op.cc potrf)."""
+    return jnp.linalg.cholesky(A)
+
+
+@register("linalg_potri")
+def linalg_potri(A):
+    """Inverse from Cholesky factor (ref: la_op.cc potri)."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    inv_l = jax.scipy.linalg.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(inv_l, -1, -2), inv_l)
+
+
+@register("linalg_trmm")
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * (jnp.matmul(B, a) if rightside else jnp.matmul(a, B))
+
+
+@register("linalg_trsm")
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    if rightside:
+        # X A = alpha B  =>  A^T X^T = alpha B^T; transposing flips lower/upper
+        a = jnp.swapaxes(A, -1, -2)
+        eff_lower = lower if transpose else not lower
+        x = jax.scipy.linalg.solve_triangular(
+            a if not transpose else A, jnp.swapaxes(alpha * B, -1, -2),
+            lower=eff_lower, trans=0)
+        return jnp.swapaxes(x, -1, -2)
+    return jax.scipy.linalg.solve_triangular(A, alpha * B, lower=lower,
+                                             trans=1 if transpose else 0)
+
+
+@register("linalg_sumlogdiag")
+def linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_syrk")
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
+
+
+@register("linalg_gelqf", num_outputs=2)
+def linalg_gelqf(A):
+    """LQ factorization (ref: la_op.cc gelqf). A = L Q with Q orthonormal rows."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return [jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)]
+
+
+@register("linalg_syevd", num_outputs=2)
+def linalg_syevd(A):
+    """Symmetric eigendecomposition (ref: la_op.cc syevd): returns (U, L) with
+    A = U^T diag(L) U."""
+    w, v = jnp.linalg.eigh(A)
+    return [jnp.swapaxes(v, -1, -2), w]
+
+
+@register("linalg_makediag")
+def linalg_makediag(A, offset=0):
+    return jnp.zeros(A.shape + (A.shape[-1],), A.dtype) + jnp.expand_dims(A, -2) * \
+        jnp.eye(A.shape[-1], dtype=A.dtype)
+
+
+@register("linalg_extractdiag")
+def linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_inverse", aliases=("inverse",))
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("linalg_det", aliases=("det",))
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("linalg_slogdet", aliases=("slogdet",), num_outputs=2)
+def linalg_slogdet(A):
+    sign, ld = jnp.linalg.slogdet(A)
+    return [sign, ld]

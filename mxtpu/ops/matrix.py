@@ -1,0 +1,314 @@
+"""Shape-manipulation, indexing, ordering and dot ops.
+
+Reference: src/operator/tensor/matrix_op.cc (reshape/transpose/slice/clip/repeat/tile/
+flip/depth-space), indexing_op.cc (take/Embedding/gather_nd/scatter_nd/one_hot),
+ordering_op.cc (sort/argsort/topk), dot-inl.h (dot/batch_dot), init_op.cc.
+All become single XLA HLOs; the reference's hand-written CUDA gather/scatter/sort
+kernels are subsumed by XLA's lowering (sort → variadic HLO Sort, take → Gather).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+# ------------------------------------------------------------------ shape
+@register("Reshape", aliases=("reshape",), as_method=False)
+def Reshape(x, shape=None, reverse=False, **_ig):
+    """MXNet reshape with special codes 0 (copy dim) and -1 (infer); -2/-3/-4 codes
+    (ref matrix_op.cc ReshapeParam) supported for the common cases."""
+    src = list(x.shape)
+    if shape is None:
+        raise ValueError("reshape requires target shape")
+    tgt = []
+    src_i = 0
+    shape = list(shape)
+    i = 0
+    while i < len(shape):
+        s = shape[i]
+        if s == 0:
+            tgt.append(src[src_i]); src_i += 1
+        elif s == -1:
+            tgt.append(-1); src_i += 1
+        elif s == -2:  # copy all remaining dims
+            tgt.extend(src[src_i:]); src_i = len(src)
+        elif s == -3:  # merge two dims
+            tgt.append(src[src_i] * src[src_i + 1]); src_i += 2
+        elif s == -4:  # split dim into next two values
+            a, b = shape[i + 1], shape[i + 2]
+            dim = src[src_i]
+            if a == -1:
+                a = dim // b
+            if b == -1:
+                b = dim // a
+            tgt.extend([a, b]); src_i += 1; i += 2
+        else:
+            tgt.append(s); src_i += 1
+        i += 1
+    return jnp.reshape(x, tuple(tgt))
+
+
+@register("Flatten", aliases=("flatten",), as_method=False)
+def Flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("transpose", as_method=False)
+def transpose(x, axes=None):
+    axes = tuple(axes) if axes else None
+    return jnp.transpose(x, axes)
+
+
+@register("expand_dims", as_method=False)
+def expand_dims(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+@register("squeeze", as_method=False)
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis)
+
+
+@register("Concat", aliases=("concat", "concatenate"), as_method=False)
+def Concat(*args, dim=1, axis=None, num_args=None):
+    ax = axis if axis is not None else dim
+    return jnp.concatenate(args, axis=ax)
+
+
+@register("stack", as_method=False)
+def stack(*args, axis=0, num_args=None):
+    return jnp.stack(args, axis=axis)
+
+
+@register("SliceChannel", aliases=("split",), as_method=False)
+def SliceChannel(x, num_outputs=1, axis=1, squeeze_axis=False):
+    outs = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        outs = [jnp.squeeze(o, axis=axis) for o in outs]
+    return outs if num_outputs > 1 else outs[0]
+
+
+@register("slice", aliases=("crop",), as_method=False)
+def slice_(x, begin=(), end=(), step=()):
+    idx = []
+    step = step or [None] * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(slice(b, e, s))
+    return x[tuple(idx)]
+
+
+@register("slice_axis", as_method=True)
+def slice_axis(x, axis=0, begin=0, end=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like", as_method=True)
+def slice_like(x, shape_like, axes=()):
+    axes = axes or range(min(x.ndim, shape_like.ndim))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a] = slice(0, shape_like.shape[a])
+    return x[tuple(idx)]
+
+
+@register("tile", as_method=True)
+def tile(x, reps=()):
+    return jnp.tile(x, tuple(reps))
+
+
+@register("repeat", as_method=True)
+def repeat(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("pad", aliases=("Pad",), as_method=True)
+def pad(x, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pw, mode=jmode, constant_values=constant_value)
+    return jnp.pad(x, pw, mode=jmode)
+
+
+@register("reverse", aliases=("flip",), as_method=True)
+def reverse(x, axis=()):
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(x, axis=tuple(axis))
+
+
+@register("depth_to_space")
+def depth_to_space(x, block_size):
+    n, c, h, w = x.shape
+    b = block_size
+    y = jnp.reshape(x, (n, b, b, c // (b * b), h, w))
+    y = jnp.transpose(y, (0, 3, 4, 1, 5, 2))
+    return jnp.reshape(y, (n, c // (b * b), h * b, w * b))
+
+
+@register("space_to_depth")
+def space_to_depth(x, block_size):
+    n, c, h, w = x.shape
+    b = block_size
+    y = jnp.reshape(x, (n, c, h // b, b, w // b, b))
+    y = jnp.transpose(y, (0, 3, 5, 1, 2, 4))
+    return jnp.reshape(y, (n, c * b * b, h // b, w // b))
+
+
+@register("diag", as_method=True)
+def diag(x, k=0, **_ig):
+    if x.ndim == 1:
+        return jnp.diag(x, k=k)
+    return jnp.diagonal(x, offset=k, axis1=-2, axis2=-1)
+
+
+@register("swapaxes", aliases=("SwapAxis",), as_method=False)
+def swapaxes(x, dim1=0, dim2=0):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("shape_array")
+def shape_array(x):
+    return jnp.asarray(x.shape, dtype=jnp.int64)
+
+
+@register("size_array")
+def size_array(x):
+    return jnp.asarray([x.size], dtype=jnp.int64)
+
+
+# ------------------------------------------------------------------ indexing
+@register("take", as_method=True)
+def take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    elif mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    idx = jnp.clip(indices.astype(jnp.int32), 0, a.shape[1] - 1)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register("Embedding")
+def Embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False):
+    """Embedding lookup (ref: src/operator/tensor/indexing_op.cc Embedding).
+    Lowered to HLO Gather — the MXU-free path; the row-sparse gradient of the
+    reference becomes a scatter-add which XLA emits for the vjp automatically."""
+    idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    """Ref: indexing_op.cc gather_nd. indices shape (M, ...) indexes the first M dims."""
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, shape=()):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, indices, rhs, shape=()):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return lhs.at[tuple(idx[i] for i in range(m))].set(rhs)
+
+
+@register("one_hot", as_method=True)
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..ndarray.ndarray import _as_jax_dtype
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    return (oh * (on_value - off_value) + off_value).astype(_as_jax_dtype(dtype))
+
+
+@register("index_copy")
+def index_copy(old, index, new):
+    """Ref: src/operator/contrib/index_copy.cc."""
+    return old.at[index.astype(jnp.int32)].set(new)
+
+
+# ------------------------------------------------------------------ ordering
+@register("sort", as_method=True)
+def sort(x, axis=-1, is_ascend=True):
+    y = jnp.sort(x, axis=axis)
+    return y if is_ascend else jnp.flip(y, axis=axis)
+
+
+@register("argsort", as_method=True)
+def argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    idx = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(jnp.float32)
+
+
+@register("topk", as_method=True)
+def topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """Ref: src/operator/tensor/ordering_op.cc TopK. On TPU lowered to HLO Sort/TopK."""
+    xm = jnp.moveaxis(x, axis, -1)
+    if is_ascend:
+        vals, idx_int = jax.lax.top_k(-xm, k)
+        vals = -vals
+    else:
+        vals, idx_int = jax.lax.top_k(xm, k)
+    if ret_typ == "mask":
+        mask = jnp.sum(jax.nn.one_hot(idx_int, xm.shape[-1]), axis=-2)
+        return jnp.moveaxis(mask, -1, axis)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx_int, -1, axis).astype(jnp.float32)
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return [vals, idx]
+    raise ValueError("unknown ret_typ " + ret_typ)
+
+
+# ------------------------------------------------------------------ dot
+@register("dot", as_method=True)
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
+    """General dot (ref: src/operator/tensor/dot-inl.h). MXU-bound: contracts the
+    last axis of lhs with the first of rhs (tensor-dot semantics for ndim>2)."""
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a and lhs.ndim >= 2 else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b and rhs.ndim >= 2 else rhs
+    if transpose_a and lhs.ndim > 2:
+        a = jnp.transpose(lhs, tuple(range(lhs.ndim))[::-1])
+    if transpose_b and rhs.ndim > 2:
+        b = jnp.transpose(rhs, tuple(range(rhs.ndim))[::-1])
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return jnp.tensordot(a, b, axes=([-1], [0]))
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao")
+def khatri_rao(*args):
+    """Column-wise Khatri-Rao product (ref: src/operator/contrib/krprod.cc)."""
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("ik,jk->ijk", out, m).reshape(-1, out.shape[1])
+    return out

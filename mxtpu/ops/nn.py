@@ -1,0 +1,562 @@
+"""Neural-network ops: the FLOP-carrying layer of the framework.
+
+Reference: src/operator/nn/* — each op is an (-inl.h, .cc, .cu) kernel triple with
+cuDNN/MKL-DNN backends and an autotuned algo registry (cudnn_algoreg-inl.h).
+
+TPU-native re-design: every op lowers to the XLA HLO that maps onto the MXU/VPU —
+``lax.conv_general_dilated`` (MXU), ``lax.reduce_window`` (VPU), ``jax.nn.*`` — and
+XLA's own autotuner/fusion replaces the cuDNN algo registry and MKL-DNN format
+machinery. Layouts: the reference is NCHW-only; here every spatial op takes a
+``layout`` attr and NHWC is preferred on TPU (channels-last vectorizes on the 8x128
+VPU and feeds the MXU without transposes) while NCHW remains the API default for
+reference parity — XLA inserts the transposes when needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import autograd
+from ..random import next_key
+from .registry import register
+
+
+def _pair(v, n=2):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+# ------------------------------------------------------------- dense / conv
+@register("FullyConnected", aliases=("fully_connected",))
+def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                   flatten=True):
+    """y = x W^T + b (ref: src/operator/nn/fully_connected.cc:239-328).
+
+    Weight layout (num_hidden, in_units) matches the reference exactly so
+    checkpoints are interchangeable. The matmul accumulates in f32 on the MXU
+    (preferred_element_type) even for bf16 inputs.
+    """
+    x = data
+    if flatten and x.ndim > 2:
+        x = jnp.reshape(x, (x.shape[0], -1))
+    y = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32).astype(x.dtype)
+    if bias is not None and not no_bias:
+        y = y + bias
+    return y
+
+
+_LAYOUTS = {
+    1: {"NCW": ("NCH", "OIH", "NCH"), "NWC": ("NHC", "HIO", "NHC")},
+}
+
+
+def _conv_dims(ndim, layout):
+    """Dimension-number strings for lax.conv_general_dilated."""
+    if ndim == 1:
+        if layout in (None, "NCW"):
+            return ("NCH", "OIH", "NCH")
+        return ("NHC", "HIO", "NHC")
+    if ndim == 2:
+        if layout in (None, "NCHW"):
+            return ("NCHW", "OIHW", "NCHW")
+        return ("NHWC", "HWIO", "NHWC")
+    if ndim == 3:
+        if layout in (None, "NCDHW"):
+            return ("NCDHW", "OIDHW", "NCDHW")
+        return ("NDHWC", "DHWIO", "NDHWC")
+    raise ValueError("unsupported conv ndim %d" % ndim)
+
+
+@register("Convolution", aliases=("convolution",))
+def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False, layout=None,
+                workspace=None, cudnn_tune=None, cudnn_off=None):
+    """N-D convolution (ref: src/operator/nn/convolution.cc; CUDA path
+    src/operator/nn/convolution.cu + cudnn wrappers). One HLO ConvGeneralDilated;
+    grouped/depthwise via feature_group_count (the reference needed a dedicated
+    TF-derived depthwise kernel, depthwise_convolution_tf.cuh — here it's the same
+    HLO and XLA picks the kernel)."""
+    ndim = data.ndim - 2
+    kernel = _pair(kernel, ndim)
+    stride = _pair(stride, ndim)
+    dilate = _pair(dilate, ndim)
+    pad = _pair(pad, ndim) if pad is not None else (0,) * ndim
+    dims = _conv_dims(ndim, layout)
+    channels_last = dims[0][-1] == "C"
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dims,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+    if bias is not None and not no_bias:
+        if channels_last:
+            out = out + bias
+        else:
+            out = out + jnp.reshape(bias, (1, -1) + (1,) * ndim)
+    return out
+
+
+@register("Deconvolution", aliases=("deconvolution",))
+def Deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                  pad=None, adj=None, target_shape=None, num_filter=None, num_group=1,
+                  no_bias=True, layout=None, workspace=None, cudnn_tune=None,
+                  cudnn_off=None):
+    """Transposed convolution (ref: src/operator/nn/deconvolution.cc). Implemented as
+    the gradient of Convolution wrt data — lhs-dilated ConvGeneralDilated."""
+    ndim = data.ndim - 2
+    kernel = _pair(kernel, ndim)
+    stride = _pair(stride, ndim)
+    dilate = _pair(dilate, ndim)
+    pad = _pair(pad, ndim) if pad is not None else (0,) * ndim
+    adj = _pair(adj, ndim) if adj is not None else (0,) * ndim
+    dims = _conv_dims(ndim, layout)
+    channels_last = dims[0][-1] == "C"
+    # weight layout (in, out/g, *k) per reference; flip spatial + swap io for transpose
+    spatial_axes = tuple(range(2, 2 + ndim)) if not channels_last else tuple(range(0, ndim))
+    if channels_last:
+        w = jnp.flip(weight, axis=spatial_axes)
+        w = jnp.swapaxes(w, -1, -2)
+    else:
+        w = jnp.flip(weight, axis=spatial_axes)
+        w = jnp.swapaxes(w, 0, 1)
+    padding = []
+    for i in range(ndim):
+        k = (kernel[i] - 1) * dilate[i]
+        padding.append((k - pad[i], k - pad[i] + adj[i]))
+    out = lax.conv_general_dilated(
+        data, w,
+        window_strides=(1,) * ndim,
+        padding=padding,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dims,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+    if bias is not None and not no_bias:
+        if channels_last:
+            out = out + bias
+        else:
+            out = out + jnp.reshape(bias, (1, -1) + (1,) * ndim)
+    return out
+
+
+# ------------------------------------------------------------------ pooling
+@register("Pooling", aliases=("pooling",))
+def Pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
+            pad=None, pooling_convention="valid", count_include_pad=True,
+            layout=None, cudnn_off=None, p_value=None):
+    """Spatial pooling (ref: src/operator/nn/pooling.cc + pool.cuh hand kernels).
+    One HLO ReduceWindow; 'full' (ceil) convention handled via asymmetric padding."""
+    ndim = data.ndim - 2
+    channels_last = layout is not None and layout.endswith("C")
+    sp = tuple(range(1, 1 + ndim)) if channels_last else tuple(range(2, 2 + ndim))
+    if global_pool:
+        if pool_type == "max":
+            return jnp.max(data, axis=sp, keepdims=True)
+        if pool_type in ("avg", "sum"):
+            r = jnp.mean if pool_type == "avg" else jnp.sum
+            return r(data, axis=sp, keepdims=True)
+        if pool_type == "lp":
+            p = p_value or 2
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(data), p), axis=sp, keepdims=True), 1.0 / p)
+    kernel = _pair(kernel, ndim)
+    stride = _pair(stride, ndim) if stride is not None else (1,) * ndim
+    pad = _pair(pad, ndim) if pad is not None else (0,) * ndim
+
+    window = [1] * data.ndim
+    strides = [1] * data.ndim
+    padding = [(0, 0)] * data.ndim
+    for i, a in enumerate(sp):
+        window[a] = kernel[i]
+        strides[a] = stride[i]
+        lo = hi = pad[i]
+        if pooling_convention == "full":
+            size = data.shape[a]
+            out_sz = -(-(size + 2 * pad[i] - kernel[i]) // stride[i]) + 1  # ceil
+            needed = (out_sz - 1) * stride[i] + kernel[i] - size - pad[i]
+            hi = max(hi, needed)
+        padding[a] = (lo, hi)
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
+                                 window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
+                              window, strides, padding)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            denom = 1
+            for i in range(ndim):
+                denom *= kernel[i]
+            return s / denom
+        ones = jnp.ones(data.shape, data.dtype)
+        cnt = lax.reduce_window(ones, jnp.asarray(0, data.dtype), lax.add,
+                                window, strides, padding)
+        return s / cnt
+    if pool_type == "lp":
+        p = p_value or 2
+        s = lax.reduce_window(jnp.power(jnp.abs(data), p), jnp.asarray(0, data.dtype),
+                              lax.add, window, strides, padding)
+        return jnp.power(s, 1.0 / p)
+    raise ValueError("unknown pool_type " + pool_type)
+
+
+@register("UpSampling")
+def UpSampling(*data, scale=1, sample_type="nearest", num_args=1, num_filter=0,
+               multi_input_mode="concat", workspace=None):
+    """Ref: src/operator/nn/upsampling.cc (nearest; bilinear via Deconvolution)."""
+    x = data[0]
+    n, c, h, w = x.shape
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+        return out
+    # bilinear
+    out = jax.image.resize(x, (n, c, h * scale, w * scale), method="bilinear")
+    return out
+
+
+# ----------------------------------------------------------------- softmax
+@register("softmax", aliases=("Softmax",), as_method=True)
+def softmax(x, axis=-1, temperature=None, length=None, **_ig):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    if length is not None:
+        mask = jnp.arange(x.shape[axis]) < jnp.expand_dims(length.astype(jnp.int32), -1)
+        x = jnp.where(mask, x, -jnp.inf)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax", as_method=True)
+def log_softmax(x, axis=-1, temperature=None, **_ig):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("softmin")
+def softmin(x, axis=-1, **_ig):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+@register("SoftmaxActivation")
+def SoftmaxActivation(x, mode="instance"):
+    """Deprecated alias family (ref: src/operator/nn/softmax_activation.cc)."""
+    if mode == "channel":
+        return jax.nn.softmax(x, axis=1)
+    return jax.nn.softmax(x, axis=-1)
+
+
+@register("SoftmaxOutput", aliases=("softmax_output",))
+def SoftmaxOutput(data, label, grad_scale=1.0, ignore_label=-1.0,
+                  multi_output=False, use_ignore=False, preserve_shape=False,
+                  normalization="null", out_grad=False, smooth_alpha=0.0):
+    """Softmax with implicit cross-entropy gradient (ref: src/operator/softmax_output.cc).
+
+    Forward returns softmax(data); the custom vjp makes d(data) = (p - onehot(label))
+    * grad_scale exactly as the reference's fused backward kernel, including
+    ignore_label masking and batch/valid normalization.
+    """
+    axis = 1 if multi_output else -1
+
+    @jax.custom_vjp
+    def _so(d, lab):
+        return jax.nn.softmax(d, axis=axis)
+
+    def _fwd(d, lab):
+        p = jax.nn.softmax(d, axis=axis)
+        return p, (p, lab)
+
+    def _bwd(res, g):
+        p, lab = res
+        li = lab.astype(jnp.int32)
+        nclass = p.shape[axis]
+        oh = jax.nn.one_hot(li, nclass, axis=axis, dtype=p.dtype)
+        if smooth_alpha:
+            oh = oh * (1.0 - smooth_alpha) + smooth_alpha / (nclass - 1) * (1.0 - oh)
+        grad = p - oh
+        if use_ignore:
+            valid = (lab != ignore_label).astype(p.dtype)
+            grad = grad * jnp.expand_dims(valid, axis=axis)
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / lab.shape[0]
+        elif normalization == "valid" and use_ignore:
+            nvalid = jnp.maximum(jnp.sum(lab != ignore_label), 1)
+            grad = grad / nvalid.astype(p.dtype)
+        return (grad * scale, jnp.zeros_like(lab))
+
+    _so.defvjp(_fwd, _bwd)
+    return _so(data, label)
+
+
+# ------------------------------------------------------------- activations
+@register("Activation", aliases=("activation",))
+def Activation(x, act_type="relu"):
+    """Ref: src/operator/nn/activation.cc."""
+    if act_type == "relu":
+        return jnp.maximum(x, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return jax.nn.softplus(x)
+    if act_type == "softsign":
+        return x / (1 + jnp.abs(x))
+    raise ValueError("unknown act_type " + act_type)
+
+
+@register("LeakyReLU", wrap=False)
+def LeakyReLU(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
+              upper_bound=0.334):
+    """Leaky/PReLU/ELU/SELU/RReLU family (ref: src/operator/leaky_relu.cc)."""
+    from ..ndarray.ndarray import _apply
+    if act_type == "rrelu":
+        return _rrelu_apply(data, lower_bound, upper_bound)
+    if act_type == "prelu":
+        return _apply(lambda x, g: _leaky_impl(x, g, "prelu", slope), (data, gamma),
+                      name="LeakyReLU")
+    return _apply(lambda x: _leaky_impl(x, None, act_type, slope), (data,),
+                  name="LeakyReLU")
+
+
+def _leaky_impl(x, gamma, act_type, slope):
+    if act_type == "leaky":
+        return jnp.where(x > 0, x, slope * x)
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim < x.ndim and g.ndim == 1:
+            g = jnp.reshape(g, (1, -1) + (1,) * (x.ndim - 2))
+        return jnp.where(x > 0, x, g * x)
+    if act_type == "elu":
+        return jnp.where(x > 0, x, slope * (jnp.exp(x) - 1))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1))
+    if act_type == "gelu":
+        return jax.nn.gelu(x)
+    raise ValueError("unknown act_type " + act_type)
+
+
+@register("BatchNorm", aliases=("batch_norm",), wrap=False)
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
+              fix_gamma=True, use_global_stats=False, output_mean_var=False,
+              axis=1, cudnn_off=False):
+    """Batch normalization (ref: src/operator/nn/batch_norm.cc).
+
+    Pure-functional: in training mode normalizes by batch stats; the *layer*
+    (gluon.nn.BatchNorm) owns the moving-stat update, mirroring how the reference
+    mutates aux states inside the kernel while keeping XLA purity. Train/predict
+    mode is resolved here at call time (see statefulness note above).
+    """
+    from ..ndarray.ndarray import _apply
+    training = autograd.is_training() and not use_global_stats
+
+    def fn(x, g_, b_, mm, mv):
+        shape = [1] * x.ndim
+        ax = axis % x.ndim
+        shape[ax] = x.shape[ax]
+        g = jnp.ones_like(g_) if fix_gamma else g_
+        if training:
+            red = tuple(i for i in range(x.ndim) if i != ax)
+            mean = jnp.mean(x.astype(jnp.float32), axis=red)
+            var = jnp.var(x.astype(jnp.float32), axis=red)
+        else:
+            mean, var = mm, mv
+        inv = lax.rsqrt(var + eps)
+        out = (x.astype(jnp.float32) - jnp.reshape(mean, shape)) \
+            * jnp.reshape(inv * g.astype(jnp.float32), shape) \
+            + jnp.reshape(b_.astype(jnp.float32), shape)
+        out = out.astype(x.dtype)
+        if output_mean_var:
+            return out, mean, var
+        return out
+
+    return _apply(fn, (data, gamma, beta, moving_mean, moving_var), name="BatchNorm")
+
+
+@register("Dropout", aliases=("dropout",), wrap=False)
+def Dropout(data, p=0.5, mode="training", axes=(), cudnn_off=None):
+    """Inverted dropout (ref: src/operator/nn/dropout.cc). Active only in autograd
+    training mode (or mode='always'); RNG key drawn at call time (note above)."""
+    from ..ndarray.ndarray import _apply
+    if p <= 0 or (mode != "always" and not autograd.is_training()):
+        return _apply(lambda x: x, (data,), name="identity")
+    key = next_key()
+    keep = 1.0 - p
+
+    def fn(x):
+        shape = list(x.shape)
+        for a in axes or ():
+            shape[a] = 1
+        mask = jax.random.bernoulli(key, keep, tuple(shape))
+        return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+    return _apply(fn, (data,), name="Dropout")
+
+
+# NOTE on statefulness: ops whose semantics depend on RNG or train/predict mode
+# (Dropout, RReLU, BatchNorm batch-stats) resolve that state *at call time* in an
+# unwrapped wrapper, then tape a pure closure. The tape re-executes the closure under
+# jax.vjp during backward (recompute-based autograd), so anything resolved inside the
+# closure would be re-resolved at backward time — a different dropout mask or the
+# wrong BatchNorm branch. This mirrors the reference recording the resolved op state
+# (FCreateOpState) on the tape, not the env that produced it.
+@register("_rrelu_train", wrap=False)
+def _rrelu_apply(data, lower_bound, upper_bound):
+    from ..ndarray.ndarray import _apply
+    if autograd.is_training():
+        key = next_key()
+
+        def fn(x):
+            s = jax.random.uniform(key, x.shape, jnp.float32,
+                                   lower_bound, upper_bound).astype(x.dtype)
+            return jnp.where(x > 0, x, s * x)
+    else:
+        mid = (lower_bound + upper_bound) / 2.0
+
+        def fn(x):
+            return jnp.where(x > 0, x, mid * x)
+    return _apply(fn, (data,), name="rrelu")
+
+
+# ---------------------------------------------------------------- normalize
+@register("LayerNorm", aliases=("layer_norm",))
+def LayerNorm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    """Ref: src/operator/nn/layer_norm.cc. f32 statistics even for bf16 inputs."""
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axis, keepdims=True)
+    var = jnp.var(x32, axis=axis, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    out = ((x32 - mean) * inv).astype(data.dtype)
+    shape = [1] * data.ndim
+    ax = axis % data.ndim
+    shape[ax] = data.shape[ax]
+    out = out * jnp.reshape(gamma, shape) + jnp.reshape(beta, shape)
+    if output_mean_var:
+        return [out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)]
+    return out
+
+
+@register("InstanceNorm")
+def InstanceNorm(data, gamma, beta, eps=1e-3):
+    """Ref: src/operator/instance_norm.cc (NCHW; normalize over spatial dims)."""
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * jnp.reshape(gamma, shape) + jnp.reshape(beta, shape)
+
+
+@register("LRN")
+def LRN(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response normalization over channels (ref: src/operator/nn/lrn.cc)."""
+    sq = jnp.square(data)
+    half = nsize // 2
+    pad = [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2)
+    sq = jnp.pad(sq, pad)
+    window = [1, nsize] + [1] * (data.ndim - 2)
+    s = lax.reduce_window(sq, jnp.asarray(0, data.dtype), lax.add,
+                          window, [1] * data.ndim, [(0, 0)] * data.ndim)
+    return data / jnp.power(knorm + alpha / nsize * s, beta)
+
+
+# ------------------------------------------------------------ regression/heads
+@register("LinearRegressionOutput", aliases=("linear_regression_output",))
+def LinearRegressionOutput(data, label, grad_scale=1.0):
+    """Identity forward, (pred-label)*scale backward (ref: src/operator/regression_output.cc)."""
+    return _regression(data, label, grad_scale, lambda d: d)
+
+
+@register("LogisticRegressionOutput", aliases=("logistic_regression_output",))
+def LogisticRegressionOutput(data, label, grad_scale=1.0):
+    return _regression(data, label, grad_scale, jax.nn.sigmoid)
+
+
+@register("MAERegressionOutput", aliases=("mae_regression_output",))
+def MAERegressionOutput(data, label, grad_scale=1.0):
+    return _regression(data, label, grad_scale, lambda d: d, grad=jnp.sign)
+
+
+def _regression(data, label, grad_scale, link, grad=None):
+    @jax.custom_vjp
+    def _f(d, lab):
+        return link(d)
+
+    def _fwd(d, lab):
+        return link(d), (link(d), lab)
+
+    def _bwd(res, g):
+        p, lab = res
+        diff = grad(p - lab) if grad is not None else (p - lab)
+        return (diff * grad_scale / (lab.shape[1] if lab.ndim > 1 else 1),
+                jnp.zeros_like(lab))
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(data, label)
+
+
+# ------------------------------------------------------------- sequence ops
+def _seq_mask(data, sequence_length, use_sequence_length, value, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    L = sequence_length.astype(jnp.int32)
+    if axis == 0:
+        mask = steps[:, None] < L[None, :]
+        mask = jnp.reshape(mask, mask.shape + (1,) * (data.ndim - 2))
+    else:
+        mask = steps[None, :] < L[:, None]
+        mask = jnp.reshape(mask, mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceMask")
+def SequenceMask(data, sequence_length=None, use_sequence_length=False, value=0.0,
+                 axis=0):
+    """Ref: src/operator/sequence_mask.cc (TNC or NTC via axis)."""
+    return _seq_mask(data, sequence_length, use_sequence_length, value, axis)
+
+
+@register("SequenceLast")
+def SequenceLast(data, sequence_length=None, use_sequence_length=False, axis=0):
+    """Ref: src/operator/sequence_last.cc."""
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    L = jnp.maximum(sequence_length.astype(jnp.int32) - 1, 0)
+    moved = jnp.moveaxis(data, axis, 0)  # (T, N, ...)
+    return jnp.take_along_axis(moved, jnp.reshape(L, (1, -1) + (1,) * (moved.ndim - 2)),
+                               axis=0)[0]
+
+
+@register("SequenceReverse")
+def SequenceReverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    """Ref: src/operator/sequence_reverse.cc (time axis 0)."""
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    steps = jnp.arange(T)
+    L = sequence_length.astype(jnp.int32)  # (N,)
+    rev_idx = jnp.where(steps[:, None] < L[None, :], L[None, :] - 1 - steps[:, None],
+                        steps[:, None])  # (T, N)
+    rev_idx = jnp.reshape(rev_idx, rev_idx.shape + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, jnp.broadcast_to(rev_idx, data.shape), axis=0)

@@ -1,0 +1,149 @@
+"""Optimizer-update ops.
+
+Reference: src/operator/optimizer_op.cc — update rules are *ops* so they run inside
+the engine next to compute. Here they are jnp functions the Optimizer/Trainer jits
+(mxtpu/optimizer) — same motivation (no host round-trip between grad and update);
+XLA fuses the whole update into one kernel. Multi-precision (fp16/bf16 weights with
+f32 master copy) follows the reference's mp_sgd_update pattern.
+
+All update fns return the *new* values (functional) rather than mutating; the
+NDArray-level wrappers in mx.nd mutate `weight` in place for API parity.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _rescale_clip(grad, rescale_grad, clip_gradient, wd=0.0, weight=None):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    if wd and weight is not None:
+        g = g + wd * weight
+    return g
+
+
+def sgd_update_fn(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                  lazy_update=False):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    return weight - lr * g
+
+
+def sgd_mom_update_fn(weight, grad, mom, lr, momentum=0.0, wd=0.0, rescale_grad=1.0,
+                      clip_gradient=-1.0, lazy_update=False):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    mom_new = momentum * mom - lr * g
+    return weight + mom_new, mom_new
+
+
+def nag_mom_update_fn(weight, grad, mom, lr, momentum=0.0, wd=0.0, rescale_grad=1.0,
+                      clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    mom_new = momentum * mom + g
+    return weight - lr * (g + momentum * mom_new), mom_new
+
+
+def adam_update_fn(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    mean_new = beta1 * mean + (1 - beta1) * g
+    var_new = beta2 * var + (1 - beta2) * jnp.square(g)
+    return weight - lr * mean_new / (jnp.sqrt(var_new) + epsilon), mean_new, var_new
+
+
+def rmsprop_update_fn(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    n_new = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    w = weight - lr * g / jnp.sqrt(n_new + epsilon)
+    if clip_weights and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n_new
+
+
+def rmspropalex_update_fn(weight, grad, n, g_avg, delta, lr, gamma1=0.95, gamma2=0.9,
+                          epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                          clip_weights=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    n_new = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    g_avg_new = (1 - gamma1) * g + gamma1 * g_avg
+    delta_new = gamma2 * delta - lr * g / jnp.sqrt(n_new - jnp.square(g_avg_new) + epsilon)
+    w = weight + delta_new
+    if clip_weights and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n_new, g_avg_new, delta_new
+
+
+def ftrl_update_fn(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    n_new = n + jnp.square(g)
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+    z_new = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(z_new) > lamda1,
+        -(z_new - jnp.sign(z_new) * lamda1) / ((beta + jnp.sqrt(n_new)) / lr + wd),
+        0.0,
+    )
+    return w.astype(weight.dtype), z_new, n_new
+
+
+def adagrad_update_fn(weight, grad, history, lr, epsilon=1e-7, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    hist_new = history + jnp.square(g)
+    w = weight - lr * (g / jnp.sqrt(hist_new + epsilon) + wd * weight)
+    return w, hist_new
+
+
+def signsgd_update_fn(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+def signum_update_fn(weight, grad, mom, lr, momentum=0.9, wd=0.0, rescale_grad=1.0,
+                     clip_gradient=-1.0, wd_lh=0.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    mom_new = momentum * mom - (1 - momentum) * g
+    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(mom_new)
+    return w, mom_new
+
+
+def ftml_update_fn(weight, grad, d, v, z, lr, t, beta1=0.6, beta2=0.999, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_grad, wd, weight)
+    v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_new = (1 - beta1 ** t) / lr * (jnp.sqrt(v_new / (1 - beta2 ** t)) + epsilon)
+    sigma = d_new - beta1 * d
+    z_new = beta1 * z + (1 - beta1) * g - sigma * weight
+    return -z_new / d_new, d_new, v_new, z_new
+
+
+def _mutating(fn, n_state):
+    """Make the mx.nd-style mutating wrapper: weight (and states) updated in place."""
+    def wrapper(weight, grad, *states_and_args, out=None, **kwargs):
+        states = list(states_and_args[:n_state])
+        args = states_and_args[n_state:]
+        res = fn(weight._data, grad._data, *[s._data for s in states], *args, **kwargs)
+        if n_state == 0:
+            weight._set_data(res)
+        else:
+            weight._set_data(res[0])
+            for s, new in zip(states, res[1:]):
+                s._set_data(new)
+        return weight
+    return wrapper
+
+
+sgd_update = register("sgd_update", wrap=False)(_mutating(sgd_update_fn, 0))
+sgd_mom_update = register("sgd_mom_update", wrap=False)(_mutating(sgd_mom_update_fn, 1))
+nag_mom_update = register("nag_mom_update", wrap=False)(_mutating(nag_mom_update_fn, 1))
+adam_update = register("adam_update", wrap=False)(_mutating(adam_update_fn, 2))
+rmsprop_update = register("rmsprop_update", wrap=False)(_mutating(rmsprop_update_fn, 1))
+rmspropalex_update = register("rmspropalex_update", wrap=False)(_mutating(rmspropalex_update_fn, 3))
+ftrl_update = register("ftrl_update", wrap=False)(_mutating(ftrl_update_fn, 2))
+adagrad_update = register("adagrad_update", wrap=False)(_mutating(adagrad_update_fn, 1))
+signsgd_update = register("signsgd_update", wrap=False)(_mutating(signsgd_update_fn, 0))
+signum_update = register("signum_update", wrap=False)(_mutating(signum_update_fn, 1))

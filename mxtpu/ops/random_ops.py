@@ -1,0 +1,160 @@
+"""Sampling ops (ref: src/operator/random/sample_op.cc, multisample_op.cc,
+sample_multinomial_op.cc, shuffle_op.cc — backed there by per-ctx PRNG resources,
+here by JAX functional PRNG keys drawn at call time from mxtpu.random).
+
+None of these are registered with wrap=True: the key must be fixed *before* taping
+(see statefulness note in ops/nn.py), and sampling ops are non-differentiable leaves
+anyway, so they return fresh untaped NDArrays.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray, _as_jax_dtype
+from ..random import next_key
+from .registry import register
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def _dt(dtype):
+    if dtype in (None, "None"):
+        return jnp.float32
+    return _as_jax_dtype(dtype)
+
+
+@register("uniform", aliases=("_random_uniform", "random_uniform"), wrap=False)
+def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None, **_ig):
+    if isinstance(low, NDArray):  # broadcastable param form (multisample)
+        shape = jnp.broadcast_shapes(low.shape, high.shape if isinstance(high, NDArray) else ()) \
+            + _shape(shape)
+        lo = low._data if isinstance(low, NDArray) else low
+        hi = high._data if isinstance(high, NDArray) else high
+        d = jax.random.uniform(next_key(), shape, _dt(dtype)) * (hi - lo) + lo
+    else:
+        d = jax.random.uniform(next_key(), _shape(shape), _dt(dtype), low, high)
+    r = NDArray(d)
+    if out is not None:
+        out._set_data(r._data)
+        return out
+    return r
+
+
+@register("normal", aliases=("_random_normal", "random_normal", "randn"), wrap=False)
+def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None, **_ig):
+    if isinstance(loc, NDArray) or isinstance(scale, NDArray):
+        lo = loc._data if isinstance(loc, NDArray) else loc
+        sc = scale._data if isinstance(scale, NDArray) else scale
+        base = jnp.broadcast_shapes(jnp.shape(lo), jnp.shape(sc)) + _shape(shape)
+        d = jax.random.normal(next_key(), base, _dt(dtype)) * sc + lo
+    else:
+        d = jax.random.normal(next_key(), _shape(shape), _dt(dtype)) * scale + loc
+    r = NDArray(d)
+    if out is not None:
+        out._set_data(r._data)
+        return out
+    return r
+
+
+@register("gamma", aliases=("_random_gamma", "random_gamma"), wrap=False)
+def gamma_sample(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None, out=None, **_ig):
+    a = alpha._data if isinstance(alpha, NDArray) else alpha
+    b = beta._data if isinstance(beta, NDArray) else beta
+    base = jnp.broadcast_shapes(jnp.shape(a), jnp.shape(b)) + _shape(shape)
+    d = jax.random.gamma(next_key(), a, base, _dt(dtype)) * b
+    r = NDArray(d)
+    if out is not None:
+        out._set_data(r._data)
+        return out
+    return r
+
+
+@register("exponential", aliases=("_random_exponential", "random_exponential"), wrap=False)
+def exponential(lam=1.0, shape=None, dtype=None, ctx=None, out=None, **_ig):
+    lm = lam._data if isinstance(lam, NDArray) else lam
+    base = jnp.broadcast_shapes(jnp.shape(lm)) + _shape(shape)
+    d = jax.random.exponential(next_key(), base, _dt(dtype)) / lm
+    return NDArray(d)
+
+
+@register("poisson", aliases=("_random_poisson", "random_poisson"), wrap=False)
+def poisson(lam=1.0, shape=None, dtype=None, ctx=None, out=None, **_ig):
+    lm = lam._data if isinstance(lam, NDArray) else lam
+    base = jnp.broadcast_shapes(jnp.shape(lm)) + _shape(shape)
+    d = jax.random.poisson(next_key(), lm, base).astype(_dt(dtype))
+    return NDArray(d)
+
+
+@register("negative_binomial", aliases=("_random_negative_binomial",), wrap=False)
+def negative_binomial(k=1, p=1.0, shape=None, dtype=None, ctx=None, **_ig):
+    # NB(k,p) = Poisson(Gamma(k, (1-p)/p))
+    kk = k._data if isinstance(k, NDArray) else k
+    pp = p._data if isinstance(p, NDArray) else p
+    base = jnp.broadcast_shapes(jnp.shape(kk), jnp.shape(pp)) + _shape(shape)
+    lam = jax.random.gamma(next_key(), kk, base) * (1.0 - pp) / pp
+    return NDArray(jax.random.poisson(next_key(), lam, base).astype(_dt(dtype)))
+
+
+@register("generalized_negative_binomial",
+          aliases=("_random_generalized_negative_binomial",), wrap=False)
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype=None, ctx=None, **_ig):
+    m = mu._data if isinstance(mu, NDArray) else mu
+    a = alpha._data if isinstance(alpha, NDArray) else alpha
+    base = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(a)) + _shape(shape)
+    # GNB: Poisson with Gamma(1/alpha, alpha*mu) mixture
+    lam = jax.random.gamma(next_key(), 1.0 / a, base) * a * m
+    return NDArray(jax.random.poisson(next_key(), lam, base).astype(_dt(dtype)))
+
+
+@register("randint", aliases=("_random_randint", "random_randint"), wrap=False)
+def randint(low=0, high=None, shape=None, dtype="int32", ctx=None, **_ig):
+    d = jax.random.randint(next_key(), _shape(shape), low, high,
+                           _as_jax_dtype(dtype if dtype != "None" else "int32"))
+    return NDArray(d)
+
+
+@register("multinomial", aliases=("_sample_multinomial", "sample_multinomial"), wrap=False)
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **_ig):
+    """Sample category ids from (batched) distributions
+    (ref: src/operator/random/sample_multinomial_op.cc)."""
+    p = data._data
+    n = 1 if shape in (None, ()) else (shape if isinstance(shape, int) else int(jnp.prod(jnp.asarray(shape))))
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if p.ndim == 1:
+        out = jax.random.categorical(next_key(), logits, shape=(n,))
+        out = out[0] if shape in (None, ()) else out
+    else:
+        out = jax.random.categorical(next_key(), logits[:, None, :].repeat(n, 1), axis=-1)
+        out = out[:, 0] if shape in (None, ()) else out
+    res = NDArray(out.astype(_as_jax_dtype(dtype)))
+    if get_prob:
+        lp = jnp.take_along_axis(jax.nn.log_softmax(logits, -1),
+                                 jnp.atleast_1d(out)[..., None].astype(jnp.int32), -1)[..., 0]
+        return [res, NDArray(lp)]
+    return res
+
+
+@register("shuffle", aliases=("_shuffle",), wrap=False)
+def shuffle(data, **_ig):
+    """Shuffle along axis 0 (ref: src/operator/random/shuffle_op.cc)."""
+    return NDArray(jax.random.permutation(next_key(), data._data, axis=0))
+
+
+# *_like variants (ref: sample_op.cc *_like registrations)
+@register("uniform_like", wrap=False)
+def uniform_like(data, low=0.0, high=1.0, **_ig):
+    return NDArray(jax.random.uniform(next_key(), data.shape, jnp.float32, low, high)
+                   .astype(data._data.dtype))
+
+
+@register("normal_like", wrap=False)
+def normal_like(data, loc=0.0, scale=1.0, **_ig):
+    return NDArray((jax.random.normal(next_key(), data.shape) * scale + loc)
+                   .astype(data._data.dtype))

@@ -1,0 +1,114 @@
+"""Operator registry: the TPU-native analog of the NNVM op registry.
+
+Reference contract: every op registers name + FInferShape/FInferType/FCompute/FGradient
+attrs via ``NNVM_REGISTER_OP`` (include/mxnet/op_attr_types.h:198-301; canonical example
+src/operator/nn/fully_connected.cc:239-328).
+
+TPU-native re-design: an op is a *pure jax-traceable function* — shape/dtype inference
+comes from jax's abstract evaluation (``jax.eval_shape``), the gradient from ``jax.vjp``,
+and the kernel from XLA lowering (or a Pallas kernel for hot ops). So a registration
+here is just ``(name, fn, aliases)``; the registry exists to
+
+* generate the ``mx.nd.*`` imperative namespace (ref: per-op Python codegen at import,
+  python/mxnet/ndarray/register.py:143-157),
+* give :mod:`mxtpu.symbol` a name → fn table for deferred graph execution,
+* attach NDArray methods (``x.sum()`` etc) the way the reference's frontend codegen does.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Callable, Dict, List, Optional
+
+from ..ndarray.ndarray import NDArray, _apply
+
+__all__ = ["Op", "register", "get_op", "list_ops", "invoke", "REGISTRY"]
+
+
+class Op:
+    """A registered operator: ``fn`` works on jax arrays / pytrees; wrapper works on
+    NDArrays with tape recording."""
+
+    __slots__ = ("name", "fn", "wrapper", "aliases", "as_method", "doc")
+
+    def __init__(self, name: str, fn: Callable, wrapper: Callable,
+                 aliases=(), as_method: bool = False):
+        self.name = name
+        self.fn = fn
+        self.wrapper = wrapper
+        self.aliases = tuple(aliases)
+        self.as_method = as_method
+        self.doc = fn.__doc__
+
+
+REGISTRY: Dict[str, Op] = {}
+
+
+def register(name: Optional[str] = None, aliases=(), as_method: bool = False,
+             wrap: bool = True, num_outputs: int = 1):
+    """Register a jnp-level op and return its NDArray-level function.
+
+    The returned wrapper accepts NDArrays (and scalars/attrs), snapshots payloads,
+    evaluates, wraps outputs, and tapes the call when autograd is recording — i.e. it
+    performs the whole MXImperativeInvokeEx → Imperative::Invoke path
+    (src/c_api/c_api_ndarray.cc:81, src/imperative/imperative.cc:87) in one function.
+    """
+
+    def deco(fn: Callable):
+        op_name = name or fn.__name__
+
+        if wrap:
+            @functools.wraps(fn)
+            def wrapper(*args, **kwargs):
+                out = kwargs.pop("out", None)
+                res = _apply(fn, args, kwargs, name=op_name)
+                if out is not None:
+                    if isinstance(res, list):
+                        for o, r in zip(out if isinstance(out, (list, tuple)) else [out], res):
+                            o._set_data(r._data)
+                        return out
+                    out._set_data(res._data)
+                    return out
+                return res
+        else:
+            wrapper = fn
+
+        op = Op(op_name, fn, wrapper, aliases=aliases, as_method=as_method)
+        REGISTRY[op_name] = op
+        for al in aliases:
+            REGISTRY[al] = op
+        return wrapper
+
+    return deco
+
+
+def get_op(name: str) -> Op:
+    if name not in REGISTRY:
+        raise KeyError("Operator %s is not registered" % name)
+    return REGISTRY[name]
+
+
+def list_ops() -> List[str]:
+    return sorted(REGISTRY)
+
+
+def invoke(name: str, *args, **kwargs):
+    """Invoke a registered op by name (symbol executor / C-ABI entry point)."""
+    return get_op(name).wrapper(*args, **kwargs)
+
+
+def attach_methods(cls=NDArray):
+    """Attach registered ops marked ``as_method`` as NDArray methods, mirroring the
+    reference's generated method surface (python/mxnet/ndarray/register.py)."""
+    for key, op in list(REGISTRY.items()):
+        if not op.as_method:
+            continue
+        if getattr(cls, key, None) is not None:
+            continue  # don't clobber hand-written methods
+
+        def make(opw):
+            def method(self, *args, **kwargs):
+                return opw(self, *args, **kwargs)
+            return method
+
+        setattr(cls, key, make(op.wrapper))

@@ -1,0 +1,626 @@
+"""Optimizer registry + the full update-rule zoo.
+
+Reference: python/mxnet/optimizer/optimizer.py:41-1300 (registry, Updater,
+multi-precision fp16 master weights :500, SGD/Signum/FTML/LBSGD/DCASGD/NAG/SGLD/
+Adam/AdaGrad/RMSProp/AdaDelta/Ftrl/Adamax/Nadam) and the in-engine update kernels
+src/operator/optimizer_op.cc.
+
+TPU-native re-design: each optimizer's ``update`` applies a pure jnp update fn
+(mxtpu/ops/optimizer_ops.py) to the NDArray payloads; when driven from the jitted
+Trainer step the whole parameter update fuses into the step executable (the
+reference's motivation for making updates *ops* — SURVEY §2.2 optimizer_op).
+Multi-precision: bf16/fp16 weights keep an f32 master copy, like mp_sgd_update.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+from .ops import optimizer_ops as _uo
+
+__all__ = ["Optimizer", "Updater", "create", "register", "get_updater",
+           "SGD", "Signum", "FTML", "DCASGD", "NAG", "SGLD", "Adam", "AdaGrad",
+           "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam", "LBSGD", "Test"]
+
+
+class Optimizer:
+    """Base optimizer (ref: optimizer.py:Optimizer). Holds lr/wd schedules,
+    per-param lr_mult/wd_mult, update counts for bias correction."""
+
+    opt_registry = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.multi_precision = multi_precision
+        self.param_idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self.idx2name = dict(self.param_idx2name)
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    # -- registry ---------------------------------------------------------
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() not in Optimizer.opt_registry:
+            raise MXNetError("Cannot find optimizer %s" % name)
+        return Optimizer.opt_registry[name.lower()](**kwargs)
+
+    # -- lr/wd ------------------------------------------------------------
+    def set_learning_rate(self, lr):
+        self.lr = lr
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.base_lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        name = self.idx2name.get(index, index if isinstance(index, str) else None)
+        if name in self.param_dict:
+            lr *= self.param_dict[name].lr_mult
+        elif name in self.lr_mult:
+            lr *= self.lr_mult[name]
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        name = self.idx2name.get(index, index if isinstance(index, str) else None)
+        if name in self.param_dict:
+            wd *= self.param_dict[name].wd_mult
+        elif name in self.wd_mult:
+            wd *= self.wd_mult[name]
+        return wd
+
+    # -- state ------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype in (jnp.float16, jnp.bfloat16):
+            master = weight.astype("float32")
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype in (jnp.float16, jnp.bfloat16):
+            master, base_state = state
+            g32 = grad.astype("float32")
+            self.update(index, master, g32, base_state)
+            weight._set_data(master._data.astype(weight._data.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+    def _common_kwargs(self, index):
+        return dict(rescale_grad=self.rescale_grad,
+                    clip_gradient=self.clip_gradient if self.clip_gradient else -1.0)
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+@register
+class SGD(Optimizer):
+    """SGD ± momentum, multi-precision, lazy sparse update
+    (ref: optimizer.py:SGD; kernels src/operator/optimizer_op.cc sgd_update)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        from .ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            self._sparse_update(weight, grad, state, lr, wd)
+            return
+        if state is None:
+            _uo.sgd_update(weight, grad, lr, wd=wd, **self._common_kwargs(index))
+        else:
+            _uo.sgd_mom_update(weight, grad, state, lr, momentum=self.momentum, wd=wd,
+                               **self._common_kwargs(index))
+
+    def _sparse_update(self, weight, grad, state, lr, wd):
+        """Lazy update: only rows present in the gradient move (ref: sgd-inl lazy)."""
+        rows = grad._aux["indices"]
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        w = weight._data
+        wr = w[rows]
+        g = g + wd * wr
+        if state is None:
+            weight._set_data(w.at[rows].add(-lr * g))
+        else:
+            m = state._data
+            m_rows = self.momentum * m[rows] - lr * g
+            state._set_data(m.at[rows].set(m_rows))
+            weight._set_data(w.at[rows].add(m_rows))
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (ref: optimizer.py:NAG)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is None:
+            _uo.sgd_update(weight, grad, lr, wd=wd, **self._common_kwargs(index))
+        else:
+            _uo.nag_mom_update(weight, grad, state, lr, momentum=self.momentum, wd=wd,
+                               **self._common_kwargs(index))
+
+
+@register
+class Signum(Optimizer):
+    """SignSGD + momentum (ref: optimizer.py:Signum)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is None:
+            _uo.signsgd_update(weight, grad, lr, wd=wd, **self._common_kwargs(index))
+        else:
+            _uo.signum_update(weight, grad, state, lr, momentum=self.momentum, wd=wd,
+                              wd_lh=self.wd_lh, **self._common_kwargs(index))
+
+
+@register
+class FTML(Optimizer):
+    """Follow the Moving Leader (ref: optimizer.py:FTML)."""
+
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z), NDArray(z))  # d, v, z
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        new_w, new_d, new_v, new_z = _uo.ftml_update_fn(
+            weight._data, grad._data, d._data, v._data, z._data, lr, t,
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+            rescale_grad=self.rescale_grad,
+            clip_grad=self.clip_gradient if self.clip_gradient else -1.0)
+        weight._set_data(new_w)
+        d._set_data(new_d); v._set_data(new_v); z._set_data(new_z)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (ref: optimizer.py:DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = NDArray(jnp.zeros(weight.shape, weight._data.dtype)) if self.momentum else None
+        prev = NDArray(weight._data)
+        return (mom, prev)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mom, prev = state
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight._data
+        comp = g + self.lamda * g * g * (weight._data - prev._data)
+        if mom is None:
+            step = -lr * comp
+        else:
+            m = self.momentum * mom._data - lr * comp
+            mom._set_data(m)
+            step = m
+        prev._set_data(weight._data)
+        weight._set_data(weight._data + step)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (ref: optimizer.py:SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        from .random import next_key
+        import jax
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight._data
+        noise = jax.random.normal(next_key(), weight.shape) * math.sqrt(lr)
+        weight._set_data(weight._data - lr / 2 * g + noise.astype(weight._data.dtype))
+
+
+@register
+class Adam(Optimizer):
+    """Ref: optimizer.py:Adam (+ sparse lazy update src/operator/optimizer_op.cc
+    adam_update row_sparse path)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr_t = lr * math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        mean, var = state
+        from .ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            rows = grad._aux["indices"]
+            g = grad._data * self.rescale_grad
+            if self.clip_gradient:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            g = g + wd * weight._data[rows]
+            m_new = self.beta1 * mean._data[rows] + (1 - self.beta1) * g
+            v_new = self.beta2 * var._data[rows] + (1 - self.beta2) * jnp.square(g)
+            mean._set_data(mean._data.at[rows].set(m_new))
+            var._set_data(var._data.at[rows].set(v_new))
+            weight._set_data(weight._data.at[rows].add(
+                -lr_t * m_new / (jnp.sqrt(v_new) + self.epsilon)))
+            return
+        _uo.adam_update(weight, grad, mean, var, lr_t, beta1=self.beta1,
+                        beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+                        **self._common_kwargs(index))
+
+
+@register
+class AdaGrad(Optimizer):
+    """Ref: optimizer.py:AdaGrad; sparse variant optimizer_op.cc _sparse_adagrad_update."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        from .ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            rows = grad._aux["indices"]
+            g = grad._data * self.rescale_grad
+            h_new = state._data[rows] + jnp.square(g)
+            state._set_data(state._data.at[rows].set(h_new))
+            weight._set_data(weight._data.at[rows].add(
+                -lr * g / jnp.sqrt(h_new + self.float_stable_eps)))
+            return
+        _uo.adagrad_update(weight, grad, state, lr, epsilon=self.float_stable_eps,
+                           wd=wd, **self._common_kwargs(index))
+
+
+@register
+class RMSProp(Optimizer):
+    """Ref: optimizer.py:RMSProp (centered=Alex variant w/ gamma2)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9, epsilon=1e-8,
+                 centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.epsilon = epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        if self.centered:
+            return (NDArray(z), NDArray(z), NDArray(z))  # n, g, delta
+        return (NDArray(z),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = dict(gamma1=self.gamma1, epsilon=self.epsilon, wd=wd,
+                  rescale_grad=self.rescale_grad,
+                  clip_gradient=self.clip_gradient if self.clip_gradient else -1.0,
+                  clip_weights=self.clip_weights if self.clip_weights else -1.0)
+        if self.centered:
+            n, g, delta = state
+            _uo.rmspropalex_update(weight, grad, n, g, delta, lr, gamma2=self.gamma2, **kw)
+        else:
+            (n,) = state
+            _uo.rmsprop_update(weight, grad, n, lr, **kw)
+
+
+@register
+class AdaDelta(Optimizer):
+    """Ref: optimizer.py:AdaDelta."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))  # acc_g, acc_delta
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight._data
+        ag = self.rho * acc_g._data + (1 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_delta._data + self.epsilon) / jnp.sqrt(ag + self.epsilon) * g
+        ad = self.rho * acc_delta._data + (1 - self.rho) * jnp.square(delta)
+        acc_g._set_data(ag)
+        acc_delta._set_data(ad)
+        weight._set_data(weight._data - delta)
+
+
+@register
+class Ftrl(Optimizer):
+    """Ref: optimizer.py:Ftrl."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))  # z, n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        _uo.ftrl_update(weight, grad, z, n, lr, lamda1=self.lamda1, beta=self.beta,
+                        wd=wd, **self._common_kwargs(index))
+
+
+@register
+class Adamax(Optimizer):
+    """Ref: optimizer.py:Adamax."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr_t = lr / (1.0 - self.beta1 ** t)
+        m, u = state
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight._data
+        m_new = self.beta1 * m._data + (1 - self.beta1) * g
+        u_new = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
+        m._set_data(m_new)
+        u._set_data(u_new)
+        weight._set_data(weight._data - lr_t * m_new / (u_new + 1e-8))
+
+
+@register
+class Nadam(Optimizer):
+    """Ref: optimizer.py:Nadam."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight._data
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule *= momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        m_new = self.beta1 * m._data + (1 - self.beta1) * g
+        v_new = self.beta2 * v._data + (1 - self.beta2) * jnp.square(g)
+        g_prime = g / (1 - self.m_schedule)
+        m_prime = m_new / (1 - m_schedule_next)
+        v_prime = v_new / (1 - self.beta2 ** t)
+        m_bar = (1 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        m._set_data(m_new)
+        v._set_data(v_new)
+        weight._set_data(weight._data - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon))
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style layer-wise adaptive rate
+    (ref: optimizer.py:LBSGD, warmup + lars trust ratio)."""
+
+    def __init__(self, momentum=0.0, warmup_strategy="linear", warmup_epochs=5,
+                 batch_scale=1, updates_per_epoch=32, begin_epoch=0, num_epochs=60,
+                 **kwargs):
+        super().__init__(momentum=momentum, **kwargs)
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.init_updates = begin_epoch * updates_per_epoch
+        self.num_epochs = num_epochs
+        self.adaptive = warmup_strategy == "lars"
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        # LARS trust ratio
+        wn = float(jnp.linalg.norm(weight._data.reshape(-1)))
+        gn = float(jnp.linalg.norm(grad._data.reshape(-1))) * self.rescale_grad
+        if wn > 0 and gn > 0:
+            lr = lr * min(wn / (gn + wd * wn + 1e-9), 1.0) if self.adaptive else lr
+        if state is None:
+            _uo.sgd_update(weight, grad, lr, wd=wd, **self._common_kwargs(index))
+        else:
+            _uo.sgd_mom_update(weight, grad, state, lr, momentum=self.momentum, wd=wd,
+                               **self._common_kwargs(index))
+
+
+@register
+class Test(Optimizer):
+    """Plumbing-test optimizer (ref: optimizer.py "Test")."""
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        weight._set_data(weight._data + grad._data * self.rescale_grad)
+        state._set_data(weight._data)
+
+
+class Updater:
+    """Per-index state store applying an optimizer (ref: optimizer.py:Updater;
+    serialized as the kvstore's server-side updater)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+        state = {}
+        for k, v in self.states.items():
+            state[k] = _state_to_numpy(v)
+        return pickle.dumps((state, self.optimizer) if dump_optimizer else state)
+
+    def set_states(self, states):
+        import pickle
+        obj = pickle.loads(states)
+        if isinstance(obj, tuple):
+            obj, self.optimizer = obj
+        self.states = {k: _state_from_numpy(v) for k, v in obj.items()}
+
+
+def _state_to_numpy(v):
+    if v is None:
+        return None
+    if isinstance(v, NDArray):
+        return v.asnumpy()
+    if isinstance(v, (tuple, list)):
+        return tuple(_state_to_numpy(x) for x in v)
+    return v
+
+
+def _state_from_numpy(v):
+    if v is None:
+        return None
+    if isinstance(v, tuple):
+        return tuple(_state_from_numpy(x) for x in v)
+    if isinstance(v, _np.ndarray):
+        return array(v)
+    return v
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

@@ -1,0 +1,67 @@
+"""Random state management.
+
+Reference: per-ctx PRNG resources handed to ops via ResourceRequest{kRandom,
+kParallelRandom} (include/mxnet/resource.h:38-56, src/resource.cc:87) and
+``mx.random.seed``.
+
+TPU-native re-design: JAX functional PRNG. A process-global key is split on every
+draw (eager mode). Inside a traced/jitted computation (CachedOp / hybridized block),
+drawing from a hidden global would bake the key into the compiled executable, so a
+*key supply* can be pushed for the trace: the CachedOp passes a fresh key argument
+each call and random ops split from it — keeping compiled dropout stochastic across
+calls while staying purely functional.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["seed", "next_key", "push_key_supply", "pop_key_supply"]
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.key = jax.random.key(0)
+        self.supply = []  # stack of _KeySupply for active traces
+
+
+_STATE = _RngState()
+
+
+class _KeySupply:
+    """Deterministic splitter over a (possibly traced) base key."""
+
+    def __init__(self, base_key):
+        self.base = base_key
+        self.count = 0
+
+    def next(self):
+        k = jax.random.fold_in(self.base, self.count)
+        self.count += 1
+        return k
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the global generator (ref: mx.random.seed / MXRandomSeed)."""
+    _STATE.key = jax.random.key(int(seed_state))
+    _STATE.supply = []
+
+
+def next_key():
+    """Return a fresh PRNG key (the per-op kRandom resource acquisition)."""
+    if _STATE.supply:
+        return _STATE.supply[-1].next()
+    _STATE.key, sub = jax.random.split(_STATE.key)
+    return sub
+
+
+def push_key_supply(base_key) -> _KeySupply:
+    s = _KeySupply(base_key)
+    _STATE.supply.append(s)
+    return s
+
+
+def pop_key_supply():
+    return _STATE.supply.pop()

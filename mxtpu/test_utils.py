@@ -1,0 +1,90 @@
+"""Testing utilities (ref: python/mxnet/test_utils.py — assert_almost_equal,
+check_numeric_gradient, check_symbolic_forward/backward, default contexts).
+
+The numeric-gradient checker is the reference's central operator-test mechanism
+(SURVEY §4); here it validates the jax.vjp-derived gradients against central
+finite differences computed in float64 on host.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import autograd
+from .base import Context, current_context
+from .ndarray import NDArray, array
+
+
+def default_context() -> Context:
+    return current_context()
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b")):
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                               err_msg="%s vs %s mismatch" % names)
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20) -> bool:
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    return np.allclose(a, b, rtol=rtol, atol=atol)
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype="float32"):
+    a = np.random.uniform(-1, 1, size=shape).astype(dtype)
+    nd = array(a)
+    if stype != "default":
+        return nd.tostype(stype)
+    return nd
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-4,
+                           head_grad=None):
+    """Compare autograd gradients of ``fn(*inputs) -> NDArray`` against central
+    finite differences (ref: mxnet.test_utils.check_numeric_gradient)."""
+    inputs = [array(x) if not isinstance(x, NDArray) else x for x in inputs]
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*inputs)
+    if head_grad is None:
+        hg = np.ones(out.shape, np.float32)
+    else:
+        hg = np.asarray(head_grad, np.float32)
+    out.backward(array(hg))
+    analytic = [x.grad.asnumpy().astype(np.float64) for x in inputs]
+
+    for i, x in enumerate(inputs):
+        base = x.asnumpy().astype(np.float64)
+        flat = base.reshape(-1)
+        num = np.zeros_like(flat)
+        for j in range(flat.size):
+            for sgn, acc in ((+1, 1.0), (-1, -1.0)):
+                pert = flat.copy()
+                pert[j] += sgn * eps
+                args = [inputs[k] if k != i else array(pert.reshape(base.shape).astype(np.float32))
+                        for k in range(len(inputs))]
+                with autograd.pause():
+                    val = fn(*args).asnumpy().astype(np.float64)
+                num[j] += acc * np.sum(val * hg)
+        num /= 2 * eps
+        np.testing.assert_allclose(analytic[i].reshape(-1), num, rtol=rtol, atol=atol,
+                                   err_msg="numeric grad mismatch for input %d" % i)
+
+
+def check_consistency(fn, inputs, ctxs=None, rtol=1e-4, atol=1e-5):
+    """Run fn on multiple contexts and compare (ref: test_utils.check_consistency,
+    used by tests/python/gpu/test_operator_gpu.py to cross-check CPU vs GPU)."""
+    from .base import cpu, tpu
+    ctxs = ctxs or [cpu(), tpu()]
+    outs = []
+    for ctx in ctxs:
+        args = [x.as_in_context(ctx) for x in inputs]
+        outs.append(fn(*args).asnumpy())
+    for o in outs[1:]:
+        np.testing.assert_allclose(outs[0], o, rtol=rtol, atol=atol)
+
+
+def same(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,23 @@
+"""Test environment: force an 8-device virtual CPU mesh before jax initializes,
+mirroring SURVEY §4's implication — multi-chip collective tests must run on a single
+host the way the reference runs multi-process localhost PS tests."""
+import os
+
+# the environment presets JAX_PLATFORMS=axon (the TPU tunnel); tests force CPU so
+# the suite is hermetic and the 8-device virtual mesh is available
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    """@with_seed equivalent (ref: tests/python/unittest/common.py)."""
+    np.random.seed(0)
+    import mxtpu as mx
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,166 @@
+"""Autograd tests (modeled on tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd as ag
+from mxtpu.test_utils import assert_almost_equal
+
+
+def test_simple_grad():
+    x = mx.nd.array([1., 2., 3.])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain_and_reuse():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        z = y * x  # x^3
+    z.backward()
+    assert_almost_equal(x.grad, [12.0])  # 3x^2
+
+
+def test_multi_input():
+    a = mx.nd.array([1., 2.])
+    b = mx.nd.array([3., 4.])
+    a.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        c = (a * b).sum()
+    c.backward()
+    assert_almost_equal(a.grad, b.asnumpy())
+    assert_almost_equal(b.grad, a.asnumpy())
+
+
+def test_grad_add_accumulate():
+    x = mx.nd.array([1., 2.])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with ag.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, 3 * 2 * x.asnumpy())
+
+
+def test_head_grad():
+    x = mx.nd.array([1., 2., 3.])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+    y.backward(mx.nd.array([1., 10., 100.]))
+    assert_almost_equal(x.grad, [2., 20., 200.])
+
+
+def test_detach_blocks():
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, [9.0])  # only d(y_const * x)/dx = y
+
+
+def test_blockgrad_op():
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.BlockGrad(x * x) * x
+    y.backward()
+    assert_almost_equal(x.grad, [9.0])
+
+
+def test_training_modes():
+    assert not ag.is_training()
+    with ag.record():
+        assert ag.is_training()
+        assert ag.is_recording()
+        with ag.pause():
+            assert not ag.is_recording()
+    with ag.predict_mode():
+        assert not ag.is_training()
+
+
+def test_dropout_respects_mode():
+    x = mx.nd.ones((100,))
+    out = mx.nd.Dropout(x, p=0.5)  # not training: identity
+    assert_almost_equal(out, np.ones(100))
+    with ag.record():
+        out = mx.nd.Dropout(x, p=0.5)
+    a = out.asnumpy()
+    assert (a == 0).any() and (a > 1).any()  # inverted dropout scales kept values
+
+
+def test_dropout_backward_consistent_mask():
+    # backward must re-use the forward's mask (key captured at call time)
+    x = mx.nd.ones((1000,))
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.Dropout(x, p=0.5)
+        s = y.sum()
+    s.backward()
+    fwd = y.asnumpy()
+    g = x.grad.asnumpy()
+    assert_almost_equal(g, fwd)  # grad of sum(dropout(x)) is exactly the mask/keep
+
+
+def test_inplace_while_recording():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 3
+        y += x  # taped as functional add
+        z = y * x
+    z.backward()
+    # z = (3x + x) * x = 4x^2, dz/dx = 8x = 16
+    assert_almost_equal(x.grad, [16.0])
+
+
+def test_grad_function():
+    x = mx.nd.array([1., 2., 3.])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+    g = ag.grad(y, x, retain_graph=True)
+    assert_almost_equal(g, 2 * x.asnumpy())
+
+
+def test_custom_function():
+    class Sigmoid(ag.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + mx.nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = mx.nd.array([0.0, 1.0])
+    x.attach_grad()
+    with ag.record():
+        y = f(x)
+    y.backward()
+    sig = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, sig * (1 - sig), rtol=1e-5)
+
+
+def test_error_on_unrecorded_head():
+    x = mx.nd.array([1.0])
+    with pytest.raises(mx.MXNetError):
+        x.backward()
+
+
+def test_deferred_style_exception():
+    # errors inside async dispatch surface at sync points (wait_to_read/asnumpy)
+    a = mx.nd.array([1.0, 2.0])
+    with pytest.raises(Exception):
+        b = a.reshape((3,))  # impossible reshape raises at call or sync
+        b.wait_to_read()

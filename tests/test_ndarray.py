@@ -1,0 +1,188 @@
+"""NDArray semantics tests (modeled on tests/python/unittest/test_ndarray.py in the
+reference: creation, arithmetic, indexing, copy, serialization, sync semantics)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = mx.nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    b = mx.nd.ones((2, 3), dtype="int32")
+    assert b.asnumpy().sum() == 6
+    c = mx.nd.full((2, 2), 7.0)
+    assert c.asnumpy().tolist() == [[7, 7], [7, 7]]
+    d = mx.nd.array(np.arange(6).reshape(2, 3))
+    assert d.shape == (2, 3)
+    e = mx.nd.arange(0, 10, 2)
+    assert e.asnumpy().tolist() == [0, 2, 4, 6, 8]
+
+
+def test_python_float_defaults_to_f32():
+    a = mx.nd.array([1.5, 2.5])
+    assert a.dtype == np.float32
+
+
+def test_arithmetic():
+    a = mx.nd.array([[1., 2.], [3., 4.]])
+    b = mx.nd.array([[10., 20.], [30., 40.]])
+    assert_almost_equal(a + b, [[11, 22], [33, 44]])
+    assert_almost_equal(b - a, [[9, 18], [27, 36]])
+    assert_almost_equal(a * 2, [[2, 4], [6, 8]])
+    assert_almost_equal(2 * a, [[2, 4], [6, 8]])
+    assert_almost_equal(1 / a, 1 / a.asnumpy())
+    assert_almost_equal(b / a, b.asnumpy() / a.asnumpy())
+    assert_almost_equal(a ** 2, a.asnumpy() ** 2)
+    assert_almost_equal(-a, -a.asnumpy())
+    assert_almost_equal(abs(-a), a.asnumpy())
+    assert_almost_equal(a % 2, a.asnumpy() % 2)
+
+
+def test_comparison_returns_float():
+    a = mx.nd.array([1., 2., 3.])
+    b = mx.nd.array([2., 2., 2.])
+    assert (a == b).asnumpy().tolist() == [0, 1, 0]
+    assert (a > b).asnumpy().tolist() == [0, 0, 1]
+    assert (a >= b).asnumpy().tolist() == [0, 1, 1]
+    assert (a < b).asnumpy().tolist() == [1, 0, 0]
+    assert (a != b).dtype == np.float32
+
+
+def test_inplace():
+    a = mx.nd.ones((2, 2))
+    a += 1
+    assert_almost_equal(a, np.full((2, 2), 2.0))
+    a *= 3
+    assert_almost_equal(a, np.full((2, 2), 6.0))
+    a /= 2
+    assert_almost_equal(a, np.full((2, 2), 3.0))
+    a -= 1
+    assert_almost_equal(a, np.full((2, 2), 2.0))
+
+
+def test_indexing():
+    a = mx.nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a[1].shape == (3, 4)
+    assert a[1, 2].shape == (4,)
+    assert float(a[1, 2, 3].asscalar()) == 23
+    assert a[:, 1:3].shape == (2, 2, 4)
+    assert a[0, :, ::2].shape == (3, 2)
+    # advanced indexing with NDArray
+    idx = mx.nd.array([0, 1], dtype="int32")
+    assert a[idx].shape == (2, 3, 4)
+
+
+def test_setitem():
+    a = mx.nd.zeros((3, 3))
+    a[1] = 5.0
+    assert a.asnumpy()[1].tolist() == [5, 5, 5]
+    a[0, 0] = 2.0
+    assert float(a[0, 0].asscalar()) == 2.0
+    a[:] = np.ones((3, 3))
+    assert a.asnumpy().sum() == 9
+    b = mx.nd.zeros((2, 2))
+    b[:] = mx.nd.ones((2, 2)) * 4
+    assert b.asnumpy().sum() == 16
+
+
+def test_reshape_codes():
+    a = mx.nd.zeros((2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert mx.nd.Reshape(a, shape=(-3, 4)).shape == (6, 4)
+    assert mx.nd.Reshape(a, shape=(-2,)).shape == (2, 3, 4)
+    assert mx.nd.Reshape(a, shape=(-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
+
+
+def test_copy_and_context():
+    a = mx.nd.ones((2, 2))
+    b = a.copy()
+    b += 1
+    assert a.asnumpy().sum() == 4  # copy is deep
+    c = mx.nd.zeros((2, 2))
+    a.copyto(c)
+    assert c.asnumpy().sum() == 4
+    d = a.as_in_context(mx.cpu())
+    assert d.context.device_type == "cpu"
+
+
+def test_dtype_cast():
+    a = mx.nd.ones((2, 2))
+    b = a.astype("float16")
+    assert b.dtype == np.float16
+    c = a.astype("bfloat16")
+    assert str(c.dtype) == "bfloat16"
+    assert_almost_equal(c.astype("float32"), np.ones((2, 2)))
+
+
+def test_wait_and_scalar():
+    a = mx.nd.ones((2,))
+    a.wait_to_read()
+    mx.nd.waitall()
+    s = mx.nd.array([3.5])
+    assert float(s.asscalar()) == 3.5
+    with pytest.raises(mx.MXNetError):
+        mx.nd.ones((2,)).asscalar()
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "nd.params")
+    a = mx.nd.uniform(shape=(3, 4))
+    b = mx.nd.arange(0, 5)
+    mx.nd.save(fname, {"a": a, "b": b})
+    loaded = mx.nd.load(fname)
+    assert set(loaded) == {"a", "b"}
+    assert_almost_equal(loaded["a"], a)
+    assert_almost_equal(loaded["b"], b)
+    mx.nd.save(fname, [a, b])
+    lst = mx.nd.load(fname)
+    assert len(lst) == 2
+    assert_almost_equal(lst[0], a)
+
+
+def test_iteration_len():
+    a = mx.nd.array(np.arange(6).reshape(3, 2))
+    assert len(a) == 3
+    rows = [r.asnumpy().tolist() for r in a]
+    assert rows == [[0, 1], [2, 3], [4, 5]]
+
+
+def test_attached_methods():
+    a = mx.nd.array([[1., 2.], [3., 4.]])
+    assert float(a.sum().asscalar()) == 10
+    assert float(a.mean().asscalar()) == 2.5
+    assert float(a.max().asscalar()) == 4
+    assert a.sum(axis=1).asnumpy().tolist() == [3, 7]
+    assert a.clip(2, 3).asnumpy().tolist() == [[2, 2], [3, 3]]
+    assert a.sqrt().shape == (2, 2)
+    assert a.T.shape == (2, 2)
+    assert a.expand_dims(0).shape == (1, 2, 2)
+    assert a.flatten().shape == (2, 2)
+
+
+def test_sparse_roundtrip():
+    dense = np.zeros((4, 3), np.float32)
+    dense[1] = [1, 2, 3]
+    dense[3] = [4, 5, 6]
+    rsp = mx.nd.array(dense).tostype("row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert rsp.indices.asnumpy().tolist() == [1, 3]
+    assert_almost_equal(rsp.todense(), dense)
+    csr = mx.nd.array(dense).tostype("csr")
+    assert csr.stype == "csr"
+    assert_almost_equal(csr.todense(), dense)
+
+
+def test_sparse_save_load(tmp_path):
+    fname = str(tmp_path / "sp.params")
+    dense = np.zeros((4, 3), np.float32)
+    dense[2] = [7, 8, 9]
+    rsp = mx.nd.array(dense).tostype("row_sparse")
+    mx.nd.save(fname, {"w": rsp})
+    loaded = mx.nd.load(fname)
+    assert loaded["w"].stype == "row_sparse"
+    assert_almost_equal(loaded["w"].todense(), dense)

@@ -1,0 +1,358 @@
+"""Operator numerics vs NumPy references + numeric-gradient checks.
+
+Modeled on tests/python/unittest/test_operator.py (7213 LoC in the reference): each
+op family checked against a NumPy implementation, gradients via finite differences.
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd as ag
+from mxtpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_unary_math_matches_numpy():
+    x = np.random.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    nd = mx.nd.array(x)
+    for name, ref in [
+        ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt), ("square", np.square),
+        ("sin", np.sin), ("cos", np.cos), ("tanh", np.tanh), ("abs", np.abs),
+        ("floor", np.floor), ("ceil", np.ceil), ("sign", np.sign),
+        ("log1p", np.log1p), ("expm1", np.expm1),
+    ]:
+        out = mx.ops.invoke(name, nd)
+        # rtol 1e-3: XLA CPU uses polynomial approximations for transcendentals
+        assert_almost_equal(out, ref(x), rtol=1e-3, atol=1e-5)
+
+
+def test_binary_broadcast():
+    a = np.random.uniform(-2, 2, (2, 3, 1)).astype(np.float32)
+    b = np.random.uniform(0.5, 2, (1, 3, 4)).astype(np.float32)
+    na, nb = mx.nd.array(a), mx.nd.array(b)
+    assert_almost_equal(mx.nd.broadcast_add(na, nb), a + b, rtol=1e-5)
+    assert_almost_equal(mx.nd.broadcast_mul(na, nb), a * b, rtol=1e-5)
+    assert_almost_equal(mx.nd.broadcast_maximum(na, nb), np.maximum(a, b))
+    assert_almost_equal(mx.nd.broadcast_power(na + 3, nb), np.power(a + 3, b), rtol=1e-4)
+
+
+def test_reduce_ops():
+    x = np.random.uniform(-1, 1, (2, 3, 4)).astype(np.float32)
+    nd = mx.nd.array(x)
+    assert_almost_equal(mx.nd.sum(nd), x.sum(), rtol=1e-5)
+    assert_almost_equal(mx.nd.sum(nd, axis=1), x.sum(1), rtol=1e-5)
+    assert_almost_equal(mx.nd.sum(nd, axis=(0, 2), keepdims=True),
+                        x.sum((0, 2), keepdims=True), rtol=1e-5)
+    assert_almost_equal(mx.nd.mean(nd, axis=1, exclude=True),
+                        x.mean(axis=(0, 2)), rtol=1e-5)
+    assert_almost_equal(mx.nd.max(nd, axis=2), x.max(2))
+    assert_almost_equal(mx.nd.norm(nd), np.sqrt((x ** 2).sum()), rtol=1e-5)
+    assert_almost_equal(mx.nd.argmax(nd, axis=1), x.argmax(1).astype(np.float32))
+
+
+def test_dot():
+    a = np.random.uniform(-1, 1, (3, 4)).astype(np.float32)
+    b = np.random.uniform(-1, 1, (4, 5)).astype(np.float32)
+    assert_almost_equal(mx.nd.dot(mx.nd.array(a), mx.nd.array(b)), a @ b, rtol=1e-4)
+    assert_almost_equal(
+        mx.nd.dot(mx.nd.array(a), mx.nd.array(b.T), transpose_b=True), a @ b, rtol=1e-4)
+    assert_almost_equal(
+        mx.nd.dot(mx.nd.array(a.T), mx.nd.array(b), transpose_a=True), a @ b, rtol=1e-4)
+    # batch_dot
+    x = np.random.uniform(-1, 1, (2, 3, 4)).astype(np.float32)
+    y = np.random.uniform(-1, 1, (2, 4, 5)).astype(np.float32)
+    assert_almost_equal(mx.nd.batch_dot(mx.nd.array(x), mx.nd.array(y)),
+                        np.matmul(x, y), rtol=1e-4)
+
+
+def test_fully_connected():
+    x = np.random.uniform(-1, 1, (2, 5)).astype(np.float32)
+    w = np.random.uniform(-1, 1, (3, 5)).astype(np.float32)
+    b = np.random.uniform(-1, 1, (3,)).astype(np.float32)
+    out = mx.nd.FullyConnected(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+                               num_hidden=3)
+    assert_almost_equal(out, x @ w.T + b, rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(
+        lambda a, ww, bb: mx.nd.FullyConnected(a, ww, bb, num_hidden=3).sum(),
+        [x, w, b], rtol=2e-2, atol=1e-2)
+
+
+def test_convolution_vs_reference():
+    # compare against explicit im2col NumPy conv
+    x = np.random.uniform(-1, 1, (1, 2, 5, 5)).astype(np.float32)
+    w = np.random.uniform(-1, 1, (3, 2, 3, 3)).astype(np.float32)
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), no_bias=True,
+                            kernel=(3, 3), num_filter=3).asnumpy()
+    ref = np.zeros((1, 3, 3, 3), np.float32)
+    for o in range(3):
+        for i in range(3):
+            for j in range(3):
+                patch = x[0, :, i:i + 3, j:j + 3]
+                ref[0, o, i, j] = (patch * w[o]).sum()
+    assert_almost_equal(out, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_conv_grouped_and_strided():
+    x = mx.nd.uniform(shape=(2, 4, 8, 8))
+    w = mx.nd.uniform(shape=(4, 1, 3, 3))
+    out = mx.nd.Convolution(x, w, no_bias=True, kernel=(3, 3), num_filter=4,
+                            num_group=4, stride=(2, 2), pad=(1, 1))
+    assert out.shape == (2, 4, 4, 4)
+
+
+def test_pooling():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert out.asnumpy().reshape(2, 2).tolist() == [[5, 7], [13, 15]]
+    avg = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    assert avg.asnumpy().reshape(2, 2).tolist() == [[2.5, 4.5], [10.5, 12.5]]
+    gl = mx.nd.Pooling(mx.nd.array(x), global_pool=True, pool_type="max")
+    assert gl.shape == (1, 1, 1, 1) and float(gl.asscalar()) == 15
+
+
+def test_softmax_and_grad():
+    x = np.random.uniform(-2, 2, (3, 5)).astype(np.float32)
+    out = mx.nd.softmax(mx.nd.array(x))
+    e = np.exp(x - x.max(1, keepdims=True))
+    assert_almost_equal(out, e / e.sum(1, keepdims=True), rtol=1e-5)
+    check_numeric_gradient(lambda a: mx.nd.softmax(a).sum(), [x], rtol=2e-2, atol=1e-3)
+    ls = mx.nd.log_softmax(mx.nd.array(x))
+    assert_almost_equal(ls, np.log(e / e.sum(1, keepdims=True)), rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_modes():
+    x = np.random.uniform(-1, 1, (4, 3, 2, 2)).astype(np.float32)
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mm = np.zeros(3, np.float32)
+    mv = np.ones(3, np.float32)
+    args = [mx.nd.array(v) for v in (x, gamma, beta, mm, mv)]
+    # inference: normalize by moving stats
+    out = mx.nd.BatchNorm(*args, eps=0.0)
+    assert_almost_equal(out, x, rtol=1e-4, atol=1e-5)
+    # training: batch stats
+    with ag.record():
+        out_t = mx.nd.BatchNorm(*args, eps=1e-5)
+    o = out_t.asnumpy()
+    assert abs(o.mean(axis=(0, 2, 3))).max() < 1e-4
+    assert abs(o.std(axis=(0, 2, 3)) - 1).max() < 1e-2
+
+
+def test_layernorm():
+    x = np.random.uniform(-1, 1, (2, 5)).astype(np.float32)
+    g = np.random.uniform(0.5, 1.5, (5,)).astype(np.float32)
+    b = np.random.uniform(-0.5, 0.5, (5,)).astype(np.float32)
+    out = mx.nd.LayerNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b), eps=1e-5)
+    mu = x.mean(-1, keepdims=True)
+    sd = np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    assert_almost_equal(out, (x - mu) / sd * g + b, rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(
+        lambda a, gg, bb: mx.nd.LayerNorm(a, gg, bb).sum(), [x, g, b],
+        rtol=2e-2, atol=1e-2)
+
+
+def test_activations():
+    x = np.array([-2., -0.5, 0., 0.5, 2.], np.float32)
+    nd = mx.nd.array(x)
+    assert_almost_equal(mx.nd.Activation(nd, act_type="relu"), np.maximum(x, 0))
+    assert_almost_equal(mx.nd.Activation(nd, act_type="sigmoid"), 1 / (1 + np.exp(-x)),
+                        rtol=1e-5)
+    assert_almost_equal(mx.nd.LeakyReLU(nd, act_type="leaky", slope=0.1),
+                        np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+    assert_almost_equal(mx.nd.LeakyReLU(nd, act_type="elu", slope=1.0),
+                        np.where(x > 0, x, np.exp(x) - 1), rtol=1e-5)
+    g = mx.nd.array(np.array([0.2], np.float32))
+    assert_almost_equal(mx.nd.LeakyReLU(nd, g, act_type="prelu"),
+                        np.where(x > 0, x, 0.2 * x), rtol=1e-6)
+
+
+def test_take_embedding_onehot():
+    w = np.random.uniform(-1, 1, (10, 4)).astype(np.float32)
+    idx = np.array([1, 3, 5], np.float32)
+    out = mx.nd.Embedding(mx.nd.array(idx), mx.nd.array(w), input_dim=10, output_dim=4)
+    assert_almost_equal(out, w[[1, 3, 5]])
+    t = mx.nd.take(mx.nd.array(w), mx.nd.array(idx))
+    assert_almost_equal(t, w[[1, 3, 5]])
+    oh = mx.nd.one_hot(mx.nd.array([0, 2]), 3)
+    assert oh.asnumpy().tolist() == [[1, 0, 0], [0, 0, 1]]
+    # embedding gradient is scatter-add
+    wnd = mx.nd.array(w)
+    wnd.attach_grad()
+    with ag.record():
+        y = mx.nd.Embedding(mx.nd.array(np.array([1, 1, 2], np.float32)), wnd,
+                            input_dim=10, output_dim=4).sum()
+    y.backward()
+    expect = np.zeros_like(w)
+    expect[1] = 2
+    expect[2] = 1
+    assert_almost_equal(wnd.grad, expect)
+
+
+def test_concat_split_stack():
+    a = np.ones((2, 3), np.float32)
+    b = 2 * np.ones((2, 3), np.float32)
+    c = mx.nd.Concat(mx.nd.array(a), mx.nd.array(b), dim=0)
+    assert c.shape == (4, 3)
+    parts = mx.nd.SliceChannel(c, num_outputs=2, axis=0)
+    assert_almost_equal(parts[0], a)
+    assert_almost_equal(parts[1], b)
+    s = mx.nd.stack(mx.nd.array(a), mx.nd.array(b), axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_transpose_slice_pad():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    nd = mx.nd.array(x)
+    assert_almost_equal(mx.nd.transpose(nd, axes=(2, 0, 1)), x.transpose(2, 0, 1))
+    assert_almost_equal(mx.nd.slice(nd, begin=(0, 1), end=(2, 3)), x[0:2, 1:3])
+    assert_almost_equal(mx.nd.slice_axis(nd, axis=2, begin=1, end=3), x[:, :, 1:3])
+    assert_almost_equal(mx.nd.reverse(nd, axis=1), x[:, ::-1, :])
+    assert_almost_equal(mx.nd.tile(nd, reps=(1, 2, 1)), np.tile(x, (1, 2, 1)))
+    x4 = np.ones((1, 1, 2, 2), np.float32)
+    padded = mx.nd.pad(mx.nd.array(x4), mode="constant",
+                       pad_width=(0, 0, 0, 0, 1, 1, 1, 1), constant_value=9)
+    assert padded.shape == (1, 1, 4, 4)
+    assert float(padded[0, 0, 0, 0].asscalar()) == 9
+
+
+def test_ordering():
+    x = np.array([[3., 1., 2.], [0., 5., 4.]], np.float32)
+    nd = mx.nd.array(x)
+    assert_almost_equal(mx.nd.sort(nd), np.sort(x, -1))
+    assert_almost_equal(mx.nd.argsort(nd), np.argsort(x, -1).astype(np.float32))
+    tk = mx.nd.topk(nd, k=2, ret_typ="value")
+    assert tk.asnumpy().tolist() == [[3, 2], [5, 4]]
+    ti = mx.nd.topk(nd, k=1)
+    assert ti.asnumpy().reshape(-1).tolist() == [0, 1]
+
+
+def test_where_clip_misc():
+    cond = mx.nd.array([1., 0., 1.])
+    a = mx.nd.array([1., 2., 3.])
+    b = mx.nd.array([10., 20., 30.])
+    assert mx.nd.where(cond, a, b).asnumpy().tolist() == [1, 20, 3]
+    assert mx.nd.clip(b, 15, 25).asnumpy().tolist() == [15, 20, 25]
+    assert_almost_equal(mx.nd.elemwise_sum(a, a, a), 3 * a.asnumpy())
+
+
+def test_sequence_ops():
+    x = np.arange(24, dtype=np.float32).reshape(4, 2, 3)  # (T, N, C)
+    length = mx.nd.array([2., 4.])
+    masked = mx.nd.SequenceMask(mx.nd.array(x), length, use_sequence_length=True,
+                                value=-1.0)
+    m = masked.asnumpy()
+    assert (m[2:, 0] == -1).all() and (m[:, 1] != -1).all()
+    last = mx.nd.SequenceLast(mx.nd.array(x), length, use_sequence_length=True)
+    assert_almost_equal(last, np.stack([x[1, 0], x[3, 1]]))
+    rev = mx.nd.SequenceReverse(mx.nd.array(x), length, use_sequence_length=True)
+    assert_almost_equal(rev.asnumpy()[0, 0], x[1, 0])
+    assert_almost_equal(rev.asnumpy()[0, 1], x[3, 1])
+
+
+def test_softmax_output_grad():
+    x = np.random.uniform(-1, 1, (4, 3)).astype(np.float32)
+    label = np.array([0, 1, 2, 1], np.float32)
+    data = mx.nd.array(x)
+    data.attach_grad()
+    with ag.record():
+        out = mx.nd.SoftmaxOutput(data, mx.nd.array(label))
+    out.backward()
+    p = np.exp(x - x.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    expect = p.copy()
+    expect[np.arange(4), label.astype(int)] -= 1
+    assert_almost_equal(data.grad, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_lstm_shapes():
+    T, N, I, H, L = 5, 2, 4, 8, 2
+    from mxtpu.ops.rnn_ops import rnn_param_size
+    psz = rnn_param_size("lstm", L, I, H)
+    params = mx.nd.uniform(-0.1, 0.1, shape=(psz,))
+    x = mx.nd.uniform(shape=(T, N, I))
+    h0 = mx.nd.zeros((L, N, H))
+    c0 = mx.nd.zeros((L, N, H))
+    out = mx.nd.RNN(x, params, h0, c0, state_size=H, num_layers=L, mode="lstm")
+    assert out.shape == (T, N, H)
+    outs = mx.nd.RNN(x, params, h0, c0, state_size=H, num_layers=L, mode="lstm",
+                     state_outputs=True)
+    assert outs[1].shape == (L, N, H) and outs[2].shape == (L, N, H)
+    # bidirectional GRU
+    psz = rnn_param_size("gru", 1, I, H, bidirectional=True)
+    params = mx.nd.uniform(-0.1, 0.1, shape=(psz,))
+    h0 = mx.nd.zeros((2, N, H))
+    out = mx.nd.RNN(x, params, h0, state_size=H, num_layers=1, mode="gru",
+                    bidirectional=True)
+    assert out.shape == (T, N, 2 * H)
+
+
+def test_control_flow_foreach():
+    def step(x, state):
+        new = state + x
+        return new, new
+
+    data = mx.nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+    init = mx.nd.zeros((2,))
+    outs, final = mx.ops.invoke("foreach", step, data, init)
+    assert_almost_equal(final, data.asnumpy().sum(0))
+    assert_almost_equal(outs, np.cumsum(data.asnumpy(), 0))
+
+
+def test_control_flow_while_cond():
+    def cond_fn(i, s):
+        return i < 5
+
+    def body_fn(i, s):
+        return [i + 1, s + i]
+
+    _, (i_f, s_f) = mx.ops.invoke("while_loop", cond_fn, body_fn,
+                                  [mx.nd.array([0.0]), mx.nd.array([0.0])])
+    assert float(i_f.asscalar()) == 5
+    assert float(s_f.asscalar()) == 10
+    r = mx.ops.invoke("cond", mx.nd.array([1.0]),
+                      lambda x: x * 2, lambda x: x * 3, mx.nd.array([7.0]))
+    assert float(r.asscalar()) == 14
+
+
+def test_linalg():
+    a = np.random.uniform(-1, 1, (3, 3)).astype(np.float32)
+    spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    L = mx.nd.linalg_potrf(mx.nd.array(spd))
+    assert_almost_equal(mx.nd.dot(L, L.T), spd, rtol=1e-3, atol=1e-4)
+    assert_almost_equal(mx.nd.linalg_sumlogdiag(mx.nd.array(spd)),
+                        np.log(np.diag(spd)).sum(), rtol=1e-4)
+
+
+def test_random_ops():
+    u = mx.nd.uniform(0, 1, shape=(1000,))
+    a = u.asnumpy()
+    assert 0 <= a.min() and a.max() <= 1 and 0.4 < a.mean() < 0.6
+    n = mx.nd.normal(0, 1, shape=(2000,)).asnumpy()
+    assert abs(n.mean()) < 0.1 and 0.8 < n.std() < 1.2
+    mx.random.seed(42)
+    x1 = mx.nd.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(42)
+    x2 = mx.nd.uniform(shape=(5,)).asnumpy()
+    assert (x1 == x2).all()
+    m = mx.nd.multinomial(mx.nd.array([0., 0., 1., 0.]))
+    assert int(m.asscalar()) == 2
+
+
+def test_optimizer_ops():
+    w = mx.nd.array([1.0, 2.0])
+    g = mx.nd.array([0.1, 0.1])
+    mx.nd.sgd_update(w, g, 0.5)  # lr positional
+    assert_almost_equal(w, [0.95, 1.95])
+    w = mx.nd.array([1.0])
+    mom = mx.nd.zeros((1,))
+    mx.nd.sgd_mom_update(w, mx.nd.array([1.0]), mom, 0.1, momentum=0.9)
+    assert_almost_equal(w, [0.9])
+    assert_almost_equal(mom, [-0.1])
+
+
+def test_gather_scatter():
+    data = mx.nd.array(np.arange(9, dtype=np.float32).reshape(3, 3))
+    idx = mx.nd.array([[0, 2], [1, 1]])  # (2, M) indexing dims 0,1
+    out = mx.nd.gather_nd(data, idx)
+    assert out.asnumpy().tolist() == [1, 7]
+    sc = mx.nd.scatter_nd(mx.nd.array([5.0, 6.0]), idx, shape=(3, 3))
+    assert float(sc[0, 1].asscalar()) == 5 and float(sc[2, 1].asscalar()) == 6
