@@ -26,3 +26,12 @@ from . import ndarray
 from . import ndarray as nd  # mx.nd alias
 from .ndarray import NDArray
 from . import ops
+from . import initializer
+from . import initializer as init  # mx.init alias
+from . import lr_scheduler
+from . import optimizer
+from . import metric
+from . import kvstore
+from . import kvstore as kv  # mx.kv alias
+from . import gluon
+from . import test_utils

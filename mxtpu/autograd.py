@@ -230,8 +230,8 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
             out_cots.append(c)
         in_cots = vjp_fn(out_cots[0] if num_out == 1 else tuple(out_cots))
         for e, c in zip(node.in_entries, in_cots):
-            if c is None:
-                continue
+            if c is None or getattr(c, "dtype", None) == jax.dtypes.float0:
+                continue  # non-differentiable (integer) input
             cots[id(e)] = cots.get(id(e), 0) + c
             if e.node is None:
                 leaf_entries[id(e)] = e

@@ -14,8 +14,12 @@ import numpy as _np
 
 __all__ = [
     "MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",
-    "DTYPE_TO_CODE", "CODE_TO_DTYPE", "np_dtype",
+    "DTYPE_TO_CODE", "CODE_TO_DTYPE", "np_dtype", "numeric_types", "string_types",
 ]
+
+# ref: python/mxnet/base.py numeric_types/string_types
+numeric_types = (float, int, _np.generic)
+string_types = (str,)
 
 
 class MXNetError(RuntimeError):

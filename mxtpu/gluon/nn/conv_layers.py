@@ -1,0 +1,283 @@
+"""Convolution & pooling layers (ref: python/mxnet/gluon/nn/conv_layers.py)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D",
+           "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D", "GlobalMaxPool2D",
+           "GlobalMaxPool3D", "GlobalAvgPool1D", "GlobalAvgPool2D",
+           "GlobalAvgPool3D", "ReflectionPad2D"]
+
+
+def _tuplify(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+class _Conv(HybridBlock):
+    """Shared conv implementation (ref: conv_layers.py:_Conv)."""
+
+    def __init__(self, channels, kernel_size, strides, padding, dilation, groups,
+                 layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", op_name="Convolution",
+                 adj=None, **kwargs):
+        super().__init__(**kwargs)
+        self._channels = channels
+        self._in_channels = in_channels
+        ndim = len(kernel_size)
+        self._layout = layout
+        self._op_name = op_name
+        self._kwargs = dict(kernel=kernel_size, stride=strides, dilate=dilation,
+                            pad=padding, num_filter=channels, num_group=groups,
+                            no_bias=not use_bias, layout=layout)
+        if adj is not None:
+            self._kwargs["adj"] = adj
+        # weight layout: (out, in/g, *k) for Convolution; (in, out/g, *k) transposed
+        if op_name == "Convolution":
+            wshape = (channels, in_channels // groups if in_channels else 0) + kernel_size
+        else:
+            wshape = (in_channels, channels // groups if channels else 0) + kernel_size
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=wshape, init=weight_initializer,
+                allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(channels,), init=bias_initializer,
+                    allow_deferred_init=True)
+            else:
+                self.bias = None
+            if activation is not None:
+                from .activations import Activation
+                self.act = Activation(activation)
+            else:
+                self.act = None
+
+    def _channel_axis(self):
+        return len(self._layout) - 1 if self._layout.endswith("C") and \
+            self._layout[1] != "C" else 1
+
+    def infer_shape(self, x, *args):
+        axis = 1 if self._layout[1] == "C" else len(self._layout) - 1
+        in_c = x.shape[axis]
+        groups = self._kwargs["num_group"]
+        kernel = tuple(self._kwargs["kernel"])
+        if self._op_name == "Convolution":
+            self.weight._shape_resolved((self._channels, in_c // groups) + kernel)
+        else:
+            self.weight._shape_resolved((in_c, self._channels // groups) + kernel)
+        if self.bias is not None:
+            self.bias._shape_resolved((self._channels,))
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        op = getattr(F, self._op_name)
+        act = op(x, weight, bias, **self._kwargs)
+        if self.act is not None:
+            act = self.act(act)
+        return act
+
+    def __repr__(self):
+        s = "{name}({mapping}, kernel_size={kernel}, stride={stride})"
+        shape = self.weight.shape
+        return s.format(name=self.__class__.__name__,
+                        mapping="{0} -> {1}".format(shape[1] if shape[1] else None,
+                                                    shape[0]),
+                        kernel=self._kwargs["kernel"], stride=self._kwargs["stride"])
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", in_channels=0,
+                 **kwargs):
+        super().__init__(channels, _tuplify(kernel_size, 1), _tuplify(strides, 1),
+                         _tuplify(padding, 1), _tuplify(dilation, 1), groups, layout,
+                         in_channels, activation, use_bias, weight_initializer,
+                         bias_initializer, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 use_bias=True, weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, _tuplify(kernel_size, 2), _tuplify(strides, 2),
+                         _tuplify(padding, 2), _tuplify(dilation, 2), groups, layout,
+                         in_channels, activation, use_bias, weight_initializer,
+                         bias_initializer, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1), padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", activation=None,
+                 use_bias=True, weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, _tuplify(kernel_size, 3), _tuplify(strides, 3),
+                         _tuplify(padding, 3), _tuplify(dilation, 3), groups, layout,
+                         in_channels, activation, use_bias, weight_initializer,
+                         bias_initializer, **kwargs)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, output_padding=0,
+                 dilation=1, groups=1, layout="NCW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", in_channels=0,
+                 **kwargs):
+        super().__init__(channels, _tuplify(kernel_size, 1), _tuplify(strides, 1),
+                         _tuplify(padding, 1), _tuplify(dilation, 1), groups, layout,
+                         in_channels, activation, use_bias, weight_initializer,
+                         bias_initializer, op_name="Deconvolution",
+                         adj=_tuplify(output_padding, 1), **kwargs)
+        self.outpad = _tuplify(output_padding, 1)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1, layout="NCHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _tuplify(kernel_size, 2), _tuplify(strides, 2),
+                         _tuplify(padding, 2), _tuplify(dilation, 2), groups, layout,
+                         in_channels, activation, use_bias, weight_initializer,
+                         bias_initializer, op_name="Deconvolution",
+                         adj=_tuplify(output_padding, 2), **kwargs)
+        self.outpad = _tuplify(output_padding, 2)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1), padding=(0, 0, 0),
+                 output_padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", in_channels=0,
+                 **kwargs):
+        super().__init__(channels, _tuplify(kernel_size, 3), _tuplify(strides, 3),
+                         _tuplify(padding, 3), _tuplify(dilation, 3), groups, layout,
+                         in_channels, activation, use_bias, weight_initializer,
+                         bias_initializer, op_name="Deconvolution",
+                         adj=_tuplify(output_padding, 3), **kwargs)
+        self.outpad = _tuplify(output_padding, 3)
+
+
+class _Pooling(HybridBlock):
+    """Shared pooling implementation (ref: conv_layers.py:_Pooling)."""
+
+    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
+                 pool_type, layout=None, count_include_pad=None, **kwargs):
+        super().__init__(**kwargs)
+        if strides is None:
+            strides = pool_size
+        self._kwargs = dict(
+            kernel=pool_size, stride=strides, pad=padding, global_pool=global_pool,
+            pool_type=pool_type,
+            pooling_convention="full" if ceil_mode else "valid")
+        if count_include_pad is not None:
+            self._kwargs["count_include_pad"] = count_include_pad
+
+    def _alias(self):
+        return "pool"
+
+    def hybrid_forward(self, F, x):
+        return F.Pooling(x, **self._kwargs)
+
+    def __repr__(self):
+        return "{name}(size={kernel}, stride={stride}, padding={pad}, ceil_mode={ceil})".format(
+            name=self.__class__.__name__, ceil=self._kwargs["pooling_convention"] == "full",
+            **{k: self._kwargs[k] for k in ("kernel", "stride", "pad")})
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(_tuplify(pool_size, 1),
+                         _tuplify(strides, 1) if strides is not None else None,
+                         _tuplify(padding, 1), ceil_mode, False, "max", layout, **kwargs)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(_tuplify(pool_size, 2),
+                         _tuplify(strides, 2) if strides is not None else None,
+                         _tuplify(padding, 2), ceil_mode, False, "max", layout, **kwargs)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout="NCDHW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(_tuplify(pool_size, 3),
+                         _tuplify(strides, 3) if strides is not None else None,
+                         _tuplify(padding, 3), ceil_mode, False, "max", layout, **kwargs)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(_tuplify(pool_size, 1),
+                         _tuplify(strides, 1) if strides is not None else None,
+                         _tuplify(padding, 1), ceil_mode, False, "avg", layout,
+                         count_include_pad, **kwargs)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(_tuplify(pool_size, 2),
+                         _tuplify(strides, 2) if strides is not None else None,
+                         _tuplify(padding, 2), ceil_mode, False, "avg", layout,
+                         count_include_pad, **kwargs)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout="NCDHW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(_tuplify(pool_size, 3),
+                         _tuplify(strides, 3) if strides is not None else None,
+                         _tuplify(padding, 3), ceil_mode, False, "avg", layout,
+                         count_include_pad, **kwargs)
+
+
+class GlobalMaxPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), None, (0,), True, True, "max", layout, **kwargs)
+
+
+class GlobalMaxPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, (0, 0), True, True, "max", layout, **kwargs)
+
+
+class GlobalMaxPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "max", layout, **kwargs)
+
+
+class GlobalAvgPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), None, (0,), True, True, "avg", layout, **kwargs)
+
+
+class GlobalAvgPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, (0, 0), True, True, "avg", layout, **kwargs)
+
+
+class GlobalAvgPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "avg", layout, **kwargs)
+
+
+class ReflectionPad2D(HybridBlock):
+    """Reflection padding on H/W of NCHW input (ref: nn/conv_layers.py:ReflectionPad2D,
+    op src/operator/pad.cc mode='reflect')."""
+
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(padding, int):
+            padding = (0, 0, 0, 0, padding, padding, padding, padding)
+        self._padding = padding
+
+    def hybrid_forward(self, F, x):
+        return F.pad(x, mode="reflect", pad_width=self._padding)

@@ -1,0 +1,168 @@
+"""Trainer: applies an Optimizer to a set of Parameters.
+
+Reference: ``python/mxnet/gluon/trainer.py:27-423`` — kvstore setup (:158),
+``step`` (:254) = _allreduce_grads (kv.push/pull per param, :304) then _update
+(per-device Updater, :347), save/load_states (:376).
+
+TPU-native notes: parameters have ONE logical copy (possibly sharded on the mesh),
+so `_allreduce_grads` reduces across the mesh via the kvstore's XLA-collective
+push/pull rather than across per-GPU copies. ``update_on_kvstore`` semantics are
+preserved: True runs the optimizer inside the store (the reference's server-side
+update), False runs the updater locally after the reduce.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError(
+                "First argument must be a list or dict of Parameters, got %s."
+                % type(params))
+        self._params = []
+        for param in params:
+            if not isinstance(param, Parameter):
+                raise MXNetError(
+                    "First argument must be a list or dict of Parameters, got "
+                    "list of %s." % type(param))
+            param._trainer = self
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kv_initialized = False
+        self._kvstore_kind = kvstore
+        self._kvstore = None
+        self._update_on_kvstore = update_on_kvstore
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params:
+                raise MXNetError(
+                    "optimizer_params must be None if optimizer is an Optimizer "
+                    "instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer, param_dict=param_dict,
+                                             **optimizer_params)
+        self._updaters = [opt_mod.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        if self._kvstore_kind:
+            from .. import kvstore as kv_mod
+            kv = kv_mod.create(self._kvstore_kind) \
+                if isinstance(self._kvstore_kind, str) else self._kvstore_kind
+            if self._compression_params:
+                kv.set_gradient_compression(self._compression_params)
+            update_on_kvstore = self._update_on_kvstore
+            if update_on_kvstore is None:
+                update_on_kvstore = "dist" in kv.type
+            for i, param in enumerate(self._params):
+                if param._data is not None:
+                    kv.init(i, param.data())
+            if update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+            self._kvstore = kv
+            self._update_on_kvstore = update_on_kvstore
+        else:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """One optimization step (ref: trainer.py:254). rescale_grad is set to
+        1/batch_size on top of any user scale, like the reference."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError("allreduce_grads() when parameters are updated on "
+                             "kvstore is not supported")
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                if self._update_on_kvstore:
+                    # push grad; pull back the updated weight (server-side update)
+                    self._kvstore.push(i, param.list_grad(), priority=-i)
+                    self._kvstore.pull(i, param.list_data(), priority=-i)
+                else:
+                    self._kvstore.push(i, param.list_grad(), priority=-i)
+                    self._kvstore.pull(i, param.list_grad(), priority=-i,
+                                       ignore_sparse=False)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore and self._update_on_kvstore:
+            raise MXNetError("update() when parameters are updated on kvstore "
+                             "is not supported")
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._update_on_kvstore:
+            return  # weights already updated by the store during push/pull
+        updater = self._updaters[0]
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if not ignore_stale_grad and param._data is None:
+                raise MXNetError("Parameter %s was not initialized" % param.name)
+            updater(i, param.grad(), param.data())
+
+    def save_states(self, fname):
+        """Save optimizer/updater states (ref: trainer.py:376)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            for updater in self._updaters:
+                updater.set_states(states)
+                updater.optimizer = self._optimizer
+        self._optimizer.param_dict = {i: p for i, p in enumerate(self._params)}

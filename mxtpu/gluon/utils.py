@@ -1,0 +1,78 @@
+"""Gluon utilities (ref: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch axis into num_slice chunks (ref: utils.py:split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            "data with shape %s cannot be evenly split into %d slices along axis %d. "
+            "Use a batch size that's a multiple of %d or set even_split=False."
+            % (str(data.shape), num_slice, batch_axis, num_slice))
+    step = size // num_slice
+    if not even_split and size < num_slice:
+        step = 1
+        num_slice = size
+    slices = []
+    for i in range(num_slice):
+        lo = i * step
+        hi = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(lo, hi)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split and place on each ctx (ref: utils.py:split_and_load). On TPU the
+    mesh-sharded path (mxtpu.parallel) supersedes per-ctx copies; this keeps the
+    multi-device-loop API working."""
+    from ..ndarray import array
+    if not isinstance(data, NDArray):
+        data = array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so that the sum of their 2-norm is smaller than max_norm
+    (ref: utils.py:clip_global_norm)."""
+    if not arrays:
+        raise MXNetError("arrays must not be empty")
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(a._data.astype(jnp.float32)))
+                         for a in arrays))
+    total_f = float(total)
+    if check_isfinite and not jnp.isfinite(total_f):
+        import warnings
+        warnings.warn("nan or inf is detected. Clipping results will be undefined.",
+                      stacklevel=2)
+    scale = max_norm / (total_f + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._set_data(a._data * scale)
+    return total_f
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None):  # pragma: no cover
+    raise MXNetError("download() requires network access, which is unavailable "
+                     "in this environment")

@@ -19,6 +19,11 @@ _REGISTRY = {}
 
 def register(klass):
     _REGISTRY[klass.__name__.lower()] = klass
+    # string aliases matching mx.init.create names (ref: mxnet uses 'zeros'/'ones')
+    _ALIASES = {"zero": "zeros", "one": "ones"}
+    alias = _ALIASES.get(klass.__name__.lower())
+    if alias:
+        _REGISTRY[alias] = klass
     return klass
 
 

@@ -1,0 +1,204 @@
+"""KVStore: parameter synchronization over XLA collectives.
+
+Reference: ``include/mxnet/kvstore.h:59-411`` and ``src/kvstore/`` — `local`/`device`
+reduce gradients across device copies (CommCPU/CommDevice/CommDeviceTree,
+src/kvstore/comm.h, comm_tree.h), `nccl` uses ncclReduce/Bcast (kvstore_nccl.h), and
+`dist_*` shards keys over ps-lite parameter servers (kvstore_dist.h).
+
+TPU-native re-design (SURVEY §2.3 "→ TPU" and §5): there is ONE logical copy of each
+parameter, laid out on the `jax.sharding.Mesh`. Cross-device reduction is an XLA
+all-reduce riding ICI — the topology-aware tree building (gpu_topology.h's
+Kernighan-Lin partitioning), P2P buffer heuristics, and NCCL integration are all
+*subsumed* by the XLA collective layer, so this file replaces ~3k LoC of comm code
+with sharding annotations. Multi-host (the reference's ps-lite path) is the same
+collective spanning DCN via jax.distributed initialization — `dist_sync` and `nccl`
+therefore share one implementation. `dist_async`'s parameter-server semantics have no
+collective analog and raise (SURVEY §7 hard-part 5 scopes this to sync).
+
+The data-plane reduction for the *fast path* happens inside the jitted train step
+(mxtpu.parallel); this KVStore services the Trainer/Module API: Init/Push/Pull/
+set_updater/rank/num_workers/Barrier, so frontend training loops run unmodified.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_str(key):
+    return str(key)
+
+
+class KVStore:
+    """Key-value store for parameter synchronization (ref: kvstore.h:59)."""
+
+    def __init__(self, kind="local"):
+        self._kind = kind
+        self._store = {}      # key -> NDArray (the merged/authoritative copy)
+        self._updater = None
+        self._optimizer = None
+        self._compression = None
+
+    @property
+    def type(self):
+        return self._kind
+
+    # ------------------------------------------------------------------- init
+    def init(self, key, value):
+        """Initialize key(s) (ref: KVStore::Init; rank-0 broadcast semantics are
+        trivial single-logical-copy here)."""
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                continue
+            self._store[k] = NDArray(v._data)
+
+    # -------------------------------------------------------------- push/pull
+    def push(self, key, value, priority=0):
+        """Aggregate value(s) into the store (ref: KVStoreLocal::PushImpl,
+        src/kvstore/kvstore_local.h:184: comm_->Reduce then updater or merge)."""
+        keys, values = _normalize_grouped(key, value)
+        for k, vs in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("key %s has not been initialized" % k)
+            # reduce across "devices": with one logical copy this is a tree-sum
+            # of the pushed list (ElementwiseSum, src/ndarray/ndarray.cc:1280)
+            merged = vs[0]._data
+            for v in vs[1:]:
+                merged = merged + v._data
+            if self._updater is not None:
+                self._updater(_int_key(k), NDArray(merged), self._store[k])
+            else:
+                self._store[k]._set_data(merged)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Copy current value into out (ref: KVStoreLocal::PullImpl)."""
+        keys, outs = _normalize_grouped(key, out)
+        for k, os_ in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %s has not been initialized" % k)
+            for o in os_:
+                o._set_data(jnp.asarray(self._store[k]._data, dtype=o._data.dtype))
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only given rows (ref: KVStore::PullRowSparse, kvstore.h:235;
+        dist row-sparse path kvstore_dist.h:448). TPU lowering: gather of the
+        requested rows — across hosts this is an all-gather of ids + dynamic-slice."""
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        keys, outs = _normalize_grouped(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        for k, os_ in zip(keys, outs):
+            src = self._store[k]
+            for o, rid in zip(os_, rids * len(os_)):
+                rows = rid._data.astype(jnp.int32)
+                from .ndarray.sparse import RowSparseNDArray
+                vals = src._data[rows]
+                if isinstance(o, RowSparseNDArray):
+                    o._update(NDArray(vals), NDArray(rows))
+                else:
+                    o._set_data(vals)
+
+    # -------------------------------------------------------------- optimizer
+    def set_updater(self, updater):
+        """Run this updater on merged gradients (ref: KVStore::set_updater)."""
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        from . import optimizer as opt_mod
+        self._optimizer = optimizer
+        self.set_updater(opt_mod.get_updater(optimizer))
+
+    def set_gradient_compression(self, compression_params):
+        """2-bit gradient compression (ref: src/kvstore/gradient_compression.h).
+        Stored for API parity; the collective data plane runs uncompressed over
+        ICI where bandwidth makes compression counterproductive."""
+        self._compression = dict(compression_params)
+
+    # ------------------------------------------------------------ distributed
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        return jax.process_count()
+
+    def barrier(self):
+        """Global barrier (ref: KVStore::Barrier → ps Postoffice barrier). A psum
+        across all devices is the collective rendezvous."""
+        if jax.device_count() > 1:
+            x = jnp.ones((jax.local_device_count(),))
+            jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x).block_until_ready()
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("there is no optimizer set, cannot save states")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("there is no optimizer set, cannot load states")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def _int_key(k):
+    try:
+        return int(k)
+    except ValueError:
+        return k
+
+
+def _normalize(key, value):
+    if isinstance(key, (list, tuple)):
+        out_v = []
+        for v in value:
+            out_v.append(v)
+        return [_key_str(k) for k in key], out_v
+    return [_key_str(key)], [value]
+
+
+def _normalize_grouped(key, value):
+    """Group values per key (a key may receive a list of per-device values)."""
+    if isinstance(key, (list, tuple)):
+        keys = [_key_str(k) for k in key]
+        if len(value) == len(keys) and all(
+                isinstance(v, (list, tuple)) for v in value):
+            return keys, [list(v) for v in value]
+        if len(value) == len(keys):
+            return keys, [[v] for v in value]
+        per = len(value) // len(keys)
+        return keys, [list(value[i * per:(i + 1) * per]) for i in range(len(keys))]
+    vs = value if isinstance(value, (list, tuple)) else [value]
+    return [_key_str(key)], [list(vs)]
+
+
+def create(name="local"):
+    """Factory (ref: src/kvstore/kvstore.cc:40-72). `local`, `device`, and `nccl`
+    collapse to the same XLA-collective store; `dist_sync*` requires
+    jax.distributed multi-process initialization."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "local_allreduce_device", "device", "nccl"):
+        return KVStore(name)
+    if name in ("dist_sync", "dist_sync_device"):
+        return KVStore(name)
+    if name in ("dist_async", "dist"):
+        raise MXNetError(
+            "dist_async parameter-server semantics have no XLA-collective analog "
+            "(SURVEY §7); use dist_sync")
+    raise MXNetError("unknown KVStore type %s" % name)

@@ -59,10 +59,13 @@ def _apply(fn, args, kwargs=None, name="", num_outputs=None):
                 a[i] = in_data[j]
             for j, k in enumerate(nd_keys):
                 kw[k] = in_data[len(nd_idx) + j]
-            return fn(*a, **kw)
+            out = fn(*a, **kw)
+            # normalize multi-output to tuple so vjp cotangent structure is stable
+            return tuple(out) if isinstance(out, list) else out
     else:
         def pure_fn():
-            return fn(*args, **kwargs)
+            out = fn(*args, **kwargs)
+            return tuple(out) if isinstance(out, list) else out
 
     out_data = pure_fn(*[x._data for x in inputs])
     if isinstance(out_data, (tuple, list)):

@@ -192,10 +192,10 @@ def Pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
 
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
+        return lax.reduce_window(data, init, lax.max,
                                  window, strides, padding)
     if pool_type in ("avg", "sum"):
-        s = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
+        s = lax.reduce_window(data, 0, lax.add,
                               window, strides, padding)
         if pool_type == "sum":
             return s
@@ -205,12 +205,12 @@ def Pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
                 denom *= kernel[i]
             return s / denom
         ones = jnp.ones(data.shape, data.dtype)
-        cnt = lax.reduce_window(ones, jnp.asarray(0, data.dtype), lax.add,
+        cnt = lax.reduce_window(ones, 0, lax.add,
                                 window, strides, padding)
         return s / cnt
     if pool_type == "lp":
         p = p_value or 2
-        s = lax.reduce_window(jnp.power(jnp.abs(data), p), jnp.asarray(0, data.dtype),
+        s = lax.reduce_window(jnp.power(jnp.abs(data), p), 0,
                               lax.add, window, strides, padding)
         return jnp.power(s, 1.0 / p)
     raise ValueError("unknown pool_type " + pool_type)
@@ -472,7 +472,7 @@ def LRN(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
     pad = [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2)
     sq = jnp.pad(sq, pad)
     window = [1, nsize] + [1] * (data.ndim - 2)
-    s = lax.reduce_window(sq, jnp.asarray(0, data.dtype), lax.add,
+    s = lax.reduce_window(sq, 0, lax.add,
                           window, [1] * data.ndim, [(0, 0)] * data.ndim)
     return data / jnp.power(knorm + alpha / nsize * s, beta)
 

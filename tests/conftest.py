@@ -4,11 +4,18 @@ host the way the reference runs multi-process localhost PS tests."""
 import os
 
 # the environment presets JAX_PLATFORMS=axon (the TPU tunnel); tests force CPU so
-# the suite is hermetic and the 8-device virtual mesh is available
+# the suite is hermetic and the 8-device virtual mesh is available. The axon
+# sitecustomize calls jax config programmatically (jax_platforms='axon,cpu'),
+# which overrides the env var — so the config must be updated via jax.config,
+# not os.environ.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

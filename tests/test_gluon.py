@@ -1,0 +1,347 @@
+"""Gluon layer tests (ref: tests/python/unittest/test_gluon.py, test_loss.py).
+
+Covers: parameter registration & sharing, Dense/Conv/Pooling/BatchNorm/LayerNorm
+layers, deferred shape inference, hybridize (compiled forward/backward parity with
+eager), Trainer+optimizer end-to-end, losses, save/load round-trips, RNN layers.
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, gluon
+from mxtpu.gluon import nn, rnn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+
+
+def test_paramdict_save_load(tmp_path):
+    params = gluon.ParameterDict("net_")
+    w = params.get("weight", shape=(4, 5))
+    params.initialize()
+    fname = str(tmp_path / "pd.params")
+    params.save(fname)
+    params2 = gluon.ParameterDict("net_")
+    w2 = params2.get("weight", shape=(4, 5))
+    params2.load(fname)
+    np.testing.assert_allclose(w.data().asnumpy(), w2.data().asnumpy())
+
+
+def test_parameter_sharing():
+    class Net(gluon.Block):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.dense0 = nn.Dense(5, in_units=5)
+                self.dense1 = nn.Dense(5, in_units=5)
+
+        def forward(self, x):
+            return self.dense1(self.dense0(x))
+
+    net1 = Net(prefix="net1_")
+    net2 = Net(prefix="net2_", params=net1.collect_params())
+    net1.collect_params().initialize()
+    net2(mx.nd.zeros((3, 5)))
+    net1.save_parameters("/tmp/net1.params")
+    net3 = Net(prefix="net3_")
+    net3.load_parameters("/tmp/net1.params")
+
+
+def test_dense_flatten():
+    net = nn.Dense(8, flatten=True, in_units=12)
+    net.initialize()
+    x = mx.nd.ones((4, 3, 4))
+    assert net(x).shape == (4, 8)
+    net2 = nn.Dense(8, flatten=False, in_units=4)
+    net2.initialize()
+    assert net2(x).shape == (4, 3, 8)
+
+
+def test_deferred_init_and_infer_shape():
+    net = nn.Dense(8)
+    net.initialize()
+    x = mx.nd.ones((4, 7))
+    net(x)
+    assert net.weight.shape == (8, 7)
+
+
+@pytest.mark.parametrize("hybridize", [False, True])
+def test_mlp_training_decreases_loss(hybridize):
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(2))
+    net.initialize(init="xavier")
+    if hybridize:
+        net.hybridize()
+    # separable toy data
+    x = mx.nd.array(np.random.randn(64, 8).astype("float32"))
+    w_true = np.random.randn(8).astype("float32")
+    y = mx.nd.array((x.asnumpy() @ w_true > 0).astype("float32"))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.5})
+    losses = []
+    for _ in range(20):
+        with autograd.record():
+            l = loss_fn(net(x), y)
+        l.backward()
+        trainer.step(64)
+        losses.append(float(l.asnumpy().mean()))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_hybrid_eager_parity():
+    """Compiled forward must equal eager forward (the reference's
+    check_consistency pattern, SURVEY §4)."""
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(4, 3, padding=1, in_channels=3),
+                nn.BatchNorm(in_channels=4),
+                nn.Activation("relu"),
+                nn.MaxPool2D(),
+                nn.Flatten(),
+                nn.Dense(6, in_units=4 * 8 * 8))
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 3, 16, 16).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    compiled = net(x).asnumpy()
+    np.testing.assert_allclose(eager, compiled, rtol=1e-5, atol=1e-5)
+
+
+def test_hybrid_grad_parity():
+    np.random.seed(0)
+    x_np = np.random.randn(4, 5).astype("float32")
+
+    def run(hybridize):
+        mx.random.seed(7)
+        net = nn.Dense(3, in_units=5)
+        net.initialize(init="one")
+        if hybridize:
+            net.hybridize()
+        x = mx.nd.array(x_np)
+        x.attach_grad()
+        with autograd.record():
+            out = net(x)
+            l = (out * out).sum()
+        l.backward()
+        return x.grad.asnumpy(), net.weight.grad().asnumpy()
+
+    xg_e, wg_e = run(False)
+    xg_h, wg_h = run(True)
+    np.testing.assert_allclose(xg_e, xg_h, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(wg_e, wg_h, rtol=1e-5, atol=1e-5)
+
+
+def test_batchnorm_moving_stats_update_hybrid():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.randn(8, 3, 4, 4).astype("float32") * 3 + 1)
+    before = net.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    after = net.running_mean.data().asnumpy()
+    assert not np.allclose(before, after)
+
+
+def test_pool_layers():
+    x = mx.nd.array(np.random.randn(2, 3, 8, 8).astype("float32"))
+    assert nn.MaxPool2D()(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(pool_size=2, strides=1)(x).shape == (2, 3, 7, 7)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    assert nn.GlobalMaxPool2D()(x).shape == (2, 3, 1, 1)
+    x1 = mx.nd.array(np.random.randn(2, 3, 8).astype("float32"))
+    assert nn.MaxPool1D()(x1).shape == (2, 3, 4)
+    x3 = mx.nd.array(np.random.randn(2, 3, 8, 8, 8).astype("float32"))
+    assert nn.MaxPool3D()(x3).shape == (2, 3, 4, 4, 4)
+
+
+def test_conv_layers():
+    x = mx.nd.array(np.random.randn(2, 3, 10, 10).astype("float32"))
+    c = nn.Conv2D(8, 3, padding=1)
+    c.initialize()
+    assert c(x).shape == (2, 8, 10, 10)
+    ct = nn.Conv2DTranspose(4, 2, strides=2, in_channels=8)
+    ct.initialize()
+    assert ct(c(x)).shape == (2, 4, 20, 20)
+    c1 = nn.Conv1D(6, 3)
+    c1.initialize()
+    x1 = mx.nd.array(np.random.randn(2, 3, 10).astype("float32"))
+    assert c1(x1).shape == (2, 6, 8)
+    # grouped conv
+    cg = nn.Conv2D(8, 3, groups=2, in_channels=4)
+    cg.initialize()
+    xg = mx.nd.array(np.random.randn(2, 4, 6, 6).astype("float32"))
+    assert cg(xg).shape == (2, 8, 4, 4)
+
+
+def test_layernorm_instancenorm():
+    x = mx.nd.array(np.random.randn(2, 5, 4).astype("float32"))
+    ln = nn.LayerNorm(in_channels=4)
+    ln.initialize()
+    out = ln(x).asnumpy()
+    np.testing.assert_allclose(out.mean(-1), 0, atol=1e-5)
+    inorm = nn.InstanceNorm(in_channels=5)
+    inorm.initialize()
+    assert inorm(x).shape == x.shape
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = mx.nd.array(np.array([[1, 2], [3, 4]]))
+    assert emb(idx).shape == (2, 2, 4)
+    # gradient flows into rows
+    with autograd.record():
+        out = emb(idx).sum()
+    out.backward()
+    g = emb.weight.grad().asnumpy()
+    assert g[1].sum() != 0 and g[0].sum() == 0
+
+
+def test_activations_layers():
+    x = mx.nd.array(np.array([-2.0, -0.5, 0.5, 2.0], dtype="float32"))
+    for act in [nn.LeakyReLU(0.1), nn.ELU(), nn.SELU(), nn.Swish(), nn.GELU()]:
+        act.initialize()
+        y = act(x).asnumpy()
+        assert y.shape == x.shape
+    prelu = nn.PReLU()
+    prelu.initialize()
+    y = prelu(x).asnumpy()
+    np.testing.assert_allclose(y[0], -0.5, rtol=1e-5)
+
+
+def test_sequential_slicing():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(5), nn.Dense(6))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    assert len(net[1:]) == 2
+
+
+def test_losses_numeric():
+    pred = mx.nd.array(np.array([[1.0, 2.0, 3.0], [3.0, 2.0, 1.0]], dtype="float32"))
+    label = mx.nd.array(np.array([2, 0], dtype="float32"))
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label).asnumpy()
+    ref = -np.log(np.exp(3) / np.exp([1, 2, 3]).sum())
+    np.testing.assert_allclose(l, [ref, ref], rtol=1e-5)
+
+    pred = mx.nd.array(np.array([[0.5, -0.5]], dtype="float32"))
+    label = mx.nd.array(np.array([[1.0, 0.0]], dtype="float32"))
+    l2 = gluon.loss.L2Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(l2, [((0.5 - 1) ** 2 + 0.5 ** 2) / 2 / 2], rtol=1e-5)
+    l1 = gluon.loss.L1Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(l1, [(0.5 + 0.5) / 2], rtol=1e-5)
+
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()(pred, label).asnumpy()
+    p = 1 / (1 + np.exp(-np.array([0.5, -0.5])))
+    ref_bce = -(np.log(p[0]) + np.log(1 - p[1])) / 2
+    np.testing.assert_allclose(bce, [ref_bce], rtol=1e-4)
+
+    hu = gluon.loss.HuberLoss()(pred, label).asnumpy()
+    assert hu.shape == (1,)
+    hi = gluon.loss.HingeLoss()(pred, mx.nd.array(np.array([[1.0, -1.0]]))).asnumpy()
+    np.testing.assert_allclose(hi, [(0.5 + 0.5) / 2], rtol=1e-5)
+
+
+def test_save_load_parameters_roundtrip(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(5, in_units=4), nn.Dense(3, in_units=5))
+    net.initialize()
+    fname = str(tmp_path / "m.params")
+    net.save_parameters(fname)
+    x = mx.nd.ones((2, 4))
+    expected = net(x).asnumpy()
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(5, in_units=4), nn.Dense(3, in_units=5))
+    net2.load_parameters(fname)
+    np.testing.assert_allclose(net2(x).asnumpy(), expected, rtol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["lstm", "gru", "rnn"])
+def test_rnn_layers(mode):
+    T, N, C, H = 5, 3, 4, 6
+    x = mx.nd.array(np.random.randn(T, N, C).astype("float32"))
+    layer = {"lstm": rnn.LSTM, "gru": rnn.GRU, "rnn": rnn.RNN}[mode](H, 2)
+    layer.initialize()
+    out = layer(x)
+    assert out.shape == (T, N, H)
+    states = layer.begin_state(batch_size=N)
+    out, new_states = layer(x, states)
+    assert out.shape == (T, N, H)
+    assert new_states[0].shape == (2, N, H)
+    # gradient flows
+    with autograd.record():
+        loss = layer(x).sum()
+    loss.backward()
+    g = layer.l0_i2h_weight.grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_rnn_bidirectional():
+    T, N, C, H = 4, 2, 3, 5
+    x = mx.nd.array(np.random.randn(T, N, C).astype("float32"))
+    layer = rnn.LSTM(H, 1, bidirectional=True)
+    layer.initialize()
+    assert layer(x).shape == (T, N, 2 * H)
+
+
+def test_rnn_ntc_layout():
+    N, T, C, H = 2, 4, 3, 5
+    x = mx.nd.array(np.random.randn(N, T, C).astype("float32"))
+    layer = rnn.GRU(H, 1, layout="NTC")
+    layer.initialize()
+    assert layer(x).shape == (N, T, H)
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    x = mx.nd.ones((2, 4))
+    with autograd.record():
+        l = net(x).sum()
+    l.backward()
+    tr.step(2)
+    fname = str(tmp_path / "t.states")
+    tr.save_states(fname)
+    tr.load_states(fname)
+
+
+def test_clip_global_norm():
+    arrays = [mx.nd.ones((2, 2)) * 3, mx.nd.ones((3,)) * 4]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    assert total > 1.0
+    new_total = float(np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays)))
+    np.testing.assert_allclose(new_total, 1.0, rtol=1e-4)
+
+
+def test_block_repr_and_summary(capsys):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    repr(net)
+    net.summary(mx.nd.ones((1, 3)))
+    out = capsys.readouterr().out
+    assert "Total params" in out
+
+
+def test_hooks():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    calls = []
+    h1 = net.register_forward_pre_hook(lambda blk, inp: calls.append("pre"))
+    h2 = net.register_forward_hook(lambda blk, inp, out: calls.append("post"))
+    net(mx.nd.ones((1, 2)))
+    assert calls == ["pre", "post"]
+    h1.detach()
+    net(mx.nd.ones((1, 2)))
+    assert calls == ["pre", "post", "post"]
