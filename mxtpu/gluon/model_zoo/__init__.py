@@ -1,0 +1,2 @@
+"""Model zoo (ref: python/mxnet/gluon/model_zoo/__init__.py)."""
+from . import vision  # noqa: F401

@@ -1,0 +1,40 @@
+"""Vision model zoo (ref: python/mxnet/gluon/model_zoo/vision/__init__.py).
+
+`get_model(name, **kwargs)` resolves any of the reference's model names.
+Pretrained weights are not bundled (the reference downloads them from S3);
+use `net.load_parameters(path)` with locally stored weights.
+"""
+from ....base import MXNetError
+# import modules before star-imports: the `alexnet` function from the star
+# import shadows the `alexnet` submodule attribute on this package
+from . import alexnet as _alexnet
+from . import densenet as _densenet
+from . import inception as _inception
+from . import mobilenet as _mobilenet
+from . import resnet as _resnet
+from . import squeezenet as _squeezenet
+from . import vgg as _vgg
+from .alexnet import *  # noqa: F401,F403
+from .densenet import *  # noqa: F401,F403
+from .inception import *  # noqa: F401,F403
+from .mobilenet import *  # noqa: F401,F403
+from .resnet import *  # noqa: F401,F403
+from .squeezenet import *  # noqa: F401,F403
+from .vgg import *  # noqa: F401,F403
+
+_models = {}
+for _mod in (_alexnet, _densenet, _inception, _mobilenet, _resnet, _squeezenet,
+             _vgg):
+    for _name in _mod.__all__:
+        _obj = getattr(_mod, _name)
+        if callable(_obj) and _name[0].islower() and not _name.startswith("get_"):
+            _models[_name] = _obj
+
+
+def get_model(name, **kwargs):
+    """Return a model by name (ref: model_zoo/vision/__init__.py:get_model)."""
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError(
+            "model %s not supported; available: %s" % (name, sorted(_models)))
+    return _models[name](**kwargs)

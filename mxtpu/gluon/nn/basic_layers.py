@@ -7,7 +7,7 @@ from ..block import Block, HybridBlock
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
            "InstanceNorm", "LayerNorm", "Embedding", "Flatten", "Lambda",
-           "HybridLambda"]
+           "HybridLambda", "HybridConcurrent", "Concurrent", "Identity"]
 
 
 class Sequential(Block):
@@ -356,3 +356,36 @@ class HybridLambda(HybridBlock):
 
     def __repr__(self):
         return "HybridLambda({})".format(self._func_name)
+
+
+class Concurrent(Sequential):
+    """Run children on the same input, concat outputs on ``axis``
+    (ref: python/mxnet/gluon/contrib/nn/basic_layers.py:Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from ... import ndarray as nd
+        return nd.concat(*[block(x) for block in self._children.values()],
+                         dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (ref: contrib/nn/basic_layers.py:HybridConcurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Identity mapping (ref: contrib/nn/basic_layers.py:Identity)."""
+
+    def hybrid_forward(self, F, x):
+        return x

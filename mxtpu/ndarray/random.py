@@ -1,0 +1,35 @@
+"""``mx.nd.random`` namespace (ref: python/mxnet/ndarray/random.py).
+
+Thin aliasing layer over the sampling ops in mxtpu.ops.random_ops — the
+reference generates these from `_random_*` / `_sample_*` registry entries.
+"""
+from ..ops import random_ops as _r
+
+uniform = _r.uniform
+normal = _r.normal
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype=None, ctx=None, **kwargs):
+    """Ref: python/mxnet/ndarray/random.py:randn — normal with *shape args."""
+    return _r.normal(loc=loc, scale=scale, shape=shape or None, dtype=dtype,
+                     ctx=ctx, **kwargs)
+
+
+gamma = _r.gamma_sample
+
+
+def exponential(scale=1.0, shape=None, dtype=None, ctx=None, **kwargs):
+    """Ref: python/mxnet/ndarray/random.py:exponential — mean=scale; the
+    underlying op is rate-parameterized (lam = 1/scale)."""
+    return _r.exponential(lam=1.0 / scale, shape=shape, dtype=dtype, ctx=ctx,
+                          **kwargs)
+poisson = _r.poisson
+negative_binomial = _r.negative_binomial
+generalized_negative_binomial = _r.generalized_negative_binomial
+multinomial = _r.multinomial
+shuffle = _r.shuffle
+randint = _r.randint
+
+__all__ = ["uniform", "normal", "randn", "gamma", "exponential", "poisson",
+           "negative_binomial", "generalized_negative_binomial", "multinomial",
+           "shuffle", "randint"]

@@ -1,0 +1,62 @@
+"""Model zoo tests (ref: tests/python/unittest/test_gluon_model_zoo.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.gluon.model_zoo import vision
+
+
+@pytest.mark.parametrize("name", [
+    "resnet18_v1", "resnet18_v2", "mobilenet0_25", "mobilenet_v2_0_25",
+    "squeezenet1_0", "squeezenet1_1", "alexnet",
+])
+def test_model_forward(name):
+    net = vision.get_model(name, classes=10)
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(1, 3, 224, 224))
+    y = net(x)
+    assert y.shape == (1, 10)
+    assert np.isfinite(y.asnumpy()).all()
+
+
+def test_model_zoo_registry():
+    # every reference model name resolves (model_zoo/vision/__init__.py)
+    for name in ["resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+                 "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
+                 "resnet101_v2", "resnet152_v2", "vgg11", "vgg13", "vgg16",
+                 "vgg19", "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn",
+                 "alexnet", "densenet121", "densenet161", "densenet169",
+                 "densenet201", "squeezenet1_0", "squeezenet1_1",
+                 "inception_v3", "mobilenet1_0", "mobilenet0_75",
+                 "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0",
+                 "mobilenet_v2_0_75", "mobilenet_v2_0_5", "mobilenet_v2_0_25"]:
+        assert name in vision._models, name
+    with pytest.raises(mx.MXNetError):
+        vision.get_model("resnet19_v9")
+
+
+def test_thumbnail_resnet_train_step():
+    """ResNet-20-ish thumbnail on CIFAR shapes trains one step end to end."""
+    net = vision.get_resnet(1, 18, thumbnail=True, classes=10)
+    net.initialize(mx.init.Xavier())
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+    x = mx.nd.random.uniform(shape=(4, 3, 32, 32))
+    y = mx.nd.array(np.array([0, 1, 2, 3]))
+    with mx.autograd.record():
+        out = net(x)
+        loss = loss_fn(out, y)
+    loss.backward()
+    trainer.step(4)
+    assert np.isfinite(loss.asnumpy()).all()
+
+
+def test_hybridize_model():
+    net = vision.get_model("resnet18_v1", classes=10)
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3, 224, 224))
+    y0 = net(x).asnumpy()
+    net.hybridize()
+    y1 = net(x).asnumpy()
+    np.testing.assert_allclose(y0, y1, rtol=1e-4, atol=1e-4)
