@@ -1,0 +1,64 @@
+"""Benchmark: ResNet-50 training throughput, single chip.
+
+Reference baseline: 363.69 img/s — ResNet-50 training, batch 128, fp32 on
+1x V100 (docs/faq/perf.md:219; BASELINE.md "Training, single GPU").
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The whole train step (fwd+loss+bwd+SGD-momentum update) runs as one compiled
+XLA program via mxtpu.parallel.ShardedTrainStep; bf16 compute is the TPU
+design point (MXU-native), matching how the reference leans on cuDNN fp32.
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+BATCH = int(os.environ.get("BENCH_BATCH", "128"))
+DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+BASELINE = 363.69  # img/s, V100 fp32 batch 128
+
+
+def main():
+    import mxtpu as mx
+    from mxtpu import gluon
+    from mxtpu.gluon.model_zoo import vision
+    from mxtpu.parallel import ShardedTrainStep, data_parallel_mesh
+
+    net = vision.resnet50_v1()
+    net.initialize()
+    x_np = np.random.uniform(-1, 1, size=(BATCH, 3, 224, 224))
+    y_np = np.random.randint(0, 1000, size=(BATCH,))
+    x = mx.nd.array(x_np, dtype="float32")
+    net(x)  # settle deferred shapes
+    if DTYPE != "float32":
+        net.cast(DTYPE)
+        x = x.astype(DTYPE)
+    y = mx.nd.array(y_np, dtype="float32")
+
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = data_parallel_mesh()
+    step = ShardedTrainStep(net, loss, mesh, optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.01,
+                                              "momentum": 0.9})
+
+    for _ in range(3):  # warmup + compile
+        step(x, y).asnumpy()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        out = step(x, y)
+    out.asnumpy()  # sync
+    dt = time.perf_counter() - t0
+
+    value = BATCH * STEPS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput_b%d_%s" % (BATCH, DTYPE),
+        "value": round(value, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(value / BASELINE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -34,4 +34,5 @@ from . import metric
 from . import kvstore
 from . import kvstore as kv  # mx.kv alias
 from . import gluon
+from . import parallel
 from . import test_utils

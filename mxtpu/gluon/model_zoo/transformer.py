@@ -1,0 +1,131 @@
+"""Transformer language model — the long-context flagship.
+
+The reference predates transformers (its only attention helper is
+``_contrib_div_sqrt_dim``, src/operator/contrib/transformer.cc; SURVEY §5
+records long-context support as absent). This model family is therefore a
+TPU-first addition: a pre-norm decoder-only LM whose attention runs as ring
+attention (:mod:`mxtpu.parallel.ring_attention`) when a mesh with a sequence
+axis is supplied, so context length scales linearly with the `sp` mesh axis.
+
+Parallelism axes, all expressible in one ShardedTrainStep:
+* batch over ``data``,
+* sequence over ``sp`` (K/V ring over ICI),
+* MLP / attention projections over ``model`` via PartitionSpec rules
+  (:func:`tensor_parallel_rules`).
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from .. import nn
+
+__all__ = ["TransformerLM", "TransformerBlock", "MultiHeadSelfAttention",
+           "tensor_parallel_rules"]
+
+
+class MultiHeadSelfAttention(HybridBlock):
+    """Causal multi-head self-attention; ring-parallel over `sp` when a mesh
+    is given."""
+
+    def __init__(self, dim, num_heads, mesh=None, seq_axis="sp",
+                 batch_axis="data", causal=True, **kwargs):
+        super().__init__(**kwargs)
+        if dim % num_heads:
+            raise MXNetError("dim %d not divisible by num_heads %d"
+                             % (dim, num_heads))
+        self._dim = dim
+        self._heads = num_heads
+        self._mesh = mesh
+        self._seq_axis = seq_axis
+        self._batch_axis = batch_axis
+        self._causal = causal
+        with self.name_scope():
+            self.qkv = nn.Dense(3 * dim, use_bias=False, flatten=False,
+                                prefix="qkv_")
+            self.proj = nn.Dense(dim, use_bias=False, flatten=False,
+                                 prefix="proj_")
+
+    def hybrid_forward(self, F, x):
+        b, t, _ = x.shape
+        h, d = self._heads, self._dim // self._heads
+        qkv = self.qkv(x)                                  # [B, T, 3C]
+        qkv = F.reshape(qkv, (b, t, 3, h, d))
+        qkv = F.transpose(qkv, (2, 0, 3, 1, 4))            # [3, B, H, T, D]
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        from ...parallel.ring_attention import ring_attention_nd
+        out = ring_attention_nd(q, k, v, mesh=self._mesh,
+                                seq_axis=self._seq_axis,
+                                batch_axis=self._batch_axis,
+                                causal=self._causal)       # [B, H, T, D]
+        out = F.reshape(F.transpose(out, (0, 2, 1, 3)), (b, t, self._dim))
+        return self.proj(out)
+
+
+class TransformerBlock(HybridBlock):
+    """Pre-norm block: x + attn(ln(x)); x + mlp(ln(x))."""
+
+    def __init__(self, dim, num_heads, hidden_mult=4, mesh=None,
+                 seq_axis="sp", batch_axis="data", **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ln1 = nn.LayerNorm()
+            self.attn = MultiHeadSelfAttention(
+                dim, num_heads, mesh=mesh, seq_axis=seq_axis,
+                batch_axis=batch_axis, prefix="attn_")
+            self.ln2 = nn.LayerNorm()
+            self.fc1 = nn.Dense(hidden_mult * dim, flatten=False,
+                                activation="relu", prefix="mlp1_")
+            self.fc2 = nn.Dense(dim, flatten=False, prefix="mlp2_")
+
+    def hybrid_forward(self, F, x):
+        x = x + self.attn(self.ln1(x))
+        return x + self.fc2(self.fc1(self.ln2(x)))
+
+
+class TransformerLM(HybridBlock):
+    """Decoder-only LM: embed → N blocks → LayerNorm → vocab head.
+
+    Input: int token ids [B, T]; output: logits [B, T, vocab].
+    """
+
+    def __init__(self, vocab_size, dim=256, num_heads=8, num_layers=2,
+                 max_len=2048, hidden_mult=4, mesh=None, seq_axis="sp",
+                 batch_axis="data", **kwargs):
+        super().__init__(**kwargs)
+        self._vocab = vocab_size
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab_size, dim, prefix="wte_")
+            self.pos_embed = nn.Embedding(max_len, dim, prefix="wpe_")
+            self.blocks = nn.HybridSequential(prefix="h_")
+            with self.blocks.name_scope():
+                for _ in range(num_layers):
+                    self.blocks.add(TransformerBlock(
+                        dim, num_heads, hidden_mult=hidden_mult, mesh=mesh,
+                        seq_axis=seq_axis, batch_axis=batch_axis))
+            self.ln_f = nn.LayerNorm()
+            self.head = nn.Dense(vocab_size, use_bias=False, flatten=False,
+                                 prefix="head_")
+
+    def hybrid_forward(self, F, tokens):
+        t = tokens.shape[-1]
+        pos = F.arange(0, t, dtype="int32")
+        x = self.embed(tokens) + self.pos_embed(pos)
+        x = self.blocks(x)
+        return self.head(self.ln_f(x))
+
+
+def tensor_parallel_rules(model_axis="model"):
+    """PartitionSpec rules sharding the FLOP-heavy projections over the model
+    axis (Dense weights are [units, in]: dim 0 = column-parallel, dim 1 =
+    row-parallel, Megatron-style pairing so activations stay sharded through
+    the MLP)."""
+    return [
+        (r".*qkv_weight", P(model_axis, None)),
+        (r".*proj_weight", P(None, model_axis)),
+        (r".*mlp1_weight", P(model_axis, None)),
+        (r".*mlp2_weight", P(None, model_axis)),
+        (r".*head_weight", P(model_axis, None)),
+        (r".*wte_weight", P(None, model_axis)),
+    ]

@@ -40,14 +40,16 @@ def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
     """y = x W^T + b (ref: src/operator/nn/fully_connected.cc:239-328).
 
     Weight layout (num_hidden, in_units) matches the reference exactly so
-    checkpoints are interchangeable. The matmul accumulates in f32 on the MXU
-    (preferred_element_type) even for bf16 inputs.
+    checkpoints are interchangeable. bf16 inputs accumulate in f32 on the MXU
+    by hardware semantics; f32 inputs get true-f32 contractions via the global
+    jax_default_matmul_precision setting (mxtpu/__init__.py). No
+    preferred_element_type: a widened primitive output breaks jax's
+    conv/dot transpose rules under bf16 autodiff (mixed-dtype operands).
     """
     x = data
     if flatten and x.ndim > 2:
         x = jnp.reshape(x, (x.shape[0], -1))
-    y = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32).astype(x.dtype)
+    y = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())))
     if bias is not None and not no_bias:
         y = y + bias
     return y
@@ -98,8 +100,7 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         rhs_dilation=dilate,
         dimension_numbers=dims,
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32,
-    ).astype(data.dtype)
+    )
     if bias is not None and not no_bias:
         if channels_last:
             out = out + bias
@@ -143,8 +144,7 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None
         rhs_dilation=dilate,
         dimension_numbers=dims,
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32,
-    ).astype(data.dtype)
+    )
     if bias is not None and not no_bias:
         if channels_last:
             out = out + bias

@@ -1,0 +1,25 @@
+"""mxtpu.parallel: distributed training over the TPU device mesh.
+
+This package is the TPU-native replacement for the reference's entire
+multi-device/multi-node stack (SURVEY §2.3): the CUDA-P2P comm trees
+(src/kvstore/comm.h, comm_tree.h, gpu_topology.h), NCCL store
+(src/kvstore/kvstore_nccl.h) and the ps-lite parameter-server plane
+(src/kvstore/kvstore_dist.h) all collapse into ONE mechanism — a
+`jax.sharding.Mesh` with named axes, sharding annotations on a single jitted
+training program, and XLA-inserted collectives riding ICI (DCN across slices).
+
+What the reference could not express (SURVEY §2.3 "Parallelism NOT present" —
+no tensor/sequence/context parallelism) is first-class here:
+
+* ``data``  axis — batch sharding (the reference's data-parallel KVStore path),
+* ``model`` axis — tensor parallelism via parameter PartitionSpecs,
+* ``sp``    axis — sequence/context parallelism: ring attention
+  (:mod:`mxtpu.parallel.ring_attention`) rotates K/V blocks around the ring
+  with ``ppermute`` while accumulating flash-style online softmax.
+"""
+from .mesh import make_mesh, data_parallel_mesh
+from .train import ShardedTrainStep, pure_forward
+from .ring_attention import ring_attention, ring_self_attention
+
+__all__ = ["make_mesh", "data_parallel_mesh", "ShardedTrainStep",
+           "pure_forward", "ring_attention", "ring_self_attention"]

@@ -1,0 +1,62 @@
+"""Device-mesh construction.
+
+The reference discovers topology at runtime (CUDA P2P link matrix →
+Kernighan-Lin tree partitioning, src/kvstore/gpu_topology.h); on TPU the ICI
+torus topology is XLA's concern — the framework only names logical axes and
+lets the compiler map collectives onto the interconnect.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "data_parallel_mesh"]
+
+
+def make_mesh(axes, devices=None):
+    """Build a named `jax.sharding.Mesh`.
+
+    Parameters
+    ----------
+    axes : dict[str, int]
+        Ordered mapping of axis name -> size. At most one size may be ``-1``,
+        which absorbs all remaining devices.
+    devices : list, optional
+        Devices to lay out (default ``jax.devices()``).
+
+    Examples
+    --------
+    >>> mesh = make_mesh({"data": -1})                    # pure DP
+    >>> mesh = make_mesh({"data": 2, "sp": 2, "model": 2})  # DP x SP x TP
+    """
+    if devices is None:
+        devices = jax.devices()
+    names = list(axes)
+    sizes = [axes[n] for n in names]
+    n_dev = len(devices)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if n_dev % known:
+            raise ValueError(
+                "cannot infer -1 axis: %d devices not divisible by %d"
+                % (n_dev, known))
+        sizes[sizes.index(-1)] = n_dev // known
+    total = int(np.prod(sizes))
+    if total > n_dev:
+        raise ValueError("mesh %s needs %d devices, only %d available"
+                         % (axes, total, n_dev))
+    if total < n_dev:
+        import warnings
+        warnings.warn("mesh %s uses %d of %d devices; the remaining %d are "
+                      "idle (use -1 on one axis to absorb all devices)"
+                      % (dict(zip(names, sizes)), total, n_dev,
+                         n_dev - total), stacklevel=2)
+    arr = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def data_parallel_mesh(devices=None, axis="data"):
+    """All devices on one data axis — the KVStore `device`/`nccl` equivalent."""
+    return make_mesh({axis: -1}, devices)

@@ -1,0 +1,131 @@
+"""Ring attention: sequence/context parallelism over the mesh.
+
+No reference counterpart — the reference's long-sequence story is fused RNNs +
+bucketing (SURVEY §5 "Long-context/sequence parallelism: Absent"). On TPU,
+long-context attention shards the sequence axis across devices and rotates
+key/value blocks around the ICI ring with ``ppermute`` while each device keeps
+its query shard resident, accumulating the softmax *online* (flash-attention
+style m/l running max/sum), so the full [T, T] score matrix never materializes
+and per-device memory is O(T/n * T/n) per step.
+
+Layout convention: ``[batch, heads, seq, head_dim]`` (the MXU-friendly layout:
+the contraction q @ k^T is a [Tq, d] x [d, Tk] matmul per head).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_self_attention"]
+
+_NEG_INF = -1e30  # mask value; avoids -inf - -inf = nan in the online rescale
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Per-shard ring attention body — call INSIDE ``shard_map`` (or ``pmap``)
+    with the sequence axis sharded over ``axis_name``.
+
+    q, k, v: [B, H, T_local, D] local shards. Returns [B, H, T_local, D].
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, h, t_local, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    q32 = q.astype(jnp.float32)
+    q_pos = idx * t_local + jnp.arange(t_local)
+
+    acc0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    m0 = jnp.full((b, h, t_local), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local), jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def attend(k_c, v_c, acc, m, l, src):
+        k_pos = src * t_local + jnp.arange(t_local)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_c.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_c.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l
+
+    def step(carry, i):
+        k_c, v_c, acc, m, l = carry
+        # after i rotations of send-to-next, we hold the block that started
+        # on shard (idx - i) mod n
+        src = (idx - i) % n
+        if causal:
+            # blocks strictly in the future (src > idx) are fully masked:
+            # skip both einsums (saves ~half the attention FLOPs on average)
+            acc, m, l = jax.lax.cond(
+                src <= idx,
+                lambda args: attend(*args, src),
+                lambda args: (args[2], args[3], args[4]),
+                (k_c, v_c, acc, m, l))
+        else:
+            acc, m, l = attend(k_c, v_c, acc, m, l, src)
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        return (k_c, v_c, acc, m, l), None
+
+    (_, _, acc, _, l), _ = jax.lax.scan(
+        step, (k, v, acc0, m0, l0), jnp.arange(n))
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def _dense_attention(q, k, v, causal=False, scale=None):
+    """Single-device reference path (the degenerate 1-shard ring)."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        t_q, t_k = s.shape[-2:]
+        mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mesh=None, seq_axis="sp", batch_axis=None,
+                        causal=False, scale=None):
+    """Sequence-parallel attention over a mesh (dense fallback when mesh is
+    None or lacks the sequence axis).
+
+    q, k, v: [B, H, T, D] *global* arrays (or tracers inside a jitted sharded
+    program). The sequence axis T is sharded over ``seq_axis``; the batch axis
+    optionally over ``batch_axis``.
+    """
+    if mesh is None or seq_axis not in mesh.shape or mesh.shape[seq_axis] == 1:
+        return _dense_attention(q, k, v, causal=causal, scale=scale)
+    spec = P(batch_axis, None, seq_axis, None)
+    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
+                           scale=scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+# mx.nd-level op so eager autograd tapes through attention like any other op
+# (the registry's _apply path, ref: Imperative::Invoke)
+from ..ops.registry import register as _register  # noqa: E402
+
+ring_attention_nd = _register("_contrib_ring_attention")(
+    lambda q, k, v, mesh=None, seq_axis="sp", batch_axis=None, causal=False,
+    scale=None: ring_self_attention(q, k, v, mesh=mesh, seq_axis=seq_axis,
+                                    batch_axis=batch_axis, causal=causal,
+                                    scale=scale))

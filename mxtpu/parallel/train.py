@@ -1,0 +1,349 @@
+"""ShardedTrainStep: the whole training step as ONE compiled sharded program.
+
+This is the TPU-native fast path that replaces the reference's entire
+per-batch machinery — DataParallelExecutorGroup batch slicing
+(python/mxnet/module/executor_group.py:281), KVStore push/pull gradient
+reduction (src/kvstore/kvstore_local.h:184), and in-engine optimizer kernels
+(src/operator/optimizer_op.cc) — with a single ``jax.jit`` over a
+`jax.sharding.Mesh`:
+
+* forward + loss + backward + optimizer update trace into one XLA program,
+* the batch is sharded on the ``data`` axis; the mean loss / summed gradients
+  ARE the cross-device all-reduce (GSPMD inserts the collectives — the
+  explicit push/pull of the reference becomes implicit dataflow),
+* parameters may carry PartitionSpecs (tensor parallelism — absent from the
+  reference, SURVEY §2.3) and are donated, so the update is in-place in HBM
+  like the reference's in-engine mutate-in-place optimizer ops.
+
+The block's imperative forward is traced through the same `_TraceFrame`
+machinery as CachedOp (mxtpu/gluon/block.py), so BatchNorm moving-stat
+updates and Dropout RNG stay functional under the trace.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import autograd
+from .. import random as _random
+from ..base import MXNetError
+from ..gluon.block import _flatten_nd, _regroup, _IN_TRACE
+from ..gluon.parameter import _TraceFrame, _TRACE
+from ..ndarray import NDArray
+from ..ops import optimizer_ops as _uo
+
+__all__ = ["ShardedTrainStep", "pure_forward"]
+
+
+def _run_traced(params, param_datas, rng_key, train, body):
+    """Execute `body()` (imperative mxtpu code) under a functional trace where
+    each Parameter in `params` reads from `param_datas`. Returns (result,
+    aux_updates list aligned with params)."""
+    frame = _TraceFrame()
+    for p, d in zip(params, param_datas):
+        frame.param_map[p] = NDArray(d)
+    _TRACE.stack.append(frame)
+    _random.push_key_supply(rng_key)
+    prev_train = autograd.set_training(train)
+    prev_rec = autograd.set_recording(False)
+    _IN_TRACE.active += 1
+    try:
+        result = body()
+    finally:
+        _IN_TRACE.active -= 1
+        autograd.set_recording(prev_rec)
+        autograd.set_training(prev_train)
+        _random.pop_key_supply()
+        _TRACE.stack.pop()
+    aux = [frame.aux_updates.get(p) for p in params]
+    return result, aux
+
+
+def pure_forward(block, train=False):
+    """Extract the block's forward as a pure jittable function.
+
+    Returns ``(fn, param_datas)`` where ``fn(param_datas, *input_arrays,
+    rng=None)`` maps raw jax arrays to raw jax array(s). Pass a fresh ``rng``
+    key per call for stochastic layers (Dropout) — with the default ``None``
+    a fixed key is used, which is only correct for deterministic inference
+    (every call would otherwise draw the SAME dropout mask). The block must
+    be initialized with shapes settled (run one eager forward first for
+    deferred init).
+    """
+    params = list(block.collect_params().values())
+    if any(p._data is None for p in params):
+        raise MXNetError(
+            "pure_forward requires initialized parameters; call initialize() "
+            "and run one forward pass to settle deferred shapes")
+    param_datas = [p.data()._data for p in params]
+
+    def fn(param_datas, *in_datas, rng=None):
+        key = jax.random.PRNGKey(0) if rng is None else rng
+
+        def body():
+            return block(*[NDArray(d) for d in in_datas])
+        out, _aux = _run_traced(params, param_datas, key, train, body)
+        flat = _flatten_nd(out, [])
+        datas = [o._data for o in flat]
+        return datas[0] if len(datas) == 1 else tuple(datas)
+
+    return fn, param_datas
+
+
+# --------------------------------------------------------------- optimizers
+# Functional (weight, grad, *states, **hyper) -> (weight, *states) adapters
+# over the same pure update kernels the imperative Optimizer zoo uses
+# (mxtpu/ops/optimizer_ops.py ~ src/operator/optimizer_op.cc).
+def _sgd(w, g, states, lr, wd, mom, t, clip_gradient=-1.0):
+    if mom == 0.0:
+        return _uo.sgd_update_fn(w, g, lr, wd=wd,
+                                 clip_gradient=clip_gradient), states
+    new_w, new_m = _uo.sgd_mom_update_fn(w, g, states[0], lr, momentum=mom,
+                                         wd=wd, clip_gradient=clip_gradient)
+    return new_w, (new_m,)
+
+
+def _adam(w, g, states, lr, wd, mom, t, beta1=0.9, beta2=0.999, epsilon=1e-8,
+          clip_gradient=-1.0):
+    # bias correction folded into lr, as the reference's Adam.update does
+    # (python/mxnet/optimizer/optimizer.py Adam)
+    coef1 = 1.0 - beta1 ** t
+    coef2 = 1.0 - beta2 ** t
+    lr_t = lr * jnp.sqrt(coef2) / coef1
+    new_w, new_mean, new_var = _uo.adam_update_fn(
+        w, g, states[0], states[1], lr_t, beta1=beta1, beta2=beta2,
+        epsilon=epsilon, wd=wd, clip_gradient=clip_gradient)
+    return new_w, (new_mean, new_var)
+
+
+# name -> (update_fn, state_init, accepted extra hyperparameter keys)
+_FUNCTIONAL_OPTS = {
+    "sgd": (_sgd,
+            lambda w, mom: () if mom == 0.0 else (jnp.zeros_like(w),),
+            ("clip_gradient",)),
+    "adam": (_adam,
+             lambda w, mom: (jnp.zeros_like(w), jnp.zeros_like(w)),
+             ("beta1", "beta2", "epsilon", "clip_gradient")),
+}
+
+
+class ShardedTrainStep:
+    """One jitted, mesh-sharded training step for a gluon block.
+
+    Parameters
+    ----------
+    block : HybridBlock — initialized, shapes settled.
+    loss : callable ``loss(out, label) -> NDArray`` (e.g. a gluon Loss).
+    mesh : jax.sharding.Mesh with a data axis (and optionally model/sp axes).
+    optimizer : "sgd" | "adam".
+    optimizer_params : dict — learning_rate, momentum, wd (python-side; a
+        changed learning rate does NOT retrigger compilation: hyperparams are
+        traced scalars).
+    data_axis : mesh axis name the batch is sharded over.
+    param_specs : list of ``(name_regex, PartitionSpec)`` — tensor-parallel
+        placement rules; first match wins; default replicated. Shapes not
+        divisible by the mesh axis fall back to replicated.
+    batch_specs : optional list of PartitionSpecs, one per flattened batch
+        input; default shards dim 0 over `data_axis`.
+    forward : optional ``forward(block, *batch) -> loss NDArray`` overriding
+        the default ``loss(block(data), label)`` convention.
+    """
+
+    def __init__(self, block, loss, mesh, optimizer="sgd",
+                 optimizer_params=None, data_axis="data", param_specs=(),
+                 batch_specs=None, forward=None, donate=True):
+        self._block = block
+        self._loss = loss
+        self._mesh = mesh
+        self._data_axis = data_axis
+        self._forward = forward
+        self._donate = donate
+        self._batch_specs = batch_specs
+
+        opt_params = dict(optimizer_params or {})
+        self._lr = float(opt_params.pop("learning_rate", 0.01))
+        self._mom = float(opt_params.pop("momentum", 0.0))
+        self._wd = float(opt_params.pop("wd", 0.0))
+        self._lr_scheduler = opt_params.pop("lr_scheduler", None)
+        if optimizer not in _FUNCTIONAL_OPTS:
+            raise MXNetError("ShardedTrainStep supports %s; got %r"
+                             % (sorted(_FUNCTIONAL_OPTS), optimizer))
+        update_fn, state_init, extra_keys = _FUNCTIONAL_OPTS[optimizer]
+        extras = {k: opt_params.pop(k) for k in list(opt_params)
+                  if k in extra_keys}
+        if opt_params:
+            raise MXNetError("unknown optimizer_params for %r: %s"
+                             % (optimizer, sorted(opt_params)))
+        self._update_fn = (lambda *a, _f=update_fn, _e=extras: _f(*a, **_e))
+        self._num_update = 0
+
+        params = list(block.collect_params().values())
+        if any(p._data is None for p in params):
+            raise MXNetError(
+                "initialize() the block and run one forward pass before "
+                "building a ShardedTrainStep")
+        self._params = params
+        self._trainable = [p.grad_req != "null" for p in params]
+
+        rules = [(re.compile(pat), spec) for pat, spec in param_specs]
+        self._param_shardings = [
+            NamedSharding(mesh, self._spec_for(p, rules)) for p in params]
+        self._param_datas = [
+            jax.device_put(p.data()._data, s)
+            for p, s in zip(params, self._param_shardings)]
+        for p, d in zip(params, self._param_datas):
+            p.data()._set_data(d)
+        self._opt_states = [
+            tuple(jax.device_put(s0, sh) for s0 in state_init(d, self._mom))
+            if t else ()
+            for d, t, sh in zip(self._param_datas, self._trainable,
+                                self._param_shardings)]
+        self._state_shardings = [
+            tuple(sh for _ in st)
+            for st, sh in zip(self._opt_states, self._param_shardings)]
+        self._jit = None
+        self._in_fmt = None
+
+    # ------------------------------------------------------------- placement
+    def _spec_for(self, param, rules):
+        for pat, spec in rules:
+            if pat.match(param.name):
+                spec = spec if isinstance(spec, P) else P(*spec)
+                # replicated fallback when the shape doesn't divide the mesh
+                ok = True
+                for dim, axis in zip(param.shape, tuple(spec)):
+                    if axis is None:
+                        continue
+                    axes = axis if isinstance(axis, tuple) else (axis,)
+                    size = 1
+                    for a in axes:
+                        if a not in self._mesh.shape:
+                            raise MXNetError(
+                                "param_specs rule %r -> %s names axis %r not "
+                                "in mesh axes %s"
+                                % (pat.pattern, spec, a,
+                                   tuple(self._mesh.shape)))
+                        size *= self._mesh.shape[a]
+                    if dim % size:
+                        ok = False
+                if ok:
+                    return spec
+                return P()
+        return P()
+
+    # ------------------------------------------------------------------ step
+    def _build(self, in_fmt, n_inputs):
+        params, trainable = self._params, self._trainable
+        block, loss_blk, forward = self._block, self._loss, self._forward
+        update_fn = self._update_fn
+        t_idx = [i for i, t in enumerate(trainable) if t]
+
+        wd, mom = self._wd, self._mom  # static: `if wd:` in the kernels
+
+        def step(param_datas, opt_states, hyper, rng, in_datas):
+            lr, t = hyper  # traced scalars: lr schedule / step count don't recompile
+            frozen = list(param_datas)
+
+            def loss_of(train_datas):
+                datas = list(frozen)
+                for i, d in zip(t_idx, train_datas):
+                    datas[i] = d
+
+                def body():
+                    args, _, _ = _regroup(
+                        [NDArray(d) for d in in_datas], in_fmt)
+                    if forward is not None:
+                        return forward(block, *args)
+                    if len(args) < 2:
+                        raise MXNetError(
+                            "default convention needs (data..., label); pass "
+                            "forward= for custom batch structures")
+                    out = block(*args[:-1])
+                    return loss_blk(out, args[-1])
+
+                out, aux = _run_traced(params, datas, rng, True, body)
+                scalar = jnp.mean(out._data)
+                return scalar, aux
+
+            train_datas = [param_datas[i] for i in t_idx]
+            (loss_val, aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_datas)
+
+            new_datas = list(param_datas)
+            new_states = [list(s) for s in opt_states]
+            for j, i in enumerate(t_idx):
+                w, st = update_fn(new_datas[i], grads[j], opt_states[i],
+                                  lr, wd, mom, t)
+                # the f32 lr/state promote the arithmetic to f32 (precision),
+                # but storage keeps the parameter dtype (bf16 fast path) —
+                # the reference's multi-precision update pattern
+                # (optimizer.py:500 mp_sgd_update)
+                new_datas[i] = w.astype(param_datas[i].dtype)
+                new_states[i] = [s.astype(o.dtype)
+                                 for s, o in zip(st, opt_states[i])]
+            for i, a in enumerate(aux):
+                if a is not None:  # BatchNorm moving stats etc.
+                    new_datas[i] = a.astype(new_datas[i].dtype)
+            return new_datas, new_states, loss_val
+
+        mesh = self._mesh
+        repl = NamedSharding(mesh, P())
+        if self._batch_specs is not None:
+            in_specs = [spec if isinstance(spec, P) else P(*spec)
+                        for spec in self._batch_specs]
+        else:
+            in_specs = [P(self._data_axis)] * n_inputs
+        self._in_shardings = [NamedSharding(mesh, s) for s in in_specs]
+        donate = (0, 1) if self._donate else ()
+        return jax.jit(
+            step,
+            in_shardings=(self._param_shardings,
+                          [list(s) for s in self._state_shardings],
+                          None, None, self._in_shardings),
+            out_shardings=(self._param_shardings,
+                           [list(s) for s in self._state_shardings],
+                           repl),
+            donate_argnums=donate)
+
+    def __call__(self, *batch):
+        """Run one step on a batch (``(data, label)`` by default). Returns the
+        scalar loss as a lazy NDArray — no host sync (SURVEY §1: frontend
+        never blocks; sync at asnumpy())."""
+        in_fmt = []
+        flat = _flatten_nd(batch, in_fmt)
+        in_datas = [x._data if isinstance(x, NDArray) else jnp.asarray(x)
+                    for x in flat]
+        if self._jit is None or self._in_fmt != in_fmt:
+            self._jit = self._build(in_fmt, len(in_datas))
+            self._in_fmt = in_fmt
+        in_datas = [jax.device_put(d, s)
+                    for d, s in zip(in_datas, self._in_shardings)]
+        self._num_update += 1
+        lr = (self._lr_scheduler(self._num_update)
+              if self._lr_scheduler else self._lr)
+        hyper = (jnp.float32(lr), jnp.float32(self._num_update))
+        rng = _random.next_key()
+        opt_states = [list(s) for s in self._opt_states]
+        new_datas, new_states, loss = self._jit(
+            self._param_datas, opt_states, hyper, rng, in_datas)
+        self._param_datas = new_datas
+        self._opt_states = [tuple(s) for s in new_states]
+        for p, d in zip(self._params, new_datas):
+            p.data()._set_data(d)
+        return NDArray(loss)
+
+    @property
+    def learning_rate(self):
+        if self._lr_scheduler is not None:
+            return self._lr_scheduler(max(self._num_update, 1))
+        return self._lr
+
+    def set_learning_rate(self, lr):
+        if self._lr_scheduler is not None:
+            # the reference Trainer raises here too (gluon/trainer.py)
+            raise MXNetError(
+                "cannot set learning_rate: an lr_scheduler is active")
+        self._lr = float(lr)
