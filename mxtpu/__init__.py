@@ -33,6 +33,16 @@ from . import optimizer
 from . import metric
 from . import kvstore
 from . import kvstore as kv  # mx.kv alias
+from . import symbol
+from . import symbol as sym  # mx.sym alias
+from . import io
+from . import model
+from . import callback
+from . import monitor
+from .monitor import Monitor
+from . import module
+from . import module as mod  # mx.mod alias
+from .module import Module
 from . import gluon
 from . import parallel
 from . import test_utils

@@ -90,6 +90,33 @@ class _InTrace(threading.local):
 _IN_TRACE = _InTrace()
 
 
+def _run_traced(params, param_datas, rng_key, train, body):
+    """Execute `body()` (imperative mxtpu code) as a pure traced region:
+    each Parameter in `params` reads from the matching entry of `param_datas`,
+    RNG draws split from `rng_key`, autograd taping is off, and BatchNorm-style
+    aux writes are collected functionally. Returns (result, aux_updates list
+    aligned with params). Single source of truth for CachedOp and
+    mxtpu.parallel.ShardedTrainStep."""
+    frame = _TraceFrame()
+    for p, d in zip(params, param_datas):
+        frame.param_map[p] = NDArray(d)
+    _TRACE.stack.append(frame)
+    _random.push_key_supply(rng_key)
+    prev_train = autograd.set_training(train)
+    prev_rec = autograd.set_recording(False)
+    _IN_TRACE.active += 1
+    try:
+        result = body()
+    finally:
+        _IN_TRACE.active -= 1
+        autograd.set_recording(prev_rec)
+        autograd.set_training(prev_train)
+        _random.pop_key_supply()
+        _TRACE.stack.pop()
+    aux = [frame.aux_updates.get(p) for p in params]
+    return result, aux
+
+
 # ----------------------------------------------------------------- name scope
 class _BlockScope(threading.local):
     """Auto-naming of blocks/parameters (ref: gluon/block.py:_BlockScope)."""
@@ -374,28 +401,15 @@ class CachedOp:
         cell = {}  # out_fmt discovered at trace time
 
         def pure(rng_key, in_datas, param_datas):
-            frame = _TraceFrame()
-            for p, d in zip(params, param_datas):
-                frame.param_map[p] = NDArray(d)
-            _TRACE.stack.append(frame)
-            _random.push_key_supply(rng_key)
-            prev_train = autograd.set_training(train)
-            prev_rec = autograd.set_recording(False)
-            _IN_TRACE.active += 1
-            try:
+            def body():
                 args, _, _ = _regroup([NDArray(d) for d in in_datas],
                                       cell["in_fmt"])
-                out = block._forward_eager(*args)
-            finally:
-                _IN_TRACE.active -= 1
-                autograd.set_recording(prev_rec)
-                autograd.set_training(prev_train)
-                _random.pop_key_supply()
-                _TRACE.stack.pop()
+                return block._forward_eager(*args)
+
+            out, aux = _run_traced(params, param_datas, rng_key, train, body)
             out_fmt = []
             flat_out = _flatten_nd(out, out_fmt)
             cell["out_fmt"] = out_fmt
-            aux = [frame.aux_updates.get(p) for p in params]
             return [o._data for o in flat_out], aux
 
         jitted = jax.jit(pure)
@@ -516,6 +530,9 @@ class HybridBlock(Block):
         except DeferredInitializationError:
             self.infer_shape(*args)
             params = {k: p.data() for k, p in self._reg_params.items()}
+        # remember input signatures so export/trace can replay (symbol.py)
+        self._in_specs = [(a.shape, a.dtype) for a in args
+                          if isinstance(a, NDArray)]
         from .. import ndarray as F
         return self.hybrid_forward(F, *args, **params)
 
@@ -549,7 +566,11 @@ class SymbolBlock(HybridBlock):
     """Run a loaded Symbol as a Block (ref: gluon/block.py:SymbolBlock:954)."""
 
     def __init__(self, outputs, inputs, params=None):
-        super().__init__(prefix=None, params=params)
+        super().__init__(prefix=None, params=None)
+        # param names must match the symbol's input names exactly
+        # (ref: SymbolBlock.__init__ resets prefix to '')
+        self._prefix = ""
+        self._params = ParameterDict("", params)
         from .. import symbol as sym_mod
         if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
             outputs = outputs[0]

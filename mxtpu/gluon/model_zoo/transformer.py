@@ -95,6 +95,7 @@ class TransformerLM(HybridBlock):
                  batch_axis="data", **kwargs):
         super().__init__(**kwargs)
         self._vocab = vocab_size
+        self._max_len = max_len
         with self.name_scope():
             self.embed = nn.Embedding(vocab_size, dim, prefix="wte_")
             self.pos_embed = nn.Embedding(max_len, dim, prefix="wpe_")
@@ -110,6 +111,10 @@ class TransformerLM(HybridBlock):
 
     def hybrid_forward(self, F, tokens):
         t = tokens.shape[-1]
+        if t > self._max_len:
+            raise MXNetError(
+                "sequence length %d exceeds max_len %d (positions would be "
+                "clamped to the last positional embedding)" % (t, self._max_len))
         pos = F.arange(0, t, dtype="int32")
         x = self.embed(tokens) + self.pos_embed(pos)
         x = self.blocks(x)

@@ -330,6 +330,10 @@ class ParameterDict:
              restore_prefix=""):
         from ..ndarray import load as nd_load
         loaded = nd_load(filename)
+        # strip the checkpoint kind markers (ref: parameter.py load strips
+        # the arg:/aux: prefixes written by export/save_checkpoint)
+        loaded = {(k[4:] if k.startswith(("arg:", "aux:")) else k): v
+                  for k, v in loaded.items()}
         loaded = {restore_prefix + k: v for k, v in loaded.items()}
         if not allow_missing:
             for name in self._params:

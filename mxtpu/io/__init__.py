@@ -1,0 +1,11 @@
+"""mx.io: data iterators.
+
+Reference: ``python/mxnet/io/io.py`` (DataDesc/DataBatch/DataIter/NDArrayIter)
+and the C++ iterator chain (SURVEY §2.4: src/io/ — source → augmenter →
+batch loader → prefetcher).
+"""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter, CSVIter)
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter"]

@@ -1,0 +1,347 @@
+"""Data iterators (ref: python/mxnet/io/io.py).
+
+TPU-native notes: batches are host numpy until the training step consumes
+them — device transfer happens once per batch at the jit boundary (the
+reference's PrefetcherIter double-buffering maps to PJRT async host→device
+copies; a threaded PrefetchingIter is still provided for expensive pipelines).
+"""
+from __future__ import annotations
+
+import threading
+from collections import namedtuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    """Data description: name/shape/dtype/layout (ref: io.py:DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        return 0 if layout is None else layout.find("N")
+
+
+class DataBatch:
+    """One mini-batch (ref: io.py:DataBatch)."""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            data = [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Iterator base (ref: io.py:DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input data to list of (name, np.ndarray) (ref: io.py:_init_data)."""
+    if data is None:
+        if not allow_empty:
+            raise ValueError("data cannot be None")
+        return []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty and len(data) == 0:
+            raise ValueError("data cannot be empty")
+        data = {(default_name if len(data) == 1 else "_%d_%s" %
+                 (i, default_name)): d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, list or dict")
+    out = []
+    for k, v in data.items():
+        v = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+        out.append((k, v))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (ref: io.py:NDArrayIter) with pad /
+    discard / roll_over last-batch handling."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        for k, v in self.data + self.label:
+            if v.shape[0] != self.num_data:
+                raise MXNetError("all data must have the same length")
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+        self._order = np.arange(self.num_data)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self._order)
+        self._cursor = -1
+
+    def iter_next(self):
+        self._cursor += 1
+        return self._cursor < self.num_batches
+
+    def _slice(self, arrays):
+        start = self._cursor * self.batch_size
+        end = start + self.batch_size
+        out = []
+        for _, v in arrays:
+            idx = self._order[start:end]
+            chunk = v[idx]
+            if chunk.shape[0] < self.batch_size:
+                if self.last_batch_handle == "roll_over":
+                    wrap = self._order[:self.batch_size - chunk.shape[0]]
+                    chunk = np.concatenate([chunk, v[wrap]], axis=0)
+                else:  # pad
+                    pad = np.zeros((self.batch_size - chunk.shape[0],)
+                                   + v.shape[1:], dtype=v.dtype)
+                    chunk = np.concatenate([chunk, pad], axis=0)
+            out.append(array(chunk))
+        return out
+
+    def getdata(self):
+        return self._slice(self.data)
+
+    def getlabel(self):
+        return self._slice(self.label)
+
+    def getpad(self):
+        start = self._cursor * self.batch_size
+        remaining = self.num_data - start
+        if self.last_batch_handle == "pad" and remaining < self.batch_size:
+            return self.batch_size - remaining
+        return 0
+
+    def getindex(self):
+        start = self._cursor * self.batch_size
+        return self._order[start:start + self.batch_size]
+
+
+class ResizeIter(DataIter):
+    """Resize (truncate/loop) another iterator to a fixed number of batches
+    per epoch (ref: io.py:ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        return self.cur < self.size
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        self.cur += 1
+        try:
+            return self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            return self.data_iter.next()
+
+
+class PrefetchingIter(DataIter):
+    """Threaded double-buffering over one or more iterators
+    (ref: io.py:PrefetchingIter ~ the C++ PrefetcherIter, src/io/iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._batch = [None] * len(iters)
+        self._ready = [threading.Event() for _ in iters]
+        self._taken = [threading.Event() for _ in iters]
+        self._stop = False
+        for e in self._taken:
+            e.set()
+
+        def worker(i):
+            while not self._stop:
+                self._taken[i].wait()
+                if self._stop:
+                    return
+                self._taken[i].clear()
+                try:
+                    self._batch[i] = self.iters[i].next()
+                except StopIteration:
+                    self._batch[i] = None
+                self._ready[i].set()
+
+        self._threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                         for i in range(len(iters))]
+        for t in self._threads:
+            t.start()
+
+    @property
+    def provide_data(self):
+        out = []
+        for i, it in enumerate(self.iters):
+            descs = it.provide_data
+            if self.rename_data:
+                descs = [DataDesc(self.rename_data[i].get(d.name, d.name),
+                                  d.shape, d.dtype) for d in descs]
+            out.extend(descs)
+        return out
+
+    @property
+    def provide_label(self):
+        out = []
+        for i, it in enumerate(self.iters):
+            descs = it.provide_label
+            if self.rename_label:
+                descs = [DataDesc(self.rename_label[i].get(d.name, d.name),
+                                  d.shape, d.dtype) for d in descs]
+            out.extend(descs)
+        return out
+
+    def reset(self):
+        for e in self._ready:
+            e.wait()
+        for it in self.iters:
+            it.reset()
+        for e in self._ready:
+            e.clear()
+        for e in self._taken:
+            e.set()
+
+    def next(self):
+        for e in self._ready:
+            e.wait()
+        if any(b is None for b in self._batch):
+            for e in self._ready:
+                e.clear()
+            for e in self._taken:
+                e.set()
+            raise StopIteration
+        batches = list(self._batch)
+        for i in range(len(self.iters)):
+            self._ready[i].clear()
+            self._taken[i].set()
+        data = sum((b.data for b in batches), [])
+        label = sum((b.label or [] for b in batches), [])
+        return DataBatch(data=data, label=label or None, pad=batches[0].pad,
+                         index=batches[0].index)
+
+    def iter_next(self):
+        for e in self._ready:
+            e.wait()
+        return not any(b is None for b in self._batch)
+
+    def __del__(self):
+        self._stop = True
+        for e in self._taken:
+            e.set()
+
+
+class CSVIter(DataIter):
+    """CSV file iterator (ref: src/io/iter_csv.cc). Loads host-side with
+    numpy; shapes must be given like the reference's data_shape param."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32,
+                               ndmin=2).reshape((-1,) + tuple(label_shape))
+            if label.shape[-1] == 1:
+                label = label.reshape(label.shape[:-1])
+        self._inner = NDArrayIter(
+            data, label, batch_size=batch_size,
+            last_batch_handle="roll_over" if round_batch else "pad")
+        super().__init__(batch_size)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()

@@ -5,7 +5,7 @@ from __future__ import annotations
 
 import math
 
-import numpy as np
+import numpy as _np
 
 from .base import MXNetError
 from .ndarray import NDArray
@@ -19,7 +19,7 @@ def register(klass):
 
 
 def _as_np(x):
-    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+    return x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
 
 
 def check_label_shapes(labels, preds, shape=False):
@@ -80,15 +80,15 @@ class Accuracy(EvalMetric):
         self.axis = axis
 
     def update(self, labels, preds):
-        if isinstance(labels, (NDArray, np.ndarray)):
+        if isinstance(labels, (NDArray, _np.ndarray)):
             labels, preds = [labels], [preds]
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
             p = _as_np(pred)
-            l = _as_np(label).astype(np.int64)
+            l = _as_np(label).astype(_np.int64)
             if p.ndim > l.ndim:
                 p = p.argmax(axis=self.axis)
-            p = p.astype(np.int64).reshape(-1)
+            p = p.astype(_np.int64).reshape(-1)
             l = l.reshape(-1)
             self.sum_metric += (p == l).sum()
             self.num_inst += len(l)
@@ -103,8 +103,8 @@ class TopKAccuracy(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
             p = _as_np(pred)
-            l = _as_np(label).astype(np.int64)
-            order = np.argsort(-p, axis=1)[:, :self.top_k]
+            l = _as_np(label).astype(_np.int64)
+            order = _np.argsort(-p, axis=1)[:, :self.top_k]
             self.sum_metric += (order == l[:, None]).any(axis=1).sum()
             self.num_inst += len(l)
 
@@ -127,10 +127,10 @@ class F1(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
             p = _as_np(pred)
-            l = _as_np(label).astype(np.int64).reshape(-1)
+            l = _as_np(label).astype(_np.int64).reshape(-1)
             if p.ndim > 1:
                 p = p.argmax(axis=1)
-            p = p.astype(np.int64).reshape(-1)
+            p = p.astype(_np.int64).reshape(-1)
             self.tp += ((p == 1) & (l == 1)).sum()
             self.fp += ((p == 1) & (l == 0)).sum()
             self.fn += ((p == 0) & (l == 1)).sum()
@@ -156,10 +156,10 @@ class MCC(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
             p = _as_np(pred)
-            l = _as_np(label).astype(np.int64).reshape(-1)
+            l = _as_np(label).astype(_np.int64).reshape(-1)
             if p.ndim > 1:
                 p = p.argmax(axis=1)
-            p = p.astype(np.int64).reshape(-1)
+            p = p.astype(_np.int64).reshape(-1)
             self.tp += ((p == 1) & (l == 1)).sum()
             self.fp += ((p == 1) & (l == 0)).sum()
             self.tn += ((p == 0) & (l == 0)).sum()
@@ -182,14 +182,14 @@ class Perplexity(EvalMetric):
         num = 0
         for label, pred in zip(labels, preds):
             p = _as_np(pred)
-            l = _as_np(label).astype(np.int64).reshape(-1)
+            l = _as_np(label).astype(_np.int64).reshape(-1)
             p = p.reshape(-1, p.shape[-1])
-            probs = p[np.arange(len(l)), l]
+            probs = p[_np.arange(len(l)), l]
             if self.ignore_label is not None:
                 ignore = (l == self.ignore_label)
-                probs = np.where(ignore, 1.0, probs)
+                probs = _np.where(ignore, 1.0, probs)
                 num -= ignore.sum()
-            loss -= np.log(np.maximum(probs, 1e-10)).sum()
+            loss -= _np.log(_np.maximum(probs, 1e-10)).sum()
             num += len(l)
         self.sum_metric += math.exp(loss / max(num, 1)) * num
         self.num_inst += num
@@ -210,7 +210,7 @@ class MAE(EvalMetric):
             l, p = _as_np(label), _as_np(pred)
             if l.ndim == 1:
                 l = l.reshape(-1, 1)
-            self.sum_metric += np.abs(l - p.reshape(l.shape)).mean()
+            self.sum_metric += _np.abs(l - p.reshape(l.shape)).mean()
             self.num_inst += 1
 
 
@@ -250,10 +250,10 @@ class CrossEntropy(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
-            l = _as_np(label).astype(np.int64).reshape(-1)
+            l = _as_np(label).astype(_np.int64).reshape(-1)
             p = _as_np(pred).reshape(len(l), -1)
-            prob = p[np.arange(len(l)), l]
-            self.sum_metric += (-np.log(prob + self.eps)).sum()
+            prob = p[_np.arange(len(l)), l]
+            self.sum_metric += (-_np.log(prob + self.eps)).sum()
             self.num_inst += len(l)
 
 
@@ -271,7 +271,7 @@ class PearsonCorrelation(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
             l, p = _as_np(label).reshape(-1), _as_np(pred).reshape(-1)
-            cc = np.corrcoef(l, p)[0, 1]
+            cc = _np.corrcoef(l, p)[0, 1]
             self.sum_metric += cc
             self.num_inst += 1
 
@@ -284,7 +284,7 @@ class Loss(EvalMetric):
         super().__init__(name, **kwargs)
 
     def update(self, _, preds):
-        if isinstance(preds, (NDArray, np.ndarray)):
+        if isinstance(preds, (NDArray, _np.ndarray)):
             preds = [preds]
         for pred in preds:
             p = _as_np(pred)
@@ -343,7 +343,7 @@ def np_metric(numpy_feval, name=None, allow_extra_outputs=False):
     return CustomMetric(feval, feval.__name__, allow_extra_outputs)
 
 
-np = np_metric  # mx.metric.np parity (shadows numpy only inside this module's API)
+np = np_metric  # mx.metric.np parity (numpy is imported as _np to avoid clobbering)
 
 
 def create(metric, *args, **kwargs):

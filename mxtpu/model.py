@@ -1,0 +1,48 @@
+"""Checkpoint helpers + BatchEndParam (ref: python/mxnet/model.py).
+
+Format parity: ``prefix-symbol.json`` (graph) + ``prefix-%04d.params`` holding
+``arg:name`` / ``aux:name`` keyed NDArrays, exactly the reference's layout
+(model.py:383-413), so tooling that inspects checkpoints ports over.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+from .base import MXNetError
+from .ndarray.utils import load as nd_load, save as nd_save
+
+__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint"]
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Ref: model.py:save_checkpoint."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd_save(param_name, save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    """Ref: model.py:load_checkpoint. Returns (symbol, arg_params, aux_params)."""
+    from . import symbol as sym_mod
+    import os
+    symbol = None
+    if os.path.exists("%s-symbol.json" % prefix):
+        symbol = sym_mod.load("%s-symbol.json" % prefix)
+    save_dict = nd_load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:
+            raise MXNetError("Invalid param file key %s" % k)
+    return symbol, arg_params, aux_params

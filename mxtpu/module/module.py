@@ -1,0 +1,267 @@
+"""Module: bind a Symbol to data shapes and train it.
+
+Reference: ``python/mxnet/module/module.py:40-642`` — binds a
+DataParallelExecutorGroup (per-device executors + batch slicing,
+executor_group.py:281) and reduces gradients through KVStore.
+
+TPU-native re-design: ONE executor over the whole (possibly mesh-sharded)
+program — the reference's per-GPU executor group + kvstore reduce collapse
+into XLA GSPMD (SURVEY §2.3). The optimizer runs host-side through the same
+Updater machinery as the reference (update_on_kvstore semantics preserved via
+mx.kv)."""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from ..initializer import InitDesc
+from ..model import load_checkpoint, save_checkpoint
+from ..ndarray import NDArray, zeros
+from .base_module import BaseModule
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._context = context
+
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+        self._update_on_kvstore = False
+        self._data_shapes = None
+        self._label_shapes = None
+        self._grad_req = "write"
+
+    # ------------------------------------------------------------- binding
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def output_shapes(self):
+        return [(n, tuple(o.shape))
+                for n, o in zip(self.output_names, self._exec.outputs)]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """(ref: module.py:bind)"""
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self._grad_req = grad_req
+
+        shapes = {}
+        for desc in data_shapes:
+            name, shape = (desc.name, desc.shape) if hasattr(desc, "name") \
+                else (desc[0], desc[1])
+            shapes[name] = tuple(shape)
+        if label_shapes:
+            for desc in label_shapes:
+                name, shape = (desc.name, desc.shape) if hasattr(desc, "name") \
+                    else (desc[0], desc[1])
+                shapes[name] = tuple(shape)
+        self._data_shapes = [(n, shapes[n]) for n in self._data_names]
+        self._label_shapes = [(n, shapes[n]) for n in self._label_names
+                              if n in shapes]
+
+        req = {}
+        for n in self._symbol.list_arguments():
+            if n in self._data_names or n in self._label_names \
+                    or n in self._fixed_param_names:
+                req[n] = "null" if not inputs_need_grad \
+                    or n not in self._data_names else grad_req
+            else:
+                req[n] = grad_req if for_training else "null"
+        if shared_module is not None and shared_module._exec is not None:
+            # share parameter arrays (BucketingModule path)
+            exe = self._symbol.simple_bind(grad_req=req, **shapes)
+            for n in self._param_names:
+                if n in shared_module._exec.arg_dict:
+                    exe.arg_dict[n] = shared_module._exec.arg_dict[n]
+                    exe.arg_arrays = [exe.arg_dict[a]
+                                      for a in self._symbol.list_arguments()]
+                    if n in shared_module._exec.grad_dict:
+                        exe.grad_dict[n] = shared_module._exec.grad_dict[n]
+            for n in self._aux_names:
+                if n in shared_module._exec.aux_dict:
+                    exe.aux_dict[n] = shared_module._exec.aux_dict[n]
+            self._exec = exe
+        else:
+            self._exec = self._symbol.simple_bind(grad_req=req, **shapes)
+        self.binded = True
+
+    # ---------------------------------------------------------- parameters
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False):
+        """(ref: module.py:init_params)"""
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before init_params"
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arr._set_data(arg_params[name].astype(arr.dtype)._data)
+            elif initializer is not None:
+                initializer(InitDesc(name), arr)
+            elif not allow_missing and arg_params is not None:
+                raise MXNetError("%s not initialized" % name)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                arr._set_data(aux_params[name].astype(arr.dtype)._data)
+            elif initializer is not None:
+                initializer(InitDesc(name), arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        return ({n: self._exec.arg_dict[n].copy() for n in self._param_names},
+                {n: self._exec.aux_dict[n].copy() for n in self._aux_names})
+
+    # ----------------------------------------------------------- optimizer
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """(ref: module.py:init_optimizer; kvstore plumbing model.py
+        _create_kvstore)"""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            opt_kw = dict(optimizer_params or {})
+            # default rescale_grad = 1/batch (ref: module.py init_optimizer —
+            # loss-layer grads like SoftmaxOutput are per-sample sums)
+            if "rescale_grad" not in opt_kw and self._data_shapes:
+                opt_kw["rescale_grad"] = 1.0 / self._data_shapes[0][1][0]
+            optimizer = opt_mod.create(
+                optimizer, param_idx2name=idx2name, sym=self._symbol,
+                **opt_kw)
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+        if kvstore:
+            from .. import kvstore as kv_mod
+            kv = kv_mod.create(kvstore) if isinstance(kvstore, str) else kvstore
+            self._kvstore = kv
+            self._update_on_kvstore = "dist" in kv.type
+            for i, name in enumerate(self._param_names):
+                kv.init(i, self._exec.arg_dict[name])
+            if self._update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------- running
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed[name] = arr
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Optimizer step on accumulated grads (ref: module.py:update →
+        _update_params / _update_params_on_kvstore, model.py)."""
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        if self._kvstore is not None:
+            for i, name in enumerate(self._param_names):
+                w = self._exec.arg_dict[name]
+                g = self._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                if self._update_on_kvstore:
+                    self._kvstore.push(i, g, priority=-i)
+                    self._kvstore.pull(i, w, priority=-i)
+                else:
+                    self._kvstore.push(i, g, priority=-i)
+                    self._kvstore.pull(i, g, priority=-i)
+                    self._updater(i, g, w)
+        else:
+            for i, name in enumerate(self._param_names):
+                g = self._exec.grad_dict.get(name)
+                if g is not None:
+                    self._updater(i, g, self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def install_monitor(self, mon):
+        mon.install(self._exec)
+
+    # ---------------------------------------------------------- checkpoint
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+        if save_optimizer_states:
+            with open("%s-%04d.states" % (prefix, epoch), "wb") as f:
+                f.write(self._updater.get_states())
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._preloaded = (args, auxs)
+        orig_init = mod.init_params
+
+        def init_params(initializer=None, arg_params=None, aux_params=None,
+                        allow_missing=False, force_init=False):
+            orig_init(initializer=initializer,
+                      arg_params=arg_params or args,
+                      aux_params=aux_params or auxs,
+                      allow_missing=allow_missing, force_init=force_init)
+        mod.init_params = init_params
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod

@@ -72,11 +72,26 @@ def _apply(fn, args, kwargs=None, name="", num_outputs=None):
         outputs = [NDArray(d) for d in out_data]
         if autograd.is_recording():
             autograd.record_op(pure_fn, inputs, outputs, name=name)
+        _maybe_record_symbol(name, args, kwargs, inputs, outputs)
         return outputs
     out = NDArray(out_data)
     if autograd.is_recording():
         autograd.record_op(pure_fn, inputs, [out], name=name)
+    _maybe_record_symbol(name, args, kwargs, inputs, [out])
     return out
+
+
+_sym_tape = None  # resolved lazily once; avoids import cost on the hot path
+
+
+def _maybe_record_symbol(name, args, kwargs, inputs, outputs):
+    """Graph-export tape (mxtpu.symbol.trace_block); no-op unless tracing."""
+    global _sym_tape
+    if _sym_tape is None:
+        from ..symbol import symbol as _sym_tape_mod
+        _sym_tape = _sym_tape_mod
+    if _sym_tape._SYM_TAPE.active is not None and name:
+        _sym_tape.record_apply(name, args, kwargs, inputs, outputs)
 
 
 class NDArray:

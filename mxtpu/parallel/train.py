@@ -30,36 +30,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import autograd
 from .. import random as _random
 from ..base import MXNetError
-from ..gluon.block import _flatten_nd, _regroup, _IN_TRACE
-from ..gluon.parameter import _TraceFrame, _TRACE
+from ..gluon.block import _flatten_nd, _regroup, _run_traced
 from ..ndarray import NDArray
 from ..ops import optimizer_ops as _uo
 
 __all__ = ["ShardedTrainStep", "pure_forward"]
 
 
-def _run_traced(params, param_datas, rng_key, train, body):
-    """Execute `body()` (imperative mxtpu code) under a functional trace where
-    each Parameter in `params` reads from `param_datas`. Returns (result,
-    aux_updates list aligned with params)."""
-    frame = _TraceFrame()
-    for p, d in zip(params, param_datas):
-        frame.param_map[p] = NDArray(d)
-    _TRACE.stack.append(frame)
-    _random.push_key_supply(rng_key)
-    prev_train = autograd.set_training(train)
-    prev_rec = autograd.set_recording(False)
-    _IN_TRACE.active += 1
-    try:
-        result = body()
-    finally:
-        _IN_TRACE.active -= 1
-        autograd.set_recording(prev_rec)
-        autograd.set_training(prev_train)
-        _random.pop_key_supply()
-        _TRACE.stack.pop()
-    aux = [frame.aux_updates.get(p) for p in params]
-    return result, aux
 
 
 def pure_forward(block, train=False):

@@ -1,0 +1,63 @@
+"""mx.sym namespace: symbolic op functions generated from the op registry.
+
+Reference: ``python/mxnet/symbol/register.py`` codegen — every registered op
+gets a symbol-level function that composes graph nodes instead of executing.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ops import registry as _reg
+from .symbol import (Group, Symbol, Variable, load, load_json, trace_block,
+                     var, _Node, _Counter, _ARG)
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "trace_block", "zeros", "ones"]
+
+
+def _symbolic_call(op_name, *args, name=None, **kwargs):
+    """Build a graph node for a registered op (the symbolic twin of
+    ndarray._apply)."""
+    op = _reg.get_op(op_name)
+    in_edges = []
+    pos_template = []
+    for a in args:
+        if isinstance(a, Symbol):
+            if len(a._heads) != 1:
+                raise MXNetError(
+                    "op %s cannot take a multi-output symbol; slice it first"
+                    % op_name)
+            node, idx = a._heads[0]
+            in_edges.append((node, 0 if idx is None else idx))
+            pos_template.append(_ARG)
+        else:
+            pos_template.append(a)
+    kw_arrays = []
+    attrs = {}
+    for k, v in kwargs.items():
+        if isinstance(v, Symbol):
+            node, idx = v._heads[0]
+            in_edges.append((node, 0 if idx is None else idx))
+            kw_arrays.append(k)
+        else:
+            attrs[k] = v
+    if name is None:
+        name = "%s%d" % (op.name.lower().lstrip("_"),
+                         _Counter.next(op.name.lower()))
+    node = _Node(op.name, name, attrs, in_edges, pos_template, kw_arrays)
+    return Symbol([(node, None)])
+
+
+def _make_sym_fn(op_name):
+    def sym_fn(*args, **kwargs):
+        return _symbolic_call(op_name, *args, **kwargs)
+    sym_fn.__name__ = op_name
+    sym_fn.__doc__ = "Symbolic %s (composes a graph node; see mx.nd.%s)" % (
+        op_name, op_name)
+    return sym_fn
+
+
+# generate the namespace (ref: symbol/register.py:143 codegen at import)
+for _name in _reg.list_ops():
+    if _name not in globals():
+        globals()[_name] = _make_sym_fn(_name)
+del _name

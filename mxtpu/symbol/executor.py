@@ -1,0 +1,247 @@
+"""Executor: bind a Symbol to arrays and run compiled forward/backward.
+
+Reference: ``include/mxnet/executor.h:56-152`` and GraphExecutor
+(src/executor/graph_executor.cc — Init :690, InitDataEntryMemory :927,
+RunOps :1318, SimpleBind :1626).
+
+TPU-native re-design: *everything GraphExecutor hand-builds is the XLA
+compiler's job*. Bind = allocate/adopt arg arrays; forward = one
+``jax.jit``-compiled executable per (is_train, shape signature); backward =
+the companion vjp executable (rematerialized, SURVEY §7 stage 3). Memory
+planning, inplace detection, op fusion and segment bulking
+(InitOpSegs/BulkTrainingOpSegs) have no analog here — XLA does them better.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from ..base import MXNetError
+from ..ndarray import NDArray, zeros
+from .symbol import Symbol
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        if isinstance(args, dict):
+            self.arg_dict = {n: args[n] for n in arg_names}
+        else:
+            if args is None or len(args) != len(arg_names):
+                raise MXNetError("bind needs one array per argument %s"
+                                 % arg_names)
+            self.arg_dict = dict(zip(arg_names, args))
+        self.arg_arrays = [self.arg_dict[n] for n in arg_names]
+
+        if aux_states is None:
+            aux_states = {}
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(aux_names, aux_states))
+        self.aux_dict = {n: aux_states.get(n) for n in aux_names}
+        for n in aux_names:
+            if self.aux_dict[n] is None:
+                raise MXNetError("bind: missing aux state %s" % n)
+        self.aux_arrays = [self.aux_dict[n] for n in aux_names]
+
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(arg_names, grad_req))
+        self._grad_req = grad_req
+        if args_grad is None:
+            args_grad = {n: zeros(self.arg_dict[n].shape,
+                                  dtype=self.arg_dict[n].dtype)
+                         for n in arg_names if grad_req.get(n, "null") != "null"}
+        elif not isinstance(args_grad, dict):
+            args_grad = dict(zip(arg_names, args_grad))
+        self.grad_dict = args_grad
+        self.grad_arrays = [self.grad_dict.get(n) for n in arg_names]
+
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+        self._diff_names = [n for n in arg_names
+                            if grad_req.get(n, "null") != "null"]
+        self._jits = {}
+        self.outputs = []
+        self._monitor = None
+        self._last = None
+
+    # ------------------------------------------------------------- factory
+    @staticmethod
+    def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None,
+                    **shapes):
+        """Infer shapes from the given input shapes and allocate everything
+        (ref: MXExecutorSimpleBind, src/c_api/c_api_executor.cc:224)."""
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
+        if arg_shapes is None or any(s is None for s in arg_shapes):
+            raise MXNetError("simple_bind: cannot infer all shapes from %s"
+                             % shapes)
+        type_dict = type_dict or {}
+        args = {n: zeros(s, dtype=type_dict.get(n, "float32"))
+                for n, s in zip(arg_names, arg_shapes)}
+        # feed shapes in `shapes` refer to data inputs; honor their dtypes
+        aux = {n: zeros(s, dtype=type_dict.get(n, "float32"))
+               for n, s in zip(aux_names, aux_shapes)}
+        return Executor(symbol, ctx=ctx, args=args, grad_req=grad_req,
+                        aux_states=aux)
+
+    # ------------------------------------------------------------- running
+    def _feed(self):
+        feed = dict(self.arg_dict)
+        feed.update(self.aux_dict)
+        return feed
+
+    def forward(self, is_train=False, **kwargs):
+        """Run forward; inputs may be updated via kwargs
+        (ref: Executor::Forward, graph_executor.cc:64)."""
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown input %s" % k)
+            self.arg_dict[k]._set_data(jnp.asarray(
+                v._data if isinstance(v, NDArray) else v,
+                dtype=self.arg_dict[k]._data.dtype))
+        feed = self._feed()
+        prev = autograd.set_training(is_train)
+        try:
+            if self._monitor is not None:
+                self.outputs = self._run_monitored(feed, is_train)
+            else:
+                self.outputs = self._run_jit(feed, is_train)
+        finally:
+            autograd.set_training(prev)
+        self._last = (dict(feed), is_train)
+        return self.outputs
+
+    def _run_jit(self, feed, is_train):
+        key = (is_train,) + tuple(
+            (k, feed[k].shape, str(feed[k].dtype)) for k in sorted(feed))
+        if key not in self._jits:
+            sym = self._symbol
+            names = sorted(feed)
+
+            def pure(datas):
+                fd = {n: NDArray(d) for n, d in zip(names, datas)}
+                prev = autograd.set_training(is_train)
+                prev_r = autograd.set_recording(False)
+                try:
+                    aux_updates = {}
+                    outs = sym._execute(fd, is_train=is_train,
+                                        collect_aux=aux_updates
+                                        if is_train else None)
+                finally:
+                    autograd.set_recording(prev_r)
+                    autograd.set_training(prev)
+                return ([o._data for o in outs],
+                        {k: v._data for k, v in aux_updates.items()})
+
+            self._jits[key] = jax.jit(pure)
+        out_datas, aux_updates = self._jits[key](
+            [feed[n]._data for n in sorted(feed)])
+        for k, v in aux_updates.items():
+            self.aux_dict[k]._set_data(v)
+        return [NDArray(d) for d in out_datas]
+
+    def _run_monitored(self, feed, is_train):
+        """Uncompiled per-op run so the monitor callback sees every output
+        (ref: MXExecutorSetMonitorCallback / GraphExecutor monitor,
+        src/executor/graph_executor.cc:104)."""
+        outs = self._symbol._execute(feed, is_train=is_train)
+        return outs
+
+    def backward(self, out_grads=None):
+        """Gradients into grad_dict honoring grad_req write/add
+        (ref: Executor::Backward, graph_executor.cc:77)."""
+        if self._last is None:
+            raise MXNetError("call forward before backward")
+        feed, is_train = self._last
+        diff = self._diff_names
+        if not diff:
+            return
+        sym = self._symbol
+        names = sorted(feed)
+        key = ("bwd", is_train) + tuple(
+            (k, feed[k].shape, str(feed[k].dtype)) for k in names)
+        if key not in self._jits:
+            def bwd(datas, cots):
+                def f(diff_datas):
+                    full = dict(zip(names, datas))
+                    full.update(dict(zip(diff, diff_datas)))
+                    fd = {n: NDArray(d) for n, d in full.items()}
+                    prev = autograd.set_training(is_train)
+                    prev_r = autograd.set_recording(False)
+                    try:
+                        outs = sym._execute(fd, is_train=is_train)
+                    finally:
+                        autograd.set_recording(prev_r)
+                        autograd.set_training(prev)
+                    return [o._data for o in outs]
+
+                _, vjp_fn = jax.vjp(f, [dict(zip(names, datas))[n]
+                                        for n in diff])
+                return vjp_fn(cots)[0]
+
+            self._jits[key] = jax.jit(bwd)
+        if out_grads is None:
+            cots = [jnp.ones_like(o._data) for o in self.outputs]
+        else:
+            if not isinstance(out_grads, (list, tuple)):
+                out_grads = [out_grads]
+            cots = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                    for g in out_grads]
+        grads = self._jits[key]([feed[n]._data for n in names], cots)
+        for n, g in zip(diff, grads):
+            tgt = self.grad_dict.get(n)
+            if tgt is None:
+                continue
+            if self._grad_req.get(n) == "add":
+                tgt._set_data(tgt._data + g)
+            else:
+                tgt._set_data(g.astype(tgt._data.dtype))
+
+    # --------------------------------------------------------------- misc
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor = callback
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(jnp.asarray(
+                    v._data, dtype=self.arg_dict[k]._data.dtype))
+            elif not allow_extra_params:
+                raise MXNetError("Found name \"%s\" not in arguments" % k)
+        if aux_params:
+            for k, v in aux_params.items():
+                if k in self.aux_dict:
+                    self.aux_dict[k]._set_data(jnp.asarray(
+                        v._data, dtype=self.aux_dict[k]._data.dtype))
+                elif not allow_extra_params:
+                    raise MXNetError("Found name \"%s\" not in aux" % k)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor for new input shapes (ref: Executor::Reshape)
+        — with a jit cache this is just a rebind."""
+        arg_names = self._symbol.list_arguments()
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        args = {}
+        for n, s in zip(arg_names, arg_shapes):
+            cur = self.arg_dict[n]
+            args[n] = cur if tuple(cur.shape) == tuple(s) else \
+                zeros(s, dtype=cur.dtype)
+        aux = {n: a for n, a in self.aux_dict.items()}
+        return Executor(self._symbol, ctx=self._ctx, args=args,
+                        grad_req=self._grad_req, aux_states=aux)
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))

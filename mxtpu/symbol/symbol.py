@@ -1,0 +1,667 @@
+"""Symbol: the deferred-composition graph layer.
+
+Reference: ``python/mxnet/symbol/symbol.py`` (compose/infer_shape/save-load JSON,
+simple_bind :1290) over the nnvm graph IR (SURVEY §2.1 "nnvm graph IR").
+
+TPU-native re-design: a Symbol is a lightweight DAG over the op *registry*
+(mxtpu/ops/registry.py) — each node stores the registered op name, static
+attrs, and input edges. There are no separate shape/type inference passes:
+``infer_shape``/``infer_type`` run jax abstract evaluation (``jax.eval_shape``)
+over the graph, and the executor (mxtpu/symbol/executor.py) compiles the whole
+graph with ``jax.jit`` — XLA performs the memory planning, operator fusion and
+scheduling that GraphExecutor (src/executor/graph_executor.cc) hand-built.
+
+Serialization keeps the reference's node-list JSON shape (nodes / arg_nodes /
+heads) so graph checkpoints remain diffable and tooling-friendly.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import threading
+
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ops import registry as _reg
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "trace_block"]
+
+# marker for "an array flows here" inside serialized positional templates
+_ARG = "__arg__"
+
+
+def _pairify(v, n):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _fc_shapes(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return {}
+    num_hidden = int(attrs.get("num_hidden"))
+    flatten = attrs.get("flatten", True)
+    in_units = 1
+    if flatten:
+        for s in data[1:]:
+            in_units *= s
+    else:
+        in_units = data[-1]
+    out = {1: (num_hidden, in_units)}
+    if len(shapes) > 2 and not attrs.get("no_bias", False):
+        out[2] = (num_hidden,)
+    return out
+
+
+def _conv_shapes(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return {}
+    ndim = len(data) - 2
+    kernel = _pairify(attrs.get("kernel"), ndim)
+    num_filter = int(attrs.get("num_filter"))
+    num_group = int(attrs.get("num_group", 1))
+    layout = attrs.get("layout") or "NC" + "DHW"[3 - ndim:]
+    c_axis = layout.index("C")
+    in_ch = data[c_axis]
+    out = {1: (num_filter, in_ch // num_group) + kernel}
+    if len(shapes) > 2 and not attrs.get("no_bias", False):
+        out[2] = (num_filter,)
+    return out
+
+
+def _deconv_shapes(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return {}
+    ndim = len(data) - 2
+    kernel = _pairify(attrs.get("kernel"), ndim)
+    num_filter = int(attrs.get("num_filter"))
+    num_group = int(attrs.get("num_group", 1))
+    in_ch = data[1]
+    out = {1: (in_ch, num_filter // num_group) + kernel}
+    if len(shapes) > 2 and not attrs.get("no_bias", True):
+        out[2] = (num_filter,)
+    return out
+
+
+def _channel_shapes(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return {}
+    axis = int(attrs.get("axis", 1)) % len(data)
+    c = (data[axis],)
+    return {i: c for i in range(1, len(shapes))}
+
+
+def _lastdim_shapes(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return {}
+    axis = int(attrs.get("axis", -1)) % len(data)
+    c = (data[axis],)
+    return {i: c for i in range(1, len(shapes))}
+
+
+def _embedding_shapes(shapes, attrs):
+    return {1: (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+# op name -> fn(input_shapes, attrs) -> {input_index: shape} for unknown
+# parameter inputs (the FInferShape backward-fill of the reference registry)
+_SHAPE_HOOKS = {
+    "FullyConnected": _fc_shapes,
+    "Convolution": _conv_shapes,
+    "Deconvolution": _deconv_shapes,
+    "BatchNorm": _channel_shapes,
+    "InstanceNorm": _channel_shapes,
+    "LayerNorm": _lastdim_shapes,
+    "Embedding": _embedding_shapes,
+}
+
+_AUX_SUFFIXES = ("running_mean", "running_var", "moving_mean", "moving_var")
+
+
+class _Counter:
+    _lock = threading.Lock()
+    _counts = {}
+
+    @classmethod
+    def next(cls, hint):
+        with cls._lock:
+            c = cls._counts.get(hint, 0)
+            cls._counts[hint] = c + 1
+            return c
+
+
+class _Node:
+    """One graph node. op None => variable (a free input)."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "pos_template",
+                 "kw_arrays", "num_outputs")
+
+    def __init__(self, op, name, attrs=None, inputs=(), pos_template=None,
+                 kw_arrays=(), num_outputs=1):
+        self.op = op
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.inputs = list(inputs)          # [(node, out_index)]
+        # how to rebuild the positional call: list of _ARG (array slot,
+        # consumed from self.inputs in order) or a literal static value
+        self.pos_template = (list(pos_template) if pos_template is not None
+                             else [_ARG] * len(self.inputs))
+        self.kw_arrays = list(kw_arrays)    # kwarg names that are array slots
+        self.num_outputs = num_outputs
+
+    def is_var(self):
+        return self.op is None
+
+
+def _topo(heads):
+    """Post-order DFS over nodes reachable from heads (stable input order)."""
+    seen = {}
+    order = []
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen[id(node)] = node
+        for inp, _ in node.inputs:
+            visit(inp)
+        order.append(node)
+
+    for node, _ in heads:
+        visit(node)
+    return order
+
+
+class Symbol:
+    """A (possibly multi-output) symbolic expression (ref: symbol.py:Symbol)."""
+
+    def __init__(self, heads):
+        self._heads = list(heads)  # [(node, out_index)]
+
+    # ------------------------------------------------------------- structure
+    @property
+    def name(self):
+        if len(self._heads) == 1:
+            return self._heads[0][0].name
+        return None
+
+    def __repr__(self):
+        names = ", ".join(n.name for n, _ in self._heads)
+        return "<Symbol %s>" % names
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self.list_outputs())))
+
+    def __getitem__(self, index):
+        outs = self._expand_heads()
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise MXNetError("Cannot find output %s" % index)
+            index = names.index(index)
+        return Symbol([outs[index]])
+
+    def _expand_heads(self):
+        outs = []
+        for node, idx in self._heads:
+            if idx is None and node.num_outputs > 1:
+                outs.extend((node, i) for i in range(node.num_outputs))
+            else:
+                outs.append((node, 0 if idx is None else idx))
+        return outs
+
+    def list_outputs(self):
+        names = []
+        for node, idx in self._expand_heads():
+            if node.num_outputs > 1:
+                names.append("%s_output%d" % (node.name, idx))
+            else:
+                names.append("%s_output" % node.name)
+        return names
+
+    def list_inputs(self):
+        return [n.name for n in _topo(self._heads) if n.is_var()]
+
+    def list_arguments(self):
+        return [name for name in self.list_inputs()
+                if not name.endswith(_AUX_SUFFIXES)]
+
+    def list_auxiliary_states(self):
+        return [name for name in self.list_inputs()
+                if name.endswith(_AUX_SUFFIXES)]
+
+    def get_internals(self):
+        """All intermediate outputs as a grouped symbol (ref: get_internals)."""
+        return Symbol([(n, 0) for n in _topo(self._heads)])
+
+    def attr(self, key):
+        if len(self._heads) == 1:
+            v = self._heads[0][0].attrs.get(key)
+            return None if v is None else str(v)
+        return None
+
+    def list_attr(self):
+        if len(self._heads) == 1:
+            return {k: str(v) for k, v in self._heads[0][0].attrs.items()}
+        return {}
+
+    # ------------------------------------------------------------- compose
+    def __call__(self, *args, **kwargs):
+        """Compose: replace free variables with other symbols
+        (ref: symbol.py Symbol.__call__/_compose)."""
+        self._compose(*args, **kwargs)
+        return self
+
+    def _compose(self, *args, **kwargs):
+        if args:
+            # positional: substitute variables in list_inputs order
+            names = self.list_inputs()
+            if len(args) > len(names):
+                raise MXNetError("too many positional composition args")
+            kwargs = dict(zip(names, args), **kwargs)
+        mapping = {}
+        for node in _topo(self._heads):
+            if node.is_var() and node.name in kwargs:
+                repl = kwargs[node.name]
+                if not isinstance(repl, Symbol):
+                    raise TypeError("compose expects Symbols")
+                if len(repl._heads) != 1:
+                    raise MXNetError("cannot compose with multi-output symbol")
+                mapping[id(node)] = repl._heads[0]
+        if not mapping:
+            return
+        for node in _topo(self._heads):
+            node.inputs = [
+                (mapping.get(id(inp), (inp, idx))[0],
+                 mapping[id(inp)][1] if id(inp) in mapping else idx)
+                for inp, idx in node.inputs]
+        self._heads = [
+            (mapping.get(id(n), (n, i))[0],
+             mapping[id(n)][1] if id(n) in mapping else i)
+            for n, i in self._heads]
+
+    # ------------------------------------------------------------- execution
+    def _execute(self, feed, is_train=False, collect_aux=None):
+        """Run the graph on NDArrays. feed: name -> NDArray. Returns list of
+        output NDArrays per head. When ``collect_aux`` is a dict, training-mode
+        BatchNorm nodes deposit (new_running_mean, new_running_var) there —
+        the in-kernel aux mutation of the reference (src/operator/nn/
+        batch_norm.cc) done functionally."""
+        values = {}  # id(node) -> list of output NDArrays
+        for node in _topo(self._heads):
+            if node.is_var():
+                if node.name not in feed:
+                    raise MXNetError("variable %s is not bound" % node.name)
+                values[id(node)] = [feed[node.name]]
+                continue
+            arrays = [values[id(inp)][idx] for inp, idx in node.inputs]
+            it = iter(arrays)
+            pos = [next(it) if a is _ARG else a for a in node.pos_template]
+            kwargs = dict(node.attrs)
+            for k in node.kw_arrays:
+                kwargs[k] = next(it)
+            op = _reg.get_op(node.op)
+            if collect_aux is not None and node.op == "BatchNorm" \
+                    and is_train and not kwargs.get("use_global_stats"):
+                kwargs["output_mean_var"] = True
+                out, mean, var = op.wrapper(*pos, **kwargs)
+                momentum = float(kwargs.get("momentum", 0.9))
+                rm, rv = pos[3], pos[4]  # moving_mean, moving_var inputs
+                collect_aux[node.inputs[3][0].name] = \
+                    rm * momentum + mean * (1 - momentum)
+                collect_aux[node.inputs[4][0].name] = \
+                    rv * momentum + var * (1 - momentum)
+                res = out
+            else:
+                res = op.wrapper(*pos, **kwargs)
+            outs = list(res) if isinstance(res, (list, tuple)) else [res]
+            node.num_outputs = len(outs)
+            values[id(node)] = outs
+        return [values[id(n)][i] for n, i in self._expand_heads()]
+
+    def eval(self, ctx=None, **kwargs):
+        """Evaluate with NDArray bindings (ref: symbol.py:eval). Returns a
+        list of NDArrays."""
+        return self._execute(kwargs)
+
+    # ------------------------------------------------------------ inference
+    def infer_shape(self, **kwargs):
+        """(arg_shapes, out_shapes, aux_shapes) via jax abstract eval —
+        the InferShape pass (src/executor/infer_graph_attr_pass.cc) for free."""
+        args, outs, auxs = self._infer(kwargs, want="shape")
+        return args, outs, auxs
+
+    def infer_type(self, **kwargs):
+        args, outs, auxs = self._infer(kwargs, want="dtype")
+        return args, outs, auxs
+
+    def _infer(self, hints, want="shape"):
+        """Forward shape/type propagation with per-op parameter completion —
+        the TPU-native InferShape pass. Known input specs flow through each
+        node via per-node jax abstract eval; unknown *parameter* inputs
+        (weights/bias/stats) are filled by `_SHAPE_HOOKS` rules, the analog of
+        each reference op's FInferShape filling in unknowns
+        (e.g. fully_connected.cc weight = (num_hidden, in_units))."""
+        import jax
+
+        nodes = _topo(self._heads)
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+
+        specs = {}  # var name -> ShapeDtypeStruct | None
+        for n in nodes:
+            if not n.is_var():
+                continue
+            if want == "dtype" and n.name in hints:
+                shape = n.attrs.get("__shape__")
+                dtype = hints[n.name]
+            else:
+                shape = hints.get(n.name, n.attrs.get("__shape__"))
+                dtype = n.attrs.get("__dtype__", "float32")
+            specs[n.name] = (jax.ShapeDtypeStruct(tuple(shape),
+                                                  jnp.dtype(dtype))
+                             if shape is not None else None)
+
+        values = {}  # id(node) -> list[ShapeDtypeStruct] | None
+        for node in nodes:
+            if node.is_var():
+                values[id(node)] = ([specs[node.name]]
+                                    if specs[node.name] is not None else None)
+                continue
+            in_specs = [values[id(inp)][idx]
+                        if values[id(inp)] is not None else None
+                        for inp, idx in node.inputs]
+            hook = _SHAPE_HOOKS.get(node.op)
+            if hook is not None and any(s is None for s in in_specs):
+                filled = hook([None if s is None else tuple(s.shape)
+                               for s in in_specs], node.attrs)
+                for i, shape in (filled or {}).items():
+                    inp, idx = node.inputs[i]
+                    if inp.is_var() and specs.get(inp.name) is None \
+                            and shape is not None:
+                        dt = inp.attrs.get("__dtype__", "float32")
+                        specs[inp.name] = jax.ShapeDtypeStruct(
+                            tuple(shape), jnp.dtype(dt))
+                        values[id(inp)] = [specs[inp.name]]
+                        in_specs[i] = specs[inp.name]
+            if any(s is None for s in in_specs):
+                values[id(node)] = None
+                continue
+            values[id(node)] = self._abstract_node(node, in_specs)
+
+        get = (lambda s: None if s is None else tuple(s.shape)) \
+            if want == "shape" else (lambda s: None if s is None else s.dtype)
+        outs = []
+        for n, i in self._expand_heads():
+            v = values[id(n)]
+            outs.append(None if v is None else get(v[i]))
+        return ([get(specs[n]) for n in arg_names],
+                outs,
+                [get(specs[n]) for n in aux_names])
+
+    @staticmethod
+    def _abstract_node(node, in_specs):
+        """Abstract-eval one node (shapes/dtypes only, nothing computed)."""
+        import jax
+
+        op = _reg.get_op(node.op)
+
+        def f(datas):
+            arrays = [NDArray(d) for d in datas]
+            it = iter(arrays)
+            pos = [next(it) if a is _ARG else a for a in node.pos_template]
+            kwargs = dict(node.attrs)
+            for k in node.kw_arrays:
+                kwargs[k] = next(it)
+            res = op.wrapper(*pos, **kwargs)
+            outs = list(res) if isinstance(res, (list, tuple)) else [res]
+            return [o._data for o in outs]
+
+        out = jax.eval_shape(f, list(in_specs))
+        node.num_outputs = len(out)
+        return list(out)
+
+    # ---------------------------------------------------------------- bind
+    def simple_bind(self, ctx=None, grad_req="write", **kwargs):
+        from .executor import Executor
+        return Executor.simple_bind(self, ctx=ctx, grad_req=grad_req, **kwargs)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **_ignored):
+        from .executor import Executor
+        return Executor(self, ctx=ctx, args=args, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux_states)
+
+    # ------------------------------------------------------------ serialize
+    def tojson(self):
+        nodes = _topo(self._heads)
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append({
+                "op": "null" if n.is_var() else n.op,
+                "name": n.name,
+                "attrs": {k: repr(v) for k, v in n.attrs.items()},
+                "inputs": [[nid[id(inp)], idx, 0] for inp, idx in n.inputs],
+                "pos_template": [x if x is _ARG else repr(x)
+                                 for x in n.pos_template],
+                "kw_arrays": list(n.kw_arrays),
+                "num_outputs": n.num_outputs,
+            })
+        return json.dumps({
+            "nodes": jnodes,
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.is_var()],
+            "heads": [[nid[id(n)], 0 if i is None else i, 0]
+                      for n, i in self._heads],
+            "attrs": {"mxtpu_version": 1},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # ----------------------------------------------------------- operators
+    def __add__(self, other):
+        return _binary("broadcast_add", "_plus_scalar", self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return _binary("broadcast_sub", "_minus_scalar", self, other)
+
+    def __rsub__(self, other):
+        return _binary("broadcast_sub", "_rminus_scalar", self, other, rev=True)
+
+    def __mul__(self, other):
+        return _binary("broadcast_mul", "_mul_scalar", self, other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return _binary("broadcast_div", "_div_scalar", self, other)
+
+    def __rtruediv__(self, other):
+        return _binary("broadcast_div", "_rdiv_scalar", self, other, rev=True)
+
+    def __pow__(self, other):
+        return _binary("broadcast_power", "_power_scalar", self, other)
+
+    def __neg__(self):
+        return self.__mul__(-1.0)
+
+    def __getattr__(self, name):
+        # generated method surface: sym.reshape(...) -> symbolic op
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            _reg.get_op(name)
+        except KeyError:
+            raise AttributeError(name)
+        from . import _symbolic_call
+        return lambda *a, **kw: _symbolic_call(name, self, *a, **kw)
+
+
+def _binary(op_name, scalar_op, lhs, rhs, rev=False):
+    # scalar variants are registered as (x, scalar) positional aliases of the
+    # broadcast ops (mxtpu/ops/elemwise.py; _r* variants already reversed)
+    from . import _symbolic_call
+    if isinstance(rhs, Symbol):
+        return _symbolic_call(op_name, lhs, rhs)
+    try:
+        _reg.get_op(scalar_op)
+        return _symbolic_call(scalar_op, lhs, float(rhs))
+    except KeyError:
+        raise MXNetError("scalar op %s not registered" % scalar_op)
+
+
+def var(name, attr=None, shape=None, dtype=None, init=None, **kwargs):
+    """Create a variable symbol (ref: symbol.py:var)."""
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = str(_np.dtype(dtype)) if dtype is not None else None
+    attrs.update(kwargs)
+    return Symbol([(_Node(None, name, attrs), None)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    heads = []
+    for s in symbols:
+        heads.extend(s._expand_heads())
+    return Symbol(heads)
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes = []
+    for jn in data["nodes"]:
+        attrs = {k: _literal(v) for k, v in jn.get("attrs", {}).items()}
+        op = None if jn["op"] == "null" else jn["op"]
+        node = _Node(op, jn["name"], attrs,
+                     num_outputs=jn.get("num_outputs", 1))
+        node.pos_template = [_ARG if x == _ARG else _literal(x)
+                             for x in jn.get("pos_template", [])]
+        node.kw_arrays = list(jn.get("kw_arrays", []))
+        nodes.append(node)
+    for node, jn in zip(nodes, data["nodes"]):
+        node.inputs = [(nodes[i], idx) for i, idx, _ in jn.get("inputs", [])]
+        if not jn.get("pos_template"):
+            node.pos_template = [_ARG] * len(node.inputs)
+    heads = [(nodes[i], idx) for i, idx, _ in data["heads"]]
+    return Symbol(heads)
+
+
+def _literal(s):
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# --------------------------------------------------------------- block trace
+class _SymTape(threading.local):
+    def __init__(self):
+        self.active = None   # dict: id(NDArray) -> (node, out_idx)
+        self.names = None
+
+
+_SYM_TAPE = _SymTape()
+
+
+def record_apply(op_name, args, kwargs, inputs, outputs):
+    """Hook called by ndarray._apply when symbol tracing is active: appends the
+    op call to the graph under construction (the analog of autograd's RecordOp
+    for graph export)."""
+    tape = _SYM_TAPE.active
+    if tape is None:
+        return
+    in_edges = []
+    for x in inputs:
+        if id(x) not in tape:
+            # unseen array entering the graph: promote to a variable
+            name = "extra%d" % _Counter.next("extra")
+            tape[id(x)] = (_Node(None, name, {}), 0)
+        in_edges.append(tape[id(x)])
+    pos_template = [_ARG if isinstance(a, NDArray) else a for a in args]
+    kw_arrays = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
+    attrs = {k: v for k, v in kwargs.items() if not isinstance(v, NDArray)}
+    name = "%s%d" % (op_name.lower(), _Counter.next(op_name.lower()))
+    node = _Node(op_name, name, attrs, in_edges, pos_template, kw_arrays,
+                 num_outputs=len(outputs))
+    for i, o in enumerate(outputs):
+        tape[id(o)] = (node, i)
+
+
+def trace_block(block, *example_inputs):
+    """Trace a HybridBlock's forward into a Symbol (used by Block.export and
+    SymbolBlock; ref: gluon exports hybridized CachedOp graphs,
+    python/mxnet/gluon/block.py:870).
+
+    Traces in inference mode — BatchNorm uses global stats and Dropout is
+    identity, matching the reference's deploy export. Returns
+    ``(symbol, arg_names)``.
+    """
+    from .. import autograd
+    from ..ndarray import zeros
+
+    if not example_inputs:
+        specs = getattr(block, "_in_specs", None)
+        if not specs:
+            raise MXNetError(
+                "export/trace requires the block to have run at least once "
+                "(or pass example inputs)")
+        example_inputs = [zeros(s, dtype=d) for s, d in specs]
+
+    tape = {}
+    data_names = []
+    for i, x in enumerate(example_inputs):
+        name = "data" if i == 0 else "data%d" % i
+        tape[id(x)] = (_Node(None, name, {"__shape__": tuple(x.shape),
+                                          "__dtype__": str(x.dtype)}), 0)
+        data_names.append(name)
+    # parameters become named variables
+    for pname, p in block.collect_params().items():
+        if p._data is not None:
+            tape[id(p.data())] = (_Node(None, pname, {}), 0)
+
+    from ..gluon.block import _IN_TRACE
+
+    prev = autograd.set_training(False)
+    _SYM_TAPE.active = tape
+    _IN_TRACE.active += 1  # force eager forward (bypass CachedOp jit)
+    try:
+        out = block(*example_inputs)
+    finally:
+        _IN_TRACE.active -= 1
+        _SYM_TAPE.active = None
+        autograd.set_training(prev)
+
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    heads = []
+    for o in outs:
+        if id(o) not in tape:
+            raise MXNetError("block output was not produced by registered ops")
+        heads.append(tape[id(o)])
+    sym = Symbol(heads)
+    return sym, sym.list_arguments()

@@ -1,0 +1,143 @@
+"""Module API tests (ref patterns: tests/python/unittest/test_module.py,
+tests/python/train/test_mlp.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import symbol as sym
+from mxtpu.io import DataBatch, DataDesc, NDArrayIter
+from mxtpu.module import BucketingModule, Module
+
+
+def _mlp_symbol(num_hidden=32, num_classes=4):
+    data = sym.var("data")
+    net = sym.FullyConnected(data, sym.var("fc1_weight"), sym.var("fc1_bias"),
+                             num_hidden=num_hidden, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, sym.var("fc2_weight"), sym.var("fc2_bias"),
+                             num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(net, sym.var("softmax_label"), name="softmax")
+
+
+def _toy_dataset(n=256, dim=8, classes=4, seed=0):
+    """Linearly separable-ish clusters."""
+    rng = np.random.RandomState(seed)
+    centers = rng.normal(scale=3.0, size=(classes, dim))
+    y = rng.randint(0, classes, size=(n,))
+    x = centers[y] + rng.normal(scale=0.5, size=(n, dim))
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def test_module_bind_forward_shapes():
+    net = _mlp_symbol()
+    mod = Module(net, data_names=("data",), label_names=("softmax_label",))
+    mod.bind(data_shapes=[DataDesc("data", (16, 8))],
+             label_shapes=[DataDesc("softmax_label", (16,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    batch = DataBatch(data=[mx.nd.ones((16, 8))],
+                      label=[mx.nd.zeros((16,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (16, 4)
+    probs = out.asnumpy()
+    np.testing.assert_allclose(probs.sum(axis=1), np.ones(16), rtol=1e-5)
+
+
+def test_module_fit_accuracy():
+    """End-to-end fit() must learn the toy problem (train-tier test,
+    ref: tests/python/train/test_mlp.py accuracy assert)."""
+    x, y = _toy_dataset()
+    train = NDArrayIter(x, y, batch_size=32, shuffle=True)
+    val = NDArrayIter(x, y, batch_size=32)
+    net = _mlp_symbol()
+    mod = Module(net)
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            num_epoch=10, initializer=mx.init.Xavier())
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.95, score
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    x, y = _toy_dataset(n=64)
+    train = NDArrayIter(x, y, batch_size=32)
+    net = _mlp_symbol()
+    mod = Module(net)
+    mod.bind(data_shapes=train.provide_data, label_shapes=train.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer()
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 3)
+
+    mod2 = Module.load(prefix, 3)
+    mod2.bind(data_shapes=train.provide_data, label_shapes=train.provide_label)
+    mod2.init_params()
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    for k in a1:
+        np.testing.assert_allclose(a2[k].asnumpy(), a1[k].asnumpy())
+    batch = next(iter(train))
+    mod.forward(batch, is_train=False)
+    mod2.forward(batch, is_train=False)
+    np.testing.assert_allclose(mod2.get_outputs()[0].asnumpy(),
+                               mod.get_outputs()[0].asnumpy(), rtol=1e-5)
+
+
+def test_module_predict_and_input_grads():
+    x, y = _toy_dataset(n=64)
+    it = NDArrayIter(x, y, batch_size=16)
+    mod = Module(_mlp_symbol())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             inputs_need_grad=True)
+    mod.init_params(initializer=mx.init.Xavier())
+    preds = mod.predict(it)
+    assert preds.shape == (64, 4)
+    batch = DataBatch(data=[mx.nd.array(x[:16])],
+                      label=[mx.nd.array(y[:16])])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    g = mod.get_input_grads()[0]
+    assert g is not None and g.shape == (16, 8)
+    assert float(np.abs(g.asnumpy()).sum()) > 0
+
+
+def test_bucketing_module_shares_params():
+    """Variable-length inputs share one set of weights
+    (ref: tests/python/train/test_bucketing.py)."""
+    def sym_gen(seq_len):
+        data = sym.var("data")
+        net = sym.FullyConnected(data, sym.var("fc_weight"), sym.var("fc_bias"),
+                                 num_hidden=8, flatten=False, name="fc")
+        net = sym.mean(net, axis=1)
+        net = sym.SoftmaxOutput(net, sym.var("softmax_label"), name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = BucketingModule(sym_gen, default_bucket_key=10)
+    mod.bind(data_shapes=[DataDesc("data", (4, 10, 6))],
+             label_shapes=[DataDesc("softmax_label", (4,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer()
+
+    for key, t in ((10, 10), (5, 5)):
+        batch = DataBatch(
+            data=[mx.nd.ones((4, t, 6))], label=[mx.nd.zeros((4,))],
+            bucket_key=key,
+            provide_data=[DataDesc("data", (4, t, 6))],
+            provide_label=[DataDesc("softmax_label", (4,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    # both buckets see the same (updated) weight array
+    w10 = mod._buckets[10]._exec.arg_dict["fc_weight"].asnumpy()
+    w5 = mod._buckets[5]._exec.arg_dict["fc_weight"].asnumpy()
+    np.testing.assert_allclose(w10, w5)
+
+
+def test_ndarray_iter_pad_and_shuffle():
+    x = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = NDArrayIter(x, None, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    it2 = NDArrayIter(x, None, batch_size=4, last_batch_handle="discard")
+    assert len(list(it2)) == 2

@@ -1,0 +1,174 @@
+"""Symbol/Executor tests (ref pattern: tests/python/unittest/test_symbol.py,
+test_executor.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import gluon
+from mxtpu import symbol as sym
+from mxtpu.base import MXNetError
+from mxtpu.gluon import nn
+
+
+def _mlp_sym():
+    data = sym.var("data")
+    w1, b1 = sym.var("fc1_weight"), sym.var("fc1_bias")
+    net = sym.FullyConnected(data, w1, b1, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    w2, b2 = sym.var("fc2_weight"), sym.var("fc2_bias")
+    return sym.FullyConnected(net, w2, b2, num_hidden=4, name="fc2")
+
+
+def test_compose_and_listing():
+    net = _mlp_sym()
+    assert net.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias"]
+    assert net.list_outputs() == ["fc2_output"]
+    assert net.name == "fc2"
+
+
+def test_infer_shape():
+    net = _mlp_sym()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(
+        data=(8, 10), fc1_weight=(16, 10), fc1_bias=(16,),
+        fc2_weight=(4, 16), fc2_bias=(4,))
+    assert out_shapes == [(8, 4)]
+    assert arg_shapes[0] == (8, 10)
+
+
+def test_eval_matches_ndarray():
+    np.random.seed(0)
+    x = mx.nd.array(np.random.normal(size=(3, 5)).astype(np.float32))
+    w = mx.nd.array(np.random.normal(size=(7, 5)).astype(np.float32))
+    b = mx.nd.array(np.random.normal(size=(7,)).astype(np.float32))
+    s = sym.FullyConnected(sym.var("x"), sym.var("w"), sym.var("b"),
+                           num_hidden=7)
+    out = s.eval(x=x, w=w, b=b)[0]
+    ref = mx.nd.FullyConnected(x, w, b, num_hidden=7)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-5)
+
+
+def test_arithmetic_and_scalar_ops():
+    a, b = sym.var("a"), sym.var("b")
+    c = (a + b) * 2.0 - a / b
+    x = mx.nd.array([[2.0, 4.0]])
+    y = mx.nd.array([[1.0, 2.0]])
+    out = c.eval(a=x, b=y)[0].asnumpy()
+    np.testing.assert_allclose(out, [[(2 + 1) * 2 - 2, (4 + 2) * 2 - 2]])
+
+
+def test_json_roundtrip():
+    net = _mlp_sym()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    x = mx.nd.ones((2, 10))
+    feed = {"data": x,
+            "fc1_weight": mx.nd.ones((16, 10)), "fc1_bias": mx.nd.zeros((16,)),
+            "fc2_weight": mx.nd.ones((4, 16)), "fc2_bias": mx.nd.zeros((4,))}
+    np.testing.assert_allclose(net2.eval(**feed)[0].asnumpy(),
+                               net.eval(**feed)[0].asnumpy())
+
+
+def test_simple_bind_forward_backward():
+    net = _mlp_sym()
+    exe = net.simple_bind(grad_req="write", data=(8, 10))
+    rng = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        arr._set_data(mx.nd.array(
+            rng.normal(scale=0.1, size=arr.shape).astype(np.float32))._data)
+    out = exe.forward(is_train=True, data=mx.nd.ones((8, 10)))[0]
+    assert out.shape == (8, 4)
+    exe.backward(out_grads=mx.nd.ones((8, 4)))
+    # numeric check of one weight gradient against finite differences
+    w = exe.arg_dict["fc2_weight"]
+    g = exe.grad_dict["fc2_weight"].asnumpy()
+    eps = 1e-3
+    wd = w.asnumpy().copy()
+    wd[0, 0] += eps
+    w._set_data(mx.nd.array(wd)._data)
+    out_p = exe.forward(is_train=True)[0].asnumpy().sum()
+    wd[0, 0] -= 2 * eps
+    w._set_data(mx.nd.array(wd)._data)
+    out_m = exe.forward(is_train=True)[0].asnumpy().sum()
+    np.testing.assert_allclose(g[0, 0], (out_p - out_m) / (2 * eps),
+                               rtol=1e-2, atol=1e-3)
+
+
+def test_batchnorm_executor_updates_aux():
+    data = sym.var("data")
+    out = sym.BatchNorm(data, sym.var("bn_gamma"), sym.var("bn_beta"),
+                        sym.var("bn_moving_mean"), sym.var("bn_moving_var"),
+                        fix_gamma=False, name="bn")
+    exe = out.simple_bind(data=(16, 4))
+    exe.arg_dict["bn_gamma"]._set_data(mx.nd.ones((4,))._data)
+    x = mx.nd.array(np.random.RandomState(0).normal(
+        loc=3.0, size=(16, 4)).astype(np.float32))
+    before = exe.aux_dict["bn_moving_mean"].asnumpy().copy()
+    exe.forward(is_train=True, data=x)
+    after = exe.aux_dict["bn_moving_mean"].asnumpy()
+    assert not np.allclose(before, after)
+    # eval mode must not touch aux
+    snap = after.copy()
+    exe.forward(is_train=False, data=x)
+    np.testing.assert_allclose(exe.aux_dict["bn_moving_mean"].asnumpy(), snap)
+
+
+def test_grad_req_add_and_null():
+    x = sym.var("x")
+    y = (x * 2.0)
+    exe = y.bind(args={"x": mx.nd.ones((3,))},
+                 grad_req={"x": "add"})
+    exe.forward(is_train=True)
+    exe.backward(out_grads=mx.nd.ones((3,)))
+    exe.backward(out_grads=mx.nd.ones((3,)))
+    np.testing.assert_allclose(exe.grad_dict["x"].asnumpy(), [4.0, 4.0, 4.0])
+
+
+def test_trace_block_export_symbolblock():
+    np.random.seed(0)
+    net = nn.HybridSequential(prefix="mlp_")
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(np.random.normal(size=(2, 8)).astype(np.float32))
+    ref = net(x).asnumpy()
+
+    s, arg_names = sym.trace_block(net)
+    assert "data" in s.list_inputs()
+    # evaluate the traced graph with the block's own params
+    feed = {"data": x}
+    for name, p in net.collect_params().items():
+        feed[name] = p.data()
+    np.testing.assert_allclose(s.eval(**feed)[0].asnumpy(), ref, rtol=1e-5)
+
+
+def test_export_and_symbolblock_imports(tmp_path):
+    np.random.seed(0)
+    net = nn.HybridSequential(prefix="exp_")
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"))
+        net.add(nn.Dense(2))
+    net.initialize()
+    x = mx.nd.array(np.random.normal(size=(4, 6)).astype(np.float32))
+    ref = net(x).asnumpy()
+    path = str(tmp_path / "model")
+    net.export(path)
+
+    loaded = gluon.SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                       path + "-0000.params")
+    out = loaded(x).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_group_and_slicing():
+    a, b = sym.var("a"), sym.var("b")
+    g = sym.Group([a * 2.0, b + 1.0])
+    assert len(g.list_outputs()) == 2
+    outs = g.eval(a=mx.nd.ones((2,)), b=mx.nd.zeros((2,)))
+    np.testing.assert_allclose(outs[0].asnumpy(), [2, 2])
+    np.testing.assert_allclose(outs[1].asnumpy(), [1, 1])
+    first = g[0]
+    np.testing.assert_allclose(first.eval(a=mx.nd.ones((2,)))[0].asnumpy(),
+                               [2, 2])
