@@ -36,6 +36,9 @@ from . import kvstore as kv  # mx.kv alias
 from . import symbol
 from . import symbol as sym  # mx.sym alias
 from . import io
+from . import recordio
+from . import image
+from . import profiler
 from . import model
 from . import callback
 from . import monitor

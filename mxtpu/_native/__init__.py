@@ -1,0 +1,96 @@
+"""Native library loader: builds src/*.cc into one shared object on first use.
+
+The reference ships libmxnet.so built by CMake; here the native surface is
+small enough to compile on demand with g++ (cached by source mtime) and bound
+via ctypes — the framework's FFI convention (no pybind11 in the image).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LOCK = threading.Lock()
+_LIB = None
+_BUILD_ERR = None
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC_DIR = os.path.normpath(os.path.join(_HERE, "..", "..", "src"))
+_SO_PATH = os.path.join(_HERE, "_libmxtpu.so")
+
+
+def _sources():
+    out = []
+    for root, _dirs, files in os.walk(_SRC_DIR):
+        for f in sorted(files):
+            if f.endswith(".cc"):
+                out.append(os.path.join(root, f))
+    return out
+
+
+def _needs_build(sources):
+    if not os.path.exists(_SO_PATH):
+        return True
+    so_mtime = os.path.getmtime(_SO_PATH)
+    return any(os.path.getmtime(s) > so_mtime for s in sources)
+
+
+def _build(sources):
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", _SO_PATH] + sources
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError("native build failed:\n%s" % proc.stderr)
+
+
+def get_lib():
+    """Return the ctypes library, building it if needed; None when the
+    toolchain is unavailable (callers fall back to pure python)."""
+    global _LIB, _BUILD_ERR
+    if _LIB is not None or _BUILD_ERR is not None:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _BUILD_ERR is not None:
+            return _LIB
+        try:
+            sources = _sources()
+            if not sources:
+                raise RuntimeError("no native sources under %s" % _SRC_DIR)
+            if _needs_build(sources):
+                _build(sources)
+            lib = ctypes.CDLL(_SO_PATH)
+            _configure(lib)
+            _LIB = lib
+        except Exception as e:  # noqa: BLE001 - any failure => python fallback
+            _BUILD_ERR = e
+    return _LIB
+
+
+def build_error():
+    return _BUILD_ERR
+
+
+def _configure(lib):
+    u64 = ctypes.c_uint64
+    lib.mxtpu_recordio_writer_create.restype = ctypes.c_void_p
+    lib.mxtpu_recordio_writer_create.argtypes = [ctypes.c_char_p,
+                                                 ctypes.c_char_p]
+    lib.mxtpu_recordio_writer_write.restype = ctypes.c_int
+    lib.mxtpu_recordio_writer_write.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_char_p, u64]
+    lib.mxtpu_recordio_writer_tell.restype = u64
+    lib.mxtpu_recordio_writer_tell.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_recordio_writer_close.restype = None
+    lib.mxtpu_recordio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_recordio_reader_create.restype = ctypes.c_void_p
+    lib.mxtpu_recordio_reader_create.argtypes = [ctypes.c_char_p]
+    lib.mxtpu_recordio_reader_read.restype = ctypes.POINTER(ctypes.c_char)
+    lib.mxtpu_recordio_reader_read.argtypes = [ctypes.c_void_p,
+                                               ctypes.POINTER(u64)]
+    lib.mxtpu_recordio_reader_seek.restype = None
+    lib.mxtpu_recordio_reader_seek.argtypes = [ctypes.c_void_p, u64]
+    lib.mxtpu_recordio_reader_tell.restype = u64
+    lib.mxtpu_recordio_reader_tell.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_recordio_reader_close.restype = None
+    lib.mxtpu_recordio_reader_close.argtypes = [ctypes.c_void_p]

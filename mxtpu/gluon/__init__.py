@@ -9,4 +9,5 @@ from . import trainer  # noqa: F401
 from .trainer import Trainer  # noqa: F401
 from . import utils  # noqa: F401
 from .utils import split_and_load, split_data, clip_global_norm  # noqa: F401
+from . import data  # noqa: F401
 from . import model_zoo  # noqa: F401

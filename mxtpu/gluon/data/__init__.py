@@ -1,0 +1,9 @@
+"""Gluon data API (ref: python/mxnet/gluon/data/)."""
+from .dataset import (ArrayDataset, Dataset, RecordFileDataset, SimpleDataset)
+from .sampler import (BatchSampler, RandomSampler, Sampler, SequentialSampler)
+from .dataloader import DataLoader
+from . import vision
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset",
+           "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
+           "DataLoader", "vision"]

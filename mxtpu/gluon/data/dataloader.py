@@ -1,0 +1,127 @@
+"""DataLoader (ref: python/mxnet/gluon/data/dataloader.py).
+
+TPU-native notes: the reference forks multiprocessing workers that decode into
+shared-memory NDArrays; here workers are a thread pool (decode/augment release
+the GIL inside numpy/jax) feeding a bounded prefetch queue, and the batch
+crosses to the device once at the jit boundary. The `num_workers` /
+`batchify_fn` / sampler surface is unchanged.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+
+import numpy as np
+
+from ...ndarray import NDArray, array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (ref: dataloader.py:default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        from ...ndarray import stack
+        return stack(*data)
+    if isinstance(data[0], tuple):
+        transposed = list(zip(*data))
+        return [default_batchify_fn(list(x)) for x in transposed]
+    data = np.asarray(data)
+    return array(data)
+
+
+class DataLoader:
+    """Iterate a Dataset in mini-batches (ref: dataloader.py:DataLoader)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size is required when batch_sampler "
+                                 "is not specified")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must be False with a sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                "batch_size/shuffle/sampler/last_batch must not be set "
+                "when batch_sampler is specified")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _load(self, batch_idx):
+        return self._batchify_fn([self._dataset[i] for i in batch_idx])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch_idx in self._batch_sampler:
+                yield self._load(batch_idx)
+            return
+
+        # thread-pool pipeline with ordered delivery
+        batches = list(self._batch_sampler)
+        results = {}
+        results_lock = threading.Lock()
+        results_ready = threading.Condition(results_lock)
+        work = _queue.Queue()
+        for i, b in enumerate(batches):
+            work.put((i, b))
+        stop = threading.Event()
+
+        bound = max(self._prefetch, self._num_workers, 1)
+        state = {"next": 0}  # next batch index the consumer will take
+
+        def worker():
+            while not stop.is_set():
+                try:
+                    i, b = work.get_nowait()
+                except _queue.Empty:
+                    return
+                # bounded prefetch: never decode more than `bound` batches
+                # ahead of the consumer (reference: dataloader prefetch).
+                # Throttling on distance-from-consumer (not on len(results))
+                # cannot block the batch the consumer needs next.
+                with results_ready:
+                    while i > state["next"] + bound and not stop.is_set():
+                        results_ready.wait(0.1)
+                if stop.is_set():
+                    return
+                try:
+                    out = self._load(b)
+                except Exception as e:  # surfaced at delivery
+                    out = e
+                with results_ready:
+                    results[i] = out
+                    results_ready.notify_all()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self._num_workers)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(len(batches)):
+                with results_ready:
+                    while i not in results:
+                        results_ready.wait()
+                    out = results.pop(i)
+                    state["next"] = i + 1
+                    results_ready.notify_all()  # release throttled workers
+                if isinstance(out, Exception):
+                    raise out
+                yield out
+        finally:
+            stop.set()

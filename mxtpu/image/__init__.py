@@ -1,0 +1,14 @@
+"""mx.image: image IO + augmentation pipeline (ref: python/mxnet/image/)."""
+from .image import (imread, imdecode, imresize, fixed_crop, center_crop,
+                    random_crop, resize_short, color_normalize, ImageIter,
+                    CreateAugmenter, Augmenter, ResizeAug, ForceResizeAug,
+                    RandomCropAug, CenterCropAug, HorizontalFlipAug, CastAug,
+                    ColorNormalizeAug, BrightnessJitterAug, ContrastJitterAug,
+                    SaturationJitterAug)
+
+__all__ = ["imread", "imdecode", "imresize", "fixed_crop", "center_crop",
+           "random_crop", "resize_short", "color_normalize", "ImageIter",
+           "CreateAugmenter", "Augmenter", "ResizeAug", "ForceResizeAug",
+           "RandomCropAug", "CenterCropAug", "HorizontalFlipAug", "CastAug",
+           "ColorNormalizeAug", "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug"]

@@ -20,6 +20,7 @@ for _name, _op in _ops.REGISTRY.items():
 from ..ops.init_ops import arange, empty, eye, full, linspace, ones, zeros  # noqa: E402,F401
 from .utils import load, save  # noqa: E402,F401
 from . import random  # noqa: E402,F401
+from . import image  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from .sparse import CSRNDArray, RowSparseNDArray  # noqa: E402,F401
 

@@ -1,0 +1,14 @@
+"""mx.nd.image namespace (ref: mx.nd.image generated from the _image_* ops,
+src/operator/image/)."""
+from ..ops import registry as _reg
+
+_NAMES = ["to_tensor", "normalize", "resize", "crop", "center_crop",
+          "flip_left_right", "flip_top_bottom", "random_flip_left_right",
+          "random_flip_top_bottom", "brightness", "contrast", "saturation",
+          "hue"]
+
+for _n in _NAMES:
+    globals()[_n] = _reg.get_op("_image_" + _n).wrapper
+del _n
+
+__all__ = list(_NAMES)

@@ -67,7 +67,17 @@ def _apply(fn, args, kwargs=None, name="", num_outputs=None):
             out = fn(*args, **kwargs)
             return tuple(out) if isinstance(out, list) else out
 
-    out_data = pure_fn(*[x._data for x in inputs])
+    global _profiler
+    if _profiler is None:
+        from .. import profiler as _profiler
+    if _profiler._PROF.active:
+        import time as _time
+        _t0 = _time.perf_counter_ns()
+        out_data = pure_fn(*[x._data for x in inputs])
+        _profiler.record_event(name or "op", "operator", _t0 // 1000,
+                               (_time.perf_counter_ns() - _t0) // 1000)
+    else:
+        out_data = pure_fn(*[x._data for x in inputs])
     if isinstance(out_data, (tuple, list)):
         outputs = [NDArray(d) for d in out_data]
         if autograd.is_recording():
@@ -82,6 +92,7 @@ def _apply(fn, args, kwargs=None, name="", num_outputs=None):
 
 
 _sym_tape = None  # resolved lazily once; avoids import cost on the hot path
+_profiler = None  # same lazy-resolution pattern for the profiler hook
 
 
 def _maybe_record_symbol(name, args, kwargs, inputs, outputs):

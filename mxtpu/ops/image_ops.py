@@ -1,0 +1,167 @@
+"""Image ops: decode-side tensor transforms.
+
+Reference: ``src/operator/image/image_random-inl.h`` (to_tensor, normalize,
+random flips/brightness/contrast/saturation/hue/lighting) and ``mx.image``
+resize/crop kernels (python/mxnet/image/image.py over OpenCV).
+
+TPU-native notes: everything is pure jnp so transforms fuse into the input
+pipeline under jit; resize lowers to ``jax.image.resize`` (XLA gather/matmul)
+instead of OpenCV. Layout convention follows the reference: HWC uint8/float
+in, ``to_tensor`` produces CHW float32.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from .. import random as _random
+
+__all__ = ["image_to_tensor", "image_normalize", "image_resize",
+           "image_crop", "image_center_crop", "image_flip_left_right",
+           "image_flip_top_bottom", "image_random_flip_left_right",
+           "image_random_flip_top_bottom", "image_brightness",
+           "image_contrast", "image_saturation", "image_hue"]
+
+_LUMA = (0.299, 0.587, 0.114)
+
+
+@register("_image_to_tensor", aliases=("image_to_tensor",))
+def image_to_tensor(data):
+    """HWC [0,255] -> CHW [0,1] float32 (ref: image_random-inl.h ToTensor).
+    Batched NHWC input becomes NCHW."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("_image_normalize", aliases=("image_normalize",))
+def image_normalize(data, mean=0.0, std=1.0):
+    """Channel-wise (x - mean) / std on CHW input (ref: Normalize)."""
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    if data.ndim == 3:   # CHW
+        mean = mean.reshape((-1, 1, 1)) if mean.ndim else mean
+        std = std.reshape((-1, 1, 1)) if std.ndim else std
+    else:                # NCHW
+        mean = mean.reshape((1, -1, 1, 1)) if mean.ndim else mean
+        std = std.reshape((1, -1, 1, 1)) if std.ndim else std
+    return (data.astype(jnp.float32) - mean) / std
+
+
+@register("_image_resize", aliases=("image_resize",))
+def image_resize(data, size=None, keep_ratio=False, interp=1):
+    """Resize HWC (or NHWC) images (ref: mx.image.imresize). interp: 0=nearest,
+    1=bilinear, 2=bicubic (maps to jax.image methods)."""
+    method = {0: "nearest", 1: "linear", 2: "cubic"}.get(int(interp), "linear")
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = size  # reference convention: size=(w, h)
+    if data.ndim == 3:
+        out_shape = (h, w, data.shape[2])
+    else:
+        out_shape = (data.shape[0], h, w, data.shape[3])
+    out = jax.image.resize(data.astype(jnp.float32), out_shape, method=method)
+    return out.astype(data.dtype) if jnp.issubdtype(data.dtype, jnp.integer) \
+        else out
+
+
+@register("_image_crop", aliases=("image_crop",))
+def image_crop(data, x=0, y=0, width=None, height=None):
+    """Fixed crop of HWC/NHWC (ref: mx.image.fixed_crop)."""
+    if data.ndim == 3:
+        return data[y:y + height, x:x + width, :]
+    return data[:, y:y + height, x:x + width, :]
+
+
+@register("_image_center_crop", aliases=("image_center_crop",))
+def image_center_crop(data, size=None):
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = size
+    H, W = (data.shape[0], data.shape[1]) if data.ndim == 3 \
+        else (data.shape[1], data.shape[2])
+    y = max((H - h) // 2, 0)
+    x = max((W - w) // 2, 0)
+    return _crop_raw(data, x, y, w, h)
+
+
+def _crop_raw(data, x, y, w, h):
+    if data.ndim == 3:
+        return data[y:y + h, x:x + w, :]
+    return data[:, y:y + h, x:x + w, :]
+
+
+@register("_image_flip_left_right", aliases=("image_flip_left_right",))
+def image_flip_left_right(data):
+    axis = 1 if data.ndim == 3 else 2
+    return jnp.flip(data, axis=axis)
+
+
+@register("_image_flip_top_bottom", aliases=("image_flip_top_bottom",))
+def image_flip_top_bottom(data):
+    axis = 0 if data.ndim == 3 else 1
+    return jnp.flip(data, axis=axis)
+
+
+@register("_image_random_flip_left_right",
+          aliases=("image_random_flip_left_right",))
+def image_random_flip_left_right(data, p=0.5):
+    key = _random.next_key()
+    flip = jax.random.bernoulli(key, p)
+    axis = 1 if data.ndim == 3 else 2
+    return jnp.where(flip, jnp.flip(data, axis=axis), data)
+
+
+@register("_image_random_flip_top_bottom",
+          aliases=("image_random_flip_top_bottom",))
+def image_random_flip_top_bottom(data, p=0.5):
+    key = _random.next_key()
+    flip = jax.random.bernoulli(key, p)
+    axis = 0 if data.ndim == 3 else 1
+    return jnp.where(flip, jnp.flip(data, axis=axis), data)
+
+
+def _blend(a, b, alpha):
+    return a.astype(jnp.float32) * alpha + b * (1.0 - alpha)
+
+
+@register("_image_brightness", aliases=("image_brightness",))
+def image_brightness(data, alpha=1.0):
+    return _blend(data, 0.0, alpha).astype(jnp.float32)
+
+
+@register("_image_contrast", aliases=("image_contrast",))
+def image_contrast(data, alpha=1.0):
+    coef = jnp.asarray(_LUMA, jnp.float32)
+    c_axis = -1  # HWC / NHWC
+    gray = jnp.sum(data.astype(jnp.float32) * coef, axis=c_axis, keepdims=True)
+    mean = jnp.mean(gray, axis=(-3, -2), keepdims=True)
+    return _blend(data, mean, alpha)
+
+
+@register("_image_saturation", aliases=("image_saturation",))
+def image_saturation(data, alpha=1.0):
+    coef = jnp.asarray(_LUMA, jnp.float32)
+    gray = jnp.sum(data.astype(jnp.float32) * coef, axis=-1, keepdims=True)
+    return _blend(data, gray, alpha)
+
+
+@register("_image_hue", aliases=("image_hue",))
+def image_hue(data, alpha=0.0):
+    """Approximate hue rotation via the YIQ rotation matrix
+    (ref: image_random-inl.h RandomHue's yiq transform)."""
+    u = jnp.cos(alpha * jnp.pi)
+    w = jnp.sin(alpha * jnp.pi)
+    t_yiq = jnp.asarray([[0.299, 0.587, 0.114],
+                         [0.596, -0.274, -0.321],
+                         [0.211, -0.523, 0.311]], jnp.float32)
+    t_rgb = jnp.asarray([[1.0, 0.956, 0.621],
+                         [1.0, -0.272, -0.647],
+                         [1.0, -1.107, 1.705]], jnp.float32)
+    rot = jnp.asarray([[1.0, 0.0, 0.0],
+                       [0.0, u, -w],
+                       [0.0, w, u]], jnp.float32)
+    m = t_rgb @ rot @ t_yiq
+    return jnp.einsum("...c,dc->...d", data.astype(jnp.float32), m)

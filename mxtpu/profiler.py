@@ -1,0 +1,163 @@
+"""mx.profiler: op-level profiling with chrome://tracing output.
+
+Reference: ``python/mxnet/profiler.py:33-291`` over the C++ profiler
+(src/profiler/profiler.h — per-op events incl. engine queue time, chrome-trace
+JSON dump, aggregate stats tables).
+
+TPU-native re-design: eager op events are timed at the dispatch boundary
+(ndarray._apply); compiled regions are one event per executable call — the
+inside of a jit step is XLA's domain, so ``profile_xla=True`` additionally
+starts the JAX/XLA profiler (TensorBoard trace with per-HLO timing), replacing
+the reference's engine-level instrumentation. Dump format is chrome://tracing
+JSON, same as the reference, plus ``aggregate_stats`` tables.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import defaultdict
+
+__all__ = ["set_config", "start", "stop", "pause", "resume", "dump", "dumps",
+           "ProfileTask", "ProfileFrame", "ProfileEvent", "ProfileScope",
+           "scope"]
+
+class _Profiler:
+    def __init__(self):
+        self.active = False
+        self.events = []          # (name, cat, ts_us, dur_us, tid)
+        self.lock = threading.Lock()
+        self.filename = "profile.json"
+        self.aggregate = True
+        self.profile_xla = False
+        self._xla_dir = None
+
+
+_PROF = _Profiler()
+
+
+def set_config(filename="profile.json", profile_all=False,
+               profile_symbolic=True, profile_imperative=True,
+               profile_memory=False, profile_api=False, aggregate_stats=True,
+               profile_xla=False, xla_trace_dir=None, **_kwargs):
+    """(ref: profiler.py:set_config — continuous_dump etc accepted via kwargs)"""
+    _PROF.filename = filename
+    _PROF.aggregate = aggregate_stats
+    _PROF.profile_xla = profile_xla
+    _PROF._xla_dir = xla_trace_dir or (filename + ".xla")
+
+
+def start():
+    """(ref: profiler.py:set_state('run'))"""
+    _PROF.active = True
+    if _PROF.profile_xla:
+        import jax
+        jax.profiler.start_trace(_PROF._xla_dir)
+
+
+def stop():
+    _PROF.active = False
+    if _PROF.profile_xla:
+        import jax
+        jax.profiler.stop_trace()
+
+
+def pause():
+    _PROF.active = False
+
+
+def resume():
+    _PROF.active = True
+
+
+def record_event(name, cat, ts_us, dur_us):
+    """Called from the op dispatch path when profiling is on."""
+    tid = threading.get_ident() & 0xFFFF
+    with _PROF.lock:
+        _PROF.events.append((name, cat, ts_us, dur_us, tid))
+
+
+def is_active():
+    return _PROF.active
+
+
+def dumps(reset=False):
+    """Aggregate statistics table as a string (ref: profiler.py:dumps)."""
+    stats = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
+    with _PROF.lock:
+        events = list(_PROF.events)
+        if reset:
+            _PROF.events.clear()
+    for name, _cat, _ts, dur, _tid in events:
+        s = stats[name]
+        s[0] += 1
+        s[1] += dur
+        s[2] = min(s[2], dur)
+        s[3] = max(s[3], dur)
+    lines = ["%-40s %10s %12s %12s %12s %12s" %
+             ("Name", "Calls", "Total(us)", "Avg(us)", "Min(us)", "Max(us)")]
+    for name in sorted(stats, key=lambda n: -stats[n][1]):
+        cnt, total, mn, mx = stats[name]
+        lines.append("%-40s %10d %12.1f %12.1f %12.1f %12.1f" %
+                     (name, cnt, total, total / cnt, mn, mx))
+    return "\n".join(lines)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome://tracing JSON (ref: profiler.py:dump; C++ emitter
+    src/profiler/profiler.h:256-437)."""
+    with _PROF.lock:
+        events = list(_PROF.events)
+    trace = {"traceEvents": [
+        {"ph": "X", "name": name, "cat": cat, "ts": ts, "dur": dur,
+         "pid": 0, "tid": tid}
+        for name, cat, ts, dur, tid in events]}
+    with open(_PROF.filename, "w") as f:
+        json.dump(trace, f)
+
+
+# ------------------------------------------------------------ user scopes
+class ProfileScope:
+    """Context manager timing a custom region (ref: ProfileTask/Frame/Event,
+    profiler.py:287+)."""
+
+    def __init__(self, name, cat="user"):
+        self.name = name
+        self.cat = cat
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter_ns()
+
+    def stop(self):
+        if self._t0 is None:
+            return
+        dur = (time.perf_counter_ns() - self._t0) // 1000
+        record_event(self.name, self.cat, self._t0 // 1000, dur)
+        self._t0 = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class ProfileTask(ProfileScope):
+    def __init__(self, name, domain=None):
+        super().__init__(name, cat="task")
+
+
+class ProfileFrame(ProfileScope):
+    def __init__(self, name, domain=None):
+        super().__init__(name, cat="frame")
+
+
+class ProfileEvent(ProfileScope):
+    def __init__(self, name):
+        super().__init__(name, cat="event")
+
+
+def scope(name, cat="user"):
+    return ProfileScope(name, cat)

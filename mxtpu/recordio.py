@@ -1,0 +1,309 @@
+"""mx.recordio: RecordIO file API.
+
+Reference: ``python/mxnet/recordio.py`` — MXRecordIO / MXIndexedRecordIO over
+the dmlc recordio C++ reader, plus pack/unpack(+_img) helpers with the IRHeader
+struct.
+
+TPU-native: the C++ backend lives in src/io/recordio.cc (compiled on demand,
+ctypes-bound); a pure-python implementation of the same wire format is the
+fallback so the API never hard-depends on the toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+from ._native import get_lib
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+           "pack", "unpack", "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+_MAGIC_BYTES = struct.pack("<I", _MAGIC)
+
+
+# --------------------------------------------------------- python fallback
+class _PyWriter:
+    def __init__(self, path, mode):
+        self._f = open(path, mode)
+
+    def write(self, data):
+        cuts = [i for i in range(0, len(data) - 3, 4)
+                if data[i:i + 4] == _MAGIC_BYTES]
+        if not cuts:
+            self._chunk(0, data)
+            return
+        begin = 0
+        for c, end in enumerate(cuts + [len(data)]):
+            cflag = 1 if c == 0 else (3 if end == len(data) else 2)
+            self._chunk(cflag, data[begin:end])
+            begin = end + 4
+
+    def _chunk(self, cflag, data):
+        lrec = (cflag << 29) | len(data)
+        self._f.write(_MAGIC_BYTES)
+        self._f.write(struct.pack("<I", lrec))
+        self._f.write(data)
+        pad = (4 - (len(data) & 3)) & 3
+        self._f.write(b"\x00" * pad)
+
+    def tell(self):
+        return self._f.tell()
+
+    def close(self):
+        self._f.close()
+
+
+class _PyReader:
+    def __init__(self, path):
+        self._f = open(path, "rb")
+
+    def read(self):
+        out = b""
+        started = False
+        while True:
+            head = self._f.read(8)
+            if len(head) < 8:
+                return None
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _MAGIC:
+                return None
+            length, cflag = lrec & ((1 << 29) - 1), lrec >> 29
+            data = self._f.read(length)
+            pad = (4 - (length & 3)) & 3
+            if pad:
+                self._f.read(pad)
+            out += data
+            if cflag == 0 or cflag == 3:
+                return out
+            if cflag == 1:
+                started = True
+            elif not started:
+                return None
+            out += _MAGIC_BYTES  # re-insert elided magic between chunks
+
+    def seek(self, pos):
+        self._f.seek(pos)
+
+    def tell(self):
+        return self._f.tell()
+
+    def close(self):
+        self._f.close()
+
+
+# ----------------------------------------------------------------- MXRecordIO
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (ref: recordio.py:MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        lib = get_lib()
+        self._lib = lib
+        if self.flag == "w":
+            if lib is not None:
+                self.handle = lib.mxtpu_recordio_writer_create(
+                    self.uri.encode(), b"wb")
+                if not self.handle:
+                    raise MXNetError("cannot open %s" % self.uri)
+            else:
+                self.handle = _PyWriter(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            if lib is not None:
+                self.handle = lib.mxtpu_recordio_reader_create(
+                    self.uri.encode())
+                if not self.handle:
+                    raise MXNetError("cannot open %s" % self.uri)
+            else:
+                self.handle = _PyReader(self.uri)
+            self.writable = False
+        else:
+            raise MXNetError("invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self._lib is not None:
+            if self.writable:
+                self._lib.mxtpu_recordio_writer_close(self.handle)
+            else:
+                self._lib.mxtpu_recordio_reader_close(self.handle)
+        else:
+            self.handle.close()
+        self.is_open = False
+        self.handle = None
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        """Pickling support for multi-worker loaders (ref: recordio.py)."""
+        d = dict(self.__dict__)
+        d["handle"] = None
+        d["_lib"] = None
+        d["is_open"] = False
+        d.pop("_rw_lock", None)  # locks don't pickle; recreated in __setstate__
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        if hasattr(self, "idx_path"):
+            import threading
+            self._rw_lock = threading.Lock()
+        self.open()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        if self._lib is not None:
+            rc = self._lib.mxtpu_recordio_writer_write(
+                self.handle, bytes(buf), len(buf))
+            if rc != 0:
+                raise MXNetError("write failed on %s" % self.uri)
+        else:
+            self.handle.write(bytes(buf))
+
+    def read(self):
+        assert not self.writable
+        if self._lib is not None:
+            n = ctypes.c_uint64()
+            ptr = self._lib.mxtpu_recordio_reader_read(
+                self.handle, ctypes.byref(n))
+            if not ptr:
+                return None
+            return ctypes.string_at(ptr, n.value)
+        return self.handle.read()
+
+    def tell(self):
+        if self._lib is not None:
+            if self.writable:
+                return int(self._lib.mxtpu_recordio_writer_tell(self.handle))
+            return int(self._lib.mxtpu_recordio_reader_tell(self.handle))
+        return self.handle.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Keyed random access via a .idx sidecar (ref: recordio.py:
+    MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        import threading
+        # seek+read must be atomic: thread-pool DataLoader workers share this
+        # handle (the reference instead forks a process per worker)
+        self._rw_lock = threading.Lock()
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 2:
+                        continue
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self.writable:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write("%s\t%d\n" % (str(key), self.idx[key]))
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        pos = self.idx[idx]
+        if self._lib is not None:
+            self._lib.mxtpu_recordio_reader_seek(self.handle, pos)
+        else:
+            self.handle.seek(pos)
+
+    def read_idx(self, idx):
+        with self._rw_lock:
+            self.seek(idx)
+            return self.read()
+
+    def write_idx(self, idx, buf):
+        assert self.writable
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.keys.append(key)
+        self.idx[key] = pos
+
+
+# ------------------------------------------------------------- pack helpers
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a header + raw bytes (ref: recordio.py:pack). flag>0 means the
+    label is a float array of that length stored before the payload."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (np.ndarray, list, tuple)):
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    return struct.pack(_IR_FORMAT, *header) + s
+
+
+def unpack(s):
+    """Inverse of pack: returns (IRHeader, payload bytes)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """JPEG/PNG-encode an image and pack (ref: recordio.py:pack_img)."""
+    import cv2
+    encode_params = None
+    if img_fmt.lower() in (".jpg", ".jpeg"):
+        encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+    elif img_fmt.lower() == ".png":
+        encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+    ret, buf = cv2.imencode(img_fmt, img, encode_params)
+    if not ret:
+        raise MXNetError("failed to encode image")
+    return pack(header, buf.tobytes())
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack + decode an image record (ref: recordio.py:unpack_img).
+    Returns (IRHeader, HWC BGR ndarray like the reference's cv2 convention)."""
+    import cv2
+    header, s = unpack(s)
+    img = cv2.imdecode(np.frombuffer(s, dtype=np.uint8), iscolor)
+    return header, img

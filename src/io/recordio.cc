@@ -1,0 +1,156 @@
+// RecordIO: the framework's packed-record container format.
+//
+// Wire-format parity with the reference's dmlc-core recordio (used by
+// ImageRecordIter, SURVEY §2.4; format described in
+// docs/architecture/note_data_loading.md): stream of
+//   [kMagic:4B][lrec:4B][data: ceil(len/4)*4 B]
+// where lrec's upper 3 bits are a continuation flag and lower 29 bits the
+// chunk length. Payloads containing the magic word at 4-byte alignment are
+// split at those points (the magic bytes are elided and re-inserted on read),
+// which keeps the stream resynchronizable at arbitrary offsets — the property
+// distributed shard readers (part_index/num_parts) rely on.
+//
+// This is a from-scratch implementation of the format, not a copy: plain
+// stdio, one in-memory buffer per reader, C ABI for ctypes (the framework's
+// FFI convention, no pybind11).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+inline uint32_t EncodeLRec(uint32_t cflag, uint32_t len) {
+  return (cflag << 29) | (len & kLenMask);
+}
+inline uint32_t DecodeFlag(uint32_t lrec) { return lrec >> 29; }
+inline uint32_t DecodeLen(uint32_t lrec) { return lrec & kLenMask; }
+
+struct Writer {
+  FILE* f = nullptr;
+};
+
+struct Reader {
+  FILE* f = nullptr;
+  std::string buf;  // last assembled record, returned to the caller
+};
+
+int WriteChunk(FILE* f, uint32_t cflag, const char* data, uint32_t len) {
+  uint32_t magic = kMagic;
+  uint32_t lrec = EncodeLRec(cflag, len);
+  if (fwrite(&magic, 4, 1, f) != 1) return -1;
+  if (fwrite(&lrec, 4, 1, f) != 1) return -1;
+  if (len && fwrite(data, 1, len, f) != len) return -1;
+  uint32_t pad = (4 - (len & 3)) & 3;
+  const char zeros[4] = {0, 0, 0, 0};
+  if (pad && fwrite(zeros, 1, pad, f) != pad) return -1;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* mxtpu_recordio_writer_create(const char* path, const char* mode) {
+  FILE* f = fopen(path, mode);
+  if (!f) return nullptr;
+  Writer* w = new Writer();
+  w->f = f;
+  return w;
+}
+
+// Split the payload at aligned magic occurrences; elide the magic bytes.
+int mxtpu_recordio_writer_write(void* h, const char* data, uint64_t len) {
+  Writer* w = static_cast<Writer*>(h);
+  std::vector<uint64_t> cuts;  // offsets of elided magic words
+  for (uint64_t i = 0; i + 4 <= len; i += 4) {
+    uint32_t word;
+    std::memcpy(&word, data + i, 4);
+    if (word == kMagic) cuts.push_back(i);
+  }
+  if (cuts.empty()) {
+    return WriteChunk(w->f, 0, data, static_cast<uint32_t>(len));
+  }
+  uint64_t begin = 0;
+  for (size_t c = 0; c <= cuts.size(); ++c) {
+    uint64_t end = (c < cuts.size()) ? cuts[c] : len;
+    uint32_t cflag = (c == 0) ? 1u : (c == cuts.size()) ? 3u : 2u;
+    if (WriteChunk(w->f, cflag, data + begin,
+                   static_cast<uint32_t>(end - begin)) != 0)
+      return -1;
+    begin = end + 4;  // skip the elided magic word
+  }
+  return 0;
+}
+
+uint64_t mxtpu_recordio_writer_tell(void* h) {
+  return static_cast<uint64_t>(ftell(static_cast<Writer*>(h)->f));
+}
+
+void mxtpu_recordio_writer_close(void* h) {
+  Writer* w = static_cast<Writer*>(h);
+  if (w->f) fclose(w->f);
+  delete w;
+}
+
+void* mxtpu_recordio_reader_create(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  Reader* r = new Reader();
+  r->f = f;
+  return r;
+}
+
+// Returns pointer to an internal buffer valid until the next call;
+// nullptr at EOF or on a malformed stream.
+const char* mxtpu_recordio_reader_read(void* h, uint64_t* out_len) {
+  Reader* r = static_cast<Reader*>(h);
+  r->buf.clear();
+  bool in_continuation = false;
+  while (true) {
+    uint32_t magic, lrec;
+    if (fread(&magic, 4, 1, r->f) != 1) return nullptr;  // EOF
+    if (magic != kMagic) return nullptr;                 // lost sync
+    if (fread(&lrec, 4, 1, r->f) != 1) return nullptr;
+    uint32_t len = DecodeLen(lrec), cflag = DecodeFlag(lrec);
+    size_t off = r->buf.size();
+    r->buf.resize(off + len);
+    if (len && fread(&r->buf[off], 1, len, r->f) != len) return nullptr;
+    uint32_t pad = (4 - (len & 3)) & 3;
+    if (pad && fseek(r->f, pad, SEEK_CUR) != 0) return nullptr;
+    if (cflag == 0) break;
+    if (cflag == 1) {
+      in_continuation = true;
+    } else if (!in_continuation) {
+      return nullptr;  // middle/end without a start
+    }
+    if (cflag == 3) break;
+    // re-insert the elided magic between chunks
+    char m[4];
+    std::memcpy(m, &magic, 4);
+    r->buf.append(m, 4);
+  }
+  *out_len = r->buf.size();
+  return r->buf.data();
+}
+
+void mxtpu_recordio_reader_seek(void* h, uint64_t pos) {
+  fseek(static_cast<Reader*>(h)->f, static_cast<long>(pos), SEEK_SET);
+}
+
+uint64_t mxtpu_recordio_reader_tell(void* h) {
+  return static_cast<uint64_t>(ftell(static_cast<Reader*>(h)->f));
+}
+
+void mxtpu_recordio_reader_close(void* h) {
+  Reader* r = static_cast<Reader*>(h);
+  if (r->f) fclose(r->f);
+  delete r;
+}
+
+}  // extern "C"

@@ -1,0 +1,43 @@
+"""Profiler tests (ref pattern: tests/python/unittest/test_profiler.py)."""
+import json
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import profiler
+
+
+def test_profiler_records_ops_and_dumps(tmp_path):
+    fname = str(tmp_path / "trace.json")
+    profiler.set_config(filename=fname)
+    profiler.start()
+    a = mx.nd.ones((32, 32))
+    b = mx.nd.dot(a, a)
+    (b + 1).asnumpy()
+    profiler.stop()
+    profiler.dump()
+    with open(fname) as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert any("dot" in n for n in names), names
+    assert all(e["ph"] == "X" for e in trace["traceEvents"])
+    stats = profiler.dumps()
+    assert "Calls" in stats
+
+
+def test_profiler_scopes(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "t.json"))
+    profiler.start()
+    with profiler.ProfileTask("mytask"):
+        mx.nd.ones((4,)).asnumpy()
+    profiler.stop()
+    stats = profiler.dumps(reset=True)
+    assert "mytask" in stats
+
+
+def test_profiler_off_records_nothing(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "t2.json"))
+    profiler.dumps(reset=True)
+    mx.nd.ones((4,)).asnumpy()
+    stats = profiler.dumps()
+    assert "ones" not in stats

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""im2rec: pack an image folder / .lst file into RecordIO shards.
+
+Reference: ``tools/im2rec.py`` (and the C++ tools/im2rec.cc). Usage parity for
+the common flows:
+
+  python tools/im2rec.py --list prefix image_root   # build prefix.lst
+  python tools/im2rec.py prefix image_root          # pack prefix.rec/.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def list_images(root, recursive, exts):
+    cat = {}
+    for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+        dirs.sort()
+        files.sort()
+        for fname in files:
+            fpath = os.path.join(path, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in exts:
+                label_dir = os.path.relpath(path, root)
+                if label_dir not in cat:
+                    cat[label_dir] = len(cat)
+                yield os.path.relpath(fpath, root), cat[label_dir]
+        if not recursive:
+            break
+
+
+def write_list(prefix, root, args):
+    entries = list(list_images(root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(entries)
+    n_total = len(entries)
+    chunk = n_total // args.chunks
+    for i in range(args.chunks):
+        name = prefix + ("_%d" % i if args.chunks > 1 else "") + ".lst"
+        with open(name, "w") as f:
+            for j, (path, label) in enumerate(
+                    entries[i * chunk:(i + 1) * chunk
+                            if i + 1 < args.chunks else n_total]):
+                f.write("%d\t%f\t%s\n" % (i * chunk + j, label, path))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(prefix, root, args):
+    import cv2
+    import numpy as np
+    from mxtpu import recordio
+
+    lst = prefix + ".lst"
+    if not os.path.exists(lst):
+        write_list(prefix, root, args)
+    w = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, label, rel_path in read_list(lst):
+        img = cv2.imread(os.path.join(root, rel_path), args.color)
+        if img is None:
+            print("imread failed for %s, skipping" % rel_path)
+            continue
+        if args.resize:
+            h, w_ = img.shape[:2]
+            if min(h, w_) > args.resize:
+                scale = args.resize / min(h, w_)
+                img = cv2.resize(img, (int(w_ * scale), int(h * scale)))
+        header = recordio.IRHeader(
+            0, label[0] if len(label) == 1 else np.asarray(label, np.float32),
+            idx, 0)
+        w.write_idx(idx, recordio.pack_img(
+            header, img, quality=args.quality, img_fmt=args.encoding))
+        count += 1
+    w.close()
+    print("packed %d images into %s.rec" % (count, prefix))
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("prefix")
+    parser.add_argument("root")
+    parser.add_argument("--list", action="store_true",
+                        help="only build the .lst file")
+    parser.add_argument("--exts", nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    parser.add_argument("--chunks", type=int, default=1)
+    parser.add_argument("--recursive", action=argparse.BooleanOptionalAction,
+                        default=True)
+    parser.add_argument("--shuffle", action=argparse.BooleanOptionalAction,
+                        default=True)
+    parser.add_argument("--resize", type=int, default=0)
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--encoding", default=".jpg")
+    parser.add_argument("--color", type=int, default=1)
+    args = parser.parse_args()
+    if args.list:
+        write_list(args.prefix, args.root, args)
+    else:
+        pack(args.prefix, args.root, args)
+
+
+if __name__ == "__main__":
+    main()
