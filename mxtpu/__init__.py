@@ -47,5 +47,6 @@ from . import module
 from . import module as mod  # mx.mod alias
 from .module import Module
 from . import gluon
+from . import rnn
 from . import parallel
 from . import test_utils

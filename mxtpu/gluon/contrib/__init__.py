@@ -1,0 +1,5 @@
+"""Gluon contrib (ref: python/mxnet/gluon/contrib/)."""
+from . import nn
+from . import rnn
+
+__all__ = ["nn", "rnn"]

@@ -1,0 +1,83 @@
+"""Contrib layers (ref: python/mxnet/gluon/contrib/nn/basic_layers.py)."""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ...nn import BatchNorm, HybridSequential, Embedding
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm"]
+
+
+class Concurrent(HybridSequential):
+    """Run children on the same input, concat outputs (ref: Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(Concurrent):
+    pass
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(HybridBlock):
+    """Embedding backed by row_sparse gradients (ref: SparseEmbedding).
+
+    TPU note: gradients stay dense under jit (XLA scatter-add); the
+    row_sparse benefit of the reference (PS bandwidth) is subsumed by the
+    collective data plane, so this is API parity over the same Embedding op.
+    """
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim,
+                        "dtype": dtype, "sparse_grad": True}
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim), dtype=dtype,
+                init=weight_initializer, grad_stype="row_sparse")
+
+    def hybrid_forward(self, F, x, weight):
+        return F.Embedding(x, weight, **{k: v for k, v in self._kwargs.items()
+                                         if k != "sparse_grad"})
+
+    def __repr__(self):
+        return "SparseEmbedding({input_dim} -> {output_dim}, {dtype})".format(
+            **self._kwargs)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm (ref: contrib SyncBatchNorm over
+    src/operator/contrib/sync_batch_norm.cc — a barrier/broadcast protocol
+    across GPU workers).
+
+    TPU-native: inside a jitted sharded step, batch statistics are GLOBAL
+    means over the full (mesh-sharded) batch automatically — GSPMD inserts the
+    cross-replica reduction, so plain BatchNorm *is* SyncBatchNorm on the
+    mesh. Kept as a distinct class for API parity; `num_devices` is accepted
+    and ignored.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=running_variance_initializer,
+                         in_channels=in_channels, **kwargs)

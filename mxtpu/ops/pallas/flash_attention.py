@@ -1,0 +1,236 @@
+"""Flash attention: fused online-softmax attention for TPU.
+
+No reference counterpart (the reference predates flash attention; its only
+attention helper is ``_contrib_div_sqrt_dim``, src/operator/contrib/
+transformer.cc). This is the single-chip hot path under
+:func:`mxtpu.parallel.ring_attention.ring_self_attention`'s per-shard compute
+and the model zoo transformer.
+
+Design (TPU-first):
+* forward: one Pallas kernel, grid (batch*heads, Tq/bq, Tk/bk) — the k-block
+  axis is innermost so the online-softmax state (m, l, acc) lives in VMEM
+  scratch across k steps; the [T, T] score matrix never materializes in HBM.
+  Causal q/k block pairs above the diagonal are skipped (`pl.when`), saving
+  ~half the FLOPs.
+* backward: custom_vjp recomputes probabilities blockwise from the saved
+  log-sum-exp via ``lax.scan`` over k-blocks (flash-attention-2 equations) —
+  memory stays O(T*D), no Pallas needed since the MXU work is plain matmuls
+  XLA already schedules well.
+* fallback: non-TPU platforms or non-divisible shapes use the XLA softmax
+  path with the same signature.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _xla_attention(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = s.shape[-2:]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+               *, scale, causal, block_q, block_k, n_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: a k block strictly above the q block's diagonal is all-masked
+    run = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _compute():
+        # operands stay in their input dtype (bf16 = single-pass MXU);
+        # accumulation is f32 via preferred_element_type. K arrives
+        # pre-transposed [d, bk] so both matmuls are plain (1,0)
+        # contractions (Mosaic's native MXU form).
+        q = q_ref[0]                              # [bq, d]
+        kt = k_ref[0]                             # [d, bk]
+        vb = v_ref[0]                             # [bk, d]
+        # bf16 inputs: single-pass MXU (DEFAULT) — the global
+        # jax_default_matmul_precision=float32 would request a multi-pass
+        # bf16 contraction Mosaic cannot lower. f32 inputs keep HIGHEST so
+        # reference-parity numerics hold.
+        prec = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
+                else jax.lax.Precision.DEFAULT)
+        s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=prec) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_scr[:, :1]                     # [bq, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                    # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)           # [bq, 1]
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # p cast to the value dtype for a single-pass MXU matmul (standard
+        # flash practice); accumulator stays f32
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        # [bq, 128] lane-replicated (TPU tiling needs a 128 trailing dim);
+        # lane 0 is sliced out on the host side
+        lse_ref[0] = jnp.broadcast_to(
+            m_scr[:, :1] + jnp.log(jnp.maximum(l, 1e-30)), lse_ref.shape[1:])
+
+
+def _fa_forward_pallas(q, k, v, causal, scale, block_q, block_k):
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    bh = b * h
+    q3 = q.reshape(bh, t, d)
+    k3 = jnp.swapaxes(k.reshape(bh, tk, d), 1, 2)  # [bh, d, tk] for the MXU
+    v3 = v.reshape(bh, tk, d)
+    n_q = t // block_q
+    n_k = tk // block_k
+    from jax.experimental.pallas import tpu as pltpu
+    kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, n_k=n_k)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, d, block_k), lambda b_, i, j: (b_, 0, j)),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b_, i, j: (b_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running sum l
+            pltpu.VMEM((block_q, d), jnp.float32),    # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(q3, k3, v3)
+    return out.reshape(b, h, t, d), lse[:, :, 0].reshape(b, h, t)
+
+
+def _fa_backward_blockwise(q, k, v, out, lse, g, causal, scale, block_k):
+    """Flash-attention-2 backward, blockwise over k in plain jax:
+    P = exp(S - lse); dv = P^T g; ds = P * (g v^T - D); dq += ds k; dk += ds^T q.
+    """
+    f32 = jnp.float32
+    q32, k32, v32 = q.astype(f32), k.astype(f32), v.astype(f32)
+    g32, out32 = g.astype(f32), out.astype(f32)
+    t, tk = q.shape[2], k.shape[2]
+    delta = jnp.sum(out32 * g32, axis=-1)            # [b, h, t]
+    n_k = tk // block_k
+    q_pos = jnp.arange(t)
+
+    def body(dq_acc, j):
+        ks = jax.lax.dynamic_slice_in_dim(k32, j * block_k, block_k, axis=2)
+        vs = jax.lax.dynamic_slice_in_dim(v32, j * block_k, block_k, axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, ks,
+                       preferred_element_type=f32) * scale
+        if causal:
+            k_pos = j * block_k + jnp.arange(block_k)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])              # [b,h,t,bk]
+        dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32,
+                        preferred_element_type=f32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", g32, vs,
+                        preferred_element_type=f32)
+        ds = p * (dp - delta[..., None]) * scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, ks,
+                                     preferred_element_type=f32)
+        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q32,
+                        preferred_element_type=f32)
+        return dq_acc, (dk, dv)
+
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        body, jnp.zeros_like(q32), jnp.arange(n_k))
+    # scan stacks [n_k, b, h, bk, d] -> [b, h, tk, d]
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(k.shape)
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(v.shape)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _supported(q, k, block_q, block_k):
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:  # noqa: BLE001
+        return False
+    if platform != "tpu":
+        return False
+    t, tk, d = q.shape[2], k.shape[2], q.shape[3]
+    return (t % block_q == 0 and tk % block_k == 0
+            and t >= block_q and tk >= block_k and d % 128 == 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
+                    block_k=512):
+    """Fused attention [B, H, T, D] -> [B, H, T, D]; falls back to XLA softmax
+    off-TPU or for non-divisible shapes."""
+    out, _ = _fa_fwd(q, k, v, causal, scale, block_q, block_k)
+    return out
+
+
+def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    block_q = min(block_q, q.shape[2])
+    block_k = min(block_k, k.shape[2])
+    if not _supported(q, k, block_q, block_k):
+        out = _xla_attention(q, k, v, causal, scale)
+        return out, (q, k, v, out, None)
+    out, lse = _fa_forward_pallas(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _fa_bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if lse is None:
+        # fallback path: differentiate the XLA implementation directly
+        _, vjp = jax.vjp(lambda q_, k_, v_:
+                         _xla_attention(q_, k_, v_, causal, scale), q, k, v)
+        return vjp(g)
+    return _fa_backward_blockwise(q, k, v, out, lse, g, causal, scale,
+                                  block_k)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

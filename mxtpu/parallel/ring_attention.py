@@ -85,21 +85,12 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
 
 
 def _dense_attention(q, k, v, causal=False, scale=None):
-    """Single-device reference path (the degenerate 1-shard ring)."""
-    d = q.shape[-1]
+    """Single-device reference path (the degenerate 1-shard ring) — one
+    implementation shared with flash_attention's fallback."""
+    from ..ops.pallas.flash_attention import _xla_attention
     if scale is None:
-        scale = 1.0 / (d ** 0.5)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32),
-                   preferred_element_type=jnp.float32) * scale
-    if causal:
-        t_q, t_k = s.shape[-2:]
-        mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
-        s = jnp.where(mask[None, None], s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
-                     preferred_element_type=jnp.float32)
-    return out.astype(q.dtype)
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _xla_attention(q, k, v, causal, scale)
 
 
 def ring_self_attention(q, k, v, mesh=None, seq_axis="sp", batch_axis=None,
@@ -112,7 +103,9 @@ def ring_self_attention(q, k, v, mesh=None, seq_axis="sp", batch_axis=None,
     optionally over ``batch_axis``.
     """
     if mesh is None or seq_axis not in mesh.shape or mesh.shape[seq_axis] == 1:
-        return _dense_attention(q, k, v, causal=causal, scale=scale)
+        # single-shard path: fused flash kernel (Pallas on TPU, XLA fallback)
+        from ..ops.pallas import flash_attention
+        return flash_attention(q, k, v, causal=causal, scale=scale)
     spec = P(batch_axis, None, seq_axis, None)
     fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
                            scale=scale)

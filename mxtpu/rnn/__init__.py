@@ -1,0 +1,19 @@
+"""mx.rnn: legacy RNN utilities (ref: python/mxnet/rnn/).
+
+The legacy symbol-composing cells are superseded by gluon.rnn cells (which
+trace to compiled graphs via hybridize — the TPU-native path); they are
+re-exported here under the legacy names for API familiarity. The data-side
+utilities (BucketSentenceIter, encode_sentences) are full ports.
+"""
+from ..gluon.rnn.rnn_cell import (BidirectionalCell, DropoutCell, GRUCell,
+                                  LSTMCell, ModifierCell, RNNCell,
+                                  RecurrentCell, ResidualCell,
+                                  SequentialRNNCell, ZoneoutCell)
+from .io import BucketSentenceIter, encode_sentences
+
+BaseRNNCell = RecurrentCell  # the legacy base covers all cell variants
+
+__all__ = ["RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell",
+           "BidirectionalCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "ModifierCell", "BaseRNNCell", "BucketSentenceIter",
+           "encode_sentences"]

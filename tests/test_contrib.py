@@ -1,0 +1,104 @@
+"""Tests for gluon.contrib + mx.rnn (ref patterns:
+tests/python/unittest/test_gluon_contrib.py, test_rnn.py)."""
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import gluon
+from mxtpu.gluon import nn
+from mxtpu.gluon.contrib import nn as cnn
+from mxtpu.gluon.contrib import rnn as crnn
+from mxtpu.rnn import BucketSentenceIter, encode_sentences
+
+
+def test_concurrent_and_identity():
+    net = cnn.HybridConcurrent(axis=1)
+    with net.name_scope():
+        net.add(nn.Dense(4))
+        net.add(nn.Dense(6))
+        net.add(cnn.Identity())
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3))
+    out = net(x)
+    assert out.shape == (2, 4 + 6 + 3)
+
+
+def test_sparse_embedding():
+    emb = cnn.SparseEmbedding(10, 4)
+    emb.initialize()
+    out = emb(mx.nd.array([1, 2, 1]))
+    assert out.shape == (3, 4)
+    np.testing.assert_allclose(out[0].asnumpy(), out[2].asnumpy())
+
+
+def test_sync_batch_norm_runs():
+    bn = cnn.SyncBatchNorm(num_devices=4)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8), bn)
+    net.initialize()
+    with mx.autograd.record():
+        out = net(mx.nd.random.uniform(shape=(4, 3)))
+    assert out.shape == (4, 8)
+
+
+def test_conv_lstm_cell():
+    cell = crnn.Conv2DLSTMCell(input_shape=(3, 8, 8), hidden_channels=5,
+                               i2h_kernel=3, i2h_pad=1, h2h_kernel=3)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3, 8, 8))
+    states = cell.begin_state(batch_size=2)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 5, 8, 8)
+    assert len(new_states) == 2
+    assert new_states[1].shape == (2, 5, 8, 8)
+
+
+def test_conv_gru_unroll():
+    cell = crnn.Conv1DGRUCell(input_shape=(2, 10), hidden_channels=4,
+                              i2h_kernel=3, i2h_pad=1, h2h_kernel=3)
+    cell.initialize()
+    inputs = [mx.nd.random.uniform(shape=(3, 2, 10)) for _ in range(4)]
+    outputs, states = cell.unroll(4, inputs, layout="TNC", merge_outputs=False)
+    assert len(outputs) == 4
+    assert outputs[0].shape == (3, 4, 10)
+
+
+def test_variational_dropout_cell_mask_reuse():
+    base = gluon.rnn.LSTMCell(8)
+    cell = crnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize()
+    x = mx.nd.ones((2, 4))
+    states = cell.begin_state(batch_size=2)
+    with mx.autograd.record():  # training mode so dropout is live
+        out1, states = cell(x, states)
+        mask1 = cell.drop_inputs_mask.asnumpy()
+        out2, states = cell(x, states)
+        mask2 = cell.drop_inputs_mask.asnumpy()
+    np.testing.assert_allclose(mask1, mask2)  # same mask across steps
+
+
+def test_lstmp_cell():
+    cell = crnn.LSTMPCell(hidden_size=16, projection_size=6)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(2, 4))
+    states = cell.begin_state(batch_size=2)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 6)       # projected
+    assert new_states[1].shape == (2, 16)  # cell state unprojected
+
+
+def test_encode_sentences_and_bucket_iter():
+    sentences = [["the", "cat", "sat"], ["a", "dog", "ran", "far"],
+                 ["hi"], ["the", "dog", "sat"]] * 4
+    coded, vocab = encode_sentences(sentences, start_label=1)
+    assert vocab["the"] != vocab["cat"]
+    it = BucketSentenceIter(coded, batch_size=2, buckets=[3, 5],
+                            invalid_label=0)
+    batches = list(it)
+    assert batches, "no batches produced"
+    for b in batches:
+        assert b.bucket_key in (3, 5)
+        assert b.data[0].shape == (2, b.bucket_key)
+        d = b.data[0].asnumpy()
+        l = b.label[0].asnumpy()
+        np.testing.assert_allclose(l[:, :-1], d[:, 1:])
