@@ -21,6 +21,7 @@ _jax.config.update("jax_default_matmul_precision", "float32")
 from . import base
 from .base import Context, MXNetError, cpu, current_context, gpu, num_gpus, tpu
 from . import autograd
+from .layout import layout
 from . import random
 from . import ndarray
 from . import ndarray as nd  # mx.nd alias

@@ -67,13 +67,13 @@ class TransformerBlock(HybridBlock):
     """Pre-norm block: x + attn(ln(x)); x + mlp(ln(x))."""
 
     def __init__(self, dim, num_heads, hidden_mult=4, mesh=None,
-                 seq_axis="sp", batch_axis="data", **kwargs):
+                 seq_axis="sp", batch_axis="data", causal=True, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.ln1 = nn.LayerNorm()
             self.attn = MultiHeadSelfAttention(
                 dim, num_heads, mesh=mesh, seq_axis=seq_axis,
-                batch_axis=batch_axis, prefix="attn_")
+                batch_axis=batch_axis, causal=causal, prefix="attn_")
             self.ln2 = nn.LayerNorm()
             self.fc1 = nn.Dense(hidden_mult * dim, flatten=False,
                                 activation="relu", prefix="mlp1_")
@@ -88,11 +88,13 @@ class TransformerLM(HybridBlock):
     """Decoder-only LM: embed → N blocks → LayerNorm → vocab head.
 
     Input: int token ids [B, T]; output: logits [B, T, vocab].
+    ``causal=False`` gives the bidirectional (BERT-style encoder) variant —
+    the same trunk the masked-LM pretraining benchmark drives.
     """
 
     def __init__(self, vocab_size, dim=256, num_heads=8, num_layers=2,
                  max_len=2048, hidden_mult=4, mesh=None, seq_axis="sp",
-                 batch_axis="data", **kwargs):
+                 batch_axis="data", causal=True, **kwargs):
         super().__init__(**kwargs)
         self._vocab = vocab_size
         self._max_len = max_len
@@ -104,7 +106,8 @@ class TransformerLM(HybridBlock):
                 for _ in range(num_layers):
                     self.blocks.add(TransformerBlock(
                         dim, num_heads, hidden_mult=hidden_mult, mesh=mesh,
-                        seq_axis=seq_axis, batch_axis=batch_axis))
+                        seq_axis=seq_axis, batch_axis=batch_axis,
+                        causal=causal))
             self.ln_f = nn.LayerNorm()
             self.head = nn.Dense(vocab_size, use_bias=False, flatten=False,
                                  prefix="head_")

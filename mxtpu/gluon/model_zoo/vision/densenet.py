@@ -6,6 +6,7 @@ channel-concats; XLA keeps these as views into one buffer where possible.
 from __future__ import annotations
 
 from ....base import MXNetError
+from ....layout import channel_axis as _channel_axis
 from ...block import HybridBlock
 from ... import nn
 
@@ -18,6 +19,7 @@ class _DenseLayer(HybridBlock):
 
     def __init__(self, growth_rate, bn_size, dropout, **kwargs):
         super().__init__(**kwargs)
+        self._concat_axis = _channel_axis(None)
         self.body = nn.HybridSequential(prefix="")
         self.body.add(nn.BatchNorm())
         self.body.add(nn.Activation("relu"))
@@ -32,7 +34,7 @@ class _DenseLayer(HybridBlock):
 
     def hybrid_forward(self, F, x):
         out = self.body(x)
-        return F.concat(x, out, dim=1)
+        return F.concat(x, out, dim=self._concat_axis)
 
 
 def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):

@@ -6,6 +6,7 @@ concats map to one XLA concat per block.
 from __future__ import annotations
 
 from ....base import MXNetError
+from ....layout import channel_axis as _channel_axis
 from ...block import HybridBlock
 from ... import nn
 from ...nn import HybridConcurrent
@@ -38,7 +39,7 @@ def _make_branch(use_pool, *conv_settings):
 
 
 def _make_A(pool_features, prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
+    out = HybridConcurrent(axis=_channel_axis(None), prefix=prefix)
     out.add(_make_branch(None, (64, 1, None, None)))
     out.add(_make_branch(None, (48, 1, None, None), (64, 5, None, 2)))
     out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
@@ -48,7 +49,7 @@ def _make_A(pool_features, prefix):
 
 
 def _make_B(prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
+    out = HybridConcurrent(axis=_channel_axis(None), prefix=prefix)
     out.add(_make_branch(None, (384, 3, 2, None)))
     out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
                          (96, 3, 2, None)))
@@ -57,7 +58,7 @@ def _make_B(prefix):
 
 
 def _make_C(channels_7x7, prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
+    out = HybridConcurrent(axis=_channel_axis(None), prefix=prefix)
     out.add(_make_branch(None, (192, 1, None, None)))
     out.add(_make_branch(None, (channels_7x7, 1, None, None),
                          (channels_7x7, (1, 7), None, (0, 3)),
@@ -72,7 +73,7 @@ def _make_C(channels_7x7, prefix):
 
 
 def _make_D(prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
+    out = HybridConcurrent(axis=_channel_axis(None), prefix=prefix)
     out.add(_make_branch(None, (192, 1, None, None), (320, 3, 2, None)))
     out.add(_make_branch(None, (192, 1, None, None), (192, (1, 7), None, (0, 3)),
                          (192, (7, 1), None, (3, 0)), (192, 3, 2, None)))
@@ -81,13 +82,13 @@ def _make_D(prefix):
 
 
 def _make_E(prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
+    out = HybridConcurrent(axis=_channel_axis(None), prefix=prefix)
     out.add(_make_branch(None, (320, 1, None, None)))
 
     branch_3x3 = nn.HybridSequential(prefix="")
     out.add(branch_3x3)
     branch_3x3.add(_make_branch(None, (384, 1, None, None)))
-    branch_3x3_split = HybridConcurrent(axis=1, prefix="")
+    branch_3x3_split = HybridConcurrent(axis=_channel_axis(None), prefix="")
     branch_3x3_split.add(_make_branch(None, (384, (1, 3), None, (0, 1))))
     branch_3x3_split.add(_make_branch(None, (384, (3, 1), None, (1, 0))))
     branch_3x3.add(branch_3x3_split)
@@ -96,7 +97,7 @@ def _make_E(prefix):
     out.add(branch_3x3dbl)
     branch_3x3dbl.add(_make_branch(None, (448, 1, None, None),
                                    (384, 3, None, 1)))
-    branch_3x3dbl_split = HybridConcurrent(axis=1, prefix="")
+    branch_3x3dbl_split = HybridConcurrent(axis=_channel_axis(None), prefix="")
     branch_3x3dbl.add(branch_3x3dbl_split)
     branch_3x3dbl_split.add(_make_branch(None, (384, (1, 3), None, (0, 1))))
     branch_3x3dbl_split.add(_make_branch(None, (384, (3, 1), None, (1, 0))))

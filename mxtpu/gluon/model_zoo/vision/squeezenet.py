@@ -5,6 +5,7 @@ Iandola et al., "SqueezeNet: AlexNet-level accuracy with 50x fewer parameters".
 from __future__ import annotations
 
 from ....base import MXNetError
+from ....layout import channel_axis as _channel_axis
 from ...block import HybridBlock
 from ... import nn
 from ...nn import HybridConcurrent
@@ -16,7 +17,7 @@ def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
     out = nn.HybridSequential(prefix="")
     out.add(_make_fire_conv(squeeze_channels, 1))
     # the two expand branches run in parallel and concat on channels
-    paths = HybridConcurrent(axis=1, prefix="")
+    paths = HybridConcurrent(axis=_channel_axis(None), prefix="")
     paths.add(_make_fire_conv(expand1x1_channels, 1))
     paths.add(_make_fire_conv(expand3x3_channels, 3, 1))
     out.add(paths)

@@ -163,11 +163,16 @@ class BatchNorm(HybridBlock):
     Under a hybrid trace the moving-stat update is collected functionally
     (Parameter._update_aux) and written back after the compiled call."""
 
-    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True, scale=True,
+    def __init__(self, axis=None, momentum=0.9, epsilon=1e-5, center=True, scale=True,
                  use_global_stats=False, beta_initializer="zeros",
                  gamma_initializer="ones", running_mean_initializer="zeros",
                  running_variance_initializer="ones", in_channels=0, **kwargs):
         super().__init__(**kwargs)
+        if axis is None:
+            # reference default is axis=1 (NCHW); under mx.layout("NHWC")
+            # the channel axis moves last (mxtpu/layout.py)
+            from ...layout import channel_axis
+            axis = channel_axis(None)
         self._kwargs = dict(axis=axis, eps=epsilon, momentum=momentum,
                             fix_gamma=not scale, use_global_stats=use_global_stats)
         self._axis = axis

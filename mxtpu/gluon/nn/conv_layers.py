@@ -1,7 +1,16 @@
-"""Convolution & pooling layers (ref: python/mxnet/gluon/nn/conv_layers.py)."""
+"""Convolution & pooling layers (ref: python/mxnet/gluon/nn/conv_layers.py).
+
+Layout: the reference is channels-first only; here every layer also runs
+channels-last (the TPU-native layout) — pass ``layout="NHWC"`` explicitly or
+build under ``mx.layout("NHWC")`` (mxtpu/layout.py). Channels-last convs
+store weights HWIO, exactly what ``lax.conv_general_dilated`` consumes with
+zero relayout ops on the MXU.
+"""
 from __future__ import annotations
 
 from ...base import MXNetError
+from ...layout import channel_axis as _scope_channel_axis
+from ...layout import conv_layout as _scope_conv_layout
 from ..block import HybridBlock
 
 __all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
@@ -28,18 +37,19 @@ class _Conv(HybridBlock):
         self._channels = channels
         self._in_channels = in_channels
         ndim = len(kernel_size)
+        layout = _scope_conv_layout(layout, ndim)
         self._layout = layout
+        self._channels_last = _scope_channel_axis(layout) == -1
         self._op_name = op_name
         self._kwargs = dict(kernel=kernel_size, stride=strides, dilate=dilation,
                             pad=padding, num_filter=channels, num_group=groups,
                             no_bias=not use_bias, layout=layout)
         if adj is not None:
             self._kwargs["adj"] = adj
-        # weight layout: (out, in/g, *k) for Convolution; (in, out/g, *k) transposed
-        if op_name == "Convolution":
-            wshape = (channels, in_channels // groups if in_channels else 0) + kernel_size
-        else:
-            wshape = (in_channels, channels // groups if channels else 0) + kernel_size
+        # weight layout: channels-first (out, in/g, *k) for Convolution /
+        # (in, out/g, *k) transposed; channels-last stores what the HLO
+        # consumes directly — (*k, in/g, out) / (*k, out/g, in).
+        wshape = self._weight_shape(in_channels)
         with self.name_scope():
             self.weight = self.params.get(
                 "weight", shape=wshape, init=weight_initializer,
@@ -57,18 +67,25 @@ class _Conv(HybridBlock):
                 self.act = None
 
     def _channel_axis(self):
-        return len(self._layout) - 1 if self._layout.endswith("C") and \
-            self._layout[1] != "C" else 1
+        return _scope_channel_axis(self._layout)
 
-    def infer_shape(self, x, *args):
-        axis = 1 if self._layout[1] == "C" else len(self._layout) - 1
-        in_c = x.shape[axis]
+    def _weight_shape(self, in_channels):
         groups = self._kwargs["num_group"]
         kernel = tuple(self._kwargs["kernel"])
+        in_g = in_channels // groups if in_channels else 0
+        out_g = self._channels // groups if self._channels else 0
         if self._op_name == "Convolution":
-            self.weight._shape_resolved((self._channels, in_c // groups) + kernel)
-        else:
-            self.weight._shape_resolved((in_c, self._channels // groups) + kernel)
+            if self._channels_last:
+                return kernel + (in_g, self._channels)
+            return (self._channels, in_g) + kernel
+        if self._channels_last:
+            return kernel + (out_g, in_channels)
+        return (in_channels, out_g) + kernel
+
+    def infer_shape(self, x, *args):
+        in_c = x.shape[self._channel_axis()]
+        self._in_channels = in_c
+        self.weight._shape_resolved(self._weight_shape(in_c))
         if self.bias is not None:
             self.bias._shape_resolved((self._channels,))
 
@@ -81,16 +98,15 @@ class _Conv(HybridBlock):
 
     def __repr__(self):
         s = "{name}({mapping}, kernel_size={kernel}, stride={stride})"
-        shape = self.weight.shape
         return s.format(name=self.__class__.__name__,
-                        mapping="{0} -> {1}".format(shape[1] if shape[1] else None,
-                                                    shape[0]),
+                        mapping="{0} -> {1}".format(self._in_channels or None,
+                                                    self._channels),
                         kernel=self._kwargs["kernel"], stride=self._kwargs["stride"])
 
 
 class Conv1D(_Conv):
     def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
-                 groups=1, layout="NCW", activation=None, use_bias=True,
+                 groups=1, layout=None, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros", in_channels=0,
                  **kwargs):
         super().__init__(channels, _tuplify(kernel_size, 1), _tuplify(strides, 1),
@@ -101,7 +117,7 @@ class Conv1D(_Conv):
 
 class Conv2D(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
-                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 dilation=(1, 1), groups=1, layout=None, activation=None,
                  use_bias=True, weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
         super().__init__(channels, _tuplify(kernel_size, 2), _tuplify(strides, 2),
@@ -112,7 +128,7 @@ class Conv2D(_Conv):
 
 class Conv3D(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1, 1), padding=(0, 0, 0),
-                 dilation=(1, 1, 1), groups=1, layout="NCDHW", activation=None,
+                 dilation=(1, 1, 1), groups=1, layout=None, activation=None,
                  use_bias=True, weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
         super().__init__(channels, _tuplify(kernel_size, 3), _tuplify(strides, 3),
@@ -123,7 +139,7 @@ class Conv3D(_Conv):
 
 class Conv1DTranspose(_Conv):
     def __init__(self, channels, kernel_size, strides=1, padding=0, output_padding=0,
-                 dilation=1, groups=1, layout="NCW", activation=None, use_bias=True,
+                 dilation=1, groups=1, layout=None, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros", in_channels=0,
                  **kwargs):
         super().__init__(channels, _tuplify(kernel_size, 1), _tuplify(strides, 1),
@@ -136,7 +152,7 @@ class Conv1DTranspose(_Conv):
 
 class Conv2DTranspose(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
-                 output_padding=(0, 0), dilation=(1, 1), groups=1, layout="NCHW",
+                 output_padding=(0, 0), dilation=(1, 1), groups=1, layout=None,
                  activation=None, use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
         super().__init__(channels, _tuplify(kernel_size, 2), _tuplify(strides, 2),
@@ -150,7 +166,7 @@ class Conv2DTranspose(_Conv):
 class Conv3DTranspose(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1, 1), padding=(0, 0, 0),
                  output_padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
-                 layout="NCDHW", activation=None, use_bias=True,
+                 layout=None, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros", in_channels=0,
                  **kwargs):
         super().__init__(channels, _tuplify(kernel_size, 3), _tuplify(strides, 3),
@@ -169,9 +185,10 @@ class _Pooling(HybridBlock):
         super().__init__(**kwargs)
         if strides is None:
             strides = pool_size
+        layout = _scope_conv_layout(layout, len(pool_size))
         self._kwargs = dict(
             kernel=pool_size, stride=strides, pad=padding, global_pool=global_pool,
-            pool_type=pool_type,
+            pool_type=pool_type, layout=layout,
             pooling_convention="full" if ceil_mode else "valid")
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
@@ -189,7 +206,7 @@ class _Pooling(HybridBlock):
 
 
 class MaxPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+    def __init__(self, pool_size=2, strides=None, padding=0, layout=None,
                  ceil_mode=False, **kwargs):
         super().__init__(_tuplify(pool_size, 1),
                          _tuplify(strides, 1) if strides is not None else None,
@@ -197,7 +214,7 @@ class MaxPool1D(_Pooling):
 
 
 class MaxPool2D(_Pooling):
-    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout=None,
                  ceil_mode=False, **kwargs):
         super().__init__(_tuplify(pool_size, 2),
                          _tuplify(strides, 2) if strides is not None else None,
@@ -205,7 +222,7 @@ class MaxPool2D(_Pooling):
 
 
 class MaxPool3D(_Pooling):
-    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout="NCDHW",
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout=None,
                  ceil_mode=False, **kwargs):
         super().__init__(_tuplify(pool_size, 3),
                          _tuplify(strides, 3) if strides is not None else None,
@@ -213,7 +230,7 @@ class MaxPool3D(_Pooling):
 
 
 class AvgPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+    def __init__(self, pool_size=2, strides=None, padding=0, layout=None,
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_tuplify(pool_size, 1),
                          _tuplify(strides, 1) if strides is not None else None,
@@ -222,7 +239,7 @@ class AvgPool1D(_Pooling):
 
 
 class AvgPool2D(_Pooling):
-    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout=None,
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_tuplify(pool_size, 2),
                          _tuplify(strides, 2) if strides is not None else None,
@@ -231,7 +248,7 @@ class AvgPool2D(_Pooling):
 
 
 class AvgPool3D(_Pooling):
-    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout="NCDHW",
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout=None,
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_tuplify(pool_size, 3),
                          _tuplify(strides, 3) if strides is not None else None,
@@ -240,32 +257,32 @@ class AvgPool3D(_Pooling):
 
 
 class GlobalMaxPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1,), None, (0,), True, True, "max", layout, **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1), None, (0, 0), True, True, "max", layout, **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "max", layout, **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1,), None, (0,), True, True, "avg", layout, **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1), None, (0, 0), True, True, "avg", layout, **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "avg", layout, **kwargs)
 
 

@@ -1,0 +1,99 @@
+"""Global convolution-layout scope: run any model channels-last with one line.
+
+The reference is NCHW-only (src/operator/nn/convolution.cc checks layout
+kNCW/kNCHW/kNCDHW); every gluon layer and model-zoo net hardcodes that
+default. On TPU the preferred layout is channels-last — the C dimension
+vectorizes onto the 8x128 VPU lanes and feeds the MXU without relayouts —
+so instead of threading a ``layout=`` kwarg through every zoo constructor
+(invasive, and the reference API has no such parameter), mxtpu provides a
+scope that flips the *default* layout read by Conv/Pool/BatchNorm layers at
+construction time:
+
+    with mx.layout("NHWC"):
+        net = vision.resnet50_v1()
+    net.initialize()
+    net(x_nhwc)
+
+Explicit ``layout=``/``axis=`` arguments always win over the scope. The
+scope affects layer construction only — an already-built block is fixed.
+Parameters are stored in the layout-native shape (HWIO for channels-last
+convs), which is also what feeds ``lax.conv_general_dilated`` with zero
+relayout ops.
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+__all__ = ["layout", "current_layout", "conv_layout", "channel_axis",
+           "is_channels_last"]
+
+_state = threading.local()
+
+_CHANNELS_LAST = {1: "NWC", 2: "NHWC", 3: "NDHWC"}
+_CHANNELS_FIRST = {1: "NCW", 2: "NCHW", 3: "NCDHW"}
+
+
+class layout:
+    """Context manager / global setter for the default conv-family layout.
+
+    ``with layout("NHWC"): ...`` makes channels-last the default for every
+    Conv*/Pool*/BatchNorm constructed in the scope and restores the previous
+    default on exit; a bare ``layout("NHWC")`` call sets it globally (like
+    the reference's process-wide env toggles). ``"NCHW"`` /
+    ``"channels_first"`` restores the reference default. Any family name
+    (NWC/NHWC/NDHWC) selects the whole channels-last family — a Conv1D
+    built under ``layout("NHWC")`` is NWC.
+    """
+
+    def __init__(self, name):
+        name = str(name)
+        if name in ("channels_last",) or name in _CHANNELS_LAST.values():
+            last = True
+        elif name in ("channels_first",) or name in _CHANNELS_FIRST.values():
+            last = False
+        else:
+            raise MXNetError(
+                "unknown layout %r; expected one of %s / %s or "
+                "channels_first / channels_last"
+                % (name, sorted(_CHANNELS_FIRST.values()),
+                   sorted(_CHANNELS_LAST.values())))
+        # applied immediately so a bare call is a global set; entering the
+        # context only arms the restore
+        self._prev = getattr(_state, "channels_last", False)
+        _state.channels_last = last
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _state.channels_last = self._prev
+        return False
+
+
+def is_channels_last():
+    """True when the current default layout family is channels-last."""
+    return getattr(_state, "channels_last", False)
+
+
+def current_layout(ndim=2):
+    """The current default data layout string for an ndim-spatial conv."""
+    table = _CHANNELS_LAST if is_channels_last() else _CHANNELS_FIRST
+    if ndim not in table:
+        raise MXNetError("unsupported spatial ndim %d" % ndim)
+    return table[ndim]
+
+
+def conv_layout(explicit, ndim):
+    """Resolve a layer's layout argument: explicit value wins, else scope."""
+    if explicit is not None:
+        return explicit
+    return current_layout(ndim)
+
+
+def channel_axis(layout_str):
+    """Channel axis index for a layout string ('NCHW' -> 1, 'NHWC' -> -1)."""
+    if layout_str is None:
+        return -1 if is_channels_last() else 1
+    return -1 if layout_str.endswith("C") and layout_str[1] != "C" else 1

@@ -1,0 +1,114 @@
+"""CTC loss (ref: src/operator/nn/ctc_loss.cc + ctc_include/ warp-ctc).
+
+The reference ships Baidu's warp-ctc CUDA/CPU kernels; here the alpha
+(forward-variable) recursion of Graves et al. runs in the log semiring as a
+``lax.scan`` over time — compiler-friendly static control flow, batched over
+N on the VPU — and the gradient falls out of ``jax.vjp`` through the scan
+(recompute-based, like every mxtpu op), replacing warp-ctc's hand-written
+beta/backward kernel.
+
+Semantics pinned to the reference implementation (ctc_loss-inl.h:120-200 —
+note its code, not its docstring, which contradicts the code):
+
+* input ``data`` is TNC (seq, batch, alphabet); softmax over C is applied
+  internally (warp-ctc convention: raw activations in).
+* ``blank_label='first'``: blank index 0, vocab tokens 1..C-1, label padding
+  value 0. ``'last'``: blank C-1, tokens 0..C-2, padding -1
+  (ctc_loss-inl.h:342).
+* output: per-sample negative log likelihood, shape (N,).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_NEG = -1e30  # effective -inf that keeps logaddexp grads finite
+
+
+def _ctc_nll(log_probs, labels, data_lengths, label_lengths, blank):
+    """Batched CTC negative log likelihood.
+
+    log_probs: [T, N, C] log-softmax outputs (f32).
+    labels:    [N, L] int32 class ids (garbage beyond label_lengths is fine).
+    data_lengths:  [N] int32, label_lengths: [N] int32.
+    """
+    T, N, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+
+    # extended sequence z[s]: blanks at even s, labels at odd s
+    s_idx = jnp.arange(S)
+    lab_idx = jnp.clip((s_idx - 1) // 2, 0, L - 1)
+    z = jnp.where(s_idx % 2 == 1, labels[:, lab_idx], blank)       # [N, S]
+    z = jnp.clip(z, 0, C - 1)  # padded labels may hold -1 etc.
+    # skip transition s-2 -> s allowed when z[s] is a non-blank that differs
+    # from z[s-2] (standard CTC topology)
+    z_prev2 = jnp.pad(z, ((0, 0), (2, 0)), constant_values=blank)[:, :S]
+    allow_skip = (s_idx % 2 == 1) & (z != z_prev2)                 # [N, S]
+
+    def emit(t):
+        return jnp.take_along_axis(log_probs[t], z, axis=1)        # [N, S]
+
+    alpha0 = jnp.full((N, S), _NEG, jnp.float32)
+    alpha0 = alpha0.at[:, 0].set(0.0)
+    has_label = label_lengths > 0
+    alpha0 = alpha0.at[:, 1].set(jnp.where(has_label, 0.0, _NEG))
+    alpha0 = alpha0 + emit(0)
+
+    def step_fn(alpha, t):
+        a1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=_NEG)[:, :S]
+        a2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=_NEG)[:, :S]
+        new = jnp.logaddexp(alpha, a1)
+        new = jnp.where(allow_skip, jnp.logaddexp(new, a2), new)
+        new = new + emit(t)
+        # past a sample's data length the forward variable is frozen so the
+        # readout below sees alpha at exactly t = T_n - 1
+        new = jnp.where((t < data_lengths)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step_fn, alpha0, jnp.arange(1, T))
+
+    rows = jnp.arange(N)
+    end = 2 * label_lengths                                        # [N]
+    ll_blank = alpha[rows, end]
+    ll_label = jnp.where(has_label,
+                         alpha[rows, jnp.maximum(end - 1, 0)], _NEG)
+    return -jnp.logaddexp(ll_blank, ll_label)
+
+
+@register("CTCLoss", aliases=("ctc_loss", "_contrib_CTCLoss", "_contrib_ctc_loss"))
+def CTCLoss(data, label, data_lengths=None, label_lengths=None,
+            use_data_lengths=False, use_label_lengths=False,
+            blank_label="first"):
+    """Connectionist temporal classification loss (ref: ctc_loss.cc).
+
+    data: (T, N, C) raw activations; label: (N, L) padded class ids.
+    Returns (N,) negative log likelihoods.
+    """
+    T, N, C = data.shape
+    log_probs = jnp.asarray(data, jnp.float32)
+    log_probs = log_probs - lax.stop_gradient(
+        jnp.max(log_probs, axis=2, keepdims=True))
+    log_probs = log_probs - jnp.log(
+        jnp.sum(jnp.exp(log_probs), axis=2, keepdims=True))
+
+    labels = jnp.asarray(label, jnp.int32)
+    blank = 0 if blank_label == "first" else C - 1
+    pad_value = 0 if blank_label == "first" else -1
+
+    if use_data_lengths and data_lengths is not None:
+        dlen = jnp.asarray(data_lengths, jnp.int32)
+    else:
+        dlen = jnp.full((N,), T, jnp.int32)
+    if use_label_lengths and label_lengths is not None:
+        llen = jnp.asarray(label_lengths, jnp.int32)
+    else:
+        # length = position of first padding value (ctc_loss-inl.h:138)
+        is_pad = labels == pad_value
+        llen = jnp.where(jnp.any(is_pad, axis=1),
+                         jnp.argmax(is_pad, axis=1),
+                         labels.shape[1]).astype(jnp.int32)
+
+    return _ctc_nll(log_probs, labels, dlen, llen, blank).astype(data.dtype)

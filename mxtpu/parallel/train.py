@@ -183,6 +183,7 @@ class ShardedTrainStep:
             for st, sh in zip(self._opt_states, self._param_shardings)]
         self._jit = None
         self._in_fmt = None
+        self._last_abstract = None
 
     # ------------------------------------------------------------- placement
     def _spec_for(self, param, rules):
@@ -296,6 +297,7 @@ class ShardedTrainStep:
         if self._jit is None or self._in_fmt != in_fmt:
             self._jit = self._build(in_fmt, len(in_datas))
             self._in_fmt = in_fmt
+            self._last_abstract = None
         in_datas = [jax.device_put(d, s)
                     for d, s in zip(in_datas, self._in_shardings)]
         self._num_update += 1
@@ -304,6 +306,12 @@ class ShardedTrainStep:
         hyper = (jnp.float32(lr), jnp.float32(self._num_update))
         rng = _random.next_key()
         opt_states = [list(s) for s in self._opt_states]
+        if self._last_abstract is None:
+            # abstract shapes for compiled_step_flops; shapes are invariant
+            # per (in_fmt, shapes) so capture once, off the per-step path
+            self._last_abstract = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (self._param_datas, opt_states, hyper, rng, in_datas))
         new_datas, new_states, loss = self._jit(
             self._param_datas, opt_states, hyper, rng, in_datas)
         self._param_datas = new_datas
@@ -311,6 +319,22 @@ class ShardedTrainStep:
         for p, d in zip(self._params, new_datas):
             p.data()._set_data(d)
         return NDArray(loss)
+
+    def compiled_step_flops(self):
+        """FLOPs of one compiled step per XLA's own cost model.
+
+        The analog of the reference's per-op FLOP counting in its benchmark
+        scripts — but measured on the exact fused HLO that runs, not a
+        hand-derived formula. Requires at least one __call__ (shapes must be
+        known); pays one extra (cached-HLO) compile.
+        """
+        if self._jit is None or self._last_abstract is None:
+            raise MXNetError("run at least one step before asking for FLOPs")
+        compiled = self._jit.lower(*self._last_abstract).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0]
+        return float(cost["flops"])
 
     @property
     def learning_rate(self):

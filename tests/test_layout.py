@@ -1,0 +1,159 @@
+"""Channels-last (NHWC) layout scope vs reference NCHW numerics.
+
+The reference is NCHW-only (src/operator/nn/convolution.cc layout check);
+mxtpu adds a channels-last path because that is the TPU-native layout
+(mxtpu/layout.py). These tests pin NHWC == NCHW numerics so the fast path
+can't drift from the reference-parity path.
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import gluon
+from mxtpu.gluon import nn
+
+
+def _to_nhwc(x):
+    return np.transpose(x, (0, 2, 3, 1))
+
+
+def test_conv2d_layout_match():
+    x = np.random.uniform(-1, 1, (2, 3, 8, 8)).astype("float32")
+    a = nn.Conv2D(5, 3, strides=2, padding=1, in_channels=3)
+    a.initialize()
+    with mx.layout("NHWC"):
+        b = nn.Conv2D(5, 3, strides=2, padding=1, in_channels=3)
+    b.initialize()
+    # share weights: OIHW -> HWIO
+    w = a.weight.data().asnumpy()
+    b.weight.set_data(mx.nd.array(np.transpose(w, (2, 3, 1, 0))))
+    b.bias.set_data(a.bias.data())
+    ya = a(mx.nd.array(x)).asnumpy()
+    yb = b(mx.nd.array(_to_nhwc(x))).asnumpy()
+    np.testing.assert_allclose(_to_nhwc(ya), yb, rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_transpose_layout_match():
+    x = np.random.uniform(-1, 1, (2, 3, 8, 8)).astype("float32")
+    a = nn.Conv2DTranspose(5, 3, strides=2, padding=1, in_channels=3)
+    a.initialize()
+    with mx.layout("NHWC"):
+        b = nn.Conv2DTranspose(5, 3, strides=2, padding=1, in_channels=3)
+    b.initialize()
+    # IOHW -> HWOI (channels-last deconv stores (*k, out/g, in))
+    w = a.weight.data().asnumpy()
+    b.weight.set_data(mx.nd.array(np.transpose(w, (2, 3, 1, 0))))
+    b.bias.set_data(a.bias.data())
+    ya = a(mx.nd.array(x)).asnumpy()
+    yb = b(mx.nd.array(_to_nhwc(x))).asnumpy()
+    np.testing.assert_allclose(_to_nhwc(ya), yb, rtol=1e-5, atol=1e-5)
+
+
+def test_pooling_layout_match():
+    x = np.random.uniform(-1, 1, (2, 3, 9, 9)).astype("float32")
+    for cls, kw in [(nn.MaxPool2D, dict(pool_size=3, strides=2, padding=1)),
+                    (nn.AvgPool2D, dict(pool_size=2, strides=2)),
+                    (nn.GlobalAvgPool2D, {}), (nn.GlobalMaxPool2D, {})]:
+        a = cls(**kw)
+        with mx.layout("NHWC"):
+            b = cls(**kw)
+        ya = a(mx.nd.array(x)).asnumpy()
+        yb = b(mx.nd.array(_to_nhwc(x))).asnumpy()
+        np.testing.assert_allclose(_to_nhwc(ya), yb, rtol=1e-6, atol=1e-6)
+
+
+def test_batchnorm_layout_match():
+    x = np.random.uniform(-1, 1, (2, 3, 4, 4)).astype("float32")
+    a = nn.BatchNorm(in_channels=3)
+    a.initialize()
+    with mx.layout("NHWC"):
+        b = nn.BatchNorm(in_channels=3)
+    b.initialize()
+    with mx.autograd.record():
+        ya = a(mx.nd.array(x))
+        yb = b(mx.nd.array(_to_nhwc(x)))
+    np.testing.assert_allclose(_to_nhwc(ya.asnumpy()), yb.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_explicit_layout_overrides_scope():
+    with mx.layout("NHWC"):
+        c = nn.Conv2D(4, 3, layout="NCHW", in_channels=3)
+    assert c._layout == "NCHW"
+    assert c.weight.shape == (4, 3, 3, 3)
+
+
+def test_resnet18_layout_scope_end_to_end():
+    """The whole untouched model zoo flips to NHWC with one scope line."""
+    np.random.seed(0)
+    x = np.random.uniform(-1, 1, (2, 3, 32, 32)).astype("float32")
+    from mxtpu.gluon.model_zoo import vision
+    mx.random.seed(0)
+    a = vision.resnet18_v1(classes=10, thumbnail=True)
+    a.initialize()
+    mx.random.seed(0)
+    with mx.layout("NHWC"):
+        b = vision.resnet18_v1(classes=10, thumbnail=True)
+    b.initialize()
+    ya = a(mx.nd.array(x))
+    yb = b(mx.nd.array(_to_nhwc(x)))
+    # same seed -> same init draw order; conv weights differ only by
+    # transpose, which the fan-in/fan-out Xavier computation is blind to,
+    # so outputs agree when we copy weights across
+    for (na, pa), (nb, pb) in zip(sorted(a.collect_params().items()),
+                                  sorted(b.collect_params().items())):
+        wa = pa.data().asnumpy()
+        if wa.ndim == 4:  # OIHW -> HWIO
+            pb.set_data(mx.nd.array(np.transpose(wa, (2, 3, 1, 0))))
+        else:
+            pb.set_data(pa.data())
+    ya = a(mx.nd.array(x)).asnumpy()
+    yb = b(mx.nd.array(_to_nhwc(x))).asnumpy()
+    np.testing.assert_allclose(ya, yb, rtol=1e-4, atol=1e-4)
+
+
+def test_concat_models_nhwc_forward():
+    """Channel-concat zoo families (densenet/squeezenet) resolve their
+    concat axis from the layout scope."""
+    from mxtpu.gluon.model_zoo import vision
+    x = np.random.uniform(-1, 1, (1, 64, 64, 3)).astype("float32")
+    with mx.layout("NHWC"):
+        for name in ("densenet121", "squeezenet1_1"):
+            net = vision.get_model(name, classes=7)
+            net.initialize()
+            out = net(mx.nd.array(x))
+            assert out.shape == (1, 7), (name, out.shape)
+
+
+def test_layout_global_set_and_restore():
+    """Bare call sets globally; context restores."""
+    mx.layout("NHWC")
+    from mxtpu.layout import is_channels_last
+    assert is_channels_last()
+    mx.layout("NCHW")
+    assert not is_channels_last()
+    with mx.layout("NHWC"):
+        assert is_channels_last()
+    assert not is_channels_last()
+
+
+def test_nhwc_train_step():
+    """NHWC net trains under ShardedTrainStep (loss decreases)."""
+    from mxtpu.parallel import ShardedTrainStep, data_parallel_mesh
+    np.random.seed(0)
+    with mx.layout("NHWC"):
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+                nn.BatchNorm(), nn.MaxPool2D(2), nn.Flatten(), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(np.random.uniform(-1, 1, (8, 8, 8, 3)))
+    y = mx.nd.array(np.random.randint(0, 4, (8,)).astype("float32"))
+    net(x)
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = ShardedTrainStep(net, loss, data_parallel_mesh(),
+                            optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.1})
+    first = float(step(x, y).asnumpy())
+    for _ in range(10):
+        last = float(step(x, y).asnumpy())
+    assert last < first
