@@ -1,0 +1,141 @@
+"""Multi-host distributed runtime (ref: the ps-lite worker/server stack).
+
+The reference bootstraps distributed training through ps-lite: every worker
+connects to a scheduler at DMLC_PS_ROOT_URI:DMLC_PS_ROOT_PORT with role/rank
+from DMLC_ROLE/DMLC_NUM_WORKER (src/kvstore/kvstore_dist.h:44,
+python/mxnet/kvstore_server.py:28-75, launcher tools/launch.py). Parameter
+servers hold shards; workers push/pull over TCP.
+
+TPU-native re-design: there are no parameter servers. Every process joins one
+JAX distributed runtime (`jax.distributed.initialize`) and the global device
+mesh then spans all hosts — XLA collectives ride ICI within a slice and DCN
+across slices, and the same jitted ShardedTrainStep that does single-host
+data parallelism becomes multi-host by construction (the mesh just has more
+devices). This module is the bootstrap: the analog of kvstore_server.py's
+role dance, reduced to one symmetric `init()`.
+
+Env bootstrap accepts both spellings:
+
+* ``MXTPU_COORDINATOR`` / ``MXTPU_NUM_PROCESSES`` / ``MXTPU_PROCESS_ID``
+* reference names: ``DMLC_PS_ROOT_URI`` + ``DMLC_PS_ROOT_PORT`` /
+  ``DMLC_NUM_WORKER`` / ``DMLC_WORKER_ID`` (tools/launch.py exports these)
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["init", "is_initialized", "shutdown", "rank", "num_workers",
+           "barrier"]
+
+_initialized = False
+
+
+def _env_config():
+    env = os.environ
+    coord = env.get("MXTPU_COORDINATOR")
+    if coord is None and env.get("DMLC_PS_ROOT_URI"):
+        coord = "%s:%s" % (env["DMLC_PS_ROOT_URI"],
+                           env.get("DMLC_PS_ROOT_PORT", "9091"))
+    nproc = env.get("MXTPU_NUM_PROCESSES") or env.get("DMLC_NUM_WORKER")
+    pid = env.get("MXTPU_PROCESS_ID")
+    if pid is None:
+        pid = env.get("DMLC_WORKER_ID")
+    return coord, (int(nproc) if nproc else None), (int(pid) if pid else None)
+
+
+def init(coordinator_address=None, num_processes=None, process_id=None,
+         local_device_ids=None):
+    """Join the distributed runtime. Idempotent; returns (rank, num_workers).
+
+    With no arguments, reads the env bootstrap (see module docstring) — on
+    Cloud TPU pods jax.distributed can also autodetect everything, so all
+    arguments staying None there is fine too.
+    """
+    global _initialized
+    if _initialized:
+        return rank(), num_workers()
+    env_coord, env_n, env_id = _env_config()
+    coordinator_address = coordinator_address or env_coord
+    num_processes = num_processes if num_processes is not None else env_n
+    process_id = process_id if process_id is not None else env_id
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    _initialized = True
+    return rank(), num_workers()
+
+
+def is_initialized():
+    """True when this process joined a multi-process runtime (or one was
+    already active, e.g. via jax.distributed autodetection). Careful NOT to
+    initialize the XLA backend while probing — jax.process_count() would,
+    and afterwards jax.distributed.initialize() is impossible in this
+    process, making any 'call init() first' advice unfollowable."""
+    if _initialized:
+        return True
+    try:
+        from jax._src import distributed as _jd
+        if _jd.global_state.client is not None:
+            return True
+    except Exception:
+        pass
+    try:
+        from jax._src import xla_bridge as _xb
+        backend_up = _xb.backends_are_initialized()
+    except Exception:
+        backend_up = True  # conservative: don't block an active runtime
+    return backend_up and jax.process_count() > 1
+
+
+def shutdown():
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def rank():
+    """This process's id (ref: KVStore::get_rank / ps::MyRank)."""
+    return jax.process_index()
+
+
+def num_workers():
+    """World size (ref: KVStore::get_group_size)."""
+    return jax.process_count()
+
+
+def barrier(name="mxtpu_barrier"):
+    """Block until every process reaches the barrier (ref: KVStore::Barrier →
+    ps Postoffice::Barrier). A tiny psum over all global devices is the
+    rendezvous; it rides DCN across hosts."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
+def allgather_host(x):
+    """Gather a host-local array from every process; returns [world, ...].
+    Single-process returns x[None]."""
+    import numpy as np
+    if jax.process_count() <= 1:
+        return np.asarray(x)[None]
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(x))
+
+
+def allreduce_host(x):
+    """Sum a host-local numpy/jax array across all processes (the control
+    plane's allreduce — the data plane's lives inside jitted steps). Returns
+    the global sum as a host array; single-process is the identity."""
+    if jax.process_count() <= 1:
+        return x
+    from jax.experimental import multihost_utils
+    import numpy as np
+    stacked = multihost_utils.process_allgather(x)
+    return np.asarray(stacked).sum(axis=0)

@@ -63,7 +63,10 @@ class KVStore:
     # -------------------------------------------------------------- push/pull
     def push(self, key, value, priority=0):
         """Aggregate value(s) into the store (ref: KVStoreLocal::PushImpl,
-        src/kvstore/kvstore_local.h:184: comm_->Reduce then updater or merge)."""
+        src/kvstore/kvstore_local.h:184: comm_->Reduce then updater or merge).
+        dist_sync additionally sums the merged value across every worker
+        process — the reference's ps-lite server-side aggregation
+        (kvstore_dist_server.h:155) becomes one DCN allreduce."""
         keys, values = _normalize_grouped(key, value)
         for k, vs in zip(keys, values):
             if k not in self._store:
@@ -73,6 +76,23 @@ class KVStore:
             merged = vs[0]._data
             for v in vs[1:]:
                 merged = merged + v._data
+            if self._kind.startswith("dist"):
+                from . import distributed
+                if self._compression is not None:
+                    # quantize the local contribution; only the packed
+                    # 2-bit wire format (16x smaller) crosses DCN, then
+                    # every worker dequantizes and sums; error feedback
+                    # stays local (ref: kvstore_dist.h PushCompressed)
+                    import numpy as np
+                    shape, dtype = merged.shape, merged.dtype
+                    packed, n = self._compression.quantize(k, merged)
+                    gathered = distributed.allgather_host(packed)
+                    summed = np.zeros(shape, np.float32)
+                    for row in gathered:
+                        summed += self._compression.dequantize(row, n, shape)
+                    merged = jnp.asarray(summed, dtype=dtype)
+                else:
+                    merged = jnp.asarray(distributed.allreduce_host(merged))
             if self._updater is not None:
                 self._updater(_int_key(k), NDArray(merged), self._store[k])
             else:
@@ -121,10 +141,12 @@ class KVStore:
         self.set_updater(opt_mod.get_updater(optimizer))
 
     def set_gradient_compression(self, compression_params):
-        """2-bit gradient compression (ref: src/kvstore/gradient_compression.h).
-        Stored for API parity; the collective data plane runs uncompressed over
-        ICI where bandwidth makes compression counterproductive."""
-        self._compression = dict(compression_params)
+        """2-bit gradient compression with error feedback
+        (ref: src/kvstore/gradient_compression.h). Active on the dist_*
+        DCN allreduce path; the ICI data plane inside jitted steps stays
+        uncompressed (bandwidth there makes compression counterproductive)."""
+        from .gradient_compression import GradientCompression
+        self._compression = GradientCompression(**dict(compression_params))
 
     # ------------------------------------------------------------ distributed
     @property
@@ -136,11 +158,11 @@ class KVStore:
         return jax.process_count()
 
     def barrier(self):
-        """Global barrier (ref: KVStore::Barrier → ps Postoffice barrier). A psum
-        across all devices is the collective rendezvous."""
-        if jax.device_count() > 1:
-            x = jnp.ones((jax.local_device_count(),))
-            jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x).block_until_ready()
+        """Global barrier (ref: KVStore::Barrier → ps Postoffice barrier).
+        Multi-process: a true cross-host rendezvous over DCN; single-process
+        it is a no-op (nothing to wait for)."""
+        from . import distributed
+        distributed.barrier("mxtpu_kvstore_barrier")
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
@@ -196,6 +218,15 @@ def create(name="local"):
                 "local_allreduce_device", "device", "nccl"):
         return KVStore(name)
     if name in ("dist_sync", "dist_sync_device"):
+        from . import distributed
+        if not distributed.is_initialized():
+            raise MXNetError(
+                "kvstore %r needs the multi-process runtime: call "
+                "mxtpu.distributed.init() first (env bootstrap: "
+                "MXTPU_COORDINATOR/MXTPU_NUM_PROCESSES/MXTPU_PROCESS_ID or "
+                "the reference's DMLC_* names; see tools/launch.py). "
+                "Refusing to silently fall back to the single-process store."
+                % name)
         return KVStore(name)
     if name in ("dist_async", "dist"):
         raise MXNetError(

@@ -111,7 +111,8 @@ class Module(BaseModule):
                 req[n] = grad_req if for_training else "null"
         if shared_module is not None and shared_module._exec is not None:
             # share parameter arrays (BucketingModule path)
-            exe = self._symbol.simple_bind(grad_req=req, **shapes)
+            exe = self._symbol.simple_bind(ctx=self._context, grad_req=req,
+                                           **shapes)
             for n in self._param_names:
                 if n in shared_module._exec.arg_dict:
                     exe.arg_dict[n] = shared_module._exec.arg_dict[n]
@@ -124,7 +125,8 @@ class Module(BaseModule):
                     exe.aux_dict[n] = shared_module._exec.aux_dict[n]
             self._exec = exe
         else:
-            self._exec = self._symbol.simple_bind(grad_req=req, **shapes)
+            self._exec = self._symbol.simple_bind(ctx=self._context,
+                                                  grad_req=req, **shapes)
         self.binded = True
 
     # ---------------------------------------------------------- parameters

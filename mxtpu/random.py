@@ -23,8 +23,17 @@ __all__ = ["seed", "next_key", "push_key_supply", "pop_key_supply"]
 
 class _RngState(threading.local):
     def __init__(self):
-        self.key = jax.random.key(0)
+        # lazy: materializing a key would initialize the XLA backend at
+        # `import mxtpu` time, which must stay legal BEFORE
+        # mxtpu.distributed.init() (jax.distributed refuses to start after
+        # backend init)
+        self.key = None
         self.supply = []  # stack of _KeySupply for active traces
+
+    def base_key(self):
+        if self.key is None:
+            self.key = jax.random.key(0)
+        return self.key
 
 
 _STATE = _RngState()
@@ -53,7 +62,7 @@ def next_key():
     """Return a fresh PRNG key (the per-op kRandom resource acquisition)."""
     if _STATE.supply:
         return _STATE.supply[-1].next()
-    _STATE.key, sub = jax.random.split(_STATE.key)
+    _STATE.key, sub = jax.random.split(_STATE.base_key())
     return sub
 
 

@@ -73,6 +73,13 @@ class Executor:
         self.outputs = []
         self._monitor = None
         self._last = None
+        # names bound as feed inputs (data/label); set by simple_bind. When
+        # ctx is a jax.sharding.Mesh these are batch-sharded over its 'data'
+        # axis and everything else is replicated — the classic Module API's
+        # answer to the reference's DataParallelExecutorGroup batch slicing
+        # (python/mxnet/module/executor_group.py:281): GSPMD partitions the
+        # one compiled program instead of running one executor per device.
+        self._input_names = set()
 
     # ------------------------------------------------------------- factory
     @staticmethod
@@ -92,8 +99,10 @@ class Executor:
         # feed shapes in `shapes` refer to data inputs; honor their dtypes
         aux = {n: zeros(s, dtype=type_dict.get(n, "float32"))
                for n, s in zip(aux_names, aux_shapes)}
-        return Executor(symbol, ctx=ctx, args=args, grad_req=grad_req,
-                        aux_states=aux)
+        exe = Executor(symbol, ctx=ctx, args=args, grad_req=grad_req,
+                       aux_states=aux)
+        exe._input_names = set(shapes)
+        return exe
 
     # ------------------------------------------------------------- running
     def _feed(self):
@@ -111,6 +120,7 @@ class Executor:
                 v._data if isinstance(v, NDArray) else v,
                 dtype=self.arg_dict[k]._data.dtype))
         feed = self._feed()
+        self._place_on_mesh(feed)
         prev = autograd.set_training(is_train)
         try:
             if self._monitor is not None:
@@ -121,6 +131,24 @@ class Executor:
             autograd.set_training(prev)
         self._last = (dict(feed), is_train)
         return self.outputs
+
+    def _place_on_mesh(self, feed):
+        """When bound to a Mesh ctx, commit feed inputs batch-sharded over
+        the 'data' axis and parameters replicated; the jit then compiles one
+        GSPMD program whose gradient all-reduce is implicit."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        if not isinstance(self._ctx, Mesh):
+            return
+        mesh = self._ctx
+        nd = mesh.shape.get("data", 0)
+        for name, arr in feed.items():
+            if nd and name in self._input_names and arr.shape \
+                    and arr.shape[0] % nd == 0:
+                spec = P("data")
+            else:
+                spec = P()
+            arr._set_data(jax.device_put(arr._data,
+                                         NamedSharding(mesh, spec)))
 
     def _run_jit(self, feed, is_train):
         key = (is_train,) + tuple(
@@ -239,8 +267,10 @@ class Executor:
             args[n] = cur if tuple(cur.shape) == tuple(s) else \
                 zeros(s, dtype=cur.dtype)
         aux = {n: a for n, a in self.aux_dict.items()}
-        return Executor(self._symbol, ctx=self._ctx, args=args,
-                        grad_req=self._grad_req, aux_states=aux)
+        exe = Executor(self._symbol, ctx=self._ctx, args=args,
+                       grad_req=self._grad_req, aux_states=aux)
+        exe._input_names = set(self._input_names)
+        return exe
 
     @property
     def output_dict(self):

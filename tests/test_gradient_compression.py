@@ -1,0 +1,56 @@
+"""2-bit gradient compression (ref: src/kvstore/gradient_compression.h;
+test model tests/python/unittest/test_kvstore.py compressed paths)."""
+import numpy as np
+import pytest
+
+from mxtpu.base import MXNetError
+from mxtpu.gradient_compression import GradientCompression
+
+
+def test_quantize_semantics():
+    gc = GradientCompression(threshold=0.5)
+    g = np.array([0.6, -0.7, 0.2, -0.2, 0.0], "float32")
+    packed, n = gc.quantize("k", g)
+    out = gc.dequantize(packed, n, g.shape)
+    np.testing.assert_allclose(out, [0.5, -0.5, 0.0, 0.0, 0.0])
+    # residual keeps the quantization error
+    np.testing.assert_allclose(gc._residuals["k"],
+                               [0.1, -0.2, 0.2, -0.2, 0.0], atol=1e-6)
+
+
+def test_error_feedback_accumulates():
+    """Sub-threshold gradients eventually fire thanks to the residual —
+    over many steps the sent total tracks the true total."""
+    gc = GradientCompression(threshold=0.5)
+    g = np.full((3,), 0.2, "float32")
+    sent = np.zeros(3)
+    for _ in range(10):
+        packed, n = gc.quantize("k", g)
+        sent += gc.dequantize(packed, n, g.shape)
+    np.testing.assert_allclose(sent, 2.0, atol=0.5)  # true total = 10*0.2
+
+
+def test_packing_roundtrip_shapes():
+    gc = GradientCompression(threshold=1.0)
+    rng = np.random.RandomState(0)
+    for shape in [(1,), (4,), (5,), (3, 7), (2, 3, 5)]:
+        g = rng.uniform(-3, 3, shape).astype("float32")
+        packed, n = gc.quantize(str(shape), g)
+        assert packed.dtype == np.uint8 and packed.size == -(-n // 4)
+        out = gc.dequantize(packed, n, shape)
+        assert out.shape == shape
+        assert set(np.unique(out)).issubset({-1.0, 0.0, 1.0})
+
+
+def test_rejects_bad_params():
+    with pytest.raises(MXNetError):
+        GradientCompression(type="1bit")
+    with pytest.raises(MXNetError):
+        GradientCompression(threshold=-1)
+
+
+def test_kvstore_accepts_compression_params():
+    import mxtpu as mx
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    assert kv._compression.threshold == 0.5

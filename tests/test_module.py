@@ -141,3 +141,38 @@ def test_ndarray_iter_pad_and_shuffle():
     assert batches[-1].pad == 2
     it2 = NDArrayIter(x, None, batch_size=4, last_batch_handle="discard")
     assert len(list(it2)) == 2
+
+
+def test_module_on_mesh_matches_single_device():
+    """Module(context=Mesh) runs the classic fit loop data-parallel over the
+    mesh (the reference's DataParallelExecutorGroup role) with identical
+    numerics to the unsharded run."""
+    import jax
+    from mxtpu.parallel import make_mesh
+
+    x, y = _toy_dataset(n=64)
+
+    def run(ctx):
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = _mlp_symbol()
+        mod = Module(net, context=ctx)
+        mod.bind(data_shapes=[DataDesc("data", (32, 8))],
+                 label_shapes=[DataDesc("softmax_label", (32,))])
+        mod.init_params(initializer=mx.init.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        losses = []
+        for i in range(4):
+            batch = DataBatch(data=[mx.nd.array(x[i * 32:(i + 1) * 32])],
+                              label=[mx.nd.array(y[i * 32:(i + 1) * 32])])
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            losses.append(mod.get_outputs()[0].asnumpy().copy())
+        return losses
+
+    plain = run(None)
+    mesh = run(make_mesh({"data": 8}, jax.devices()[:8]))
+    for a, b in zip(plain, mesh):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
