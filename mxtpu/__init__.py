@@ -48,6 +48,8 @@ from . import module
 from . import module as mod  # mx.mod alias
 from .module import Module
 from . import gluon
+from . import operator
+from . import contrib
 from . import rnn
 from . import parallel
 from . import test_utils

@@ -1,0 +1,152 @@
+"""INT8 post-training quantization driver
+(ref: python/mxnet/contrib/quantization.py + quantize_graph_pass.cc).
+
+The reference rewrites the NNVM graph: FP32 conv/FC nodes become
+quantized_conv/quantized_fully_connected bracketed by quantize/dequantize,
+with thresholds from a calibration pass (min/max or KL-entropy over a
+calibration dataset). TPU-native: the same three phases, expressed on gluon
+blocks instead of graph nodes —
+
+1. ``quantize_net(net)`` structurally swaps every Dense/Conv2D for a
+   Quantized* wrapper (the graph pass),
+2. ``calibrate(qnet, data_iter)`` runs FP32 forwards recording per-layer
+   input ranges (the calibration pass; ``mode="naive"`` min/max like the
+   reference's default),
+3. ``freeze(qnet)`` quantizes weights per-tensor symmetric int8 and flips
+   the wrappers to the int8 kernels (mxtpu/ops/quantization.py), which XLA
+   fuses into MXU int8 dot/conv with int32 accumulation.
+
+The wrappers stay HybridBlocks, so a frozen net hybridizes/exports like any
+other.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["quantize_net", "calibrate", "freeze", "quantize_model_gluon"]
+
+
+class _QuantizedLayer(HybridBlock):
+    """Shared calibrate/freeze machinery for wrapped FLOP layers."""
+
+    def __init__(self, inner, **kwargs):
+        super().__init__(**kwargs)
+        self._mode = "calib"
+        self._data_range = 0.0
+        self._w_range = None
+        self._wq = None
+        with self.name_scope():
+            self.inner = inner
+
+    def _observe(self, x):
+        self._data_range = max(self._data_range,
+                               float(np.abs(x.asnumpy()).max()) or 1e-6)
+
+    def freeze(self):
+        from .. import nd
+        w = self.inner.weight.data()
+        self._w_range = float(np.abs(w.asnumpy()).max()) or 1e-6
+        q, _, _ = nd.quantize(w, -self._w_range, self._w_range)
+        self._wq = q
+        self._mode = "int8"
+
+    def hybrid_forward(self, F, x, **params):
+        if self._mode == "calib":
+            self._observe(x)
+            return self.inner(x)
+        if self._mode != "int8":
+            raise MXNetError("call freeze() after calibration")
+        r = self._data_range
+        xq, _, _ = F.quantize(x, -r, r)
+        out = self._int8_forward(F, xq, r)
+        if getattr(self.inner, "act", None) is not None:
+            out = self.inner.act(out)
+        return out
+
+
+class QuantizedDense(_QuantizedLayer):
+    def _int8_forward(self, F, xq, r):
+        inner = self.inner
+        bias = None if inner.bias is None else inner.bias.data()
+        return F.quantized_fully_connected(
+            xq, self._wq, bias, min_data=-r, max_data=r,
+            min_weight=-self._w_range, max_weight=self._w_range,
+            no_bias=bias is None, flatten=inner._flatten,
+            num_hidden=inner._units)
+
+
+class QuantizedConv2D(_QuantizedLayer):
+    def _int8_forward(self, F, xq, r):
+        inner = self.inner
+        bias = None if inner.bias is None else inner.bias.data()
+        kw = inner._kwargs
+        return F.quantized_conv(
+            xq, self._wq, bias, min_data=-r, max_data=r,
+            min_weight=-self._w_range, max_weight=self._w_range,
+            kernel=kw["kernel"], stride=kw["stride"], dilate=kw["dilate"],
+            pad=kw["pad"], num_filter=kw["num_filter"],
+            num_group=kw["num_group"], no_bias=bias is None,
+            layout=kw["layout"])
+
+
+def quantize_net(net, exclude=()):
+    """Swap quantizable leaves in place; returns the same net
+    (the quantize_graph_pass analog). ``exclude``: layer name substrings to
+    keep FP32 (the reference's excluded_sym_names)."""
+    for parent, name, child in _walk(net):
+        if any(s in child.name for s in exclude):
+            continue
+        if isinstance(child, nn.Dense):
+            _swap(parent, name, QuantizedDense(child))
+        elif isinstance(child, nn.Conv2D) and type(child) is nn.Conv2D:
+            _swap(parent, name, QuantizedConv2D(child))
+    return net
+
+
+def _walk(block):
+    for name, child in list(block._children.items()):
+        yield block, name, child
+        yield from _walk(child)
+
+
+def _swap(parent, name, wrapper):
+    parent._children[name] = wrapper
+    # attribute access (net.fc1) must resolve to the wrapper too
+    for attr, val in list(vars(parent).items()):
+        if val is wrapper.inner:
+            object.__setattr__(parent, attr, wrapper)
+
+
+def calibrate(net, calib_data, num_batches=None):
+    """Run FP32 forwards so every wrapper records its input range
+    (ref: quantization.py _collect_layer_statistics, mode='naive')."""
+    for i, batch in enumerate(calib_data):
+        if num_batches is not None and i >= num_batches:
+            break
+        data = batch.data[0] if hasattr(batch, "data") else batch
+        net(data)
+    return net
+
+
+def freeze(net):
+    """Quantize weights and flip wrappers to the int8 kernels."""
+    n = 0
+    for _, _, child in _walk(net):
+        if isinstance(child, _QuantizedLayer):
+            child.freeze()
+            n += 1
+    if not n:
+        raise MXNetError("freeze: no quantized layers found; "
+                         "call quantize_net first")
+    return net
+
+
+def quantize_model_gluon(net, calib_data, exclude=(), num_batches=None):
+    """One-call flow (ref: quantize_model): pass -> calibrate -> freeze."""
+    quantize_net(net, exclude=exclude)
+    calibrate(net, calib_data, num_batches=num_batches)
+    return freeze(net)

@@ -1,0 +1,206 @@
+"""Custom operators with python callbacks (ref: python/mxnet/operator.py,
+kernel plumbing src/operator/custom/custom-inl.h + custom.cc).
+
+The reference runs user python code on a dedicated CustomOperator worker
+thread woven into the async engine so the callback can't deadlock the
+dependency scheduler. The TPU-native equivalent is ``jax.pure_callback``:
+XLA compiles a host-callback custom-call, the runtime ships device buffers
+to the host, the user's numpy code runs, and results stream back — working
+identically under eager dispatch, CachedOp/hybridize, and Symbol executors
+because they all lower through the same registry op. The gradient is a
+``jax.custom_vjp`` whose backward is a second pure_callback into the user's
+``CustomOp.backward``.
+
+API parity: ``CustomOp``/``CustomOpProp``/``operator.register`` and
+``mx.nd.Custom(*data, op_type=...)`` match the reference surface
+(operator.py:426-640).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get"]
+
+_PROPS = {}
+
+
+class CustomOp:
+    """User-defined forward/backward on numpy-like NDArrays
+    (ref: operator.py:426)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write src into dst honoring the grad request
+        (ref: operator.py:463)."""
+        if req in ("null", None):
+            return
+        if req == "add":
+            dst[:] = dst[:] + src
+        else:
+            dst[:] = src
+
+
+class CustomOpProp:
+    """Op metadata: arguments, outputs, shapes, types
+    (ref: operator.py:472). ``need_top_grad`` defaults True like the
+    reference (loss-style ops set it False)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp subclass under op_type=reg_name
+    (ref: operator.py:register)."""
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _PROPS[reg_name] = prop_cls
+        return prop_cls
+    return deco
+
+
+def get(op_type):
+    if op_type not in _PROPS:
+        raise MXNetError(
+            "custom op %r is not registered; use "
+            "@mxtpu.operator.register(%r) on a CustomOpProp" % (op_type,
+                                                                op_type))
+    return _PROPS[op_type]
+
+
+class _HostArray:
+    """The numpy view handed to user forward/backward — quacks enough like
+    an NDArray (asnumpy, shape, dtype, slice-assign) for reference-style op
+    code to run unchanged."""
+
+    def __init__(self, arr):
+        self._np = np.asarray(arr)
+
+    def asnumpy(self):
+        return self._np
+
+    @property
+    def shape(self):
+        return self._np.shape
+
+    @property
+    def dtype(self):
+        return self._np.dtype
+
+    def __getitem__(self, idx):
+        return self._np[idx]
+
+    def __setitem__(self, idx, val):
+        self._np[idx] = np.asarray(val._np if isinstance(val, _HostArray)
+                                   else val)
+
+
+def _custom_fn(op_type, n_inputs, **attrs):
+    """Build the jnp-level function for one Custom invocation signature."""
+    prop_cls = get(op_type)
+    kwargs = {k: str(v) for k, v in attrs.items()}
+    try:
+        prop = prop_cls(**kwargs)
+    except TypeError:
+        prop = prop_cls()
+    n_outputs = len(prop.list_outputs())
+
+    def _shapes_dtypes(in_datas):
+        in_shapes = [list(d.shape) for d in in_datas]
+        _, out_shapes, _ = prop.infer_shape(in_shapes)
+        in_types = [d.dtype for d in in_datas]
+        _, out_types, _ = prop.infer_type(in_types)
+        return [jax.ShapeDtypeStruct(tuple(s), t)
+                for s, t in zip(out_shapes, out_types)]
+
+    def _make_op(in_datas):
+        return prop.create_operator(
+            None, [list(d.shape) for d in in_datas],
+            [d.dtype for d in in_datas])
+
+    @jax.custom_vjp
+    def fn(*in_datas):
+        out_sds = _shapes_dtypes(in_datas)
+
+        def host_fwd(*arrs):
+            op = _make_op(arrs)
+            ins = [_HostArray(a) for a in arrs]
+            outs = [_HostArray(np.zeros(s.shape, s.dtype)) for s in out_sds]
+            op.forward(True, ["write"] * len(outs), ins, outs, [])
+            return tuple(o._np for o in outs)
+
+        out = jax.pure_callback(host_fwd, tuple(out_sds), *in_datas,
+                                vmap_method="sequential")
+        return out[0] if n_outputs == 1 else list(out)
+
+    def fwd(*in_datas):
+        return fn(*in_datas), in_datas
+
+    def bwd(in_datas, cots):
+        out_sds = _shapes_dtypes(in_datas)
+        cots = [cots] if n_outputs == 1 else list(cots)
+        in_sds = tuple(jax.ShapeDtypeStruct(d.shape, d.dtype)
+                       for d in in_datas)
+
+        def host_bwd(*arrs):
+            ins = [_HostArray(a) for a in arrs[:n_inputs]]
+            gouts = [_HostArray(a) for a in arrs[n_inputs:]]
+            op = _make_op(arrs[:n_inputs])
+            # recompute forward outputs for ops whose backward reads them
+            outs = [_HostArray(np.zeros(s.shape, s.dtype)) for s in out_sds]
+            op.forward(True, ["write"] * len(outs), ins, outs, [])
+            gins = [_HostArray(np.zeros(a.shape, a.dtype))
+                    for a in arrs[:n_inputs]]
+            op.backward(["write"] * len(gins), gouts, ins, outs, gins, [])
+            return tuple(g._np for g in gins)
+
+        gin = jax.pure_callback(host_bwd, in_sds, *(list(in_datas) + cots),
+                                vmap_method="sequential")
+        return tuple(gin)
+
+    fn.defvjp(fwd, bwd)
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_custom_fn(op_type, n_inputs, attr_items):
+    return _custom_fn(op_type, n_inputs, **dict(attr_items))
+
+
+def _invoke(op_type, data, attrs):
+    """Entry point for the registry-level `Custom` op (mxtpu/ops/custom.py)."""
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    fn = _cached_custom_fn(op_type, len(data), tuple(sorted(attrs.items())))
+    return fn(*data)

@@ -21,6 +21,8 @@ from . import contrib_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import ctc  # noqa: F401
+from . import custom  # noqa: F401
+from . import quantization  # noqa: F401
 from . import image_ops  # noqa: F401
 
 attach_methods()

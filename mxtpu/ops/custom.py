@@ -1,0 +1,12 @@
+"""The `Custom` registry op (ref: src/operator/custom/custom.cc — Custom is
+a real NNVM op whose attrs name a python-registered prop). Registering here,
+inside the ops import chain, puts it in the mx.nd / mx.sym namespaces like
+every other op; the callback machinery lives in mxtpu/operator.py.
+"""
+from .registry import register
+
+
+@register("Custom")
+def Custom(*data, op_type=None, **attrs):
+    from .. import operator as _operator
+    return _operator._invoke(op_type, data, attrs)

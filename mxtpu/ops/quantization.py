@@ -1,0 +1,122 @@
+"""INT8 quantization ops (ref: src/operator/quantization/*).
+
+The reference pairs int8 kernels (cuDNN/MKL-DNN) with a graph pass that
+inserts quantize/dequantize/requantize nodes and a python calibration driver
+(python/mxnet/contrib/quantization.py). TPU-native: the int8 compute is one
+``lax.dot_general`` / ``conv_general_dilated`` with
+``preferred_element_type=int32`` — XLA lowers that to the MXU's native int8
+path (2x the bf16 throughput on v5e) — and scales stay ordinary traced
+scalars so calibrated models still compile into single fused programs.
+
+Semantics follow the reference's signed-symmetric path
+(quantize-inl.h:75-78): real range ``r = max(|min|, |max|)`` maps to
+quantized range 127, ``q = sign(x) * min(|x| * 127/r + 0.5, 127)``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_QMAX = 127.0
+
+
+def _real_range(min_range, max_range):
+    return jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+
+
+@register("_contrib_quantize", aliases=("quantize",))
+def quantize(data, min_range, max_range, out_type="int8"):
+    """f32 -> int8 + (min, max) carried through (ref: quantize.cc).
+    Returns [quantized, min_range, max_range] like the reference's 3-output
+    convention so downstream quantized ops see the calibration range."""
+    r = _real_range(jnp.float32(min_range), jnp.float32(max_range))
+    scale = _QMAX / r
+    x = jnp.asarray(data, jnp.float32)
+    q = jnp.sign(x) * jnp.minimum(jnp.abs(x) * scale + 0.5, _QMAX)
+    return [lax.convert_element_type(q, jnp.int8),
+            -r.astype(jnp.float32), r.astype(jnp.float32)]
+
+
+@register("_contrib_dequantize", aliases=("dequantize",))
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """int8 -> f32 (ref: dequantize.cc)."""
+    r = _real_range(jnp.float32(min_range), jnp.float32(max_range))
+    return jnp.asarray(data, jnp.float32) * (r / _QMAX)
+
+
+@register("_contrib_requantize", aliases=("requantize",))
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 (accumulator) -> int8 with a narrower calibrated range
+    (ref: requantize.cc). min/max_range describe the int32's real range."""
+    r32 = _real_range(jnp.float32(min_range), jnp.float32(max_range))
+    real = jnp.asarray(data, jnp.float32) * (r32 / (2.0 ** 31 - 1))
+    if min_calib_range is not None and max_calib_range is not None:
+        r8 = _real_range(jnp.float32(min_calib_range),
+                         jnp.float32(max_calib_range))
+    else:
+        r8 = r32
+    q = jnp.sign(real) * jnp.minimum(jnp.abs(real) * (_QMAX / r8) + 0.5,
+                                     _QMAX)
+    return [lax.convert_element_type(q, jnp.int8),
+            -r8.astype(jnp.float32), r8.astype(jnp.float32)]
+
+
+@register("_contrib_quantized_fully_connected",
+          aliases=("quantized_fully_connected",))
+def quantized_fully_connected(data, weight, bias=None, min_data=None,
+                              max_data=None, min_weight=None, max_weight=None,
+                              min_bias=None, max_bias=None, num_hidden=None,
+                              no_bias=False, flatten=True):
+    """int8 x int8 -> f32 FC (ref: quantized_fully_connected.cc).
+
+    The int8 contraction accumulates in int32 on the MXU
+    (preferred_element_type), then one dequant scale maps back to real
+    units; the f32 bias adds after dequant (the reference quantizes the
+    bias too — shifting it into the int32 domain costs precision for no TPU
+    win, so bias stays f32 here).
+    """
+    x = jnp.asarray(data, jnp.int8)
+    if flatten and x.ndim > 2:
+        x = jnp.reshape(x, (x.shape[0], -1))
+    acc = lax.dot_general(x, jnp.asarray(weight, jnp.int8),
+                          (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    sx = _real_range(jnp.float32(min_data), jnp.float32(max_data)) / _QMAX
+    sw = _real_range(jnp.float32(min_weight), jnp.float32(max_weight)) / _QMAX
+    out = acc.astype(jnp.float32) * (sx * sw)
+    if bias is not None and not no_bias:
+        out = out + jnp.asarray(bias, jnp.float32)
+    return out
+
+
+@register("_contrib_quantized_conv", aliases=("quantized_conv",))
+def quantized_conv(data, weight, bias=None, min_data=None, max_data=None,
+                   min_weight=None, max_weight=None, min_bias=None,
+                   max_bias=None, kernel=None, stride=None, dilate=None,
+                   pad=None, num_filter=None, num_group=1, no_bias=False,
+                   layout=None):
+    """int8 conv with int32 accumulation (ref: quantized_conv.cc)."""
+    from .nn import _conv_dims, _pair
+    ndim = data.ndim - 2
+    stride = _pair(stride, ndim)
+    dilate = _pair(dilate, ndim)
+    pad = _pair(pad, ndim) if pad is not None else (0,) * ndim
+    dims = _conv_dims(ndim, layout)
+    channels_last = dims[0][-1] == "C"
+    acc = lax.conv_general_dilated(
+        jnp.asarray(data, jnp.int8), jnp.asarray(weight, jnp.int8),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dims,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    sx = _real_range(jnp.float32(min_data), jnp.float32(max_data)) / _QMAX
+    sw = _real_range(jnp.float32(min_weight), jnp.float32(max_weight)) / _QMAX
+    out = acc.astype(jnp.float32) * (sx * sw)
+    if bias is not None and not no_bias:
+        b = jnp.asarray(bias, jnp.float32)
+        out = out + (b if channels_last
+                     else jnp.reshape(b, (1, -1) + (1,) * ndim))
+    return out

@@ -1,0 +1,121 @@
+"""Custom python-callback ops (ref: python/mxnet/operator.py surface;
+tests/python/unittest/test_operator.py:test_custom_op patterns).
+
+The VERDICT gap: eager-only autograd.Function existed, but no python op
+usable from jit/hybridize/Symbol. These tests pin all three paths.
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import gluon
+from mxtpu.base import MXNetError
+from mxtpu.gluon import nn
+
+
+@mx.operator.register("sqr")
+class SqrProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return Sqr()
+
+
+class Sqr(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0].asnumpy() ** 2)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0],
+                    2 * in_data[0].asnumpy() * out_grad[0].asnumpy())
+
+
+def test_custom_eager_forward_backward():
+    x = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(x, op_type="sqr")
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() ** 2)
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_custom_unregistered_raises():
+    with pytest.raises(MXNetError, match="not registered"):
+        mx.nd.Custom(mx.nd.ones((2,)), op_type="nope")
+
+
+class _CustomBlock(gluon.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.dense = nn.Dense(4, in_units=3)
+
+    def hybrid_forward(self, F, x):
+        return F.Custom(self.dense(x), op_type="sqr")
+
+
+def test_custom_trains_inside_hybridized_block():
+    """The VERDICT item verbatim: a python-defined op trains inside a
+    hybridized (jit-compiled) block."""
+    np.random.seed(0)
+    net = _CustomBlock()
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.uniform(0.5, 1.5, (8, 3)))
+    y = mx.nd.array(np.random.uniform(0.5, 1.5, (8, 4)))
+    loss_fn = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+    first = None
+    for _ in range(15):
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(8)
+        v = float(loss.mean().asnumpy())
+        first = first if first is not None else v
+    assert v < first * 0.8, (first, v)
+
+
+def test_custom_from_symbol():
+    data = mx.sym.var("data")
+    out = mx.sym.Custom(data, op_type="sqr", name="sq")
+    exe = out.simple_bind(data=(2, 3))
+    r = exe.forward(data=mx.nd.full((2, 3), 3.0))[0]
+    np.testing.assert_allclose(r.asnumpy(), np.full((2, 3), 9.0))
+    exe.backward(out_grads=mx.nd.ones((2, 3)))
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                               np.full((2, 3), 6.0))
+
+
+@mx.operator.register("twoout")
+class TwoOutProp(mx.operator.CustomOpProp):
+    def list_outputs(self):
+        return ["sum", "diff"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return TwoOut()
+
+
+class TwoOut(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        a = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], a + 1.0)
+        self.assign(out_data[1], req[1], a - 1.0)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0],
+                    out_grad[0].asnumpy() + out_grad[1].asnumpy())
+
+
+def test_custom_multi_output():
+    x = mx.nd.array([1.0, 2.0])
+    outs = mx.nd.Custom(x, op_type="twoout")
+    np.testing.assert_allclose(outs[0].asnumpy(), [2.0, 3.0])
+    np.testing.assert_allclose(outs[1].asnumpy(), [0.0, 1.0])

@@ -1,0 +1,120 @@
+"""INT8 quantization flow (ref: src/operator/quantization/*,
+python/mxnet/contrib/quantization.py; test model
+tests/python/quantization/test_quantization.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import gluon
+from mxtpu.contrib import quantization as q
+from mxtpu.gluon import nn
+
+
+def test_quantize_dequantize_roundtrip():
+    x = mx.nd.array(np.linspace(-3, 3, 64).astype("float32"))
+    xq, mn, mx_ = mx.nd.quantize(x, -3.0, 3.0)
+    assert xq.dtype == np.int8
+    back = mx.nd.dequantize(xq, mn, mx_)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy(), atol=3.0 / 127 + 1e-6)
+
+
+def test_quantize_saturates():
+    x = mx.nd.array([-10.0, 0.0, 10.0])
+    xq, _, _ = mx.nd.quantize(x, -1.0, 1.0)
+    np.testing.assert_array_equal(xq.asnumpy(), [-127, 0, 127])
+
+
+def test_quantized_fully_connected_matches_fp32():
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (4, 8)).astype("float32")
+    w = rng.uniform(-0.5, 0.5, (3, 8)).astype("float32")
+    b = rng.uniform(-0.1, 0.1, (3,)).astype("float32")
+    want = x @ w.T + b
+    xq, _, _ = mx.nd.quantize(mx.nd.array(x), -1.0, 1.0)
+    wq, _, _ = mx.nd.quantize(mx.nd.array(w), -0.5, 0.5)
+    got = mx.nd.quantized_fully_connected(
+        xq, wq, mx.nd.array(b), min_data=-1.0, max_data=1.0,
+        min_weight=-0.5, max_weight=0.5).asnumpy()
+    np.testing.assert_allclose(got, want, atol=0.08)
+
+
+def test_quantized_conv_matches_fp32():
+    rng = np.random.RandomState(1)
+    x = rng.uniform(-1, 1, (2, 3, 8, 8)).astype("float32")
+    w = rng.uniform(-0.5, 0.5, (5, 3, 3, 3)).astype("float32")
+    want = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                             pad=(1, 1), num_filter=5, no_bias=True).asnumpy()
+    xq, _, _ = mx.nd.quantize(mx.nd.array(x), -1.0, 1.0)
+    wq, _, _ = mx.nd.quantize(mx.nd.array(w), -0.5, 0.5)
+    got = mx.nd.quantized_conv(
+        xq, wq, None, min_data=-1.0, max_data=1.0, min_weight=-0.5,
+        max_weight=0.5, kernel=(3, 3), pad=(1, 1), num_filter=5,
+        no_bias=True).asnumpy()
+    err = np.abs(got - want).max()
+    assert err < 0.3, err  # int8 conv over 27-elem receptive field
+
+
+def _toy_images(n=512, seed=0):
+    """4-class problem: bright quadrant of a 12x12 image."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 4, n)
+    x = rng.uniform(0, 0.3, (n, 1, 12, 12)).astype("float32")
+    for i, c in enumerate(y):
+        r, cc = divmod(int(c), 2)
+        x[i, 0, r * 6:(r + 1) * 6, cc * 6:(cc + 1) * 6] += 0.7
+    return x, y.astype("float32")
+
+
+def test_quantize_trained_cnn_accuracy_drop_below_1pct():
+    """The VERDICT acceptance test: quantize a trained small CNN and show
+    <1%% accuracy drop vs fp32."""
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Conv2D(16, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Flatten(),
+            nn.Dense(32, activation="relu"),
+            nn.Dense(4))
+    net.initialize()
+    x, y = _toy_images()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    bs = 64
+    for epoch in range(4):
+        for i in range(0, len(x), bs):
+            xb = mx.nd.array(x[i:i + bs])
+            yb = mx.nd.array(y[i:i + bs])
+            with mx.autograd.record():
+                loss = loss_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(bs)
+
+    def accuracy(m):
+        pred = m(mx.nd.array(x)).asnumpy().argmax(axis=1)
+        return (pred == y).mean()
+
+    acc_fp32 = accuracy(net)
+    assert acc_fp32 > 0.9, acc_fp32
+
+    calib = [mx.nd.array(x[i:i + bs]) for i in range(0, 256, bs)]
+    q.quantize_model_gluon(net, calib)
+    acc_int8 = accuracy(net)
+    assert acc_fp32 - acc_int8 < 0.01, (acc_fp32, acc_int8)
+
+
+def test_quantized_net_hybridizes():
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    xs = mx.nd.array(np.random.uniform(-1, 1, (4, 5)).astype("float32"))
+    net(xs)
+    q.quantize_model_gluon(net, [xs])
+    eager = net(xs).asnumpy()
+    net.hybridize()
+    hybrid = net(xs).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-5)
