@@ -1,0 +1,65 @@
+"""Isolate the effect of jax_default_matmul_precision and dtype mixing on
+conv fwd/bwd time (scan-fused to avoid tunnel RTT)."""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def timed(name, jfn, *args, K=None):
+    out = jfn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = jfn(*args)
+    v = np.asarray(jax.device_get(out))
+    dt = time.perf_counter() - t0 - 0.0665  # subtract measured tunnel RTT
+    if K:
+        dt /= K
+    print("%-46s %8.2f ms" % (name, dt * 1e3))
+    return v
+
+
+def conv_stack(prec, dtype, bwd):
+    # 8 chained 3x3 convs at 56x56x256 — MXU-heavy, resnet-like
+    k = jax.random.PRNGKey(0)
+    x = jax.random.normal(k, (128, 56, 56, 128), dtype)
+    w = jax.random.normal(k, (3, 3, 128, 128), dtype)
+    dn = ("NHWC", "HWIO", "NHWC")
+
+    def f(x, w):
+        for _ in range(8):
+            x = lax.conv_general_dilated(x, w, (1, 1), "SAME",
+                                         dimension_numbers=dn,
+                                         precision=prec)
+        return jnp.sum(x * 1e-30)
+
+    if bwd:
+        g = jax.grad(f, argnums=(0, 1))
+
+        def body(c, _):
+            gx, gw = g(c[0], c[1])
+            return (c[0] + gx * 0, c[1] + gw * 0), None
+
+        jfn = jax.jit(lambda x, w: lax.scan(body, (x, w), None, length=5)[0][1])
+        timed("conv8 %s prec=%s grad" % (dtype, prec), jfn, x, w, K=5)
+    else:
+        def body(c, _):
+            return (f(c[0], c[1]) * 0 + c[0], c[1]), None
+
+        jfn = jax.jit(lambda x, w: lax.scan(body, (x, w), None, length=5)[0][1])
+        timed("conv8 %s prec=%s fwd" % (dtype, prec), jfn, x, w, K=5)
+
+
+def main():
+    print("default_matmul_precision =",
+          jax.config.jax_default_matmul_precision)
+    for dtype in ("bfloat16", "float32"):
+        for prec in (None, "default", "highest"):
+            conv_stack(prec, dtype, bwd=False)
+            conv_stack(prec, dtype, bwd=True)
+
+
+if __name__ == "__main__":
+    main()

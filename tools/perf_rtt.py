@@ -1,0 +1,92 @@
+"""Measure axon-tunnel dispatch overhead vs on-chip compute.
+
+a) trivial op dispatch+sync latency (tunnel RTT floor)
+b) fwd with a true host fetch each iteration
+c) N train steps fused into ONE dispatch via lax.scan -> per-step chip time
+"""
+import os
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    # a) trivial dispatch latency
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.ones((8, 8))
+    float(f(x).sum())
+    for label, sync in (("block_until_ready", lambda o: jax.block_until_ready(o)),
+                        ("device_get", lambda o: jax.device_get(o))):
+        t0 = time.perf_counter()
+        for _ in range(20):
+            o = f(x)
+            sync(o)
+        dt = (time.perf_counter() - t0) / 20
+        print("trivial op, %-17s: %.3f ms" % (label, dt * 1e3))
+    # async pipelining: 20 dispatches, one sync at end
+    t0 = time.perf_counter()
+    for _ in range(20):
+        o = f(x)
+    jax.device_get(o)
+    print("trivial op, sync-at-end    : %.3f ms/step"
+          % ((time.perf_counter() - t0) / 20 * 1e3))
+
+    import mxtpu as mx
+    from mxtpu import gluon
+    from mxtpu.gluon.model_zoo import vision
+    from mxtpu.parallel import pure_forward
+
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    with mx.layout("NHWC"):
+        net = vision.resnet50_v1()
+    net.initialize()
+    x = mx.nd.array(np.random.uniform(-1, 1, (batch, 224, 224, 3)),
+                    dtype="float32")
+    net(x)
+    net.cast("bfloat16")
+    x = x.astype("bfloat16")
+    yl = mx.nd.array(np.random.randint(0, 1000, (batch,)), dtype="float32")
+
+    # b) fwd with true host fetch
+    fn, params = pure_forward(net)
+    jfwd = jax.jit(lambda p, d: fn(p, d).sum())
+    float(jfwd(params, x._data))
+    t0 = time.perf_counter()
+    for _ in range(10):
+        v = float(jfwd(params, x._data))
+    dt = (time.perf_counter() - t0) / 10
+    print("fwd + host fetch           : %.2f ms" % (dt * 1e3))
+
+    # c) K steps of fwd+bwd+sgd inside one scan = one dispatch
+    fn_t, params_t = pure_forward(net, train=True)
+    loss_blk = gluon.loss.SoftmaxCrossEntropyLoss()
+    from mxtpu.ndarray import NDArray
+
+    def loss_of(p, xd, yd):
+        out = fn_t(p, xd)
+        return jnp.mean(loss_blk(NDArray(out), NDArray(yd))._data)
+
+    def one_step(p, _):
+        l, g = jax.value_and_grad(loss_of)(p, x._data, yl._data)
+        p = [(w - 0.01 * gw.astype(w.dtype)) for w, gw in zip(p, g)]
+        return p, l
+
+    K = 10
+
+    @jax.jit
+    def multi(p):
+        p, ls = jax.lax.scan(one_step, p, None, length=K)
+        return ls[-1]
+
+    float(multi(params_t))  # compile+run
+    t0 = time.perf_counter()
+    float(multi(params_t))
+    dt = time.perf_counter() - t0
+    print("scan(%d) fwd+bwd+sgd       : %.2f ms/step -> %.0f img/s"
+          % (K, dt / K * 1e3, batch * K / dt))
+
+
+if __name__ == "__main__":
+    main()

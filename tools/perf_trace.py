@@ -1,0 +1,86 @@
+"""Capture a jax.profiler device trace of the resnet50 train step and print
+per-op time aggregates (PERF.md evidence)."""
+import glob
+import gzip
+import os
+import sys
+import time
+from collections import defaultdict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+LOGDIR = "/tmp/mxtpu_trace"
+
+
+def build_step():
+    import mxtpu as mx
+    from mxtpu import gluon
+    from mxtpu.gluon.model_zoo import vision
+    from mxtpu.parallel import pure_forward
+    from mxtpu.ndarray import NDArray
+
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    with mx.layout("NHWC"):
+        net = vision.resnet50_v1()
+    net.initialize()
+    x = mx.nd.array(np.random.uniform(-1, 1, (batch, 224, 224, 3)),
+                    dtype="float32")
+    net(x)
+    net.cast("bfloat16")
+    x = x.astype("bfloat16")
+    yl = mx.nd.array(np.random.randint(0, 1000, (batch,)), dtype="float32")
+    fn_t, params_t = pure_forward(net, train=True)
+    loss_blk = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def loss_of(p, xd, yd):
+        out = fn_t(p, xd)
+        return jnp.mean(loss_blk(NDArray(out), NDArray(yd))._data)
+
+    @jax.jit
+    def step(p, xd, yd):
+        l, g = jax.value_and_grad(loss_of)(p, xd, yd)
+        return [(w - 0.01 * gw.astype(w.dtype)) for w, gw in zip(p, g)], l
+
+    return step, params_t, x._data, yl._data
+
+
+def main():
+    step, p, xd, yd = build_step()
+    newp, l = step(p, xd, yd)
+    float(l)  # ensure compiled + executed
+
+    os.system("rm -rf %s" % LOGDIR)
+    with jax.profiler.trace(LOGDIR):
+        for _ in range(3):
+            newp, l = step(p, xd, yd)
+        float(l)
+
+    # parse the xplane protobuf with the tensorboard plugin
+    from tensorboard_plugin_profile.convert import raw_to_tool_data
+
+    files = glob.glob(LOGDIR + "/**/*.xplane.pb", recursive=True)
+    print("xplane files:", files)
+    if not files:
+        return
+    data, _ = raw_to_tool_data.xspace_to_tool_data(files, "framework_op_stats",
+                                                   {})
+    out = LOGDIR + "/op_stats.csv"
+    blob = data if isinstance(data, (bytes, str)) else data[0]
+    if isinstance(blob, bytes):
+        blob = blob.decode()
+    with open(out, "w") as f:
+        f.write(blob)
+    print("wrote", out)
+    # print top rows
+    import csv
+    rows = list(csv.DictReader(blob.splitlines()))
+    rows.sort(key=lambda r: -float(r.get("total_self_time_in_us") or
+                                   r.get("self_time.2c_us") or 0))
+    for r in rows[:25]:
+        print(r)
+
+
+if __name__ == "__main__":
+    main()
