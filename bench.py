@@ -250,8 +250,14 @@ CONFIGS = {
 def main():
     name = os.environ.get("BENCH_CONFIG", "all")
     if name == "all":
-        for fn in CONFIGS.values():
-            print(json.dumps(fn()), flush=True)
+        # per-config isolation: a failing config must not eat the headline
+        # resnet50 line (the driver parses the LAST printed line)
+        for cname, fn in CONFIGS.items():
+            try:
+                print(json.dumps(fn()), flush=True)
+            except Exception as e:  # noqa: BLE001 - report and move on
+                print(json.dumps({"metric": cname, "error": str(e)}),
+                      flush=True)
         return
     print(json.dumps(CONFIGS[name]()))
 

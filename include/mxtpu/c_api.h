@@ -1,0 +1,83 @@
+/*
+ * mxtpu C ABI — flat C surface over the TPU-native runtime.
+ *
+ * Reference parity: include/mxnet/c_api.h (~194 MX* functions) and
+ * include/mxnet/c_predict_api.h in /root/reference. The reference's C ABI
+ * fronts its C++ engine; here the runtime orchestrator is the Python/JAX
+ * layer (XLA:TPU does the computing), so this ABI embeds — or attaches to —
+ * a CPython interpreter and routes calls through mxtpu.c_api_impl. That
+ * keeps the layering SURVEY §2.6 asks for: any frontend that can speak C
+ * can drive the framework without knowing it is JAX underneath.
+ *
+ * Conventions (mirroring the reference):
+ *   - every function returns 0 on success, -1 on failure;
+ *   - MXTPUGetLastError() returns the failure message for this thread;
+ *   - handles are opaque; free them with the matching *Free call.
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *NDArrayHandle;
+typedef void *PredictorHandle;
+
+/* Last error message for the calling thread (never NULL). */
+const char *MXTPUGetLastError(void);
+
+/* Optional eager runtime bring-up (first API call does this lazily).
+ * platform may be "tpu", "cpu", or NULL for the environment default. */
+int MXTPURuntimeInit(const char *platform);
+
+/* ---- NDArray (ref: MXNDArrayCreate* / MXNDArraySyncCopy*) ---- */
+
+/* Create from a float32 host blob. */
+int MXTPUNDArrayCreateFromBlob(const float *data, const int64_t *shape,
+                               int ndim, NDArrayHandle *out);
+
+/* ndim/shape of the array; shape must have room for 8 dims. */
+int MXTPUNDArrayShape(NDArrayHandle handle, int *ndim, int64_t *shape);
+
+/* Synchronous device->host copy as float32 (the deferred-exception sync
+ * point: async errors surface here, ref threaded_engine.cc:472). */
+int MXTPUNDArraySyncCopyToCPU(NDArrayHandle handle, float *dst, int64_t size);
+
+int MXTPUNDArrayFree(NDArrayHandle handle);
+
+/* ---- imperative invoke (ref: MXImperativeInvokeEx) ----
+ * Invokes a registered operator by name. String attrs are parsed as Python
+ * literals where possible. outputs must have capacity *num_outputs; the
+ * actual count is written back. */
+int MXTPUImperativeInvoke(const char *op_name, NDArrayHandle *inputs,
+                          int num_inputs, const char **attr_keys,
+                          const char **attr_vals, int num_attrs,
+                          NDArrayHandle *outputs, int *num_outputs);
+
+/* ---- predict API (ref: c_predict_api.h MXPred*) ----
+ * Loads "<prefix>-symbol.json" + "<prefix>-%04d.params" (the checkpoint
+ * format of mxtpu.model.save_checkpoint / Block.export). */
+int MXTPUPredCreate(const char *prefix, int epoch, const char *input_name,
+                    const int64_t *shape, int ndim, PredictorHandle *out);
+
+int MXTPUPredSetInput(PredictorHandle handle, const float *data,
+                      int64_t size);
+
+int MXTPUPredForward(PredictorHandle handle);
+
+int MXTPUPredGetOutputShape(PredictorHandle handle, int index, int *ndim,
+                            int64_t *shape);
+
+int MXTPUPredGetOutput(PredictorHandle handle, int index, float *dst,
+                       int64_t size);
+
+int MXTPUPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_C_API_H_ */

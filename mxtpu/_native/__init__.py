@@ -20,28 +20,82 @@ _SRC_DIR = os.path.normpath(os.path.join(_HERE, "..", "..", "src"))
 _SO_PATH = os.path.join(_HERE, "_libmxtpu.so")
 
 
+def _have_python_dev():
+    import sysconfig
+    inc = sysconfig.get_paths().get("include")
+    return bool(inc) and os.path.exists(os.path.join(inc, "Python.h"))
+
+
 def _sources():
     out = []
+    skip_c_api = not _have_python_dev()
     for root, _dirs, files in os.walk(_SRC_DIR):
+        # the C ABI needs Python.h; without it, still build the rest
+        # (recordio etc.) rather than losing the whole native fast path
+        if skip_c_api and os.path.basename(root) == "c_api":
+            continue
         for f in sorted(files):
             if f.endswith(".cc"):
                 out.append(os.path.join(root, f))
     return out
 
 
+_STAMP_PATH = _SO_PATH + ".stamp"
+
+
+def _build_stamp():
+    """Cache key beyond source mtimes: the build bakes in this interpreter's
+    include dir / libpython / rpath, so a different venv must rebuild."""
+    import sys
+    import sysconfig
+    return "%s|%s|%s" % (sys.version, sysconfig.get_config_var("LIBDIR"),
+                         sysconfig.get_config_var("LDVERSION"))
+
+
 def _needs_build(sources):
     if not os.path.exists(_SO_PATH):
+        return True
+    try:
+        with open(_STAMP_PATH) as f:
+            if f.read() != _build_stamp():
+                return True
+    except OSError:
         return True
     so_mtime = os.path.getmtime(_SO_PATH)
     return any(os.path.getmtime(s) > so_mtime for s in sources)
 
 
+def _python_flags():
+    """Compile/link flags for the embedded-CPython C ABI (src/c_api/).
+
+    The C ABI delegates to mxtpu.c_api_impl through the CPython API: inside
+    a Python process the symbols resolve from the interpreter; a plain-C
+    host gets them from the linked libpython (python3-config --embed).
+    """
+    import sysconfig
+    inc = sysconfig.get_paths().get("include")
+    cflags = ["-I" + inc] if inc else []
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION")
+    ldflags = []
+    if libdir and ver:
+        ldflags = ["-L" + libdir, "-Wl,-rpath," + libdir, "-lpython" + ver]
+    return cflags, ldflags
+
+
 def _build(sources):
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           "-o", _SO_PATH] + sources
+    if _have_python_dev():
+        cflags, ldflags = _python_flags()
+    else:
+        cflags, ldflags = [], []
+    cmd = (["g++", "-O3", "-shared", "-fPIC", "-std=c++17"] + cflags +
+           ["-o", _SO_PATH] + sources + ldflags)
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError("native build failed:\n%s" % proc.stderr)
+    with open(_STAMP_PATH, "w") as f:
+        f.write(_build_stamp())
 
 
 def get_lib():
@@ -73,6 +127,8 @@ def build_error():
 
 def _configure(lib):
     u64 = ctypes.c_uint64
+    if hasattr(lib, "MXTPUGetLastError"):  # absent when built w/o Python.h
+        _configure_c_api(lib)
     lib.mxtpu_recordio_writer_create.restype = ctypes.c_void_p
     lib.mxtpu_recordio_writer_create.argtypes = [ctypes.c_char_p,
                                                  ctypes.c_char_p]
@@ -94,3 +150,42 @@ def _configure(lib):
     lib.mxtpu_recordio_reader_tell.argtypes = [ctypes.c_void_p]
     lib.mxtpu_recordio_reader_close.restype = None
     lib.mxtpu_recordio_reader_close.argtypes = [ctypes.c_void_p]
+
+
+def _configure_c_api(lib):
+    """ctypes signatures for the flat C ABI (include/mxtpu/c_api.h)."""
+    p = ctypes.c_void_p
+    pp = ctypes.POINTER(p)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    ip = ctypes.POINTER(ctypes.c_int)
+    fp = ctypes.POINTER(ctypes.c_float)
+    ccp = ctypes.c_char_p
+    cpp = ctypes.POINTER(ccp)
+    lib.MXTPUGetLastError.restype = ctypes.c_char_p
+    lib.MXTPUGetLastError.argtypes = []
+    lib.MXTPURuntimeInit.restype = ctypes.c_int
+    lib.MXTPURuntimeInit.argtypes = [ccp]
+    lib.MXTPUNDArrayCreateFromBlob.restype = ctypes.c_int
+    lib.MXTPUNDArrayCreateFromBlob.argtypes = [fp, i64p, ctypes.c_int, pp]
+    lib.MXTPUNDArrayShape.restype = ctypes.c_int
+    lib.MXTPUNDArrayShape.argtypes = [p, ip, i64p]
+    lib.MXTPUNDArraySyncCopyToCPU.restype = ctypes.c_int
+    lib.MXTPUNDArraySyncCopyToCPU.argtypes = [p, fp, ctypes.c_int64]
+    lib.MXTPUNDArrayFree.restype = ctypes.c_int
+    lib.MXTPUNDArrayFree.argtypes = [p]
+    lib.MXTPUImperativeInvoke.restype = ctypes.c_int
+    lib.MXTPUImperativeInvoke.argtypes = [ccp, pp, ctypes.c_int, cpp, cpp,
+                                          ctypes.c_int, pp, ip]
+    lib.MXTPUPredCreate.restype = ctypes.c_int
+    lib.MXTPUPredCreate.argtypes = [ccp, ctypes.c_int, ccp, i64p,
+                                    ctypes.c_int, pp]
+    lib.MXTPUPredSetInput.restype = ctypes.c_int
+    lib.MXTPUPredSetInput.argtypes = [p, fp, ctypes.c_int64]
+    lib.MXTPUPredForward.restype = ctypes.c_int
+    lib.MXTPUPredForward.argtypes = [p]
+    lib.MXTPUPredGetOutputShape.restype = ctypes.c_int
+    lib.MXTPUPredGetOutputShape.argtypes = [p, ctypes.c_int, ip, i64p]
+    lib.MXTPUPredGetOutput.restype = ctypes.c_int
+    lib.MXTPUPredGetOutput.argtypes = [p, ctypes.c_int, fp, ctypes.c_int64]
+    lib.MXTPUPredFree.restype = ctypes.c_int
+    lib.MXTPUPredFree.argtypes = [p]

@@ -1,0 +1,119 @@
+"""Python guts of the C ABI (src/c_api/c_api.cc delegates here).
+
+The reference's src/c_api/*.cc marshals C arguments into its C++ engine;
+the TPU-native runtime's orchestrator is this package, so the C layer
+marshals into these functions instead. Every function takes/returns only
+plain C-friendly values (bytes, tuples, opaque objects used as handles).
+
+Env: set ``MXTPU_JAX_PLATFORMS`` (e.g. ``cpu``) before the first call to pin
+the jax platform from a C host — the axon sitecustomize would otherwise
+override ``JAX_PLATFORMS``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+_PLATFORM_PIN = os.environ.get("MXTPU_JAX_PLATFORMS")
+if _PLATFORM_PIN:
+    import jax
+
+    jax.config.update("jax_platforms", _PLATFORM_PIN)
+
+import numpy as np  # noqa: E402
+
+from . import ndarray as nd  # noqa: E402
+from . import ops  # noqa: E402
+from .base import MXNetError  # noqa: E402
+from .model import load_checkpoint  # noqa: E402
+from .ndarray import NDArray  # noqa: E402
+
+
+def runtime_init(platform=None):
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    import jax
+
+    jax.devices()  # force backend bring-up so later calls are fast
+    return True
+
+
+def ndarray_from_blob(data: bytes, shape: tuple) -> NDArray:
+    arr = np.frombuffer(data, dtype=np.float32).reshape(shape)
+    return nd.array(arr)
+
+
+def ndarray_shape(handle: NDArray) -> tuple:
+    return tuple(int(d) for d in handle.shape)
+
+
+def ndarray_to_bytes(handle: NDArray) -> bytes:
+    return np.ascontiguousarray(handle.asnumpy().astype(np.float32)).tobytes()
+
+
+def _parse_attr(v: str):
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def imperative_invoke(name: str, inputs: list, attrs: dict) -> list:
+    kwargs = {k: _parse_attr(v) for k, v in attrs.items()}
+    out = ops.invoke(name, *inputs, **kwargs)
+    if isinstance(out, (list, tuple)):
+        return list(out)
+    return [out]
+
+
+class _Predictor:
+    """C-predict-API state (ref: src/c_api/c_predict_api.cc:59-213 — the
+    reference binds a static executor; here bind = jit-compiled Symbol
+    executor over the same checkpoint format)."""
+
+    def __init__(self, prefix, epoch, input_name, shape):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        if symbol is None:
+            raise MXNetError("no symbol file for prefix %r" % prefix)
+        self.input_name = input_name
+        self.shape = tuple(int(d) for d in shape)
+        args = dict(arg_params)
+        args[input_name] = nd.zeros(self.shape)
+        self.executor = symbol.bind(args=args, aux_states=aux_params,
+                                    grad_req="null")
+        self._input = None
+        self.outputs = []
+
+    def set_input(self, data: bytes):
+        arr = np.frombuffer(data, dtype=np.float32).reshape(self.shape)
+        self._input = nd.array(arr)
+
+    def forward(self):
+        kwargs = {}
+        if self._input is not None:
+            kwargs[self.input_name] = self._input
+        self.outputs = self.executor.forward(is_train=False, **kwargs)
+
+
+def pred_create(prefix, epoch, input_name, shape) -> _Predictor:
+    return _Predictor(prefix, epoch, input_name, shape)
+
+
+def pred_set_input(pred: _Predictor, data: bytes):
+    pred.set_input(data)
+    return True
+
+
+def pred_forward(pred: _Predictor):
+    pred.forward()
+    return True
+
+
+def pred_output_shape(pred: _Predictor, index: int) -> tuple:
+    return tuple(int(d) for d in pred.outputs[index].shape)
+
+
+def pred_output_bytes(pred: _Predictor, index: int) -> bytes:
+    return ndarray_to_bytes(pred.outputs[index])

@@ -1,0 +1,327 @@
+// mxtpu C ABI implementation: embed (or attach to) CPython and delegate to
+// mxtpu.c_api_impl.
+//
+// Reference: src/c_api/c_api.cc + c_api_ndarray.cc + c_predict_api.cc. The
+// reference marshals into its C++ engine; the TPU-native runtime's
+// orchestrator is Python (XLA/PJRT does the compute), so this layer marshals
+// into the interpreter instead — one GIL scope per call, thread-local error
+// strings, opaque PyObject* handles. When the host process *is* Python
+// (ctypes), the already-running interpreter is used; from a plain C program
+// the first call boots one.
+
+#include "../../include/mxtpu/c_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void SetError(const std::string &msg) { g_last_error = msg; }
+
+// Capture the pending Python exception into the thread-local error string.
+void SetErrorFromPython() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  SetError(msg);
+}
+
+// Boot the interpreter if this process doesn't have one (plain-C host).
+// std::call_once: two C host threads may race their first API call here.
+// Releases the GIL after boot so PyGILState_Ensure works from any thread.
+bool EnsureInterpreter() {
+  static std::once_flag boot_flag;
+  static bool boot_ok = false;
+  std::call_once(boot_flag, []() {
+    if (Py_IsInitialized()) {
+      boot_ok = true;
+      return;
+    }
+    Py_InitializeEx(0);
+    boot_ok = Py_IsInitialized();
+    if (boot_ok) PyEval_SaveThread();  // release the GIL the boot holds
+  });
+  if (!boot_ok) SetError("failed to initialize embedded Python interpreter");
+  return boot_ok;
+}
+
+// The mxtpu.c_api_impl module (borrowed global ref, imported once).
+PyObject *ImplModule() {
+  static PyObject *mod = nullptr;
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("mxtpu.c_api_impl");
+    if (mod == nullptr) SetErrorFromPython();
+  }
+  return mod;
+}
+
+// RAII GIL scope.
+class GilScope {
+ public:
+  GilScope() : state_(PyGILState_Ensure()) {}
+  ~GilScope() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+PyObject *ShapeTuple(const int64_t *shape, int ndim) {
+  PyObject *t = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyTuple_SetItem(t, i, PyLong_FromLongLong(shape[i]));
+  }
+  return t;
+}
+
+// Call impl.<method>(args...); returns new ref or nullptr (error recorded).
+PyObject *CallImpl(const char *method, PyObject *args) {
+  PyObject *mod = ImplModule();
+  if (mod == nullptr) {
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *fn = PyObject_GetAttrString(mod, method);
+  if (fn == nullptr) {
+    SetErrorFromPython();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *res = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  Py_XDECREF(args);
+  if (res == nullptr) SetErrorFromPython();
+  return res;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXTPUGetLastError(void) { return g_last_error.c_str(); }
+
+int MXTPURuntimeInit(const char *platform) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  PyObject *args = Py_BuildValue("(z)", platform);
+  PyObject *res = CallImpl("runtime_init", args);
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArrayCreateFromBlob(const float *data, const int64_t *shape,
+                               int ndim, NDArrayHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  PyObject *bytes =
+      PyBytes_FromStringAndSize(reinterpret_cast<const char *>(data),
+                                static_cast<Py_ssize_t>(n * sizeof(float)));
+  // "N" steals both new refs into the args tuple
+  PyObject *args = Py_BuildValue("(NN)", bytes, ShapeTuple(shape, ndim));
+  PyObject *res = CallImpl("ndarray_from_blob", args);
+  if (res == nullptr) return -1;
+  *out = res;  // keep the new ref as the handle
+  return 0;
+}
+
+int MXTPUNDArrayShape(NDArrayHandle handle, int *ndim, int64_t *shape) {
+  GilScope gil;
+  PyObject *nd = reinterpret_cast<PyObject *>(handle);
+  PyObject *args = PyTuple_Pack(1, nd);
+  PyObject *res = CallImpl("ndarray_shape", args);
+  if (res == nullptr) return -1;
+  Py_ssize_t n = PyTuple_Size(res);
+  if (n > 8) {
+    Py_DECREF(res);
+    SetError("ndim > 8 unsupported by MXTPUNDArrayShape");
+    return -1;
+  }
+  *ndim = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    shape[i] = PyLong_AsLongLong(PyTuple_GetItem(res, i));
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArraySyncCopyToCPU(NDArrayHandle handle, float *dst,
+                              int64_t size) {
+  GilScope gil;
+  PyObject *nd = reinterpret_cast<PyObject *>(handle);
+  PyObject *args = PyTuple_Pack(1, nd);
+  PyObject *res = CallImpl("ndarray_to_bytes", args);
+  if (res == nullptr) return -1;
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &len) != 0) {
+    SetErrorFromPython();
+    Py_DECREF(res);
+    return -1;
+  }
+  if (len != static_cast<Py_ssize_t>(size * sizeof(float))) {
+    SetError("MXTPUNDArraySyncCopyToCPU: size mismatch");
+    Py_DECREF(res);
+    return -1;
+  }
+  std::memcpy(dst, buf, static_cast<size_t>(len));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArrayFree(NDArrayHandle handle) {
+  if (handle == nullptr) return 0;
+  GilScope gil;
+  Py_DECREF(reinterpret_cast<PyObject *>(handle));
+  return 0;
+}
+
+int MXTPUImperativeInvoke(const char *op_name, NDArrayHandle *inputs,
+                          int num_inputs, const char **attr_keys,
+                          const char **attr_vals, int num_attrs,
+                          NDArrayHandle *outputs, int *num_outputs) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  PyObject *ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject *o = reinterpret_cast<PyObject *>(inputs[i]);
+    Py_INCREF(o);
+    PyList_SetItem(ins, i, o);
+  }
+  PyObject *attrs = PyDict_New();
+  for (int i = 0; i < num_attrs; ++i) {
+    PyObject *v = PyUnicode_FromString(attr_vals[i]);
+    PyDict_SetItemString(attrs, attr_keys[i], v);
+    Py_DECREF(v);
+  }
+  PyObject *name = PyUnicode_FromString(op_name);
+  PyObject *args = PyTuple_Pack(3, name, ins, attrs);
+  Py_DECREF(name);
+  Py_DECREF(ins);
+  Py_DECREF(attrs);
+  PyObject *res = CallImpl("imperative_invoke", args);
+  if (res == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(res);
+  if (n > *num_outputs) {
+    Py_DECREF(res);
+    SetError("output capacity too small");
+    return -1;
+  }
+  *num_outputs = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(res, i);
+    Py_INCREF(o);
+    outputs[i] = o;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUPredCreate(const char *prefix, int epoch, const char *input_name,
+                    const int64_t *shape, int ndim, PredictorHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  PyObject *args = Py_BuildValue("(sisN)", prefix, epoch, input_name,
+                                 ShapeTuple(shape, ndim));
+  PyObject *res = CallImpl("pred_create", args);
+  if (res == nullptr) return -1;
+  *out = res;
+  return 0;
+}
+
+int MXTPUPredSetInput(PredictorHandle handle, const float *data,
+                      int64_t size) {
+  GilScope gil;
+  PyObject *pred = reinterpret_cast<PyObject *>(handle);
+  PyObject *bytes =
+      PyBytes_FromStringAndSize(reinterpret_cast<const char *>(data),
+                                static_cast<Py_ssize_t>(size * sizeof(float)));
+  PyObject *args = PyTuple_Pack(2, pred, bytes);
+  Py_DECREF(bytes);
+  PyObject *res = CallImpl("pred_set_input", args);
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUPredForward(PredictorHandle handle) {
+  GilScope gil;
+  PyObject *pred = reinterpret_cast<PyObject *>(handle);
+  PyObject *args = PyTuple_Pack(1, pred);
+  PyObject *res = CallImpl("pred_forward", args);
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUPredGetOutputShape(PredictorHandle handle, int index, int *ndim,
+                            int64_t *shape) {
+  GilScope gil;
+  PyObject *pred = reinterpret_cast<PyObject *>(handle);
+  PyObject *args = Py_BuildValue("(Oi)", pred, index);
+  PyObject *res = CallImpl("pred_output_shape", args);
+  if (res == nullptr) return -1;
+  Py_ssize_t n = PyTuple_Size(res);
+  if (n > 8) {
+    Py_DECREF(res);
+    SetError("ndim > 8 unsupported");
+    return -1;
+  }
+  *ndim = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    shape[i] = PyLong_AsLongLong(PyTuple_GetItem(res, i));
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUPredGetOutput(PredictorHandle handle, int index, float *dst,
+                       int64_t size) {
+  GilScope gil;
+  PyObject *pred = reinterpret_cast<PyObject *>(handle);
+  PyObject *args = Py_BuildValue("(Oi)", pred, index);
+  PyObject *res = CallImpl("pred_output_bytes", args);
+  if (res == nullptr) return -1;
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &len) != 0) {
+    SetErrorFromPython();
+    Py_DECREF(res);
+    return -1;
+  }
+  if (len != static_cast<Py_ssize_t>(size * sizeof(float))) {
+    SetError("MXTPUPredGetOutput: size mismatch");
+    Py_DECREF(res);
+    return -1;
+  }
+  std::memcpy(dst, buf, static_cast<size_t>(len));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUPredFree(PredictorHandle handle) {
+  if (handle == nullptr) return 0;
+  GilScope gil;
+  Py_DECREF(reinterpret_cast<PyObject *>(handle));
+  return 0;
+}
+
+}  // extern "C"

@@ -33,24 +33,12 @@ def flops_of(jfn, *args):
 
 
 def main():
-    import mxtpu as mx
     from mxtpu import gluon
-    from mxtpu.gluon.model_zoo import vision
     from mxtpu.parallel import ShardedTrainStep, data_parallel_mesh, pure_forward
+    from perf_common import build_resnet
 
     batch = int(os.environ.get("BENCH_BATCH", "128"))
-    layout = os.environ.get("BENCH_LAYOUT", "NHWC")
-    dtype = "bfloat16"
-
-    with mx.layout(layout):
-        net = vision.resnet50_v1()
-    net.initialize()
-    shape = (batch, 224, 224, 3) if layout == "NHWC" else (batch, 3, 224, 224)
-    x = mx.nd.array(np.random.uniform(-1, 1, size=shape), dtype="float32")
-    net(x)
-    net.cast(dtype)
-    x = x.astype(dtype)
-    y = mx.nd.array(np.random.randint(0, 1000, size=(batch,)), dtype="float32")
+    net, x, y = build_resnet(batch)
 
     # --- fwd only (train=False)
     fn, params = pure_forward(net)

@@ -1,0 +1,44 @@
+"""Shared pieces for the perf diagnosis tools (perf_bisect/perf_rtt/
+perf_prec/perf_trace): ONE copy of the bench-identical resnet50 setup and an
+in-process tunnel-RTT measurement, so the tools can't drift from bench.py."""
+import os
+import time
+
+import numpy as np
+
+
+def build_resnet(batch=None, layout=None, dtype="bfloat16"):
+    """Build the exact resnet50 bench model + batch (mirrors
+    bench.bench_resnet50). Returns (net, x, y)."""
+    import mxtpu as mx
+    from mxtpu.gluon.model_zoo import vision
+
+    batch = batch or int(os.environ.get("BENCH_BATCH", "128"))
+    layout = layout or os.environ.get("BENCH_LAYOUT", "NHWC")
+    with mx.layout(layout):
+        net = vision.resnet50_v1()
+    net.initialize()
+    shape = (batch, 224, 224, 3) if layout == "NHWC" else (batch, 3, 224, 224)
+    x = mx.nd.array(np.random.uniform(-1, 1, size=shape), dtype="float32")
+    net(x)  # settle deferred shapes
+    if dtype != "float32":
+        net.cast(dtype)
+        x = x.astype(dtype)
+    y = mx.nd.array(np.random.randint(0, 1000, size=(batch,)),
+                    dtype="float32")
+    return net, x, y
+
+
+def measure_rtt(n=10):
+    """Dispatch+sync latency of a trivial jitted op — the tunnel RTT floor
+    to subtract from single-shot timings. Measured, never hardcoded."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda v: v + 1)
+    v = jnp.ones((8, 8))
+    jax.device_get(f(v))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        jax.device_get(f(v))
+    return (time.perf_counter() - t0) / n

@@ -33,21 +33,12 @@ def main():
     print("trivial op, sync-at-end    : %.3f ms/step"
           % ((time.perf_counter() - t0) / 20 * 1e3))
 
-    import mxtpu as mx
     from mxtpu import gluon
-    from mxtpu.gluon.model_zoo import vision
     from mxtpu.parallel import pure_forward
+    from perf_common import build_resnet
 
     batch = int(os.environ.get("BENCH_BATCH", "128"))
-    with mx.layout("NHWC"):
-        net = vision.resnet50_v1()
-    net.initialize()
-    x = mx.nd.array(np.random.uniform(-1, 1, (batch, 224, 224, 3)),
-                    dtype="float32")
-    net(x)
-    net.cast("bfloat16")
-    x = x.astype("bfloat16")
-    yl = mx.nd.array(np.random.randint(0, 1000, (batch,)), dtype="float32")
+    net, x, yl = build_resnet(batch)
 
     # b) fwd with true host fetch
     fn, params = pure_forward(net)

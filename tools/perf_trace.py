@@ -15,22 +15,13 @@ LOGDIR = "/tmp/mxtpu_trace"
 
 
 def build_step():
-    import mxtpu as mx
     from mxtpu import gluon
-    from mxtpu.gluon.model_zoo import vision
     from mxtpu.parallel import pure_forward
     from mxtpu.ndarray import NDArray
+    from perf_common import build_resnet
 
     batch = int(os.environ.get("BENCH_BATCH", "128"))
-    with mx.layout("NHWC"):
-        net = vision.resnet50_v1()
-    net.initialize()
-    x = mx.nd.array(np.random.uniform(-1, 1, (batch, 224, 224, 3)),
-                    dtype="float32")
-    net(x)
-    net.cast("bfloat16")
-    x = x.astype("bfloat16")
-    yl = mx.nd.array(np.random.randint(0, 1000, (batch,)), dtype="float32")
+    net, x, yl = build_resnet(batch)
     fn_t, params_t = pure_forward(net, train=True)
     loss_blk = gluon.loss.SoftmaxCrossEntropyLoss()
 
