@@ -238,9 +238,41 @@ def bench_bert_base():
     }
 
 
+def bench_eager():
+    """Eager-dispatch overhead guard (VERDICT r2 weak #5): ops/sec through
+    the full imperative path (mx.nd wrapper -> _apply -> jax eager) on a
+    small tensor, the mode every reference BASELINE table was measured in.
+    Each iteration is 3 chained elementwise ops; sync only at the end
+    (SURVEY §1 async-dispatch semantics)."""
+    import mxtpu as mx
+
+    n_iter = int(os.environ.get("BENCH_EAGER_ITERS", "200"))
+    x = mx.nd.ones((128, 128))
+    y = (x * 1.01 + 0.5).tanh()
+    y.asnumpy()  # warm every kernel
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        y = (y * 1.01 + 0.5).tanh()
+    y.asnumpy()
+    dt = time.perf_counter() - t0
+    rate = 3 * n_iter / dt
+    # floor: the reference's eager NDArray path sustains O(10k) small ops/s
+    # on CPU hosts (engine dispatch ~100us/op); below 3k ops/s eager mode
+    # has regressed into per-call retracing
+    return {
+        "metric": "eager_dispatch_small_ops",
+        "value": round(rate, 1),
+        "unit": "ops/sec",
+        "vs_baseline": round(rate / 3000.0, 3),
+        "mfu": None,
+        "hfu": None,
+    }
+
+
 # headline config LAST: the driver records the final printed line as the
 # round's parsed headline metric (see BENCH_r0*.json "parsed")
 CONFIGS = {
+    "eager": bench_eager,
     "lstm_ptb": bench_lstm_ptb,
     "bert_base": bench_bert_base,
     "resnet50": bench_resnet50,

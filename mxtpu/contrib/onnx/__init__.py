@@ -1,0 +1,10 @@
+"""ONNX interchange (ref: python/mxnet/contrib/onnx/ — mx2onnx export +
+onnx2mx import). Self-contained wire-format codec: no ``onnx`` package
+needed to produce or consume valid ModelProto files; when the real ``onnx``
+package IS available, tests additionally run onnx.checker over our bytes.
+"""
+from .export import export_model, export_symbol  # noqa: F401
+from .import_model import import_model, import_model_bytes  # noqa: F401
+
+__all__ = ["export_model", "export_symbol", "import_model",
+           "import_model_bytes"]

@@ -1,0 +1,241 @@
+"""ONNX export for mxtpu symbols / gluon blocks.
+
+Reference: python/mxnet/contrib/onnx/mx2onnx/export_model.py +
+_op_translations.py — per-op converters from the symbol graph to ONNX
+nodes. Covers the model-zoo op surface (Conv, BatchNorm, Activation,
+Pooling, Add, FullyConnected/Gemm, Flatten, Clip, Concat, Dropout,
+LayerNorm, softmax); export is inference-mode (BatchNorm = moving stats),
+matching the reference's deploy export.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ...ops.nn import _pair as _pairify  # one kernel/stride/pad normalizer
+from ...symbol.symbol import _ARG, _topo
+from . import proto
+
+__all__ = ["export_model", "export_symbol"]
+
+
+class _Ctx:
+    def __init__(self):
+        self.nodes = []
+        self.initializers = []
+        self.counter = 0
+
+    def emit(self, op_type, inputs, outputs, name="", attrs=None):
+        self.nodes.append(proto.node(op_type, inputs, outputs, name=name,
+                                     attrs=attrs))
+
+    def const(self, value, hint="const"):
+        name = "%s_%d" % (hint, self.counter)
+        self.counter += 1
+        self.initializers.append(proto.tensor(name, np.asarray(value)))
+        return name
+
+
+def _conv(ctx, n, ins, outs, params):
+    attrs = n.attrs
+    if (attrs.get("layout") or "NCHW") not in ("NCHW", None):
+        raise MXNetError("ONNX export requires NCHW convs; re-trace the "
+                        "model outside a channels-last layout scope")
+    kernel = _pairify(attrs.get("kernel"))
+    stride = _pairify(attrs.get("stride"))
+    dilate = _pairify(attrs.get("dilate"))
+    pad = _pairify(attrs.get("pad") or 0)
+    a = {"kernel_shape": list(kernel), "strides": list(stride),
+         "dilations": list(dilate),
+         "pads": list(pad) + list(pad),
+         "group": int(attrs.get("num_group", 1))}
+    ctx.emit("Conv", ins, outs, name=n.name, attrs=a)
+
+
+def _batchnorm(ctx, n, ins, outs, params):
+    # inference semantics: Y = gamma*(x-mean)/sqrt(var+eps)+beta
+    x, gamma, beta, mean, var = ins
+    if n.attrs.get("fix_gamma", True):
+        g = params.get(gamma)
+        ones = np.ones(g.shape if g is not None else
+                       params[beta].shape, np.float32)
+        gamma = ctx.const(ones, "fixed_gamma")
+    ctx.emit("BatchNormalization", [x, gamma, beta, mean, var], outs,
+             name=n.name,
+             attrs={"epsilon": float(n.attrs.get("eps", 1e-3)),
+                    "momentum": float(n.attrs.get("momentum", 0.9))})
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+def _activation(ctx, n, ins, outs, params):
+    act = n.attrs.get("act_type", "relu")
+    if act not in _ACT:
+        raise MXNetError("ONNX export: unsupported act_type %r" % act)
+    ctx.emit(_ACT[act], ins, outs, name=n.name)
+
+
+def _pooling(ctx, n, ins, outs, params):
+    attrs = n.attrs
+    ptype = attrs.get("pool_type", "max")
+    if attrs.get("global_pool", False):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}.get(ptype)
+        if op is None:
+            raise MXNetError("ONNX export: global %s pool" % ptype)
+        ctx.emit(op, ins, outs, name=n.name)
+        return
+    kernel = _pairify(attrs.get("kernel"))
+    stride = _pairify(attrs.get("stride") or 1)
+    pad = _pairify(attrs.get("pad") or 0)
+    a = {"kernel_shape": list(kernel), "strides": list(stride),
+         "pads": list(pad) + list(pad)}
+    if attrs.get("pooling_convention", "valid") == "full":
+        a["ceil_mode"] = 1
+    if ptype == "avg":
+        a["count_include_pad"] = int(attrs.get("count_include_pad", True))
+        ctx.emit("AveragePool", ins, outs, name=n.name, attrs=a)
+    elif ptype == "max":
+        ctx.emit("MaxPool", ins, outs, name=n.name, attrs=a)
+    else:
+        raise MXNetError("ONNX export: pool_type %r" % ptype)
+
+
+def _fully_connected(ctx, n, ins, outs, params):
+    data = ins[0]
+    if n.attrs.get("flatten", True):
+        flat = outs[0] + "_flat"
+        ctx.emit("Flatten", [data], [flat], attrs={"axis": 1})
+        data = flat
+    gemm_ins = [data, ins[1]]
+    if len(ins) > 2 and not n.attrs.get("no_bias", False):
+        gemm_ins.append(ins[2])
+    ctx.emit("Gemm", gemm_ins, outs, name=n.name,
+             attrs={"alpha": 1.0, "beta": 1.0, "transB": 1})
+
+
+def _clip(ctx, n, ins, outs, params):
+    lo = ctx.const(np.float32(n.attrs.get("a_min", 0.0)), "clip_min")
+    hi = ctx.const(np.float32(n.attrs.get("a_max", 0.0)), "clip_max")
+    ctx.emit("Clip", [ins[0], lo, hi], outs, name=n.name)
+
+
+def _softmax(ctx, n, ins, outs, params):
+    ctx.emit("Softmax", ins, outs, name=n.name,
+             attrs={"axis": int(n.attrs.get("axis", -1))})
+
+
+def _concat(ctx, n, ins, outs, params):
+    axis = int(n.attrs.get("dim", n.attrs.get("axis", 1)))
+    ctx.emit("Concat", ins, outs, name=n.name, attrs={"axis": axis})
+
+
+_CONVERTERS = {
+    "Convolution": _conv,
+    "BatchNorm": _batchnorm,
+    "Activation": _activation,
+    "Pooling": _pooling,
+    "FullyConnected": _fully_connected,
+    "clip": _clip,
+    "softmax": _softmax,
+    "Concat": _concat,
+    "broadcast_add": lambda ctx, n, ins, outs, p:
+        ctx.emit("Add", ins, outs, name=n.name),
+    "broadcast_mul": lambda ctx, n, ins, outs, p:
+        ctx.emit("Mul", ins, outs, name=n.name),
+    "elemwise_sum": lambda ctx, n, ins, outs, p:
+        ctx.emit("Sum", ins, outs, name=n.name),
+    "Flatten": lambda ctx, n, ins, outs, p:
+        ctx.emit("Flatten", ins, outs, name=n.name, attrs={"axis": 1}),
+    "flatten": lambda ctx, n, ins, outs, p:
+        ctx.emit("Flatten", ins, outs, name=n.name, attrs={"axis": 1}),
+    "relu": lambda ctx, n, ins, outs, p:
+        ctx.emit("Relu", ins, outs, name=n.name),
+    "Dropout": lambda ctx, n, ins, outs, p:
+        ctx.emit("Identity", ins, outs, name=n.name),
+    "identity": lambda ctx, n, ins, outs, p:
+        ctx.emit("Identity", ins, outs, name=n.name),
+}
+
+
+def export_symbol(sym, params, input_shapes, path=None):
+    """Serialize a Symbol + params dict to ONNX ModelProto bytes.
+
+    params: name -> NDArray/np array for every non-data variable.
+    input_shapes: {input_name: shape} for the data inputs.
+    """
+    nodes = _topo(sym._heads)
+    ctx = _Ctx()
+    np_params = {}
+    for k, v in params.items():
+        np_params[k] = v.asnumpy() if hasattr(v, "asnumpy") else \
+            np.asarray(v)
+
+    names = {}  # (id(node), out_idx) -> onnx tensor name
+    graph_inputs = []
+    for n in nodes:
+        if n.is_var():
+            names[(id(n), 0)] = n.name
+            if n.name in np_params:
+                ctx.initializers.append(
+                    proto.tensor(n.name, np_params[n.name]))
+            elif n.name in input_shapes:
+                graph_inputs.append(
+                    proto.value_info(n.name, input_shapes[n.name]))
+            else:
+                raise MXNetError(
+                    "export: variable %r has neither a parameter value nor "
+                    "an input shape" % n.name)
+            continue
+        conv = _CONVERTERS.get(n.op)
+        if conv is None:
+            raise MXNetError(
+                "ONNX export: no converter for op %r (supported: %s)"
+                % (n.op, sorted(_CONVERTERS)))
+        arrays = [names[(id(inp), idx)] for inp, idx in n.inputs]
+        it = iter(arrays)
+        ins = [next(it) for a in n.pos_template if a is _ARG]
+        ins += [next(it) for _ in n.kw_arrays]
+        outs = ["%s_out%d" % (n.name, i) for i in range(n.num_outputs)]
+        for i in range(n.num_outputs):
+            names[(id(n), i)] = outs[i]
+        conv(ctx, n, ins, outs, np_params)
+
+    out_infos = []
+    graph_outputs = []
+    for node_, idx in sym._heads:
+        i = 0 if idx is None else idx
+        nm = names[(id(node_), i)]
+        out_infos.append(nm)
+        # output shapes via infer_shape when derivable
+    _, out_shapes, _ = sym.infer_shape(**input_shapes)
+    for nm, shp in zip(out_infos, out_shapes):
+        graph_outputs.append(proto.value_info(nm, shp or ()))
+
+    g = proto.graph(ctx.nodes, "mxtpu_graph", ctx.initializers,
+                    graph_inputs, graph_outputs)
+    blob = proto.model(g)
+    if path:
+        with open(path, "wb") as f:
+            f.write(blob)
+    return blob
+
+
+def export_model(block, path=None, input_shapes=None):
+    """Export a (run-once) gluon HybridBlock to ONNX
+    (ref: mx.contrib.onnx.export_model)."""
+    from ...symbol.symbol import trace_block
+
+    sym, _ = trace_block(block)
+    params = {}
+    for name, p in block.collect_params().items():
+        params[name] = p.data()
+    if input_shapes is None:
+        specs = getattr(block, "_in_specs", None)
+        if not specs:
+            raise MXNetError("run the block once or pass input_shapes")
+        data_names = [n for n in sym.list_inputs() if n not in params]
+        input_shapes = {nm: tuple(s)
+                        for nm, (s, _d) in zip(data_names, specs)}
+    return export_symbol(sym, params, input_shapes, path=path)

@@ -1,0 +1,228 @@
+"""ONNX import: ModelProto bytes -> (Symbol, arg_params, aux_params).
+
+Reference: python/mxnet/contrib/onnx/onnx2mx/import_model.py. Covers the
+same op subset the exporter emits, so export/import round-trips — and since
+the decoder is a generic wire-format parser (proto.decode), a malformed
+export fails here rather than being silently re-read.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from . import proto
+
+__all__ = ["import_model", "import_model_bytes"]
+
+
+def _tensor_to_np(tbytes):
+    t = proto.decode(tbytes)
+    dims = [int(d) for d in t.get(1, [])]
+    dtype = int(t.get(2, [proto.TENSOR_FLOAT])[0])
+    if dtype != proto.TENSOR_FLOAT:
+        raise MXNetError("import: only float32 tensors supported")
+    name = t.get(8, [b""])[0].decode()
+    if 9 in t:
+        arr = np.frombuffer(t[9][0], np.float32).reshape(dims)
+    else:
+        arr = np.array([proto.as_float(v) if isinstance(v, int) else v
+                        for v in t.get(4, [])], np.float32).reshape(dims)
+    return name, arr
+
+
+def _attrs_of(node_msg):
+    out = {}
+    for ab in node_msg.get(5, []):
+        a = proto.decode(ab)
+        name = a[1][0].decode()
+        atype = int(a.get(20, [0])[0])
+        if atype == proto.ATTR_INT:
+            out[name] = int(a[3][0])
+        elif atype == proto.ATTR_FLOAT:
+            out[name] = proto.as_float(a[2][0])
+        elif atype == proto.ATTR_STRING:
+            out[name] = a[4][0].decode()
+        elif atype == proto.ATTR_INTS:
+            out[name] = proto.decode_packed_int64s(a[8][0]) if a.get(8) \
+                else []
+        elif atype == proto.ATTR_FLOATS:
+            raw = a.get(7, [b""])[0]
+            out[name] = list(np.frombuffer(raw, np.float32))
+        else:
+            out[name] = None
+    return out
+
+
+def import_model_bytes(blob):
+    """Returns (sym, arg_params, aux_params) like the reference's
+    import_model (onnx2mx/import_model.py)."""
+    from ... import symbol as sym_api
+
+    m = proto.decode(blob)
+    g = proto.decode(m[7][0])
+    inits = {}
+    for tb in g.get(5, []):
+        name, arr = _tensor_to_np(tb)
+        inits[name] = arr
+
+    env = {}  # onnx tensor name -> Symbol
+    for vb in g.get(11, []):
+        v = proto.decode(vb)
+        name = v[1][0].decode()
+        env[name] = sym_api.Variable(name)
+
+    def sym_of(name):
+        if name in env:
+            return env[name]
+        if name in inits:
+            env[name] = sym_api.Variable(name)
+            return env[name]
+        raise MXNetError("import: undefined tensor %r" % name)
+
+    for nb in g.get(1, []):
+        n = proto.decode(nb)
+        op = n[4][0].decode()
+        ins = [i.decode() for i in n.get(1, [])]
+        outs = [o.decode() for o in n.get(2, [])]
+        attrs = _attrs_of(n)
+        out_sym = _IMPORTERS.get(op)
+        if out_sym is None:
+            raise MXNetError("import: unsupported ONNX op %r" % op)
+        res = out_sym(sym_of, ins, attrs, inits)
+        if not isinstance(res, (list, tuple)):
+            res = [res]
+        for name, s in zip(outs, res):
+            env[name] = s
+
+    out_names = [proto.decode(vb)[1][0].decode() for vb in g.get(12, [])]
+    outs = [env[nm] for nm in out_names]
+    sym = outs[0] if len(outs) == 1 else sym_api.Group(outs)
+
+    from ... import ndarray as nd
+    arg_params, aux_params = {}, {}
+    arg_names = set(sym.list_arguments())
+    aux_names = set(sym.list_auxiliary_states())
+    for name, arr in inits.items():
+        if name in aux_names:
+            aux_params[name] = nd.array(arr)
+        elif name in arg_names:
+            arg_params[name] = nd.array(arr)
+        # consts folded into unused (e.g. fixed_gamma for used path) are
+        # still arg_params if referenced; silently skip truly unused ones
+    return sym, arg_params, aux_params
+
+
+def import_model(path):
+    with open(path, "rb") as f:
+        return import_model_bytes(f.read())
+
+
+# ------------------------------------------------------------ op importers
+def _imp_conv(sym_of, ins, attrs, inits):
+    from ... import symbol as sym_api
+    kwargs = {"kernel": tuple(attrs.get("kernel_shape", ())),
+              "stride": tuple(attrs.get("strides", (1, 1))),
+              "dilate": tuple(attrs.get("dilations", (1, 1))),
+              "num_group": int(attrs.get("group", 1)),
+              "num_filter": int(inits[ins[1]].shape[0])}
+    pads = attrs.get("pads")
+    if pads:
+        kwargs["pad"] = tuple(pads[:len(pads) // 2])
+    args = [sym_of(i) for i in ins]
+    if len(args) == 2:
+        kwargs["no_bias"] = True
+        return sym_api.Convolution(args[0], weight=args[1], **kwargs)
+    return sym_api.Convolution(args[0], weight=args[1], bias=args[2],
+                               **kwargs)
+
+
+def _imp_bn(sym_of, ins, attrs, inits):
+    from ... import symbol as sym_api
+    x, g, b, mean, var = (sym_of(i) for i in ins)
+    return sym_api.BatchNorm(x, gamma=g, beta=b, moving_mean=mean,
+                             moving_var=var, fix_gamma=False,
+                             use_global_stats=True,
+                             eps=float(attrs.get("epsilon", 1e-5)))
+
+
+def _imp_pool(op):
+    def f(sym_of, ins, attrs, inits):
+        from ... import symbol as sym_api
+        kwargs = {"pool_type": "max" if "Max" in op else "avg"}
+        if op.startswith("Global"):
+            kwargs["global_pool"] = True
+            kwargs["kernel"] = (1, 1)
+        else:
+            kwargs["kernel"] = tuple(attrs.get("kernel_shape", ()))
+            kwargs["stride"] = tuple(attrs.get("strides", (1, 1)))
+            pads = attrs.get("pads")
+            if pads:
+                kwargs["pad"] = tuple(pads[:len(pads) // 2])
+            if attrs.get("ceil_mode"):
+                kwargs["pooling_convention"] = "full"
+            if "Average" in op:
+                # ONNX spec default is 0 (exclude padding)
+                kwargs["count_include_pad"] = bool(
+                    attrs.get("count_include_pad", 0))
+        return sym_api.Pooling(sym_of(ins[0]), **kwargs)
+    return f
+
+
+def _imp_gemm(sym_of, ins, attrs, inits):
+    from ... import symbol as sym_api
+    if not attrs.get("transB"):
+        raise MXNetError("import: Gemm without transB unsupported")
+    if attrs.get("transA") or attrs.get("alpha", 1.0) != 1.0 \
+            or attrs.get("beta", 1.0) != 1.0:
+        # refusing beats silently-wrong numerics (alpha scales A@B etc.)
+        raise MXNetError("import: Gemm with transA/alpha/beta != defaults "
+                         "unsupported")
+    kwargs = {"num_hidden": int(inits[ins[1]].shape[0]), "flatten": False}
+    args = [sym_of(i) for i in ins]
+    if len(args) == 2:
+        return sym_api.FullyConnected(args[0], weight=args[1],
+                                      no_bias=True, **kwargs)
+    return sym_api.FullyConnected(args[0], weight=args[1], bias=args[2],
+                                  **kwargs)
+
+
+def _imp_clip(sym_of, ins, attrs, inits):
+    from ... import symbol as sym_api
+    lo = float(np.ravel(inits[ins[1]])[0]) if len(ins) > 1 \
+        else attrs.get("min")
+    hi = float(np.ravel(inits[ins[2]])[0]) if len(ins) > 2 \
+        else attrs.get("max")
+    return sym_api.clip(sym_of(ins[0]), a_min=lo, a_max=hi)
+
+
+def _unary(name):
+    def f(sym_of, ins, attrs, inits):
+        from ... import symbol as sym_api
+        return getattr(sym_api, name)(sym_of(ins[0]))
+    return f
+
+
+_IMPORTERS = {
+    "Conv": _imp_conv,
+    "BatchNormalization": _imp_bn,
+    "MaxPool": _imp_pool("MaxPool"),
+    "AveragePool": _imp_pool("AveragePool"),
+    "GlobalMaxPool": _imp_pool("GlobalMaxPool"),
+    "GlobalAveragePool": _imp_pool("GlobalAveragePool"),
+    "Gemm": _imp_gemm,
+    "Clip": _imp_clip,
+    "Relu": _unary("relu"),
+    "Sigmoid": _unary("sigmoid"),
+    "Tanh": _unary("tanh"),
+    "Identity": _unary("identity"),
+    "Flatten": _unary("Flatten"),
+    "Add": lambda sym_of, ins, a, i:
+        sym_of(ins[0]) + sym_of(ins[1]),
+    "Mul": lambda sym_of, ins, a, i:
+        sym_of(ins[0]) * sym_of(ins[1]),
+    "Sum": lambda sym_of, ins, a, i:
+        sym_of(ins[0]) + sym_of(ins[1]),
+    "Softmax": lambda sym_of, ins, a, i: __import__(
+        "mxtpu.symbol", fromlist=["softmax"]).softmax(
+        sym_of(ins[0]), axis=int(a.get("axis", -1))),
+}

@@ -1,0 +1,185 @@
+"""Minimal protobuf wire-format codec for the ONNX subset we emit/read.
+
+The image ships no ``onnx`` package (and none may be installed), so the
+exporter writes ModelProto bytes directly. Field numbers follow onnx.proto
+(ONNX IR). The decoder is a generic wire-format parser (returns nested
+{field_number: [values]} dicts), so export bugs can't be masked by a
+mirrored reader.
+
+Reference counterpart: python/mxnet/contrib/onnx/mx2onnx/ builds protos via
+the onnx python package; the wire format here is identical.
+"""
+from __future__ import annotations
+
+import struct
+
+# ------------------------------------------------------------------ encode
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def field_varint(num: int, value: int) -> bytes:
+    return _varint(num << 3 | 0) + _varint(int(value))
+
+
+def field_bytes(num: int, payload: bytes) -> bytes:
+    return _varint(num << 3 | 2) + _varint(len(payload)) + payload
+
+
+def field_string(num: int, s: str) -> bytes:
+    return field_bytes(num, s.encode("utf-8"))
+
+
+def field_float(num: int, value: float) -> bytes:
+    return _varint(num << 3 | 5) + struct.pack("<f", float(value))
+
+
+def packed_int64s(num: int, values) -> bytes:
+    payload = b"".join(_varint(int(v)) for v in values)
+    return field_bytes(num, payload)
+
+
+# ONNX enums
+TENSOR_FLOAT = 1
+ATTR_FLOAT = 1
+ATTR_INT = 2
+ATTR_STRING = 3
+ATTR_TENSOR = 4
+ATTR_FLOATS = 6
+ATTR_INTS = 7
+
+
+def attribute(name, value) -> bytes:
+    """AttributeProto from a python value (int/float/str/list thereof)."""
+    out = field_string(1, name)
+    if isinstance(value, bool):
+        value = int(value)
+    if isinstance(value, int):
+        out += field_varint(3, value) + field_varint(20, ATTR_INT)
+    elif isinstance(value, float):
+        out += field_float(2, value) + field_varint(20, ATTR_FLOAT)
+    elif isinstance(value, str):
+        out += field_bytes(4, value.encode()) + field_varint(20, ATTR_STRING)
+    elif isinstance(value, (list, tuple)):
+        if all(isinstance(v, int) for v in value):
+            out += packed_int64s(8, value) + field_varint(20, ATTR_INTS)
+        else:
+            payload = b"".join(struct.pack("<f", float(v)) for v in value)
+            out += field_bytes(7, payload) + field_varint(20, ATTR_FLOATS)
+    else:
+        raise TypeError("unsupported attribute %r" % (value,))
+    return out
+
+
+def tensor(name, np_array) -> bytes:
+    """TensorProto (float32, raw_data)."""
+    import numpy as np
+
+    arr = np.ascontiguousarray(np_array, np.float32)
+    out = b"".join(field_varint(1, d) for d in arr.shape)
+    out += field_varint(2, TENSOR_FLOAT)
+    out += field_string(8, name)
+    out += field_bytes(9, arr.tobytes())
+    return out
+
+
+def value_info(name, shape) -> bytes:
+    dims = b"".join(
+        field_bytes(1, field_varint(1, int(d))) for d in shape)
+    tensor_type = field_varint(1, TENSOR_FLOAT) + field_bytes(2, dims)
+    type_proto = field_bytes(1, tensor_type)
+    return field_string(1, name) + field_bytes(2, type_proto)
+
+
+def node(op_type, inputs, outputs, name="", attrs=None) -> bytes:
+    out = b"".join(field_string(1, i) for i in inputs)
+    out += b"".join(field_string(2, o) for o in outputs)
+    if name:
+        out += field_string(3, name)
+    out += field_string(4, op_type)
+    for k in sorted(attrs or {}):
+        out += field_bytes(5, attribute(k, attrs[k]))
+    return out
+
+
+def graph(nodes, name, initializers, inputs, outputs) -> bytes:
+    out = b"".join(field_bytes(1, n) for n in nodes)
+    out += field_string(2, name)
+    out += b"".join(field_bytes(5, t) for t in initializers)
+    out += b"".join(field_bytes(11, v) for v in inputs)
+    out += b"".join(field_bytes(12, v) for v in outputs)
+    return out
+
+
+def model(graph_bytes, opset=13, producer="mxtpu") -> bytes:
+    opset_id = field_string(1, "") + field_varint(2, opset)
+    out = field_varint(1, 8)  # ir_version 8
+    out += field_string(2, producer)
+    out += field_bytes(7, graph_bytes)
+    out += field_bytes(8, opset_id)
+    return out
+
+
+# ------------------------------------------------------------------ decode
+def decode(buf: bytes):
+    """Generic wire-format parse: {field: [value, ...]} — value is int for
+    varint/fixed fields, bytes for length-delimited (decode nested messages
+    by calling decode() again)."""
+    out = {}
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _read_varint(buf, i)
+        num, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = struct.unpack("<I", buf[i:i + 4])[0]
+            i += 4
+        elif wt == 1:
+            v = struct.unpack("<Q", buf[i:i + 8])[0]
+            i += 8
+        else:
+            raise ValueError("unsupported wire type %d" % wt)
+        out.setdefault(num, []).append(v)
+    return out
+
+
+def _read_varint(buf, i):
+    shift = 0
+    val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def decode_packed_int64s(payload: bytes):
+    vals = []
+    i = 0
+    while i < len(payload):
+        v, i = _read_varint(payload, i)
+        vals.append(v)
+    return vals
+
+
+def as_float(fixed32: int) -> float:
+    return struct.unpack("<f", struct.pack("<I", fixed32))[0]

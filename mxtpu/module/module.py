@@ -36,6 +36,15 @@ class Module(BaseModule):
         self._label_names = list(label_names or [])
         self._fixed_param_names = list(fixed_param_names or [])
         self._context = context
+        if group2ctxs is not None:
+            # the reference's manual model-parallel placement
+            # (PlaceDevice pass via __ctx_group__). The TPU-native answer is
+            # GSPMD sharding (ShardedTrainStep param_specs) — accepting and
+            # ignoring this would silently drop the user's placement intent.
+            raise MXNetError(
+                "group2ctxs manual device placement is not supported: use a "
+                "jax.sharding.Mesh context plus ShardedTrainStep "
+                "param_specs (GSPMD) for model parallelism")
 
         arg_names = symbol.list_arguments()
         input_names = self._data_names + self._label_names

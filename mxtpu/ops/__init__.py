@@ -24,5 +24,7 @@ from . import ctc  # noqa: F401
 from . import custom  # noqa: F401
 from . import quantization  # noqa: F401
 from . import image_ops  # noqa: F401
+from . import subgraph_ops  # noqa: F401
+from . import legacy_vision  # noqa: F401
 
 attach_methods()

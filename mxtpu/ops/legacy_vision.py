@@ -1,0 +1,391 @@
+"""Legacy v1 + SSD vision op stragglers.
+
+Reference: src/operator/crop.cc, src/operator/svm_output.cc,
+src/operator/correlation.cc, src/operator/tensor/histogram.cc,
+src/operator/contrib/multibox_{prior,target,detection}.cc.
+
+TPU-native notes: Crop/histogram/Correlation lower to pure XLA
+(slice/searchsorted/conv-like shifted products — Correlation's static
+displacement grid unrolls into fused VPU work, where the reference needed a
+dedicated CUDA kernel). SVMOutput mirrors SoftmaxOutput's fused-backward
+trick via custom_vjp. The multibox target/detection pair is data-dependent
+sequential matching/NMS; on TPU that work belongs on the HOST side of the
+input pipeline (the standard TPU SSD recipe), so they run as NumPy under
+``jax.pure_callback`` — jit-compatible, non-differentiable by definition
+(targets/detections are labels, as in the reference where backward writes
+zeros)."""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+# ------------------------------------------------------------------- Crop
+@register("Crop")
+def Crop(*data, offset=(0, 0), h_w=(0, 0), center_crop=False, num_args=None):
+    """Crop data (NCHW) to h_w or to crop_like's spatial size
+    (ref: src/operator/crop.cc)."""
+    x = data[0]
+    if len(data) > 1:
+        th, tw = data[1].shape[2], data[1].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    if center_crop:
+        oy = (x.shape[2] - th) // 2
+        ox = (x.shape[3] - tw) // 2
+    else:
+        oy, ox = int(offset[0]), int(offset[1])
+    return jax.lax.dynamic_slice(
+        x, (0, 0, oy, ox), (x.shape[0], x.shape[1], th, tw))
+
+
+# -------------------------------------------------------------- SVMOutput
+@register("SVMOutput", aliases=("svm_output",))
+def SVMOutput(data, label, margin=1.0, regularization_coefficient=1.0,
+              use_linear=False):
+    """Identity forward; hinge-loss gradient in backward
+    (ref: src/operator/svm_output.cc — like SoftmaxOutput, the loss lives
+    in the fused backward kernel)."""
+
+    @jax.custom_vjp
+    def _svm(d, lab):
+        return d
+
+    def _fwd(d, lab):
+        return d, (d, lab)
+
+    def _bwd(res, g):
+        d, lab = res
+        li = lab.astype(jnp.int32)
+        nclass = d.shape[1]
+        oh = jax.nn.one_hot(li, nclass, dtype=d.dtype)  # [N, C]
+        # score margin per class vs the true-class score
+        true_score = jnp.sum(d * oh, axis=1, keepdims=True)
+        viol = (margin - (true_score - d)) > 0  # violates the margin
+        if use_linear:
+            # L1-SVM: +-1 gradients on violating classes
+            gneg = jnp.where(viol & (oh == 0), 1.0, 0.0)
+        else:
+            # L2-SVM: proportional to the violation
+            gneg = jnp.where(viol & (oh == 0),
+                             2.0 * (margin - (true_score - d)), 0.0)
+        gpos = -jnp.sum(gneg, axis=1, keepdims=True) * oh
+        grad = (gneg + gpos) * regularization_coefficient
+        return (grad.astype(d.dtype), jnp.zeros_like(lab))
+
+    _svm.defvjp(_fwd, _bwd)
+    return _svm(data, label)
+
+
+# -------------------------------------------------------------- histogram
+@register("histogram")
+def histogram(data, bins=None, bin_cnt=None, range=None):
+    """(histo, bin_edges) (ref: src/operator/tensor/histogram.cc). Either
+    explicit ``bins`` edges or ``bin_cnt`` + ``range``."""
+    x = jnp.ravel(data)
+    if bins is not None:
+        edges = jnp.asarray(bins)
+        cnt = edges.shape[0] - 1
+        lo, hi = edges[0], edges[-1]
+        idx = jnp.clip(jnp.searchsorted(edges, x, side="right") - 1,
+                       0, cnt - 1)
+        valid = (x >= lo) & (x <= hi)
+    else:
+        cnt = int(bin_cnt)
+        lo, hi = (jnp.min(x), jnp.max(x)) if range is None else \
+            (jnp.float32(range[0]), jnp.float32(range[1]))
+        edges = jnp.linspace(lo, hi, cnt + 1)
+        width = (hi - lo) / cnt
+        idx = jnp.clip(((x - lo) / width).astype(jnp.int32), 0, cnt - 1)
+        valid = (x >= lo) & (x <= hi)
+    counts = jnp.zeros((cnt,), jnp.int64 if jax.config.x64_enabled
+                       else jnp.int32)
+    counts = counts.at[idx].add(valid.astype(counts.dtype))
+    return [counts, edges]
+
+
+# ------------------------------------------------------------ Correlation
+@register("Correlation")
+def Correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation layer (ref: src/operator/correlation.cc).
+
+    The displacement grid is static, so it unrolls into shifted elementwise
+    products + average pooling — all XLA-fusible; the reference needed a
+    bespoke CUDA kernel (correlation.cu)."""
+    n, c, h, w = data1.shape
+    k = int(kernel_size)
+    bd = int(max_displacement)
+    s1, s2 = int(stride1), int(stride2)
+    pad = int(pad_size)
+    kr = k // 2
+    border = bd + kr
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = h + 2 * pad, w + 2 * pad
+    out_h = int(np.ceil((ph - border * 2) / float(s1)))
+    out_w = int(np.ceil((pw - border * 2) / float(s1)))
+    grid = int(np.floor(2.0 * bd / s2) + 1)
+    ys = border + s1 * jnp.arange(out_h)
+    xs = border + s1 * jnp.arange(out_w)
+    planes = []
+    for dy in (-bd + s2 * np.arange(grid)):
+        for dx in (-bd + s2 * np.arange(grid)):
+            acc = 0.0
+            for ky in np.arange(-kr, kr + 1):
+                for kx in np.arange(-kr, kr + 1):
+                    a = p1[:, :, ys + ky][:, :, :, xs + kx]
+                    b = p2[:, :, ys + ky + int(dy)][:, :, :,
+                                                    xs + kx + int(dx)]
+                    acc = acc + (a * b if is_multiply else
+                                 jnp.abs(a - b))
+            planes.append(jnp.sum(acc, axis=1) / (k * k * c))
+    return jnp.stack(planes, axis=1)
+
+
+# ---------------------------------------------------------- multibox SSD
+@register("_contrib_MultiBoxPrior", aliases=("multibox_prior",))
+def MultiBoxPrior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                  steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """SSD prior boxes (ref: multibox_prior-inl.h MultiBoxPriorForward);
+    fully static — computed as one fused XLA expression."""
+    in_h, in_w = data.shape[2], data.shape[3]
+    step_y = 1.0 / in_h if steps[0] <= 0 else float(steps[0])
+    step_x = 1.0 / in_w if steps[1] <= 0 else float(steps[1])
+    cy = (jnp.arange(in_h) + float(offsets[0])) * step_y  # [H]
+    cx = (jnp.arange(in_w) + float(offsets[1])) * step_x  # [W]
+    hw = []
+    for s in sizes:  # ratio 1, all sizes
+        hw.append((float(s) * in_h / in_w / 2.0, float(s) / 2.0))
+    for r in ratios[1:]:  # size[0], remaining ratios
+        sr = float(np.sqrt(r))
+        hw.append((float(sizes[0]) * in_h / in_w * sr / 2.0,
+                   float(sizes[0]) / sr / 2.0))
+    half_w = jnp.asarray([p[0] for p in hw])  # [A]
+    half_h = jnp.asarray([p[1] for p in hw])
+    shape = (in_h, in_w, half_w.shape[0])
+    CY = jnp.broadcast_to(cy[:, None, None], shape)
+    CX = jnp.broadcast_to(cx[None, :, None], shape)
+    HW = jnp.broadcast_to(half_w[None, None, :], shape)
+    HH = jnp.broadcast_to(half_h[None, None, :], shape)
+    boxes = jnp.stack([CX - HW, CY - HH, CX + HW, CY + HH],
+                      axis=-1)  # [H, W, A, 4]
+    out = boxes.reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def _np_multibox_target(anchors, labels, cls_preds, overlap_threshold,
+                        ignore_label, negative_mining_ratio,
+                        negative_mining_thresh, minimum_negative_samples,
+                        variances):
+    """NumPy matching (ref: multibox_target.cc MultiBoxTargetForward):
+    greedy bipartite match, threshold match, optional hard-negative mining,
+    variance-encoded location targets."""
+    anchors = anchors.reshape(-1, 4)
+    num_anchors = anchors.shape[0]
+    nb = labels.shape[0]
+    loc_target = np.zeros((nb, num_anchors * 4), np.float32)
+    loc_mask = np.zeros((nb, num_anchors * 4), np.float32)
+    cls_target = np.full((nb, num_anchors), ignore_label, np.float32)
+
+    def iou(a, b):
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = ix * iy
+        ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) \
+            - inter
+        return inter / ua if ua > 0 else 0.0
+
+    for b in range(nb):
+        lab = labels[b]
+        valid = []
+        for row in lab:
+            if row[0] == -1.0:
+                break
+            valid.append(row)
+        cls_target[b] = 0.0  # default background
+        if not valid:
+            continue
+        ov = np.array([[iou(anchors[j], g[1:5]) for g in valid]
+                       for j in range(num_anchors)], np.float32)
+        matched_gt = np.full(num_anchors, -1, np.int64)
+        anchor_used = np.zeros(num_anchors, bool)
+        gt_used = np.zeros(len(valid), bool)
+        # greedy bipartite: each gt grabs its best remaining anchor
+        while not gt_used.all():
+            masked = ov.copy()
+            masked[anchor_used] = -1.0
+            masked[:, gt_used] = -1.0
+            j, k = np.unravel_index(np.argmax(masked), masked.shape)
+            if masked[j, k] <= 1e-6:
+                break
+            matched_gt[j] = k
+            anchor_used[j] = True
+            gt_used[k] = True
+        if overlap_threshold > 0:
+            for j in range(num_anchors):
+                if anchor_used[j]:
+                    continue
+                k = int(np.argmax(ov[j]))
+                if ov[j, k] > overlap_threshold:
+                    matched_gt[j] = k
+                    anchor_used[j] = True
+        # negative mining
+        if negative_mining_ratio > 0:
+            num_pos = int(anchor_used.sum())
+            num_neg = min(int(num_pos * negative_mining_ratio),
+                          num_anchors - num_pos)
+            num_neg = max(num_neg, int(minimum_negative_samples))
+            # hardness = -softmax_prob(background), exactly the reference's
+            # ranking (multibox_target.cc:218-232): a confidently-wrong
+            # anchor (low bg prob) is the hardest negative
+            p = cls_preds[b]  # [C, A]
+            e = np.exp(p - p.max(axis=0, keepdims=True))
+            bg_prob = e[0] / e.sum(axis=0)
+            scores = -bg_prob  # higher = harder negative
+            cand = [(scores[j], j) for j in range(num_anchors)
+                    if not anchor_used[j] and ov[j].max()
+                    < negative_mining_thresh]
+            cand.sort(key=lambda t: -t[0])
+            keep_neg = {j for _, j in cand[:num_neg]}
+            for j in range(num_anchors):
+                if not anchor_used[j] and j not in keep_neg:
+                    cls_target[b, j] = ignore_label
+        for j in range(num_anchors):
+            k = matched_gt[j]
+            if k < 0:
+                continue
+            g = valid[k]
+            cls_target[b, j] = g[0] + 1  # class id + 1 (0 = background)
+            ax = (anchors[j, 0] + anchors[j, 2]) / 2
+            ay = (anchors[j, 1] + anchors[j, 3]) / 2
+            aw = anchors[j, 2] - anchors[j, 0]
+            ah = anchors[j, 3] - anchors[j, 1]
+            gx = (g[1] + g[3]) / 2
+            gy = (g[2] + g[4]) / 2
+            gw = g[3] - g[1]
+            gh = g[4] - g[2]
+            loc_target[b, j * 4:(j + 1) * 4] = [
+                (gx - ax) / aw / variances[0],
+                (gy - ay) / ah / variances[1],
+                float(np.log(max(gw / aw, 1e-12))) / variances[2],
+                float(np.log(max(gh / ah, 1e-12))) / variances[3]]
+            loc_mask[b, j * 4:(j + 1) * 4] = 1.0
+    return loc_target, loc_mask, cls_target
+
+
+@register("_contrib_MultiBoxTarget", aliases=("multibox_target",))
+def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
+                   ignore_label=-1.0, negative_mining_ratio=-1.0,
+                   negative_mining_thresh=0.5, minimum_negative_samples=0,
+                   variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training targets (ref: multibox_target.cc). Host-side matching
+    via pure_callback (see module docstring): [loc_target, loc_mask,
+    cls_target]."""
+    num_anchors = anchor.shape[1]
+    nb = label.shape[0]
+    fn = functools.partial(
+        _np_multibox_target, overlap_threshold=float(overlap_threshold),
+        ignore_label=float(ignore_label),
+        negative_mining_ratio=float(negative_mining_ratio),
+        negative_mining_thresh=float(negative_mining_thresh),
+        minimum_negative_samples=int(minimum_negative_samples),
+        variances=tuple(float(v) for v in variances))
+    out_shapes = (
+        jax.ShapeDtypeStruct((nb, num_anchors * 4), jnp.float32),
+        jax.ShapeDtypeStruct((nb, num_anchors * 4), jnp.float32),
+        jax.ShapeDtypeStruct((nb, num_anchors), jnp.float32))
+    lt, lm, ct = jax.pure_callback(
+        lambda a, l, c: fn(np.asarray(a, np.float32),
+                           np.asarray(l, np.float32),
+                           np.asarray(c, np.float32)),
+        out_shapes, anchor, label, cls_pred)
+    return [lt, lm, ct]
+
+
+def _np_multibox_detection(cls_prob, loc_pred, anchors, threshold, clip,
+                           background_id, nms_threshold, force_suppress,
+                           variances, nms_topk, keep_topk):
+    """NumPy decode + per-class NMS (ref: multibox_detection.cc)."""
+    anchors = anchors.reshape(-1, 4)
+    nb, num_classes, num_anchors = cls_prob.shape
+    out = np.full((nb, num_anchors, 6), -1.0, np.float32)
+    for b in range(nb):
+        dets = []
+        for j in range(num_anchors):
+            cid = int(np.argmax(cls_prob[b, :, j]))
+            score = float(cls_prob[b, cid, j])
+            if cid == background_id or score < threshold:
+                continue
+            ax = (anchors[j, 0] + anchors[j, 2]) / 2
+            ay = (anchors[j, 1] + anchors[j, 3]) / 2
+            aw = anchors[j, 2] - anchors[j, 0]
+            ah = anchors[j, 3] - anchors[j, 1]
+            p = loc_pred[b, j * 4:(j + 1) * 4]
+            cx = p[0] * variances[0] * aw + ax
+            cy = p[1] * variances[1] * ah + ay
+            w = float(np.exp(p[2] * variances[2])) * aw / 2
+            h = float(np.exp(p[3] * variances[3])) * ah / 2
+            box = [cx - w, cy - h, cx + w, cy + h]
+            if clip:
+                box = [min(max(v, 0.0), 1.0) for v in box]
+            # class id shifted down by one when background is class 0
+            oid = cid - 1 if background_id == 0 else cid
+            dets.append([float(oid), score] + box)
+        dets.sort(key=lambda d: -d[1])
+        if nms_topk > 0:
+            dets = dets[:nms_topk]
+        keep = []  # truncated to keep_topk after NMS (below)
+        for d in dets:
+            ok = True
+            for kd in keep:
+                if not force_suppress and kd[0] != d[0]:
+                    continue
+                ix = max(0.0, min(d[4], kd[4]) - max(d[2], kd[2]))
+                iy = max(0.0, min(d[5], kd[5]) - max(d[3], kd[3]))
+                inter = ix * iy
+                ua = (d[4] - d[2]) * (d[5] - d[3]) \
+                    + (kd[4] - kd[2]) * (kd[5] - kd[3]) - inter
+                if ua > 0 and inter / ua > nms_threshold:
+                    ok = False
+                    break
+            if ok:
+                keep.append(d)
+        if keep_topk > 0:
+            keep = keep[:keep_topk]
+        for i, d in enumerate(keep):
+            out[b, i] = d
+    return out
+
+
+@register("_contrib_MultiBoxDetection", aliases=("multibox_detection",))
+def MultiBoxDetection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                      background_id=0, nms_threshold=0.5,
+                      force_suppress=False, variances=(0.1, 0.1, 0.2, 0.2),
+                      nms_topk=-1, keep_topk=-1):
+    """SSD detection decode + NMS (ref: multibox_detection.cc). Host-side
+    via pure_callback; output [N, num_anchors, 6] rows of
+    (class_id, score, xmin, ymin, xmax, ymax), -1-padded."""
+    nb = cls_prob.shape[0]
+    num_anchors = anchor.shape[1]
+    fn = functools.partial(
+        _np_multibox_detection, threshold=float(threshold), clip=bool(clip),
+        background_id=int(background_id),
+        nms_threshold=float(nms_threshold),
+        force_suppress=bool(force_suppress),
+        variances=tuple(float(v) for v in variances),
+        nms_topk=int(nms_topk), keep_topk=int(keep_topk))
+    out = jax.pure_callback(
+        lambda c, l, a: fn(np.asarray(c, np.float32),
+                           np.asarray(l, np.float32),
+                           np.asarray(a, np.float32)),
+        jax.ShapeDtypeStruct((nb, num_anchors, 6), jnp.float32),
+        cls_prob, loc_pred, anchor)
+    return out

@@ -11,7 +11,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import register
+from .registry import register, register_param_shapes
 
 
 # ------------------------------------------------------------------ shape
@@ -312,3 +312,10 @@ def khatri_rao(*args):
     for m in args[1:]:
         out = jnp.einsum("ik,jk->ijk", out, m).reshape(-1, out.shape[1])
     return out
+
+
+@register_param_shapes("Embedding")
+def _embedding_param_shapes(shapes, attrs):
+    """Weight=(input_dim, output_dim) regardless of data shape (ref:
+    src/operator/tensor/indexing_op.h EmbeddingOpShape)."""
+    return {1: (int(attrs["input_dim"]), int(attrs["output_dim"]))}

@@ -560,3 +560,108 @@ def SequenceReverse(data, sequence_length=None, use_sequence_length=False, axis=
                         steps[:, None])  # (T, N)
     rev_idx = jnp.reshape(rev_idx, rev_idx.shape + (1,) * (data.ndim - 2))
     return jnp.take_along_axis(data, jnp.broadcast_to(rev_idx, data.shape), axis=0)
+
+
+# ---------------------------------------------------- parameter shape rules
+# FInferShape backward fill (ref: each op's FInferShape in src/operator/nn/*
+# deriving weight shapes from the data shape). Consumed by
+# Symbol.infer_shape via the registry (mxtpu/ops/registry.py).
+from .registry import register_param_shapes  # noqa: E402
+
+
+@register_param_shapes("FullyConnected")
+def _fc_param_shapes(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return {}
+    num_hidden = int(attrs.get("num_hidden"))
+    flatten = attrs.get("flatten", True)
+    in_units = 1
+    if flatten:
+        for s in data[1:]:
+            in_units *= s
+    else:
+        in_units = data[-1]
+    out = {1: (num_hidden, in_units)}
+    if len(shapes) > 2 and not attrs.get("no_bias", False):
+        out[2] = (num_hidden,)
+    return out
+
+
+@register_param_shapes("Convolution")
+def _conv_param_shapes(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return {}
+    ndim = len(data) - 2
+    kernel = _pair(attrs.get("kernel"), ndim)
+    num_filter = int(attrs.get("num_filter"))
+    num_group = int(attrs.get("num_group", 1))
+    layout = attrs.get("layout") or "NC" + "DHW"[3 - ndim:]
+    channels_last = layout[-1] == "C"
+    c_axis = layout.index("C")
+    in_ch = data[c_axis]
+    if channels_last:
+        # weight is HWIO for channels-last (mirrors _conv_dims)
+        w = kernel + (in_ch // num_group, num_filter)
+    else:
+        w = (num_filter, in_ch // num_group) + kernel
+    out = {1: w}
+    if len(shapes) > 2 and not attrs.get("no_bias", False):
+        out[2] = (num_filter,)
+    return out
+
+
+@register_param_shapes("Deconvolution")
+def _deconv_param_shapes(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return {}
+    ndim = len(data) - 2
+    kernel = _pair(attrs.get("kernel"), ndim)
+    num_filter = int(attrs.get("num_filter"))
+    num_group = int(attrs.get("num_group", 1))
+    layout = attrs.get("layout") or "NC" + "DHW"[3 - ndim:]
+    channels_last = layout[-1] == "C"
+    in_ch = data[len(data) - 1 if channels_last else 1]
+    if channels_last:
+        w = kernel + (num_filter // num_group, in_ch)
+    else:
+        w = (in_ch, num_filter // num_group) + kernel
+    out = {1: w}
+    if len(shapes) > 2 and not attrs.get("no_bias", True):
+        out[2] = (num_filter,)
+    return out
+
+
+def _channel_param_shapes(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return {}
+    axis = int(attrs.get("axis", 1)) % len(data)
+    c = (data[axis],)
+    return {i: c for i in range(1, len(shapes))}
+
+
+register_param_shapes("BatchNorm")(_channel_param_shapes)
+register_param_shapes("InstanceNorm")(_channel_param_shapes)
+
+
+@register_param_shapes("LayerNorm")
+def _ln_param_shapes(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return {}
+    axis = int(attrs.get("axis", -1)) % len(data)
+    c = (data[axis],)
+    return {i: c for i in range(1, len(shapes))}
+
+
+@register_param_shapes("LeakyReLU")
+def _leaky_param_shapes(shapes, attrs):
+    # only PReLU has a learnable gamma, shaped per-channel (ref:
+    # src/operator/leaky_relu-inl.h FInferShape)
+    if attrs.get("act_type") != "prelu" or shapes[0] is None \
+            or len(shapes) < 2:
+        return {}
+    return {1: (shapes[0][1],)}

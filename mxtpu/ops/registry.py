@@ -22,7 +22,8 @@ from typing import Callable, Dict, List, Optional
 
 from ..ndarray.ndarray import NDArray, _apply
 
-__all__ = ["Op", "register", "get_op", "list_ops", "invoke", "REGISTRY"]
+__all__ = ["Op", "register", "get_op", "list_ops", "invoke", "REGISTRY",
+           "register_param_shapes", "get_param_shape_rule"]
 
 
 class Op:
@@ -80,6 +81,31 @@ def register(name: Optional[str] = None, aliases=(), as_method: bool = False,
         return wrapper
 
     return deco
+
+
+# canonical op name -> fn(input_shapes, attrs) -> {input_index: shape}.
+# The FInferShape *backward fill* of the reference registry
+# (include/mxnet/op_attr_types.h FInferShape; e.g. fully_connected.cc
+# derives weight=(num_hidden, in_units) from the data shape): given the
+# known input shapes (None for unknown), a rule returns shapes for the
+# op's parameter inputs so symbols with undeclared parameter shapes can
+# still be inferred (BucketingModule on unseen buckets depends on this).
+PARAM_SHAPE_RULES: Dict[str, Callable] = {}
+
+
+def register_param_shapes(name: str):
+    """Attach a parameter-shape backward-fill rule to a registered op."""
+
+    def deco(fn: Callable):
+        PARAM_SHAPE_RULES[name] = fn
+        return fn
+
+    return deco
+
+
+def get_param_shape_rule(name: str) -> Optional[Callable]:
+    op = REGISTRY.get(name)
+    return PARAM_SHAPE_RULES.get(op.name if op is not None else name)
 
 
 def get_op(name: str) -> Op:

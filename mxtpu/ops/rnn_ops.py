@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register
+from .registry import register, register_param_shapes
 
 
 def _gates(mode):
@@ -132,4 +132,26 @@ def RNN(data, parameters, state, state_cell=None, state_size=None, num_layers=1,
         if mode == "lstm":
             res.append(jnp.stack(c_finals, axis=0))
         return res
+    return out
+
+
+@register_param_shapes("RNN")
+def _rnn_param_shapes(shapes, attrs):
+    """Backward fill for the fused RNN's packed inputs (ref: rnn-inl.h
+    GetParamSize + FInferShape): parameters=(total,), state[/cell]
+    =(L*dirs, N, H) from the TNC data shape."""
+    data = shapes[0]
+    if data is None:
+        return {}
+    T, N, input_size = data
+    mode = attrs.get("mode", "lstm")
+    state_size = int(attrs["state_size"])
+    num_layers = int(attrs.get("num_layers", 1))
+    bidirectional = bool(attrs.get("bidirectional", False))
+    dirs = 2 if bidirectional else 1
+    out = {1: (rnn_param_size(mode, num_layers, input_size, state_size,
+                              bidirectional),),
+           2: (num_layers * dirs, N, state_size)}
+    if len(shapes) > 3 and mode == "lstm":
+        out[3] = (num_layers * dirs, N, state_size)
     return out

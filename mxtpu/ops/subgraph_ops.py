@@ -1,0 +1,101 @@
+"""Ops backing the subgraph/partition framework (mxtpu/symbol/subgraph.py).
+
+Reference: the reference's partitioned regions become a CachedOp node
+(src/operator/subgraph/default_subgraph_property.cc). Here:
+
+* ``_subgraph_exec`` — runs a serialized sub-symbol as its OWN jit
+  executable (compiled once per sub-graph, cached); differentiable because
+  the jitted pure function is.
+* ``_sg_flash_attention`` — the replacement node FlashAttentionProperty
+  emits: q/k/v from the matched softmax(QK^T*scale)V chain are fed to the
+  Pallas flash kernel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+# subgraph_json -> (symbol, input_names) with a jitted runner
+_SUBGRAPH_CACHE = {}
+
+
+def _load_sym(subgraph_json):
+    hit = _SUBGRAPH_CACHE.get(("sym", subgraph_json))
+    if hit is None:
+        from ..symbol.symbol import load_json
+        hit = load_json(subgraph_json)
+        _SUBGRAPH_CACHE[("sym", subgraph_json)] = hit
+    return hit
+
+
+def _compiled(subgraph_json, input_names, n_outputs):
+    key = (subgraph_json, tuple(input_names))
+    hit = _SUBGRAPH_CACHE.get(key)
+    if hit is not None:
+        return hit
+    from ..ndarray import NDArray
+    from .. import autograd
+
+    sym = _load_sym(subgraph_json)
+    names = list(input_names)
+
+    def pure(*datas):
+        prev = autograd.set_recording(False)
+        try:
+            feed = {n: NDArray(d) for n, d in zip(names, datas)}
+            outs = sym._execute(feed)
+        finally:
+            autograd.set_recording(prev)
+        res = [o._data for o in outs]
+        return tuple(res) if n_outputs > 1 else res[0]
+
+    fn = jax.jit(pure)
+    _SUBGRAPH_CACHE[key] = fn
+    return fn
+
+
+@register("_subgraph_exec")
+def subgraph_exec(*inputs, subgraph_json=None, input_names=(), n_outputs=1):
+    """Execute a partitioned region as its own compiled executable.
+
+    Training mode runs the region INLINE (no private jit): stochastic nodes
+    (Dropout) draw fresh keys per call and BatchNorm resolves batch-stats
+    mode at call time — a cached private jit would bake one RNG key into the
+    executable forever. Inference (the backend-offload use case the
+    reference's partitioning serves, e.g. INT8/TRT) gets the cached
+    separately-compiled executable. Note: moving-stat (aux) updates of
+    BatchNorm nodes hidden inside a partitioned region are not propagated —
+    partition for deployment, not for stat-updating training (the
+    reference's default property has the same blind spot: aux writes stay
+    inside the CachedOp)."""
+    from .. import autograd
+    from ..ndarray import NDArray
+
+    if autograd.is_training():
+        sym = _load_sym(subgraph_json)
+        feed = {n: NDArray(d) for n, d in zip(input_names, inputs)}
+        outs = sym._execute(feed, is_train=True)
+        res = [o._data for o in outs]
+        return res if int(n_outputs) > 1 else res[0]
+    fn = _compiled(subgraph_json, input_names, int(n_outputs))
+    out = fn(*inputs)
+    return list(out) if isinstance(out, tuple) else out
+
+
+@register("_sg_flash_attention")
+def sg_flash_attention(q, k, v, scale=1.0, transpose_b=False):
+    """Matched attention chain lowered onto the Pallas flash kernel.
+
+    q: [B, T, D]; k: [B, T, D] if the matched batch_dot had transpose_b
+    else [B, D, T]; v: [B, T, D]. The matched pattern applied ``scale`` to
+    the scores before softmax, so it is forwarded verbatim.
+    """
+    from .pallas.flash_attention import flash_attention
+
+    if not transpose_b:
+        k = jnp.swapaxes(k, 1, 2)
+    out = flash_attention(q[:, None], k[:, None], v[:, None], causal=False,
+                          scale=float(scale))
+    return out[:, 0]

