@@ -269,10 +269,46 @@ def bench_eager():
     }
 
 
+def bench_sparse_linear():
+    """BASELINE config 5: sparse linear classification samples/sec
+    (examples/sparse/linear_classification.py — LibSVM CSR batches through
+    the gather/segment-sum csr x dense dot, row-sparse grads, lazy Adam).
+    The reference never published a number for this config; vs_baseline
+    reports throughput against a 100k samples/sec floor."""
+    import importlib.util
+    import tempfile
+
+    spec = importlib.util.spec_from_file_location(
+        "sparse_lc", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)),
+            "examples", "sparse", "linear_classification.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+
+    num_features = int(os.environ.get("BENCH_SPARSE_FEATURES", "100000"))
+    batch = int(os.environ.get("BENCH_SPARSE_BATCH", "1024"))
+    rows = 16 * batch
+    path = os.path.join(tempfile.gettempdir(), "bench_sparse.libsvm")
+    m.make_synthetic_libsvm(path, num_rows=rows, num_features=num_features,
+                            nnz_per_row=40)
+    # steady-state: parsing + compile-heavy first epoch excluded
+    acc, _, rate = m.train(path, num_features, batch_size=batch, epochs=3,
+                           measure=True)
+    return {
+        "metric": "sparse_linear_train_b%d_f%d" % (batch, num_features),
+        "value": round(rate, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(rate / 100000.0, 3),
+        "mfu": None,
+        "hfu": None,
+    }
+
+
 # headline config LAST: the driver records the final printed line as the
 # round's parsed headline metric (see BENCH_r0*.json "parsed")
 CONFIGS = {
     "eager": bench_eager,
+    "sparse_linear": bench_sparse_linear,
     "lstm_ptb": bench_lstm_ptb,
     "bert_base": bench_bert_base,
     "resnet50": bench_resnet50,

@@ -136,9 +136,14 @@ class KVStore:
                 from .ndarray.sparse import RowSparseNDArray
                 vals = src._data[rows]
                 if isinstance(o, RowSparseNDArray):
-                    o._update(NDArray(vals), NDArray(rows))
-                else:
+                    shape = o.shape
                     o._set_data(vals)
+                    o._aux = {"indices": rows, "shape": tuple(shape)}
+                else:
+                    # dense out: scatter the pulled rows in place — the rest
+                    # of the array is untouched (replacing the whole array
+                    # with the gathered rows would destroy it)
+                    o._set_data(o._data.at[rows].set(vals))
 
     # -------------------------------------------------------------- optimizer
     def set_updater(self, updater):

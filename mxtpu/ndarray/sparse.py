@@ -132,9 +132,7 @@ class CSRNDArray(BaseSparseNDArray):
         m, n = self.shape
         indptr = self._aux["indptr"]
         indices = self._aux["indices"]
-        nnz = self._data.shape[0]
-        # row id per nnz element via searchsorted on indptr
-        rows = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+        rows = _csr_row_ids(indptr, self._data.shape[0])
         dense = jnp.zeros((m, n), self._data.dtype)
         dense = dense.at[rows, indices].add(self._data)
         return NDArray(dense)
@@ -229,10 +227,46 @@ def zeros(stype, shape, ctx=None, dtype="float32"):
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
-    """Sparse-aware dot (ref: src/operator/tensor/dot-inl.h sparse paths):
-    csr × dense and row_sparse gradients fall back to dense HLO einsum after
-    materialization of the sparse operand's rows."""
+    """Sparse-aware dot (ref: src/operator/tensor/dot-inl.h sparse paths).
+
+    The hot path — ``csr (B, F) x dense (F, C)`` with a huge feature dim F
+    (the reference's DotCsrDnsDnsImpl, the sparse linear-classification /
+    NCE workload) — NEVER materializes the (B, F) dense matrix: each nnz
+    gathers its weight row and a segment-sum scatters into the B outputs,
+    O(nnz*C) work and memory. Everything else (csr^T, row_sparse operands)
+    falls back to dense einsum after materialization — fine for small F,
+    a measured cliff for large F (see examples/sparse/README)."""
     from ..ops.matrix import dot as dense_dot
+    if isinstance(lhs, CSRNDArray) and not transpose_a \
+            and not isinstance(rhs, BaseSparseNDArray) and rhs.ndim == 2:
+        from .ndarray import _apply
+        num_rows = lhs.shape[0]
+
+        def fn(data, indptr, indices, r):
+            if transpose_b:
+                r = r.T
+            return _csr_dns_dot(data, indptr, indices, num_rows, r)
+
+        # through _apply so autograd tapes the call: grads flow to the csr
+        # values and to the dense rhs (the row-sparse rhs-grad workload)
+        return _apply(fn, (lhs.data, lhs.indptr, lhs.indices, rhs),
+                      name="dot_csr_dns")
     l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
     r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
     return dense_dot(l, r, transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+def _csr_row_ids(indptr, nnz):
+    """Row id of each nnz element of a CSR matrix (shared by todense /
+    csr-dot / sparse-grad construction)."""
+    return jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+
+
+def _csr_dns_dot(data, indptr, indices, num_rows, rhs):
+    """out[b] = sum_{j in row b} data[j] * rhs[indices[j]] via gather +
+    segment-sum — static shapes per nnz, MXU-free VPU work."""
+    import jax
+
+    rows = _csr_row_ids(indptr, data.shape[0])
+    contrib = rhs[indices] * data[:, None].astype(rhs.dtype)
+    return jax.ops.segment_sum(contrib, rows, num_segments=num_rows)

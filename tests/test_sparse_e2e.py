@@ -1,0 +1,170 @@
+"""Sparse end-to-end (VERDICT r2 item 7 / BASELINE config 5):
+LibSVMIter, gather/segment-sum csr x dense dot, row-sparse gradients with
+lazy Adam, and the linear-classification example converging.
+
+Reference: src/io/iter_libsvm.cc, src/operator/tensor/dot-inl.h sparse
+paths, example/sparse/linear_classification/.
+"""
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+import scipy.sparse
+
+import mxtpu as mx
+from mxtpu.io import LibSVMIter
+from mxtpu.ndarray.sparse import CSRNDArray
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _example():
+    spec = importlib.util.spec_from_file_location(
+        "sparse_lc", os.path.join(REPO, "examples", "sparse",
+                                  "linear_classification.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+@pytest.fixture(scope="module")
+def libsvm_file(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("svm") / "data.libsvm")
+    _example().make_synthetic_libsvm(path, num_rows=300, num_features=500,
+                                     nnz_per_row=12)
+    return path
+
+
+def test_libsvm_iter_parses(libsvm_file):
+    it = LibSVMIter(data_libsvm=libsvm_file, data_shape=(500,),
+                    batch_size=64)
+    nb = 0
+    for batch in it:
+        x = batch.data[0]
+        assert isinstance(x, CSRNDArray)
+        assert x.shape == (64, 500)
+        assert batch.label[0].shape == (64,)
+        dense = x.asnumpy()
+        # every row has exactly 12 nonzeros (last batch wraps, same rows)
+        assert (np.count_nonzero(dense, axis=1) == 12).all()
+        nb += 1
+    assert nb == (300 + 63) // 64
+
+
+def test_libsvm_iter_values_roundtrip(tmp_path):
+    path = str(tmp_path / "tiny.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:0.5 3:2.0\n0 1:1.5\n1 2:-1.0 4:0.25\n")
+    it = LibSVMIter(data_libsvm=path, data_shape=(5,), batch_size=3)
+    batch = next(iter(it))
+    dense = batch.data[0].asnumpy()
+    expect = np.array([[0.5, 0, 0, 2.0, 0],
+                       [0, 1.5, 0, 0, 0],
+                       [0, 0, -1.0, 0, 0.25]], np.float32)
+    np.testing.assert_allclose(dense, expect)
+    np.testing.assert_allclose(batch.label[0].asnumpy(), [1, 0, 1])
+
+
+def test_libsvm_iter_sharding(libsvm_file):
+    full = LibSVMIter(data_libsvm=libsvm_file, data_shape=(500,),
+                      batch_size=10)
+    part0 = LibSVMIter(data_libsvm=libsvm_file, data_shape=(500,),
+                       batch_size=10, num_parts=2, part_index=0)
+    part1 = LibSVMIter(data_libsvm=libsvm_file, data_shape=(500,),
+                       batch_size=10, num_parts=2, part_index=1)
+    assert part0.num_data + part1.num_data == full.num_data
+    assert abs(part0.num_data - part1.num_data) <= 1
+
+
+def test_csr_dot_matches_scipy():
+    r = np.random.RandomState(0)
+    sp = scipy.sparse.random(50, 400, density=0.03, random_state=r,
+                             format="csr", dtype=np.float32)
+    rhs = r.uniform(-1, 1, (400, 7)).astype(np.float32)
+    x = CSRNDArray(sp.data, sp.indptr, sp.indices, sp.shape)
+    got = mx.nd.sparse.dot(x, mx.nd.array(rhs)).asnumpy()
+    np.testing.assert_allclose(got, sp @ rhs, rtol=1e-4, atol=1e-5)
+
+
+def test_csr_dot_avoids_densification():
+    """The csr x dense hot path must do O(nnz*C) work — probe by checking
+    the jaxpr contains no op with the dense (rows, features) shape."""
+    import jax
+
+    r = np.random.RandomState(0)
+    sp = scipy.sparse.random(8, 100000, density=0.0002, random_state=r,
+                             format="csr", dtype=np.float32)
+    rhs = r.uniform(-1, 1, (100000, 4)).astype(np.float32)
+    from mxtpu.ndarray.sparse import _csr_dns_dot
+
+    jaxpr = jax.make_jaxpr(
+        lambda d, ip, ix, rh: _csr_dns_dot(d, ip, ix, 8, rh))(
+        sp.data, sp.indptr.astype(np.int32), sp.indices.astype(np.int32),
+        rhs)
+    for eqn in jaxpr.eqns:
+        for v in eqn.outvars:
+            shape = getattr(v.aval, "shape", ())
+            assert shape != (8, 100000), "densified inside csr dot"
+
+
+def test_linear_classification_example_converges(libsvm_file):
+    m = _example()
+    acc, losses = m.train(libsvm_file, 500, batch_size=50, epochs=4)
+    assert losses[-1] < losses[0] * 0.7, losses
+    assert acc > 0.8, acc
+
+
+def test_linear_classification_with_kvstore_row_sparse_pull(libsvm_file):
+    m = _example()
+    kv = mx.kv.create("local")
+    acc, losses = m.train(libsvm_file, 500, batch_size=50, epochs=3, kv=kv)
+    assert losses[-1] < losses[0], losses
+
+
+def test_csr_dot_gradient_taped():
+    """sparse.dot's csr fast path must be autograd-visible: grads flow to
+    the dense rhs under record() (review finding: the raw-jnp path was
+    untaped)."""
+    from mxtpu import autograd
+
+    r = np.random.RandomState(0)
+    sp = scipy.sparse.random(6, 40, density=0.2, random_state=r,
+                             format="csr", dtype=np.float32)
+    w = mx.nd.array(r.uniform(-1, 1, (40, 3)).astype(np.float32))
+    w.attach_grad()
+    x = CSRNDArray(sp.data, sp.indptr, sp.indices, sp.shape)
+    with autograd.record():
+        out = mx.nd.sparse.dot(x, w)
+        loss = out.sum()
+    loss.backward()
+    g = w.grad.asnumpy()
+    # d(sum(x@w))/dw = x^T @ ones
+    expect = np.asarray(sp.sum(axis=0)).ravel()[:, None].repeat(3, 1)
+    np.testing.assert_allclose(g, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_row_sparse_pull_into_row_sparse_out():
+    """row_sparse_pull with a RowSparseNDArray out (the reference's primary
+    use, kvstore.h PullRowSparse)."""
+    from mxtpu.ndarray.sparse import RowSparseNDArray
+
+    kv = mx.kv.create("local")
+    w = mx.nd.array(np.arange(20, dtype=np.float32).reshape(10, 2))
+    kv.init("w", w)
+    out = RowSparseNDArray(np.zeros((2, 2), np.float32),
+                           np.array([0, 1], np.int32), (10, 2))
+    kv.row_sparse_pull("w", out=out, row_ids=mx.nd.array([3, 7]))
+    got = out.asnumpy()
+    expect = np.zeros((10, 2), np.float32)
+    expect[3] = [6, 7]
+    expect[7] = [14, 15]
+    np.testing.assert_allclose(got, expect)
+
+
+def test_libsvm_iter_rejects_out_of_range_indices(tmp_path):
+    path = str(tmp_path / "onebased.libsvm")
+    with open(path, "w") as f:
+        f.write("1 1:0.5 5:2.0\n")  # 1-based, max idx == data_shape[0]
+    with pytest.raises(Exception, match="1-based"):
+        LibSVMIter(data_libsvm=path, data_shape=(5,), batch_size=1)
