@@ -1,2 +1,6 @@
 """Contrib namespace (ref: python/mxnet/contrib/)."""
 from . import quantization  # noqa: F401
+from . import svrg_optimization  # noqa: F401
+from . import tensorboard  # noqa: F401
+from . import text  # noqa: F401
+from . import onnx  # noqa: F401

@@ -1,0 +1,5 @@
+"""SVRG (stochastic variance-reduced gradient) optimization
+(ref: python/mxnet/contrib/svrg_optimization/)."""
+from .svrg_module import SVRGModule  # noqa: F401
+
+__all__ = ["SVRGModule"]

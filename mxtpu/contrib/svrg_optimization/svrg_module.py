@@ -1,0 +1,148 @@
+"""SVRGModule: Module trained with stochastic variance-reduced gradients.
+
+Reference: python/mxnet/contrib/svrg_optimization/svrg_module.py — every
+``update_freq`` epochs it snapshots the weights w~, computes the
+full-dataset gradient mu = grad f(w~), and per batch replaces the gradient
+with ``grad f_i(w) - grad f_i(w~) + mu`` (Johnson & Zhang 2013), shrinking
+gradient variance as w approaches w~.
+
+TPU-native simplification: the reference plumbs the full-gradient
+accumulation through a special KVStore optimizer pair
+(_SVRGOptimizer/_AssignmentOptimizer); here the snapshot model is simply a
+second bound executor over the same symbol, and the variance-reduced
+combination happens on the gradient arrays before the normal updater runs
+— same math, no optimizer-registry tricks.
+"""
+from __future__ import annotations
+
+import logging
+
+from ...base import MXNetError
+from ...module.module import Module
+from ...ndarray import NDArray
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    """Module with SVRG gradient updates (same construction signature as
+    Module plus ``update_freq`` — epochs between full-gradient snapshots).
+    """
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, **kwargs)
+        if update_freq < 1:
+            raise MXNetError("update_freq must be >= 1")
+        self.update_freq = update_freq
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, **kwargs)
+        self._full_grads = None  # name -> NDArray (mu)
+
+    # ------------------------------------------------------------ plumbing
+    def bind(self, data_shapes, label_shapes=None, **kwargs):
+        super().bind(data_shapes, label_shapes=label_shapes, **kwargs)
+        self._mod_aux.bind(data_shapes, label_shapes=label_shapes, **kwargs)
+
+    def init_params(self, *args, **kwargs):
+        super().init_params(*args, **kwargs)
+        self._sync_aux_params()
+
+    def _sync_aux_params(self):
+        """Snapshot: w~ <- w."""
+        arg, aux = self.get_params()
+        self._mod_aux.init_params(arg_params=arg, aux_params=aux,
+                                  force_init=True, allow_missing=False)
+
+    # ---------------------------------------------------------------- SVRG
+    def update_full_grads(self, train_data):
+        """Snapshot the weights and accumulate mu = mean over the dataset
+        of grad f(w~) (ref: svrg_module.py:update_full_grads)."""
+        self._sync_aux_params()
+        sums = {}
+        nbatch = 0
+        train_data.reset()
+        for batch in train_data:
+            self._mod_aux.forward_backward(batch)
+            for name in self._param_names:
+                g = self._mod_aux._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                if name in sums:
+                    sums[name] = sums[name] + g._data
+                else:
+                    sums[name] = g._data
+            nbatch += 1
+        if nbatch == 0:
+            raise MXNetError("update_full_grads: empty data iterator")
+        self._full_grads = {k: NDArray(v / nbatch) for k, v in sums.items()}
+        train_data.reset()
+
+    def forward_backward(self, data_batch):
+        """fwd+bwd on the live weights AND the snapshot weights."""
+        super().forward_backward(data_batch)
+        if self._full_grads is not None:
+            self._mod_aux.forward_backward(data_batch)
+
+    def update(self):
+        """Apply the variance-reduced gradient
+        g <- g - g_snapshot + mu, then the normal optimizer step
+        (ref: svrg_module.py:_svrg_grads_update_rule)."""
+        if self._full_grads is not None:
+            for name in self._param_names:
+                g = self._exec.grad_dict.get(name)
+                if g is None or name not in self._full_grads:
+                    continue
+                g_snap = self._mod_aux._exec.grad_dict.get(name)
+                g._set_data(g._data - g_snap._data
+                            + self._full_grads[name]._data)
+        super().update()
+
+    # ----------------------------------------------------------------- fit
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
+            initializer=None, num_epoch=None, kvstore=None,
+            batch_end_callback=None, begin_epoch=0):
+        """Training loop with the periodic full-gradient pass
+        (ref: svrg_module.py:fit)."""
+        assert num_epoch is not None, "please specify number of epochs"
+        from ...initializer import Uniform
+        from ...model import BatchEndParam
+        from ...module.base_module import _as_metric
+
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label, for_training=True)
+        self.init_params(initializer=initializer or Uniform(0.01))
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        self._mod_aux.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                     optimizer_params=optimizer_params)
+        metric = _as_metric(eval_metric)
+        for epoch in range(begin_epoch, num_epoch):
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward_backward(batch)
+                self.update()
+                self.update_metric(metric, batch.label)
+                if batch_end_callback is not None:
+                    # positional BatchEndParam, list-of-callbacks supported
+                    # (same convention as base_module.py fit)
+                    param = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                          eval_metric=metric, locals=None)
+                    cbs = batch_end_callback if isinstance(
+                        batch_end_callback, (list, tuple)) \
+                        else [batch_end_callback]
+                    for cb in cbs:
+                        cb(param)
+            logging.getLogger(__name__).info(
+                "Epoch[%d] SVRG train %s", epoch, metric.get())
+            if eval_data is not None:
+                vmetric = _as_metric(eval_metric)
+                self.score(eval_data, vmetric)
+                logging.getLogger(__name__).info(
+                    "Epoch[%d] SVRG validation %s", epoch, vmetric.get())
+        return metric

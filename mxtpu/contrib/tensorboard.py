@@ -1,0 +1,67 @@
+"""TensorBoard logging callback (ref: python/mxnet/contrib/tensorboard.py
+LogMetricsCallback).
+
+The reference depends on the dmlc `tensorboard` pip package; this build
+uses torch.utils.tensorboard (torch is in the image) when available and
+falls back to a plain JSONL event log otherwise — training code keeps one
+callback either way.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Batch-end callback logging eval metrics as tensorboard scalars
+    (ref: tensorboard.py:LogMetricsCallback).
+
+    Use: ``mod.fit(..., batch_end_callback=LogMetricsCallback(logdir))``.
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self._step = 0
+        os.makedirs(logging_dir, exist_ok=True)
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self._writer = SummaryWriter(logging_dir)
+            self._jsonl = None
+        except Exception:
+            self._writer = None
+            self._jsonl = open(os.path.join(
+                logging_dir, "metrics-%d.jsonl" % int(time.time())), "a")
+
+    def __call__(self, param=None, **kwargs):
+        """Accepts a BatchEndParam-style object or keyword form."""
+        metric = getattr(param, "eval_metric", None) \
+            or kwargs.get("eval_metric")
+        if metric is None:
+            return
+        self._step += 1
+        names, values = metric.get()
+        if not isinstance(names, (list, tuple)):
+            names, values = [names], [values]
+        for name, value in zip(names, values):
+            if self.prefix:
+                name = "%s-%s" % (self.prefix, name)
+            if self._writer is not None:
+                self._writer.add_scalar(name, value, self._step)
+            else:
+                self._jsonl.write(json.dumps(
+                    {"step": self._step, "metric": name,
+                     "value": float(value)}) + "\n")
+                self._jsonl.flush()
+
+    def flush(self):
+        if self._writer is not None:
+            self._writer.flush()
+
+    def close(self):
+        if self._writer is not None:
+            self._writer.close()
+        if self._jsonl is not None:
+            self._jsonl.close()

@@ -1,0 +1,6 @@
+"""Text token indexing + embeddings
+(ref: python/mxnet/contrib/text/)."""
+from . import embedding, utils, vocab  # noqa: F401
+from .vocab import Vocabulary  # noqa: F401
+
+__all__ = ["Vocabulary", "embedding", "utils", "vocab"]

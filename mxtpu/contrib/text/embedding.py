@@ -1,0 +1,162 @@
+"""Token embeddings loaded from pretrained files
+(ref: python/mxnet/contrib/text/embedding.py).
+
+This environment has zero egress, so the reference's auto-download of
+GloVe/fastText archives becomes explicit local-file loading:
+``CustomEmbedding(path)`` reads any ``token<delim>v1<delim>v2...`` text
+file (the GloVe .txt and fastText .vec layouts both parse; .vec's
+count/dim header line is auto-skipped). The vocabulary-attachment and
+lookup surface (``get_vecs_by_tokens``/``update_token_vectors``/
+``CompositeEmbedding``) matches the reference.
+"""
+from __future__ import annotations
+
+import io
+import logging
+
+import numpy as np
+
+from ...base import MXNetError
+from ...ndarray import NDArray, array
+from .vocab import Vocabulary
+
+__all__ = ["TokenEmbedding", "CustomEmbedding", "CompositeEmbedding",
+           "register", "create", "get_pretrained_file_names"]
+
+_REGISTRY = {}
+
+
+def register(cls):
+    """Register an embedding class under its lowercase name
+    (ref: embedding.py:register)."""
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(embedding_name, **kwargs):
+    name = embedding_name.lower()
+    if name not in _REGISTRY:
+        raise MXNetError("unknown embedding %r (registered: %s)"
+                         % (embedding_name, sorted(_REGISTRY)))
+    return _REGISTRY[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """The reference lists downloadable archives; this build is offline, so
+    the answer documents the local-file path instead."""
+    return {name: "offline build: pass file_path= to %s" % name
+            for name in sorted(_REGISTRY)
+            if embedding_name in (None, name)}
+
+
+class TokenEmbedding(Vocabulary):
+    """Embedding matrix indexed by a Vocabulary
+    (ref: embedding.py:_TokenEmbedding)."""
+
+    def __init__(self, unknown_token="<unk>", init_unknown_vec=None):
+        super().__init__(counter=None, unknown_token=unknown_token)
+        self._init_unknown_vec = init_unknown_vec or (lambda d: np.zeros(d))
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    # -------------------------------------------------------------- loading
+    def _load_embedding_file(self, path, elem_delim=" ", encoding="utf-8"):
+        vecs = []
+        with io.open(path, encoding=encoding) as f:
+            for lineno, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if lineno == 0 and len(parts) == 2 and \
+                        all(p.isdigit() for p in parts):
+                    continue  # fastText .vec header: "<count> <dim>"
+                if len(parts) < 2:
+                    continue
+                tok = parts[0]
+                try:
+                    vec = [float(v) for v in parts[1:] if v]
+                except ValueError:
+                    logging.getLogger(__name__).warning(
+                        "skipping unparseable embedding line %d", lineno)
+                    continue
+                if self._vec_len == 0:
+                    self._vec_len = len(vec)
+                elif len(vec) != self._vec_len:
+                    raise MXNetError(
+                        "inconsistent vector length at line %d: %d vs %d"
+                        % (lineno, len(vec), self._vec_len))
+                if tok in self._token_to_idx:
+                    continue  # first occurrence wins (reference behavior)
+                self._token_to_idx[tok] = len(self._idx_to_token)
+                self._idx_to_token.append(tok)
+                vecs.append(vec)
+        if not vecs:
+            raise MXNetError("no embedding vectors parsed from %s" % path)
+        unk = np.asarray(self._init_unknown_vec(self._vec_len), np.float32)
+        self._idx_to_vec = array(
+            np.vstack([unk[None, :], np.asarray(vecs, np.float32)]))
+
+    # --------------------------------------------------------------- lookup
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self) -> NDArray:
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vector(s) for token(s); unknown tokens get the unknown vector
+        (ref: embedding.py:get_vecs_by_tokens)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idxs = []
+        for t in toks:
+            i = self._token_to_idx.get(t)
+            if i is None and lower_case_backup:
+                i = self._token_to_idx.get(t.lower())
+            idxs.append(0 if i is None else i)
+        vecs = self._idx_to_vec.asnumpy()[idxs]
+        return array(vecs[0]) if single else array(vecs)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        vals = new_vectors.asnumpy() if isinstance(new_vectors, NDArray) \
+            else np.asarray(new_vectors, np.float32)
+        vals = vals.reshape(len(toks), self._vec_len)
+        mat = np.array(self._idx_to_vec.asnumpy())  # writable copy
+        for t, v in zip(toks, vals):
+            if t not in self._token_to_idx:
+                raise MXNetError("token %r not indexed" % t)
+            mat[self._token_to_idx[t]] = v
+        self._idx_to_vec = array(mat)
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Embedding from a user file of ``token v1 v2 ...`` lines
+    (ref: embedding.py:CustomEmbedding; also loads GloVe .txt and
+    fastText .vec layouts)."""
+
+    def __init__(self, file_path, elem_delim=" ", encoding="utf-8",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding_file(file_path, elem_delim, encoding)
+
+
+@register
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenation of several embeddings over one vocabulary
+    (ref: embedding.py:CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        super().__init__(unknown_token=vocabulary.unknown_token)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        mats = []
+        for emb in token_embeddings:
+            mats.append(emb.get_vecs_by_tokens(self._idx_to_token)
+                        .asnumpy())
+        full = np.concatenate(mats, axis=1)
+        self._vec_len = full.shape[1]
+        self._idx_to_vec = array(full)

@@ -1,0 +1,87 @@
+"""Text vocabulary (ref: python/mxnet/contrib/text/vocab.py Vocabulary).
+
+Indexes tokens by frequency with an unknown token at index 0 and optional
+reserved tokens, exactly the reference's layout so downstream embedding
+matrices line up."""
+from __future__ import annotations
+
+from collections import Counter
+
+from ...base import MXNetError
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    """Frequency-ordered token index.
+
+    Parameters mirror the reference: ``counter`` token->count,
+    ``most_freq_count`` cap on indexed tokens (excluding unknown/reserved),
+    ``min_freq`` threshold, ``unknown_token`` at index 0, and
+    ``reserved_tokens`` right after it.
+    """
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        reserved_tokens = list(reserved_tokens or [])
+        if unknown_token in reserved_tokens:
+            raise MXNetError("unknown_token cannot also be reserved")
+        if len(set(reserved_tokens)) != len(reserved_tokens):
+            raise MXNetError("reserved_tokens must be unique")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = reserved_tokens
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        if counter is not None:
+            special = set(self._idx_to_token)
+            # frequency-major, then insertion order for ties (the
+            # reference sorts by (-freq, token))
+            pairs = sorted(Counter(counter).items(),
+                           key=lambda kv: (-kv[1], kv[0]))
+            budget = most_freq_count if most_freq_count is not None \
+                else len(pairs)
+            for tok, freq in pairs:
+                if budget <= 0:
+                    break
+                if freq < min_freq or tok in special:
+                    continue
+                self._idx_to_token.append(tok)
+                budget -= 1
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) -> index(es); unknown tokens map to index 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = [self._token_to_idx.get(t, 0) for t in toks]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        out = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise MXNetError("token index %d out of range" % i)
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out

@@ -504,9 +504,14 @@ def _regression(data, label, grad_scale, link, grad=None):
 
     def _bwd(res, g):
         p, lab = res
-        diff = grad(p - lab) if grad is not None else (p - lab)
-        return (diff * grad_scale / (lab.shape[1] if lab.ndim > 1 else 1),
-                jnp.zeros_like(lab))
+        # the reference reshapes the label to the prediction's shape
+        # (regression_output-inl.h) — without this a (N,) label against a
+        # (N,1) pred silently broadcasts the grad to (N,N)
+        lab_r = jnp.reshape(lab, p.shape)
+        diff = grad(p - lab_r) if grad is not None else (p - lab_r)
+        num_output = p.size // p.shape[0] if p.ndim > 0 and p.shape[0] \
+            else 1
+        return (diff * (grad_scale / num_output), jnp.zeros_like(lab))
 
     _f.defvjp(_fwd, _bwd)
     return _f(data, label)

@@ -1,0 +1,183 @@
+"""Contrib python remainder (VERDICT r2 missing #8): text embeddings,
+tensorboard logger, SVRG module, KL-entropy quantization calibration.
+
+Reference: python/mxnet/contrib/{text/, tensorboard.py,
+svrg_optimization/, quantization.py _get_optimal_thresholds}.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import gluon
+
+
+# ------------------------------------------------------------------- text
+def test_vocabulary_indexing():
+    from mxtpu.contrib.text import Vocabulary
+    from mxtpu.contrib.text.utils import count_tokens_from_str
+
+    counter = count_tokens_from_str("a b b c c c\nd d d d")
+    v = Vocabulary(counter, most_freq_count=3, min_freq=2,
+                   reserved_tokens=["<pad>"])
+    # layout: <unk>, <pad>, then frequency-major tokens
+    assert v.idx_to_token[:2] == ["<unk>", "<pad>"]
+    assert v.to_indices("d") == 2
+    assert v.to_indices(["c", "b", "zzz"]) == [3, 4, 0]
+    assert v.to_tokens(2) == "d"
+    assert len(v) == 5  # unk + pad + d,c,b ('a' fails min_freq)
+
+
+def test_custom_embedding_from_file(tmp_path):
+    from mxtpu.contrib.text.embedding import (CompositeEmbedding,
+                                              CustomEmbedding)
+    from mxtpu.contrib.text import Vocabulary
+
+    path = tmp_path / "vecs.txt"
+    path.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = CustomEmbedding(str(path))
+    assert emb.vec_len == 3
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("world").asnumpy(), [4, 5, 6])
+    # unknown token -> zero vector
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("nope").asnumpy(), [0, 0, 0])
+    got = emb.get_vecs_by_tokens(["hello", "world"]).asnumpy()
+    np.testing.assert_allclose(got, [[1, 2, 3], [4, 5, 6]])
+    emb.update_token_vectors("hello", mx.nd.array([9.0, 9.0, 9.0]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [9, 9, 9])
+
+    # fastText .vec header is skipped
+    path2 = tmp_path / "vecs.vec"
+    path2.write_text("2 3\nfoo 1 1 1\nbar 2 2 2\n")
+    emb2 = CustomEmbedding(str(path2))
+    assert emb2.vec_len == 3 and len(emb2) == 3
+
+    vocab = Vocabulary({"hello": 2, "foo": 1})
+    comp = CompositeEmbedding(vocab, [emb, emb2])
+    assert comp.vec_len == 6
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("hello").asnumpy(), [9, 9, 9, 0, 0, 0])
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("foo").asnumpy(), [0, 0, 0, 1, 1, 1])
+
+
+# ------------------------------------------------------------ tensorboard
+def test_log_metrics_callback(tmp_path):
+    from mxtpu.contrib.tensorboard import LogMetricsCallback
+    from mxtpu import metric as metric_mod
+    from mxtpu.model import BatchEndParam
+
+    logdir = str(tmp_path / "tb")
+    cb = LogMetricsCallback(logdir)
+    m = metric_mod.create("acc")
+    m.update([mx.nd.array([1.0, 0.0])],
+             [mx.nd.array([[0.1, 0.9], [0.8, 0.2]])])
+    cb(BatchEndParam(epoch=0, nbatch=1, eval_metric=m, locals=None))
+    cb.flush()
+    cb.close()
+    files = os.listdir(logdir)
+    assert files, "no event/jsonl file written"
+
+
+# ------------------------------------------------------------------ SVRG
+def test_svrg_module_converges_linear_regression():
+    from mxtpu.contrib.svrg_optimization import SVRGModule
+    from mxtpu.io import NDArrayIter
+
+    r = np.random.RandomState(0)
+    true_w = np.array([[2.0], [-3.0], [1.5]], np.float32)
+    X = r.uniform(-1, 1, (200, 3)).astype(np.float32)
+    Y = (X @ true_w).ravel() + r.normal(0, 0.01, 200).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("lin_label")
+    pred = mx.sym.FullyConnected(data, weight=mx.sym.Variable("w"),
+                                 bias=mx.sym.Variable("b"), num_hidden=1,
+                                 name="fc")
+    out = mx.sym.LinearRegressionOutput(pred, label, name="lro")
+
+    it = NDArrayIter(X, Y, batch_size=20, label_name="lin_label")
+    mod = SVRGModule(out, data_names=("data",), label_names=("lin_label",),
+                     update_freq=2)
+    mod.fit(it, num_epoch=8, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.2),), eval_metric="mse")
+    w = mod.get_params()[0]["w"].asnumpy().ravel()
+    np.testing.assert_allclose(w, true_w.ravel(), atol=0.15)
+
+
+def test_svrg_variance_reduction_math():
+    """After update_full_grads, update() applies g - g_snapshot + mu: with
+    weights == snapshot, the applied gradient equals mu exactly."""
+    from mxtpu.contrib.svrg_optimization import SVRGModule
+    from mxtpu.io import NDArrayIter
+
+    r = np.random.RandomState(1)
+    X = r.uniform(-1, 1, (40, 3)).astype(np.float32)
+    Y = r.uniform(-1, 1, 40).astype(np.float32)
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("lin_label")
+    pred = mx.sym.FullyConnected(data, weight=mx.sym.Variable("w"),
+                                 bias=mx.sym.Variable("b"), num_hidden=1)
+    out = mx.sym.LinearRegressionOutput(pred, label)
+    it = NDArrayIter(X, Y, batch_size=10, label_name="lin_label")
+    mod = SVRGModule(out, data_names=("data",), label_names=("lin_label",),
+                     update_freq=1)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.0),))
+    mod.update_full_grads(it)
+    mu = {k: v.asnumpy() for k, v in mod._full_grads.items()}
+    it.reset()
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    # weights == snapshot -> g and g_snapshot cancel
+    mod.update()
+    for name in ("w", "b"):
+        got = mod._exec.grad_dict[name].asnumpy()
+        np.testing.assert_allclose(got, mu[name], rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------- entropy calibration
+def test_entropy_calibration_clips_outliers():
+    from mxtpu.contrib.quantization import (_optimal_threshold, calibrate,
+                                            quantize_net, freeze)
+
+    r = np.random.RandomState(0)
+    # heavy-tailed: bulk in [-1, 1], a few extreme outliers at +-50
+    bulk = r.normal(0, 0.3, 100000).astype(np.float32)
+    outliers = np.array([50.0, -50.0, 45.0], np.float32)
+    arr = np.concatenate([bulk, outliers])
+    th = _optimal_threshold(arr)
+    assert th < 10.0, "entropy threshold should clip the +-50 outliers"
+
+    # end to end: entropy calibration quantizes better than naive when the
+    # calibration data has a spike
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16))
+    net.initialize()
+    x = r.normal(0, 0.3, (64, 8)).astype(np.float32)
+    x[0, 0] = 60.0  # one wild outlier
+    xs = mx.nd.array(x)
+    net(xs)
+    ref = net(xs).asnumpy()
+
+    def accuracy(mode):
+        q = gluon.nn.HybridSequential()
+        with q.name_scope():
+            q.add(gluon.nn.Dense(16))
+        q.initialize()
+        q(xs)
+        q[0].weight.set_data(net[0].weight.data())
+        q[0].bias.set_data(net[0].bias.data())
+        quantize_net(q, quiet=True)
+        calibrate(q, [xs], mode=mode)
+        freeze(q)
+        got = q(xs).asnumpy()
+        return np.abs(got[1:] - ref[1:]).mean()  # error off the outlier row
+
+    assert accuracy("entropy") < accuracy("naive")

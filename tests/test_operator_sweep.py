@@ -855,3 +855,20 @@ def test_deferred_exception_surfaces_at_sync():
     with pytest.raises(Exception):
         b = mx.nd.dot(a, mx.nd.array(np.ones((3, 3), np.float32)))
         b.asnumpy()
+
+
+def test_regression_output_grad_shapes():
+    """Regression-output backward must match the data shape exactly — a
+    (N,) label vs (N,1) pred once silently broadcast the grad to (N,N)
+    (caught by the SVRG convergence test; ref regression_output-inl.h
+    reshapes the label)."""
+    from mxtpu import autograd as ag
+    for name in ("LinearRegressionOutput", "LogisticRegressionOutput",
+                 "MAERegressionOutput"):
+        d = mx.nd.array(np.array([[1.0], [2.0]], np.float32))
+        lab = mx.nd.array(np.array([0.5, 0.25], np.float32))
+        d.attach_grad()
+        with ag.record():
+            out = mx.ops.invoke(name, d, lab)
+        out.backward()
+        assert d.grad.shape == d.shape, (name, d.grad.shape)
