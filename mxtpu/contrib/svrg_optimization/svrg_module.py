@@ -103,7 +103,7 @@ class SVRGModule(Module):
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
             initializer=None, num_epoch=None, kvstore=None,
-            batch_end_callback=None, begin_epoch=0):
+            batch_end_callback=None, begin_epoch=0, force_rebind=False):
         """Training loop with the periodic full-gradient pass
         (ref: svrg_module.py:fit)."""
         assert num_epoch is not None, "please specify number of epochs"
@@ -112,7 +112,8 @@ class SVRGModule(Module):
         from ...module.base_module import _as_metric
 
         self.bind(data_shapes=train_data.provide_data,
-                  label_shapes=train_data.provide_label, for_training=True)
+                  label_shapes=train_data.provide_label, for_training=True,
+                  force_rebind=force_rebind)
         self.init_params(initializer=initializer or Uniform(0.01))
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)

@@ -19,6 +19,7 @@ from jax import lax
 
 from .. import autograd
 from ..random import next_key
+from .precision_util import mxu_precision
 from .registry import register
 
 
@@ -40,8 +41,10 @@ def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
     """y = x W^T + b (ref: src/operator/nn/fully_connected.cc:239-328).
 
     Weight layout (num_hidden, in_units) matches the reference exactly so
-    checkpoints are interchangeable. bf16 inputs accumulate in f32 on the MXU
-    by hardware semantics; f32 inputs get true-f32 contractions via the global
+    checkpoints are interchangeable. bf16 inputs run one-pass on the MXU
+    with f32 accumulation (exact; precision override via mxu_precision —
+    the global HIGHEST would force f32 emulation, see precision_util.py);
+    f32 inputs get true-f32 contractions via the global
     jax_default_matmul_precision setting (mxtpu/__init__.py). No
     preferred_element_type: a widened primitive output breaks jax's
     conv/dot transpose rules under bf16 autodiff (mixed-dtype operands).
@@ -49,7 +52,8 @@ def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
     x = data
     if flatten and x.ndim > 2:
         x = jnp.reshape(x, (x.shape[0], -1))
-    y = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())))
+    y = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+                        precision=mxu_precision(x, weight))
     if bias is not None and not no_bias:
         y = y + bias
     return y
@@ -100,6 +104,7 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         rhs_dilation=dilate,
         dimension_numbers=dims,
         feature_group_count=num_group,
+        precision=mxu_precision(data, weight),
     )
     if bias is not None and not no_bias:
         if channels_last:
@@ -144,6 +149,7 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None
         rhs_dilation=dilate,
         dimension_numbers=dims,
         feature_group_count=num_group,
+        precision=mxu_precision(data, w),
     )
     if bias is not None and not no_bias:
         if channels_last:

@@ -1,0 +1,32 @@
+"""MXU precision selection for contraction ops.
+
+The package-global ``jax_default_matmul_precision='float32'``
+(mxtpu/__init__.py) exists to keep FLOAT32 contractions honest: without
+it, XLA:TPU silently truncates f32 operands to one-pass bf16. But that
+global also tags BF16 contractions HIGHEST, which makes XLA run them
+through the multi-pass f32-emulation path — 3-6x slower on the MXU for
+zero numerical benefit (one-pass bf16x bf16 with f32 accumulation is
+already exact for bf16 operands). This was the round-1/round-2 ResNet-50
+throughput ceiling: every conv in the train step lowered with
+``precision HIGHEST`` (see PERF.md).
+
+``mxu_precision(*operands)`` returns the right per-op override:
+DEFAULT when every floating operand is sub-f32 (bf16/f16), None (inherit
+the honest global) otherwise. Same policy as the flash-attention kernel
+(mxtpu/ops/pallas/flash_attention.py:71-75), applied everywhere a
+contraction is issued.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+_LOW = (jnp.bfloat16, jnp.float16)
+
+
+def mxu_precision(*operands):
+    """Precision override for lax dot/conv given the actual operands."""
+    dtypes = [o.dtype for o in operands if hasattr(o, "dtype")]
+    if dtypes and all(d in _LOW for d in dtypes):
+        return lax.Precision.DEFAULT
+    return None

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .precision_util import mxu_precision
 from .registry import register, register_param_shapes
 
 
@@ -27,7 +28,10 @@ def _cell_step(mode, W_ih, W_hh, b_ih, b_hh):
     if mode == "lstm":
         def step(carry, x):
             h, c = carry
-            z = jnp.dot(x, W_ih.T) + b_ih + jnp.dot(h, W_hh.T) + b_hh
+            # precision from the ACTUAL operands (weights may be bf16 while
+            # activations are f32 — then the honest-f32 global must win)
+            z = jnp.dot(x, W_ih.T, precision=mxu_precision(x, W_ih)) + b_ih \
+                + jnp.dot(h, W_hh.T, precision=mxu_precision(h, W_hh)) + b_hh
             i, f, g, o = jnp.split(z, 4, axis=-1)
             i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
             g = jnp.tanh(g)
@@ -38,8 +42,8 @@ def _cell_step(mode, W_ih, W_hh, b_ih, b_hh):
     if mode == "gru":
         def step(carry, x):
             h = carry
-            xi = jnp.dot(x, W_ih.T) + b_ih
-            hh = jnp.dot(h, W_hh.T) + b_hh
+            xi = jnp.dot(x, W_ih.T, precision=mxu_precision(x, W_ih)) + b_ih
+            hh = jnp.dot(h, W_hh.T, precision=mxu_precision(h, W_hh)) + b_hh
             xr, xz, xn = jnp.split(xi, 3, axis=-1)
             hr, hz, hn = jnp.split(hh, 3, axis=-1)
             r = jax.nn.sigmoid(xr + hr)
@@ -52,7 +56,10 @@ def _cell_step(mode, W_ih, W_hh, b_ih, b_hh):
 
     def step(carry, x):
         h = carry
-        h_new = act(jnp.dot(x, W_ih.T) + b_ih + jnp.dot(h, W_hh.T) + b_hh)
+        h_new = act(jnp.dot(x, W_ih.T, precision=mxu_precision(x, W_ih))
+                    + b_ih
+                    + jnp.dot(h, W_hh.T, precision=mxu_precision(h, W_hh))
+                    + b_hh)
         return h_new, h_new
     return step
 
