@@ -8,14 +8,16 @@ collectives over the device mesh. See SURVEY.md at the repo root for the layer m
 
 Use ``import mxtpu as mx`` — the namespace mirrors ``import mxnet as mx``.
 """
-__version__ = "0.1.0"
 
 import jax as _jax
 
-# float32 inputs get true-f32 matmuls (3-pass bf16 on the MXU); bfloat16 inputs —
-# the TPU fast path every model should use — are unaffected. Without this, JAX's
-# default matmul precision silently downcasts f32 contractions to one-pass bf16,
-# which breaks reference-parity numerics (MXNet computes f32 in f32).
+# float32 contractions stay honest f32 (without this, JAX's default silently
+# downcasts f32 matmuls to one-pass bf16, breaking reference-parity numerics —
+# MXNet computes f32 in f32). bfloat16 contractions do NOT inherit this
+# global: every op passes an explicit per-operand override
+# (mxtpu/ops/precision_util.py) so bf16 runs the native one-pass MXU path —
+# inheriting HIGHEST here made bf16 convs run 3-6x-slower f32 emulation,
+# the round-1/2 throughput ceiling (PERF.md).
 _jax.config.update("jax_default_matmul_precision", "float32")
 
 from . import base
@@ -52,4 +54,7 @@ from . import operator
 from . import contrib
 from . import rnn
 from . import parallel
+from . import rtc
+from . import libinfo
+from .libinfo import __version__, feature_list
 from . import test_utils

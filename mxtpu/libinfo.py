@@ -1,0 +1,69 @@
+"""Build/runtime feature introspection
+(ref: python/mxnet/libinfo.py + MXGetVersion/runtime feature flags).
+
+The reference reports compiled-in features (CUDA, CUDNN, MKLDNN, ...);
+the TPU-native equivalents are runtime-discoverable facts about the jax
+stack and the native library.
+"""
+from __future__ import annotations
+
+__all__ = ["__version__", "features", "feature_list", "find_lib_path"]
+
+__version__ = "0.3.0"  # round-numbered: bumped per build round
+
+
+class Feature:
+    __slots__ = ("name", "enabled")
+
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = bool(enabled)
+
+    def __repr__(self):
+        return "[%s %s]" % ("+" if self.enabled else "-", self.name)
+
+
+def features():
+    """Dict of feature name -> enabled (ref: runtime feature flags)."""
+    import jax
+
+    out = {}
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "unknown"
+    out["TPU"] = platform == "tpu"
+    out["CPU_FALLBACK"] = platform == "cpu"
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        out["PALLAS"] = True
+    except Exception:
+        out["PALLAS"] = False
+    from ._native import build_error, get_lib
+    lib = get_lib()
+    out["NATIVE_LIB"] = lib is not None
+    out["C_API"] = lib is not None and hasattr(lib, "MXTPUGetLastError")
+    out["NATIVE_RECORDIO"] = lib is not None and hasattr(
+        lib, "mxtpu_recordio_reader_create")
+    if lib is None and build_error() is not None:
+        out["NATIVE_BUILD_ERROR"] = True
+    try:
+        import cv2  # noqa: F401
+        out["OPENCV"] = True
+    except Exception:
+        out["OPENCV"] = False
+    out["DISTRIBUTED"] = True  # jax.distributed is always importable
+    return out
+
+
+def feature_list():
+    """List of Feature objects (ref: mx.runtime.feature_list)."""
+    return [Feature(k, v) for k, v in sorted(features().items())]
+
+
+def find_lib_path():
+    """Path(s) to the native library (ref: libinfo.py:find_lib_path)."""
+    import os
+
+    from ._native import _SO_PATH
+    return [_SO_PATH] if os.path.exists(_SO_PATH) else []

@@ -181,3 +181,31 @@ def test_entropy_calibration_clips_outliers():
         return np.abs(got[1:] - ref[1:]).mean()  # error off the outlier row
 
     assert accuracy("entropy") < accuracy("naive")
+
+
+# ------------------------------------------------------------------- rtc
+def test_rtc_pallas_module():
+    """Runtime-compiled Pallas kernel launched on NDArrays
+    (ref: python/mxnet/rtc.py CudaModule; test_rtc.py pattern)."""
+    from mxtpu.rtc import PallasModule
+
+    src = """
+def axpy(x_ref, y_ref, out_ref):
+    out_ref[...] = 2.5 * x_ref[...] + y_ref[...]
+
+def square(x_ref, out_ref):
+    out_ref[...] = x_ref[...] * x_ref[...]
+"""
+    mod = PallasModule(src)
+    x = mx.nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    y = mx.nd.array(np.ones((2, 4), np.float32))
+    k = mod.get_kernel("axpy")
+    out = k.launch([x, y], out_shapes=(2, 4))
+    np.testing.assert_allclose(out.asnumpy(),
+                               2.5 * x.asnumpy() + 1.0, rtol=1e-6)
+    sq = mod.get_kernel("square").launch([x], out_shapes=(2, 4))
+    np.testing.assert_allclose(sq.asnumpy(), x.asnumpy() ** 2)
+
+    import pytest as _pytest
+    with _pytest.raises(Exception, match="not in module"):
+        mod.get_kernel("nope")
