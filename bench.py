@@ -58,11 +58,25 @@ def _run(step, batch, n_items, model_flops_per_item=None):
     """
     for _ in range(3):  # warmup + compile
         step(*batch).asnumpy()
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        out = step(*batch)
-    out.asnumpy()  # sync
-    dt = time.perf_counter() - t0
+    profile = os.environ.get("BENCH_PROFILE")
+    if profile:
+        # chrome-trace + jax device trace of the timed region, through the
+        # framework's own profiler (mxtpu/profiler.py ~ src/profiler/
+        # profiler.h) — profile_xla owns the jax start/stop_trace pair
+        from mxtpu import profiler as _prof
+        _prof.set_config(filename=profile, profile_xla=True,
+                         xla_trace_dir=os.path.dirname(profile) or ".")
+        _prof.start()
+    try:
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            out = step(*batch)
+        out.asnumpy()  # sync
+        dt = time.perf_counter() - t0
+    finally:
+        if profile:
+            _prof.stop()
+            _prof.dump()
     rate = n_items * STEPS / dt
     peak = _peak_flops()
     mfu = hfu = None

@@ -39,14 +39,25 @@ def features():
         out["PALLAS"] = True
     except Exception:
         out["PALLAS"] = False
-    from ._native import build_error, get_lib
-    lib = get_lib()
-    out["NATIVE_LIB"] = lib is not None
-    out["C_API"] = lib is not None and hasattr(lib, "MXTPUGetLastError")
-    out["NATIVE_RECORDIO"] = lib is not None and hasattr(
-        lib, "mxtpu_recordio_reader_create")
-    if lib is None and build_error() is not None:
-        out["NATIVE_BUILD_ERROR"] = True
+    # report from on-disk state — a diagnostics query must never trigger
+    # the full native g++ build that get_lib() would kick off
+    import ctypes
+    import os as _os
+
+    from ._native import _SO_PATH, build_error
+    built = _os.path.exists(_SO_PATH)
+    out["NATIVE_LIB"] = built
+    has_c_api = has_recordio = False
+    if built:
+        try:
+            _lib = ctypes.CDLL(_SO_PATH)
+            has_c_api = hasattr(_lib, "MXTPUGetLastError")
+            has_recordio = hasattr(_lib, "mxtpu_recordio_reader_create")
+        except OSError:
+            out["NATIVE_LIB"] = False
+    out["C_API"] = has_c_api
+    out["NATIVE_RECORDIO"] = has_recordio
+    out["NATIVE_BUILD_ERROR"] = build_error() is not None
     try:
         import cv2  # noqa: F401
         out["OPENCV"] = True

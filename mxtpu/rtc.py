@@ -48,8 +48,9 @@ class PallasModule:
         except SyntaxError as e:
             raise MXNetError("PallasModule source failed to compile: %s"
                              % e) from e
+        import inspect
         fns = {k: v for k, v in self._namespace.items()
-               if callable(v) and k not in seeded
+               if inspect.isfunction(v) and k not in seeded
                and not k.startswith("__")}
         if exports is not None:
             missing = [e for e in exports if e not in fns]
@@ -99,6 +100,12 @@ class Kernel:
         n_out = len(out_shapes)
         if out_dtypes is None:
             out_dtypes = [args[0].dtype if args else _np.float32] * n_out
+        elif isinstance(out_dtypes, (str, type)) or not hasattr(
+                out_dtypes, "__len__"):
+            out_dtypes = [out_dtypes] * n_out
+        if len(out_dtypes) != n_out:
+            raise MXNetError("launch: %d out_dtypes for %d out_shapes"
+                             % (len(out_dtypes), n_out))
         if interpret is None:
             interpret = jax.devices()[0].platform != "tpu"
         out_shape = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
@@ -110,8 +117,8 @@ class Kernel:
                                 len(out_shapes)))
         key = (tuple((a.shape, str(a.dtype)) for a in args),
                tuple(tuple(s) for s in out_shapes),
-               tuple(str(d) for d in out_dtypes), grid, bool(interpret),
-               repr(in_specs), repr(out_specs))
+               tuple(str(d) for d in out_dtypes), repr(grid),
+               bool(interpret), repr(in_specs), repr(out_specs))
         if key not in self._compiled:
             kwargs = {"out_shape": out_shape if n_out > 1 else out_shape[0],
                       "interpret": interpret}

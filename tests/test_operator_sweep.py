@@ -258,7 +258,8 @@ CASES["where"] = C(
     lambda: [np.array([[1, 0, 1]], np.float32),
              RNG(0).uniform(-1, 1, (2, 3)).astype(np.float32),
              RNG(1).uniform(-1, 1, (2, 3)).astype(np.float32)],
-    lambda c, x, y: np.where(np.broadcast_to(c != 0, x.shape), x, y))
+    lambda c, x, y: np.where(np.broadcast_to(c != 0, x.shape), x, y),
+    grad=True)
 CASES["one_hot"] = C(lambda: [np.array([0, 2, 1], np.float32)],
                      lambda i: np.eye(3, dtype=np.float32)[i.astype(int)],
                      kwargs={"depth": 3}, bf16=False)
@@ -484,8 +485,10 @@ CASES["UpSampling"] = C(
     kwargs={"scale": 2, "sample_type": "nearest"}, grad=True)
 CASES["SequenceMask"] = C(
     _x(-1, 1, (3, 2, 4)), lambda x: x, kwargs={})  # no lengths = identity
-CASES["SequenceLast"] = C(_x(-1, 1, (3, 2, 4)), lambda x: x[-1])
-CASES["SequenceReverse"] = C(_x(-1, 1, (3, 2, 4)), lambda x: x[::-1])
+CASES["SequenceLast"] = C(_x(-1, 1, (3, 2, 4)), lambda x: x[-1],
+                          grad=True)
+CASES["SequenceReverse"] = C(_x(-1, 1, (3, 2, 4)), lambda x: x[::-1],
+                             grad=True)
 
 # --------------------------------------------------------- vision / contrib
 CASES["ROIPooling"] = C(
