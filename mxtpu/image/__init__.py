@@ -5,10 +5,14 @@ from .image import (imread, imdecode, imresize, fixed_crop, center_crop,
                     RandomCropAug, CenterCropAug, HorizontalFlipAug, CastAug,
                     ColorNormalizeAug, BrightnessJitterAug, ContrastJitterAug,
                     SaturationJitterAug)
+from .detection import (CreateDetAugmenter, DetAugmenter, DetBorrowAug,
+                        DetHorizontalFlipAug, DetRandomCropAug, ImageDetIter)
 
 __all__ = ["imread", "imdecode", "imresize", "fixed_crop", "center_crop",
            "random_crop", "resize_short", "color_normalize", "ImageIter",
            "CreateAugmenter", "Augmenter", "ResizeAug", "ForceResizeAug",
            "RandomCropAug", "CenterCropAug", "HorizontalFlipAug", "CastAug",
+           "ImageDetIter", "CreateDetAugmenter", "DetAugmenter",
+           "DetBorrowAug", "DetHorizontalFlipAug", "DetRandomCropAug",
            "ColorNormalizeAug", "BrightnessJitterAug", "ContrastJitterAug",
            "SaturationJitterAug"]

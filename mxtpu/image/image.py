@@ -325,15 +325,24 @@ class ImageIter(DataIter):
             _pyrandom.shuffle(self._seq)
         self._cursor = 0
 
-    def _read_sample(self, key):
+    def _read_image(self, key):
+        """Decode one sample's image (RGB HWC numpy) — shared with
+        ImageDetIter so decode fixes apply to both."""
         if self._record is not None:
             from ..recordio import unpack_img
-            header, img = unpack_img(self._record.read_idx(key))
-            img = img[..., ::-1]  # BGR -> RGB like the reference decode
+            _header, img = unpack_img(self._record.read_idx(key))
+            return img[..., ::-1]  # BGR -> RGB like the reference decode
+        path, _label = self._imglist[key]
+        return imread(os.path.join(self._path_root, path)).asnumpy()
+
+    def _read_sample(self, key):
+        if self._record is not None:
+            from ..recordio import unpack
+            header, _ = unpack(self._record.read_idx(key))
             label = header.label
         else:
-            path, label = self._imglist[key]
-            img = imread(os.path.join(self._path_root, path)).asnumpy()
+            _, label = self._imglist[key]
+        img = self._read_image(key)
         for aug in self.auglist:
             img = aug(img)
         img = _as_np(img)

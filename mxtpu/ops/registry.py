@@ -23,7 +23,7 @@ from typing import Callable, Dict, List, Optional
 from ..ndarray.ndarray import NDArray, _apply
 
 __all__ = ["Op", "register", "get_op", "list_ops", "invoke", "REGISTRY",
-           "register_param_shapes", "get_param_shape_rule"]
+           "register_param_shapes", "get_param_shape_rule", "describe"]
 
 
 class Op:
@@ -138,3 +138,27 @@ def attach_methods(cls=NDArray):
             return method
 
         setattr(cls, key, make(op.wrapper))
+
+
+def describe(name: str) -> dict:
+    """Parameter reflection for a registered op — the dmlc::Parameter /
+    DMLC_DECLARE_FIELD analog (SURVEY §5 config system): the reference
+    generates Python signatures + docstrings from each op's declared param
+    struct; here the op IS a Python function, so its signature is the
+    declaration. Returns {"name", "doc", "arguments": [...],
+    "attributes": [{"name", "default"}...]}."""
+    op = get_op(name)
+    sig = inspect.signature(op.fn)
+    arguments = []
+    attributes = []
+    for pname, p in sig.parameters.items():
+        if p.kind in (inspect.Parameter.VAR_POSITIONAL,
+                      inspect.Parameter.VAR_KEYWORD):
+            arguments.append({"name": pname, "variadic": True})
+        elif p.default is inspect.Parameter.empty:
+            arguments.append({"name": pname})
+        else:
+            attributes.append({"name": pname, "default": p.default})
+    return {"name": op.name, "aliases": list(op.aliases),
+            "doc": op.doc, "arguments": arguments,
+            "attributes": attributes}

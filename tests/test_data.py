@@ -244,3 +244,47 @@ def test_image_record_dataset_and_iter(tmp_path):
     batch = next(iter(it))
     assert batch.data[0].shape == (4, 3, 32, 32)
     assert batch.label[0].shape == (4,)
+
+
+def test_image_det_iter():
+    """Detection iterator: padded object labels, box-aware flip
+    (ref: python/mxnet/image/detection.py ImageDetIter; feeds the SSD
+    multibox ops)."""
+    import numpy as np
+    import mxtpu as mx
+    from mxtpu.image import ImageDetIter
+    from mxtpu.image.detection import DetHorizontalFlipAug
+
+    # two in-memory "images" via imglist: label = [A=4, B=5, pad, pad,
+    # objects...]
+    import cv2
+    import tempfile, os
+    tmp = tempfile.mkdtemp()
+    paths = []
+    for i in range(3):
+        p = os.path.join(tmp, "img%d.png" % i)
+        cv2.imwrite(p, np.random.randint(0, 255, (40, 60, 3), np.uint8))
+        paths.append(p)
+    # one object for img0, two for img1, one for img2
+    lab0 = [4, 5, 0, 0, 1.0, 0.1, 0.2, 0.5, 0.6]
+    lab1 = [4, 5, 0, 0, 0.0, 0.0, 0.0, 0.3, 0.3,
+            2.0, 0.5, 0.5, 0.9, 0.9]
+    lab2 = [4, 5, 0, 0, 1.0, 0.2, 0.2, 0.4, 0.4]
+    imglist = [lab0 + [paths[0]], lab1 + [paths[1]], lab2 + [paths[2]]]
+    it = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                      imglist=imglist, path_root="")
+    batch = next(iter(it))
+    assert batch.data[0].shape == (2, 3, 32, 32)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape == (2, 2, 5)  # padded to max 2 objects
+    np.testing.assert_allclose(lab[0, 0], [1.0, 0.1, 0.2, 0.5, 0.6],
+                               atol=1e-6)
+    assert lab[0, 1, 0] == -1.0  # padding row
+
+    # flip adjusts boxes: x -> 1 - x (always flip)
+    flip = DetHorizontalFlipAug(p=1.1)
+    img = np.zeros((10, 10, 3), np.float32)
+    objs = np.array([[1.0, 0.1, 0.2, 0.5, 0.6]], np.float32)
+    _, flipped = flip(img, objs)
+    np.testing.assert_allclose(flipped[0], [1.0, 0.5, 0.2, 0.9, 0.6],
+                               atol=1e-6)

@@ -875,3 +875,22 @@ def test_regression_output_grad_shapes():
             out = mx.ops.invoke(name, d, lab)
         out.backward()
         assert d.grad.shape == d.shape, (name, d.grad.shape)
+
+
+def test_op_describe_reflection():
+    """Op parameter reflection (the dmlc::Parameter analog, SURVEY §5):
+    declared arguments/attributes with defaults are introspectable for
+    every registered op."""
+    from mxtpu.ops.registry import describe
+
+    d = describe("Convolution")
+    assert d["name"] == "Convolution"
+    arg_names = [a["name"] for a in d["arguments"]]
+    assert "data" in arg_names and "weight" in arg_names
+    attrs = {a["name"]: a.get("default") for a in d["attributes"]}
+    assert attrs["num_group"] == 1 and attrs["no_bias"] is False
+    assert "convolution" in d["aliases"]
+    # every unique op must be describable
+    for name in _unique_ops():
+        info = describe(name)
+        assert info["name"] == name

@@ -1,9 +1,7 @@
 """One-shot post-fix validation on the real chip (run when the tunnel is
-up): scan-fused on-chip step time before/after context, then the real
-bench numbers. Appends results to PERF.md manually afterwards."""
-import json
+up): tunnel RTT + scan-fused on-chip step time. Run ``python bench.py``
+separately for the full scoring numbers; append both to PERF.md."""
 import os
-import subprocess
 import sys
 import time
 
@@ -12,7 +10,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main():
     import jax
-    import numpy as np
 
     print("devices:", jax.devices(), flush=True)
 
