@@ -335,18 +335,22 @@ def main():
         # per-config isolation: a failing config must not eat the headline
         # resnet50 line (the driver parses the LAST printed line)
         base_profile = os.environ.get("BENCH_PROFILE")
-        for cname, fn in CONFIGS.items():
+        try:
+            for cname, fn in CONFIGS.items():
+                if base_profile:
+                    # one trace file per config — a shared file would be
+                    # clobbered and merged across configs
+                    root, ext = os.path.splitext(base_profile)
+                    os.environ["BENCH_PROFILE"] = "%s.%s%s" % (root, cname,
+                                                               ext or ".json")
+                try:
+                    print(json.dumps(fn()), flush=True)
+                except Exception as e:  # noqa: BLE001 - report and move on
+                    print(json.dumps({"metric": cname, "error": str(e)}),
+                          flush=True)
+        finally:
             if base_profile:
-                # one trace file per config — a shared file would be
-                # clobbered and merged across configs
-                root, ext = os.path.splitext(base_profile)
-                os.environ["BENCH_PROFILE"] = "%s.%s%s" % (root, cname,
-                                                           ext or ".json")
-            try:
-                print(json.dumps(fn()), flush=True)
-            except Exception as e:  # noqa: BLE001 - report and move on
-                print(json.dumps({"metric": cname, "error": str(e)}),
-                      flush=True)
+                os.environ["BENCH_PROFILE"] = base_profile
         return
     print(json.dumps(CONFIGS[name]()))
 

@@ -137,11 +137,15 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_mirror=False,
     auglist.append(_DetResizeAug((data_shape[2], data_shape[1]),
                                  inter_method))
     auglist.append(DetBorrowAug(CastAug()))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
     if mean is not None or std is not None:
-        if mean is True:
-            mean = np.array([123.68, 116.28, 103.53])
-        if std is True:
-            std = np.array([58.395, 57.12, 57.375])
+        # either side may be absent: normalize with identity for that side
+        # (np.asarray(None) is NaN — never pass None through)
+        mean = np.zeros(3) if mean is None else mean
+        std = np.ones(3) if std is None else std
         auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
     return auglist
 
@@ -155,14 +159,24 @@ class ImageDetIter(ImageIter):
                  shuffle=False, part_index=0, num_parts=1, aug_list=None,
                  data_name="data", label_name="label", label_shape=None,
                  **kwargs):
+        # split kwargs: CreateDetAugmenter params vs parent-iterator params
+        # (e.g. last_batch_handle) — mirroring ImageIter's own aug_keys split
+        det_aug_keys = ("resize", "rand_crop", "rand_mirror", "mean", "std",
+                        "min_crop_scale", "inter_method")
+        det_kwargs = {k: kwargs.pop(k) for k in list(kwargs)
+                      if k in det_aug_keys}
+        if aug_list is not None and det_kwargs:
+            raise MXNetError("aug_list given; augmenter kwargs %s would be "
+                             "ignored" % sorted(det_kwargs))
         aug = aug_list if aug_list is not None else \
-            CreateDetAugmenter(data_shape, **kwargs)
+            CreateDetAugmenter(data_shape, **det_kwargs)
         super().__init__(batch_size, data_shape, label_width=1,
                          path_imgrec=path_imgrec, path_imglist=path_imglist,
                          path_root=path_root, imglist=imglist,
                          shuffle=shuffle, part_index=part_index,
                          num_parts=num_parts, aug_list=[],
-                         data_name=data_name, label_name=label_name)
+                         data_name=data_name, label_name=label_name,
+                         **kwargs)
         self._det_auglist = aug
         self._obj_width = None
         if label_shape is not None:
@@ -231,8 +245,8 @@ class ImageDetIter(ImageIter):
         while i < self.batch_size:
             if self._cursor < len(self._seq):
                 key = self._seq[self._cursor]
-                objs = self._parse_label(self._raw_label(key))
-                img = self._read_image(key)
+                raw, img = self._read_record(key)
+                objs = self._parse_label(raw)
                 for aug in self._det_auglist:
                     img, objs = aug(img, objs)
                 img = _as_np(img)

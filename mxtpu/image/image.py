@@ -325,24 +325,27 @@ class ImageIter(DataIter):
             _pyrandom.shuffle(self._seq)
         self._cursor = 0
 
-    def _read_image(self, key):
-        """Decode one sample's image (RGB HWC numpy) — shared with
-        ImageDetIter so decode fixes apply to both."""
+    def _read_record(self, key):
+        """ONE read+decode of a sample -> (label vector, RGB HWC image).
+        Shared with ImageDetIter; the RecordIO blob is read and unpacked
+        exactly once per sample (the hot IO path)."""
         if self._record is not None:
             from ..recordio import unpack_img
-            _header, img = unpack_img(self._record.read_idx(key))
-            return img[..., ::-1]  # BGR -> RGB like the reference decode
-        path, _label = self._imglist[key]
-        return imread(os.path.join(self._path_root, path)).asnumpy()
+            header, img = unpack_img(self._record.read_idx(key))
+            # BGR -> RGB like the reference decode
+            return (np.asarray(header.label, np.float32).reshape(-1),
+                    img[..., ::-1])
+        path, label = self._imglist[key]
+        return (np.asarray(label, np.float32).reshape(-1),
+                imread(os.path.join(self._path_root, path)).asnumpy())
+
+    def _read_image(self, key):
+        """Decode one sample's image only (compat shim; prefer
+        _read_record when the label is also needed)."""
+        return self._read_record(key)[1]
 
     def _read_sample(self, key):
-        if self._record is not None:
-            from ..recordio import unpack
-            header, _ = unpack(self._record.read_idx(key))
-            label = header.label
-        else:
-            _, label = self._imglist[key]
-        img = self._read_image(key)
+        label, img = self._read_record(key)
         for aug in self.auglist:
             img = aug(img)
         img = _as_np(img)

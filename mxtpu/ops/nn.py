@@ -19,7 +19,8 @@ from jax import lax
 
 from .. import autograd
 from ..random import next_key
-from .precision_util import mxu_precision
+from .conv_acc import conv_fast
+from .precision_util import dot_acc, mxu_precision
 from .registry import register
 
 
@@ -42,18 +43,17 @@ def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
 
     Weight layout (num_hidden, in_units) matches the reference exactly so
     checkpoints are interchangeable. bf16 inputs run one-pass on the MXU
-    with f32 accumulation (exact; precision override via mxu_precision —
-    the global HIGHEST would force f32 emulation, see precision_util.py);
-    f32 inputs get true-f32 contractions via the global
-    jax_default_matmul_precision setting (mxtpu/__init__.py). No
-    preferred_element_type: a widened primitive output breaks jax's
-    conv/dot transpose rules under bf16 autodiff (mixed-dtype operands).
+    with an f32 accumulator output cast back to bf16 in the epilogue —
+    the measurably fastest v5e schedule (tools/perf_peak.py: 140 vs 102
+    TFLOP/s for the bf16-out form) and exact accumulation for free; f32
+    inputs get true-f32 contractions via the global
+    jax_default_matmul_precision setting (mxtpu/__init__.py). See
+    precision_util.dot_acc.
     """
     x = data
     if flatten and x.ndim > 2:
         x = jnp.reshape(x, (x.shape[0], -1))
-    y = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
-                        precision=mxu_precision(x, weight))
+    y = dot_acc(x, weight, (((x.ndim - 1,), (1,)), ((), ())))
     if bias is not None and not no_bias:
         y = y + bias
     return y
@@ -89,7 +89,8 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     src/operator/nn/convolution.cu + cudnn wrappers). One HLO ConvGeneralDilated;
     grouped/depthwise via feature_group_count (the reference needed a dedicated
     TF-derived depthwise kernel, depthwise_convolution_tf.cuh — here it's the same
-    HLO and XLA picks the kernel)."""
+    HLO and XLA picks the kernel). bf16 operands take the f32-accumulate
+    custom-vjp fast path (conv_acc.py)."""
     ndim = data.ndim - 2
     kernel = _pair(kernel, ndim)
     stride = _pair(stride, ndim)
@@ -97,14 +98,14 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     pad = _pair(pad, ndim) if pad is not None else (0,) * ndim
     dims = _conv_dims(ndim, layout)
     channels_last = dims[0][-1] == "C"
-    out = lax.conv_general_dilated(
+    out = conv_fast(
         data, weight,
-        window_strides=stride,
+        strides=stride,
         padding=[(p, p) for p in pad],
+        lhs_dilation=(1,) * ndim,
         rhs_dilation=dilate,
-        dimension_numbers=dims,
-        feature_group_count=num_group,
-        precision=mxu_precision(data, weight),
+        dims=dims,
+        groups=num_group,
     )
     if bias is not None and not no_bias:
         if channels_last:
@@ -141,15 +142,14 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None
     for i in range(ndim):
         k = (kernel[i] - 1) * dilate[i]
         padding.append((k - pad[i], k - pad[i] + adj[i]))
-    out = lax.conv_general_dilated(
+    out = conv_fast(
         data, w,
-        window_strides=(1,) * ndim,
+        strides=(1,) * ndim,
         padding=padding,
         lhs_dilation=stride,
         rhs_dilation=dilate,
-        dimension_numbers=dims,
-        feature_group_count=num_group,
-        precision=mxu_precision(data, w),
+        dims=dims,
+        groups=num_group,
     )
     if bias is not None and not no_bias:
         if channels_last:

@@ -15,12 +15,25 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .precision_util import mxu_precision
+from .precision_util import acc_dtype, acc_out_dtype, mxu_precision
 from .registry import register, register_param_shapes
 
 
 def _gates(mode):
     return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def _gdot(x, W):
+    """Gate matmul x @ W.T with the fast-accumulate policy: f32 MXU
+    accumulator for bf16 operands, cast back to the activation dtype
+    (precision_util.acc_dtype; measured faster than the bf16-out form,
+    tools/perf_peak.py). Precision still from the ACTUAL operands —
+    weights may be bf16 while activations are f32, then the honest-f32
+    global must win."""
+    pet = acc_dtype(x, W)
+    out = jnp.dot(x, W.T, precision=mxu_precision(x, W),
+                  preferred_element_type=pet)
+    return out.astype(acc_out_dtype(x, W)) if pet is not None else out
 
 
 def _cell_step(mode, W_ih, W_hh, b_ih, b_hh):
@@ -30,8 +43,8 @@ def _cell_step(mode, W_ih, W_hh, b_ih, b_hh):
             h, c = carry
             # precision from the ACTUAL operands (weights may be bf16 while
             # activations are f32 — then the honest-f32 global must win)
-            z = jnp.dot(x, W_ih.T, precision=mxu_precision(x, W_ih)) + b_ih \
-                + jnp.dot(h, W_hh.T, precision=mxu_precision(h, W_hh)) + b_hh
+            z = _gdot(x, W_ih) + b_ih \
+                + _gdot(h, W_hh) + b_hh
             i, f, g, o = jnp.split(z, 4, axis=-1)
             i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
             g = jnp.tanh(g)
@@ -42,8 +55,8 @@ def _cell_step(mode, W_ih, W_hh, b_ih, b_hh):
     if mode == "gru":
         def step(carry, x):
             h = carry
-            xi = jnp.dot(x, W_ih.T, precision=mxu_precision(x, W_ih)) + b_ih
-            hh = jnp.dot(h, W_hh.T, precision=mxu_precision(h, W_hh)) + b_hh
+            xi = _gdot(x, W_ih) + b_ih
+            hh = _gdot(h, W_hh) + b_hh
             xr, xz, xn = jnp.split(xi, 3, axis=-1)
             hr, hz, hn = jnp.split(hh, 3, axis=-1)
             r = jax.nn.sigmoid(xr + hr)
@@ -56,9 +69,9 @@ def _cell_step(mode, W_ih, W_hh, b_ih, b_hh):
 
     def step(carry, x):
         h = carry
-        h_new = act(jnp.dot(x, W_ih.T, precision=mxu_precision(x, W_ih))
+        h_new = act(_gdot(x, W_ih)
                     + b_ih
-                    + jnp.dot(h, W_hh.T, precision=mxu_precision(h, W_hh))
+                    + _gdot(h, W_hh)
                     + b_hh)
         return h_new, h_new
     return step

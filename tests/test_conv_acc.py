@@ -1,0 +1,89 @@
+"""The f32-accumulate conv custom-vjp (mxtpu/ops/conv_acc.py) must be
+numerically indistinguishable from jax's own autodiff of the plain conv —
+the bwd reuses jax's transpose-rule implementations, so any drift means the
+wiring (padding/stride/group plumbing) broke."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+import pytest
+
+from mxtpu.ops.conv_acc import HAVE_ACC_VJP, conv_fast
+
+pytestmark = pytest.mark.skipif(not HAVE_ACC_VJP,
+                                reason="private jax transpose helpers absent")
+
+DN = ("NHWC", "HWIO", "NHWC")
+
+
+def _plain(x, w, strides, padding, lhs_dil, rhs_dil, dims, groups):
+    return lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        lhs_dilation=lhs_dil, rhs_dilation=rhs_dil,
+        dimension_numbers=dims, feature_group_count=groups,
+        precision=lax.Precision.DEFAULT)
+
+
+@pytest.mark.parametrize("strides,pad,rhs_dil,groups,cin,cout,k", [
+    ((1, 1), (1, 1), (1, 1), 1, 8, 16, 3),
+    ((2, 2), (1, 1), (1, 1), 1, 8, 16, 3),
+    ((2, 2), (3, 3), (1, 1), 1, 3, 16, 7),   # resnet stem shape
+    ((1, 1), (0, 0), (1, 1), 1, 8, 16, 1),   # 1x1 bottleneck
+    ((1, 1), (2, 2), (2, 2), 1, 8, 16, 3),   # dilated
+    ((1, 1), (1, 1), (1, 1), 4, 8, 16, 3),   # grouped
+    ((1, 1), (1, 1), (1, 1), 8, 8, 8, 3),    # depthwise
+])
+def test_conv_acc_matches_plain_autodiff(strides, pad, rhs_dil, groups,
+                                         cin, cout, k):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 12, 12, cin), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(k, k, cin // groups, cout) * 0.1, jnp.bfloat16)
+    padding = [(pad[0], pad[0]), (pad[1], pad[1])]
+    args = (strides, padding, (1, 1), rhs_dil, DN, groups)
+
+    def f_fast(x, w):
+        return jnp.sum(conv_fast(x, w, *args).astype(jnp.float32) ** 2)
+
+    def f_plain(x, w):
+        return jnp.sum(_plain(x, w, *args).astype(jnp.float32) ** 2)
+
+    y_fast = conv_fast(x, w, *args)
+    y_plain = _plain(x, w, *args)
+    assert y_fast.dtype == x.dtype
+    # fwd: f32 accumulation is at least as accurate as the plain result
+    np.testing.assert_allclose(np.asarray(y_fast, np.float32),
+                               np.asarray(y_plain, np.float32),
+                               rtol=2e-2, atol=2e-2)
+    gf = jax.grad(f_fast, argnums=(0, 1))(x, w)
+    gp = jax.grad(f_plain, argnums=(0, 1))(x, w)
+    for a, b in zip(gf, gp):
+        assert a.dtype == b.dtype
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=5e-2, atol=5e-2)
+
+
+def test_conv_acc_under_jit_and_vmap():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(3, 2, 8, 8, 4), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(3, 3, 4, 4) * 0.1, jnp.bfloat16)
+    args = ((1, 1), [(1, 1), (1, 1)], (1, 1), (1, 1), DN, 1)
+
+    @jax.jit
+    def g(x, w):
+        per = jax.vmap(lambda xi: conv_fast(xi, w, *args))(x)
+        return jnp.sum(per.astype(jnp.float32))
+
+    val, grads = jax.value_and_grad(g, argnums=(0, 1))(x, w)
+    assert np.isfinite(float(val))
+    assert grads[0].shape == x.shape and grads[1].shape == w.shape
+
+
+def test_f32_operands_keep_plain_path():
+    """f32 convs must NOT take the custom path — they stay on the honest
+    HIGHEST-precision global (precision_util docstring)."""
+    x = jnp.ones((1, 6, 6, 4), jnp.float32)
+    w = jnp.ones((3, 3, 4, 4), jnp.float32)
+    args = ((1, 1), [(1, 1), (1, 1)], (1, 1), (1, 1), DN, 1)
+    txt = jax.jit(lambda x, w: conv_fast(x, w, *args)).lower(x, w).as_text()
+    assert "HIGHEST" in txt

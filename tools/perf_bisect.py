@@ -14,13 +14,22 @@ import jax
 import jax.numpy as jnp
 
 
+def _sync(out):
+    """True sync: fetch a few elements to host. block_until_ready does not
+    reliably wait through the axon tunnel (PERF.md timing methodology);
+    device execution is queue-ordered, so fetching the LAST output waits
+    for every step before it."""
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(jax.device_get(leaf.ravel()[:2]))
+
+
 def timeit(fn, *args, steps=20):
     out = fn(*args)
-    jax.block_until_ready(out)
+    _sync(out)
     t0 = time.perf_counter()
     for _ in range(steps):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _sync(out)
     return (time.perf_counter() - t0) / steps
 
 
