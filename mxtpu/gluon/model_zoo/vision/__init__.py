@@ -32,8 +32,10 @@ for _mod in (_alexnet, _densenet, _inception, _mobilenet, _resnet, _squeezenet,
 
 
 def get_model(name, **kwargs):
-    """Return a model by name (ref: model_zoo/vision/__init__.py:get_model)."""
-    name = name.lower()
+    """Return a model by name (ref: model_zoo/vision/__init__.py:get_model).
+    Accepts the reference's dotted multiplier spellings ('mobilenet1.0',
+    'squeezenet1.0') as well as the underscore form."""
+    name = name.lower().replace(".", "_")
     if name not in _models:
         raise MXNetError(
             "model %s not supported; available: %s" % (name, sorted(_models)))

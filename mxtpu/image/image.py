@@ -275,6 +275,13 @@ class ImageIter(DataIter):
         aug_keys = ("resize", "rand_crop", "rand_resize", "rand_mirror",
                     "mean", "std", "brightness", "contrast", "saturation",
                     "hue", "pca_noise", "rand_gray", "inter_method")
+        unknown = set(kwargs) - set(aug_keys)
+        if unknown:
+            # loud, not silent: a misspelled augmenter option must not
+            # train with the augmentation quietly missing
+            raise MXNetError("ImageIter: unknown options %s (augmenter "
+                             "options: %s)" % (sorted(unknown),
+                                               ", ".join(aug_keys)))
         self.auglist = aug_list if aug_list is not None else \
             CreateAugmenter(data_shape, **{k: v for k, v in kwargs.items()
                                            if k in aug_keys})

@@ -100,12 +100,19 @@ def _bwd(strides, padding, lhs_dilation, rhs_dilation, dims, groups, res, g):
 conv_acc.defvjp(_fwd, _bwd)
 
 
+def _enabled():
+    """MXTPU_CONV_ACC=0 disables the custom path (escape hatch: revert to
+    plain autodiff convs without a code change)."""
+    import os
+    return os.environ.get("MXTPU_CONV_ACC", "1") != "0"
+
+
 def conv_fast(x, w, strides, padding, lhs_dilation, rhs_dilation, dims,
               groups):
     """Dispatch: the f32-accumulate custom-vjp path for all-low-precision
     operands (when the private transpose helpers imported), else plain
     conv_general_dilated under the package precision policy."""
-    if (HAVE_ACC_VJP and x.dtype in _LOW and w.dtype in _LOW):
+    if (HAVE_ACC_VJP and _enabled() and x.dtype in _LOW and w.dtype in _LOW):
         return conv_acc(x, w, tuple(strides), tuple(map(tuple, padding)),
                         tuple(lhs_dilation), tuple(rhs_dilation), dims,
                         int(groups))

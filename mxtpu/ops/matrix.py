@@ -11,7 +11,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .precision_util import acc_dtype, acc_out_dtype, mxu_precision
+from .precision_util import contract_acc, mxu_precision
 from .registry import register, register_param_shapes
 
 
@@ -294,24 +294,16 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
         a = jnp.transpose(lhs, tuple(range(lhs.ndim))[::-1])
     if transpose_b and rhs.ndim > 2:
         b = jnp.transpose(rhs, tuple(range(rhs.ndim))[::-1])
-    prec = mxu_precision(a, b)
-    pet = acc_dtype(a, b)
     if a.ndim == 1 and b.ndim == 1:
-        out = jnp.dot(a, b, precision=prec, preferred_element_type=pet)
-    else:
-        out = jnp.tensordot(a, b, axes=([-1], [0]), precision=prec,
-                            preferred_element_type=pet)
-    return out.astype(acc_out_dtype(a, b)) if pet is not None else out
+        return contract_acc(jnp.dot, a, b)
+    return contract_acc(jnp.tensordot, a, b, axes=([-1], [0]))
 
 
 @register("batch_dot")
 def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
     a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
     b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
-    pet = acc_dtype(a, b)
-    out = jnp.matmul(a, b, precision=mxu_precision(a, b),
-                     preferred_element_type=pet)
-    return out.astype(acc_out_dtype(a, b)) if pet is not None else out
+    return contract_acc(jnp.matmul, a, b)
 
 
 @register("khatri_rao")

@@ -1,20 +1,19 @@
-"""MXU precision selection for contraction ops.
+"""MXU precision + accumulation policy for contraction ops.
 
 The package-global ``jax_default_matmul_precision='float32'``
 (mxtpu/__init__.py) exists to keep FLOAT32 contractions honest: without
-it, XLA:TPU silently truncates f32 operands to one-pass bf16. But that
-global also tags BF16 contractions HIGHEST, which makes XLA run them
-through the multi-pass f32-emulation path — 3-6x slower on the MXU for
-zero numerical benefit (one-pass bf16x bf16 with f32 accumulation is
-already exact for bf16 operands). This was the round-1/round-2 ResNet-50
-throughput ceiling: every conv in the train step lowered with
-``precision HIGHEST`` (see PERF.md).
+it, XLA:TPU silently truncates f32 operands to one-pass bf16. That global
+also tags BF16 contractions HIGHEST; ``mxu_precision(*operands)`` overrides
+to DEFAULT when every floating operand is sub-f32 (bf16/f16) and returns
+None (inherit the honest global) otherwise — the correct policy, though
+measurement showed bf16-at-HIGHEST was NOT the historical throughput
+ceiling (83 vs 85 TFLOP/s; an earlier 3-6x claim was a sync artifact —
+see PERF.md "RETRACTED").
 
-``mxu_precision(*operands)`` returns the right per-op override:
-DEFAULT when every floating operand is sub-f32 (bf16/f16), None (inherit
-the honest global) otherwise. Same policy as the flash-attention kernel
-(mxtpu/ops/pallas/flash_attention.py:71-75), applied everywhere a
-contraction is issued.
+What DOES move the MXU (PERF.md "achievable ceiling"): asking low-precision
+contractions for an **f32 accumulator output** (``preferred_element_type``)
+— 102 -> 140 TFLOP/s on an 8k matmul, +10% on conv stacks — implemented by
+``acc_dtype``/``dot_acc`` here and the conv custom-vjp in conv_acc.py.
 """
 from __future__ import annotations
 
@@ -62,13 +61,21 @@ def acc_out_dtype(*operands):
     return jnp.result_type(*operands)
 
 
-def dot_acc(x, w, dimension_numbers):
-    """lax.dot_general with the fast-accumulate policy applied: f32
-    accumulator for low-precision operands, result cast back to the
+def contract_acc(contraction, a, b, **kwargs):
+    """ONE copy of the fast-accumulate policy for any jnp/lax contraction
+    callable taking (a, b, ..., precision=, preferred_element_type=): f32
+    accumulator for low-precision operands with the result cast back to the
     operands' promoted dtype; full-precision operands inherit the honest-f32
-    global."""
-    pet = acc_dtype(x, w)
-    y = lax.dot_general(x, w, dimension_numbers,
-                        precision=mxu_precision(x, w),
-                        preferred_element_type=pet)
-    return y.astype(acc_out_dtype(x, w)) if pet is not None else y
+    global. Used by FullyConnected, dot, batch_dot and the RNN gate matmuls
+    so the policy cannot drift between call sites (convs need the
+    custom-vjp variant in conv_acc.py instead)."""
+    pet = acc_dtype(a, b)
+    out = contraction(a, b, precision=mxu_precision(a, b),
+                      preferred_element_type=pet, **kwargs)
+    return out.astype(acc_out_dtype(a, b)) if pet is not None else out
+
+
+def dot_acc(x, w, dimension_numbers):
+    """lax.dot_general under the fast-accumulate policy (contract_acc)."""
+    return contract_acc(lax.dot_general, x, w,
+                        dimension_numbers=dimension_numbers)

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .precision_util import acc_dtype, acc_out_dtype, mxu_precision
+from .precision_util import contract_acc, mxu_precision
 from .registry import register, register_param_shapes
 
 
@@ -24,16 +24,11 @@ def _gates(mode):
 
 
 def _gdot(x, W):
-    """Gate matmul x @ W.T with the fast-accumulate policy: f32 MXU
-    accumulator for bf16 operands, cast back to the activation dtype
-    (precision_util.acc_dtype; measured faster than the bf16-out form,
-    tools/perf_peak.py). Precision still from the ACTUAL operands —
-    weights may be bf16 while activations are f32, then the honest-f32
-    global must win."""
-    pet = acc_dtype(x, W)
-    out = jnp.dot(x, W.T, precision=mxu_precision(x, W),
-                  preferred_element_type=pet)
-    return out.astype(acc_out_dtype(x, W)) if pet is not None else out
+    """Gate matmul x @ W.T under the shared fast-accumulate policy
+    (precision_util.contract_acc): f32 MXU accumulator for bf16 operands;
+    precision still from the ACTUAL operands — weights may be bf16 while
+    activations are f32, then the honest-f32 global must win."""
+    return contract_acc(jnp.dot, x, W.T)
 
 
 def _cell_step(mode, W_ih, W_hh, b_ih, b_hh):

@@ -10,6 +10,17 @@ drawing from a hidden global would bake the key into the compiled executable, so
 *key supply* can be pushed for the trace: the CachedOp passes a fresh key argument
 each call and random ops split from it — keeping compiled dropout stochastic across
 calls while staying purely functional.
+
+Parallel PRNG (the reference's kParallelRandom resource, src/resource.cc:87 —
+per-worker independent generator streams for data-parallel kernels): subsumed
+by GSPMD semantics. Random HLOs trace against the GLOBAL logical tensor shape;
+when the tensor is sharded over the mesh, XLA partitions the generator so each
+position draws its unique stream regardless of which device materializes it —
+per-device decorrelation needs no per-device resource objects, and a dropout
+mask over a batch-sharded activation is automatically distinct on every shard
+(tests/test_parallel.py exercises sharded-dropout training). Explicit
+per-process decorrelation across multi-HOST data pipelines uses
+``seed(s + rank)`` exactly like the reference's per-worker seeding.
 """
 from __future__ import annotations
 

@@ -19,6 +19,27 @@ __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
            "register_subgraph_property", "get_subgraph_property"]
 
 
+# Tensor-parameter inputs auto-created as Variables when not supplied —
+# reference behavior (python/mxnet/symbol/register.py codegen +
+# nnvm ListInputNames): ``sym.Convolution(data, num_filter=k)`` creates
+# ``<name>_weight``/``<name>_bias``; output ops create ``<name>_label``
+# (which is how the conventional ``softmax_label`` arises).
+_AUTO_PARAMS = {
+    "Convolution": ("weight", "bias"),
+    "Deconvolution": ("weight", "bias"),
+    "FullyConnected": ("weight", "bias"),
+    "Embedding": ("weight",),
+    "BatchNorm": ("gamma", "beta", "moving_mean", "moving_var"),
+    "InstanceNorm": ("gamma", "beta"),
+    "LayerNorm": ("gamma", "beta"),
+    "SoftmaxOutput": ("label",),
+    "LinearRegressionOutput": ("label",),
+    "LogisticRegressionOutput": ("label",),
+    "MAERegressionOutput": ("label",),
+    "SVMOutput": ("label",),
+}
+
+
 def _symbolic_call(op_name, *args, name=None, **kwargs):
     """Build a graph node for a registered op (the symbolic twin of
     ndarray._apply)."""
@@ -48,6 +69,21 @@ def _symbolic_call(op_name, *args, name=None, **kwargs):
     if name is None:
         name = "%s%d" % (op.name.lower().lstrip("_"),
                          _Counter.next(op.name.lower()))
+    auto = _AUTO_PARAMS.get(op.name)
+    if auto:
+        import inspect as _inspect
+        fn_params = list(_inspect.signature(op.fn).parameters)
+        supplied = set(fn_params[:len(args)]) | set(kwargs)
+        for pname in auto:
+            if pname in supplied:
+                continue
+            if pname == "bias" and attrs.get("no_bias"):
+                continue
+            vname = (name + "_label" if pname == "label"
+                     else "%s_%s" % (name, pname))
+            vnode, _ = var(vname)._heads[0]
+            in_edges.append((vnode, 0))
+            kw_arrays.append(pname)
     node = _Node(op.name, name, attrs, in_edges, pos_template, kw_arrays)
     return Symbol([(node, None)])
 

@@ -1,0 +1,22 @@
+"""Smoke test for the inference scoring benchmark (tools/benchmark_score.py,
+analog of the reference's example/image-classification/benchmark_score.py):
+it must import, resolve zoo models by the reference's dotted names, and
+produce a finite img/s on CPU."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+def test_score_model_smoke():
+    from benchmark_score import score_model
+    rate = score_model("squeezenet1.0", 2, steps=2, image_size=64)
+    assert np.isfinite(rate) and rate > 0
+
+
+def test_get_model_accepts_dotted_names():
+    from mxtpu.gluon.model_zoo import vision
+    net = vision.get_model("mobilenet1.0", classes=10)
+    assert net is not None

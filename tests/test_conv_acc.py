@@ -63,6 +63,41 @@ def test_conv_acc_matches_plain_autodiff(strides, pad, rhs_dil, groups,
                                    rtol=5e-2, atol=5e-2)
 
 
+def test_conv_acc_lhs_dilation_matches_plain_autodiff():
+    """The Deconvolution path: lhs_dilation != 1 exercises the transposed-
+    conv padding arithmetic inside the reused jax transpose helpers."""
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(2, 6, 6, 8), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(3, 3, 8, 8) * 0.1, jnp.bfloat16)
+    # deconv stride 2: lhs_dilation (2,2), padding (k-1-pad) style
+    args = ((1, 1), [(2, 2), (2, 2)], (2, 2), (1, 1), DN, 1)
+
+    def f_fast(x, w):
+        return jnp.sum(conv_fast(x, w, *args).astype(jnp.float32) ** 2)
+
+    def f_plain(x, w):
+        return jnp.sum(_plain_full(x, w, *args).astype(jnp.float32) ** 2)
+
+    def _plain_full(x, w, strides, padding, lhs_dil, rhs_dil, dims, groups):
+        return lax.conv_general_dilated(
+            x, w, window_strides=strides, padding=padding,
+            lhs_dilation=lhs_dil, rhs_dilation=rhs_dil,
+            dimension_numbers=dims, feature_group_count=groups,
+            precision=lax.Precision.DEFAULT)
+
+    y_fast = conv_fast(x, w, *args)
+    y_plain = _plain_full(x, w, *args)
+    np.testing.assert_allclose(np.asarray(y_fast, np.float32),
+                               np.asarray(y_plain, np.float32),
+                               rtol=2e-2, atol=2e-2)
+    gf = jax.grad(f_fast, argnums=(0, 1))(x, w)
+    gp = jax.grad(f_plain, argnums=(0, 1))(x, w)
+    for a, b in zip(gf, gp):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=5e-2, atol=5e-2)
+
+
 def test_conv_acc_under_jit_and_vmap():
     rng = np.random.RandomState(1)
     x = jnp.asarray(rng.randn(3, 2, 8, 8, 4), jnp.bfloat16)

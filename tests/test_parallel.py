@@ -178,3 +178,31 @@ def test_ring_attention_grads_match_dense():
     for gd, gr in zip(g_dense, g_ring):
         np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_sharded_dropout_decorrelated_across_shards():
+    """Parallel-PRNG story (ref kParallelRandom, src/resource.cc:87;
+    mxtpu/random.py docstring): a dropout mask drawn over a batch-sharded
+    tensor must be distinct on every data shard — GSPMD partitions the
+    generator over the global shape, so no per-device PRNG resource is
+    needed."""
+    from jax.sharding import NamedSharding
+    from mxtpu.ops.nn import Dropout
+    from mxtpu import autograd
+    from mxtpu.ndarray import NDArray
+
+    mesh = make_mesh({"data": 8})
+    x = jnp.ones((8, 4096), jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P("data")))
+    prev = autograd.set_training(True)
+    try:
+        out = Dropout(NDArray(x), p=0.5)
+    finally:
+        autograd.set_training(prev)
+    mask = np.asarray(out.asnumpy() != 0)
+    rows = [mask[i] for i in range(8)]
+    # each device's row must not equal any other's (same-key-per-shard
+    # implementations fail this with probability ~1)
+    for i in range(8):
+        for j in range(i + 1, 8):
+            assert not np.array_equal(rows[i], rows[j])

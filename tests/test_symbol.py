@@ -259,3 +259,30 @@ def test_infer_shape_backward_fill_conv_nhwc():
     shapes = dict(zip(net.list_arguments(), arg_shapes))
     assert shapes["w"] == (3, 3, 4, 8)
     assert out_shapes == [(2, 6, 6, 8)]
+
+
+def test_auto_created_param_variables():
+    """Reference parity (symbol/register.py codegen + nnvm ListInputNames):
+    sym ops auto-create their parameter Variables when not supplied —
+    Convolution makes <name>_weight/_bias, BatchNorm adds gamma/beta args
+    and moving_mean/var aux, output ops make <name>_label."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=4, kernel=(3, 3), pad=(1, 1),
+                             name="c1")
+    net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=3, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    assert net.list_arguments() == [
+        "data", "c1_weight", "c1_bias", "bn1_gamma", "bn1_beta",
+        "fc_weight", "fc_bias", "softmax_label"]
+    assert net.list_auxiliary_states() == ["bn1_moving_mean",
+                                           "bn1_moving_var"]
+    # no_bias suppresses the bias variable
+    nb = mx.sym.Convolution(data, num_filter=4, kernel=(1, 1), no_bias=True,
+                            name="c2")
+    assert nb.list_arguments() == ["data", "c2_weight"]
+    # explicitly supplied params are NOT duplicated
+    w = mx.sym.Variable("myw")
+    ex = mx.sym.FullyConnected(data, weight=w, num_hidden=3, name="fc2")
+    args = ex.list_arguments()
+    assert "myw" in args and "fc2_weight" not in args

@@ -1,0 +1,109 @@
+"""Inference scoring benchmark across the model zoo.
+
+Analog of the reference's ``example/image-classification/benchmark_score.py``
+(the script behind BASELINE.md's inference tables, docs/faq/perf.md:35-49 in
+the reference): forward-only throughput on synthetic data for each zoo
+family at several batch sizes.
+
+TPU-native differences: models run hybridized (one jit-compiled XLA program,
+the CachedOp fast path), channels-last, bf16 by default (the MXU design
+point — reference fp16 V100 numbers are the comparable column). Timing
+pipelines STEPS dispatches and syncs once with a host fetch; compile time is
+excluded (warmup), matching how the reference's scoring loop discards the
+first batch.
+
+Usage:
+    python tools/benchmark_score.py                  # full sweep
+    BENCH_MODELS=resnet50_v1,alexnet BENCH_BATCHES=1,32 python tools/...
+
+Prints one JSON line per (model, batch): {"metric": "score_<model>_b<N>",
+"value": img/s, ...} and a summary table at the end.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_MODELS = [
+    "alexnet",
+    "vgg16",
+    "inception_v3",
+    "resnet50_v1",
+    "resnet152_v1",
+    "mobilenet1.0",
+    "mobilenet_v2_1.0",
+    "squeezenet1.0",
+    "densenet121",
+]
+
+# reference comparison points: V100 fp16 batch-128 scoring where published
+# (docs/faq/perf.md:164-176), else V100 fp32 batch-128 (perf.md:121-162)
+_REF_V100 = {
+    "vgg16": 1169.81, "inception_v3": 1818.26, "resnet50_v1": 2355.04,
+    "resnet152_v1": 1046.98, "alexnet": 10177.84,
+}
+
+
+def score_model(name, batch, steps=20, dtype="bfloat16", image_size=None):
+    """Forward-only img/s for one zoo model at one batch size."""
+    import mxtpu as mx
+    from mxtpu.gluon.model_zoo import vision
+
+    size = image_size or (299 if "inception" in name else 224)
+    with mx.layout("NHWC"):
+        net = vision.get_model(name, classes=1000)
+    net.initialize()
+    x = mx.nd.array(np.random.uniform(-1, 1, (batch, size, size, 3))
+                    .astype(np.float32))
+    net(x)  # settle deferred shapes
+    if dtype != "float32":
+        net.cast(dtype)
+        x = x.astype(dtype)
+    net.hybridize()
+    out = net(x)
+    out.asnumpy()  # compile + settle
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = net(x)
+    out.asnumpy()  # queue-ordered: syncs every dispatched step
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def main():
+    models = os.environ.get("BENCH_MODELS")
+    models = models.split(",") if models else DEFAULT_MODELS
+    batches = [int(b) for b in
+               os.environ.get("BENCH_BATCHES", "1,32,128").split(",")]
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    rows = []
+    for name in models:
+        for batch in batches:
+            try:
+                rate = score_model(name, batch, steps=steps, dtype=dtype)
+                err = None
+            except Exception as e:  # noqa: BLE001 - score the rest
+                rate, err = None, str(e)
+            rec = {"metric": "score_%s_b%d" % (name, batch),
+                   "value": round(rate, 2) if rate else None,
+                   "unit": "images/sec"}
+            if err:
+                rec["error"] = err[:200]
+            ref = _REF_V100.get(name)
+            if rate and ref and batch == 128:
+                rec["vs_baseline"] = round(rate / ref, 3)
+            print(json.dumps(rec), flush=True)
+            rows.append((name, batch, rate, err))
+    print("\n%-18s %6s %12s" % ("model", "batch", "img/s"))
+    for name, batch, rate, err in rows:
+        print("%-18s %6d %12s" % (name, batch,
+                                  "%.1f" % rate if rate else "FAIL"))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,14 +18,14 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def probe(N, K=20, acc=None, prec=None):
-    a = jax.random.normal(jax.random.PRNGKey(0), (N, N), jnp.bfloat16)
-    b = jax.random.normal(jax.random.PRNGKey(1), (N, N), jnp.bfloat16)
+def probe(N, K=20, acc=None, prec=None, dtype=jnp.bfloat16):
+    a = jax.random.normal(jax.random.PRNGKey(0), (N, N), dtype)
+    b = jax.random.normal(jax.random.PRNGKey(1), (N, N), dtype)
 
     def body(c, _):
         out = lax.dot(c, b, preferred_element_type=acc, precision=prec)
         # rescale so the chain neither overflows nor constant-folds
-        return out.astype(jnp.bfloat16) * jnp.bfloat16(1e-3), None
+        return out.astype(dtype) * jnp.asarray(1e-3, dtype), None
 
     @jax.jit
     def run(a, b):
@@ -53,6 +53,8 @@ def main():
     for n in (4096, 8192):
         best = max(best, probe(n))
     best = max(best, probe(8192, acc=jnp.float32))
+    # the honest-f32 emulation floor (PERF.md ceiling table, f32 HIGHEST row)
+    probe(8192, prec="highest", dtype=jnp.float32)
     nominal = 197.0
     print("achievable ceiling: %.1f TFLOP/s = %.0f%% of the %.0f TFLOP/s "
           "v5e datasheet peak" % (best, 100 * best / nominal, nominal))

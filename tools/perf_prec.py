@@ -17,7 +17,9 @@ def timed(name, jfn, *args, K=None):
         from perf_common import measure_rtt
         _RTT = measure_rtt()
     out = jfn(*args)
-    jax.block_until_ready(out)
+    # true sync: host fetch — block_until_ready does not reliably wait
+    # through the axon tunnel (PERF.md timing methodology)
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[:2]))
     t0 = time.perf_counter()
     out = jfn(*args)
     v = np.asarray(jax.device_get(out))

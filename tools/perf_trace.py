@@ -1,5 +1,12 @@
 """Capture a jax.profiler device trace of the resnet50 train step and print
-per-op time aggregates (PERF.md evidence)."""
+per-op time aggregates (PERF.md evidence).
+
+WARNING: device profiling through the axon tunnel can WEDGE THE CHIP for
+every subsequent process if this script is killed mid-trace (observed: a
+timeout during jax.profiler.trace left even trivial jit dispatches hanging
+until the server-side lease recovered, ~hours). Prefer the scan-fusion
+timing tools (perf_peak/perf_stages/perf_bisect); run this only when
+nothing else needs the chip and never under a watchdog that SIGKILLs."""
 import glob
 import gzip
 import os
