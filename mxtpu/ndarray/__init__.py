@@ -16,6 +16,19 @@ for _name, _op in _ops.REGISTRY.items():
     if not hasattr(_mod, _name):
         setattr(_mod, _name, _op.wrapper)
 
+# mx.nd.contrib.* — the reference's contrib namespace (ndarray/contrib.py):
+# every `_contrib_X` registry op is exposed as contrib.X (plus its aliases)
+import types as _types  # noqa: E402
+
+contrib = _types.ModuleType(__name__ + ".contrib")
+for _name, _op in _ops.REGISTRY.items():
+    if _name.startswith("_contrib_"):
+        setattr(contrib, _name[len("_contrib_"):], _op.wrapper)
+    for _alias in getattr(_op, "aliases", ()):
+        if not hasattr(contrib, _alias) and _op.name.startswith("_contrib_"):
+            setattr(contrib, _alias, _op.wrapper)
+_sys.modules[contrib.__name__] = contrib
+
 # creation helpers registered wrap=False already return NDArrays
 from ..ops.init_ops import arange, empty, eye, full, linspace, ones, zeros  # noqa: E402,F401
 from .utils import load, save  # noqa: E402,F401

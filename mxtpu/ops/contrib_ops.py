@@ -248,3 +248,367 @@ def SpatialTransformer(data, loc, target_shape=(0, 0), transform_type="affine",
     from .registry import get_op
     g = get_op("GridGenerator").fn(loc, transform_type="affine", target_shape=target_shape)
     return get_op("BilinearSampler").fn(data, g)
+
+
+# ---------------------------------------------------------------- matching
+@register("_contrib_bipartite_matching", aliases=("bipartite_matching",))
+def bipartite_matching(data, is_ascend=False, threshold=None, topk=-1):
+    """Greedy bipartite matching on a (B, N, M) or (N, M) score matrix
+    (ref: src/operator/contrib/bounding_box.cc:147). Returns (x, y):
+    x[b, n] = matched column of row n (-1 unmatched), y[b, m] = matched row
+    of column m. Implemented as a lax.fori_loop of argmax-pick-and-mask
+    steps — min(N, M) iterations of O(NM) masked argmax, XLA-friendly."""
+    squeeze = data.ndim == 2
+    scores = data[None] if squeeze else data
+    b, n, m = scores.shape
+    neg = jnp.asarray(-jnp.inf, scores.dtype)
+    sc = -scores if is_ascend else scores
+    thr = None if threshold is None else (
+        -threshold if is_ascend else threshold)
+
+    limit = min(n, m) if topk is None or topk <= 0 else min(topk, n, m)
+
+    def one(s):
+        def body(_, carry):
+            s_, x, y = carry
+            flat = jnp.argmax(s_)
+            i, j = flat // m, flat % m
+            best = s_[i, j]
+            ok = best > (thr if thr is not None else neg)
+            x = jnp.where(ok, x.at[i].set(j.astype(jnp.int32)), x)
+            y = jnp.where(ok, y.at[j].set(i.astype(jnp.int32)), y)
+            s_ = jnp.where(ok, s_.at[i, :].set(neg).at[:, j].set(neg), s_)
+            return s_, x, y
+
+        x0 = jnp.full((n,), -1, jnp.int32)
+        y0 = jnp.full((m,), -1, jnp.int32)
+        _, x, y = jax.lax.fori_loop(0, limit, body, (s, x0, y0))
+        return x.astype(data.dtype), y.astype(data.dtype)
+
+    x, y = jax.vmap(one)(sc)
+    if squeeze:
+        return x[0], y[0]
+    return x, y
+
+
+# ------------------------------------------------- position-sensitive ROI
+def _roi_bilinear_grid(img, yy, xx):
+    """Bilinear-sample img (c, h, w) at float grids yy/xx -> (c, *grid)."""
+    c, h, w = img.shape
+    y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wy = jnp.clip(yy, 0, h - 1) - y0
+    wx = jnp.clip(xx, 0, w - 1) - x0
+    y0i, x0i, y1i, x1i = (a.astype(jnp.int32) for a in (y0, x0, y1, x1))
+    return (img[:, y0i, x0i] * (1 - wy) * (1 - wx)
+            + img[:, y1i, x0i] * wy * (1 - wx)
+            + img[:, y0i, x1i] * (1 - wy) * wx
+            + img[:, y1i, x1i] * wy * wx)
+
+
+@register("_contrib_PSROIPooling", aliases=("PSROIPooling",))
+def PSROIPooling(data, rois, spatial_scale=1.0, output_dim=1, pooled_size=7,
+                 group_size=0):
+    """Position-sensitive ROI pooling (ref: src/operator/contrib/
+    psroi_pooling.cc): bin (i, j) of output channel c averages input channel
+    c*g*g + i*g + j over that bin. TPU re-design: the reference's exact
+    integer-extent average is replaced by a fixed 2x2 bilinear sample grid
+    per bin (the ROIAlign discretization) so shapes stay static."""
+    g = int(group_size) or int(pooled_size)
+    p = int(pooled_size)
+    n, c, h, w = data.shape
+    sr = 2
+
+    def one_roi(roi):
+        batch_id = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = (roi[1] * spatial_scale, roi[2] * spatial_scale,
+                          roi[3] * spatial_scale, roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        ys = y1 + (jnp.arange(p * sr) + 0.5) * rh / (p * sr)
+        xs = x1 + (jnp.arange(p * sr) + 0.5) * rw / (p * sr)
+        yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+        v = _roi_bilinear_grid(data[batch_id], yy, xx)  # (c, p*sr, p*sr)
+        v = v.reshape(c, p, sr, p, sr).mean(axis=(2, 4))  # (c, p, p)
+        # position-sensitive channel select: out[d, i, j] = v[d*g*g + gi*g + gj, i, j]
+        v = v.reshape(output_dim, g, g, p, p)
+        gi = (jnp.arange(p) * g) // p
+        gj = (jnp.arange(p) * g) // p
+        return v[:, gi[:, None], gj[None, :], jnp.arange(p)[:, None],
+                 jnp.arange(p)[None, :]]
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_DeformablePSROIPooling",
+          aliases=("DeformablePSROIPooling",))
+def DeformablePSROIPooling(data, rois, trans=None, spatial_scale=1.0,
+                           output_dim=1, group_size=1, pooled_size=7,
+                           part_size=0, sample_per_part=2, trans_std=0.0,
+                           no_trans=False):
+    """Deformable position-sensitive ROI pooling (ref: src/operator/contrib/
+    deformable_psroi_pooling.cc): PSROIPooling whose bins are shifted by the
+    learned normalized offsets in ``trans`` (N, 2*cls, part, part)."""
+    g = int(group_size)
+    p = int(pooled_size)
+    pt = int(part_size) or p
+    n, c, h, w = data.shape
+    sr = int(sample_per_part)
+
+    def one_roi(roi, tr):
+        batch_id = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = (roi[1] * spatial_scale - 0.5,
+                          roi[2] * spatial_scale - 0.5,
+                          roi[3] * spatial_scale + 0.5,
+                          roi[4] * spatial_scale + 0.5)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h, bin_w = rh / p, rw / p
+        # per-bin offsets from trans: (2*cls, pt, pt) -> class 0 layout like
+        # the reference's class-agnostic use (cls = output channels share)
+        if no_trans or tr is None:
+            dy = jnp.zeros((p, p))
+            dx = jnp.zeros((p, p))
+        else:
+            pi = (jnp.arange(p) * pt) // p
+            dy = tr[0][pi[:, None], pi[None, :]] * trans_std * rh
+            dx = tr[1][pi[:, None], pi[None, :]] * trans_std * rw
+        sub = (jnp.arange(sr) + 0.5) / sr
+        # grids (p, sr, p, sr): bin (i, j), sub-sample (a, b), both axes
+        # shifted by that bin's learned offset (dy, dx)[i, j]
+        i_ = jnp.arange(p)[:, None, None, None]
+        a_ = sub[None, :, None, None]
+        j_ = jnp.arange(p)[None, None, :, None]
+        b_ = sub[None, None, None, :]
+        full = (p, sr, p, sr)
+        yy = jnp.broadcast_to(y1 + (i_ + a_) * bin_h + dy[:, None, :, None],
+                              full)
+        xx = jnp.broadcast_to(x1 + (j_ + b_) * bin_w + dx[:, None, :, None],
+                              full)
+        v = _roi_bilinear_grid(data[batch_id],
+                               yy.reshape(p * sr, p * sr),
+                               xx.reshape(p * sr, p * sr))
+        v = v.reshape(c, p, sr, p, sr).mean(axis=(2, 4))
+        v = v.reshape(output_dim, g, g, p, p)
+        gi = (jnp.arange(p) * g) // p
+        return v[:, gi[:, None], gi[None, :], jnp.arange(p)[:, None],
+                 jnp.arange(p)[None, :]]
+
+    if trans is None or no_trans:
+        tr_in = jnp.zeros((rois.shape[0], 2, pt, pt), data.dtype)
+    else:
+        # rois carry batch ids; trans is per-image — gather per roi
+        ids = rois[:, 0].astype(jnp.int32)
+        tr_in = trans[ids, :2]
+    return jax.vmap(one_roi)(rois, tr_in)
+
+
+@register("_contrib_DeformableConvolution", aliases=("DeformableConvolution",))
+def DeformableConvolution(data, offset, weight, bias=None, kernel=(3, 3),
+                          stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                          num_filter=None, num_group=1,
+                          num_deformable_group=1, no_bias=False,
+                          workspace=None, layout=None):
+    """Deformable convolution v1 (ref: src/operator/contrib/
+    deformable_convolution.cc, deformable_im2col.h). NCHW only, like the
+    reference.
+
+    TPU re-design: instead of the reference's deformable_im2col CUDA
+    kernel, each kernel tap (ky, kx) bilinear-samples the input at
+    base_grid + dilation_offset + learned_offset, producing a
+    (N, Hout, Wout, C*kh*kw) tensor that contracts with the flattened
+    weight on the MXU — the gather feeds one big matmul, which is the
+    XLA-friendly shape of im2col.
+
+    ``offset`` is (N, 2*kh*kw*ndg, Hout, Wout), reference channel layout
+    offset[:, 2*(dg*kh*kw + k) + {0: y, 1: x}]."""
+    kh, kw = kernel
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    n, c, h, w = data.shape
+    cout = weight.shape[0]
+    ndg = int(num_deformable_group)
+    hout = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    wout = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    base_y = jnp.arange(hout) * sh - ph   # top-left of each window
+    base_x = jnp.arange(wout) * sw - pw
+    off = offset.reshape(n, ndg, kh * kw, 2, hout, wout)
+
+    def _zero_pad_bilinear(img, yy, xx):
+        """Bilinear sample with ZERO padding outside the image — each of the
+        four corners contributes only if it lies in-bounds, so fractional
+        taps near the border fade to zero exactly like the reference's
+        deformable_im2col (deformable_im2col.h im2col_bilinear), unlike the
+        clip-to-edge sampling the ROI ops use."""
+        y0f = jnp.floor(yy)
+        x0f = jnp.floor(xx)
+        wy = yy - y0f
+        wx = xx - x0f
+        out = 0.0
+        for (cy, wyc) in ((y0f, 1 - wy), (y0f + 1, wy)):
+            for (cx, wxc) in ((x0f, 1 - wx), (x0f + 1, wx)):
+                ok = (cy >= 0) & (cy <= h - 1) & (cx >= 0) & (cx <= w - 1)
+                ci = jnp.clip(cy, 0, h - 1).astype(jnp.int32)
+                cj = jnp.clip(cx, 0, w - 1).astype(jnp.int32)
+                out = out + img[:, ci, cj] * (wyc * wxc * ok)[None]
+        return out
+
+    def one_image(img, off_i):
+        # img (c, h, w); off_i (ndg, kh*kw, 2, hout, wout)
+        cols = []
+        cpg = c // ndg  # channels per deformable group
+        for k in range(kh * kw):
+            ky, kx = k // kw, k % kw
+            taps = []
+            for dg in range(ndg):
+                yy = (base_y[:, None] + ky * dh + off_i[dg, k, 0])
+                xx = (base_x[None, :] + kx * dw + off_i[dg, k, 1])
+                taps.append(_zero_pad_bilinear(
+                    img[dg * cpg:(dg + 1) * cpg], yy, xx))
+            cols.append(jnp.concatenate(taps, axis=0))  # (c, hout, wout)
+        return jnp.stack(cols, axis=1)  # (c, kh*kw, hout, wout)
+
+    cols = jax.vmap(one_image)(data, off)  # (n, c, kh*kw, hout, wout)
+    cols = cols.reshape(n, c * kh * kw, hout * wout)
+    wmat = weight.reshape(cout, -1)  # (cout, c/g*kh*kw) with num_group=1
+    if num_group == 1:
+        out = jnp.einsum("ok,nkp->nop", wmat, cols)
+    else:
+        cg = c // num_group
+        og = cout // num_group
+        cols_g = cols.reshape(n, num_group, cg * kh * kw, hout * wout)
+        wg = wmat.reshape(num_group, og, cg * kh * kw)
+        out = jnp.einsum("gok,ngkp->ngop", wg, cols_g) \
+            .reshape(n, cout, hout * wout)
+    out = out.reshape(n, cout, hout, wout)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+# ------------------------------------------------------------------- RPN
+def _gen_anchors(base_size, ratios, scales):
+    """Faster-RCNN anchor generation (ref: src/operator/contrib/
+    proposal.cc GenerateAnchors): base box -> ratio enum -> scale enum."""
+    import numpy as _np
+    base = _np.array([0, 0, base_size - 1, base_size - 1], _np.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    out = []
+    for r in ratios:
+        size = w * h
+        ws = _np.round(_np.sqrt(size / r))
+        hs = _np.round(ws * r)
+        for s in scales:
+            ws_s, hs_s = ws * s, hs * s
+            out.append([cx - 0.5 * (ws_s - 1), cy - 0.5 * (hs_s - 1),
+                        cx + 0.5 * (ws_s - 1), cy + 0.5 * (hs_s - 1)])
+    return _np.asarray(out, _np.float32)  # (A, 4)
+
+
+def _proposal_one(scores, deltas, im_info, anchors, feature_stride,
+                  pre_n, post_n, thresh, min_size, iou_loss):
+    """RPN proposals for ONE image. scores (A, H, W) fg; deltas (A*4, H, W)."""
+    a, h, w = scores.shape
+    sx = jnp.arange(w, dtype=jnp.float32) * feature_stride
+    sy = jnp.arange(h, dtype=jnp.float32) * feature_stride
+    # boxes indexed (a, y, x)
+    anc = anchors[:, None, None, :]  # (A,1,1,4)
+    shift = jnp.stack([sx[None, None, :].repeat(h, 1).repeat(a, 0),
+                       sy[None, :, None].repeat(w, 2).repeat(a, 0)], -1)
+    boxes = jnp.concatenate([anc[..., :2] + shift, anc[..., 2:] + shift], -1)
+    d = deltas.reshape(a, 4, h, w).transpose(0, 2, 3, 1)  # (A,H,W,4)
+    wa = boxes[..., 2] - boxes[..., 0] + 1
+    ha = boxes[..., 3] - boxes[..., 1] + 1
+    cxa = boxes[..., 0] + 0.5 * (wa - 1)
+    cya = boxes[..., 1] + 0.5 * (ha - 1)
+    if iou_loss:
+        x1 = boxes[..., 0] + d[..., 0]
+        y1 = boxes[..., 1] + d[..., 1]
+        x2 = boxes[..., 2] + d[..., 2]
+        y2 = boxes[..., 3] + d[..., 3]
+    else:
+        cx = d[..., 0] * wa + cxa
+        cy = d[..., 1] * ha + cya
+        pw = jnp.exp(jnp.clip(d[..., 2], -10, 10)) * wa
+        ph = jnp.exp(jnp.clip(d[..., 3], -10, 10)) * ha
+        x1, y1 = cx - 0.5 * (pw - 1), cy - 0.5 * (ph - 1)
+        x2, y2 = cx + 0.5 * (pw - 1), cy + 0.5 * (ph - 1)
+    imh, imw, imscale = im_info[0], im_info[1], im_info[2]
+    x1 = jnp.clip(x1, 0, imw - 1)
+    y1 = jnp.clip(y1, 0, imh - 1)
+    x2 = jnp.clip(x2, 0, imw - 1)
+    y2 = jnp.clip(y2, 0, imh - 1)
+    ms = min_size * imscale
+    keep_sz = ((x2 - x1 + 1) >= ms) & ((y2 - y1 + 1) >= ms)
+    sc = jnp.where(keep_sz, scores, -jnp.inf).reshape(-1)
+    flat = jnp.stack([x1, y1, x2, y2], -1).reshape(-1, 4)
+
+    k = min(pre_n, sc.shape[0])
+    top_sc, top_i = jax.lax.top_k(sc, k)
+    top_box = flat[top_i]
+    # greedy NMS over the score-ordered top-k
+    tl = jnp.maximum(top_box[:, None, :2], top_box[None, :, :2])
+    br = jnp.minimum(top_box[:, None, 2:], top_box[None, :, 2:])
+    whi = jnp.maximum(br - tl + 1, 0)
+    inter = whi[..., 0] * whi[..., 1]
+    area = (top_box[:, 2] - top_box[:, 0] + 1) * \
+        (top_box[:, 3] - top_box[:, 1] + 1)
+    iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-12)
+
+    def body(i, keep):
+        sup = (iou[i] > thresh) & (jnp.arange(k) > i) & keep[i]
+        return keep & ~sup
+
+    keep = jax.lax.fori_loop(0, k, body, top_sc > -jnp.inf)
+    # stable-select first post_n kept boxes (score order preserved)
+    rank = jnp.cumsum(keep) - 1
+    sel = jnp.where(keep & (rank < post_n), rank, post_n)
+    out = jnp.zeros((post_n + 1, 4), top_box.dtype) \
+        .at[sel].set(top_box)[:post_n]
+    out_sc = jnp.zeros((post_n + 1,), top_sc.dtype).at[sel].set(top_sc)[:post_n]
+    nkept = jnp.maximum(jnp.minimum(jnp.sum(keep), post_n), 1)
+    # reference pads short lists by repeating; repeat the LAST kept box so
+    # the score column stays descending
+    idx = jnp.minimum(jnp.arange(post_n), nkept - 1)
+    return out[idx], out_sc[idx]
+
+
+@register("_contrib_MultiProposal", aliases=("MultiProposal",))
+def MultiProposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                  rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                  scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                  feature_stride=16, output_score=False, iou_loss=False):
+    """Batched RPN proposal generation (ref: src/operator/contrib/
+    multi_proposal.cc). Returns rois (N*post, 5) [batch_idx, x1..y2]
+    (+ scores (N*post, 1) when output_score)."""
+    n, a2, h, w = cls_prob.shape
+    a = a2 // 2
+    anchors = jnp.asarray(_gen_anchors(feature_stride, ratios, scales))
+
+    def one(scores_i, deltas_i, info_i):
+        return _proposal_one(scores_i, deltas_i, info_i, anchors,
+                             feature_stride, int(rpn_pre_nms_top_n),
+                             int(rpn_post_nms_top_n), threshold,
+                             float(rpn_min_size), iou_loss)
+
+    boxes, scores = jax.vmap(one)(cls_prob[:, a:], bbox_pred, im_info)
+    ids = jnp.repeat(jnp.arange(n, dtype=boxes.dtype),
+                     int(rpn_post_nms_top_n))
+    rois = jnp.concatenate([ids[:, None], boxes.reshape(-1, 4)], axis=1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois
+
+
+@register("_contrib_Proposal", aliases=("Proposal",))
+def Proposal(cls_prob, bbox_pred, im_info, **kwargs):
+    """Single-image RPN proposals (ref: src/operator/contrib/proposal.cc)
+    — MultiProposal restricted to batch 1, like the reference."""
+    return MultiProposal(cls_prob, bbox_pred, im_info, **kwargs)

@@ -389,3 +389,86 @@ def MultiBoxDetection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
         jax.ShapeDtypeStruct((nb, num_anchors, 6), jnp.float32),
         cls_prob, loc_pred, anchor)
     return out
+
+
+# ------------------------------------------------------------- v1 aliases
+@register("BatchNorm_v1", aliases=("batch_norm_v1",), wrap=False)
+def BatchNorm_v1(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                 momentum=0.9, fix_gamma=True, use_global_stats=False,
+                 output_mean_var=False):
+    """Legacy BatchNorm (ref: src/operator/batch_norm_v1.cc). The v1 op is
+    the modern one restricted to axis=1 and without cudnn_off — delegated;
+    kept as a distinct registry name so old symbol JSON deserializes."""
+    from .nn import BatchNorm
+    return BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=eps,
+                     momentum=momentum, fix_gamma=fix_gamma,
+                     use_global_stats=use_global_stats,
+                     output_mean_var=output_mean_var, axis=1)
+
+
+@register("Convolution_v1", aliases=("convolution_v1",))
+def Convolution_v1(data, weight, bias=None, kernel=None, stride=None,
+                   dilate=None, pad=None, num_filter=None, num_group=1,
+                   no_bias=False, workspace=None, cudnn_tune=None,
+                   cudnn_off=None):
+    """Legacy Convolution (ref: src/operator/convolution_v1.cc) — same math
+    as the modern op in NCHW; kept for old symbol JSON."""
+    from .nn import Convolution
+    return Convolution(data, weight, bias, kernel=kernel, stride=stride,
+                       dilate=dilate, pad=pad, num_filter=num_filter,
+                       num_group=num_group, no_bias=no_bias)
+
+
+@register("Pooling_v1", aliases=("pooling_v1",))
+def Pooling_v1(data, kernel=None, pool_type="max", global_pool=False,
+               stride=None, pad=None, pooling_convention="valid"):
+    """Legacy Pooling (ref: src/operator/pooling_v1.cc)."""
+    from .nn import Pooling
+    return Pooling(data, kernel=kernel, pool_type=pool_type,
+                   global_pool=global_pool, stride=stride, pad=pad,
+                   pooling_convention=pooling_convention)
+
+
+@register("IdentityAttachKLSparseReg", wrap=False)
+def IdentityAttachKLSparseReg(data, sparseness_target=0.1, penalty=0.001,
+                              momentum=0.9):
+    """Identity forward; backward adds the KL sparseness-regularization
+    gradient on sigmoid activations (ref:
+    src/operator/identity_attach_KL_sparse_reg.cc): for unit-mean rho_hat,
+    d += penalty * (-rho/rho_hat + (1-rho)/(1-rho_hat)).
+
+    Deviation from the reference: rho_hat is the CURRENT batch mean, not a
+    momentum moving average across batches (the reference keeps moving
+    rho_hat as mutable op state; this op is pure). ``momentum`` is
+    therefore ignored — warned once below — and with small batches the
+    regularization gradient is noisier than the reference's."""
+    import logging
+
+    import jax
+    from ..ndarray.ndarray import _apply
+
+    if momentum != 0.9 and not getattr(IdentityAttachKLSparseReg,
+                                       "_warned", False):
+        IdentityAttachKLSparseReg._warned = True
+        logging.getLogger(__name__).warning(
+            "IdentityAttachKLSparseReg: momentum is ignored — rho_hat is "
+            "the current batch mean (pure-op deviation from the reference)")
+    rho = sparseness_target
+
+    def fn(x):
+        @jax.custom_vjp
+        def ident(x):
+            return x
+
+        def fwd(x):
+            # rho_hat: batch mean activation per hidden unit (axis 0)
+            return x, jnp.clip(jnp.mean(x, axis=0), 1e-6, 1 - 1e-6)
+
+        def bwd(rho_hat, g):
+            reg = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+            return (g + jnp.broadcast_to(reg, g.shape).astype(g.dtype),)
+
+        ident.defvjp(fwd, bwd)
+        return ident(x)
+
+    return _apply(fn, (data,), name="IdentityAttachKLSparseReg")

@@ -320,3 +320,78 @@ def _embedding_param_shapes(shapes, attrs):
     """Weight=(input_dim, output_dim) regardless of data shape (ref:
     src/operator/tensor/indexing_op.h EmbeddingOpShape)."""
     return {1: (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+@register("reshape_like", as_method=True)
+def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    """Reshape lhs to rhs's shape, optionally only over a dim range
+    (ref: src/operator/tensor/elemwise_unary_op_basic.cc reshape_like)."""
+    if lhs_begin is None and rhs_begin is None:
+        return jnp.reshape(lhs, rhs.shape)
+
+    def _norm(v, ndim, default):
+        # reference convention (matrix_op.cc ReshapeLikeParam): negative
+        # indices mean ndim + v (so end=-1 is the last axis, NOT one-past)
+        if v is None:
+            return default
+        return v + ndim if v < 0 else v
+
+    lb = _norm(lhs_begin, lhs.ndim, 0)
+    le = _norm(lhs_end, lhs.ndim, lhs.ndim)
+    rb = _norm(rhs_begin, rhs.ndim, 0)
+    re_ = _norm(rhs_end, rhs.ndim, rhs.ndim)
+    tgt = lhs.shape[:lb] + rhs.shape[rb:re_] + lhs.shape[le:]
+    return jnp.reshape(lhs, tgt)
+
+
+@register("_ravel_multi_index", aliases=("ravel_multi_index",))
+def _ravel_multi_index(data, shape=None):
+    """(ndim, N) coordinates -> flat indices (ref: src/operator/tensor/
+    ravel.cc). Row-major like the reference's RavelIndex kernel."""
+    shape = tuple(int(s) for s in shape)
+    idx = data.astype(jnp.int32)
+    stride = 1
+    strides = []
+    for size in reversed(shape):
+        strides.append(stride)
+        stride *= size
+    strides = strides[::-1]
+    out = jnp.zeros(idx.shape[1:], jnp.int32)
+    for d in range(len(shape)):
+        out = out + idx[d] * strides[d]
+    return out
+
+
+@register("_unravel_index", aliases=("unravel_index",))
+def _unravel_index(data, shape=None):
+    """Flat indices -> (ndim, N) coordinates (ref: ravel.cc UnravelIndex)."""
+    shape = tuple(int(s) for s in shape)
+    flat = data.astype(jnp.int32)
+    coords = []
+    rem = flat
+    for size in reversed(shape):
+        coords.append(rem % size)
+        rem = rem // size
+    return jnp.stack(coords[::-1], axis=0)
+
+
+@register("_contrib_getnnz", aliases=("getnnz",))
+def getnnz(data, axis=None):
+    """Count non-zeros (ref: src/operator/contrib/nnz.cc; the reference
+    reads CSR metadata — here a dense reduction XLA fuses for free)."""
+    nz = (data != 0)
+    if axis is None:
+        return jnp.sum(nz).astype(jnp.int32)
+    return jnp.sum(nz, axis=axis).astype(jnp.int32)
+
+
+@register("_contrib_SparseEmbedding", aliases=("SparseEmbedding",))
+def SparseEmbedding(data, weight, input_dim=None, output_dim=None,
+                    dtype="float32", deterministic=False):
+    """Embedding whose gradient is row-sparse (ref: src/operator/tensor/
+    indexing_op.cc SparseEmbedding). Same lowering as Embedding — the
+    row-sparse gradient shape is an autograd-tape concern here
+    (Parameter(sparse_grad=True)), not a separate kernel."""
+    return Embedding(data, weight, input_dim=input_dim,
+                     output_dim=output_dim, dtype=dtype)

@@ -120,3 +120,36 @@ def quantized_conv(data, weight, bias=None, min_data=None, max_data=None,
         out = out + (b if channels_last
                      else jnp.reshape(b, (1, -1) + (1,) * ndim))
     return out
+
+
+@register("_contrib_quantized_flatten", aliases=("quantized_flatten",))
+def quantized_flatten(data, min_data, max_data):
+    """Flatten an int8 tensor, ranges unchanged (ref: src/operator/
+    quantization/quantized_flatten.cc)."""
+    return (jnp.reshape(data, (data.shape[0], -1)), min_data, max_data)
+
+
+@register("_contrib_quantized_pooling", aliases=("quantized_pooling",))
+def quantized_pooling(data, min_data, max_data, kernel=None, pool_type="max",
+                      global_pool=False, stride=None, pad=None,
+                      pooling_convention="valid", layout=None):
+    """Pooling on int8 data, ranges unchanged (ref: src/operator/
+    quantization/quantized_pooling.cc). Max pool is exact in int8; avg
+    accumulates in int32 then rounds back, like the reference's
+    requantize-free path."""
+    from .registry import get_op
+    pool = get_op("Pooling").fn  # unwrapped: jnp in, jnp out
+    if pool_type == "max":
+        # reduce_window needs a matching-dtype init; int32 round-trip is
+        # exact for int8 max
+        out = pool(data.astype(jnp.int32), kernel=kernel, pool_type="max",
+                   global_pool=global_pool, stride=stride, pad=pad,
+                   pooling_convention=pooling_convention,
+                   layout=layout).astype(data.dtype)
+    else:
+        acc = pool(data.astype(jnp.float32), kernel=kernel,
+                   pool_type=pool_type, global_pool=global_pool,
+                   stride=stride, pad=pad,
+                   pooling_convention=pooling_convention, layout=layout)
+        out = jnp.clip(jnp.round(acc), -128, 127).astype(data.dtype)
+    return (out, min_data, max_data)

@@ -624,3 +624,44 @@ def _state_from_numpy(v):
 
 def get_updater(optimizer: Optimizer) -> Updater:
     return Updater(optimizer)
+
+
+@register
+class GroupAdaGrad(Optimizer):
+    """Per-row (grouped) AdaGrad (ref: python/mxnet/optimizer/contrib.py
+    GroupAdaGrad + src/operator/contrib/optimizer_op.cc
+    _contrib_group_adagrad_update): history is the MEAN of squared
+    gradients over each row (axis 1+), one adaptive rate per embedding row
+    — the memory-light AdaGrad used for large embeddings."""
+
+    def __init__(self, eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros((weight.shape[0],), weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        from .ndarray.sparse import RowSparseNDArray
+        red = tuple(range(1, weight._data.ndim))
+        if isinstance(grad, RowSparseNDArray):
+            rows = grad._aux["indices"]
+            g = grad._data * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            h_new = state._data[rows] + jnp.mean(jnp.square(g), axis=red)
+            state._set_data(state._data.at[rows].set(h_new))
+            div = jnp.sqrt(h_new + self.float_stable_eps)
+            weight._set_data(weight._data.at[rows].add(
+                -lr * g / div.reshape((-1,) + (1,) * (g.ndim - 1))))
+            return
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        h_new = state._data + jnp.mean(jnp.square(g), axis=red)
+        state._set_data(h_new)
+        div = jnp.sqrt(h_new + self.float_stable_eps)
+        weight._set_data(weight._data
+                         - lr * g / div.reshape((-1,) + (1,) * (g.ndim - 1)))

@@ -38,6 +38,7 @@ _AUTO_PARAMS = {
     "MAERegressionOutput": ("label",),
     "SVMOutput": ("label",),
 }
+_PARAM_ORDER_CACHE = {}  # op name -> positional parameter order of op.fn
 
 
 def _symbolic_call(op_name, *args, name=None, **kwargs):
@@ -71,8 +72,11 @@ def _symbolic_call(op_name, *args, name=None, **kwargs):
                          _Counter.next(op.name.lower()))
     auto = _AUTO_PARAMS.get(op.name)
     if auto:
-        import inspect as _inspect
-        fn_params = list(_inspect.signature(op.fn).parameters)
+        fn_params = _PARAM_ORDER_CACHE.get(op.name)
+        if fn_params is None:
+            import inspect as _inspect
+            fn_params = list(_inspect.signature(op.fn).parameters)
+            _PARAM_ORDER_CACHE[op.name] = fn_params
         supplied = set(fn_params[:len(args)]) | set(kwargs)
         for pname in auto:
             if pname in supplied:
@@ -101,4 +105,15 @@ def _make_sym_fn(op_name):
 for _name in _reg.list_ops():
     if _name not in globals():
         globals()[_name] = _make_sym_fn(_name)
+del _name
+
+# mx.sym.contrib.* — symbolic twin of mx.nd.contrib (ref: symbol/contrib.py)
+import sys as _sys  # noqa: E402
+import types as _types  # noqa: E402
+
+contrib = _types.ModuleType(__name__ + ".contrib")
+for _name in _reg.list_ops():
+    if _name.startswith("_contrib_"):
+        setattr(contrib, _name[len("_contrib_"):], _make_sym_fn(_name))
+_sys.modules[contrib.__name__] = contrib
 del _name

@@ -1,0 +1,246 @@
+"""Oracle tests for the round-3 parity ops: deformable conv family, RPN
+proposals, bipartite matching, ravel/unravel, reshape_like, getnnz,
+quantized flatten/pooling, legacy v1 aliases, KL sparse reg,
+SparseEmbedding, GroupAdaGrad."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import mxtpu as mx
+from mxtpu.ops.contrib_ops import (DeformableConvolution,
+                                   DeformablePSROIPooling, MultiProposal,
+                                   Proposal, PSROIPooling,
+                                   bipartite_matching)
+from mxtpu.ops.legacy_vision import IdentityAttachKLSparseReg
+from mxtpu.ops.matrix import (SparseEmbedding, _ravel_multi_index,
+                              _unravel_index, getnnz, reshape_like)
+from mxtpu.ops.nn import Convolution
+from mxtpu.ops.quantization import quantized_flatten, quantized_pooling
+
+
+def test_bipartite_matching_reference_example():
+    # the exact doc example from bounding_box.cc:162
+    s = jnp.array([[0.5, 0.6], [0.1, 0.2], [0.3, 0.4]])
+    x, y = bipartite_matching(s, threshold=1e-12)
+    assert list(x.asnumpy()) == [1, -1, 0]
+    assert list(y.asnumpy()) == [2, 0]
+    # ascending mode picks smallest first
+    x2, _y2 = bipartite_matching(s, is_ascend=True, threshold=1e6)
+    assert x2.asnumpy()[1] == 0  # smallest score 0.1 at row1/col0 matched
+
+
+def test_psroipooling_position_sensitive_mapping():
+    g, p, od = 2, 2, 3
+    c = od * g * g
+    data = jnp.broadcast_to(
+        jnp.arange(c, dtype=jnp.float32)[None, :, None, None], (1, c, 8, 8))
+    rois = jnp.array([[0, 0, 0, 7, 7]], jnp.float32)
+    out = PSROIPooling(data, rois, spatial_scale=1.0, output_dim=od,
+                       pooled_size=p, group_size=g)
+    np.testing.assert_allclose(out.asnumpy()[0],
+                               np.arange(c).reshape(od, g, g), atol=1e-5)
+
+
+def test_deformable_psroipooling_zero_and_const_offsets():
+    g, p, od = 2, 2, 2
+    c = od * g * g
+    data = jnp.broadcast_to(
+        jnp.arange(c, dtype=jnp.float32)[None, :, None, None], (1, c, 8, 8))
+    rois = jnp.array([[0, 0, 0, 7, 7]], jnp.float32)
+    out = DeformablePSROIPooling(data, rois, None, spatial_scale=1.0,
+                                 output_dim=od, group_size=g, pooled_size=p,
+                                 no_trans=True)
+    np.testing.assert_allclose(out.asnumpy()[0],
+                               np.arange(c).reshape(od, g, g), atol=1e-5)
+    # constant-per-channel input is shift-invariant under learned offsets
+    tr = jnp.ones((1, 2, p, p), jnp.float32)
+    out2 = DeformablePSROIPooling(data, rois, tr, spatial_scale=1.0,
+                                  output_dim=od, group_size=g,
+                                  pooled_size=p, trans_std=0.1)
+    np.testing.assert_allclose(out2.asnumpy()[0],
+                               np.arange(c).reshape(od, g, g), atol=1e-5)
+
+
+@pytest.mark.parametrize("stride,dilate,pad,groups", [
+    ((1, 1), (1, 1), (1, 1), 1),
+    ((2, 2), (2, 2), (2, 2), 1),
+    ((1, 1), (1, 1), (1, 1), 2),
+])
+def test_deformable_conv_zero_offset_equals_conv(stride, dilate, pad, groups):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 4, 9, 9), jnp.float32)
+    wt = jnp.asarray(rng.randn(6, 4 // groups, 3, 3) * 0.2, jnp.float32)
+    hout = (9 + 2 * pad[0] - dilate[0] * 2 - 1) // stride[0] + 1
+    off = jnp.zeros((2, 2 * 9, hout, hout), jnp.float32)
+    dc = DeformableConvolution(x, off, wt, kernel=(3, 3), stride=stride,
+                               dilate=dilate, pad=pad, num_filter=6,
+                               num_group=groups, no_bias=True)
+    ref = Convolution(x, wt, kernel=(3, 3), stride=stride, dilate=dilate,
+                      pad=pad, num_filter=6, num_group=groups, no_bias=True)
+    np.testing.assert_allclose(dc.asnumpy(), ref.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts_input():
+    # offset of exactly (0, +1) on every tap == conv of x shifted left by 1
+    rng = np.random.RandomState(1)
+    x = np.zeros((1, 1, 6, 6), np.float32)
+    x[0, 0] = rng.randn(6, 6)
+    wt = jnp.asarray(rng.randn(1, 1, 1, 1), jnp.float32)  # 1x1 kernel
+    off = np.zeros((1, 2, 6, 6), np.float32)
+    off[0, 1] = 1.0  # x-offset +1
+    dc = DeformableConvolution(jnp.asarray(x), jnp.asarray(off), wt,
+                               kernel=(1, 1), num_filter=1, no_bias=True)
+    shifted = np.zeros_like(x)
+    shifted[0, 0, :, :-1] = x[0, 0, :, 1:]
+    expect = shifted * np.asarray(wt)[0, 0, 0, 0]
+    np.testing.assert_allclose(dc.asnumpy(), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_proposal_and_multiproposal():
+    rng = np.random.RandomState(0)
+    N, A, H, W = 2, 3, 4, 4
+    import jax
+    cls = jax.nn.softmax(jnp.asarray(rng.randn(N, 2 * A, H, W), jnp.float32),
+                         axis=1)
+    bbox = jnp.asarray(rng.randn(N, 4 * A, H, W) * 0.1, jnp.float32)
+    info = jnp.asarray([[64, 64, 1.0]] * N, jnp.float32)
+    rois = MultiProposal(cls, bbox, info, rpn_pre_nms_top_n=12,
+                         rpn_post_nms_top_n=5, scales=(8,),
+                         ratios=(0.5, 1, 2), feature_stride=16)
+    r = rois.asnumpy()
+    assert r.shape == (10, 5)
+    assert set(r[:, 0]) == {0.0, 1.0}
+    assert (r[:, 1] >= 0).all() and (r[:, 3] <= 63).all()
+    assert (r[:, 1] <= r[:, 3]).all() and (r[:, 2] <= r[:, 4]).all()
+    rois1, scores1 = Proposal(cls[:1], bbox[:1], info[:1],
+                              rpn_pre_nms_top_n=12, rpn_post_nms_top_n=4,
+                              scales=(8,), ratios=(0.5, 1, 2),
+                              feature_stride=16, output_score=True)
+    assert rois1.shape == (4, 5) and scores1.shape == (4, 1)
+    # scores are sorted descending (greedy NMS preserves score order)
+    s = scores1.asnumpy().ravel()
+    assert (np.diff(s) <= 1e-6).all()
+
+
+def test_ravel_unravel_roundtrip():
+    coords = jnp.array([[0, 1, 2], [1, 0, 3]])
+    flat = _ravel_multi_index(coords, shape=(3, 4))
+    assert list(flat.asnumpy()) == [1, 4, 11]
+    back = _unravel_index(flat, shape=(3, 4))
+    np.testing.assert_array_equal(back.asnumpy(), np.asarray(coords))
+
+
+def test_reshape_like_and_getnnz():
+    a = jnp.arange(12.0).reshape(3, 4)
+    assert reshape_like(a, jnp.zeros((2, 6))).shape == (2, 6)
+    assert reshape_like(a, jnp.zeros((4, 3)), lhs_begin=0, lhs_end=2,
+                        rhs_begin=0, rhs_end=2).shape == (4, 3)
+    m = jnp.array([[1.0, 0.0], [2.0, 3.0]])
+    assert int(getnnz(m).asnumpy()) == 3
+    np.testing.assert_array_equal(getnnz(m, axis=0).asnumpy(), [2, 1])
+
+
+def test_quantized_flatten_and_pooling():
+    d = jnp.asarray(np.arange(-8, 8, dtype=np.int8).reshape(1, 1, 4, 4))
+    mn, mx_ = jnp.float32(-1.0), jnp.float32(1.0)
+    f, fmn, fmx = quantized_flatten(d, mn, mx_)
+    assert f.shape == (1, 16)
+    assert float(fmn.asnumpy()) == -1.0 and float(fmx.asnumpy()) == 1.0
+    p, pmn, pmx = quantized_pooling(d, mn, mx_, kernel=(2, 2), stride=(2, 2),
+                                    pool_type="max")
+    assert p.asnumpy().dtype == np.int8
+    np.testing.assert_array_equal(p.asnumpy()[0, 0],
+                                  [[-3, -1], [5, 7]])
+    pa, _, _ = quantized_pooling(d, mn, mx_, kernel=(2, 2), stride=(2, 2),
+                                 pool_type="avg")
+    assert pa.asnumpy().dtype == np.int8
+
+
+def test_v1_aliases_match_modern_ops():
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(2, 3, 8, 8).astype(np.float32))
+    w = mx.nd.array(rng.randn(4, 3, 3, 3).astype(np.float32) * 0.1)
+    b = mx.nd.array(np.zeros(4, np.float32))
+    v1 = mx.nd.Convolution_v1(x, w, b, kernel=(3, 3), pad=(1, 1),
+                              num_filter=4)
+    v2 = mx.nd.Convolution(x, w, b, kernel=(3, 3), pad=(1, 1), num_filter=4)
+    np.testing.assert_allclose(v1.asnumpy(), v2.asnumpy(), rtol=1e-5)
+    p1 = mx.nd.Pooling_v1(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    p2 = mx.nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    np.testing.assert_allclose(p1.asnumpy(), p2.asnumpy(), rtol=1e-6)
+    g = mx.nd.array(np.ones(3, np.float32))
+    be = mx.nd.array(np.zeros(3, np.float32))
+    mm = mx.nd.array(np.zeros(3, np.float32))
+    mv = mx.nd.array(np.ones(3, np.float32))
+    b1 = mx.nd.BatchNorm_v1(x, g, be, mm, mv, fix_gamma=False)
+    b2 = mx.nd.BatchNorm(x, g, be, mm, mv, fix_gamma=False, axis=1)
+    np.testing.assert_allclose(b1.asnumpy(), b2.asnumpy(), rtol=1e-5)
+
+
+def test_identity_attach_kl_sparse_reg_gradient():
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.uniform(0.2, 0.8, (4, 3)).astype(np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = IdentityAttachKLSparseReg(x, sparseness_target=0.1, penalty=0.01)
+        s = y.sum()
+    s.backward()
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())  # identity fwd
+    rho_hat = x.asnumpy().mean(0)
+    reg = 0.01 * (-0.1 / rho_hat + 0.9 / (1 - rho_hat))
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               1.0 + np.broadcast_to(reg, x.shape),
+                               rtol=1e-5)
+
+
+def test_sparse_embedding_forward():
+    w = jnp.asarray(np.eye(5, 3, dtype=np.float32))
+    idx = jnp.asarray([0, 2, 4])
+    out = SparseEmbedding(idx, w, input_dim=5, output_dim=3)
+    np.testing.assert_allclose(out.asnumpy(), np.eye(5, 3)[[0, 2, 4]])
+
+
+def test_group_adagrad_dense_and_sparse():
+    opt = mx.optimizer.create("groupadagrad", learning_rate=0.1)
+    w = mx.nd.array(np.ones((3, 4), np.float32))
+    g = mx.nd.array(np.full((3, 4), 0.5, np.float32))
+    st = opt.create_state(0, w)
+    assert st.shape == (3,)  # one slot per row, not per element
+    opt.update(0, w, g, st)
+    exp = 1 - 0.1 * 0.5 / np.sqrt(0.25 + 1e-5)
+    np.testing.assert_allclose(w.asnumpy(), np.full((3, 4), exp), rtol=1e-5)
+
+
+def test_reshape_like_negative_end_reference_convention():
+    # reference matrix_op.cc: negative end means ndim + end (last axis),
+    # e.g. (30, 7) with rhs (15, 2, 4), ends = -1 -> (15, 2, 7)
+    a = jnp.zeros((30, 7))
+    b = jnp.zeros((15, 2, 4))
+    out = reshape_like(a, b, lhs_begin=0, lhs_end=-1, rhs_begin=0,
+                       rhs_end=-1)
+    assert out.shape == (15, 2, 7)
+
+
+def test_bipartite_matching_topk_limit():
+    s = jnp.asarray(np.random.RandomState(0).rand(6, 6), jnp.float32)
+    x, _ = bipartite_matching(s, threshold=1e-9, topk=2)
+    assert int((x.asnumpy() >= 0).sum()) == 2
+
+
+def test_deformable_conv_fractional_border_fades_to_zero():
+    # tap at y = -0.5 must contribute HALF the row-0 value (zero padding),
+    # not the full clipped value (ref deformable_im2col.h im2col_bilinear)
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    x[0, 0, 0, :] = 8.0
+    wt = jnp.ones((1, 1, 1, 1), jnp.float32)
+    off = np.zeros((1, 2, 4, 4), np.float32)
+    off[0, 0] = -0.5  # y-offset
+    out = DeformableConvolution(jnp.asarray(x), jnp.asarray(off), wt,
+                                kernel=(1, 1), num_filter=1, no_bias=True)
+    np.testing.assert_allclose(out.asnumpy()[0, 0, 0], [4.0] * 4, atol=1e-5)
+    # fully outside (y = -1.5) -> exactly zero
+    off[0, 0] = -1.5
+    out2 = DeformableConvolution(jnp.asarray(x), jnp.asarray(off), wt,
+                                 kernel=(1, 1), num_filter=1, no_bias=True)
+    np.testing.assert_allclose(out2.asnumpy()[0, 0, 0], [0.0] * 4, atol=1e-6)

@@ -650,6 +650,24 @@ COVERED_ELSEWHERE = {
     "_subgraph_exec": "test_subgraph.py",
     "_sg_flash_attention": "test_subgraph.py",
     "linalg_gelqf": "test_operator_sweep.py",  # run-only above
+    # round-3 parity ops, oracle-tested in test_new_ops.py
+    "BatchNorm_v1": "test_new_ops.py",
+    "Convolution_v1": "test_new_ops.py",
+    "Pooling_v1": "test_new_ops.py",
+    "IdentityAttachKLSparseReg": "test_new_ops.py",
+    "_contrib_DeformableConvolution": "test_new_ops.py",
+    "_contrib_DeformablePSROIPooling": "test_new_ops.py",
+    "_contrib_PSROIPooling": "test_new_ops.py",
+    "_contrib_Proposal": "test_new_ops.py",
+    "_contrib_MultiProposal": "test_new_ops.py",
+    "_contrib_SparseEmbedding": "test_new_ops.py",
+    "_contrib_bipartite_matching": "test_new_ops.py",
+    "_contrib_getnnz": "test_new_ops.py",
+    "_contrib_quantized_flatten": "test_new_ops.py",
+    "_contrib_quantized_pooling": "test_new_ops.py",
+    "_ravel_multi_index": "test_new_ops.py",
+    "_unravel_index": "test_new_ops.py",
+    "reshape_like": "test_new_ops.py",
 }
 
 

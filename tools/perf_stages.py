@@ -16,7 +16,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 def timed_scan(fn, args, K=8):
     """One jit dispatch of K chained applications; host-fetch sync."""
     def body(c, _):
-        out = fn(*c[:1], *args[1:]) if False else fn(c[0], *args[1:])
+        out = fn(c[0], *args[1:])
         # keep shapes: fold output back into the carry input cheaply
         return (c[0] + 0 * jnp.mean(out.astype(jnp.float32)).astype(c[0].dtype),
                 ), None
