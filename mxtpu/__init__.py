@@ -58,3 +58,16 @@ from . import rtc
 from . import libinfo
 from .libinfo import __version__, feature_list
 from . import test_utils
+from . import name
+from . import attribute
+from .attribute import AttrScope
+from . import registry
+from . import engine
+from . import util
+from . import visualization
+from . import visualization as viz  # mx.viz alias
+from . import kvstore_server
+from . import executor_manager
+# reference import hook (kvstore_server.py:75): a DMLC_ROLE=server process
+# must fail fast with the migration note, not silently join as a worker
+kvstore_server._init_kvstore_server_module()

@@ -1,0 +1,32 @@
+"""Engine controls (ref: python/mxnet/engine.py — bulk-execution scoping).
+
+The reference batches consecutive engine ops into bulks
+(``MXEngineSetBulkSize``); on TPU whole-graph XLA compilation subsumes
+bulking — every hybridized/jitted step IS one bulk. The API is kept so
+tuning code ports, as documented no-ops returning the previous size.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+__all__ = ["bulk", "set_bulk_size"]
+
+_bulk_size = 0
+
+
+def set_bulk_size(size):
+    """Set engine bulk size; returns the previous value. No-op on TPU
+    (XLA fuses the whole jitted program — SURVEY §2.1 CachedOp notes)."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+@contextmanager
+def bulk(size):
+    """Bulk-execution scope (ref: engine.py:bulk)."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

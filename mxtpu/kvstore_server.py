@@ -1,0 +1,38 @@
+"""Parameter-server bootstrap (ref: python/mxnet/kvstore_server.py).
+
+The reference's server role runs a blocking ps-lite KVStore server
+(kvstore_server.py:28-75, kvstore_dist_server.h). This framework's
+distributed runtime is symmetric collectives over DCN (mxtpu/distributed.py)
+— there IS no server role: every process is a worker participating in
+allreduce, and ``dist_async`` is deliberately unsupported (see the ADR in
+mxtpu/kvstore.py and README). A process launched with DMLC_ROLE=server
+gets a clear error instead of a silent hang.
+"""
+from __future__ import annotations
+
+import os
+
+from .base import MXNetError
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """Kept for import parity; running it raises with the migration note."""
+
+    def __init__(self, kvstore=None):
+        self.kvstore = kvstore
+
+    def run(self):
+        raise MXNetError(
+            "Parameter-server roles do not exist in the TPU runtime: "
+            "distributed training is symmetric XLA collectives over "
+            "ICI/DCN (mxtpu.distributed.init + kv.create('dist_sync')). "
+            "Launch every process as a worker via tools/launch.py.")
+
+
+def _init_kvstore_server_module():
+    """Reference import hook: becomes a hard error under DMLC_ROLE=server,
+    a no-op otherwise (workers need no bootstrap here)."""
+    if os.environ.get("DMLC_ROLE") == "server":
+        KVStoreServer().run()

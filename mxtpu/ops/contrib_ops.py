@@ -611,4 +611,6 @@ def MultiProposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
 def Proposal(cls_prob, bbox_pred, im_info, **kwargs):
     """Single-image RPN proposals (ref: src/operator/contrib/proposal.cc)
     — MultiProposal restricted to batch 1, like the reference."""
-    return MultiProposal(cls_prob, bbox_pred, im_info, **kwargs)
+    from .registry import get_op
+    return get_op("_contrib_MultiProposal").fn(cls_prob, bbox_pred, im_info,
+                                               **kwargs)

@@ -413,7 +413,8 @@ def Convolution_v1(data, weight, bias=None, kernel=None, stride=None,
                    cudnn_off=None):
     """Legacy Convolution (ref: src/operator/convolution_v1.cc) — same math
     as the modern op in NCHW; kept for old symbol JSON."""
-    from .nn import Convolution
+    from .registry import get_op
+    Convolution = get_op("Convolution").fn  # unwrapped: jnp in, jnp out
     return Convolution(data, weight, bias, kernel=kernel, stride=stride,
                        dilate=dilate, pad=pad, num_filter=num_filter,
                        num_group=num_group, no_bias=no_bias)
@@ -423,7 +424,8 @@ def Convolution_v1(data, weight, bias=None, kernel=None, stride=None,
 def Pooling_v1(data, kernel=None, pool_type="max", global_pool=False,
                stride=None, pad=None, pooling_convention="valid"):
     """Legacy Pooling (ref: src/operator/pooling_v1.cc)."""
-    from .nn import Pooling
+    from .registry import get_op
+    Pooling = get_op("Pooling").fn  # unwrapped: jnp in, jnp out
     return Pooling(data, kernel=kernel, pool_type=pool_type,
                    global_pool=global_pool, stride=stride, pad=pad,
                    pooling_convention=pooling_convention)

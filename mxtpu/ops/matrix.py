@@ -393,5 +393,6 @@ def SparseEmbedding(data, weight, input_dim=None, output_dim=None,
     indexing_op.cc SparseEmbedding). Same lowering as Embedding — the
     row-sparse gradient shape is an autograd-tape concern here
     (Parameter(sparse_grad=True)), not a separate kernel."""
-    return Embedding(data, weight, input_dim=input_dim,
-                     output_dim=output_dim, dtype=dtype)
+    from .registry import get_op
+    return get_op("Embedding").fn(data, weight, input_dim=input_dim,
+                                  output_dim=output_dim, dtype=dtype)

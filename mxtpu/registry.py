@@ -1,0 +1,64 @@
+"""Generic create/register machinery (ref: python/mxnet/registry.py).
+
+The reference builds per-class registries (optimizers, metrics,
+initializers, lr schedulers) from dmlc-style registry helpers; here those
+registries already exist on their base classes — this module exposes the
+same ``get_register_func``/``get_create_func``/``get_alias_func`` surface
+so code written against mx.registry ports unchanged.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["get_register_func", "get_alias_func", "get_create_func"]
+
+_REGISTRIES = {}  # (base_class, nickname) -> {name: class}
+
+
+def _registry_for(base_class, nickname):
+    return _REGISTRIES.setdefault((base_class, nickname), {})
+
+
+def get_register_func(base_class, nickname):
+    """Returns register(klass, name=None) for the class family."""
+    reg = _registry_for(base_class, nickname)
+
+    def register(klass, name=None):
+        if not issubclass(klass, base_class):
+            raise MXNetError("%s is not a subclass of %s"
+                             % (klass, base_class.__name__))
+        reg[(name or klass.__name__).lower()] = klass
+        return klass
+
+    register.__name__ = "register_%s" % nickname
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    reg = _registry_for(base_class, nickname)
+
+    def alias(name):
+        def deco(klass):
+            reg[name.lower()] = klass
+            return klass
+        return deco
+
+    alias.__name__ = "alias_%s" % nickname
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Returns create(name_or_instance, **kwargs) for the class family."""
+    reg = _registry_for(base_class, nickname)
+
+    def create(obj, **kwargs):
+        if isinstance(obj, base_class):
+            return obj
+        name = str(obj).lower()
+        if name not in reg:
+            raise MXNetError("%s %s not registered; have %s"
+                             % (nickname, obj, sorted(reg)))
+        return reg[name](**kwargs)
+
+    create.__name__ = "create_%s" % nickname
+    return create

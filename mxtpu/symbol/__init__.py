@@ -67,9 +67,20 @@ def _symbolic_call(op_name, *args, name=None, **kwargs):
             kw_arrays.append(k)
         else:
             attrs[k] = v
-    if name is None:
-        name = "%s%d" % (op.name.lower().lstrip("_"),
-                         _Counter.next(op.name.lower()))
+    from ..attribute import current_attrs
+    from ..name import current as _current_nm
+    nm = _current_nm()
+    hint = op.name.lower().lstrip("_")
+    if nm is not None:
+        name = nm.get(name, hint)
+    elif name is None:
+        name = "%s%d" % (hint, _Counter.next(op.name.lower()))
+    scope_attrs = current_attrs()
+    if scope_attrs:
+        # scope attrs are defaults; explicit kwargs-derived attrs win
+        merged = dict(scope_attrs)
+        merged.update(attrs)
+        attrs = merged
     auto = _AUTO_PARAMS.get(op.name)
     if auto:
         fn_params = _PARAM_ORDER_CACHE.get(op.name)

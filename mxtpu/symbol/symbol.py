@@ -215,7 +215,10 @@ class Symbol:
             arrays = [values[id(inp)][idx] for inp, idx in node.inputs]
             it = iter(arrays)
             pos = [next(it) if a is _ARG else a for a in node.pos_template]
-            kwargs = dict(node.attrs)
+            # dunder attrs (__ctx_group__, __lr_mult__, ... from AttrScope)
+            # are graph annotations, not op kwargs
+            kwargs = {k: v for k, v in node.attrs.items()
+                      if not (k.startswith("__") and k.endswith("__"))}
             for k in node.kw_arrays:
                 kwargs[k] = next(it)
             op = _reg.get_op(node.op)
@@ -329,7 +332,10 @@ class Symbol:
             arrays = [NDArray(d) for d in datas]
             it = iter(arrays)
             pos = [next(it) if a is _ARG else a for a in node.pos_template]
-            kwargs = dict(node.attrs)
+            # dunder attrs (__ctx_group__, __lr_mult__, ... from AttrScope)
+            # are graph annotations, not op kwargs
+            kwargs = {k: v for k, v in node.attrs.items()
+                      if not (k.startswith("__") and k.endswith("__"))}
             for k in node.kw_arrays:
                 kwargs[k] = next(it)
             res = op.wrapper(*pos, **kwargs)
@@ -436,8 +442,11 @@ def _binary(op_name, scalar_op, lhs, rhs, rev=False):
 
 
 def var(name, attr=None, shape=None, dtype=None, init=None, **kwargs):
-    """Create a variable symbol (ref: symbol.py:var)."""
-    attrs = dict(attr or {})
+    """Create a variable symbol (ref: symbol.py:var). Active AttrScope
+    attributes (mx.AttrScope) apply as defaults, like the reference."""
+    from ..attribute import current_attrs
+    attrs = current_attrs()
+    attrs.update(attr or {})
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:

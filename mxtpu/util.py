@@ -1,0 +1,52 @@
+"""Misc utilities (ref: python/mxnet/util.py)."""
+from __future__ import annotations
+
+import functools
+import os
+
+__all__ = ["makedirs", "get_gpu_count", "get_gpu_memory", "use_np_shape",
+           "is_np_shape"]
+
+
+def makedirs(d):
+    """Create directory recursively, tolerating existing dirs
+    (ref: util.py:makedirs)."""
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def get_gpu_count():
+    """Accelerator count (the reference counts CUDA GPUs; here TPU chips)."""
+    import jax
+    try:
+        return len([d for d in jax.devices() if d.platform != "cpu"])
+    except Exception:  # noqa: BLE001 - backend not initialized
+        return 0
+
+
+def get_gpu_memory(dev_id=0):
+    """(free, total) accelerator memory in bytes when the backend exposes
+    it, else (0, 0)."""
+    import jax
+    try:
+        d = jax.devices()[dev_id]
+        stats = d.memory_stats() or {}
+        total = stats.get("bytes_limit", 0)
+        used = stats.get("bytes_in_use", 0)
+        return total - used, total
+    except Exception:  # noqa: BLE001
+        return 0, 0
+
+
+def is_np_shape():
+    """NumPy-shape semantics flag — always True here: zero-size and scalar
+    shapes are native to jax, so the legacy 0=unknown convention of the
+    reference never applies (ref: util.py:is_np_shape)."""
+    return True
+
+
+def use_np_shape(func):
+    """Decorator kept for API compatibility (np-shape is always on)."""
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        return func(*args, **kwargs)
+    return wrapper

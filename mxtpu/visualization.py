@@ -1,0 +1,105 @@
+"""Network visualization (ref: python/mxnet/visualization.py).
+
+``print_summary`` renders the layer-by-layer table (output shapes +
+parameter counts) to stdout; ``plot_network`` builds a graphviz Digraph
+when the ``graphviz`` package is importable (not bundled in this image —
+the function raises a clear ImportError otherwise, like the reference).
+"""
+from __future__ import annotations
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _params_of(node, shape_map):
+    total = 0
+    for inp, _idx in node.inputs:
+        if inp.is_var() and inp.name in shape_map and \
+                not inp.name.endswith(("_label", "data")):
+            import numpy as _np
+            total += int(_np.prod(shape_map[inp.name]))
+    return total
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Layer table like the reference's print_summary (visualization.py:38).
+
+    ``shape``: dict of input name -> shape used to run shape inference so
+    output shapes and parameter counts are concrete.
+    """
+    from .symbol.symbol import _topo
+
+    shape_map = {}
+    out_shapes = {}
+    if shape:
+        arg_shapes, _outs, aux_shapes = symbol.infer_shape(**shape)
+        args = symbol.list_arguments()
+        auxs = symbol.list_auxiliary_states()
+        shape_map = dict(zip(args, arg_shapes))
+        shape_map.update(dict(zip(auxs, aux_shapes)))
+        internals = symbol.get_internals()
+        try:
+            _a, int_outs, _x = internals.infer_shape(**shape)
+            for name, s in zip(
+                    [n.name for n in _topo(internals._heads)], int_outs):
+                out_shapes[name] = s
+        except Exception:  # noqa: BLE001 - summary stays best-effort
+            pass
+
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    cols = [int(line_length * p) for p in positions]
+    header = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def row(fields):
+        line = ""
+        for text, stop in zip(fields, cols):
+            line = (line + str(text))[:stop].ljust(stop)
+        print(line)
+
+    print("_" * line_length)
+    row(header)
+    print("=" * line_length)
+    total = 0
+    nodes = [n for n in _topo(symbol._heads) if not n.is_var()]
+    for node in nodes:
+        nparam = _params_of(node, shape_map)
+        total += nparam
+        prev = ",".join(i.name for i, _ in node.inputs if not i.is_var())
+        row(["%s (%s)" % (node.name, node.op),
+             out_shapes.get(node.name, ""), nparam, prev])
+    print("=" * line_length)
+    print("Total params: {:,}".format(total))
+    print("_" * line_length)
+    return total
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """graphviz Digraph of the symbol (ref: visualization.py:plot_network).
+    Requires the optional ``graphviz`` package."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:  # pragma: no cover - graphviz not in image
+        raise ImportError(
+            "plot_network requires the python graphviz package") from e
+    from .symbol.symbol import _topo
+
+    node_attrs = {"shape": "box", "fixedsize": "false"}
+    dot = Digraph(name=title, format=save_format)
+    for node in _topo(symbol._heads):
+        if node.is_var():
+            if hide_weights and node.name.endswith(
+                    ("_weight", "_bias", "_gamma", "_beta", "_moving_mean",
+                     "_moving_var")):
+                continue
+            dot.node(node.name, label=node.name,
+                     **{**node_attrs, "shape": "oval"})
+        else:
+            dot.node(node.name, label="%s\n%s" % (node.name, node.op),
+                     **node_attrs)
+        for inp, _i in node.inputs:
+            if inp.is_var() and hide_weights and inp.name.endswith(
+                    ("_weight", "_bias", "_gamma", "_beta", "_moving_mean",
+                     "_moving_var")):
+                continue
+            dot.edge(inp.name, node.name)
+    return dot

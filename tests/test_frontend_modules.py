@@ -1,0 +1,168 @@
+"""mx.name / mx.attribute / mx.viz / mx.registry / mx.engine / mx.util —
+the reference's misc frontend modules (python/mxnet/{name,attribute,
+visualization,registry,engine,util}.py)."""
+import numpy as np
+
+import mxtpu as mx
+
+
+def test_name_manager_and_prefix():
+    with mx.name.Prefix("stage1_"):
+        s = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4)
+    args = s.list_arguments()
+    assert args[1].startswith("stage1_fullyconnected")
+    # nested scopes: inner wins, counters independent
+    with mx.name.NameManager():
+        a = mx.sym.Activation(mx.sym.Variable("x"), act_type="relu")
+        b = mx.sym.Activation(mx.sym.Variable("x"), act_type="relu")
+    names = [n for n in (a.attr("__name__") or "",)]  # names live on nodes
+    assert a._heads[0][0].name == "activation0"
+    assert b._heads[0][0].name == "activation1"
+
+
+def test_attr_scope_applies_to_ops_and_vars():
+    with mx.AttrScope(ctx_group="dev1", lr_mult=2):
+        v = mx.sym.Variable("w2")
+        s = mx.sym.FullyConnected(mx.sym.Variable("d2"), weight=v,
+                                  num_hidden=4, name="fc9")
+    assert v.attr("__ctx_group__") == "dev1"
+    assert s.attr("__lr_mult__") == "2"
+    # nesting: inner overrides, outer restored
+    with mx.AttrScope(ctx_group="a"):
+        with mx.AttrScope(ctx_group="b"):
+            inner = mx.sym.Variable("vi")
+        outer = mx.sym.Variable("vo")
+    assert inner.attr("__ctx_group__") == "b"
+    assert outer.attr("__ctx_group__") == "a"
+    # no scope: no attrs leak
+    clean = mx.sym.Variable("vc")
+    assert clean.attr("__ctx_group__") is None
+
+
+def test_print_summary_counts_params(capsys):
+    net = mx.sym.Convolution(mx.sym.Variable("data"), num_filter=8,
+                             kernel=(3, 3), pad=(1, 1), name="c1")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=10,
+                                name="fc")
+    total = mx.viz.print_summary(net, shape={"data": (1, 3, 8, 8)})
+    out = capsys.readouterr().out
+    # conv: 8*3*3*3 + 8 = 224; fc: 512*10 + 10 = 5130
+    assert total == 224 + 5130
+    assert "c1 (Convolution)" in out and "Total params" in out
+
+
+def test_registry_funcs():
+    class Base:
+        pass
+
+    class Impl(Base):
+        pass
+
+    reg = mx.registry.get_register_func(Base, "thing")
+    alias = mx.registry.get_alias_func(Base, "thing")
+    create = mx.registry.get_create_func(Base, "thing")
+    reg(Impl)
+    alias("other")(Impl)
+    assert isinstance(create("impl"), Impl)
+    assert isinstance(create("other"), Impl)
+    inst = Impl()
+    assert create(inst) is inst
+
+
+def test_engine_bulk_and_util():
+    assert mx.engine.set_bulk_size(15) == 0
+    with mx.engine.bulk(30):
+        pass
+    assert mx.engine.set_bulk_size(0) == 15
+    mx.util.makedirs("/tmp/_mxtpu_util_dir/nested")
+    mx.util.makedirs("/tmp/_mxtpu_util_dir/nested")  # idempotent
+    assert mx.util.is_np_shape() is True
+
+    @mx.util.use_np_shape
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+
+
+def test_kvstore_server_refuses_with_migration_note():
+    import pytest
+    from mxtpu.kvstore_server import KVStoreServer
+    with pytest.raises(mx.MXNetError, match="symmetric XLA collectives"):
+        KVStoreServer().run()
+
+
+def test_split_input_slice():
+    from mxtpu.executor_manager import _split_input_slice
+    sl = _split_input_slice(10, [1, 1])
+    assert [s.start for s in sl] == [0, 5] and [s.stop for s in sl] == [5, 10]
+    sl = _split_input_slice(9, [2, 1])
+    assert sl[0] == slice(0, 6) and sl[1] == slice(6, 9)
+    import pytest
+    with pytest.raises(mx.MXNetError):
+        _split_input_slice(1, [1, 1])
+
+
+def test_attr_scope_symbol_still_executes():
+    """Dunder scope attrs are graph annotations, not op kwargs — a symbol
+    built inside an AttrScope must infer and bind normally."""
+    with mx.AttrScope(ctx_group="dev1"):
+        net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                                    name="fca")
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(2, 4))
+    assert out_shapes[0] == (2, 3)
+    ex = net.simple_bind(mx.cpu(), data=(2, 4))
+    out = ex.forward(is_train=False, data=mx.nd.ones((2, 4)))
+    assert out[0].shape == (2, 3)
+
+
+def test_attr_scope_object_reuse_does_not_leak():
+    a = mx.AttrScope(lr_mult=1)
+    with mx.AttrScope(ctx_group="dev1"):
+        with a:
+            pass
+    with a:
+        v = mx.sym.Variable("reuse_v")
+    assert v.attr("__ctx_group__") is None
+    assert v.attr("__lr_mult__") == "1"
+
+
+def test_v1_and_sparse_embedding_backward():
+    import numpy as np
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(2, 3, 8, 8).astype(np.float32))
+    w = mx.nd.array(rng.randn(4, 3, 3, 3).astype(np.float32) * 0.1)
+    w.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Convolution_v1(x, w, None, kernel=(3, 3), pad=(1, 1),
+                                 num_filter=4, no_bias=True)
+        z = mx.nd.Pooling_v1(y, kernel=(2, 2), stride=(2, 2),
+                             pool_type="avg")
+        loss = (z * z).sum()
+    loss.backward()
+    assert np.isfinite(w.grad.asnumpy()).all()
+    assert np.abs(w.grad.asnumpy()).sum() > 0
+
+    emb = mx.nd.array(np.eye(5, 3, dtype=np.float32))
+    emb.attach_grad()
+    idx = mx.nd.array(np.array([0, 2], np.float32))
+    with mx.autograd.record():
+        out = mx.nd.contrib.SparseEmbedding(idx, emb, input_dim=5,
+                                            output_dim=3)
+        loss = out.sum()
+    loss.backward()
+    g = emb.grad.asnumpy()
+    assert g[0].sum() == 3 and g[2].sum() == 3 and g[1].sum() == 0
+
+
+def test_server_role_fails_fast(monkeypatch):
+    import subprocess, sys
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu'); import mxtpu"],
+        env={"PATH": "/usr/bin:/bin", "DMLC_ROLE": "server",
+             "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": "/root/repo"},
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode != 0
+    assert "symmetric XLA collectives" in r.stderr
