@@ -32,7 +32,9 @@ class KVStoreServer:
 
 
 def _init_kvstore_server_module():
-    """Reference import hook: becomes a hard error under DMLC_ROLE=server,
-    a no-op otherwise (workers need no bootstrap here)."""
-    if os.environ.get("DMLC_ROLE") == "server":
+    """Reference import hook: becomes a hard error under DMLC_ROLE=server
+    or scheduler (neither role exists in the symmetric runtime — a
+    scheduler that silently joined as a worker would skew the expected
+    world size and hang the rendezvous), a no-op for workers."""
+    if os.environ.get("DMLC_ROLE") in ("server", "scheduler"):
         KVStoreServer().run()

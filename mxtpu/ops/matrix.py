@@ -239,7 +239,7 @@ def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
     return (oh * (on_value - off_value) + off_value).astype(_as_jax_dtype(dtype))
 
 
-@register("index_copy")
+@register("_contrib_index_copy", aliases=("index_copy",))
 def index_copy(old, index, new):
     """Ref: src/operator/contrib/index_copy.cc."""
     return old.at[index.astype(jnp.int32)].set(new)

@@ -16,6 +16,19 @@ _REGISTRIES = {}  # (base_class, nickname) -> {name: class}
 
 
 def _registry_for(base_class, nickname):
+    """The live class-family registry: the framework's own registries for
+    Optimizer/EvalMetric/Initializer (so mx.registry sees every built-in,
+    e.g. create('xavier') works), a fresh dict for user base classes."""
+    from . import initializer as _init
+    from . import metric as _metric
+    from .optimizer import Optimizer as _Opt
+
+    if issubclass(base_class, _Opt):
+        return _Opt.opt_registry
+    if issubclass(base_class, _metric.EvalMetric):
+        return _metric._REGISTRY
+    if issubclass(base_class, _init.Initializer):
+        return _init._REGISTRY
     return _REGISTRIES.setdefault((base_class, nickname), {})
 
 

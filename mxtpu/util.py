@@ -32,7 +32,9 @@ def get_gpu_memory(dev_id=0):
         stats = d.memory_stats() or {}
         total = stats.get("bytes_limit", 0)
         used = stats.get("bytes_in_use", 0)
-        return total - used, total
+        if not total:  # stats dict is backend-dependent; never report
+            return 0, 0  # negative free when bytes_limit is absent
+        return max(total - used, 0), total
     except Exception:  # noqa: BLE001
         return 0, 0
 

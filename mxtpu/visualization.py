@@ -10,14 +10,21 @@ from __future__ import annotations
 __all__ = ["print_summary", "plot_network"]
 
 
-def _params_of(node, shape_map):
-    total = 0
+_PARAM_SUFFIXES = ("_weight", "_bias", "_gamma", "_beta", "_moving_mean",
+                   "_moving_var", "_running_mean", "_running_var")
+
+
+def _param_vars_of(node, shape_map):
+    """(name, size) of this node's parameter inputs — identified by the
+    parameter-name suffixes like the reference (visualization.py counts
+    weight/bias/gamma/beta), never by excluding data-ish names."""
+    import numpy as _np
+    out = []
     for inp, _idx in node.inputs:
         if inp.is_var() and inp.name in shape_map and \
-                not inp.name.endswith(("_label", "data")):
-            import numpy as _np
-            total += int(_np.prod(shape_map[inp.name]))
-    return total
+                inp.name.endswith(_PARAM_SUFFIXES):
+            out.append((inp.name, int(_np.prod(shape_map[inp.name]))))
+    return out
 
 
 def print_summary(symbol, shape=None, line_length=120, positions=None):
@@ -59,10 +66,13 @@ def print_summary(symbol, shape=None, line_length=120, positions=None):
     row(header)
     print("=" * line_length)
     total = 0
+    counted = set()  # a weight shared by two layers counts once in total
     nodes = [n for n in _topo(symbol._heads) if not n.is_var()]
     for node in nodes:
-        nparam = _params_of(node, shape_map)
-        total += nparam
+        pvars = _param_vars_of(node, shape_map)
+        nparam = sum(sz for _n, sz in pvars)
+        total += sum(sz for n_, sz in pvars if n_ not in counted)
+        counted.update(n_ for n_, _sz in pvars)
         prev = ",".join(i.name for i, _ in node.inputs if not i.is_var())
         row(["%s (%s)" % (node.name, node.op),
              out_shapes.get(node.name, ""), nparam, prev])
@@ -83,7 +93,9 @@ def plot_network(symbol, title="plot", save_format="pdf", shape=None,
             "plot_network requires the python graphviz package") from e
     from .symbol.symbol import _topo
 
-    node_attrs = {"shape": "box", "fixedsize": "false"}
+    attrs = {"shape": "box", "fixedsize": "false"}
+    attrs.update(node_attrs or {})  # caller customization wins
+    node_attrs = attrs
     dot = Digraph(name=title, format=save_format)
     for node in _topo(symbol._heads):
         if node.is_var():

@@ -337,7 +337,7 @@ CASES["_scatter_set_nd"] = C(
              np.array([[0, 2], [1, 3]], np.float32),
              np.array([9.0, 8.0], np.float32)],
     None, kwargs={"shape": (3, 4)}, run_only=True)
-CASES["index_copy"] = C(
+CASES["_contrib_index_copy"] = C(
     lambda: [np.zeros((4, 3), np.float32), np.array([1, 3], np.float32),
              RNG(0).uniform(-1, 1, (2, 3)).astype(np.float32)],
     None, run_only=True)
