@@ -32,6 +32,7 @@ matching how the reference leans on cuDNN fp32.
 """
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -329,30 +330,78 @@ CONFIGS = {
 }
 
 
+def _run_config(cname, fn, timeout_s):
+    """Run one config with a wall-clock watchdog. The TPU tunnel can wedge
+    server-side (observed: every dispatch, even a trivial jit, hangs
+    indefinitely — PERF.md timing methodology); without a watchdog a wedged
+    chip would leave the driver artifact with NO output lines. The config
+    runs on a daemon thread; on timeout an error record is printed and the
+    hung thread is abandoned (it holds no locks we need)."""
+    import threading
+
+    result = {}
+
+    def work():
+        try:
+            result["out"] = fn()
+        except BaseException as e:  # noqa: BLE001 - SystemExit included:
+            result["err"] = str(e)   # a dead thread must still yield a record
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return {"metric": cname, "timed_out": True,
+                "error": "timeout after %ds (chip/tunnel unresponsive?)"
+                         % timeout_s}
+    if "err" in result:
+        return {"metric": cname, "error": result["err"]}
+    return result.get("out") or {"metric": cname,
+                                 "error": "config returned nothing"}
+
+
 def main():
     name = os.environ.get("BENCH_CONFIG", "all")
+    timeout_s = int(os.environ.get("BENCH_CONFIG_TIMEOUT", "900"))
     if name == "all":
         # per-config isolation: a failing config must not eat the headline
         # resnet50 line (the driver parses the LAST printed line)
         base_profile = os.environ.get("BENCH_PROFILE")
+        hung = False
+        rec = {}
         try:
             for cname, fn in CONFIGS.items():
+                if hung:
+                    # the chip is unresponsive; running more configs would
+                    # hang too, and an abandoned thread that later un-wedges
+                    # must not race a live config's profiler/BENCH_PROFILE
+                    rec = {"metric": cname, "error":
+                           "skipped: earlier config timed out "
+                           "(chip/tunnel unresponsive)"}
+                    print(json.dumps(rec), flush=True)
+                    continue
                 if base_profile:
                     # one trace file per config — a shared file would be
                     # clobbered and merged across configs
                     root, ext = os.path.splitext(base_profile)
                     os.environ["BENCH_PROFILE"] = "%s.%s%s" % (root, cname,
                                                                ext or ".json")
-                try:
-                    print(json.dumps(fn()), flush=True)
-                except Exception as e:  # noqa: BLE001 - report and move on
-                    print(json.dumps({"metric": cname, "error": str(e)}),
-                          flush=True)
+                rec = _run_config(cname, fn, timeout_s)
+                hung = hung or rec.get("timed_out", False)
+                print(json.dumps(rec), flush=True)
         finally:
             if base_profile:
                 os.environ["BENCH_PROFILE"] = base_profile
-        return
-    print(json.dumps(CONFIGS[name]()))
+        code = 1 if "error" in rec else 0  # headline (last) config decides
+        if hung:
+            os._exit(code)  # abandoned daemon threads would block exit
+        sys.exit(code)
+    rec = _run_config(name, CONFIGS[name], timeout_s)
+    print(json.dumps(rec), flush=True)
+    if rec.get("timed_out"):
+        os._exit(1)  # the abandoned daemon thread would block exit
+    if "error" in rec:
+        sys.exit(1)  # config failures keep failing the invocation
 
 
 if __name__ == "__main__":
