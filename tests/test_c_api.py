@@ -201,45 +201,119 @@ int main(int argc, char **argv) {
 """
 
 
+def _compile_against_abi(src_path, exe_path, compiler="gcc", extra=()):
+    """ONE copy of the build recipe for out-of-process ABI smoke programs
+    (shared by the C and C++ frontend tests)."""
+    so_dir = os.path.join(REPO, "mxtpu", "_native")
+    ver = sysconfig.get_config_var("LDVERSION")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    cmd = ([compiler] + list(extra) + [str(src_path), "-o", str(exe_path),
+           "-I", os.path.join(REPO, "include"),
+           "-L", so_dir, "-Wl,-rpath," + so_dir, "-l:_libmxtpu.so",
+           "-L", libdir, "-Wl,-rpath," + libdir, "-lpython" + ver])
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def _run_smoke(exe_path, prefix):
+    env = dict(os.environ)
+    site = sysconfig.get_paths()["purelib"]
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO, site] + env.get("PYTHONPATH", "").split(os.pathsep))
+    env["MXTPU_JAX_PLATFORMS"] = "cpu"  # hermetic: no TPU tunnel from CI
+    proc = subprocess.run([str(exe_path), prefix], capture_output=True,
+                          text=True, env=env, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout.strip().splitlines()
+
+
+def _reference_forward(prefix):
+    """Python-side forward of the exported checkpoint on the smoke
+    programs' fixed input — the expectation both smoke tests check."""
+    x = (np.arange(16, dtype=np.float32) % 5) * 0.25 - 0.5
+    x = x.reshape(2, 8)
+    from mxtpu import model as mxmodel
+    sym, arg, aux = mxmodel.load_checkpoint(prefix, 0)
+    exe_ = sym.bind(args={**arg, "data": mx.nd.array(x)}, aux_states=aux,
+                    grad_req="null")
+    return exe_.forward(is_train=False)[0].asnumpy()
+
+
 def test_predict_api_from_c_program(lib, exported_model, tmp_path):
     """Compile + run a real C program against the ABI (no Python host)."""
     prefix, _x, expect = exported_model
     csrc = tmp_path / "smoke.c"
     csrc.write_text(C_SMOKE)
     exe = tmp_path / "smoke"
-    so_dir = os.path.join(REPO, "mxtpu", "_native")
-    ver = sysconfig.get_config_var("LDVERSION")
-    libdir = sysconfig.get_config_var("LIBDIR")
-    cmd = ["gcc", str(csrc), "-o", str(exe),
-           "-I", os.path.join(REPO, "include"),
-           "-L", so_dir, "-Wl,-rpath," + so_dir, "-l:_libmxtpu.so",
-           "-L", libdir, "-Wl,-rpath," + libdir, "-lpython" + ver]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
-
-    env = dict(os.environ)
-    site = sysconfig.get_paths()["purelib"]
-    env["PYTHONPATH"] = os.pathsep.join(
-        [REPO, site] + env.get("PYTHONPATH", "").split(os.pathsep))
-    env["MXTPU_JAX_PLATFORMS"] = "cpu"  # hermetic: no TPU tunnel from CI
-    proc = subprocess.run([str(exe), prefix], capture_output=True, text=True,
-                          env=env, timeout=300)
-    assert proc.returncode == 0, proc.stderr
-    lines = proc.stdout.strip().splitlines()
+    _compile_against_abi(csrc, exe, "gcc")
+    lines = _run_smoke(exe, prefix)
     # the C program's per-row argmax must match the python forward's
     got_classes = [int(l.split("class")[1]) for l in lines[:-1]]
-    # C smoke uses its own fixed input, so recompute the expectation here
-    x = (np.arange(16, dtype=np.float32) % 5) * 0.25 - 0.5
-    x = x.reshape(2, 8)
-    import mxtpu as mx2
-    from mxtpu.gluon import SymbolBlock  # noqa: F401  (API surface check)
-    from mxtpu import model as mxmodel
-    sym, arg, aux = mxmodel.load_checkpoint(prefix, 0)
-    exe_ = sym.bind(args={**arg, "data": mx.nd.array(x)}, aux_states=aux,
-                    grad_req="null")
-    ref = exe_.forward(is_train=False)[0].asnumpy()
+    ref = _reference_forward(prefix)
     np.testing.assert_array_equal(got_classes, ref.argmax(1))
     vals = np.fromstring(lines[-1], dtype=np.float32, sep=" ") \
         if hasattr(np, "fromstring") else None
     if vals is not None and vals.size == ref.size:
         np.testing.assert_allclose(vals.reshape(ref.shape), ref, rtol=1e-4,
                                    atol=1e-5)
+
+
+CPP_SMOKE = r"""
+#include <cstdio>
+#include <vector>
+#include "mxtpu/mxtpu-cpp.hpp"
+
+int main(int argc, char **argv) {
+  if (MXTPURuntimeInit(nullptr) != 0) {
+    fprintf(stderr, "init: %s\n", MXTPUGetLastError());
+    return 1;
+  }
+  try {
+    float da[6] = {1, 2, 3, 4, 5, 6};
+    float db[6] = {10, 20, 30, 40, 50, 60};
+    mxtpu::cpp::NDArray a({2, 3}, da), b({2, 3}, db);
+    auto c = mxtpu::cpp::Operator("broadcast_add")(a, b);
+    auto host = c.CopyToHost();
+    for (float v : host) printf("%.1f ", v);
+    printf("\n");
+    auto s = mxtpu::cpp::Operator("sum").SetAttr("axis", "1")(a);
+    for (float v : s.CopyToHost()) printf("%.1f ", v);
+    printf("\n");
+    // predictor over the exported checkpoint
+    mxtpu::cpp::Predictor pred(argv[1], 0, "data", {2, 8});
+    std::vector<float> x(16);
+    for (int i = 0; i < 16; ++i) x[i] = (i % 5) * 0.25f - 0.5f;
+    pred.SetInput(x);
+    pred.Forward();
+    auto shape = pred.OutputShape();
+    auto out = pred.Output();
+    for (int64_t r = 0; r < shape[0]; ++r) {
+      int best = 0;
+      for (int cix = 1; cix < shape[1]; ++cix)
+        if (out[r * shape[1] + cix] > out[r * shape[1] + best]) best = cix;
+      printf("row%lld:class%d\n", (long long)r, best);
+    }
+  } catch (const std::exception &e) {
+    fprintf(stderr, "exception: %s\n", e.what());
+    return 1;
+  }
+  return 0;
+}
+"""
+
+
+def test_cpp_frontend(lib, exported_model, tmp_path):
+    """Header-only C++ frontend (include/mxtpu/mxtpu-cpp.hpp, ref
+    cpp-package/include/mxnet-cpp): compile + run a real C++ program."""
+    prefix, _x, _expect = exported_model
+    src = tmp_path / "smoke.cc"
+    src.write_text(CPP_SMOKE)
+    exe = tmp_path / "smoke_cpp"
+    _compile_against_abi(src, exe, "g++", extra=("-std=c++14",))
+    lines = _run_smoke(exe, prefix)
+    assert lines[0].split() == ["11.0", "22.0", "33.0", "44.0", "55.0",
+                                "66.0"]
+    assert lines[1].split() == ["6.0", "15.0"]
+    # classification rows match the python forward
+    ref = _reference_forward(prefix)
+    got = [int(l.split("class")[1]) for l in lines[2:]]
+    np.testing.assert_array_equal(got, ref.argmax(1))
