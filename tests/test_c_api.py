@@ -231,6 +231,7 @@ def _reference_forward(prefix):
     programs' fixed input — the expectation both smoke tests check."""
     x = (np.arange(16, dtype=np.float32) % 5) * 0.25 - 0.5
     x = x.reshape(2, 8)
+    from mxtpu.gluon import SymbolBlock  # noqa: F401  (API surface check)
     from mxtpu import model as mxmodel
     sym, arg, aux = mxmodel.load_checkpoint(prefix, 0)
     exe_ = sym.bind(args={**arg, "data": mx.nd.array(x)}, aux_states=aux,
