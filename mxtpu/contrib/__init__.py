@@ -4,3 +4,4 @@ from . import svrg_optimization  # noqa: F401
 from . import tensorboard  # noqa: F401
 from . import text  # noqa: F401
 from . import onnx  # noqa: F401
+from . import async_checkpoint  # noqa: F401

@@ -1,0 +1,160 @@
+"""Sharded, optionally-async checkpointing over orbax.
+
+The reference's checkpoint story is single-writer files (SURVEY §5:
+"No async/sharded checkpoint" — mx.model.save_checkpoint and Gluon
+save_parameters serialize the full value from one process). On TPU pods
+that is the wrong shape twice over: parameters live sharded across the
+mesh (gathering to one host can exceed host RAM), and synchronous writes
+stall every chip for the IO. This module is the TPU-native upgrade:
+
+* each process writes only the shards it owns (orbax OCDBT format),
+* ``async_save`` returns as soon as device arrays are snapshotted —
+  training continues while the write completes in the background,
+* restore is sharding-aware: arrays come back distributed according to a
+  target block/TrainStep without materializing the full value per host.
+
+API mirrors the Gluon surface it augments::
+
+    from mxtpu.contrib import async_checkpoint as ackpt
+    mgr = ackpt.save_block(net, "/ckpt/dir", step=100, async_save=True)
+    mgr.wait_until_finished()        # or let the next save barrier
+    ackpt.load_block(net, "/ckpt/dir", step=100)
+
+The reference file formats (save_checkpoint / export) remain available
+for interchange; this is for large-scale training loops.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..base import MXNetError
+
+__all__ = ["save_block", "load_block", "save_train_step",
+           "load_train_step"]
+
+
+def _param_tree(block):
+    params = list(block.collect_params().values())
+    if any(p._data is None for p in params):
+        raise MXNetError("initialize the block before checkpointing")
+    tree = _keyed([p.data()._data for p in params])
+    if not tree:
+        raise MXNetError("block has no initialized parameters to checkpoint")
+    return tree
+
+
+_ASYNC_CKPTR = None  # ONE shared instance: orbax's save only barriers on
+# previous saves of the SAME AsyncCheckpointer, so per-call instances would
+# break the "next save waits" contract and leak background threads
+
+
+def _checkpointer(async_save):
+    import orbax.checkpoint as ocp
+    if async_save:
+        global _ASYNC_CKPTR
+        if _ASYNC_CKPTR is None:
+            import atexit
+            _ASYNC_CKPTR = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+            atexit.register(_ASYNC_CKPTR.close)  # drain pending writes
+        return _ASYNC_CKPTR
+    return ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+
+
+def _step_dir(directory, step):
+    import os
+    if "://" not in str(directory):  # URL-style (gs://, s3://) pass through
+        directory = os.path.abspath(directory)
+    return os.path.join(str(directory), "step_%d" % step)
+
+
+def _keyed(datas):
+    """THE positional-key scheme shared by every save/load here: gluon's
+    global name counters differ between runs (dense0 vs dense2), so
+    name-keyed trees would not match a freshly built model at restore."""
+    return {"p%d" % j: d for j, d in enumerate(datas)}
+
+
+def save_block(block, directory, step=0, async_save=False):
+    """Write the block's parameters sharded-per-process; returns the
+    checkpointer (call ``wait_until_finished()`` on async saves before
+    relying on the files)."""
+    ckptr = _checkpointer(async_save)
+    ckptr.save(_step_dir(directory, step), _param_tree(block), force=True)
+    return ckptr
+
+
+def load_block(block, directory, step=0):
+    """Restore parameters in place, preserving each parameter's CURRENT
+    sharding (restore is distributed: a host only reads its shards)."""
+    import orbax.checkpoint as ocp
+    params = list(block.collect_params().values())
+    targets = _keyed([jax.ShapeDtypeStruct(p.data()._data.shape,
+                                           p.data()._data.dtype,
+                                           sharding=p.data()._data.sharding)
+                      for p in params if p._data is not None])
+    ckptr = _checkpointer(async_save=False)
+    restored = ckptr.restore(
+        _step_dir(directory, step),
+        args=ocp.args.PyTreeRestore(
+            restore_args=jax.tree_util.tree_map(
+                lambda t: ocp.ArrayRestoreArgs(sharding=t.sharding,
+                                               global_shape=t.shape),
+                targets),
+            item=targets))
+    for j, p in enumerate(params):
+        key = "p%d" % j
+        if key in restored:
+            p.data()._set_data(restored[key])
+    return block
+
+
+def save_train_step(train_step, directory, step=0, async_save=False):
+    """Checkpoint a ShardedTrainStep: parameters AND optimizer state, each
+    written with its live sharding (ZeRO-1 state stays sharded on disk)."""
+    tree = {
+        "params": _keyed(train_step._param_datas),
+        "opt": {("p%d__%d" % (j, i)): s
+                for j, st in enumerate(train_step._opt_states)
+                for i, s in enumerate(st)},
+        "meta": {"num_update": train_step._num_update},
+    }
+    ckptr = _checkpointer(async_save)
+    ckptr.save(_step_dir(directory, step), tree, force=True)
+    return ckptr
+
+
+def load_train_step(train_step, directory, step=0):
+    """Restore a ShardedTrainStep in place with live shardings."""
+    import orbax.checkpoint as ocp
+
+    def _target(d):
+        return jax.ShapeDtypeStruct(d.shape, d.dtype, sharding=d.sharding)
+
+    targets = {
+        "params": _keyed([_target(d) for d in train_step._param_datas]),
+        "opt": {("p%d__%d" % (j, i)): _target(s)
+                for j, st in enumerate(train_step._opt_states)
+                for i, s in enumerate(st)},
+        "meta": {"num_update": 0},
+    }
+    ckptr = _checkpointer(async_save=False)
+    restored = ckptr.restore(
+        _step_dir(directory, step),
+        args=ocp.args.PyTreeRestore(
+            restore_args=jax.tree_util.tree_map(
+                lambda t: (ocp.ArrayRestoreArgs(sharding=t.sharding,
+                                                global_shape=t.shape)
+                           if hasattr(t, "sharding") and t.sharding
+                           else ocp.RestoreArgs()),
+                targets, is_leaf=lambda x: not isinstance(x, dict)),
+            item=targets))
+    new_datas = [restored["params"]["p%d" % j]
+                 for j in range(len(train_step._params))]
+    train_step._param_datas = new_datas
+    for p, d in zip(train_step._params, new_datas):
+        p.data()._set_data(d)
+    train_step._opt_states = [
+        tuple(restored["opt"]["p%d__%d" % (j, i)] for i in range(len(st)))
+        for j, st in enumerate(train_step._opt_states)]
+    train_step._num_update = int(restored["meta"]["num_update"])
+    return train_step

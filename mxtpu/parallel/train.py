@@ -198,7 +198,7 @@ class ShardedTrainStep:
             if not (shard_weight_update and t):
                 return p_sh
             ax = mesh.shape.get(data_axis, 1)
-            if (p_sh.spec == P() and d.ndim >= 1 and d.shape
+            if (p_sh.is_fully_replicated and d.ndim >= 1 and d.shape
                     and d.shape[0] % ax == 0 and ax > 1):
                 return NamedSharding(mesh, P(data_axis))
             return p_sh

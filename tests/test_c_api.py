@@ -231,7 +231,6 @@ def _reference_forward(prefix):
     programs' fixed input — the expectation both smoke tests check."""
     x = (np.arange(16, dtype=np.float32) % 5) * 0.25 - 0.5
     x = x.reshape(2, 8)
-    from mxtpu.gluon import SymbolBlock  # noqa: F401  (API surface check)
     from mxtpu import model as mxmodel
     sym, arg, aux = mxmodel.load_checkpoint(prefix, 0)
     exe_ = sym.bind(args={**arg, "data": mx.nd.array(x)}, aux_states=aux,
@@ -318,3 +317,8 @@ def test_cpp_frontend(lib, exported_model, tmp_path):
     ref = _reference_forward(prefix)
     got = [int(l.split("class")[1]) for l in lines[2:]]
     np.testing.assert_array_equal(got, ref.argmax(1))
+
+
+def test_symbolblock_importable():
+    """API-surface check (ref: gluon.SymbolBlock wraps exported symbols)."""
+    from mxtpu.gluon import SymbolBlock  # noqa: F401
