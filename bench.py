@@ -114,6 +114,14 @@ def bench_resnet50():
     shape = (batch, 224, 224, 3) if layout == "NHWC" else (batch, 3, 224, 224)
     x = mx.nd.array(np.random.uniform(-1, 1, size=shape), dtype="float32")
     net(x)  # settle deferred shapes
+    if os.environ.get("BENCH_S2D_STEM") == "1" and layout != "NHWC":
+        raise RuntimeError("BENCH_S2D_STEM=1 requires BENCH_LAYOUT=NHWC "
+                           "(refusing to report a plain-stem number as s2d)")
+    if os.environ.get("BENCH_S2D_STEM") == "1":
+        # MLPerf space-to-depth stem: exactly-equivalent 4x4 conv on 12
+        # channels instead of the MXU-hostile 7x7 on 3 (contrib/s2d_stem.py)
+        from mxtpu.contrib import s2d_stem
+        s2d_stem.apply_to_resnet(net)
     if dtype != "float32":
         net.cast(dtype)
         x = x.astype(dtype)

@@ -67,6 +67,32 @@ def _step_dir(directory, step):
     return os.path.join(str(directory), "step_%d" % step)
 
 
+def _meta_path(step_dir):
+    # sidecar lives NEXT TO the orbax dir, not inside it: async saves
+    # materialize the step dir atomically at finalize time, so a file
+    # written inside it would race/vanish
+    from etils import epath
+    p = epath.Path(step_dir)
+    return p.parent / (p.name + ".mxtpu_meta.json")
+
+
+def _write_meta(step_dir, meta):
+    import json
+    try:
+        import jax as _jax
+        if _jax.process_index() != 0:
+            return
+    except Exception:  # noqa: BLE001 - single-process fallback
+        pass
+    _meta_path(step_dir).write_text(json.dumps(meta))
+
+
+def _read_meta(step_dir):
+    import json
+    p = _meta_path(step_dir)
+    return json.loads(p.read_text()) if p.exists() else None
+
+
 def _keyed(datas):
     """THE positional-key scheme shared by every save/load here: gluon's
     global name counters differ between runs (dense0 vs dense2), so
@@ -88,10 +114,14 @@ def load_block(block, directory, step=0):
     sharding (restore is distributed: a host only reads its shards)."""
     import orbax.checkpoint as ocp
     params = list(block.collect_params().values())
+    if any(p._data is None for p in params):
+        # positional keys only align when BOTH sides enumerate every param
+        raise MXNetError("initialize the block (and settle deferred shapes) "
+                         "before load_block")
     targets = _keyed([jax.ShapeDtypeStruct(p.data()._data.shape,
                                            p.data()._data.dtype,
                                            sharding=p.data()._data.sharding)
-                      for p in params if p._data is not None])
+                      for p in params])
     ckptr = _checkpointer(async_save=False)
     restored = ckptr.restore(
         _step_dir(directory, step),
@@ -120,6 +150,11 @@ def save_train_step(train_step, directory, step=0, async_save=False):
     }
     ckptr = _checkpointer(async_save)
     ckptr.save(_step_dir(directory, step), tree, force=True)
+    # state-structure fingerprint as a sidecar (read BEFORE restore so a
+    # mismatched trainer gets a clear refusal, not an orbax tree error)
+    _write_meta(_step_dir(directory, step),
+                {"state_counts": [len(st)
+                                  for st in train_step._opt_states]})
     return ckptr
 
 
@@ -130,6 +165,15 @@ def load_train_step(train_step, directory, step=0):
     def _target(d):
         return jax.ShapeDtypeStruct(d.shape, d.dtype, sharding=d.sharding)
 
+    live_counts = [len(st) for st in train_step._opt_states]
+    meta = _read_meta(_step_dir(directory, step))
+    if meta is not None and meta.get("state_counts") != live_counts:
+        raise MXNetError(
+            "optimizer state structure mismatch: checkpoint has %s states "
+            "per param, this trainer expects %s — momentum/optimizer "
+            "settings must match the run that saved (silently dropping "
+            "state would fork the trajectory)"
+            % (meta.get("state_counts"), live_counts))
     targets = {
         "params": _keyed([_target(d) for d in train_step._param_datas]),
         "opt": {("p%d__%d" % (j, i)): _target(s)
@@ -137,17 +181,20 @@ def load_train_step(train_step, directory, step=0):
                 for i, s in enumerate(st)},
         "meta": {"num_update": 0},
     }
+    def _ra(t):
+        return ocp.ArrayRestoreArgs(sharding=t.sharding,
+                                    global_shape=t.shape)
+
+    restore_args = {
+        "params": {k: _ra(t) for k, t in targets["params"].items()},
+        "opt": {k: _ra(t) for k, t in targets["opt"].items()},
+        "meta": {"num_update": ocp.RestoreArgs()},
+    }
     ckptr = _checkpointer(async_save=False)
     restored = ckptr.restore(
         _step_dir(directory, step),
-        args=ocp.args.PyTreeRestore(
-            restore_args=jax.tree_util.tree_map(
-                lambda t: (ocp.ArrayRestoreArgs(sharding=t.sharding,
-                                                global_shape=t.shape)
-                           if hasattr(t, "sharding") and t.sharding
-                           else ocp.RestoreArgs()),
-                targets, is_leaf=lambda x: not isinstance(x, dict)),
-            item=targets))
+        args=ocp.args.PyTreeRestore(restore_args=restore_args,
+                                    item=targets))
     new_datas = [restored["params"]["p%d" % j]
                  for j in range(len(train_step._params))]
     train_step._param_datas = new_datas

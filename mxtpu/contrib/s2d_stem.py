@@ -1,0 +1,123 @@
+"""Space-to-depth stem transform for conv nets (the MLPerf ResNet trick).
+
+The first conv of ImageNet nets (7x7, stride 2, 3 input channels) wastes
+the MXU: 3 channels against the 8x128 tiling leaves most lanes idle. The
+standard fix reshapes the input into 2x2 blocks (224x224x3 -> 112x112x12)
+and runs an EXACTLY equivalent 4x4 stride-1 convolution whose weights are
+a zero-padded re-indexing of the original 7x7 kernel — same function, same
+gradients, 4x the input channels on the MXU.
+
+This implementation derives the 4x4 weights from the ORIGINAL 7x7
+parameter inside the traced forward (a scatter of 9,408 elements — free),
+so the wrapped model keeps its parameter structure: checkpoints
+round-trip, gradients flow to the original weight, and the transform can
+be toggled per run (bench: BENCH_S2D_STEM=1).
+
+Derivation (NHWC, block b=2, original stride 2 pad 3): output row y reads
+input rows R = 2y + k' for k' = ky-3 in [-3, 3]. With R = 2r + py,
+py = k' mod 2 and r = y + floor(k'/2) in [y-2, y+1] — a 4-tap kernel over
+s2d rows at stride 1 with padding (2, 1); columns identically. The s2d
+channel of (py, px, c) is (py*2 + px)*3 + c.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+
+__all__ = ["space_to_depth_nhwc", "embed_stem_weight", "SpaceToDepthStem",
+           "apply_to_resnet"]
+
+_B = 2  # block size of the transform (fixed by the stride-2 stem)
+
+
+def space_to_depth_nhwc(x):
+    """(N, H, W, C) -> (N, H/2, W/2, 4C), channel-major in (py, px)."""
+    n, h, w, c = x.shape
+    y = x.reshape(n, h // _B, _B, w // _B, _B, c)
+    y = y.transpose(0, 1, 3, 2, 4, 5)  # n, r, s, py, px, c
+    return y.reshape(n, h // _B, w // _B, _B * _B * c)
+
+
+def embed_stem_weight(w):
+    """Zero-embed a (7, 7, C, F) HWIO stem kernel into the equivalent
+    (4, 4, 4C, F) kernel for the s2d input (see module derivation)."""
+    kh, kw, c, f = w.shape
+    if (kh, kw) != (7, 7):
+        raise MXNetError("s2d stem embedding expects a 7x7 kernel, got %s"
+                         % ((kh, kw),))
+    out = jnp.zeros((4, 4, _B * _B * c, f), w.dtype)
+    for ky in range(7):
+        kyp = ky - 3
+        py = kyp % _B
+        a = (kyp - py) // _B + 2
+        for kx in range(7):
+            kxp = kx - 3
+            px = kxp % _B
+            b = (kxp - px) // _B + 2
+            ch = (py * _B + px) * c
+            out = out.at[a, b, ch:ch + c, :].set(w[ky, kx])
+    return out
+
+
+class _StemFn:
+    """Callable forward for the wrapped stem (kept tiny and pickle-free)."""
+
+    def __init__(self, weight_param, bias_param):
+        self._w = weight_param
+        self._b = bias_param
+
+    def __call__(self, x):
+        from ..ops.conv_acc import conv_fast
+        s = space_to_depth_nhwc(x)
+        w4 = embed_stem_weight(self._w)
+        out = conv_fast(s, w4, strides=(1, 1), padding=[(2, 1), (2, 1)],
+                        lhs_dilation=(1, 1), rhs_dilation=(1, 1),
+                        dims=("NHWC", "HWIO", "NHWC"), groups=1)
+        if self._b is not None:
+            out = out + self._b
+        return out
+
+
+def apply_to_resnet(net):
+    """Swap the stem Conv2D of an NHWC zoo resnet for the s2d-equivalent
+    path, in place. The conv's Parameters are untouched — only its forward
+    is re-routed — so checkpoints and trainers keep working. Returns net."""
+    feats = list(net.features._children.values())
+    conv = feats[0]
+    if type(conv).__name__ != "Conv2D":
+        raise MXNetError("expected the first feature block to be the stem "
+                         "Conv2D; got %s" % type(conv).__name__)
+    if getattr(conv, "_layout", None) not in ("NHWC",):
+        raise MXNetError("s2d stem transform supports NHWC nets (build the "
+                         "zoo model under mx.layout('NHWC'))")
+    # the derivation hardcodes the ImageNet stem: 7x7, stride 2, pad 3,
+    # no dilation/groups/activation — anything else would be silently
+    # transformed into a DIFFERENT function
+    bad = []
+    if tuple(getattr(conv, "_kwargs", {}).get("kernel", ())) != (7, 7):
+        bad.append("kernel != 7x7")
+    if tuple(conv._kwargs.get("stride", ())) != (2, 2):
+        bad.append("stride != 2")
+    if tuple(conv._kwargs.get("pad", ())) != (3, 3):
+        bad.append("pad != 3")
+    if tuple(conv._kwargs.get("dilate", (1, 1))) != (1, 1):
+        bad.append("dilate != 1")
+    if conv._kwargs.get("num_group", 1) != 1:
+        bad.append("grouped")
+    if getattr(conv, "act", None) is not None:
+        bad.append("fused activation")
+    if bad:
+        raise MXNetError("stem conv not s2d-transformable: %s"
+                         % ", ".join(bad))
+
+    from ..ndarray.ndarray import _apply
+
+    def hybrid_forward(self, F, x, weight=None, bias=None):
+        return _apply(
+            lambda xd, wd, *rest: _StemFn(wd, rest[0] if rest else None)(xd),
+            (x, weight) + (() if bias is None else (bias,)),
+            name="s2d_stem")
+
+    conv.hybrid_forward = hybrid_forward.__get__(conv, type(conv))
+    return net

@@ -179,6 +179,17 @@ class KVStore:
         from . import distributed
         distributed.barrier("mxtpu_kvstore_barrier")
 
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Failure-detection parity (ref: kvstore.h:353 — ps-lite heartbeat
+        dead-node counts). The TPU runtime has no heartbeat-and-continue
+        mode: XLA collectives FAIL FAST when a participant disappears (the
+        surviving processes get a hard error at the next collective, not a
+        degraded world), so while this process is alive the observable dead
+        count is 0 — recovery is checkpoint + restart, the same story as
+        the reference's distributed docs (SURVEY §5). Kept so monitoring
+        loops written against the reference run unmodified."""
+        return 0
+
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("there is no optimizer set, cannot save states")

@@ -59,3 +59,23 @@ def test_block_roundtrip(tmp_path):
     ackpt.load_block(net2, str(tmp_path), step=0)
     np.testing.assert_allclose(net2(x).asnumpy(), net(x).asnumpy(),
                                rtol=1e-6)
+
+
+def test_optimizer_structure_mismatch_refused(tmp_path):
+    """Restoring into a trainer with a different optimizer-state shape must
+    raise, not silently drop state (that would fork the trajectory)."""
+    net, x = _build()
+    y = mx.nd.array(np.zeros(16, np.float32))
+    mesh = make_mesh({"data": 8})
+    step = ShardedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), mesh,
+                            optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.1,
+                                              "momentum": 0.9})
+    step(x, y)
+    ackpt.save_train_step(step, str(tmp_path), step=1)
+    net2, _ = _build(seed=1)
+    momless = ShardedTrainStep(net2, gluon.loss.SoftmaxCrossEntropyLoss(),
+                               mesh, optimizer="sgd",
+                               optimizer_params={"learning_rate": 0.1})
+    with pytest.raises(mx.MXNetError, match="state structure mismatch"):
+        ackpt.load_train_step(momless, str(tmp_path), step=1)

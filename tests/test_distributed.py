@@ -182,3 +182,12 @@ def test_jax_private_probe_still_exists():
     jax upgrade fails HERE instead of silently flipping is_initialized."""
     from jax._src import xla_bridge
     assert callable(xla_bridge.backends_are_initialized)
+
+
+def test_get_num_dead_node_parity():
+    """ref kvstore.h:353 — monitoring loops written against the reference
+    must run unmodified; the TPU runtime fails fast instead of counting."""
+    import mxtpu as mx
+    kv = mx.kv.create("local")
+    assert kv.get_num_dead_node() == 0
+    assert kv.get_num_dead_node(node_id=3, timeout=1) == 0

@@ -1,0 +1,51 @@
+"""Space-to-depth stem transform (mxtpu/contrib/s2d_stem.py — the MLPerf
+ResNet trick): exact functional equivalence and gradient flow to the
+original 7x7 parameter."""
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+import mxtpu as mx
+from mxtpu import gluon
+from mxtpu.contrib.s2d_stem import (apply_to_resnet, embed_stem_weight,
+                                    space_to_depth_nhwc)
+
+
+def test_weight_embedding_exact():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 32, 32, 3), jnp.float32)
+    w = jnp.asarray(rng.randn(7, 7, 3, 8) * 0.1, jnp.float32)
+    ref = lax.conv_general_dilated(x, w, (2, 2), [(3, 3), (3, 3)],
+                                   dimension_numbers=("NHWC", "HWIO",
+                                                      "NHWC"))
+    out = lax.conv_general_dilated(
+        space_to_depth_nhwc(x), embed_stem_weight(w), (1, 1),
+        [(2, 1), (2, 1)], dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_zoo_resnet_transform_preserves_function_and_trains():
+    from mxtpu.gluon.model_zoo import vision
+    mx.random.seed(0)
+    with mx.layout("NHWC"):
+        net = vision.resnet18_v1(classes=10)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0)
+                    .uniform(-1, 1, (2, 224, 224, 3)).astype(np.float32))
+    y = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    ref = net(x).asnumpy()
+    apply_to_resnet(net)
+    np.testing.assert_allclose(net(x).asnumpy(), ref, rtol=2e-4, atol=2e-4)
+    # training still updates the ORIGINAL 7x7 stem weight
+    w = [p for n, p in net.collect_params().items()
+         if p.shape[:2] == (7, 7)][0]
+    before = w.data().asnumpy().copy()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    with mx.autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    tr.step(2)
+    assert np.abs(w.data().asnumpy() - before).sum() > 0
