@@ -78,12 +78,8 @@ def _meta_path(step_dir):
 
 def _write_meta(step_dir, meta):
     import json
-    try:
-        import jax as _jax
-        if _jax.process_index() != 0:
-            return
-    except Exception:  # noqa: BLE001 - single-process fallback
-        pass
+    if jax.process_index() != 0:  # one writer; returns 0 single-process
+        return
     _meta_path(step_dir).write_text(json.dumps(meta))
 
 
@@ -132,9 +128,7 @@ def load_block(block, directory, step=0):
                 targets),
             item=targets))
     for j, p in enumerate(params):
-        key = "p%d" % j
-        if key in restored:
-            p.data()._set_data(restored[key])
+        p.data()._set_data(restored["p%d" % j])
     return block
 
 

@@ -25,8 +25,7 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 
-__all__ = ["space_to_depth_nhwc", "embed_stem_weight", "SpaceToDepthStem",
-           "apply_to_resnet"]
+__all__ = ["space_to_depth_nhwc", "embed_stem_weight", "apply_to_resnet"]
 
 _B = 2  # block size of the transform (fixed by the stride-2 stem)
 

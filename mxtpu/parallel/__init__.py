@@ -20,6 +20,7 @@ no tensor/sequence/context parallelism) is first-class here:
 from .mesh import make_mesh, data_parallel_mesh
 from .train import ShardedTrainStep, pure_forward
 from .ring_attention import ring_attention, ring_self_attention
+from .pipeline import pipeline_apply
 
-__all__ = ["make_mesh", "data_parallel_mesh", "ShardedTrainStep",
+__all__ = ["make_mesh", "data_parallel_mesh", "ShardedTrainStep", "pipeline_apply",
            "pure_forward", "ring_attention", "ring_self_attention"]

@@ -243,3 +243,54 @@ def test_zero1_sharded_weight_update_matches_replicated():
                 assert m.sharding.spec[0] == "data"
     np.testing.assert_allclose(losses[False], losses[True], rtol=1e-5,
                                atol=1e-6)
+
+
+def test_pipeline_apply_matches_sequential_fwd_and_grad():
+    """GPipe-style pipeline over pipe x data (mxtpu/parallel/pipeline.py —
+    beyond-reference feature, SURVEY §2.3 'Parallelism NOT present'):
+    forward and grads must equal the sequential layer stack."""
+    from jax.sharding import Mesh
+    from mxtpu.parallel import pipeline_apply
+
+    rng = np.random.RandomState(0)
+    n_layers, d = 8, 16
+    params = {"w": jnp.asarray(rng.randn(n_layers, d, d) * 0.2, jnp.float32),
+              "b": jnp.asarray(rng.randn(n_layers, d) * 0.1, jnp.float32)}
+
+    def layer(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    x = jnp.asarray(rng.randn(32, d), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("pipe", "data"))
+
+    def seq(params, x):
+        h, _ = jax.lax.scan(lambda h, p: (layer(p, h), None), x, params)
+        return h
+
+    out = pipeline_apply(layer, params, x, mesh, axis="pipe",
+                         num_microbatches=8, batch_axis="data")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(seq(params, x)),
+                               rtol=1e-5, atol=1e-5)
+
+    g_pipe = jax.grad(lambda p: jnp.sum(pipeline_apply(
+        layer, p, x, mesh, axis="pipe", num_microbatches=8,
+        batch_axis="data") ** 2))(params)
+    g_seq = jax.grad(lambda p: jnp.sum(seq(p, x) ** 2))(params)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(g_pipe[k]),
+                                   np.asarray(g_seq[k]), rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_pipeline_apply_validations():
+    from jax.sharding import Mesh
+    from mxtpu.parallel import pipeline_apply
+
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("pipe", "data"))
+    params = {"w": jnp.zeros((6, 4, 4))}  # 6 layers over 4 stages: invalid
+    with pytest.raises(mx.MXNetError, match="must divide"):
+        pipeline_apply(lambda p, h: h, params, jnp.zeros((8, 4)), mesh)
+    params = {"w": jnp.zeros((4, 4, 4))}
+    with pytest.raises(mx.MXNetError, match="microbatches"):
+        pipeline_apply(lambda p, h: h, params, jnp.zeros((9, 4)), mesh,
+                       num_microbatches=4)
