@@ -1,0 +1,97 @@
+"""Mixture-of-experts with expert parallelism (the GSPMD MoE formulation).
+
+The reference has no MoE / expert parallelism (SURVEY §2.3 "Parallelism
+NOT present"). This is the TPU-native design: a switch (top-1) FFN layer
+expressed as dense einsums over a dispatch tensor — the GSPMD/Switch
+Transformer recipe — with the stacked expert weights sharded over an
+``expert`` mesh axis. Under ``jit`` on such a mesh, XLA lowers the
+dispatch/combine einsums to all-to-all collectives over ICI; on one device
+the same program is just dense math, so numerics are identical at any
+mesh size (tests prove parity against a per-token reference).
+
+Routing: top-1 with capacity. Each expert processes at most
+``C = ceil(T / E * capacity_factor)`` tokens; tokens over capacity are
+DROPPED (output zero, the standard Switch behavior — the residual path of
+the surrounding block carries them). The auxiliary load-balancing loss of
+Switch Transformer (mean fraction * mean router prob, scaled by E) is
+returned alongside the output (scaled by E, per the paper).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["switch_ffn", "shard_experts"]
+
+
+def switch_ffn(x, router_w, w1, b1, w2, b2, capacity_factor=1.25):
+    """Top-1 switch FFN layer.
+
+    Parameters
+    ----------
+    x : (T, D) tokens.
+    router_w : (D, E) router projection.
+    w1, b1 : (E, D, H), (E, H) — expert up-projections.
+    w2, b2 : (E, H, D), (E, D) — expert down-projections.
+    capacity_factor : per-expert capacity C = ceil(T/E * factor).
+
+    Returns ``(out, aux_loss)``: (T, D) combined expert outputs (dropped
+    tokens are zero) and the scalar load-balancing loss.
+    """
+    t, d = x.shape
+    e = router_w.shape[1]
+    cap = int(-(-t * capacity_factor // e))  # ceil
+
+    logits = x @ router_w                      # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = jnp.max(probs, axis=-1)             # (T,)
+    expert = jnp.argmax(probs, axis=-1)        # (T,)
+
+    # capacity: position of each token within its expert's queue
+    onehot = jax.nn.one_hot(expert, e, dtype=x.dtype)        # (T, E)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0          # (T, E)
+    keep = (pos >= 0) & (pos < cap)
+    pos_cap = jnp.where(keep, pos, 0).astype(jnp.int32)
+    slot = jax.nn.one_hot(jnp.sum(pos_cap, axis=-1), cap,
+                          dtype=x.dtype)                     # (T, C)
+    dispatch = (onehot * keep)[:, :, None] * slot[:, None, :]  # (T, E, C)
+
+    # dispatch -> expert batches (E, C, D): the all-to-all under GSPMD
+    xin = jnp.einsum("tec,td->ecd", dispatch, x)
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xin, w1) + b1[:, None, :])
+    xout = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+
+    combine = dispatch * gate[:, None, None]                 # (T, E, C)
+    out = jnp.einsum("tec,ecd->td", combine, xout)
+
+    # Switch aux loss: E * sum_e( fraction_e * mean_prob_e )
+    fraction = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(fraction * mean_prob)
+    return out, aux
+
+
+def shard_experts(params, mesh, num_experts, expert_axis="expert"):
+    """Place expert-stacked weights on the expert axis; everything else
+    (e.g. the router) replicated. A leaf is expert-stacked iff its leading
+    dim EQUALS ``num_experts`` — an explicit count, not a divisibility
+    heuristic, so a (D, E) router with D divisible by the axis can never
+    be mis-sharded over its feature dim."""
+    if expert_axis not in mesh.shape:
+        raise MXNetError("mesh has no %r axis; axes: %s"
+                         % (expert_axis, tuple(mesh.shape)))
+    size = mesh.shape[expert_axis]
+    if num_experts % size:
+        raise MXNetError("num_experts (%d) must divide over the %r axis "
+                         "(%d)" % (num_experts, expert_axis, size))
+
+    def place(leaf):
+        if leaf.ndim >= 2 and leaf.shape[0] == num_experts:
+            return jax.device_put(leaf,
+                                  NamedSharding(mesh, P(expert_axis)))
+        return jax.device_put(leaf, NamedSharding(mesh, P()))
+
+    return jax.tree_util.tree_map(place, params)

@@ -55,7 +55,7 @@ def pipeline_apply(layer_fn, stacked_params, x, mesh, axis="pipe",
     Returns the output of the full layer stack for the full batch, ordered
     like ``x``.
     """
-    from jax.experimental.shard_map import shard_map
+    shard_map = jax.shard_map
 
     n_stages = mesh.shape[axis]
     n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
@@ -114,7 +114,7 @@ def pipeline_apply(layer_fn, stacked_params, x, mesh, axis="pipe",
         stage_body, mesh=mesh,
         in_specs=(param_spec, xs_spec),
         out_specs=out_spec,
-        check_rep=False)
+        check_vma=False)
 
     xs = x.reshape((m, mb) + x.shape[1:])
     outs = shmapped(stacked_params, xs)  # (m, mb, ...)
