@@ -1,6 +1,6 @@
 """Contrib nn layers (ref: python/mxnet/gluon/contrib/nn/basic_layers.py)."""
 from .basic_layers import (Concurrent, HybridConcurrent, Identity,
-                           SparseEmbedding, SyncBatchNorm)
+                           SparseEmbedding, SwitchMoE, SyncBatchNorm)
 
 __all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
-           "SyncBatchNorm"]
+           "SyncBatchNorm", "SwitchMoE"]

@@ -5,7 +5,7 @@ from ...block import HybridBlock
 from ...nn import BatchNorm, HybridSequential, Embedding
 
 __all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
-           "SyncBatchNorm"]
+           "SyncBatchNorm", "SwitchMoE"]
 
 
 class Concurrent(HybridSequential):
@@ -81,3 +81,49 @@ class SyncBatchNorm(BatchNorm):
                          running_mean_initializer=running_mean_initializer,
                          running_variance_initializer=running_variance_initializer,
                          in_channels=in_channels, **kwargs)
+
+
+class SwitchMoE(HybridBlock):
+    """Top-1 switch mixture-of-experts FFN layer (no reference counterpart
+    — SURVEY §2.3 lists MoE/expert parallelism as absent upstream).
+
+    Wraps the registered ``_contrib_switch_moe`` op (mxtpu.parallel.moe
+    switch_ffn): router + E expert FFNs as dispatch/combine einsums so
+    GSPMD lowers routing to all-to-all when the expert weights live on an
+    ``expert`` mesh axis (place them with ``mxtpu.parallel.shard_experts``
+    or ShardedTrainStep param_specs).
+
+    Returns ``(out, aux_loss)`` — the Switch load-balancing loss is a REAL
+    second output (not a side-channel attribute), so it survives
+    hybridize()/export and its gradient flows when added to the objective.
+
+    Input (..., dim) is flattened to tokens and restored, so the layer
+    drops into transformer blocks shaped (batch, seq, dim).
+    """
+
+    def __init__(self, dim, hidden, num_experts, capacity_factor=1.25,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._dim, self._hidden = dim, hidden
+        self._num_experts = num_experts
+        self._capacity_factor = capacity_factor
+        with self.name_scope():
+            self.router = self.params.get("router", shape=(dim, num_experts))
+            self.w1 = self.params.get("w1", shape=(num_experts, dim, hidden))
+            self.b1 = self.params.get("b1", shape=(num_experts, hidden),
+                                      init="zeros")
+            self.w2 = self.params.get("w2", shape=(num_experts, hidden, dim))
+            self.b2 = self.params.get("b2", shape=(num_experts, dim),
+                                      init="zeros")
+
+    def hybrid_forward(self, F, x, router, w1, b1, w2, b2):
+        if x.shape[-1] != self._dim:
+            raise ValueError(
+                "SwitchMoE(dim=%d) got input with last axis %d"
+                % (self._dim, x.shape[-1]))
+        return F._contrib_switch_moe(x, router, w1, b1, w2, b2,
+                                     capacity_factor=self._capacity_factor)
+
+    def __repr__(self):
+        return "SwitchMoE(dim=%d, hidden=%d, experts=%d)" % (
+            self._dim, self._hidden, self._num_experts)

@@ -614,3 +614,16 @@ def Proposal(cls_prob, bbox_pred, im_info, **kwargs):
     from .registry import get_op
     return get_op("_contrib_MultiProposal").fn(cls_prob, bbox_pred, im_info,
                                                **kwargs)
+
+
+@register("_contrib_switch_moe", aliases=("switch_moe",), num_outputs=2)
+def switch_moe(data, router, w1, b1, w2, b2, capacity_factor=1.25):
+    """Top-1 switch MoE as a registered op (backs gluon.contrib.nn.SwitchMoE;
+    no reference counterpart — SURVEY §2.3 lists MoE as absent upstream).
+    data (..., D) is flattened to tokens; returns (out, aux_loss)."""
+    from ..parallel.moe import switch_ffn
+    dim = data.shape[-1]
+    toks = data.reshape(-1, dim)
+    out, aux = switch_ffn(toks, router, w1, b1, w2, b2,
+                          capacity_factor=capacity_factor)
+    return out.reshape(data.shape), aux

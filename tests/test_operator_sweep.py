@@ -668,6 +668,7 @@ COVERED_ELSEWHERE = {
     "_ravel_multi_index": "test_new_ops.py",
     "_unravel_index": "test_new_ops.py",
     "reshape_like": "test_new_ops.py",
+    "_contrib_switch_moe": "test_contrib.py",
 }
 
 
