@@ -22,7 +22,7 @@ from ..block import HybridBlock
 from .. import nn
 
 __all__ = ["TransformerLM", "TransformerBlock", "MultiHeadSelfAttention",
-           "tensor_parallel_rules"]
+           "tensor_parallel_rules", "expert_parallel_rules"]
 
 
 class MultiHeadSelfAttention(HybridBlock):
@@ -67,20 +67,32 @@ class TransformerBlock(HybridBlock):
     """Pre-norm block: x + attn(ln(x)); x + mlp(ln(x))."""
 
     def __init__(self, dim, num_heads, hidden_mult=4, mesh=None,
-                 seq_axis="sp", batch_axis="data", causal=True, **kwargs):
+                 seq_axis="sp", batch_axis="data", causal=True,
+                 num_experts=0, capacity_factor=1.25, **kwargs):
         super().__init__(**kwargs)
+        self._moe = num_experts > 0
         with self.name_scope():
             self.ln1 = nn.LayerNorm()
             self.attn = MultiHeadSelfAttention(
                 dim, num_heads, mesh=mesh, seq_axis=seq_axis,
                 batch_axis=batch_axis, causal=causal, prefix="attn_")
             self.ln2 = nn.LayerNorm()
-            self.fc1 = nn.Dense(hidden_mult * dim, flatten=False,
-                                activation="relu", prefix="mlp1_")
-            self.fc2 = nn.Dense(dim, flatten=False, prefix="mlp2_")
+            if self._moe:
+                from ..contrib.nn import SwitchMoE
+                self.moe = SwitchMoE(dim, hidden_mult * dim, num_experts,
+                                     capacity_factor=capacity_factor,
+                                     prefix="moe_")
+            else:
+                self.fc1 = nn.Dense(hidden_mult * dim, flatten=False,
+                                    activation="relu", prefix="mlp1_")
+                self.fc2 = nn.Dense(dim, flatten=False, prefix="mlp2_")
 
     def hybrid_forward(self, F, x):
         x = x + self.attn(self.ln1(x))
+        if self._moe:
+            out, aux = self.moe(self.ln2(x))
+            self._last_aux = aux  # summed by TransformerLM.aux_loss()
+            return x + out
         return x + self.fc2(self.fc1(self.ln2(x)))
 
 
@@ -94,7 +106,8 @@ class TransformerLM(HybridBlock):
 
     def __init__(self, vocab_size, dim=256, num_heads=8, num_layers=2,
                  max_len=2048, hidden_mult=4, mesh=None, seq_axis="sp",
-                 batch_axis="data", causal=True, **kwargs):
+                 batch_axis="data", causal=True, num_experts=0,
+                 capacity_factor=1.25, **kwargs):
         super().__init__(**kwargs)
         self._vocab = vocab_size
         self._max_len = max_len
@@ -107,7 +120,8 @@ class TransformerLM(HybridBlock):
                     self.blocks.add(TransformerBlock(
                         dim, num_heads, hidden_mult=hidden_mult, mesh=mesh,
                         seq_axis=seq_axis, batch_axis=batch_axis,
-                        causal=causal))
+                        causal=causal, num_experts=num_experts,
+                        capacity_factor=capacity_factor))
             self.ln_f = nn.LayerNorm()
             self.head = nn.Dense(vocab_size, use_bias=False, flatten=False,
                                  prefix="head_")
@@ -123,6 +137,23 @@ class TransformerLM(HybridBlock):
         x = self.blocks(x)
         return self.head(self.ln_f(x))
 
+    def aux_loss(self):
+        """Sum of the Switch load-balancing losses of this forward (MoE
+        blocks only; 0.0 for the dense model). Add scaled by your alpha.
+
+        Consume it in the SAME trace as the forward that produced it —
+        e.g. inside a ShardedTrainStep ``forward`` or an autograd.record
+        scope. Do NOT net.hybridize() the MoE variant and read aux_loss
+        afterwards: the compiled CachedOp returns only the logits, so the
+        attribute would hold a stale trace-time value (the SwitchMoE LAYER
+        returns (out, aux) explicitly for that usage instead)."""
+        total = None
+        for blk in self.blocks:
+            aux = getattr(blk, "_last_aux", None)
+            if aux is not None:
+                total = aux if total is None else total + aux
+        return 0.0 if total is None else total
+
 
 def tensor_parallel_rules(model_axis="model"):
     """PartitionSpec rules sharding the FLOP-heavy projections over the model
@@ -136,4 +167,16 @@ def tensor_parallel_rules(model_axis="model"):
         (r".*mlp2_weight", P(None, model_axis)),
         (r".*head_weight", P(model_axis, None)),
         (r".*wte_weight", P(None, model_axis)),
+    ]
+
+
+def expert_parallel_rules(expert_axis="expert"):
+    """PartitionSpec rules for the MoE variant (num_experts > 0): the
+    expert-stacked FFN weights shard on their leading E axis — GSPMD then
+    lowers the dispatch/combine einsums to all-to-all over the axis."""
+    return [
+        (r".*moe_w1", P(expert_axis)),
+        (r".*moe_b1", P(expert_axis)),
+        (r".*moe_w2", P(expert_axis)),
+        (r".*moe_b2", P(expert_axis)),
     ]

@@ -351,3 +351,44 @@ def test_switch_moe_capacity_drops_tokens():
                         jnp.zeros((4, 8)), capacity_factor=0.25)
     dropped = int((np.abs(np.asarray(out)).sum(1) == 0).sum())
     assert dropped > 0  # over-capacity tokens are zeroed (Switch semantics)
+
+
+def test_moe_transformer_lm_trains_expert_parallel():
+    """Zoo TransformerLM(num_experts=4) under an expert x data sharded
+    train step: the Switch aux loss joins the objective inside the trace
+    and the loss decreases."""
+    from mxtpu.gluon.model_zoo.transformer import (TransformerLM,
+                                                   expert_parallel_rules)
+
+    mx.random.seed(0)
+    vocab = 64
+    net = TransformerLM(vocab_size=vocab, dim=32, num_heads=4, num_layers=2,
+                        max_len=64, num_experts=4)
+    net.initialize()
+    rng = np.random.RandomState(0)
+    tokens = mx.nd.array(rng.randint(0, vocab, (4, 16)), dtype="int32")
+    labels = mx.nd.array(rng.randint(0, vocab, (4, 16)), dtype="float32")
+    net(tokens)
+    assert float(net.aux_loss().asnumpy()) >= 1.0  # eager aux available
+
+    loss_blk = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def forward(block, tokens, labels):
+        ce = loss_blk(block(tokens).reshape((-1, vocab)),
+                      labels.reshape((-1,)))
+        return ce + 0.01 * block.aux_loss()
+
+    mesh = make_mesh({"data": 2, "expert": 4})
+    step = ShardedTrainStep(net, None, mesh, optimizer="adam",
+                            optimizer_params={"learning_rate": 1e-3},
+                            param_specs=expert_parallel_rules("expert"),
+                            batch_specs=[P("data"), P("data")],
+                            forward=forward)
+    l1 = float(step(tokens, labels).asnumpy())
+    for _ in range(3):
+        l2 = float(step(tokens, labels).asnumpy())
+    assert l2 < l1
+    # the expert weights really live on the expert axis
+    moe_w1 = [d for p, d in zip(step._params, step._param_datas)
+              if p.name.endswith("moe_w1")]
+    assert moe_w1 and moe_w1[0].sharding.spec[0] == "expert"
