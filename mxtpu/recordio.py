@@ -63,18 +63,27 @@ class _PyReader:
     def __init__(self, path):
         self._f = open(path, "rb")
 
+    corrupt = False  # set when read() stops on damage rather than clean EOF
+
     def read(self):
         out = b""
         started = False
         while True:
             head = self._f.read(8)
+            if len(head) == 0 and not started:
+                return None  # clean EOF at a record boundary
             if len(head) < 8:
+                self.corrupt = True  # truncated mid-header
                 return None
             magic, lrec = struct.unpack("<II", head)
             if magic != _MAGIC:
+                self.corrupt = True  # lost sync
                 return None
             length, cflag = lrec & ((1 << 29) - 1), lrec >> 29
             data = self._f.read(length)
+            if len(data) < length:
+                self.corrupt = True  # truncated mid-payload: NOT a record
+                return None
             pad = (4 - (length & 3)) & 3
             if pad:
                 self._f.read(pad)

@@ -288,3 +288,48 @@ def test_image_det_iter():
     _, flipped = flip(img, objs)
     np.testing.assert_allclose(flipped[0], [1.0, 0.5, 0.2, 0.9, 0.6],
                                atol=1e-6)
+
+
+def test_rec2idx_rebuilds_index(tmp_path):
+    """tools/rec2idx.py (ref tools/rec2idx.py): a rebuilt .idx must make
+    the pack readable by key through MXIndexedRecordIO."""
+    import os
+    import sys
+    from mxtpu.recordio import MXIndexedRecordIO, MXRecordIO
+
+    rec = str(tmp_path / "pack.rec")
+    w = MXRecordIO(rec, "w")
+    payloads = [("rec%03d" % i).encode() * (i + 1) for i in range(7)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import rec2idx
+    idx_path, n = rec2idx.build_index(rec)
+    assert n == 7
+    r = MXIndexedRecordIO(idx_path, rec, "r")
+    for i in (0, 3, 6):
+        assert r.read_idx(i) == payloads[i]
+    r.close()
+
+
+def test_rec2idx_refuses_truncated_pack(tmp_path):
+    import os
+    import sys
+    import pytest
+    from mxtpu.recordio import MXRecordIO
+
+    rec = str(tmp_path / "trunc.rec")
+    w = MXRecordIO(rec, "w")
+    for i in range(5):
+        w.write(b"x" * 100)
+    w.close()
+    with open(rec, "r+b") as f:  # chop mid-record
+        f.truncate(os.path.getsize(rec) - 37)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import rec2idx
+    with pytest.raises(RuntimeError, match="corrupt/truncated"):
+        rec2idx.build_index(rec)

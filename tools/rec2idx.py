@@ -1,0 +1,51 @@
+"""Rebuild the .idx file for an existing RecordIO pack
+(ref: tools/rec2idx.py — needed to use a .rec with MXIndexedRecordIO /
+ImageRecordIter when the index was lost or never written).
+
+Usage: python tools/rec2idx.py data.rec [data.idx]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_index(rec_path, idx_path=None):
+    from mxtpu.recordio import MXRecordIO
+
+    idx_path = idx_path or os.path.splitext(rec_path)[0] + ".idx"
+    reader = MXRecordIO(rec_path, "r")
+    n = 0
+    with open(idx_path, "w") as f:
+        while True:
+            pos = reader.tell()
+            if reader.read() is None:
+                break
+            f.write("%d\t%d\n" % (n, pos))
+            n += 1
+    reader.close()
+    size = os.path.getsize(rec_path)
+    # readers return None for lost-sync/truncation exactly as for EOF; the
+    # distinguishing fact is WHERE the failed read began — a clean EOF
+    # starts exactly at the end of the file. A partial index over a corrupt
+    # pack must not look like success.
+    if pos < size:
+        raise RuntimeError(
+            "pack %s: record at byte %d of %d unreadable (corrupt/"
+            "truncated?) — index covering only the first %d records was "
+            "left at %s for inspection" % (rec_path, pos, size, n, idx_path))
+    return idx_path, n
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print(__doc__)
+        return 1
+    idx_path, n = build_index(argv[0], argv[1] if len(argv) > 1 else None)
+    print("wrote %s (%d records)" % (idx_path, n))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
