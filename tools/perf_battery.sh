@@ -1,0 +1,39 @@
+#!/bin/bash
+# The ordered on-chip measurement backlog (PERF.md "staged levers").
+# Run FIRST THING in a session with a healthy chip; each step is
+# independently useful and the order front-loads the headline numbers.
+# Serialize: never run two TPU processes at once (see PERF.md outage note).
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-perf_battery.log}
+run() {
+  echo "=== $* ($(date +%H:%M:%S)) ===" | tee -a "$LOG"
+  timeout "${STEP_TIMEOUT:-1200}" "$@" 2>&1 | grep -v WARNING | tee -a "$LOG"
+}
+
+# 0. is the chip alive? (90s; bail early if wedged)
+timeout 90 python -c "
+import jax, jax.numpy as jnp, numpy as np
+np.asarray(jax.device_get(jax.jit(lambda v: v+1)(jnp.ones(2))))
+print('chip alive')" || { echo "CHIP WEDGED — aborting battery"; exit 1; }
+
+# 1. headline: resnet50 with the f32-accumulate conv path (round-3 change)
+BENCH_CONFIG=resnet50 run python bench.py
+
+# 2. the space-to-depth stem variant (exactly-equivalent; compare to #1)
+BENCH_CONFIG=resnet50 BENCH_S2D_STEM=1 run python bench.py
+
+# 3. localize the slow forward (stage-by-stage attribution)
+run env PYTHONPATH=.:tools:/root/.axon_site python tools/perf_stages.py
+
+# 4. all scoring configs (lstm/bert should gain from dot f32-accumulate)
+run python bench.py
+
+# 5. validate the ceiling numbers post-fix
+run env PYTHONPATH=.:tools:/root/.axon_site python tools/perf_peak.py
+run env PYTHONPATH=.:tools:/root/.axon_site python tools/perf_conv_acc.py
+
+# 6. zoo inference scoring sweep (reference benchmark_score tables)
+BENCH_BATCHES=1,32,128 run python tools/benchmark_score.py
+
+echo "battery complete -> $LOG"
