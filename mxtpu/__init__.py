@@ -9,16 +9,27 @@ collectives over the device mesh. See SURVEY.md at the repo root for the layer m
 Use ``import mxtpu as mx`` — the namespace mirrors ``import mxnet as mx``.
 """
 
+import os as _os
+
 import jax as _jax
 
 # float32 contractions stay honest f32 (without this, JAX's default silently
 # downcasts f32 matmuls to one-pass bf16, breaking reference-parity numerics —
 # MXNet computes f32 in f32). bfloat16 contractions do NOT inherit this
 # global: every op passes an explicit per-operand override
-# (mxtpu/ops/precision_util.py) so bf16 runs the native one-pass MXU path —
-# inheriting HIGHEST here made bf16 convs run 3-6x-slower f32 emulation,
-# the round-1/2 throughput ceiling (PERF.md).
+# (mxtpu/ops/precision_util.py) choosing DEFAULT precision plus an f32
+# accumulator output — the measured-fastest MXU schedule (PERF.md; the
+# earlier claim that HIGHEST-on-bf16 cost 3-6x was retracted there).
 _jax.config.update("jax_default_matmul_precision", "float32")
+
+# persistent compilation cache (MXTPU_COMPILE_CACHE=<dir>): first compiles
+# through the TPU tunnel take minutes; caching across processes makes
+# repeated bench/tool runs start warm. Opt-in — the default jax in-process
+# cache already covers single-process reuse.
+_cache_dir = _os.environ.get("MXTPU_COMPILE_CACHE")
+if _cache_dir:
+    _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 from . import base
 from .base import Context, MXNetError, cpu, current_context, gpu, num_gpus, tpu

@@ -6,6 +6,8 @@
 set -u
 cd "$(dirname "$0")/.."
 LOG=${1:-perf_battery.log}
+# warm compiles across the battery's processes (tunnel compiles cost minutes)
+export MXTPU_COMPILE_CACHE=${MXTPU_COMPILE_CACHE:-/tmp/mxtpu_compile_cache}
 run() {
   echo "=== $* ($(date +%H:%M:%S)) ===" | tee -a "$LOG"
   timeout "${STEP_TIMEOUT:-1200}" "$@" 2>&1 | grep -v WARNING | tee -a "$LOG"
