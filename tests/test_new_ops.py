@@ -244,3 +244,52 @@ def test_deformable_conv_fractional_border_fades_to_zero():
     out2 = DeformableConvolution(jnp.asarray(x), jnp.asarray(off), wt,
                                  kernel=(1, 1), num_filter=1, no_bias=True)
     np.testing.assert_allclose(out2.asnumpy()[0, 0, 0], [0.0] * 4, atol=1e-6)
+
+
+def _greedy_nms_oracle(boxes_scores, thresh):
+    """O(n^2) python greedy NMS: returns indices kept, in score order."""
+    idx = np.argsort(-boxes_scores[:, 0])
+    kept = []
+    for i in idx:
+        si, bi = boxes_scores[i, 0], boxes_scores[i, 1:]
+        if si <= 0:
+            continue
+        ok = True
+        for j in kept:
+            bj = boxes_scores[j, 1:]
+            tl = np.maximum(bi[:2], bj[:2])
+            br = np.minimum(bi[2:], bj[2:])
+            wh = np.maximum(br - tl, 0)
+            inter = wh[0] * wh[1]
+            area_i = (bi[2] - bi[0]) * (bi[3] - bi[1])
+            area_j = (bj[2] - bj[0]) * (bj[3] - bj[1])
+            iou = inter / max(area_i + area_j - inter, 1e-12)
+            if iou > thresh:
+                ok = False
+                break
+        if ok:
+            kept.append(i)
+    return set(kept)
+
+
+def test_box_nms_matches_bruteforce_oracle():
+    """The fixed-iteration lax NMS must keep exactly the boxes an O(n^2)
+    python greedy reference keeps, across random inputs."""
+    from mxtpu.ops.contrib_ops import box_nms
+
+    rng = np.random.RandomState(0)
+    for trial in range(5):
+        n = 24
+        xy = rng.uniform(0, 8, (n, 2))
+        wh = rng.uniform(0.5, 4, (n, 2))
+        scores = rng.uniform(0.01, 1, (n, 1))
+        data = np.concatenate(
+            [np.zeros((n, 1)), scores, xy, xy + wh], 1).astype(np.float32)
+        out = box_nms(jnp.asarray(data), overlap_thresh=0.5,
+                      valid_thresh=0.0, coord_start=2,
+                      score_index=1).asnumpy()
+        kept_scores = sorted(s for s in out[:, 1] if s >= 0)
+        oracle = _greedy_nms_oracle(data[:, 1:6], 0.5)
+        oracle_scores = sorted(data[j, 1] for j in oracle)
+        np.testing.assert_allclose(kept_scores, oracle_scores, rtol=1e-6,
+                                   err_msg="trial %d" % trial)
