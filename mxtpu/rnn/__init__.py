@@ -2,8 +2,14 @@
 
 The legacy symbol-composing cells are superseded by gluon.rnn cells (which
 trace to compiled graphs via hybridize — the TPU-native path); they are
-re-exported here under the legacy names for API familiarity. The data-side
-utilities (BucketSentenceIter, encode_sentences) are full ports.
+re-exported here under the legacy names for API familiarity and operate on
+NDArrays/hybrid blocks, NOT on Symbols (cell.unroll needs static input
+shapes). Symbolic RNN graphs — e.g. BucketingModule sym_gen — use the
+fused ``mx.sym.RNN`` op instead, whose packed-parameter/state shapes are
+backward-filled by shape inference (tests/test_module.py
+test_bucketing_module_trains_over_bucket_sentence_iter shows the
+pattern). The data-side utilities (BucketSentenceIter, encode_sentences)
+are full ports.
 """
 from ..gluon.rnn.rnn_cell import (BidirectionalCell, DropoutCell, GRUCell,
                                   LSTMCell, ModifierCell, RNNCell,
