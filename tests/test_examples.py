@@ -53,3 +53,29 @@ def test_sparse_linear_classification():
     result = m.train(path, 100, batch_size=16, epochs=2)
     acc = result[0]
     assert acc > 0.5
+
+
+def test_distributed_example_two_workers():
+    """examples/distributed/train_dist.py through tools/launch.py -n 2:
+    the symmetric multi-process path a reference dist_sync user follows
+    (also guards the launcher's axon-env scrubbing for CPU workers)."""
+    import signal
+    import subprocess
+    # own session so a timeout can kill the whole process GROUP — otherwise
+    # hung grandchild workers outlive the test holding the coordinator port
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"), "-n", "2",
+         sys.executable, os.path.join(ROOT, "examples", "distributed",
+                                      "train_dist.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=420)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait()
+        raise
+    r = subprocess.CompletedProcess(proc.args, proc.returncode, out, err)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "workers=2" in r.stdout
+    assert "exported checkpoint" in r.stdout

@@ -35,8 +35,11 @@ def _free_port():
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", "--num-workers", type=int, required=True)
-    ap.add_argument("--cpu", action="store_true", default=True,
-                    help="force JAX_PLATFORMS=cpu in workers (default)")
+    ap.add_argument("--cpu", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="force JAX_PLATFORMS=cpu in workers (default; "
+                         "--no-cpu lets workers use the accelerator — only "
+                         "sane when each process owns its own device)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
@@ -59,12 +62,30 @@ def main():
         })
         if args.cpu:
             env["JAX_PLATFORMS"] = "cpu"
+            # the axon sitecustomize activates on PALLAS_AXON_POOL_IPS and
+            # programmatically overrides JAX_PLATFORMS — CPU workers must
+            # not inherit it, or N processes dial the one TPU (and hang
+            # outright when the tunnel is wedged)
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         procs.append(subprocess.Popen(args.command, env=env))
 
     rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
+    try:
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+            if rc:
+                break  # one worker failed: take the rest down (a partial
+                       # world would hang in the next collective anyway)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
     sys.exit(rc)
 
 
