@@ -1,24 +1,53 @@
 """Test environment: force an 8-device virtual CPU mesh before jax initializes,
 mirroring SURVEY §4's implication — multi-chip collective tests must run on a single
-host the way the reference runs multi-process localhost PS tests."""
+host the way the reference runs multi-process localhost PS tests.
+
+Cross-device tier (the reference's tests/python/gpu/test_operator_gpu.py
+pattern — the WHOLE op suite re-run against the accelerator): set
+``MXTPU_TEST_PLATFORM=tpu`` to leave the real backend active instead of
+the hermetic CPU mesh. Tests requiring >1 device are skipped there (one
+chip); everything else exercises the identical code paths on real
+hardware. Usage: ``MXTPU_TEST_PLATFORM=tpu python -m pytest
+tests/test_operator.py tests/test_operator_sweep.py ...``.
+"""
 import os
 
-# the environment presets JAX_PLATFORMS=axon (the TPU tunnel); tests force CPU so
-# the suite is hermetic and the 8-device virtual mesh is available. The axon
-# sitecustomize calls jax config programmatically (jax_platforms='axon,cpu'),
-# which overrides the env var — so the config must be updated via jax.config,
-# not os.environ.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+_PLATFORM = os.environ.get("MXTPU_TEST_PLATFORM", "cpu")
+
+if _PLATFORM == "cpu":
+    # the environment presets JAX_PLATFORMS=axon (the TPU tunnel); tests
+    # force CPU so the suite is hermetic and the 8-device virtual mesh is
+    # available. The axon sitecustomize calls jax config programmatically
+    # (jax_platforms='axon,cpu'), which overrides the env var — so the
+    # config must be updated via jax.config, not os.environ.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if _PLATFORM == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    if _PLATFORM == "cpu":
+        return
+    # accelerator tier: a single real chip — skip tests that need the
+    # multi-device mesh or spawn their own multi-process world
+    multi = pytest.mark.skip(
+        reason="needs the 8-device virtual CPU mesh (MXTPU_TEST_PLATFORM)")
+    needs_mesh = ("parallel", "distributed", "multichip", "sharded",
+                  "zero1", "mesh", "ring")
+    for item in items:
+        name = item.nodeid.lower()
+        if any(k in name for k in needs_mesh):
+            item.add_marker(multi)
 
 
 @pytest.fixture(autouse=True)
