@@ -79,6 +79,8 @@ from . import visualization
 from . import visualization as viz  # mx.viz alias
 from . import kvstore_server
 from . import executor_manager
+from . import log
+from . import torch_interop
 # reference import hook (kvstore_server.py:75): a DMLC_ROLE=server process
 # must fail fast with the migration note, not silently join as a worker
 kvstore_server._init_kvstore_server_module()

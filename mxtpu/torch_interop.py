@@ -1,0 +1,70 @@
+"""torch interop (ref: plugin/torch + the reference's dlpack bridge,
+include/mxnet/tensor_blob.h dlpack fields).
+
+The reference bridged Torch7 kernels through a plugin; the modern
+equivalent is array interchange:
+
+    t = mxtpu.torch_interop.to_torch(nd_array)      # torch.Tensor
+    a = mxtpu.torch_interop.from_torch(tensor)      # mxtpu NDArray
+
+Both directions COPY. Zero-copy DLPack aliasing is deliberately not used:
+jax buffers are immutable by contract, so handing torch a writable view
+(or aliasing a mutable torch tensor into jax) lets an in-place
+``tensor.fill_`` silently change values a jit trace already captured —
+wrong numerics with no error. dtype is preserved, including bfloat16
+(staged through DLPack on a contiguous clone; numpy cannot carry bf16).
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["to_torch", "from_torch"]
+
+
+def _torch():
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover - torch is in this image
+        raise MXNetError("torch is not installed") from e
+    return torch
+
+
+def to_torch(arr):
+    """NDArray -> torch.Tensor (an owned copy, dtype preserved)."""
+    torch = _torch()
+    if not isinstance(arr, NDArray):
+        raise MXNetError("to_torch expects an NDArray, got %s" % type(arr))
+    data = arr._data
+    try:
+        # DLPack carries every dtype incl. bf16; clone() makes it an owned
+        # copy so the immutable jax buffer is never exposed writable
+        import jax
+        host = jax.device_get(data)  # numpy-backed or jax cpu array
+        return torch.from_dlpack(jax.numpy.asarray(host)).clone()
+    except Exception:  # noqa: BLE001 - fall back through numpy (no bf16)
+        import numpy as np
+        t = torch.from_numpy(arr.asnumpy()).clone()
+        want = str(data.dtype)
+        if want == "bfloat16":
+            t = t.to(torch.bfloat16)
+        return t
+
+
+def from_torch(tensor):
+    """torch.Tensor -> NDArray (an owned copy, dtype preserved)."""
+    torch = _torch()
+    if not isinstance(tensor, torch.Tensor):
+        raise MXNetError("from_torch expects a torch.Tensor, got %s"
+                         % type(tensor))
+    import jax.numpy as jnp
+    t = tensor.detach().contiguous().cpu()
+    try:
+        # from_dlpack then copy via jnp.array: dtype-exact (incl. bf16),
+        # and the copy severs the alias to torch's mutable memory
+        return NDArray(jnp.array(jnp.from_dlpack(t)))
+    except Exception:  # noqa: BLE001 - exotic dtype/layout: numpy staging
+        if t.dtype == torch.bfloat16:
+            return NDArray(jnp.asarray(t.to(torch.float32).numpy())
+                           .astype(jnp.bfloat16))
+        return NDArray(jnp.asarray(t.numpy()))

@@ -1,0 +1,67 @@
+"""mx.log + torch interop (ref: python/mxnet/log.py, plugin/torch)."""
+import numpy as np
+
+import mxtpu as mx
+
+
+def test_log_getLogger(tmp_path, capsys):
+    logger = mx.log.get_logger("t1", level=mx.log.INFO)
+    logger.info("hello %d", 7)
+    assert mx.log.get_logger("t1") is logger  # idempotent
+    f = tmp_path / "x.log"
+    flog = mx.log.get_logger("t2", filename=str(f), level=mx.log.DEBUG)
+    flog.warning("to file")
+    for h in flog.handlers:
+        h.flush()
+    assert "to file" in f.read_text()
+
+
+def test_torch_roundtrip():
+    import torch
+    from mxtpu.torch_interop import from_torch, to_torch
+
+    a = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    t = to_torch(a)
+    assert isinstance(t, torch.Tensor)
+    np.testing.assert_array_equal(t.numpy(), a.asnumpy())
+
+    src = torch.arange(6, dtype=torch.float32).reshape(2, 3) * 0.5
+    b = from_torch(src)
+    np.testing.assert_array_equal(b.asnumpy(), src.numpy())
+    # ops compose on the converted array
+    np.testing.assert_allclose((b + b).asnumpy(), src.numpy() * 2)
+    # non-contiguous tensors still convert (copy path)
+    nc = src.t()
+    c = from_torch(nc)
+    np.testing.assert_array_equal(c.asnumpy(), nc.numpy())
+
+
+def test_from_torch_copies_and_handles_bf16():
+    import torch
+    from mxtpu.torch_interop import from_torch, to_torch
+
+    # COPY semantics: in-place torch mutation must NOT leak into the array
+    src = torch.zeros(3)
+    b = from_torch(src)
+    src.fill_(7)
+    np.testing.assert_array_equal(b.asnumpy(), [0, 0, 0])
+
+    # bf16 both ways, incl. the non-contiguous path that numpy can't carry
+    tb = torch.arange(6, dtype=torch.bfloat16).reshape(2, 3).t()
+    c = from_torch(tb)
+    assert str(c.dtype) == "bfloat16"
+    np.testing.assert_array_equal(c.asnumpy(),
+                                  tb.to(torch.float32).numpy())
+    a = mx.nd.array(np.ones((2, 2), np.float32)).astype("bfloat16")
+    t = to_torch(a)
+    assert t.dtype == torch.bfloat16
+    # and to_torch results are owned: mutating them leaves the array alone
+    t.fill_(5)
+    np.testing.assert_array_equal(a.asnumpy(), np.ones((2, 2)))
+
+
+def test_log_root_untouched():
+    import logging
+    n_before = len(logging.getLogger().handlers)
+    mx.log.get_logger()  # name=None: must not install a root handler
+    assert len(logging.getLogger().handlers) == n_before
