@@ -85,8 +85,18 @@ def _write_meta(step_dir, meta):
 
 def _read_meta(step_dir):
     import json
+    from etils import epath
     p = _meta_path(step_dir)
-    return json.loads(p.read_text()) if p.exists() else None
+    if not p.exists():
+        return None
+    if not epath.Path(step_dir).exists():
+        # sidecar without a finalized orbax dir: either an async save died
+        # mid-write (orphan) or one is still in flight — in both cases the
+        # fingerprint must not be trusted yet. Tolerate, do NOT delete:
+        # unlinking here would race an in-flight save and strip a valid
+        # checkpoint of its fingerprint.
+        return None
+    return json.loads(p.read_text())
 
 
 def _keyed(datas):
@@ -145,7 +155,11 @@ def save_train_step(train_step, directory, step=0, async_save=False):
     ckptr = _checkpointer(async_save)
     ckptr.save(_step_dir(directory, step), tree, force=True)
     # state-structure fingerprint as a sidecar (read BEFORE restore so a
-    # mismatched trainer gets a clear refusal, not an orbax tree error)
+    # mismatched trainer gets a clear refusal, not an orbax tree error).
+    # For async saves the orbax dir may not exist yet when this is written;
+    # _read_meta treats a sidecar whose step dir is absent as an orphan
+    # (deleted on read), so a crashed background write cannot leave a
+    # misleading fingerprint behind.
     _write_meta(_step_dir(directory, step),
                 {"state_counts": [len(st)
                                   for st in train_step._opt_states]})

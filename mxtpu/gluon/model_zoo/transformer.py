@@ -148,10 +148,28 @@ class TransformerLM(HybridBlock):
         attribute would hold a stale trace-time value (the SwitchMoE LAYER
         returns (out, aux) explicitly for that usage instead)."""
         total = None
+        any_moe = False
         for blk in self.blocks:
+            any_moe = any_moe or getattr(blk, "_moe", False)
             aux = getattr(blk, "_last_aux", None)
             if aux is not None:
                 total = aux if total is None else total + aux
+        from ..block import _IN_TRACE, _active_trace
+        if (any_moe and getattr(self, "_active", False)
+                and _active_trace() is None and _IN_TRACE.active == 0):
+            # compiled CachedOp forwards never refresh _last_aux — reading
+            # it here would silently return the trace-time constant. Inside
+            # an active trace forward() bypasses the CachedOp (block.py),
+            # so _last_aux IS fresh there and reading it is supported.
+            raise MXNetError(
+                "aux_loss() on a hybridized MoE TransformerLM would return "
+                "a stale trace-time value; compute the loss inside the "
+                "traced forward (use the SwitchMoE layer's (out, aux) "
+                "return) or call aux_loss() before hybridize()")
+        if any_moe and total is None:
+            raise MXNetError(
+                "aux_loss() before any forward: no load-balancing loss has "
+                "been recorded yet")
         return 0.0 if total is None else total
 
 

@@ -282,6 +282,9 @@ class ImageIter(DataIter):
             raise MXNetError("ImageIter: unknown options %s (augmenter "
                              "options: %s)" % (sorted(unknown),
                                                ", ".join(aug_keys)))
+        if aug_list is not None and kwargs:
+            raise MXNetError("aug_list given; augmenter kwargs %s would be "
+                             "ignored" % sorted(kwargs))
         self.auglist = aug_list if aug_list is not None else \
             CreateAugmenter(data_shape, **{k: v for k, v in kwargs.items()
                                            if k in aug_keys})

@@ -35,18 +35,23 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "multidevice: needs the 8-device virtual CPU mesh or spawns a "
+        "multi-process world; skipped on the single-chip TPU tier")
+
+
 def pytest_collection_modifyitems(config, items):
     if _PLATFORM == "cpu":
         return
-    # accelerator tier: a single real chip — skip tests that need the
-    # multi-device mesh or spawn their own multi-process world
+    # accelerator tier: a single real chip — skip tests explicitly marked
+    # as needing the multi-device mesh (a name-substring heuristic used
+    # here previously wrongly matched e.g. test_orde[ring])
     multi = pytest.mark.skip(
         reason="needs the 8-device virtual CPU mesh (MXTPU_TEST_PLATFORM)")
-    needs_mesh = ("parallel", "distributed", "multichip", "sharded",
-                  "zero1", "mesh", "ring")
     for item in items:
-        name = item.nodeid.lower()
-        if any(k in name for k in needs_mesh):
+        if item.get_closest_marker("multidevice") is not None:
             item.add_marker(multi)
 
 

@@ -20,6 +20,7 @@ def _build(seed=0):
     return net, x
 
 
+@pytest.mark.multidevice
 def test_train_step_roundtrip_with_zero1_state(tmp_path):
     net, x = _build()
     y = mx.nd.array(np.random.RandomState(1).randint(0, 8, (16,))
@@ -61,6 +62,7 @@ def test_block_roundtrip(tmp_path):
                                rtol=1e-6)
 
 
+@pytest.mark.multidevice
 def test_optimizer_structure_mismatch_refused(tmp_path):
     """Restoring into a trainer with a different optimizer-state shape must
     raise, not silently drop state (that would fork the trajectory)."""

@@ -17,6 +17,8 @@ import textwrap
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.multidevice
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = textwrap.dedent("""

@@ -55,6 +55,7 @@ def test_sparse_linear_classification():
     assert acc > 0.5
 
 
+@pytest.mark.multidevice
 def test_distributed_example_two_workers():
     """examples/distributed/train_dist.py through tools/launch.py -n 2:
     the symmetric multi-process path a reference dist_sync user follows

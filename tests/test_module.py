@@ -143,6 +143,7 @@ def test_ndarray_iter_pad_and_shuffle():
     assert len(list(it2)) == 2
 
 
+@pytest.mark.multidevice
 def test_module_on_mesh_matches_single_device():
     """Module(context=Mesh) runs the classic fit loop data-parallel over the
     mesh (the reference's DataParallelExecutorGroup role) with identical

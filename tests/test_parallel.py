@@ -14,6 +14,8 @@ from mxtpu.parallel import (ShardedTrainStep, data_parallel_mesh, make_mesh,
                             pure_forward, ring_self_attention)
 from mxtpu.parallel.ring_attention import _dense_attention
 
+pytestmark = pytest.mark.multidevice
+
 
 def test_make_mesh():
     mesh = make_mesh({"data": 2, "sp": 2, "model": 2})
