@@ -65,8 +65,14 @@ def _run(step, batch, n_items, model_flops_per_item=None):
         # framework's own profiler (mxtpu/profiler.py ~ src/profiler/
         # profiler.h) — profile_xla owns the jax start/stop_trace pair
         from mxtpu import profiler as _prof
+        # capture bound: the whole timed region, not the 120 s default —
+        # a truncated trace would silently misattribute the step time
+        trace_max = float(os.environ.get(
+            "BENCH_TRACE_MAX_S", os.environ.get("BENCH_CONFIG_TIMEOUT",
+                                                "900")))
         _prof.set_config(filename=profile, profile_xla=True,
-                         xla_trace_dir=os.path.dirname(profile) or ".")
+                         xla_trace_dir=os.path.dirname(profile) or ".",
+                         xla_trace_max_s=trace_max)
         _prof.start()
     try:
         t0 = time.perf_counter()
@@ -368,9 +374,61 @@ def _run_config(cname, fn, timeout_s):
                                  "error": "config returned nothing"}
 
 
+def _preflight():
+    """Distinguish 'wedged' from 'slow' BEFORE burning each config's 900 s
+    timeout: a trivial jit dispatch + host fetch runs in a SUBPROCESS (a
+    hung PJRT client must not poison this process) under a short timeout.
+    A healthy chip answers in seconds even with a cold compile; a wedged
+    tunnel (observed round 3: killed profiler trace left every dispatch
+    from every process hanging for hours) answers never. Returns a record
+    dict; rec["ok"] is False when the chip is wedged. BENCH_PREFLIGHT=0
+    skips, BENCH_PREFLIGHT_TIMEOUT overrides the 120 s budget."""
+    import subprocess
+    timeout_s = int(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "120"))
+    code = (
+        "import time, jax, jax.numpy as jnp, numpy as np\n"
+        "t0 = time.perf_counter()\n"
+        "f = jax.jit(lambda v: v + 1)\n"
+        "v = jnp.ones((8, 8))\n"
+        "np.asarray(jax.device_get(f(v).ravel()[:2]))\n"
+        "t1 = time.perf_counter()\n"
+        "for _ in range(3):\n"
+        "    np.asarray(jax.device_get(f(v).ravel()[:2]))\n"
+        "print('PREFLIGHT %.3f %.4f'\n"
+        "      % (t1 - t0, (time.perf_counter() - t1) / 3))\n")
+    try:
+        out = subprocess.run([sys.executable, "-u", "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"metric": "preflight", "ok": False,
+                "error": "chip/tunnel WEDGED: trivial jit dispatch did not "
+                         "complete in %ds (distinct from slow — a healthy "
+                         "chip answers this in seconds)" % timeout_s}
+    for line in out.stdout.splitlines():
+        if line.startswith("PREFLIGHT"):
+            _, first, rtt = line.split()
+            return {"metric": "preflight", "ok": True,
+                    "first_dispatch_s": float(first),
+                    "rtt_s": float(rtt)}
+    return {"metric": "preflight", "ok": False,
+            "error": "preflight subprocess failed rc=%d: %s"
+                     % (out.returncode, (out.stderr or "")[-300:])}
+
+
 def main():
     name = os.environ.get("BENCH_CONFIG", "all")
     timeout_s = int(os.environ.get("BENCH_CONFIG_TIMEOUT", "900"))
+    if os.environ.get("BENCH_PREFLIGHT", "1") != "0":
+        pre = _preflight()
+        print(json.dumps(pre), flush=True)
+        if not pre["ok"]:
+            names = list(CONFIGS) if name == "all" else [name]
+            for cname in names:
+                print(json.dumps({"metric": cname, "error":
+                                  "skipped: chip/tunnel wedged (see "
+                                  "preflight record)"}), flush=True)
+            sys.exit(1)
     if name == "all":
         # per-config isolation: a failing config must not eat the headline
         # resnet50 line (the driver parses the LAST printed line)

@@ -14,6 +14,8 @@ JSON, same as the reference, plus ``aggregate_stats`` tables.
 from __future__ import annotations
 
 import json
+import os
+import signal
 import threading
 import time
 from collections import defaultdict
@@ -31,6 +33,10 @@ class _Profiler:
         self.aggregate = True
         self.profile_xla = False
         self._xla_dir = None
+        self._xla_tracing = False       # a jax device trace is live
+        self._xla_max_s = 120.0         # hard bound on any device capture
+        self._xla_watchdog = None
+        self._xla_guard_installed = False
 
 
 _PROF = _Profiler()
@@ -39,12 +45,66 @@ _PROF = _Profiler()
 def set_config(filename="profile.json", profile_all=False,
                profile_symbolic=True, profile_imperative=True,
                profile_memory=False, profile_api=False, aggregate_stats=True,
-               profile_xla=False, xla_trace_dir=None, **_kwargs):
+               profile_xla=False, xla_trace_dir=None, xla_trace_max_s=None,
+               **_kwargs):
     """(ref: profiler.py:set_config — continuous_dump etc accepted via kwargs)"""
     _PROF.filename = filename
     _PROF.aggregate = aggregate_stats
     _PROF.profile_xla = profile_xla
     _PROF._xla_dir = xla_trace_dir or (filename + ".xla")
+    # reset like every other field — a sticky bound from a previous
+    # set_config would silently truncate later captures
+    _PROF._xla_max_s = (120.0 if xla_trace_max_s is None
+                        else float(xla_trace_max_s))
+
+
+def _stop_xla_trace():
+    """Idempotent device-trace stop, safe from any thread/signal context.
+
+    A device trace left running when the client dies can wedge a remote
+    TPU server-side for hours (every later dispatch from any process
+    hangs). The reference's profiler is always-stoppable
+    (src/profiler/profiler.h:256-437); this is the analog for the
+    XLA-capture path: every exit route — normal stop(), atexit, SIGTERM/
+    SIGINT, or the bounded-duration watchdog — funnels here, and only the
+    first caller actually stops.
+    """
+    if not _PROF._xla_tracing:
+        return
+    _PROF._xla_tracing = False
+    try:
+        import jax
+        jax.profiler.stop_trace()
+    except Exception:
+        pass
+
+
+def _install_xla_guards():
+    """atexit + SIGTERM/SIGINT hooks so an interrupted capture still sends
+    stop_trace. SIGKILL cannot be caught — for watchdog-supervised runs use
+    tools/safe_trace.py, which runs the capture in a child that also stops
+    the trace when its parent disappears."""
+    if _PROF._xla_guard_installed:
+        return
+    _PROF._xla_guard_installed = True
+    import atexit
+    atexit.register(_stop_xla_trace)
+    if threading.current_thread() is not threading.main_thread():
+        return  # signal handlers only installable from the main thread
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        prev = signal.getsignal(signum)
+
+        def handler(sig, frame, _prev=prev):
+            _stop_xla_trace()
+            if callable(_prev):
+                _prev(sig, frame)
+            elif _prev is signal.SIG_IGN:
+                return  # the signal was deliberately ignored; keep it so
+            else:
+                signal.signal(sig, signal.SIG_DFL)
+                os.kill(os.getpid(), sig)
+
+        signal.signal(signum, handler)
 
 
 def start():
@@ -52,14 +112,43 @@ def start():
     _PROF.active = True
     if _PROF.profile_xla:
         import jax
+        _install_xla_guards()
         jax.profiler.start_trace(_PROF._xla_dir)
+        _PROF._xla_tracing = True
+        # bounded duration: even if the profiled workload hangs (so the
+        # user's own stop() is never reached), the capture ends and the
+        # chip is released before any external watchdog resorts to SIGKILL
+        t = threading.Timer(_PROF._xla_max_s, _stop_xla_trace)
+        t.daemon = True
+        t.start()
+        _PROF._xla_watchdog = t
 
 
 def stop():
     _PROF.active = False
     if _PROF.profile_xla:
-        import jax
-        jax.profiler.stop_trace()
+        if _PROF._xla_watchdog is not None:
+            _PROF._xla_watchdog.cancel()
+            _PROF._xla_watchdog = None
+        _stop_xla_trace()
+
+
+def install_orphan_guard(poll_s=2.0):
+    """Stop any live device trace if this process is orphaned (parent
+    died, e.g. the supervising tools/safe_trace.py was SIGKILLed). Child
+    half of the safe-capture protocol."""
+    ppid0 = os.getppid()
+
+    def watch():
+        while True:
+            time.sleep(poll_s)
+            if os.getppid() != ppid0:
+                _stop_xla_trace()
+                return
+
+    t = threading.Thread(target=watch, daemon=True, name="mxtpu-trace-guard")
+    t.start()
+    return t
 
 
 def pause():

@@ -41,3 +41,30 @@ def test_profiler_off_records_nothing(tmp_path):
     mx.nd.ones((4,)).asnumpy()
     stats = profiler.dumps()
     assert "ones" not in stats
+
+
+def test_xla_trace_bounded_and_idempotent(tmp_path):
+    """A hung workload cannot leave a device capture running: the bounded
+    watchdog stops it, and every later stop path is a no-op (the round-3
+    chip wedge came from a capture with no surviving stopper)."""
+    import glob
+    import time
+
+    d = str(tmp_path / "xla")
+    profiler.set_config(filename=str(tmp_path / "t.json"), profile_xla=True,
+                        xla_trace_dir=d, xla_trace_max_s=1.0)
+    profiler.start()
+    mx.nd.ones((8, 8)).asnumpy()
+    time.sleep(2.5)  # watchdog fires at 1s while "workload" is stuck
+    assert not profiler._PROF._xla_tracing
+    profiler.stop()          # second stop: must not raise
+    profiler._stop_xla_trace()  # third: still a no-op
+    assert glob.glob(d + "/**/*.xplane.pb", recursive=True)
+    profiler.set_config(filename=str(tmp_path / "t.json"))  # reset config
+
+
+def test_orphan_guard_noops_while_parent_alive():
+    t = profiler.install_orphan_guard(poll_s=0.05)
+    import time
+    time.sleep(0.2)
+    assert t.is_alive()  # parent (us) still alive -> guard keeps watching

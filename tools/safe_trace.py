@@ -1,0 +1,86 @@
+"""Supervise a device-profiling workload so an interrupted capture cannot
+wedge the chip.
+
+A jax device trace whose client dies mid-capture can leave a remote TPU
+unresponsive server-side for hours (observed through the axon tunnel; the
+reference's C++ profiler is always-stoppable — src/profiler/profiler.h:256-437
+— and never has this failure mode). This tool is the TPU analog: it runs the
+workload in a CHILD process wired so that every way the capture can be
+interrupted still sends ``stop_trace``:
+
+* normal completion           -> the workload's own profiler.stop()
+* workload hangs              -> mxtpu.profiler's bounded-duration watchdog
+                                 (``xla_trace_max_s``, default 120 s)
+* supervisor timeout          -> SIGTERM to the child; the profiler's signal
+                                 handler stops the trace before exiting
+* supervisor itself SIGKILLed -> the child's orphan guard notices the parent
+                                 change and stops the trace
+* child SIGKILLed externally  -> the one unguardable route; the bounded
+                                 watchdog has usually already fired by then
+
+Usage::
+
+    python tools/safe_trace.py [--timeout S] script.py [args...]
+
+The script runs unmodified (``runpy``, ``__name__ == "__main__"``); use
+``mxtpu.profiler`` with ``profile_xla=True`` (e.g. tools/perf_trace.py) so
+the capture goes through the guarded start/stop path.
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_BOOTSTRAP = (
+    "import runpy, sys;"
+    "sys.path.insert(0, %(repo)r);"
+    "from mxtpu import profiler;"
+    "profiler.install_orphan_guard();"
+    "sys.argv = sys.argv[1:];"
+    "runpy.run_path(sys.argv[0], run_name='__main__')"
+)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="seconds before the child is asked to stop")
+    ap.add_argument("--grace", type=float, default=60.0,
+                    help="seconds between SIGTERM and SIGKILL")
+    ap.add_argument("script")
+    ap.add_argument("args", nargs=argparse.REMAINDER)
+    ns = ap.parse_args(argv)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = subprocess.Popen(
+        [sys.executable, "-u", "-c", _BOOTSTRAP % {"repo": repo},
+         ns.script] + ns.args)
+    deadline = time.time() + ns.timeout
+    try:
+        while child.poll() is None and time.time() < deadline:
+            time.sleep(0.5)
+        if child.poll() is None:
+            print("safe_trace: timeout after %.0fs — SIGTERM (trace stops "
+                  "in the child's handler)" % ns.timeout, file=sys.stderr)
+            child.send_signal(signal.SIGTERM)
+            t0 = time.time()
+            while child.poll() is None and time.time() - t0 < ns.grace:
+                time.sleep(0.5)
+            if child.poll() is None:
+                # by now the bounded-duration watchdog and the SIGTERM
+                # handler have both had their chance; SIGKILL is safe
+                print("safe_trace: SIGKILL after %.0fs grace" % ns.grace,
+                      file=sys.stderr)
+                child.kill()
+    except KeyboardInterrupt:
+        # forward ^C as SIGTERM so the child's handler stops the trace
+        child.send_signal(signal.SIGTERM)
+        child.wait()
+        raise
+    return child.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
