@@ -63,8 +63,15 @@ def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None, **_ig
     return r
 
 
-@register("gamma", aliases=("_random_gamma", "random_gamma"), wrap=False)
+@register("_random_gamma",
+          aliases=("random_gamma", "sample_gamma", "_sample_gamma"),
+          wrap=False)
 def gamma_sample(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None, out=None, **_ig):
+    """Gamma sampler (ref: _random_gamma / _sample_gamma). Registered under
+    the _random_ name only: the PRIMARY name ``gamma`` belongs to the
+    elementwise tgamma (elemwise.py), exactly as in the reference where
+    mx.nd.gamma is the gamma *function* — registering the sampler over it
+    shadowed the math op through round 3."""
     a = alpha._data if isinstance(alpha, NDArray) else alpha
     b = beta._data if isinstance(beta, NDArray) else beta
     base = jnp.broadcast_shapes(jnp.shape(a), jnp.shape(b)) + _shape(shape)
