@@ -1,0 +1,122 @@
+"""Convert torch / torchvision checkpoints into the model-zoo weight store.
+
+The reference ships hash-checked pretrained weights from its S3 bucket
+(model_store.py); this build has no network path, so the practical way to
+get real pretrained vision weights is to convert a torch checkpoint the
+user already has (``torch.hub`` cache, torchvision download on another
+machine, or any ``state_dict`` file). The layouts agree almost everywhere
+— torch Conv2d weights are OIHW like the reference, Linear weights are
+(out, in) like FullyConnected — so conversion is a NAME mapping plus the
+BatchNorm field renames (weight/bias -> gamma/beta).
+
+    import torch
+    from mxtpu.contrib import torch_zoo
+    from mxtpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1()
+    sd = torch.load("resnet18.pth", map_location="cpu")
+    torch_zoo.load_torch_parameters(net, sd,
+                                    torch_zoo.torchvision_resnet_map(18))
+    net.save_parameters("~/.mxtpu/models/resnet18_v1.params")  # store it
+
+NOTE on semantics: torchvision's bottleneck resnets are "v1.5" (stride-2
+on the 3x3 conv); the reference's ``resnet*_v1`` strides the first 1x1.
+Shapes convert either way, but bottleneck (50/101/152) torch weights
+reach their published accuracy only under v1.5 semantics — prefer the
+basic-block depths (18/34), where the two definitions coincide.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["load_torch_parameters", "torchvision_resnet_map",
+           "convert_state_dict"]
+
+_BN_FIELDS = {"weight": "gamma", "bias": "beta",
+              "running_mean": "running_mean",
+              "running_var": "running_var"}
+
+
+def torchvision_resnet_map(num_layers):
+    """torchvision resnet state_dict names -> this zoo's resnet_v1 names.
+
+    Layout recap — torchvision: conv1/bn1, layer{1-4}.{i}.(conv|bn){1,2,3}
+    + .downsample.{0,1}, fc.  This zoo (resnet.py): features.0 conv,
+    features.1 bn, features.{4-7}.{i}.body.{0,1,3,4[,6,7]} +
+    .downsample.{0,1}, output."""
+    blocks = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
+              101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}.get(num_layers)
+    if blocks is None:
+        raise MXNetError("no torchvision resnet with %s layers"
+                         % num_layers)
+    bottleneck = num_layers >= 50
+    n_convs = 3 if bottleneck else 2
+    m = {"conv1.weight": "features.0.weight", "fc.weight": "output.weight",
+         "fc.bias": "output.bias"}
+    for tf, of in _BN_FIELDS.items():
+        m["bn1.%s" % tf] = "features.1.%s" % of
+    for stage, n in enumerate(blocks):
+        for i in range(n):
+            t = "layer%d.%d." % (stage + 1, i)
+            o = "features.%d.%d." % (stage + 4, i)
+            for c in range(n_convs):
+                # body indices: conv at 3c, bn at 3c+1 (relu between)
+                m[t + "conv%d.weight" % (c + 1)] = \
+                    o + "body.%d.weight" % (3 * c)
+                for tf, of in _BN_FIELDS.items():
+                    m[t + "bn%d.%s" % (c + 1, tf)] = \
+                        o + "body.%d.%s" % (3 * c + 1, of)
+            if i == 0 and (stage > 0 or bottleneck):
+                # only the first block of a stage changes stride/width;
+                # stage 1 keeps channels in the basic-block nets
+                m[t + "downsample.0.weight"] = o + "downsample.0.weight"
+                for tf, of in _BN_FIELDS.items():
+                    m[t + "downsample.1.%s" % tf] = \
+                        o + "downsample.1.%s" % of
+    return m
+
+
+def convert_state_dict(state_dict, name_map, strict=True):
+    """Map a torch state_dict through ``name_map`` -> {our_name: ndarray}.
+    Unmapped torch entries raise unless they are torch bookkeeping
+    (num_batches_tracked) or ``strict=False``."""
+    out = {}
+    for tname, tensor in state_dict.items():
+        if tname.endswith("num_batches_tracked"):
+            continue  # torch-only BN counter; the reference has no analog
+        oname = name_map.get(tname)
+        if oname is None:
+            if strict:
+                raise MXNetError("no mapping for torch parameter %s"
+                                 % tname)
+            continue
+        a = tensor.detach().cpu()
+        if str(a.dtype) == "torch.bfloat16":
+            a = a.float()
+        out[oname] = _np.ascontiguousarray(a.numpy())
+    return out
+
+
+def load_torch_parameters(net, state_dict, name_map, strict=True):
+    """Load a torch state_dict into an (initialized or shape-settled)
+    block via ``name_map``; every block parameter must be covered when
+    ``strict``."""
+    from ..ndarray import array
+
+    converted = convert_state_dict(state_dict, name_map, strict=strict)
+    params = net._collect_params_with_prefix()
+    if strict:
+        missing = [n for n in params if n not in converted]
+        if missing:
+            raise MXNetError("torch checkpoint covers %d/%d parameters; "
+                             "missing e.g. %s" % (len(converted),
+                                                  len(params), missing[:5]))
+    for name, a in converted.items():
+        if name not in params:
+            if strict:
+                raise MXNetError("mapped name %s not found in block" % name)
+            continue
+        params[name].set_data(array(a))
+    return net

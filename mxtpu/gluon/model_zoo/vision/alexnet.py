@@ -42,9 +42,8 @@ class AlexNet(HybridBlock):
 
 
 def alexnet(pretrained=False, ctx=None, **kwargs):
-    from ....base import MXNetError
     net = AlexNet(**kwargs)
     if pretrained:
-        raise MXNetError("pretrained weight store is not bundled; "
-                         "load_parameters() from a local file instead")
+        from ..model_store import get_model_file
+        net.load_parameters(get_model_file("alexnet"), ctx=ctx)
     return net

@@ -99,8 +99,9 @@ def get_densenet(num_layers, pretrained=False, ctx=None, **kwargs):
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
     net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weight store is not bundled; "
-                         "load_parameters() from a local file instead")
+        from ..model_store import get_model_file
+        net.load_parameters(get_model_file("densenet%d" % num_layers),
+                            ctx=ctx)
     return net
 
 

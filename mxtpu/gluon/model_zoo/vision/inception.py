@@ -5,7 +5,6 @@ concats map to one XLA concat per block.
 """
 from __future__ import annotations
 
-from ....base import MXNetError
 from ....layout import channel_axis as _channel_axis
 from ...block import HybridBlock
 from ... import nn
@@ -143,6 +142,6 @@ class Inception3(HybridBlock):
 def inception_v3(pretrained=False, ctx=None, **kwargs):
     net = Inception3(**kwargs)
     if pretrained:
-        raise MXNetError("pretrained weight store is not bundled; "
-                         "load_parameters() from a local file instead")
+        from ..model_store import get_model_file
+        net.load_parameters(get_model_file("inceptionv3"), ctx=ctx)
     return net

@@ -6,7 +6,6 @@ feature-group convolution.
 """
 from __future__ import annotations
 
-from ....base import MXNetError
 from ...block import HybridBlock
 from ... import nn
 
@@ -121,19 +120,29 @@ class MobileNetV2(HybridBlock):
         return self.output(x)
 
 
+def _multiplier_suffix(multiplier):
+    """Zoo file-name suffix for a width multiplier (ref model_store names:
+    mobilenet0.25 ... mobilenet1.0 - one decimal for .0/.5 widths)."""
+    suffix = "%.2f" % multiplier
+    return suffix[:-1] if suffix in ("1.00", "0.50") else suffix
+
 def get_mobilenet(multiplier, pretrained=False, ctx=None, **kwargs):
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weight store is not bundled; "
-                         "load_parameters() from a local file instead")
+        from ..model_store import get_model_file
+        net.load_parameters(
+            get_model_file("mobilenet%s" % _multiplier_suffix(multiplier)),
+            ctx=ctx)
     return net
 
 
 def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, **kwargs):
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weight store is not bundled; "
-                         "load_parameters() from a local file instead")
+        from ..model_store import get_model_file
+        net.load_parameters(
+            get_model_file("mobilenetv2_%s" % _multiplier_suffix(multiplier)),
+            ctx=ctx)
     return net
 
 

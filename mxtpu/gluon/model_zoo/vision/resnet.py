@@ -255,8 +255,9 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
     block_class = resnet_block_versions[version - 1][block_type]
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weight store is not bundled; "
-                         "load_parameters() from a local file instead")
+        from ..model_store import get_model_file
+        net.load_parameters(
+            get_model_file("resnet%d_v%d" % (num_layers, version)), ctx=ctx)
     return net
 
 

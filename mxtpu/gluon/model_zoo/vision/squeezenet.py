@@ -81,8 +81,9 @@ class SqueezeNet(HybridBlock):
 def get_squeezenet(version, pretrained=False, ctx=None, **kwargs):
     net = SqueezeNet(version, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weight store is not bundled; "
-                         "load_parameters() from a local file instead")
+        from ..model_store import get_model_file
+        net.load_parameters(get_model_file("squeezenet%s" % version),
+                            ctx=ctx)
     return net
 
 

@@ -70,8 +70,11 @@ def get_vgg(num_layers, pretrained=False, ctx=None, **kwargs):
     layers, filters = vgg_spec[num_layers]
     net = VGG(layers, filters, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weight store is not bundled; "
-                         "load_parameters() from a local file instead")
+        from ..model_store import get_model_file
+        batch_norm_suffix = "_bn" if kwargs.get("batch_norm") else ""
+        net.load_parameters(
+            get_model_file("vgg%d%s" % (num_layers, batch_norm_suffix)),
+            ctx=ctx)
     return net
 
 

@@ -1,11 +1,16 @@
-"""NDArray serialization (ref: src/ndarray/ndarray.cc:1574-1776 Save/Load with magic
+"""NDArray serialization (ref: src/ndarray/ndarray.cc:1574-1806 Save/Load with magic
 number + versioned blobs; python surface mx.nd.save/load).
 
-Format (TPU build): a single file, magic ``MXTPU001`` + JSON header (names, shapes,
-dtypes, storage types, byte offsets) + raw little-endian buffers. Dense and sparse
-(row_sparse/csr as index+value buffers) supported, mirroring the reference's
-sparse-aware format. Legacy MXNet files are not binary-compatible (the reference's
-format embeds mshadow TBlob headers), but the API is identical.
+Two on-disk formats, auto-detected by magic on load:
+
+* the REFERENCE format (u64 magic 0x112 ``kMXAPINDArrayListMagic`` +
+  versioned per-array records — ``mxnet_format.py``), byte-compatible
+  with files real MXNet writes and reads. This is the DEFAULT save
+  format whenever every array has a reference-representable dtype, so
+  ``.params`` files interchange with the reference both ways.
+* the native TPU format (magic ``MXTPU001`` + JSON header + raw
+  buffers), used automatically for bfloat16 arrays (the reference's
+  mshadow dtype table predates bf16) or on request (``format="mxtpu"``).
 """
 from __future__ import annotations
 
@@ -15,6 +20,7 @@ import struct
 import numpy as _np
 
 from ..base import MXNetError
+from . import mxnet_format
 from .ndarray import NDArray, array
 
 _MAGIC = b"MXTPU001"
@@ -29,8 +35,13 @@ def _to_bytes(arr: NDArray):
     return a.tobytes(), str(_np.dtype(a.dtype).name), a.shape
 
 
-def save(fname: str, data) -> None:
-    """Save NDArrays (list or dict) to file (ref: mx.nd.save → MXNDArraySave)."""
+def save(fname: str, data, format=None) -> None:  # noqa: A002
+    """Save NDArrays (list or dict) to file (ref: mx.nd.save → MXNDArraySave).
+
+    ``format``: ``"mxnet"`` = reference byte format (0x112), ``"mxtpu"`` =
+    native, ``None`` = reference format unless an array needs a dtype the
+    reference can't encode losslessly (bfloat16), then native.
+    """
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, dict):
@@ -41,6 +52,33 @@ def save(fname: str, data) -> None:
         arrays = list(data)
     else:
         raise MXNetError("save expects NDArray, list, or dict")
+
+    if format is None:
+        # reference format only when every array round-trips losslessly:
+        # bf16/bool/int16/... have no mshadow flag, and rank-0 shapes read
+        # back as "none" records -> native format for those
+        format = "mxnet" if all(mxnet_format.ref_encodable(a.dtype)
+                                and len(a.shape) > 0
+                                for a in arrays) else "mxtpu"
+    if format == "mxnet":
+        from .sparse import BaseSparseNDArray
+        items = []
+        for arr in arrays:
+            if isinstance(arr, BaseSparseNDArray):
+                parts = dict(arr._serialize_parts())
+                parts["shape"] = arr.shape
+                items.append((arr.stype, parts))
+            elif str(arr.dtype) == "bfloat16":  # no reference dtype flag
+                items.append(("default", arr.astype("float32").asnumpy()))
+            else:
+                items.append(("default", arr.asnumpy()))
+        blob = mxnet_format.dumps(
+            items, names if isinstance(data, dict) else [])
+        with open(fname, "wb") as f:
+            f.write(blob)
+        return
+    if format != "mxtpu":
+        raise MXNetError("unknown save format %r" % (format,))
 
     entries = []
     blobs = []
@@ -76,10 +114,15 @@ def save(fname: str, data) -> None:
 
 
 def load(fname: str):
-    """Load NDArrays (ref: mx.nd.load → MXNDArrayLoad). Returns list or dict."""
+    """Load NDArrays (ref: mx.nd.load → MXNDArrayLoad). Returns list or
+    dict. Auto-detects the reference 0x112 format (files written by real
+    MXNet load directly) vs the native MXTPU001 format."""
     with open(fname, "rb") as f:
         magic = f.read(8)
         if magic != _MAGIC:
+            if struct.unpack("<Q", magic.ljust(8, b"\0"))[0] == \
+                    mxnet_format.LIST_MAGIC:
+                return _load_mxnet(magic + f.read())
             raise MXNetError("invalid NDArray file %s (bad magic)" % fname)
         (hlen,) = struct.unpack("<Q", f.read(8))
         header = json.loads(f.read(hlen).decode())
@@ -112,3 +155,19 @@ def load(fname: str):
     if header["named"]:
         return {k: v for k, v in out}
     return [v for _, v in out]
+
+
+def _load_mxnet(buf):
+    """Reference-format blob -> list or dict of NDArrays."""
+    from .sparse import _deserialize_parts
+    items, names = mxnet_format.loads(buf)
+    arrays = []
+    for stype, payload in items:
+        if stype == "default":
+            arrays.append(array(payload))
+        else:
+            shape = tuple(int(d) for d in payload.pop("shape"))
+            arrays.append(_deserialize_parts(stype, shape, payload))
+    if names:
+        return dict(zip(names, arrays))
+    return arrays

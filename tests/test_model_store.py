@@ -1,0 +1,157 @@
+"""Pretrained-weight store + torch conversion (ref:
+python/mxnet/gluon/model_zoo/model_store.py; tests/python/gpu/test_gluon_model_zoo_gpu.py
+pattern of exercising pretrained load paths)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.base import MXNetError
+from mxtpu.gluon.model_zoo import model_store, vision
+
+
+def _settle(net, size=32):
+    x = mx.nd.array(np.random.RandomState(0).uniform(
+        -1, 1, (1, 3, size, size)).astype(np.float32))
+    net(x)
+    return x
+
+
+def test_get_model_file_plain_dropin(tmp_path):
+    net = vision.resnet18_v1()
+    net.initialize()
+    _settle(net)
+    f = str(tmp_path / "resnet18_v1.params")
+    net.save_parameters(f)
+    path = model_store.get_model_file("resnet18_v1", root=str(tmp_path))
+    assert path == f
+
+
+def test_get_model_file_missing_raises_with_instructions(tmp_path):
+    with pytest.raises(MXNetError, match="convert torch weights"):
+        model_store.get_model_file("resnet18_v1", root=str(tmp_path))
+
+
+def test_get_model_file_rejects_bad_hash(tmp_path):
+    # a file wearing the hash-verified name but with wrong content must
+    # not be returned as verified (ref: check_sha1 gate)
+    bad = tmp_path / ("resnet18_v1-%s.params"
+                      % model_store.short_hash("resnet18_v1"))
+    bad.write_bytes(b"junk")
+    with pytest.raises(MXNetError):
+        model_store.get_model_file("resnet18_v1", root=str(tmp_path))
+
+
+def test_pretrained_loads_from_store(tmp_path, monkeypatch):
+    src = vision.resnet18_v1()
+    src.initialize()
+    x = _settle(src)
+    src.save_parameters(str(tmp_path / "resnet18_v1.params"))
+    monkeypatch.setenv("MXTPU_MODEL_ZOO_PATH", str(tmp_path))
+    net = vision.get_model("resnet18_v1", pretrained=True)
+    np.testing.assert_allclose(net(x).asnumpy(), src(x).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_purge(tmp_path):
+    (tmp_path / "resnet18_v1.params").write_bytes(b"x")
+    (tmp_path / "keep.txt").write_bytes(b"x")
+    model_store.purge(root=str(tmp_path))
+    assert not (tmp_path / "resnet18_v1.params").exists()
+    assert (tmp_path / "keep.txt").exists()
+
+
+# ------------------------------------------------------- torch conversion
+def test_torchvision_resnet_map_covers_all_params():
+    """The static name map must cover EXACTLY the zoo net's parameters —
+    this pins the map to both naming schemes."""
+    from mxtpu.contrib import torch_zoo
+    for depth, builder in ((18, vision.resnet18_v1),
+                           (50, vision.resnet50_v1)):
+        net = builder()
+        net.initialize()
+        _settle(net)
+        ours = set(net._collect_params_with_prefix())
+        mapped = set(torch_zoo.torchvision_resnet_map(depth).values())
+        assert mapped == ours, (depth, mapped ^ ours)
+
+
+def test_torch_state_dict_conversion_matches_numerics(tmp_path):
+    """conv-bn-dense torch module vs the gluon equivalent: converted
+    weights must reproduce torch's eval-mode forward to float tolerance
+    (validates OIHW conv layout, BN field renames, Linear transpose)."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as tnn
+
+    from mxtpu import gluon
+    from mxtpu.contrib import torch_zoo
+    from mxtpu.gluon import nn
+
+    tmod = tnn.Sequential(
+        tnn.Conv2d(3, 4, 3, padding=1),
+        tnn.BatchNorm2d(4),
+        tnn.ReLU(),
+        tnn.Flatten(),
+        tnn.Linear(4 * 8 * 8, 5))
+    # non-trivial BN running stats
+    tmod.train()
+    with torch.no_grad():
+        for _ in range(3):
+            tmod(torch.randn(4, 3, 8, 8))
+    tmod.eval()
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(4, 3, padding=1, in_channels=3))
+        net.add(nn.BatchNorm(in_channels=4))
+        net.add(nn.Activation("relu"))
+        net.add(nn.Flatten())
+        net.add(nn.Dense(5, in_units=4 * 8 * 8))
+    net.initialize()
+    x = np.random.RandomState(1).uniform(-1, 1, (2, 3, 8, 8)) \
+        .astype(np.float32)
+    net(mx.nd.array(x))
+
+    name_map = {"0.weight": "0.weight", "0.bias": "0.bias",
+                "1.weight": "1.gamma", "1.bias": "1.beta",
+                "1.running_mean": "1.running_mean",
+                "1.running_var": "1.running_var",
+                "4.weight": "4.weight", "4.bias": "4.bias"}
+    torch_zoo.load_torch_parameters(net, tmod.state_dict(), name_map)
+
+    with torch.no_grad():
+        expect = tmod(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(net(mx.nd.array(x)).asnumpy(), expect,
+                               rtol=1e-4, atol=1e-5)
+
+    # and the converted net round-trips through the weight store
+    f = str(tmp_path / "converted.params")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Conv2D(4, 3, padding=1, in_channels=3))
+        net2.add(nn.BatchNorm(in_channels=4))
+        net2.add(nn.Activation("relu"))
+        net2.add(nn.Flatten())
+        net2.add(nn.Dense(5, in_units=4 * 8 * 8))
+    net2.load_parameters(f)
+    np.testing.assert_allclose(net2(mx.nd.array(x)).asnumpy(), expect,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_strict_conversion_rejects_gaps():
+    from mxtpu.contrib import torch_zoo
+    torch = pytest.importorskip("torch")
+    import torch.nn as tnn
+
+    from mxtpu.gluon import nn
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    tmod = tnn.Linear(4, 3)
+    with pytest.raises(MXNetError, match="no mapping"):
+        torch_zoo.load_torch_parameters(net, tmod.state_dict(),
+                                        {"weight": "weight"})
+    with pytest.raises(MXNetError, match="missing"):
+        torch_zoo.load_torch_parameters(
+            net, {"weight": tmod.weight}, {"weight": "weight"})
