@@ -1,0 +1,35 @@
+"""The driver contract (__graft_entry__.py) must keep compiling: entry()
+single-device and dryrun_multichip at a NON-power-of-two device count
+(the driver itself runs n=8; n=6 catches the even/composite
+generalizations). Runs in a subprocess because the dryrun must own jax
+backend initialization."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.multidevice
+def test_dryrun_multichip_n6():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(6); print('OK6')"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "OK6" in out.stdout
+
+
+def test_mesh_axes_factoring():
+    from __graft_entry__ import _mesh_axes, _spf
+    assert _spf(6) == 2 and _spf(7) == 7 and _spf(9) == 3
+    for n in (1, 2, 3, 4, 6, 8, 9, 12):
+        ax = _mesh_axes(n)
+        assert ax["data"] * ax["sp"] * ax["model"] == n, (n, ax)
+    assert _mesh_axes(6) == {"data": 3, "sp": 1, "model": 2}
+    assert _mesh_axes(8) == {"data": 2, "sp": 2, "model": 2}
