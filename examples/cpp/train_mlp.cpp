@@ -1,0 +1,117 @@
+// Train a 2-layer MLP to convergence from C++ through the mxtpu C ABI —
+// no Python in this source file (ref: cpp-package/example/mlp.cpp, which
+// drives the reference's C ABI the same way: Symbol compose -> Executor
+// bind -> forward/backward -> KVStore optimizer updates).
+//
+// Build (see tests/test_c_api.py::test_cpp_training_via_abi):
+//   g++ -std=c++14 train_mlp.cpp -I include -l:_libmxtpu.so -lpythonX.Y
+//
+// The program makes a two-blob binary dataset, composes
+//   data -> FullyConnected(16) -> relu -> FullyConnected(2) -> SoftmaxOutput
+// binds it, and runs full-batch SGD via KVStore push(grad)/pull(weight).
+// Exit code 0 iff the final accuracy is >= 0.95 and the loss fell 5x.
+
+#include <cmath>
+#include <cstdio>
+#include <random>
+#include <string>
+#include <vector>
+
+#include <mxtpu/mxtpu-cpp.hpp>
+
+namespace mc = mxtpu::cpp;
+
+int Run() {
+  const int n = 64, in_dim = 2, hidden = 16, classes = 2;
+
+  // two gaussian blobs; label = which blob
+  std::mt19937 rng(0);
+  std::normal_distribution<float> noise(0.0f, 0.6f);
+  std::vector<float> xs(n * in_dim), ys(n);
+  for (int i = 0; i < n; ++i) {
+    float cls = static_cast<float>(i % 2);
+    float cx = cls == 0.0f ? -1.0f : 1.0f;
+    xs[i * 2 + 0] = cx + noise(rng);
+    xs[i * 2 + 1] = cx + noise(rng);
+    ys[i] = cls;
+  }
+
+  // symbol: the reference's classic MLP graph, composed op by op
+  mc::Symbol data = mc::Symbol::Variable("data");
+  mc::Symbol w1 = mc::Symbol::Variable("fc1_weight");
+  mc::Symbol w2 = mc::Symbol::Variable("fc2_weight");
+  mc::Symbol label = mc::Symbol::Variable("softmax_label");
+  mc::Symbol fc1 = mc::Symbol::Compose(
+      "FullyConnected", "fc1", {&data, &w1},
+      {{"num_hidden", std::to_string(hidden)}, {"no_bias", "True"}});
+  mc::Symbol act = mc::Symbol::Compose("Activation", "relu1", {&fc1},
+                                       {{"act_type", "relu"}});
+  mc::Symbol fc2 = mc::Symbol::Compose(
+      "FullyConnected", "fc2", {&act, &w2},
+      {{"num_hidden", std::to_string(classes)}, {"no_bias", "True"}});
+  mc::Symbol out = mc::Symbol::Compose("SoftmaxOutput", "softmax",
+                                       {&fc2, &label}, {});
+
+  // parameter init (tiny uniform, like mxnet-cpp's SimpleBind defaults)
+  std::uniform_real_distribution<float> u(-0.5f, 0.5f);
+  std::vector<float> w1v(hidden * in_dim), w2v(classes * hidden);
+  for (float &v : w1v) v = u(rng);
+  for (float &v : w2v) v = u(rng);
+
+  mc::NDArray a_data({n, in_dim}, xs.data());
+  mc::NDArray a_label({n}, ys.data());
+  mc::NDArray a_w1({hidden, in_dim}, w1v.data());
+  mc::NDArray a_w2({classes, hidden}, w2v.data());
+
+  mc::Executor exec(out, {"data", "fc1_weight", "fc2_weight",
+                          "softmax_label"},
+                    {&a_data, &a_w1, &a_w2, &a_label});
+
+  // data-parallel-style optimizer: push grads, pull refreshed weights
+  mc::KVStore kv("local");
+  kv.SetOptimizer("sgd", {{"learning_rate", "0.02"}});
+  kv.Init({"fc1_weight", "fc2_weight"}, {&a_w1, &a_w2});
+
+  double first_loss = -1.0, loss = 0.0;
+  for (int epoch = 0; epoch < 200; ++epoch) {
+    exec.Forward(true);
+    exec.Backward();
+    mc::NDArray g1 = exec.ArgGrad("fc1_weight");
+    mc::NDArray g2 = exec.ArgGrad("fc2_weight");
+    kv.Push({"fc1_weight", "fc2_weight"}, {&g1, &g2});
+    kv.Pull({"fc1_weight", "fc2_weight"}, {&a_w1, &a_w2});
+
+    std::vector<float> probs = exec.Output(0).CopyToHost();
+    loss = 0.0;
+    for (int i = 0; i < n; ++i) {
+      float p = probs[i * classes + static_cast<int>(ys[i])];
+      loss -= std::log(p > 1e-12f ? p : 1e-12f);
+    }
+    loss /= n;
+    if (first_loss < 0) first_loss = loss;
+    if (epoch % 40 == 0) std::printf("epoch %d loss %.4f\n", epoch, loss);
+  }
+
+  exec.Forward(false);
+  std::vector<float> probs = exec.Output(0).CopyToHost();
+  int correct = 0;
+  for (int i = 0; i < n; ++i) {
+    int pred = probs[i * classes] > probs[i * classes + 1] ? 0 : 1;
+    if (pred == static_cast<int>(ys[i])) ++correct;
+  }
+  double acc = static_cast<double>(correct) / n;
+  std::printf("FINAL loss %.4f (from %.4f) acc %.3f\n", loss, first_loss,
+              acc);
+  bool converged = acc >= 0.95 && loss < first_loss / 5.0;
+  std::printf(converged ? "TRAINED_OK\n" : "TRAINED_FAIL\n");
+  return converged ? 0 : 1;
+}
+
+int main() {
+  try {
+    return Run();
+  } catch (const std::exception &e) {
+    std::printf("exception: %s\n", e.what());
+    return 2;
+  }
+}

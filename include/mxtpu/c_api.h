@@ -25,6 +25,9 @@ extern "C" {
 
 typedef void *NDArrayHandle;
 typedef void *PredictorHandle;
+typedef void *KVStoreHandle;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
 
 /* Last error message for the calling thread (never NULL). */
 const char *MXTPUGetLastError(void);
@@ -56,6 +59,66 @@ int MXTPUImperativeInvoke(const char *op_name, NDArrayHandle *inputs,
                           int num_inputs, const char **attr_keys,
                           const char **attr_vals, int num_attrs,
                           NDArrayHandle *outputs, int *num_outputs);
+
+/* ---- autograd (ref: MXAutogradSetIsRecording / MXAutogradMarkVariables
+ * / MXAutogradBackward). Record imperative invokes, then backward from a
+ * scalar loss; gradients land on arrays that called AttachGrad. ---- */
+
+int MXTPUAutogradSetRecording(int is_recording, int *prev);
+int MXTPUAutogradSetTraining(int is_training, int *prev);
+int MXTPUNDArrayAttachGrad(NDArrayHandle handle);
+int MXTPUNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+int MXTPUNDArrayBackward(NDArrayHandle handle, int retain_graph);
+
+/* ---- KVStore (ref: MXKVStoreCreate / Init / PushEx / PullEx /
+ * SetOptimizer). With an optimizer set, push(grad) applies the update
+ * server-side and pull returns refreshed weights — the reference's
+ * data-parallel training loop from C. ---- */
+
+int MXTPUKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXTPUKVStoreInit(KVStoreHandle handle, int num, const char **keys,
+                     NDArrayHandle *vals);
+int MXTPUKVStorePush(KVStoreHandle handle, int num, const char **keys,
+                     NDArrayHandle *vals, int priority);
+int MXTPUKVStorePull(KVStoreHandle handle, int num, const char **keys,
+                     NDArrayHandle *outs, int priority);
+int MXTPUKVStoreSetOptimizer(KVStoreHandle handle, const char *optimizer,
+                             const char **attr_keys, const char **attr_vals,
+                             int num_attrs);
+int MXTPUKVStoreFree(KVStoreHandle handle);
+
+/* ---- Symbol (ref: MXSymbolCreateVariable / CreateAtomicSymbol +
+ * Compose / CreateFromJSON / ListArguments / SaveToJSON). Compose is
+ * atomic-create + compose in one call. Returned strings stay valid until
+ * the next MXTPUSymbol* call on the same thread. ---- */
+
+int MXTPUSymbolCreateVariable(const char *name, SymbolHandle *out);
+int MXTPUSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXTPUSymbolCreateFromFile(const char *path, SymbolHandle *out);
+int MXTPUSymbolCompose(const char *op_name, const char *name,
+                       SymbolHandle *inputs, int num_inputs,
+                       const char **attr_keys, const char **attr_vals,
+                       int num_attrs, SymbolHandle *out);
+int MXTPUSymbolListArguments(SymbolHandle sym, int *num,
+                             const char ***out_names);
+int MXTPUSymbolToJSON(SymbolHandle sym, const char **out_json);
+int MXTPUSymbolFree(SymbolHandle sym);
+
+/* ---- Executor (ref: MXExecutorBindEX / Forward / Backward /
+ * Outputs). Bind allocates gradient arrays (grad_req "write"); after
+ * Backward, per-argument gradients come from ArgGrad. ---- */
+
+int MXTPUExecutorBind(SymbolHandle sym, int num_args,
+                      const char **arg_names, NDArrayHandle *arg_vals,
+                      const char *grad_req, ExecutorHandle *out);
+int MXTPUExecutorForward(ExecutorHandle handle, int is_train);
+int MXTPUExecutorNumOutputs(ExecutorHandle handle, int *num);
+int MXTPUExecutorOutput(ExecutorHandle handle, int index,
+                        NDArrayHandle *out);
+int MXTPUExecutorBackward(ExecutorHandle handle);
+int MXTPUExecutorArgGrad(ExecutorHandle handle, const char *arg_name,
+                         NDArrayHandle *out);
+int MXTPUExecutorFree(ExecutorHandle handle);
 
 /* ---- predict API (ref: c_predict_api.h MXPred*) ----
  * Loads "<prefix>-symbol.json" + "<prefix>-%04d.params" (the checkpoint

@@ -117,3 +117,128 @@ def pred_output_shape(pred: _Predictor, index: int) -> tuple:
 
 def pred_output_bytes(pred: _Predictor, index: int) -> bytes:
     return ndarray_to_bytes(pred.outputs[index])
+
+
+# ---- autograd (ref: c_api_ndarray.cc MXAutogradSetIsRecording /
+# MarkVariables / Backward; SURVEY §2.1 imperative+autograd) ----
+
+def autograd_set_recording(flag: int) -> int:
+    from . import autograd
+    return int(autograd.set_recording(bool(flag)))
+
+
+def autograd_set_training(flag: int) -> int:
+    from . import autograd
+    return int(autograd.set_training(bool(flag)))
+
+
+def ndarray_attach_grad(handle: NDArray) -> None:
+    handle.attach_grad()
+
+
+def ndarray_grad(handle: NDArray) -> NDArray:
+    g = handle.grad
+    if g is None:
+        raise MXNetError("no gradient: attach_grad() was not called or "
+                         "backward has not run")
+    return g
+
+
+def ndarray_backward(handle: NDArray, retain_graph: int) -> None:
+    handle.backward(retain_graph=bool(retain_graph))
+
+
+# ---- KVStore (ref: c_api.cc MXKVStoreCreate / Init / Push / Pull /
+# SetOptimizer; SURVEY §2.3) ----
+
+def kvstore_create(kind: str):
+    from .kvstore import create
+    return create(kind or "local")
+
+
+def kvstore_init(kv, keys: tuple, vals: tuple) -> None:
+    kv.init(list(keys), list(vals))
+
+
+def kvstore_push(kv, keys: tuple, vals: tuple, priority: int) -> None:
+    kv.push(list(keys), list(vals), priority=priority)
+
+
+def kvstore_pull(kv, keys: tuple, outs: tuple, priority: int) -> None:
+    kv.pull(list(keys), out=list(outs), priority=priority)
+
+
+def kvstore_set_optimizer(kv, name: str, attrs: dict) -> None:
+    from .optimizer import Optimizer
+    kv.set_optimizer(Optimizer.create_optimizer(
+        name, **{k: _parse_attr(v) for k, v in attrs.items()}))
+
+
+# ---- Symbol + Executor (ref: c_api_symbolic.cc MXSymbolCreateVariable /
+# CreateAtomicSymbol+Compose / ListArguments / CreateFromJSON;
+# c_api_executor.cc MXExecutorBindEX / Forward / Backward / Outputs) ----
+
+def symbol_create_variable(name: str):
+    from .symbol import var
+    return var(name)
+
+
+def symbol_create_from_json(json_str: str):
+    from .symbol import load_json
+    return load_json(json_str)
+
+
+def symbol_create_from_file(path: str):
+    from .symbol import load as sym_load
+    return sym_load(path)
+
+
+def symbol_invoke(op_name: str, attrs: dict, name: str, inputs: tuple):
+    """CreateAtomicSymbol + Compose in one call (the reference splits
+    these only because nnvm composes lazily — ref c_api_symbolic.cc
+    MXSymbolCreateAtomicSymbol + MXSymbolCompose)."""
+    from . import symbol as sym_mod
+    fn = getattr(sym_mod, op_name, None)
+    if fn is None:
+        raise MXNetError("unknown symbolic operator %r" % op_name)
+    kwargs = {k: _parse_attr(v) for k, v in attrs.items()}
+    if name:
+        kwargs["name"] = name
+    return fn(*inputs, **kwargs)
+
+
+def symbol_list_arguments(sym) -> tuple:
+    return tuple(sym.list_arguments())
+
+
+def symbol_list_outputs(sym) -> tuple:
+    return tuple(sym.list_outputs())
+
+
+def symbol_tojson(sym) -> str:
+    return sym.tojson()
+
+
+def executor_bind(sym, arg_names: tuple, arg_vals: tuple,
+                  grad_req: str):
+    args = dict(zip(arg_names, arg_vals))
+    return sym.bind(None, args, grad_req=grad_req or "write")
+
+
+def executor_forward(ex, is_train: int) -> tuple:
+    return tuple(ex.forward(is_train=bool(is_train)))
+
+
+def executor_backward(ex) -> None:
+    ex.backward()
+
+
+def executor_outputs(ex) -> tuple:
+    return tuple(ex.outputs)
+
+
+def executor_arg_grad(ex, name: str) -> NDArray:
+    grads = ex.grad_dict if hasattr(ex, "grad_dict") else None
+    if grads is None or name not in grads or grads[name] is None:
+        raise MXNetError("no gradient for argument %r" % name)
+    return grads[name]

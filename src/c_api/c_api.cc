@@ -16,6 +16,7 @@
 #include <cstring>
 #include <mutex>
 #include <string>
+#include <vector>
 
 namespace {
 
@@ -323,5 +324,296 @@ int MXTPUPredFree(PredictorHandle handle) {
   Py_DECREF(reinterpret_cast<PyObject *>(handle));
   return 0;
 }
+
+// ---- training surface (autograd / kvstore / symbol / executor) ----
+// Same delegation pattern as above; handles are owned PyObject refs.
+
+namespace {
+
+// generic "call impl fn, keep result as handle" helper
+int CallToHandle(const char *method, PyObject *args, void **out) {
+  PyObject *res = CallImpl(method, args);
+  if (res == nullptr) return -1;
+  *out = res;
+  return 0;
+}
+
+// generic "call impl fn, discard result" helper
+int CallNoResult(const char *method, PyObject *args) {
+  PyObject *res = CallImpl(method, args);
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+PyObject *HandleTuple(void **handles, int num) {
+  PyObject *t = PyTuple_New(num);
+  for (int i = 0; i < num; ++i) {
+    PyObject *o = reinterpret_cast<PyObject *>(handles[i]);
+    Py_INCREF(o);
+    PyTuple_SetItem(t, i, o);
+  }
+  return t;
+}
+
+PyObject *StrTuple(const char **strs, int num) {
+  PyObject *t = PyTuple_New(num);
+  for (int i = 0; i < num; ++i) {
+    PyTuple_SetItem(t, i, PyUnicode_FromString(strs[i]));
+  }
+  return t;
+}
+
+PyObject *AttrDict(const char **keys, const char **vals, int num) {
+  PyObject *d = PyDict_New();
+  for (int i = 0; i < num; ++i) {
+    PyObject *v = PyUnicode_FromString(vals[i]);
+    PyDict_SetItemString(d, keys[i], v);
+    Py_DECREF(v);
+  }
+  return d;
+}
+
+// string results stay valid until the next call on this thread (the
+// reference's internal-buffer convention, c_api_common.h:Ret*)
+thread_local std::vector<std::string> g_str_store;
+thread_local std::vector<const char *> g_str_ptrs;
+thread_local std::string g_json_store;
+
+int FreeHandle(void *handle) {
+  if (handle == nullptr) return 0;
+  GilScope gil;
+  Py_DECREF(reinterpret_cast<PyObject *>(handle));
+  return 0;
+}
+
+}  // namespace
+
+int MXTPUAutogradSetRecording(int is_recording, int *prev) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  PyObject *res = CallImpl("autograd_set_recording",
+                           Py_BuildValue("(i)", is_recording));
+  if (res == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUAutogradSetTraining(int is_training, int *prev) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  PyObject *res = CallImpl("autograd_set_training",
+                           Py_BuildValue("(i)", is_training));
+  if (res == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArrayAttachGrad(NDArrayHandle handle) {
+  GilScope gil;
+  return CallNoResult("ndarray_attach_grad",
+                      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+}
+
+int MXTPUNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  GilScope gil;
+  return CallToHandle("ndarray_grad",
+                      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)),
+                      out);
+}
+
+int MXTPUNDArrayBackward(NDArrayHandle handle, int retain_graph) {
+  GilScope gil;
+  return CallNoResult(
+      "ndarray_backward",
+      Py_BuildValue("(Oi)", reinterpret_cast<PyObject *>(handle),
+                    retain_graph));
+}
+
+int MXTPUKVStoreCreate(const char *type, KVStoreHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallToHandle("kvstore_create", Py_BuildValue("(s)", type), out);
+}
+
+int MXTPUKVStoreInit(KVStoreHandle handle, int num, const char **keys,
+                     NDArrayHandle *vals) {
+  GilScope gil;
+  return CallNoResult(
+      "kvstore_init",
+      Py_BuildValue("(ONN)", reinterpret_cast<PyObject *>(handle),
+                    StrTuple(keys, num), HandleTuple(vals, num)));
+}
+
+int MXTPUKVStorePush(KVStoreHandle handle, int num, const char **keys,
+                     NDArrayHandle *vals, int priority) {
+  GilScope gil;
+  return CallNoResult(
+      "kvstore_push",
+      Py_BuildValue("(ONNi)", reinterpret_cast<PyObject *>(handle),
+                    StrTuple(keys, num), HandleTuple(vals, num), priority));
+}
+
+int MXTPUKVStorePull(KVStoreHandle handle, int num, const char **keys,
+                     NDArrayHandle *outs, int priority) {
+  GilScope gil;
+  return CallNoResult(
+      "kvstore_pull",
+      Py_BuildValue("(ONNi)", reinterpret_cast<PyObject *>(handle),
+                    StrTuple(keys, num), HandleTuple(outs, num), priority));
+}
+
+int MXTPUKVStoreSetOptimizer(KVStoreHandle handle, const char *optimizer,
+                             const char **attr_keys, const char **attr_vals,
+                             int num_attrs) {
+  GilScope gil;
+  return CallNoResult(
+      "kvstore_set_optimizer",
+      Py_BuildValue("(OsN)", reinterpret_cast<PyObject *>(handle), optimizer,
+                    AttrDict(attr_keys, attr_vals, num_attrs)));
+}
+
+int MXTPUKVStoreFree(KVStoreHandle handle) { return FreeHandle(handle); }
+
+int MXTPUSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallToHandle("symbol_create_variable", Py_BuildValue("(s)", name),
+                      out);
+}
+
+int MXTPUSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallToHandle("symbol_create_from_json", Py_BuildValue("(s)", json),
+                      out);
+}
+
+int MXTPUSymbolCreateFromFile(const char *path, SymbolHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallToHandle("symbol_create_from_file", Py_BuildValue("(s)", path),
+                      out);
+}
+
+int MXTPUSymbolCompose(const char *op_name, const char *name,
+                       SymbolHandle *inputs, int num_inputs,
+                       const char **attr_keys, const char **attr_vals,
+                       int num_attrs, SymbolHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallToHandle(
+      "symbol_invoke",
+      Py_BuildValue("(sNsN)", op_name,
+                    AttrDict(attr_keys, attr_vals, num_attrs),
+                    name == nullptr ? "" : name,
+                    HandleTuple(inputs, num_inputs)),
+      out);
+}
+
+int MXTPUSymbolListArguments(SymbolHandle sym, int *num,
+                             const char ***out_names) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "symbol_list_arguments",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(sym)));
+  if (res == nullptr) return -1;
+  Py_ssize_t n = PyTuple_Size(res);
+  g_str_store.clear();
+  g_str_ptrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *c = PyUnicode_AsUTF8(PyTuple_GetItem(res, i));
+    g_str_store.emplace_back(c == nullptr ? "" : c);
+  }
+  for (const std::string &s : g_str_store) g_str_ptrs.push_back(s.c_str());
+  Py_DECREF(res);
+  *num = static_cast<int>(n);
+  *out_names = g_str_ptrs.data();
+  return 0;
+}
+
+int MXTPUSymbolToJSON(SymbolHandle sym, const char **out_json) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "symbol_tojson", PyTuple_Pack(1, reinterpret_cast<PyObject *>(sym)));
+  if (res == nullptr) return -1;
+  const char *c = PyUnicode_AsUTF8(res);
+  g_json_store = (c == nullptr) ? "" : c;
+  Py_DECREF(res);
+  *out_json = g_json_store.c_str();
+  return 0;
+}
+
+int MXTPUSymbolFree(SymbolHandle sym) { return FreeHandle(sym); }
+
+int MXTPUExecutorBind(SymbolHandle sym, int num_args,
+                      const char **arg_names, NDArrayHandle *arg_vals,
+                      const char *grad_req, ExecutorHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "executor_bind",
+      Py_BuildValue("(ONNs)", reinterpret_cast<PyObject *>(sym),
+                    StrTuple(arg_names, num_args),
+                    HandleTuple(arg_vals, num_args),
+                    grad_req == nullptr ? "write" : grad_req),
+      out);
+}
+
+int MXTPUExecutorForward(ExecutorHandle handle, int is_train) {
+  GilScope gil;
+  return CallNoResult(
+      "executor_forward",
+      Py_BuildValue("(Oi)", reinterpret_cast<PyObject *>(handle), is_train));
+}
+
+int MXTPUExecutorNumOutputs(ExecutorHandle handle, int *num) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "executor_outputs",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+  if (res == nullptr) return -1;
+  *num = static_cast<int>(PyTuple_Size(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUExecutorOutput(ExecutorHandle handle, int index,
+                        NDArrayHandle *out) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "executor_outputs",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+  if (res == nullptr) return -1;
+  if (index < 0 || index >= PyTuple_Size(res)) {
+    Py_DECREF(res);
+    SetError("executor output index out of range");
+    return -1;
+  }
+  PyObject *o = PyTuple_GetItem(res, index);
+  Py_INCREF(o);
+  Py_DECREF(res);
+  *out = o;
+  return 0;
+}
+
+int MXTPUExecutorBackward(ExecutorHandle handle) {
+  GilScope gil;
+  return CallNoResult(
+      "executor_backward",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+}
+
+int MXTPUExecutorArgGrad(ExecutorHandle handle, const char *arg_name,
+                         NDArrayHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "executor_arg_grad",
+      Py_BuildValue("(Os)", reinterpret_cast<PyObject *>(handle), arg_name),
+      out);
+}
+
+int MXTPUExecutorFree(ExecutorHandle handle) { return FreeHandle(handle); }
 
 }  // extern "C"

@@ -214,15 +214,16 @@ def _compile_against_abi(src_path, exe_path, compiler="gcc", extra=()):
     subprocess.run(cmd, check=True, capture_output=True, text=True)
 
 
-def _run_smoke(exe_path, prefix):
+def _run_smoke(exe_path, prefix=None):
     env = dict(os.environ)
     site = sysconfig.get_paths()["purelib"]
     env["PYTHONPATH"] = os.pathsep.join(
         [REPO, site] + env.get("PYTHONPATH", "").split(os.pathsep))
     env["MXTPU_JAX_PLATFORMS"] = "cpu"  # hermetic: no TPU tunnel from CI
-    proc = subprocess.run([str(exe_path), prefix], capture_output=True,
+    cmd = [str(exe_path)] + ([] if prefix is None else [prefix])
+    proc = subprocess.run(cmd, capture_output=True,
                           text=True, env=env, timeout=300)
-    assert proc.returncode == 0, proc.stderr
+    assert proc.returncode == 0, proc.stdout + proc.stderr
     return proc.stdout.strip().splitlines()
 
 
@@ -322,3 +323,65 @@ def test_cpp_frontend(lib, exported_model, tmp_path):
 def test_symbolblock_importable():
     """API-surface check (ref: gluon.SymbolBlock wraps exported symbols)."""
     from mxtpu.gluon import SymbolBlock  # noqa: F401
+
+
+def test_cpp_training_via_abi(lib, tmp_path):
+    """A C++ program TRAINS an MLP to convergence through the ABI (ref:
+    cpp-package/example/mlp.cpp): Symbol compose -> Executor bind ->
+    forward/backward -> KVStore sgd push/pull. The round-4 widening of the
+    C surface from predict-only to training."""
+    src = os.path.join(REPO, "examples", "cpp", "train_mlp.cpp")
+    exe = tmp_path / "train_mlp"
+    _compile_against_abi(src, exe, "g++", extra=("-std=c++14",))
+    lines = _run_smoke(exe)
+    assert "TRAINED_OK" in lines, lines
+
+
+def test_autograd_and_kvstore_from_ctypes(lib):
+    """In-process tier for the new training surface: record an imperative
+    graph, backward, read the gradient, and run one kvstore sgd step."""
+    w = _nd_from_blob(lib, np.ones((2, 2), np.float32))
+    assert lib.MXTPUNDArrayAttachGrad(w) == 0, lib.MXTPUGetLastError()
+    prev = ctypes.c_int()
+    assert lib.MXTPUAutogradSetRecording(1, ctypes.byref(prev)) == 0
+    out = (ctypes.c_void_p * 4)()
+    nout = ctypes.c_int(4)
+    assert lib.MXTPUImperativeInvoke(
+        b"square", (ctypes.c_void_p * 1)(ctypes.c_void_p(w.value)), 1,
+        None, None, 0, out, ctypes.byref(nout)) == 0, \
+        lib.MXTPUGetLastError()
+    sq = ctypes.c_void_p(out[0])
+    nout = ctypes.c_int(4)
+    assert lib.MXTPUImperativeInvoke(
+        b"sum", (ctypes.c_void_p * 1)(sq), 1, None, None, 0, out,
+        ctypes.byref(nout)) == 0, lib.MXTPUGetLastError()
+    s = ctypes.c_void_p(out[0])
+    assert lib.MXTPUAutogradSetRecording(prev.value, None) == 0
+    assert lib.MXTPUNDArrayBackward(s, 0) == 0, lib.MXTPUGetLastError()
+    g = ctypes.c_void_p()
+    assert lib.MXTPUNDArrayGetGrad(w, ctypes.byref(g)) == 0, \
+        lib.MXTPUGetLastError()
+    np.testing.assert_allclose(_nd_to_numpy(lib, g),
+                               2 * np.ones((2, 2), np.float32))
+
+    kv = ctypes.c_void_p()
+    assert lib.MXTPUKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    keys = (ctypes.c_char_p * 1)(b"w0")
+    vals = (ctypes.c_void_p * 1)(ctypes.c_void_p(w.value))
+    assert lib.MXTPUKVStoreInit(kv, 1, keys, vals) == 0, \
+        lib.MXTPUGetLastError()
+    ok = (ctypes.c_char_p * 1)(b"learning_rate")
+    ov = (ctypes.c_char_p * 1)(b"0.5")
+    assert lib.MXTPUKVStoreSetOptimizer(kv, b"sgd", ok, ov, 1) == 0, \
+        lib.MXTPUGetLastError()
+    gv = (ctypes.c_void_p * 1)(ctypes.c_void_p(g.value))
+    assert lib.MXTPUKVStorePush(kv, 1, keys, gv, 0) == 0, \
+        lib.MXTPUGetLastError()
+    assert lib.MXTPUKVStorePull(kv, 1, keys, vals, 0) == 0, \
+        lib.MXTPUGetLastError()
+    # w <- w - 0.5 * grad(=2) = 1 - 1 = 0
+    np.testing.assert_allclose(_nd_to_numpy(lib, w),
+                               np.zeros((2, 2), np.float32), atol=1e-6)
+    lib.MXTPUKVStoreFree(kv)
+    for h in (w, sq, s, g):
+        lib.MXTPUNDArrayFree(h)
