@@ -30,16 +30,19 @@ class Op:
     """A registered operator: ``fn`` works on jax arrays / pytrees; wrapper works on
     NDArrays with tape recording."""
 
-    __slots__ = ("name", "fn", "wrapper", "aliases", "as_method", "doc")
+    __slots__ = ("name", "fn", "wrapper", "aliases", "as_method", "doc",
+                 "num_outputs")
 
     def __init__(self, name: str, fn: Callable, wrapper: Callable,
-                 aliases=(), as_method: bool = False):
+                 aliases=(), as_method: bool = False, num_outputs: int = 1):
         self.name = name
         self.fn = fn
         self.wrapper = wrapper
         self.aliases = tuple(aliases)
         self.as_method = as_method
         self.doc = fn.__doc__
+        self.num_outputs = num_outputs  # STATIC count (1 = single/unknown;
+        # data-dependent counts are fixed up at execution)
 
 
 REGISTRY: Dict[str, Op] = {}
@@ -74,12 +77,27 @@ def register(name: Optional[str] = None, aliases=(), as_method: bool = False,
         else:
             wrapper = fn
 
-        op = Op(op_name, fn, wrapper, aliases=aliases, as_method=as_method)
+        op = Op(op_name, fn, wrapper, aliases=aliases, as_method=as_method,
+                num_outputs=num_outputs)
         REGISTRY[op_name] = op
         for al in aliases:
             REGISTRY[al] = op
         return wrapper
 
+    return deco
+
+
+# canonical op name -> fn(attrs) -> int: STATIC output count for ops whose
+# count depends on attrs (the reference's FNumOutputs — e.g. RNN emits
+# final states only when state_outputs). Consulted by the symbol composer
+# so sym[i] works before execution.
+NUM_OUTPUT_RULES: Dict[str, Callable] = {}
+
+
+def register_num_outputs(name: str):
+    def deco(fn: Callable):
+        NUM_OUTPUT_RULES[name] = fn
+        return fn
     return deco
 
 

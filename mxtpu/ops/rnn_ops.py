@@ -16,7 +16,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .precision_util import contract_acc, mxu_precision
-from .registry import register, register_param_shapes
+from .registry import (register, register_num_outputs,
+                       register_param_shapes)
 
 
 def _gates(mode):
@@ -105,6 +106,15 @@ def rnn_param_size(mode, num_layers, input_size, state_size, bidirectional=False
         in_sz = input_size if layer == 0 else state_size * dirs
         size += dirs * ng * state_size * (in_sz + state_size + 2)
     return size
+
+
+@register_num_outputs("RNN")
+def _rnn_num_outputs(attrs):
+    """output (+ final h, + final c for lstm) when state_outputs (ref:
+    rnn.cc FNumOutputs)."""
+    if not attrs.get("state_outputs"):
+        return 1
+    return 3 if attrs.get("mode", "lstm") == "lstm" else 2
 
 
 @register("RNN")

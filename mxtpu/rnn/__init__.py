@@ -1,25 +1,19 @@
-"""mx.rnn: legacy RNN utilities (ref: python/mxnet/rnn/).
+"""mx.rnn: legacy RNN namespace (ref: python/mxnet/rnn/).
 
-The legacy symbol-composing cells are superseded by gluon.rnn cells (which
-trace to compiled graphs via hybridize — the TPU-native path); they are
-re-exported here under the legacy names for API familiarity and operate on
-NDArrays/hybrid blocks, NOT on Symbols (cell.unroll needs static input
-shapes). Symbolic RNN graphs — e.g. BucketingModule sym_gen — use the
-fused ``mx.sym.RNN`` op instead, whose packed-parameter/state shapes are
-backward-filled by shape inference (tests/test_module.py
-test_bucketing_module_trains_over_bucket_sentence_iter shows the
-pattern). The data-side utilities (BucketSentenceIter, encode_sentences)
-are full ports.
+Round 4 restored the TRUE legacy semantics: the cells here COMPOSE Symbol
+graphs (``rnn_cell.py`` — RNNCell/LSTMCell/GRUCell/FusedRNNCell and the
+wrapper cells, with ``unroll`` building the time-unrolled graph for the
+GraphExecutor/BucketingModule path), exactly as in the reference. The
+modern NDArray/hybrid cells live in ``mxtpu.gluon.rnn``. The data-side
+utilities (BucketSentenceIter, encode_sentences) are full ports.
 """
-from ..gluon.rnn.rnn_cell import (BidirectionalCell, DropoutCell, GRUCell,
-                                  LSTMCell, ModifierCell, RNNCell,
-                                  RecurrentCell, ResidualCell,
-                                  SequentialRNNCell, ZoneoutCell)
 from .io import BucketSentenceIter, encode_sentences
+from .rnn_cell import (BaseRNNCell, BidirectionalCell, DropoutCell,
+                       FusedRNNCell, GRUCell, LSTMCell, ModifierCell,
+                       ResidualCell, RNNCell, RNNParams, SequentialRNNCell,
+                       ZoneoutCell)
 
-BaseRNNCell = RecurrentCell  # the legacy base covers all cell variants
-
-__all__ = ["RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell",
-           "BidirectionalCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
-           "ModifierCell", "BaseRNNCell", "BucketSentenceIter",
-           "encode_sentences"]
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "DropoutCell", "ZoneoutCell", "ResidualCell", "ModifierCell",
+           "BucketSentenceIter", "encode_sentences"]

@@ -99,7 +99,14 @@ def _symbolic_call(op_name, *args, name=None, **kwargs):
             vnode, _ = var(vname)._heads[0]
             in_edges.append((vnode, 0))
             kw_arrays.append(pname)
-    node = _Node(op.name, name, attrs, in_edges, pos_template, kw_arrays)
+    # static output count, so sym[i] works BEFORE execution (nnvm knows
+    # this statically via FNumOutputs; here: the registry count, overridden
+    # by a num_outputs attr for split-style ops)
+    rule = _reg.NUM_OUTPUT_RULES.get(op.name)
+    n_out = int(rule(attrs) if rule is not None
+                else attrs.get("num_outputs", op.num_outputs))
+    node = _Node(op.name, name, attrs, in_edges, pos_template, kw_arrays,
+                 num_outputs=n_out)
     return Symbol([(node, None)])
 
 

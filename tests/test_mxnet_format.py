@@ -199,3 +199,26 @@ def test_scalar_arrays_preserved_via_native_fallback(tmp_path):
     assert p.read_bytes()[:8] == b"MXTPU001"
     out = mx.nd.load(str(p))
     assert out["s"].shape == () and float(out["s"].asnumpy()) == 3.0
+
+
+def test_committed_reference_fixture():
+    """A COMMITTED reference-format artifact must keep loading forever
+    (the reference's tests/python/legacy_ndarray.v0 pattern): V2 dense
+    (incl. a GPU-context record and an f64), V1 and pre-V1 legacy
+    records, and a CSR record, with the expected values pinned here."""
+    import os
+    path = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "reference_format.params")
+    out = mx.nd.load(path)
+    a = np.arange(24, dtype=np.float32).reshape(2, 3, 4) * 0.5 - 3
+    np.testing.assert_array_equal(out["arg:conv0_weight"].asnumpy(), a)
+    np.testing.assert_array_equal(out["aux:stat_f64"].asnumpy(),
+                                  np.array([[1, -2], [3, -4]], np.float64))
+    np.testing.assert_array_equal(out["legacy:v1_u8"].asnumpy(),
+                                  np.array([250, 7, 13]))
+    np.testing.assert_array_equal(out["legacy:pre_v1_i32"].asnumpy(),
+                                  np.array([[9, 8], [7, 6]]))
+    csr = out["sparse:csr"]
+    np.testing.assert_array_equal(
+        csr.todense().asnumpy(),
+        np.array([[0, 0, 1.5], [-2.5, 0, 0]], np.float32))

@@ -1,0 +1,217 @@
+"""Legacy mx.rnn symbolic cells (ref: tests/python/unittest/test_rnn.py —
+unroll each cell kind, bind, check shapes; LSTM additionally against a
+NumPy oracle)."""
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import rnn
+
+B, T, I, H = 4, 3, 5, 6
+
+
+def _bind_forward(outputs, states, feed):
+    """Group outputs+states, bind with feed dict, return forward values."""
+    from mxtpu.symbol import Group
+    heads = (list(outputs) if isinstance(outputs, (list, tuple))
+             else [outputs]) + list(states)
+    g = Group(heads)
+    args = {n: mx.nd.array(feed[n]) for n in g.list_arguments()
+            if n in feed}
+    missing = [n for n in g.list_arguments() if n not in feed]
+    assert not missing, "unbound args: %s" % missing
+    exe = g.bind(args=args, grad_req="null")
+    return [o.asnumpy() for o in exe.forward()]
+
+
+def _feed(names, rng):
+    return {n: rng.uniform(-0.5, 0.5, s).astype(np.float32)
+            for n, s in names.items()}
+
+
+def test_rnn_cell_unroll():
+    cell = rnn.RNNCell(H, prefix="rnn_")
+    x = mx.sym.var("data")
+    outputs, states = cell.unroll(
+        T, inputs=x, begin_state=cell.begin_state(batch_size=B),
+        layout="NTC", merge_outputs=False)
+    rng = np.random.RandomState(0)
+    feed = _feed({"data": (B, T, I), "rnn_i2h_weight": (H, I),
+                  "rnn_i2h_bias": (H,), "rnn_h2h_weight": (H, H),
+                  "rnn_h2h_bias": (H,)}, rng)
+    vals = _bind_forward(outputs, states, feed)
+    assert all(v.shape == (B, H) for v in vals[:T])
+    # oracle: h_t = tanh(x W_i^T + b_i + h W_h^T + b_h)
+    h = np.zeros((B, H), np.float32)
+    for t in range(T):
+        h = np.tanh(feed["data"][:, t] @ feed["rnn_i2h_weight"].T
+                    + feed["rnn_i2h_bias"]
+                    + h @ feed["rnn_h2h_weight"].T + feed["rnn_h2h_bias"])
+        np.testing.assert_allclose(vals[t], h, rtol=1e-5, atol=1e-6)
+
+
+def test_lstm_cell_oracle_and_merge():
+    cell = rnn.LSTMCell(H, prefix="lstm_", forget_bias=1.0)
+    outputs, states = cell.unroll(
+        T, inputs=mx.sym.var("data"),
+        begin_state=cell.begin_state(batch_size=B), layout="NTC",
+        merge_outputs=True)
+    rng = np.random.RandomState(1)
+    feed = _feed({"data": (B, T, I), "lstm_i2h_weight": (4 * H, I),
+                  "lstm_i2h_bias": (4 * H,), "lstm_h2h_weight": (4 * H, H),
+                  "lstm_h2h_bias": (4 * H,)}, rng)
+    merged, h_out, c_out = _bind_forward(outputs, states, feed)
+    assert merged.shape == (B, T, H)
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    for t in range(T):
+        gates = (feed["data"][:, t] @ feed["lstm_i2h_weight"].T
+                 + feed["lstm_i2h_bias"]
+                 + h @ feed["lstm_h2h_weight"].T + feed["lstm_h2h_bias"])
+        i, f, g, o = np.split(gates, 4, axis=1)
+        c = sig(f + 1.0) * c + sig(i) * np.tanh(g)  # forget_bias 1.0
+        h = sig(o) * np.tanh(c)
+        np.testing.assert_allclose(merged[:, t], h, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(h_out, h, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(c_out, c, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_cell_unroll():
+    cell = rnn.GRUCell(H, prefix="gru_")
+    outputs, states = cell.unroll(
+        T, inputs=mx.sym.var("data"),
+        begin_state=cell.begin_state(batch_size=B), merge_outputs=True)
+    rng = np.random.RandomState(2)
+    feed = _feed({"data": (B, T, I), "gru_i2h_weight": (3 * H, I),
+                  "gru_i2h_bias": (3 * H,), "gru_h2h_weight": (3 * H, H),
+                  "gru_h2h_bias": (3 * H,)}, rng)
+    merged = _bind_forward(outputs, states, feed)[0]
+    assert merged.shape == (B, T, H)
+    assert np.isfinite(merged).all()
+
+
+def test_stacked_residual_dropout_cells():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(H, prefix="l0_"))
+    stack.add(rnn.ResidualCell(rnn.LSTMCell(H, prefix="l1_")))
+    stack.add(rnn.DropoutCell(0.5, prefix="do_"))
+    outputs, states = stack.unroll(
+        T, inputs=mx.sym.var("data"),
+        begin_state=stack.begin_state(batch_size=B), merge_outputs=True)
+    assert len(states) == 4  # 2 LSTM cells x (h, c); dropout stateless
+    rng = np.random.RandomState(3)
+    shapes = {"data": (B, T, H)}
+    for p in ("l0_", "l1_"):
+        shapes.update({p + "i2h_weight": (4 * H, H),
+                       p + "i2h_bias": (4 * H,),
+                       p + "h2h_weight": (4 * H, H),
+                       p + "h2h_bias": (4 * H,)})
+    vals = _bind_forward(outputs, states, _feed(shapes, rng))
+    assert vals[0].shape == (B, T, H)
+
+
+def test_bidirectional_cell():
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(H, prefix="l_"),
+                               rnn.LSTMCell(H, prefix="r_"))
+    outputs, states = bi.unroll(
+        T, inputs=mx.sym.var("data"),
+        begin_state=bi.begin_state(batch_size=B), merge_outputs=True)
+    rng = np.random.RandomState(4)
+    shapes = {"data": (B, T, I)}
+    for p in ("l_", "r_"):
+        shapes.update({p + "i2h_weight": (4 * H, I),
+                       p + "i2h_bias": (4 * H,),
+                       p + "h2h_weight": (4 * H, H),
+                       p + "h2h_bias": (4 * H,)})
+    vals = _bind_forward(outputs, states, _feed(shapes, rng))
+    assert vals[0].shape == (B, T, 2 * H)
+    # the reverse half must actually see the reversed sequence: the last
+    # H columns at t=0 depend on the whole sequence, so they differ from
+    # a fwd-only unroll's t=0 (weak but real asymmetry check)
+    assert not np.allclose(vals[0][:, 0, H:], vals[0][:, -1, H:])
+
+
+def test_fused_rnn_cell_and_unfuse():
+    fused = rnn.FusedRNNCell(H, num_layers=2, mode="lstm", prefix="f_",
+                             get_next_state=True)
+    outputs, states = fused.unroll(
+        T, inputs=mx.sym.var("data"),
+        begin_state=fused.begin_state(batch_size=B), layout="NTC",
+        merge_outputs=True)
+    # packed parameter size: layer0 4H(I+H) + 8H bias, layer1 4H(H+H) + 8H
+    n_params = (4 * H * (I + H) + 8 * H) + (4 * H * (H + H) + 8 * H)
+    rng = np.random.RandomState(5)
+    feed = _feed({"data": (B, T, I), "f_parameters": (n_params,)}, rng)
+    vals = _bind_forward(outputs, states, feed)
+    assert vals[0].shape == (B, T, H)
+    assert vals[1].shape == (2, B, H) and vals[2].shape == (2, B, H)
+
+    stack = fused.unfuse()
+    outputs2, _ = stack.unroll(
+        T, inputs=mx.sym.var("data"),
+        begin_state=stack.begin_state(batch_size=B), merge_outputs=True)
+    names = set()
+    from mxtpu.symbol import Group
+    g = Group([outputs2])
+    names = set(g.list_arguments())
+    assert "f_l0_i2h_weight" in names and "f_l1_h2h_weight" in names
+
+
+def test_zoneout_cell_runs():
+    z = rnn.ZoneoutCell(rnn.RNNCell(H, prefix="z_"), zoneout_outputs=0.3,
+                        zoneout_states=0.3)
+    outputs, states = z.unroll(
+        T, inputs=mx.sym.var("data"),
+        begin_state=z.begin_state(batch_size=B), merge_outputs=True)
+    rng = np.random.RandomState(6)
+    feed = _feed({"data": (B, T, I), "z_i2h_weight": (H, I),
+                  "z_i2h_bias": (H,), "z_h2h_weight": (H, H),
+                  "z_h2h_bias": (H,)}, rng)
+    vals = _bind_forward(outputs, states, feed)
+    assert np.isfinite(vals[0]).all()
+
+
+def test_fused_unpack_matches_unfused_stack():
+    """fused.unroll(blob) == unfuse().unroll(unpack_weights(blob)) — the
+    reference's documented fused<->unfused workflow, checked numerically,
+    plus pack_weights round-trip."""
+    fused = rnn.FusedRNNCell(H, num_layers=2, mode="lstm", prefix="f_")
+    outputs, _ = fused.unroll(
+        T, inputs=mx.sym.var("data"),
+        begin_state=fused.begin_state(batch_size=B), merge_outputs=True)
+    n_params = (4 * H * (I + H) + 8 * H) + (4 * H * (H + H) + 8 * H)
+    rng = np.random.RandomState(7)
+    feed = _feed({"data": (B, T, I), "f_parameters": (n_params,)}, rng)
+    fused_out = _bind_forward(outputs, [], feed)[0]
+
+    stack = fused.unfuse()
+    s_out, _ = stack.unroll(
+        T, inputs=mx.sym.var("data"),
+        begin_state=stack.begin_state(batch_size=B), merge_outputs=True)
+    unpacked = fused.unpack_weights({"f_parameters":
+                                     mx.nd.array(feed["f_parameters"])})
+    feed2 = {k: (v.asnumpy() if hasattr(v, "asnumpy") else v)
+             for k, v in unpacked.items()}
+    feed2["data"] = feed["data"]
+    stack_out = _bind_forward(s_out, [], feed2)[0]
+    np.testing.assert_allclose(stack_out, fused_out, rtol=1e-4, atol=1e-5)
+
+    packed = fused.pack_weights(unpacked)
+    np.testing.assert_allclose(packed["f_parameters"].asnumpy(),
+                               feed["f_parameters"], rtol=1e-6)
+
+
+def test_cell_weight_sharing_via_params():
+    """Weight sharing through an explicit RNNParams container (ref:
+    RNNParams docstring): two cells with the same prefix+params reuse the
+    SAME variables; the stack's merged container sees them once."""
+    c1 = rnn.LSTMCell(H, prefix="s0_")
+    c2 = rnn.LSTMCell(H, prefix="s0_", params=c1.params)
+    assert c2._iW is c1._iW and c2._hB is c1._hB
+    stack = rnn.SequentialRNNCell()
+    stack.add(c1)
+    stack.add(c2)
+    assert "s0_i2h_weight" in stack.params._params
