@@ -1,0 +1,101 @@
+"""Large-tensor tier: >2^31-element arrays (ref: tests/nightly/
+test_large_array.py, gated there by the USE_INT64_TENSOR_SIZE build).
+
+The TPU-native analog of that build flag is jax's x64 mode: with it the
+engine indexes in int64 and every path below is exact past 2^31 (verified
+here); without it jax truncates indices to int32 (slice raises
+OverflowError rather than corrupting — checked too). The checks run in a
+SUBPROCESS so JAX_ENABLE_X64 can be set before jax initializes.
+
+Opt-in (like the reference's nightly tier): MXTPU_TEST_LARGE=1, needs
+~4 GB RAM and a few minutes.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("MXTPU_TEST_LARGE") != "1",
+    reason="large-tensor tier: set MXTPU_TEST_LARGE=1 (needs ~4GB RAM)")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHECKS = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import mxtpu as mx
+
+N = (1 << 31) + 5  # past int32 element count
+
+x = mx.nd.zeros((N,), dtype="uint8")
+assert x.shape == (N,)
+x[N - 2] = 7                      # setitem past 2^31
+assert int(x[N - 2].asnumpy()) == 7
+assert x[N - 4:N - 1].asnumpy().tolist() == [0, 0, 7]
+assert int(x._data.sum()) == 7  # fused reduce; no int64 copy
+
+# engine-level int64 indexing is exact (the framework argmax keeps the
+# reference's float32 return convention, which rounds past 2^24)
+am = x._data.argmax()
+assert am.dtype == jnp.int64 and int(am) == N - 2, (am.dtype, int(am))
+assert int(jnp.take(x._data, jnp.asarray([N - 2]))[0]) == 7
+print("OK1D")
+"""
+
+_CHECKS_2D = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import mxtpu as mx
+
+rows, cols = 1 << 16, (1 << 15) + 1           # 2^31 + 2^16 elements
+y = mx.nd.zeros((rows, cols), dtype="uint8")
+y[rows - 1, cols - 1] = 9
+assert int(y[rows - 1, cols - 1].asnumpy()) == 9
+t = y[rows - 1]
+assert t.shape == (cols,) and int(t.asnumpy()[-1]) == 9
+print("OK2D")
+"""
+
+
+def _run(code, x64):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO
+    env["JAX_ENABLE_X64"] = "1" if x64 else "0"
+    return subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=1200)
+
+
+def test_large_1d_int64_indexing():
+    out = _run(_CHECKS, x64=True)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "OK1D" in out.stdout
+
+
+def test_large_2d_indexing():
+    out = _run(_CHECKS_2D, x64=True)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "OK2D" in out.stdout
+
+
+def test_without_x64_fails_loudly_not_silently():
+    """Outside the large-tensor mode, indexing past 2^31 must ERROR
+    (OverflowError from the int32 index path), never silently truncate —
+    the failure mode the reference's int64 build gate also guards."""
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import mxtpu as mx\n"
+        "N = (1 << 31) + 5\n"
+        "x = mx.nd.zeros((N,), dtype='uint8')\n"
+        "try:\n"
+        "    v = x[N - 2].asnumpy()\n"
+        "    print('SILENT', v)\n"
+        "except Exception as e:\n"
+        "    print('RAISED', type(e).__name__)\n")
+    out = _run(code, x64=False)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "RAISED" in out.stdout, out.stdout
