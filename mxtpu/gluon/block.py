@@ -394,7 +394,8 @@ class CachedOp:
         return True
 
     def _get_jit(self, fmt_key, train):
-        key = (fmt_key, train)
+        from ..ops.registry import policy_key
+        key = (fmt_key, train, policy_key())
         if key in self._jits:
             return self._jits[key]
         block, params = self._block, self._params

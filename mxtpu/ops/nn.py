@@ -359,6 +359,30 @@ def _leaky_impl(x, gamma, act_type, slope):
     raise ValueError("unknown act_type " + act_type)
 
 
+def _bn_onepass():
+    """MXTPU_BN_ONEPASS=1 enables single-read batch statistics (default
+    off until the end-to-end effect is measured on chip — the round-3
+    lesson: stage levers behind flags, flip on evidence). Baked into
+    compiled executables: registry.policy_key() puts it in jit cache
+    keys so mid-process flips recompile."""
+    import os
+    return os.environ.get("MXTPU_BN_ONEPASS", "0") == "1"
+
+
+def bn_batch_stats(xf, red):
+    """(mean, var) over axes ``red`` under the active stats policy — THE
+    implementation BatchNorm compiles and tools/perf_bn.py measures.
+    One-pass mode: E[x] and E[x^2] in one fused read, var clamped >= 0
+    (catastrophic-cancellation floor; BN's eps covers the residue)."""
+    mean = jnp.mean(xf, axis=red)
+    if _bn_onepass():
+        var = jnp.maximum(
+            jnp.mean(jnp.square(xf), axis=red) - jnp.square(mean), 0.0)
+    else:
+        var = jnp.var(xf, axis=red)
+    return mean, var
+
+
 @register("BatchNorm", aliases=("batch_norm",), wrap=False)
 def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
               fix_gamma=True, use_global_stats=False, output_mean_var=False,
@@ -380,8 +404,7 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9
         g = jnp.ones_like(g_) if fix_gamma else g_
         if training:
             red = tuple(i for i in range(x.ndim) if i != ax)
-            mean = jnp.mean(x.astype(jnp.float32), axis=red)
-            var = jnp.var(x.astype(jnp.float32), axis=red)
+            mean, var = bn_batch_stats(x.astype(jnp.float32), red)
         else:
             mean, var = mm, mv
         inv = lax.rsqrt(var + eps)

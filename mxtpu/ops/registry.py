@@ -87,6 +87,17 @@ def register(name: Optional[str] = None, aliases=(), as_method: bool = False,
     return deco
 
 
+def policy_key():
+    """Trace-time env policies that get BAKED INTO compiled executables
+    (f32-accumulate convs, one-pass BN stats). Every jit cache keyed on
+    shapes/modes must include this tuple, or flipping a policy flag
+    mid-process silently reuses executables traced under the old policy
+    (an A/B measurement would then compare a lever with itself)."""
+    import os
+    return (os.environ.get("MXTPU_CONV_ACC", "1"),
+            os.environ.get("MXTPU_BN_ONEPASS", "0"))
+
+
 # canonical op name -> fn(attrs) -> int: STATIC output count for ops whose
 # count depends on attrs (the reference's FNumOutputs — e.g. RNN emits
 # final states only when state_outputs). Consulted by the symbol composer

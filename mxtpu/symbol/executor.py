@@ -170,7 +170,8 @@ class Executor:
                                          NamedSharding(mesh, spec)))
 
     def _run_jit(self, feed, is_train):
-        key = (is_train,) + tuple(
+        from ..ops.registry import policy_key
+        key = (is_train, policy_key()) + tuple(
             (k, feed[k].shape, str(feed[k].dtype)) for k in sorted(feed))
         if key not in self._jits:
             sym = self._symbol
@@ -216,7 +217,8 @@ class Executor:
             return
         sym = self._symbol
         names = sorted(feed)
-        key = ("bwd", is_train) + tuple(
+        from ..ops.registry import policy_key
+        key = ("bwd", is_train, policy_key()) + tuple(
             (k, feed[k].shape, str(feed[k].dtype)) for k in names)
         if key not in self._jits:
             def bwd(datas, cots):

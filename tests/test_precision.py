@@ -76,3 +76,53 @@ def test_whole_resnet_step_precision():
     bad = [c for c in convs if "HIGHEST" in c]
     assert not bad, "%d/%d convs at HIGHEST precision" % (len(bad),
                                                           len(convs))
+
+
+def test_bn_onepass_stats_match_twopass(monkeypatch):
+    """MXTPU_BN_ONEPASS=1 (single-read E[x^2]-mean^2 stats, the staged
+    round-4 HBM lever) must match the two-pass default to f32 tolerance
+    in training mode, eager AND hybridized (the policy is part of the
+    jit cache key — registry.policy_key — so the hybrid A/B genuinely
+    recompiles rather than reusing the first trace)."""
+    import numpy as np
+
+    import mxtpu as mx
+    from mxtpu import autograd
+    from mxtpu.gluon import nn
+
+    x = np.random.RandomState(0).uniform(-2, 2, (8, 6, 5, 5)) \
+        .astype(np.float32)
+
+    def run(hybridize):
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = nn.BatchNorm(in_channels=6)
+        net.initialize()
+        if hybridize:
+            net.hybridize()
+        with autograd.record():
+            out = net(mx.nd.array(x))
+        return out.asnumpy()
+
+    for hyb in (False, True):
+        monkeypatch.delenv("MXTPU_BN_ONEPASS", raising=False)
+        two = run(hyb)
+        monkeypatch.setenv("MXTPU_BN_ONEPASS", "1")
+        one = run(hyb)
+        np.testing.assert_allclose(one, two, rtol=1e-4, atol=1e-5)
+
+    # the cache-key guarantee itself: one SHARED hybridized net must
+    # recompile when the policy flips (a stale reuse would make A/B
+    # measurements vacuous)
+    net = nn.BatchNorm(in_channels=6)
+    net.initialize()
+    net.hybridize()
+    monkeypatch.setenv("MXTPU_BN_ONEPASS", "0")
+    with autograd.record():
+        net(mx.nd.array(x))
+    n_jits = len(net._cached_op._jits) if net._cached_op else 0
+    monkeypatch.setenv("MXTPU_BN_ONEPASS", "1")
+    with autograd.record():
+        net(mx.nd.array(x))
+    assert len(net._cached_op._jits) > n_jits, \
+        "policy flip did not recompile the cached executable"

@@ -28,14 +28,26 @@ BENCH_CONFIG=resnet50 BENCH_S2D_STEM=1 run python bench.py
 # 3. localize the slow forward (stage-by-stage attribution)
 run env PYTHONPATH=.:tools:/root/.axon_site python tools/perf_stages.py
 
-# 4. all scoring configs (lstm/bert should gain from dot f32-accumulate)
+# 4. BatchNorm attribution (round-4 lever): TPU HLO fusion structure +
+#    measured conv vs conv+bn cost, two-pass vs one-pass stats
+run env PYTHONPATH=.:/root/.axon_site python tools/perf_bn.py
+MXTPU_BN_ONEPASS=1 run env PYTHONPATH=.:/root/.axon_site python tools/perf_bn.py
+
+# 5. resnet50 with one-pass BN stats end-to-end (compare to #1)
+BENCH_CONFIG=resnet50 MXTPU_BN_ONEPASS=1 run python bench.py
+
+# 6. all scoring configs (lstm/bert should gain from dot f32-accumulate;
+#    includes the never-yet-measured eager number — VERDICT r3 #9)
 run python bench.py
 
-# 5. validate the ceiling numbers post-fix
+# 7. validate the ceiling numbers post-fix
 run env PYTHONPATH=.:tools:/root/.axon_site python tools/perf_peak.py
 run env PYTHONPATH=.:tools:/root/.axon_site python tools/perf_conv_acc.py
 
-# 6. zoo inference scoring sweep (reference benchmark_score tables)
+# 8. zoo inference scoring sweep (reference benchmark_score tables)
 BENCH_BATCHES=1,32,128 run python tools/benchmark_score.py
+
+# 9. communication bandwidth (tools/bandwidth kit; single chip: h2d/d2h)
+run env PYTHONPATH=.:/root/.axon_site python tools/bandwidth.py --sizes-mb 16,64
 
 echo "battery complete -> $LOG"
