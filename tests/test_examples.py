@@ -40,6 +40,21 @@ def test_word_language_model(capsys):
     assert "ppl" in capsys.readouterr().out
 
 
+def test_lstm_bucketing_legacy_cells(capsys):
+    """The classic mx.rnn + BucketingModule workflow (ref: example/rnn/
+    bucketing/lstm_bucketing.py): legacy symbolic cells, one executor per
+    bucket, must CONVERGE on the synthetic next-token pattern (uniform
+    perplexity over vocab 32 would be 32; require < 10)."""
+    _run("examples/rnn/lstm_bucketing.py",
+         ["--epochs", "4", "--batch-size", "8", "--num-hidden", "16",
+          "--num-embed", "8"])
+    out = capsys.readouterr().out
+    final = [l for l in out.splitlines() if l.startswith("final ")]
+    assert final, out
+    ppl = float(final[-1].split()[-1])
+    assert ppl < 10.0, out
+
+
 def test_sparse_linear_classification():
     # existing example (BASELINE config 5) keeps working through main
     import importlib.util
