@@ -42,8 +42,33 @@ int MXTPURuntimeInit(const char *platform);
 int MXTPUNDArrayCreateFromBlob(const float *data, const int64_t *shape,
                                int ndim, NDArrayHandle *out);
 
+/* Create with an explicit dtype (mshadow flags: 0 f32, 1 f64, 2 f16,
+ * 3 u8, 4 i32, 5 i8, 6 i64; ref MXNDArrayCreateEx). data points at
+ * packed little-endian elements of that dtype. */
+int MXTPUNDArrayCreateFromBlobEx(const void *data, int dtype_flag,
+                                 const int64_t *shape, int ndim,
+                                 NDArrayHandle *out);
+
 /* ndim/shape of the array; shape must have room for 8 dims. */
 int MXTPUNDArrayShape(NDArrayHandle handle, int *ndim, int64_t *shape);
+
+/* mshadow dtype flag of the array (ref MXNDArrayGetDType). */
+int MXTPUNDArrayGetDType(NDArrayHandle handle, int *out_flag);
+
+/* Save arrays to a reference-format .params file (0x112 layout real
+ * MXNet reads; ref MXNDArraySave). keys may be NULL for a nameless
+ * list. */
+int MXTPUNDArraySave(const char *fname, int num, NDArrayHandle *handles,
+                     const char **keys);
+
+/* Load a .params file (either format; ref MXNDArrayLoad). Returned
+ * arrays are new handles owned by the caller (free each); the
+ * *out_handles ARRAY itself and the name pointers are only valid until
+ * the next MXTPUNDArrayLoad on this thread — copy the handle pointers
+ * out before loading again. *out_names is NULL for a nameless list. */
+int MXTPUNDArrayLoad(const char *fname, int *out_num,
+                     NDArrayHandle **out_handles, int *out_num_names,
+                     const char ***out_names);
 
 /* Synchronous device->host copy as float32 (the deferred-exception sync
  * point: async errors surface here, ref threaded_engine.cc:472). */

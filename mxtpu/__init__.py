@@ -32,6 +32,7 @@ if _cache_dir:
     _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 from . import base
+from . import context  # module alias (ref: mxnet/context.py)
 from .base import Context, MXNetError, cpu, current_context, gpu, num_gpus, tpu
 from . import autograd
 from .layout import layout

@@ -242,3 +242,50 @@ def executor_arg_grad(ex, name: str) -> NDArray:
     if grads is None or name not in grads or grads[name] is None:
         raise MXNetError("no gradient for argument %r" % name)
     return grads[name]
+
+
+# ---- dtype-aware create / save / load (ref: MXNDArrayCreateEx,
+# MXNDArraySave, MXNDArrayLoad over src/c_api/c_api.cc:1035-1120) ----
+
+_DTYPE_FLAGS = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+                4: "int32", 5: "int8", 6: "int64"}
+_FLAGS_BY_NAME = {v: k for k, v in _DTYPE_FLAGS.items()}
+
+
+def ndarray_from_blob_ex(data: bytes, dtype_flag: int, shape: tuple):
+    name = _DTYPE_FLAGS.get(int(dtype_flag))
+    if name is None:
+        raise MXNetError("unknown mshadow dtype flag %d" % dtype_flag)
+    a = np.frombuffer(data, dtype=np.dtype(name)).reshape(shape)
+    return nd.array(a, dtype=name)
+
+
+def ndarray_dtype_flag(handle: NDArray) -> int:
+    name = str(handle.dtype)
+    if name == "bfloat16":  # no reference flag; surfaced as its f32 carrier
+        return 0
+    flag = _FLAGS_BY_NAME.get(name)
+    if flag is None:
+        raise MXNetError("dtype %s has no mshadow flag" % name)
+    return flag
+
+
+def ndarray_save(fname: str, handles: tuple, names: tuple) -> None:
+    from .ndarray.utils import save as nd_save
+    if names:
+        if len(set(names)) != len(names):
+            # the dict-keyed writer would silently drop all but the last
+            # duplicate; refuse loudly instead (the reference would write
+            # both records, which this engine's named files cannot)
+            raise MXNetError("duplicate keys in NDArray save")
+        nd_save(fname, dict(zip(names, handles)))
+    else:
+        nd_save(fname, list(handles))
+
+
+def ndarray_load(fname: str):
+    from .ndarray.utils import load as nd_load
+    out = nd_load(fname)
+    if isinstance(out, dict):
+        return tuple(out.values()), tuple(out.keys())
+    return tuple(out), ()

@@ -1,0 +1,10 @@
+"""mx.context module alias (ref: python/mxnet/context.py).
+
+The implementation lives in ``base.py`` (Context maps device kinds onto
+jax devices — 'gpu' means the accelerator, i.e. the TPU chip, see the
+Context docstring); this module preserves the reference's import path
+(``from mxnet import context`` / ``mx.context.cpu()``).
+"""
+from .base import Context, cpu, gpu, current_context, num_gpus
+
+__all__ = ["Context", "cpu", "gpu", "current_context", "num_gpus"]

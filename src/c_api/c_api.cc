@@ -389,6 +389,81 @@ int FreeHandle(void *handle) {
 
 }  // namespace
 
+int MXTPUNDArrayCreateFromBlobEx(const void *data, int dtype_flag,
+                                 const int64_t *shape, int ndim,
+                                 NDArrayHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  static const int kSizes[] = {4, 8, 2, 1, 4, 1, 8};
+  if (dtype_flag < 0 || dtype_flag > 6) {
+    SetError("unknown mshadow dtype flag");
+    return -1;
+  }
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data),
+      static_cast<Py_ssize_t>(n * kSizes[dtype_flag]));
+  PyObject *args = Py_BuildValue("(NiN)", bytes, dtype_flag,
+                                 ShapeTuple(shape, ndim));
+  return CallToHandle("ndarray_from_blob_ex", args, out);
+}
+
+int MXTPUNDArrayGetDType(NDArrayHandle handle, int *out_flag) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "ndarray_dtype_flag",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+  if (res == nullptr) return -1;
+  *out_flag = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArraySave(const char *fname, int num, NDArrayHandle *handles,
+                     const char **keys) {
+  GilScope gil;
+  PyObject *names = keys == nullptr ? PyTuple_New(0) : StrTuple(keys, num);
+  return CallNoResult(
+      "ndarray_save",
+      Py_BuildValue("(sNN)", fname, HandleTuple(handles, num), names));
+}
+
+int MXTPUNDArrayLoad(const char *fname, int *out_num,
+                     NDArrayHandle **out_handles, int *out_num_names,
+                     const char ***out_names) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  PyObject *res = CallImpl("ndarray_load", Py_BuildValue("(s)", fname));
+  if (res == nullptr) return -1;
+  PyObject *arrays = PyTuple_GetItem(res, 0);
+  PyObject *names = PyTuple_GetItem(res, 1);
+  // Load owns PRIVATE stores: sharing g_str_store with the Symbol calls
+  // would break both functions' documented name lifetimes
+  static thread_local std::vector<void *> handle_store;
+  static thread_local std::vector<std::string> name_store;
+  static thread_local std::vector<const char *> name_ptrs;
+  handle_store.clear();
+  for (Py_ssize_t i = 0; i < PyTuple_Size(arrays); ++i) {
+    PyObject *o = PyTuple_GetItem(arrays, i);
+    Py_INCREF(o);  // each becomes a caller-owned handle
+    handle_store.push_back(o);
+  }
+  name_store.clear();
+  name_ptrs.clear();
+  for (Py_ssize_t i = 0; i < PyTuple_Size(names); ++i) {
+    const char *c = PyUnicode_AsUTF8(PyTuple_GetItem(names, i));
+    name_store.emplace_back(c == nullptr ? "" : c);
+  }
+  for (const std::string &s : name_store) name_ptrs.push_back(s.c_str());
+  Py_DECREF(res);
+  *out_num = static_cast<int>(handle_store.size());
+  *out_handles = handle_store.data();
+  *out_num_names = static_cast<int>(name_ptrs.size());
+  *out_names = name_ptrs.empty() ? nullptr : name_ptrs.data();
+  return 0;
+}
+
 int MXTPUAutogradSetRecording(int is_recording, int *prev) {
   if (!EnsureInterpreter()) return -1;
   GilScope gil;

@@ -385,3 +385,49 @@ def test_autograd_and_kvstore_from_ctypes(lib):
     lib.MXTPUKVStoreFree(kv)
     for h in (w, sq, s, g):
         lib.MXTPUNDArrayFree(h)
+
+
+def test_ndarray_save_load_dtype_from_c(lib, tmp_path):
+    """C-side save writes a REAL reference-format .params the python side
+    reads (and vice versa), with dtype-aware creation (ref:
+    MXNDArraySave/Load/CreateEx)."""
+    # dtype-aware create: int32
+    a = np.array([[1, -2], [3, 4]], np.int32)
+    shape = (ctypes.c_int64 * 2)(2, 2)
+    h = ctypes.c_void_p()
+    assert lib.MXTPUNDArrayCreateFromBlobEx(
+        a.ctypes.data_as(ctypes.c_void_p), 4, shape, 2,
+        ctypes.byref(h)) == 0, lib.MXTPUGetLastError()
+    flag = ctypes.c_int()
+    assert lib.MXTPUNDArrayGetDType(h, ctypes.byref(flag)) == 0
+    assert flag.value == 4
+
+    f = str(tmp_path / "cside.params").encode()
+    keys = (ctypes.c_char_p * 1)(b"arg:w")
+    handles = (ctypes.c_void_p * 1)(ctypes.c_void_p(h.value))
+    assert lib.MXTPUNDArraySave(f, 1, handles, keys) == 0, \
+        lib.MXTPUGetLastError()
+    # python loads the C-written file; bytes are the 0x112 layout
+    import struct as _struct
+    raw = open(f, "rb").read(8)
+    assert _struct.unpack("<Q", raw)[0] == 0x112
+    out = mx.nd.load(f.decode())
+    np.testing.assert_array_equal(out["arg:w"].asnumpy(), a)
+
+    # C loads a python-written file
+    f2 = str(tmp_path / "pyside.params")
+    mx.nd.save(f2, {"x": mx.nd.array(np.arange(3, dtype=np.float32))})
+    n = ctypes.c_int()
+    hs = ctypes.POINTER(ctypes.c_void_p)()
+    nn = ctypes.c_int()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXTPUNDArrayLoad(f2.encode(), ctypes.byref(n),
+                                ctypes.byref(hs), ctypes.byref(nn),
+                                ctypes.byref(names)) == 0, \
+        lib.MXTPUGetLastError()
+    assert n.value == 1 and nn.value == 1
+    assert names[0] == b"x"
+    got = _nd_to_numpy(lib, ctypes.c_void_p(hs[0]))
+    np.testing.assert_array_equal(got, np.arange(3, dtype=np.float32))
+    lib.MXTPUNDArrayFree(ctypes.c_void_p(hs[0]))
+    lib.MXTPUNDArrayFree(h)
