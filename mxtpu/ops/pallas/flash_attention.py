@@ -31,6 +31,13 @@ _NEG_INF = -1e30
 
 
 def _xla_attention(q, k, v, causal, scale):
+    out, _ = _xla_attention_lse(q, k, v, causal, scale)
+    return out
+
+
+def _xla_attention_lse(q, k, v, causal, scale):
+    """Fallback attention returning (out, lse) — ONE copy of the XLA math
+    (softmax(s) == exp(s - lse) exactly); differentiable directly."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32),
                    preferred_element_type=jnp.float32) * scale
@@ -38,10 +45,11 @@ def _xla_attention(q, k, v, causal, scale):
         tq, tk = s.shape[-2:]
         mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
         s = jnp.where(mask[None, None], s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
     out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
                      preferred_element_type=jnp.float32)
-    return out.astype(q.dtype)
+    return out.astype(q.dtype), lse
 
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
@@ -146,15 +154,23 @@ def _fa_forward_pallas(q, k, v, causal, scale, block_q, block_k):
     return out.reshape(b, h, t, d), lse[:, :, 0].reshape(b, h, t)
 
 
-def _fa_backward_blockwise(q, k, v, out, lse, g, causal, scale, block_k):
+def _fa_backward_blockwise(q, k, v, out, lse, g, causal, scale, block_k,
+                           g_lse=None):
     """Flash-attention-2 backward, blockwise over k in plain jax:
     P = exp(S - lse); dv = P^T g; ds = P * (g v^T - D); dq += ds k; dk += ds^T q.
+
+    ``g_lse`` is the cotangent of the lse OUTPUT (flash_attention_with_lse;
+    d lse_i / d s_ik = P_ik, so it adds ``P * g_lse`` to ds).
     """
     f32 = jnp.float32
     q32, k32, v32 = q.astype(f32), k.astype(f32), v.astype(f32)
     g32, out32 = g.astype(f32), out.astype(f32)
     t, tk = q.shape[2], k.shape[2]
     delta = jnp.sum(out32 * g32, axis=-1)            # [b, h, t]
+    if g_lse is not None:
+        # fold the lse cotangent into the per-row constant: ds = P * (dP
+        # - delta + g_lse), same row-broadcast shape as delta
+        delta = delta - g_lse.astype(f32)
     n_k = tk // block_k
     q_pos = jnp.arange(t)
 
@@ -224,6 +240,8 @@ def _fa_bwd(causal, scale, block_q, block_k, res, g):
     q, k, v, out, lse = res
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    block_k = min(block_k, k.shape[2])  # forward clamps too; tk < block_k
+    # would give n_k = 0 and a zero-length scan
     if lse is None:
         # fallback path: differentiate the XLA implementation directly
         _, vjp = jax.vjp(lambda q_, k_, v_:
@@ -234,3 +252,51 @@ def _fa_bwd(causal, scale, block_q, block_k, res, g):
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(q, k, v, causal=False, scale=None, block_q=512,
+                             block_k=512):
+    """Like :func:`flash_attention` but ALSO returns the per-row
+    log-sum-exp [B, H, T] — the quantity that lets partial attention
+    results over disjoint key sets be merged exactly (ring attention's
+    per-step blocks combine as out = Σ_j softmax(lse_j) out_j)."""
+    out, lse, _res = _fa_lse_fwd_impl(q, k, v, causal, scale, block_q,
+                                      block_k)
+    return out, lse
+
+
+def _fa_lse_fwd_impl(q, k, v, causal, scale, block_q, block_k):
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    block_q = min(block_q, q.shape[2])
+    block_k = min(block_k, k.shape[2])
+    if not _supported(q, k, block_q, block_k):
+        out, lse = _xla_attention_lse(q, k, v, causal, scale)
+        return out, lse, (q, k, v, out, None)
+    out, lse = _fa_forward_pallas(q, k, v, causal, scale, block_q, block_k)
+    return out, lse, (q, k, v, out, lse)
+
+
+def _fa_lse_fwd(q, k, v, causal, scale, block_q, block_k):
+    out, lse, res = _fa_lse_fwd_impl(q, k, v, causal, scale, block_q,
+                                     block_k)
+    return (out, lse), res
+
+
+def _fa_lse_bwd(causal, scale, block_q, block_k, res, cots):
+    g, g_lse = cots
+    q, k, v, out, lse = res
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    block_k = min(block_k, k.shape[2])  # mirror the forward's clamp
+    if lse is None:
+        _, vjp = jax.vjp(lambda q_, k_, v_:
+                         _xla_attention_lse(q_, k_, v_, causal, scale),
+                         q, k, v)
+        return vjp((g, g_lse))
+    return _fa_backward_blockwise(q, k, v, out, lse, g, causal, scale,
+                                  block_k, g_lse=g_lse)
+
+
+flash_attention_with_lse.defvjp(_fa_lse_fwd, _fa_lse_bwd)

@@ -95,7 +95,8 @@ def policy_key():
     (an A/B measurement would then compare a lever with itself)."""
     import os
     return (os.environ.get("MXTPU_CONV_ACC", "1"),
-            os.environ.get("MXTPU_BN_ONEPASS", "0"))
+            os.environ.get("MXTPU_BN_ONEPASS", "0"),
+            os.environ.get("MXTPU_RING_FLASH", "0"))
 
 
 # canonical op name -> fn(attrs) -> int: STATIC output count for ops whose

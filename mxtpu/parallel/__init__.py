@@ -19,9 +19,10 @@ no tensor/sequence/context parallelism) is first-class here:
 """
 from .mesh import make_mesh, data_parallel_mesh
 from .train import ShardedTrainStep, pure_forward
-from .ring_attention import ring_attention, ring_self_attention
+from .ring_attention import ring_attention, ring_flash_attention, ring_self_attention
 from .pipeline import pipeline_apply
 from .moe import switch_ffn, shard_experts
 
 __all__ = ["make_mesh", "data_parallel_mesh", "ShardedTrainStep", "pipeline_apply", "switch_ffn", "shard_experts",
-           "pure_forward", "ring_attention", "ring_self_attention"]
+           "pure_forward", "ring_attention", "ring_flash_attention",
+           "ring_self_attention"]

@@ -19,7 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["ring_attention", "ring_self_attention"]
+__all__ = ["ring_attention", "ring_flash_attention",
+           "ring_self_attention"]
 
 _NEG_INF = -1e30  # mask value; avoids -inf - -inf = nan in the online rescale
 
@@ -84,6 +85,71 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     return out.astype(q.dtype)
 
 
+def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
+                         block_q=512, block_k=512):
+    """Ring attention whose per-step block runs the FUSED flash kernel
+    (Pallas on TPU; XLA fallback elsewhere) instead of materializing the
+    [T_local, T_local] block scores. Per-step partial results merge
+    exactly via their log-sum-exps:
+
+        out = Σ_j exp(lse_j - lse_total) · out_j
+
+    The rotation schedule makes causality STATIC per step: at step 0
+    every device attends its OWN diagonal block (causal kernel); later
+    steps see strictly-past blocks (merged via lse) or strictly-future
+    blocks (fully masked — the kernel is SKIPPED via lax.cond, matching
+    the dense body's ~half-FLOP causal saving). Staged
+    behind MXTPU_RING_FLASH (see registry.policy_key) pending on-chip
+    measurement; numerics are pinned against the dense path either way.
+    """
+    from ..ops.pallas.flash_attention import flash_attention_with_lse
+
+    n = jax.lax.psum(1, axis_name)  # concrete inside shard_map
+    idx = jax.lax.axis_index(axis_name)
+    b, h, t_local, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def merge(o_a, lse_a, o_b, lse_b):
+        m = jnp.maximum(lse_a, lse_b)
+        wa = jnp.exp(lse_a - m)
+        wb = jnp.exp(lse_b - m)
+        den = jnp.maximum(wa + wb, 1e-30)
+        o = (o_a * wa[..., None] + o_b * wb[..., None]) / den[..., None]
+        return o, m + jnp.log(den)
+
+    o_run = jnp.zeros((b, h, t_local, d), jnp.float32)
+    lse_run = jnp.full((b, h, t_local), _NEG_INF, jnp.float32)
+    k_c, v_c = k, v
+    for j in range(n):
+        if causal and j > 0:
+            # strictly-future blocks (src > idx) are fully masked: skip
+            # the kernel entirely, as the dense ring body does
+            src = (idx - j) % n
+
+            def _attend(args):
+                o_r, lse_r, k_b, v_b = args
+                out_j, lse_j = flash_attention_with_lse(
+                    q, k_b, v_b, causal=False, scale=scale,
+                    block_q=block_q, block_k=block_k)
+                return merge(o_r, lse_r, out_j.astype(jnp.float32), lse_j)
+
+            o_run, lse_run = jax.lax.cond(
+                src < idx, _attend, lambda args: (args[0], args[1]),
+                (o_run, lse_run, k_c, v_c))
+        else:
+            out_j, lse_j = flash_attention_with_lse(
+                q, k_c, v_c, causal=causal, scale=scale,
+                block_q=block_q, block_k=block_k)
+            o_run, lse_run = merge(o_run, lse_run,
+                                   out_j.astype(jnp.float32), lse_j)
+        if j < n - 1:
+            k_c = jax.lax.ppermute(k_c, axis_name, perm)
+            v_c = jax.lax.ppermute(v_c, axis_name, perm)
+    return o_run.astype(q.dtype)
+
+
 def _dense_attention(q, k, v, causal=False, scale=None):
     """Single-device reference path (the degenerate 1-shard ring) — one
     implementation shared with flash_attention's fallback."""
@@ -107,7 +173,10 @@ def ring_self_attention(q, k, v, mesh=None, seq_axis="sp", batch_axis=None,
         from ..ops.pallas import flash_attention
         return flash_attention(q, k, v, causal=causal, scale=scale)
     spec = P(batch_axis, None, seq_axis, None)
-    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
+    import os
+    body = ring_flash_attention \
+        if os.environ.get("MXTPU_RING_FLASH", "0") == "1" else ring_attention
+    fn = functools.partial(body, axis_name=seq_axis, causal=causal,
                            scale=scale)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

@@ -63,3 +63,68 @@ def test_flash_grad_through_custom_vjp():
     g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     assert all(jnp.all(jnp.isfinite(x)) for x in g)
     assert float(jnp.abs(g[0]).sum()) > 0
+
+
+def test_flash_attention_with_lse_matches_dense():
+    """(out, lse) fallback pair vs direct logsumexp + softmax, and the
+    custom_vjp with a NONZERO lse cotangent vs jax.vjp of the plain XLA
+    implementation (pins the g_lse term in the blockwise backward)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mxtpu.ops.pallas.flash_attention import (_xla_attention_lse,
+                                                  flash_attention_with_lse)
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 2, 16, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, 2, 16, 8).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, 2, 16, 8).astype(np.float32))
+    for causal in (False, True):
+        out, lse = flash_attention_with_lse(q, k, v, causal, None, 8, 8)
+        ref_out, ref_lse = _xla_attention_lse(q, k, v, causal,
+                                              1.0 / (8 ** 0.5))
+        np.testing.assert_allclose(out, ref_out, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(lse, ref_lse, rtol=1e-5, atol=1e-5)
+
+        g = jnp.asarray(rng.randn(*out.shape).astype(np.float32))
+        g_lse = jnp.asarray(rng.randn(*lse.shape).astype(np.float32))
+
+        def fa(q_, k_, v_):
+            return flash_attention_with_lse(q_, k_, v_, causal, None, 8, 8)
+
+        def ref(q_, k_, v_):
+            return _xla_attention_lse(q_, k_, v_, causal, 1.0 / (8 ** 0.5))
+
+        _, vjp_fa = jax.vjp(fa, q, k, v)
+        _, vjp_ref = jax.vjp(ref, q, k, v)
+        for a, b in zip(vjp_fa((g, g_lse)), vjp_ref((g, g_lse))):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+def test_blockwise_backward_g_lse_term():
+    """_fa_backward_blockwise with a g_lse cotangent must equal jax.vjp of
+    the XLA (out, lse) pair — pins the TPU backward's lse math on CPU."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mxtpu.ops.pallas.flash_attention import (_fa_backward_blockwise,
+                                                  _xla_attention_lse)
+
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 2, 16, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 2, 16, 8).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 2, 16, 8).astype(np.float32))
+    scale = 1.0 / (8 ** 0.5)
+    for causal in (False, True):
+        out, lse = _xla_attention_lse(q, k, v, causal, scale)
+        g = jnp.asarray(rng.randn(*out.shape).astype(np.float32))
+        g_lse = jnp.asarray(rng.randn(*lse.shape).astype(np.float32))
+        dq, dk, dv = _fa_backward_blockwise(q, k, v, out, lse, g, causal,
+                                            scale, block_k=8, g_lse=g_lse)
+        _, vjp = jax.vjp(lambda q_, k_, v_:
+                         _xla_attention_lse(q_, k_, v_, causal, scale),
+                         q, k, v)
+        for a, b in zip((dq, dk, dv), vjp((g, g_lse))):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
