@@ -54,6 +54,17 @@ from . import io
 from . import recordio
 from . import image
 from . import profiler
+
+# MXNET_PROFILER_AUTOSTART parity (ref docs/faq/env_var.md:152): profile
+# the whole program without code changes; dump lands in profile.json at
+# exit. Both the native and the reference env names are honored.
+if _os.environ.get("MXTPU_PROFILER_AUTOSTART",
+                   _os.environ.get("MXNET_PROFILER_AUTOSTART", "0")) == "1":
+    import atexit as _atexit
+
+    profiler.set_config(filename="profile.json")
+    profiler.start()
+    _atexit.register(lambda: (profiler.stop(), profiler.dump()))
 from . import model
 from . import callback
 from . import monitor

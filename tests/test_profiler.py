@@ -68,3 +68,27 @@ def test_orphan_guard_noops_while_parent_alive():
     import time
     time.sleep(0.2)
     assert t.is_alive()  # parent (us) still alive -> guard keeps watching
+
+
+def test_profiler_autostart_env(tmp_path):
+    """MXTPU_PROFILER_AUTOSTART=1 profiles the whole program with no code
+    changes and dumps profile.json at exit (ref env_var.md:152)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS",)}
+    env.update({"PYTHONPATH": repo, "JAX_PLATFORMS": "cpu",
+                "MXTPU_PROFILER_AUTOSTART": "1"})
+    code = ("import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "import mxtpu as mx\n"
+            "mx.nd.dot(mx.nd.ones((4, 4)), mx.nd.ones((4, 4))).asnumpy()\n")
+    out = subprocess.run([sys.executable, "-c", code], cwd=str(tmp_path),
+                         env=env, capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 0, out.stderr[-1500:]
+    trace = json.loads((tmp_path / "profile.json").read_text())
+    assert any("dot" in e["name"] for e in trace["traceEvents"])
