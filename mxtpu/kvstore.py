@@ -179,6 +179,21 @@ class KVStore:
         from . import distributed
         distributed.barrier("mxtpu_kvstore_barrier")
 
+    def _send_command_to_servers(self, head, body):
+        """(ref: kvstore.py:616 → MXKVStoreSendCommmandToServers, used for
+        server-side optimizer setup and kSetProfilerParams). This runtime
+        has NO server processes by design (symmetric workers, README ADR):
+        optimizer state lives in every worker (set_optimizer) and profiling
+        is per-process (mx.profiler / MXTPU_PROFILER_AUTOSTART), so there
+        is nowhere to send a command. Raises with that guidance instead of
+        silently dropping the command."""
+        raise MXNetError(
+            "no parameter-server processes exist in this runtime "
+            "(symmetric workers — README ADR). Server-side optimizer setup "
+            "is set_optimizer() on each worker; server profiling is "
+            "per-process mx.profiler (MXTPU_PROFILER_AUTOSTART=1 for "
+            "whole-program capture).")
+
     def get_num_dead_node(self, node_id=0, timeout=60):
         """Failure-detection parity (ref: kvstore.h:353 — ps-lite heartbeat
         dead-node counts). The TPU runtime has no heartbeat-and-continue

@@ -193,3 +193,14 @@ def test_get_num_dead_node_parity():
     kv = mx.kv.create("local")
     assert kv.get_num_dead_node() == 0
     assert kv.get_num_dead_node(node_id=3, timeout=1) == 0
+
+
+def test_send_command_to_servers_raises_with_guidance():
+    """Reference-parity shim (kvstore.py:616): no server processes exist
+    in the symmetric runtime, so the command endpoint must refuse with
+    migration guidance, not silently drop."""
+    import mxtpu as mx
+
+    kv = mx.kv.create("local")
+    with pytest.raises(mx.MXNetError, match="symmetric workers"):
+        kv._send_command_to_servers(4, "profile")
