@@ -396,20 +396,13 @@ def _preflight():
     dict; rec["ok"] is False when the chip is wedged. BENCH_PREFLIGHT=0
     skips, BENCH_PREFLIGHT_TIMEOUT overrides the 120 s budget."""
     import subprocess
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import perf_probe  # ONE copy of the wedge-safe probe (tools/)
     timeout_s = int(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "120"))
-    code = (
-        "import time, jax, jax.numpy as jnp, numpy as np\n"
-        "t0 = time.perf_counter()\n"
-        "f = jax.jit(lambda v: v + 1)\n"
-        "v = jnp.ones((8, 8))\n"
-        "np.asarray(jax.device_get(f(v).ravel()[:2]))\n"
-        "t1 = time.perf_counter()\n"
-        "for _ in range(3):\n"
-        "    np.asarray(jax.device_get(f(v).ravel()[:2]))\n"
-        "print('PREFLIGHT %.3f %.4f'\n"
-        "      % (t1 - t0, (time.perf_counter() - t1) / 3))\n")
     try:
-        out = subprocess.run([sys.executable, "-u", "-c", code],
+        out = subprocess.run([sys.executable, "-u", "-c",
+                              perf_probe.PROBE_SNIPPET],
                              capture_output=True, text=True,
                              timeout=timeout_s)
     except subprocess.TimeoutExpired:
@@ -417,12 +410,12 @@ def _preflight():
                 "error": "chip/tunnel WEDGED: trivial jit dispatch did not "
                          "complete in %ds (distinct from slow — a healthy "
                          "chip answers this in seconds)" % timeout_s}
-    for line in out.stdout.splitlines():
-        if line.startswith("PREFLIGHT"):
-            _, first, rtt = line.split()
-            return {"metric": "preflight", "ok": True,
-                    "first_dispatch_s": float(first),
-                    "rtt_s": float(rtt)}
+    stages = perf_probe.parse(out.stdout)
+    if "rtt_ms" in stages:
+        return {"metric": "preflight", "ok": True,
+                "first_dispatch_s": stages.get("first_dispatch"),
+                "rtt_s": stages["rtt_ms"] / 1e3,
+                "platform": stages.get("platform")}
     return {"metric": "preflight", "ok": False,
             "error": "preflight subprocess failed rc=%d: %s"
                      % (out.returncode, (out.stderr or "")[-300:])}

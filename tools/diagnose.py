@@ -1,0 +1,120 @@
+"""Environment + accelerator diagnostics (ref: tools/diagnose.py, which
+dumps platform/version/connectivity info for bug reports).
+
+TPU-native re-design: the flaky link on this runtime is the device
+tunnel, so the centerpiece is a WEDGE-SAFE backend probe — device
+discovery and a trivial dispatch run in a SUBPROCESS under a timeout, so
+a hung PJRT client can never hang the diagnostic itself (the same
+isolation bench.py's preflight uses; see PERF.md on the round-3 wedge).
+
+Usage:
+    python tools/diagnose.py [--timeout 90]
+
+Verdicts: HEALTHY (dispatch round-trips; RTT printed), WEDGED (devices
+or dispatch never answered — the round-3 signature), BROKEN (import or
+backend registration failed), CPU-ONLY (no accelerator platform).
+"""
+import argparse
+import os
+import platform
+import subprocess
+import sys
+import time
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_TOOLS)
+for _p in (_REPO, _TOOLS):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+import perf_probe  # noqa: E402 — ONE copy of the wedge-safe jit probe,
+# shared with bench.py's preflight (tools/perf_probe.py)
+
+
+def section(title):
+    print("\n----- %s -----" % title)
+
+
+def versions():
+    section("versions")
+    print("python   :", sys.version.split()[0], platform.platform())
+    for mod in ("jax", "jaxlib", "numpy", "flax", "optax", "orbax"):
+        try:
+            m = __import__(mod)
+            print("%-9s: %s" % (mod, getattr(m, "__version__", "?")))
+        except Exception as e:  # noqa: BLE001
+            print("%-9s: unavailable (%s)" % (mod, e))
+    try:
+        import mxtpu
+        print("mxtpu    :", getattr(mxtpu, "__version__", "dev"),
+              os.path.dirname(mxtpu.__file__))
+    except Exception as e:  # noqa: BLE001
+        print("mxtpu    : IMPORT FAILED (%s)" % e)
+
+
+def environment():
+    section("environment")
+    for k in sorted(os.environ):
+        if k.startswith(("MXTPU_", "MXNET_", "JAX_", "XLA_", "LIBTPU_",
+                         "PALLAS_", "AXON_", "TPU_")):
+            v = os.environ[k]
+            if any(t in k.upper() for t in ("TOKEN", "SECRET", "KEY")):
+                v = "<redacted>"
+            print("%s=%s" % (k, v))
+
+
+def native_lib():
+    section("native library")
+    try:
+        from mxtpu._native import build_error, get_lib
+        lib = get_lib()
+        print("_libmxtpu.so:", "loaded" if lib else
+              "build failed: %s" % build_error())
+    except Exception as e:  # noqa: BLE001
+        print("_libmxtpu.so: unavailable (%s)" % e)
+
+
+def backend_probe(timeout_s):
+    """The wedge-safe accelerator check; returns the verdict string."""
+    section("backend probe (subprocess, %ds timeout)" % timeout_s)
+    t0 = time.time()
+    try:
+        out = subprocess.run([sys.executable, "-u", "-c",
+                              perf_probe.PROBE_SNIPPET],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired as e:
+        got = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) \
+            else (e.stdout or "")
+        stage = "device discovery" if "devices" not in got else "dispatch"
+        print(got.strip())
+        print("VERDICT: WEDGED — %s did not answer in %ds (the round-3 "
+              "tunnel-wedge signature; see PERF.md). A healthy chip "
+              "answers in seconds." % (stage, timeout_s))
+        return "WEDGED"
+    print(out.stdout.strip())
+    if out.returncode != 0:
+        print(out.stderr.strip()[-800:])
+        print("VERDICT: BROKEN — backend failed to initialize "
+              "(%.1fs)" % (time.time() - t0))
+        return "BROKEN"
+    stages = perf_probe.parse(out.stdout)
+    verdict = "CPU-ONLY" if stages.get("platform") == "cpu" else "HEALTHY"
+    print("VERDICT: %s (platform %s, %.1fs total)"
+          % (verdict, stages.get("platform", "?"), time.time() - t0))
+    return verdict
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--timeout", type=int, default=90)
+    ns = ap.parse_args(argv)
+    versions()
+    environment()
+    native_lib()
+    verdict = backend_probe(ns.timeout)
+    return 0 if verdict in ("HEALTHY", "CPU-ONLY") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,78 @@
+"""Parse a training log into a table (ref: tools/parse_log.py).
+
+Works on this framework's logs, whose lines use the reference's exact
+formats (callback.py Speedometer "Epoch[N] Batch [B]\tSpeed: S
+samples/sec", base_module "Epoch[N] Train-metric=V" /
+"Epoch[N] Validation-metric=V", and "Time cost=T").
+
+Usage:
+    python tools/parse_log.py train.log [--format markdown|csv|none]
+"""
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+
+def parse(lines):
+    """-> (sorted epoch list, {epoch: {column: value}}) with mean speed."""
+    rows = defaultdict(dict)
+    speeds = defaultdict(list)
+    for line in lines:
+        m = re.search(r"Epoch\[(\d+)\]", line)
+        if m is None:
+            continue
+        epoch = int(m.group(1))
+        s = re.search(r"Speed: ([\d.]+) samples/sec", line)
+        if s:
+            speeds[epoch].append(float(s.group(1)))
+        for name, val in re.findall(
+                r"(Train-[^=\s]+|Validation-[^=\s]+)=([\d.eE+-]+|-?nan|-?inf)", line):
+            rows[epoch][name] = float(val)
+        t = re.search(r"Time cost=([\d.]+)", line)
+        if t:
+            rows[epoch]["time"] = float(t.group(1))
+    for epoch, vals in speeds.items():
+        rows[epoch]["speed"] = sum(vals) / len(vals)
+    return sorted(rows), dict(rows)
+
+
+def render(epochs, rows, fmt):
+    cols = sorted({c for r in rows.values() for c in r})
+    header = ["epoch"] + cols
+    out = []
+    if fmt == "markdown":
+        out.append("| " + " | ".join(header) + " |")
+        out.append("|" + "---|" * len(header))
+        pat = "| {} |"
+        join = " | "
+    elif fmt == "csv":
+        out.append(",".join(header))
+        pat = "{}"
+        join = ","
+    else:
+        return ""
+    for e in epochs:
+        cells = [str(e)] + [("%g" % rows[e][c]) if c in rows[e] else ""
+                            for c in cols]
+        out.append(pat.format(join.join(cells)))
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("logfile", nargs=1)
+    ap.add_argument("--format", default="markdown",
+                    choices=("markdown", "csv", "none"))
+    ns = ap.parse_args(argv)
+    with open(ns.logfile[0]) as f:
+        epochs, rows = parse(f)
+    if not epochs:
+        print("no Epoch[...] lines found", file=sys.stderr)
+        return 1
+    print(render(epochs, rows, ns.format))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

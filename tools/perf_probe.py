@@ -1,0 +1,55 @@
+"""THE wedge-safe accelerator probe — one copy, two users: bench.py's
+preflight and tools/diagnose.py's backend section.
+
+The snippet runs in a SUBPROCESS under a caller-enforced timeout so a
+hung PJRT client can never hang the caller (PERF.md round-3 wedge). It
+prints staged lines so the caller can tell device discovery from
+dispatch from steady-state RTT:
+
+    PROBE jax_imported <s>
+    PROBE devices <s> <platform> <count>
+    PROBE first_dispatch <s>
+    PROBE rtt_ms <ms>
+
+``parse(stdout)`` returns the stages as a dict (missing keys = the probe
+died before that stage).
+"""
+
+PROBE_SNIPPET = r"""
+import time
+t0 = time.perf_counter()
+import jax
+print("PROBE jax_imported %.2f" % (time.perf_counter() - t0), flush=True)
+devs = jax.devices()
+print("PROBE devices %.2f %s %s" % (time.perf_counter() - t0,
+                                    devs[0].platform, len(devs)),
+      flush=True)
+import numpy as np
+import jax.numpy as jnp
+f = jax.jit(lambda v: v + 1)
+v = jnp.ones((8, 8))
+td = time.perf_counter()
+np.asarray(jax.device_get(f(v).ravel()[:2]))
+print("PROBE first_dispatch %.3f" % (time.perf_counter() - td), flush=True)
+t1 = time.perf_counter()
+for _ in range(5):
+    np.asarray(jax.device_get(f(v).ravel()[:2]))
+print("PROBE rtt_ms %.2f" % ((time.perf_counter() - t1) / 5 * 1e3),
+      flush=True)
+"""
+
+
+def parse(stdout):
+    """PROBE lines -> {stage: value}; 'platform'/'device_count' from the
+    devices line."""
+    out = {}
+    for line in (stdout or "").splitlines():
+        parts = line.split()
+        if not line.startswith("PROBE ") or len(parts) < 3:
+            continue
+        stage = parts[1]
+        out[stage] = float(parts[2])
+        if stage == "devices" and len(parts) >= 5:
+            out["platform"] = parts[3]
+            out["device_count"] = int(parts[4])
+    return out
