@@ -36,6 +36,18 @@ const char *MXTPUGetLastError(void);
  * platform may be "tpu", "cpu", or NULL for the environment default. */
 int MXTPURuntimeInit(const char *platform);
 
+/* Library version as MAJOR*10000 + MINOR*100 + PATCH (ref MXGetVersion). */
+int MXTPUGetVersion(int *out);
+
+/* Every registered operator name (ref MXListAllOpNames). The returned
+ * pointers stay valid until the next MXTPUListAllOpNames on this
+ * thread. */
+int MXTPUListAllOpNames(int *out_num, const char ***out_names);
+
+/* Block until all queued async work has completed (ref MXNDArrayWaitAll;
+ * deferred async errors surface here). */
+int MXTPUNDArrayWaitAll(void);
+
 /* ---- NDArray (ref: MXNDArrayCreate* / MXNDArraySyncCopy*) ---- */
 
 /* Create from a float32 host blob. */

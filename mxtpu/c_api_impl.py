@@ -289,3 +289,31 @@ def ndarray_load(fname: str):
     if isinstance(out, dict):
         return tuple(out.values()), tuple(out.keys())
     return tuple(out), ()
+
+
+# ---- introspection / sync (ref: MXGetVersion, MXListAllOpNames,
+# MXNDArrayWaitAll) ----
+
+def get_version() -> int:
+    """Reference packs MAJOR*10000 + MINOR*100 + PATCH (c_api.cc)."""
+    import re
+    from .libinfo import __version__
+    parts = (__version__.split(".") + ["0", "0"])[:3]
+    nums = []
+    for part in parts:
+        m = re.match(r"\d+", part)  # "0rc1" -> 0 (pre-release suffixes)
+        nums.append(int(m.group()) if m else 0)
+    return nums[0] * 10000 + nums[1] * 100 + nums[2]
+
+
+def list_all_op_names() -> tuple:
+    from .ops.registry import list_ops
+    return tuple(list_ops())
+
+
+def ndarray_wait_all() -> None:
+    # NOT ndarray.waitall(), which swallows: the C contract is that
+    # deferred async errors SURFACE here (-1 + MXTPUGetLastError), the
+    # reference's MXNDArrayWaitAll semantics
+    import jax
+    jax.effects_barrier()

@@ -127,6 +127,51 @@ int MXTPURuntimeInit(const char *platform) {
   return 0;
 }
 
+int MXTPUGetVersion(int *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  PyObject *res = CallImpl("get_version", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+namespace {
+// MXTPUListAllOpNames' private string store (documented lifetime: until
+// the next call on this thread)
+thread_local std::vector<std::string> g_op_name_store;
+thread_local std::vector<const char *> g_op_name_ptrs;
+}  // namespace
+
+int MXTPUListAllOpNames(int *out_num, const char ***out_names) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  PyObject *res = CallImpl("list_all_op_names", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  g_op_name_store.clear();
+  g_op_name_ptrs.clear();
+  for (Py_ssize_t i = 0; i < PyTuple_Size(res); ++i) {
+    const char *c = PyUnicode_AsUTF8(PyTuple_GetItem(res, i));
+    g_op_name_store.emplace_back(c == nullptr ? "" : c);
+  }
+  for (const std::string &sname : g_op_name_store)
+    g_op_name_ptrs.push_back(sname.c_str());
+  Py_DECREF(res);
+  *out_num = static_cast<int>(g_op_name_ptrs.size());
+  *out_names = g_op_name_ptrs.data();
+  return 0;
+}
+
+int MXTPUNDArrayWaitAll(void) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  PyObject *res = CallImpl("ndarray_wait_all", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
 int MXTPUNDArrayCreateFromBlob(const float *data, const int64_t *shape,
                                int ndim, NDArrayHandle *out) {
   if (!EnsureInterpreter()) return -1;

@@ -431,3 +431,22 @@ def test_ndarray_save_load_dtype_from_c(lib, tmp_path):
     np.testing.assert_array_equal(got, np.arange(3, dtype=np.float32))
     lib.MXTPUNDArrayFree(ctypes.c_void_p(hs[0]))
     lib.MXTPUNDArrayFree(h)
+
+
+def test_version_opnames_waitall(lib):
+    """Introspection + sync surface (ref MXGetVersion / MXListAllOpNames /
+    MXNDArrayWaitAll)."""
+    v = ctypes.c_int()
+    assert lib.MXTPUGetVersion(ctypes.byref(v)) == 0
+    from mxtpu.libinfo import __version__
+    parts = (__version__.split(".") + ["0", "0"])[:3]
+    assert v.value == (int(parts[0]) * 10000 + int(parts[1]) * 100
+                       + int(parts[2]))
+    n = ctypes.c_int()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXTPUListAllOpNames(ctypes.byref(n),
+                                   ctypes.byref(names)) == 0
+    got = {names[i].decode() for i in range(n.value)}
+    assert {"FullyConnected", "Convolution", "dot"} <= got
+    assert n.value > 200
+    assert lib.MXTPUNDArrayWaitAll() == 0
