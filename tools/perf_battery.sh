@@ -1,53 +1,42 @@
 #!/bin/bash
-# The ordered on-chip measurement backlog (PERF.md "staged levers").
-# Run FIRST THING in a session with a healthy chip; each step is
-# independently useful and the order front-loads the headline numbers.
-# Serialize: never run two TPU processes at once (see PERF.md outage note).
+# The on-chip measurement backlog, ONE PJRT SESSION edition.
+#
+# Round-4 on-chip evidence: the tunnel wedged on the 4th-6th client
+# session of the morning (probe + 2x bench each spawning a preflight
+# subprocess = ~6 sessions in 10 min; the next process hung at its
+# first dispatch and the wedge persisted for hours). Sessions are the
+# scarce resource, so the whole battery now runs inside a single
+# process (tools/perf_session.py) that prints+flushes each result as
+# it lands — a mid-session wedge costs the tail, not the data already
+# taken. Serialize: never run two TPU processes at once.
 set -u
 cd "$(dirname "$0")/.."
 LOG=${1:-perf_battery.log}
-# warm compiles across the battery's processes (tunnel compiles cost minutes)
 export MXTPU_COMPILE_CACHE=${MXTPU_COMPILE_CACHE:-/tmp/mxtpu_compile_cache}
-run() {
-  echo "=== $* ($(date +%H:%M:%S)) ===" | tee -a "$LOG"
-  timeout "${STEP_TIMEOUT:-1200}" "$@" 2>&1 | grep -v WARNING | tee -a "$LOG"
-}
 
-# 0. is the chip alive? (90s; bail early if wedged)
+# 0. is the chip alive? (90 s; bail early if wedged). This is the ONLY
+#    extra session besides the battery itself.
 timeout 90 python -c "
 import jax, jax.numpy as jnp, numpy as np
 np.asarray(jax.device_get(jax.jit(lambda v: v+1)(jnp.ones(2))))
 print('chip alive')" || { echo "CHIP WEDGED — aborting battery"; exit 1; }
 
-# 1. headline: resnet50 with the f32-accumulate conv path (round-3 change)
-BENCH_CONFIG=resnet50 run python bench.py
+# grace: let the probe's session release fully before the battery claims
+sleep 20
 
-# 2. the space-to-depth stem variant (exactly-equivalent; compare to #1)
-BENCH_CONFIG=resnet50 BENCH_S2D_STEM=1 run python bench.py
+# 1. everything, one session, most valuable phases first (resnet50
+#    control → each lever → stage attribution → BN microtiming → peak →
+#    eager/lstm/bert). stdbuf keeps the tee line-live so a killed run
+#    still shows where it died.
+timeout "${SESSION_TIMEOUT:-3600}" stdbuf -oL -eL \
+  python -u tools/perf_session.py 2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
 
-# 3. localize the slow forward (stage-by-stage attribution)
-run env PYTHONPATH=.:tools:/root/.axon_site python tools/perf_stages.py
-
-# 4. BatchNorm attribution (round-4 lever): TPU HLO fusion structure +
-#    measured conv vs conv+bn cost, two-pass vs one-pass stats
-run env PYTHONPATH=.:/root/.axon_site python tools/perf_bn.py
-MXTPU_BN_ONEPASS=1 run env PYTHONPATH=.:/root/.axon_site python tools/perf_bn.py
-
-# 5. resnet50 with one-pass BN stats end-to-end (compare to #1)
-BENCH_CONFIG=resnet50 MXTPU_BN_ONEPASS=1 run python bench.py
-
-# 6. all scoring configs (lstm/bert should gain from dot f32-accumulate;
-#    includes the never-yet-measured eager number — VERDICT r3 #9)
-run python bench.py
-
-# 7. validate the ceiling numbers post-fix
-run env PYTHONPATH=.:tools:/root/.axon_site python tools/perf_peak.py
-run env PYTHONPATH=.:tools:/root/.axon_site python tools/perf_conv_acc.py
-
-# 8. zoo inference scoring sweep (reference benchmark_score tables)
-BENCH_BATCHES=1,32,128 run python tools/benchmark_score.py
-
-# 9. communication bandwidth (tools/bandwidth kit; single chip: h2d/d2h)
-run env PYTHONPATH=.:/root/.axon_site python tools/bandwidth.py --sizes-mb 16,64
+# 2. lower-priority extras, each its own session, spaced by a release
+#    grace period (observed: back-to-back claims correlate with wedges)
+sleep 60
+timeout 1200 python tools/benchmark_score.py 2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
+sleep 60
+timeout 900 env PYTHONPATH=.:/root/.axon_site python tools/bandwidth.py \
+  --sizes-mb 16,64 2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
 
 echo "battery complete -> $LOG"
