@@ -154,6 +154,61 @@ def phase_resnet_all_levers():
     _resnet("resnet_all_levers", BENCH_S2D_STEM="1", MXTPU_BN_ONEPASS="1")
 
 
+def phase_resnet_nchw():
+    # layout A/B: XLA:TPU may prefer a different im2col/tiling for NCHW
+    _resnet("resnet_nchw", BENCH_LAYOUT="NCHW")
+
+
+def phase_convs():
+    """Per-conv-class attribution: time the FLOP-dominant conv shapes of
+    the bench resnet50 individually (fwd, conv_acc policy, bf16 NHWC) and
+    report achieved TFLOP/s each. The prefix-stage timings say WHERE the
+    time goes; this says WHICH conv class underperforms (1x1 vs 3x3 vs
+    stem vs strided). 8 shapes ~ 95% of forward FLOPs; counts are the
+    per-model multiplicities (resnet50_v1 bottleneck table)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    # (label, HW_in, Cin, Cout, k, stride, count_in_model)
+    shapes = [
+        ("stem_7x7s2", 224, 3, 64, 7, 2, 1),
+        ("s1_3x3_64", 56, 64, 64, 3, 1, 3),
+        ("s1_1x1_64to256", 56, 64, 256, 1, 1, 4),
+        ("s2_3x3_128", 28, 128, 128, 3, 1, 3),
+        ("s3_3x3_256", 14, 256, 256, 3, 1, 5),
+        ("s3_1x1_1024to256", 14, 1024, 256, 1, 1, 5),
+        ("s4_3x3_512", 7, 512, 512, 3, 1, 2),
+        ("s4_1x1_512to2048", 7, 512, 2048, 1, 1, 3),
+    ]
+    dn = jax.lax.conv_dimension_numbers(
+        (batch, 1, 1, 1), (1, 1, 1, 1), ("NHWC", "HWIO", "NHWC"))
+    for label, hw, cin, cout, k, s, count in shapes:
+        x = jax.random.normal(jax.random.PRNGKey(0),
+                              (batch, hw, hw, cin), jnp.bfloat16)
+        w = jax.random.normal(jax.random.PRNGKey(1),
+                              (k, k, cin, cout), jnp.bfloat16)
+        pad = ((k // 2, k // 2), (k // 2, k // 2))
+
+        def f(xd, w=w, s=s, pad=pad):
+            return jax.lax.conv_general_dilated(
+                xd, w, (s, s), pad, dimension_numbers=dn,
+                preferred_element_type=jnp.float32)
+
+        try:
+            dt = timed_scan(reinject(f), x, K=16)
+        except Exception as e:  # noqa: BLE001 — keep the sweep going
+            out("convs", {"conv": label, "error": str(e)})
+            continue
+        hw_out = hw // s
+        fl = 2 * batch * hw_out * hw_out * cin * cout * k * k
+        out("convs", {"conv": label, "ms": round(dt * 1e3, 3),
+                      "tflops": round(fl / dt / 1e12, 1),
+                      "count": count,
+                      "model_ms_est": round(count * dt * 1e3, 2)})
+
+
 def phase_stages():
     """Compact forward attribution: timed truncated prefixes of the exact
     bench model (stem / +stage1+2 / +stage3 / +stage4 / full incl. dense),
@@ -285,6 +340,8 @@ PHASES = [
     ("resnet_bn_onepass", phase_resnet_bn1p),
     ("resnet_all_levers", phase_resnet_all_levers),
     ("stages", phase_stages),
+    ("convs", phase_convs),
+    ("resnet_nchw", phase_resnet_nchw),
     ("bn", phase_bn),
     ("peak", phase_peak),
     ("eager", phase_eager),
