@@ -1,0 +1,473 @@
+"""Operator DEPTH sweeps: many parameterizations per heavy op, each against
+a from-scratch NumPy oracle, in the style of the reference's exhaustive
+tests/python/unittest/test_operator.py (7,213 LoC — e.g. its convolution
+tests sweep kernel/stride/dilate/pad/group combinations; its pooling tests
+sweep conventions). tests/test_operator.py covers one-or-two configs per op;
+this module is the combinatorial tier.
+
+Oracles here are textbook implementations written for this file (naive
+loops), not ports: correctness is anchored to the math, not to either
+framework.
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+RNG = np.random.RandomState
+
+
+# ----------------------------------------------------------------- oracles
+def np_conv2d(x, w, stride=(1, 1), dilate=(1, 1), pad=(0, 0), groups=1):
+    """Naive NCHW conv: loops over every output element."""
+    n, cin, h, wd = x.shape
+    cout, cin_g, kh, kw = w.shape
+    assert cin_g * groups == cin
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    xp = np.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (wd + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    out = np.zeros((n, cout, oh, ow), np.float64)
+    cpg = cout // groups  # output channels per group
+    for b in range(n):
+        for co in range(cout):
+            g = co // cpg
+            for i in range(oh):
+                for j in range(ow):
+                    acc = 0.0
+                    for ci in range(cin_g):
+                        for u in range(kh):
+                            for v in range(kw):
+                                acc += (xp[b, g * cin_g + ci,
+                                           i * sh + u * dh, j * sw + v * dw]
+                                        * w[co, ci, u, v])
+                    out[b, co, i, j] = acc
+    return out.astype(np.float32)
+
+
+def np_deconv2d(x, w, stride=(1, 1), pad=(0, 0), adj=(0, 0)):
+    """Transposed conv oracle: insert (s-1) zeros between input pixels,
+    pad by (k-1-p, k-1-p+adj), then correlate with the spatially-flipped,
+    io-swapped kernel (the standard construction)."""
+    n, cin, h, wd = x.shape
+    cin_w, cout, kh, kw = w.shape  # reference weight layout (in, out, kh, kw)
+    assert cin_w == cin
+    sh, sw = stride
+    up = np.zeros((n, cin, (h - 1) * sh + 1, (wd - 1) * sw + 1), x.dtype)
+    up[:, :, ::sh, ::sw] = x
+    ph, pw = pad
+    ah, aw = adj
+    xp = np.pad(up, ((0, 0), (0, 0),
+                     (kh - 1 - ph, kh - 1 - ph + ah),
+                     (kw - 1 - pw, kw - 1 - pw + aw)))
+    wf = w[:, :, ::-1, ::-1].transpose(1, 0, 2, 3)  # (out,in,kh,kw) flipped
+    return np_conv2d(xp, wf)
+
+
+def np_pool2d(x, kernel, pool_type="max", stride=(1, 1), pad=(0, 0),
+              convention="valid", count_include_pad=True):
+    n, c, h, wd = x.shape
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = pad
+    if convention == "full":
+        oh = int(np.ceil((h + 2 * ph - kh) / sh)) + 1
+        ow = int(np.ceil((wd + 2 * pw - kw) / sw)) + 1
+    else:
+        oh = (h + 2 * ph - kh) // sh + 1
+        ow = (wd + 2 * pw - kw) // sw + 1
+    out = np.zeros((n, c, oh, ow), np.float64)
+    for b in range(n):
+        for ch in range(c):
+            for i in range(oh):
+                for j in range(ow):
+                    vals, n_real = [], 0
+                    for u in range(kh):
+                        for v in range(kw):
+                            y, z = i * sh + u - ph, j * sw + v - pw
+                            if 0 <= y < h and 0 <= z < wd:
+                                vals.append(x[b, ch, y, z])
+                                n_real += 1
+                    if pool_type == "max":
+                        out[b, ch, i, j] = max(vals)
+                    elif pool_type == "sum":
+                        out[b, ch, i, j] = sum(vals)
+                    else:  # avg: padded zeros count iff count_include_pad
+                        denom = kh * kw if count_include_pad else n_real
+                        out[b, ch, i, j] = sum(vals) / denom
+    return out.astype(np.float32)
+
+
+# ------------------------------------------------------------- convolution
+CONV_CFGS = [
+    # kernel, stride, dilate, pad, groups  (ref conv tests sweep these axes)
+    ((3, 3), (1, 1), (1, 1), (1, 1), 1),
+    ((3, 3), (2, 2), (1, 1), (1, 1), 1),
+    ((3, 3), (1, 1), (2, 2), (2, 2), 1),   # dilated
+    ((1, 1), (1, 1), (1, 1), (0, 0), 1),   # pointwise
+    ((5, 3), (2, 1), (1, 1), (2, 1), 1),   # asymmetric kernel/stride/pad
+    ((3, 3), (1, 1), (1, 1), (1, 1), 2),   # grouped
+    ((3, 3), (1, 1), (1, 1), (1, 1), 4),   # depthwise (g == C_in)
+]
+
+
+@pytest.mark.parametrize("kernel,stride,dilate,pad,groups", CONV_CFGS)
+def test_convolution_sweep(kernel, stride, dilate, pad, groups):
+    rng = RNG(7)
+    cin, cout = 4, 8
+    x = rng.uniform(-1, 1, (2, cin, 9, 9)).astype(np.float32)
+    w = rng.uniform(-0.5, 0.5,
+                    (cout, cin // groups) + kernel).astype(np.float32)
+    b = rng.uniform(-0.5, 0.5, (cout,)).astype(np.float32)
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+                            kernel=kernel, stride=stride, dilate=dilate,
+                            pad=pad, num_filter=cout, num_group=groups)
+    ref = np_conv2d(x, w, stride, dilate, pad, groups) + b[None, :, None, None]
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_convolution_1d_and_3d():
+    rng = RNG(3)
+    x1 = rng.uniform(-1, 1, (2, 3, 12)).astype(np.float32)
+    w1 = rng.uniform(-1, 1, (5, 3, 4)).astype(np.float32)
+    out = mx.nd.Convolution(mx.nd.array(x1), mx.nd.array(w1), no_bias=True,
+                            kernel=(4,), stride=(2,), pad=(1,), num_filter=5)
+    # 1D == 2D conv with unit height
+    ref = np_conv2d(x1[:, :, None, :], w1[:, :, None, :],
+                    (1, 2), (1, 1), (0, 1))[:, :, 0, :]
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+    x3 = rng.uniform(-1, 1, (1, 2, 5, 5, 5)).astype(np.float32)
+    w3 = rng.uniform(-1, 1, (4, 2, 2, 2, 2)).astype(np.float32)
+    out3 = mx.nd.Convolution(mx.nd.array(x3), mx.nd.array(w3), no_bias=True,
+                             kernel=(2, 2, 2), num_filter=4)
+    # 3D oracle: sum of 2D convs over the depth taps
+    ref3 = np.zeros((1, 4, 4, 4, 4), np.float32)
+    for dz in range(2):
+        for z in range(4):
+            ref3[:, :, z] += np_conv2d(x3[:, :, z + dz], w3[:, :, dz])
+    assert_almost_equal(out3, ref3, rtol=1e-4, atol=1e-4)
+
+
+def test_convolution_numeric_grad():
+    rng = RNG(11)
+    x = rng.uniform(-1, 1, (1, 2, 6, 6)).astype(np.float32)
+    w = rng.uniform(-0.5, 0.5, (3, 2, 3, 3)).astype(np.float32)
+
+    def f(x_, w_):
+        return mx.nd.Convolution(x_, w_, no_bias=True, kernel=(3, 3),
+                                 stride=(2, 2), pad=(1, 1), num_filter=3)
+    check_numeric_gradient(f, [mx.nd.array(x), mx.nd.array(w)])
+
+
+# ----------------------------------------------------------- deconvolution
+DECONV_CFGS = [
+    # kernel, stride, pad, adj
+    ((3, 3), (1, 1), (1, 1), (0, 0)),
+    ((2, 2), (2, 2), (0, 0), (0, 0)),
+    ((3, 3), (2, 2), (1, 1), (1, 1)),  # adj recovers odd sizes
+    ((4, 4), (2, 2), (1, 1), (0, 0)),
+]
+
+
+@pytest.mark.parametrize("kernel,stride,pad,adj", DECONV_CFGS)
+def test_deconvolution_sweep(kernel, stride, pad, adj):
+    rng = RNG(5)
+    cin, cout = 3, 5
+    x = rng.uniform(-1, 1, (2, cin, 5, 5)).astype(np.float32)
+    w = rng.uniform(-0.5, 0.5, (cin, cout) + kernel).astype(np.float32)
+    out = mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w),
+                              kernel=kernel, stride=stride, pad=pad,
+                              adj=adj, num_filter=cout)
+    ref = np_deconv2d(x, w, stride, pad, adj)
+    # output size formula (ref deconvolution doc): (i-1)*s - 2p + k + adj
+    expect = tuple((5 - 1) * s - 2 * p + k + a
+                   for s, p, k, a in zip(stride, pad, kernel, adj))
+    assert out.shape[2:] == expect
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deconv_is_conv_data_grad():
+    """Deconvolution must equal the gradient of Convolution wrt its input
+    (the defining property; ref implements it exactly that way)."""
+    rng = RNG(9)
+    x = rng.uniform(-1, 1, (1, 4, 4, 4)).astype(np.float32)  # conv OUTPUT side
+    w = rng.uniform(-1, 1, (4, 2, 3, 3)).astype(np.float32)  # (cout,cin,k,k)
+    from mxtpu import autograd as ag
+    inp = mx.nd.array(rng.uniform(-1, 1, (1, 2, 8, 8)).astype(np.float32))
+    inp.attach_grad()
+    with ag.record():
+        y = mx.nd.Convolution(inp, mx.nd.array(w), no_bias=True,
+                              kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                              num_filter=4)
+        y.backward(mx.nd.array(x))
+    # deconv weight layout is (cin_of_deconv==cout_of_conv, cout, k, k) = w as-is
+    dec = mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w),
+                              kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                              adj=(1, 1), num_filter=2)
+    assert_almost_equal(inp.grad, dec.asnumpy(), rtol=1e-4, atol=1e-4)
+
+
+# ----------------------------------------------------------------- pooling
+POOL_CFGS = [
+    # kernel, pool_type, stride, pad, convention, count_include_pad
+    ((2, 2), "max", (2, 2), (0, 0), "valid", True),
+    ((3, 3), "max", (2, 2), (1, 1), "valid", True),
+    ((3, 3), "max", (2, 2), (1, 1), "full", True),
+    ((2, 2), "avg", (2, 2), (0, 0), "valid", True),
+    ((3, 3), "avg", (2, 2), (1, 1), "valid", False),
+    ((3, 3), "avg", (2, 2), (1, 1), "full", True),
+    ((2, 3), "sum", (1, 2), (0, 1), "valid", True),
+    ((3, 3), "max", (3, 3), (0, 0), "full", True),
+]
+
+
+@pytest.mark.parametrize(
+    "kernel,pool_type,stride,pad,convention,cip", POOL_CFGS)
+def test_pooling_sweep(kernel, pool_type, stride, pad, convention, cip):
+    rng = RNG(13)
+    x = rng.uniform(-1, 1, (2, 3, 7, 7)).astype(np.float32)
+    out = mx.nd.Pooling(mx.nd.array(x), kernel=kernel, pool_type=pool_type,
+                        stride=stride, pad=pad,
+                        pooling_convention=convention,
+                        count_include_pad=cip)
+    ref = np_pool2d(x, kernel, pool_type, stride, pad, convention, cip)
+    assert out.shape == ref.shape, (out.shape, ref.shape)
+    assert_almost_equal(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_pooling_global_and_lp():
+    rng = RNG(17)
+    x = rng.uniform(0.1, 1, (2, 3, 5, 6)).astype(np.float32)
+    g = mx.nd.Pooling(mx.nd.array(x), global_pool=True, pool_type="max")
+    assert_almost_equal(g, x.max((2, 3), keepdims=True))
+    g = mx.nd.Pooling(mx.nd.array(x), global_pool=True, pool_type="avg")
+    assert_almost_equal(g, x.mean((2, 3), keepdims=True), rtol=1e-5)
+    lp = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                       pool_type="lp", p_value=3)
+    # lp oracle: (sum |x|^p)^(1/p) over each window
+    p3 = np_pool2d(np.abs(x) ** 3, (2, 2), "sum", (2, 2)) ** (1 / 3)
+    assert_almost_equal(lp, p3, rtol=1e-4, atol=1e-5)
+
+
+def test_avg_pool_numeric_grad():
+    rng = RNG(19)
+    x = mx.nd.array(rng.uniform(-1, 1, (1, 2, 6, 6)).astype(np.float32))
+
+    def f(x_):
+        return mx.nd.Pooling(x_, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                             pool_type="avg", count_include_pad=False)
+    check_numeric_gradient(f, [x])
+
+
+# ----------------------------------------------------------------- softmax
+@pytest.mark.parametrize("axis", [0, 1, 2, -1])
+def test_softmax_axes(axis):
+    rng = RNG(23)
+    x = rng.uniform(-3, 3, (3, 4, 5)).astype(np.float32)
+
+    def np_softmax(x, axis):
+        e = np.exp(x - x.max(axis=axis, keepdims=True))
+        return e / e.sum(axis=axis, keepdims=True)
+
+    assert_almost_equal(mx.nd.softmax(mx.nd.array(x), axis=axis),
+                        np_softmax(x, axis), rtol=1e-5, atol=1e-6)
+    assert_almost_equal(mx.nd.log_softmax(mx.nd.array(x), axis=axis),
+                        np.log(np_softmax(x, axis)), rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_temperature_and_softmin():
+    rng = RNG(29)
+    x = rng.uniform(-3, 3, (4, 6)).astype(np.float32)
+    for t in (0.5, 2.0, 10.0):
+        e = np.exp((x - x.max(1, keepdims=True)) / t)
+        assert_almost_equal(
+            mx.nd.softmax(mx.nd.array(x), temperature=t),
+            e / e.sum(1, keepdims=True), rtol=1e-5, atol=1e-6)
+    e = np.exp(-x - (-x).max(1, keepdims=True))
+    assert_almost_equal(mx.nd.softmin(mx.nd.array(x)),
+                        e / e.sum(1, keepdims=True), rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------------ norm / stats
+@pytest.mark.parametrize("ord_", [1, 2])
+@pytest.mark.parametrize("axis", [None, 0, 1, (0, 1)])
+@pytest.mark.parametrize("keepdims", [False, True])
+def test_norm_sweep(ord_, axis, keepdims):
+    rng = RNG(31)
+    x = rng.uniform(-2, 2, (3, 4, 5)).astype(np.float32)
+    if ord_ == 1:
+        ref = np.abs(x).sum(axis=axis, keepdims=keepdims)
+    else:
+        ref = np.sqrt((x ** 2).sum(axis=axis, keepdims=keepdims))
+    out = mx.nd.norm(mx.nd.array(x), ord=ord_, axis=axis, keepdims=keepdims)
+    assert_almost_equal(out, np.asarray(ref, np.float32), rtol=1e-4,
+                        atol=1e-5)
+
+
+# -------------------------------------------------------------------- topk
+@pytest.mark.parametrize("ret_typ", ["indices", "value", "mask", "both"])
+@pytest.mark.parametrize("is_ascend", [False, True])
+def test_topk_sweep(ret_typ, is_ascend):
+    rng = RNG(37)
+    x = rng.permutation(24).reshape(4, 6).astype(np.float32)  # unique values
+    k = 3
+    order = np.argsort(x, axis=1)
+    idx = order[:, :k] if is_ascend else order[:, ::-1][:, :k]
+    out = mx.nd.topk(mx.nd.array(x), axis=1, k=k, ret_typ=ret_typ,
+                     is_ascend=is_ascend)
+    if ret_typ == "indices":
+        assert_almost_equal(out, idx.astype(np.float32))
+    elif ret_typ == "value":
+        assert_almost_equal(out, np.take_along_axis(x, idx, 1))
+    elif ret_typ == "mask":
+        mask = np.zeros_like(x)
+        np.put_along_axis(mask, idx, 1.0, 1)
+        assert_almost_equal(out, mask)
+    else:  # both -> (values, indices)
+        assert_almost_equal(out[0], np.take_along_axis(x, idx, 1))
+        assert_almost_equal(out[1], idx.astype(np.float32))
+
+
+def test_topk_axis0_and_k1():
+    rng = RNG(41)
+    x = rng.permutation(12).reshape(3, 4).astype(np.float32)
+    out = mx.nd.topk(mx.nd.array(x), axis=0, k=2, ret_typ="value")
+    ref = np.sort(x, axis=0)[::-1][:2]
+    assert_almost_equal(out, ref)
+    out = mx.nd.topk(mx.nd.array(x), k=1)  # default axis=-1, indices
+    assert_almost_equal(out, x.argmax(1, keepdims=True).astype(np.float32))
+
+
+# ----------------------------------------------------------- take / gather
+@pytest.mark.parametrize("axis", [0, 1, -1])
+@pytest.mark.parametrize("mode", ["clip", "wrap"])
+def test_take_sweep(axis, mode):
+    rng = RNG(43)
+    x = rng.uniform(-1, 1, (4, 5, 6)).astype(np.float32)
+    idx = np.array([[0, 2], [7, -3]], np.float32)  # out-of-range on purpose
+    n = x.shape[axis]
+    ii = idx.astype(np.int64)
+    ii = np.clip(ii, 0, n - 1) if mode == "clip" else ii % n
+    out = mx.nd.take(mx.nd.array(x), mx.nd.array(idx), axis=axis, mode=mode)
+    assert_almost_equal(out, np.take(x, ii, axis=axis), rtol=1e-6)
+
+
+def test_embedding_grad_accumulates_repeats():
+    """Repeated indices must SUM their output grads into the same row
+    (the correctness trap for one-hot/scatter implementations)."""
+    from mxtpu import autograd as ag
+    w = mx.nd.array(np.zeros((5, 3), np.float32))
+    w.attach_grad()
+    idx = mx.nd.array(np.array([1, 1, 1, 4], np.float32))
+    with ag.record():
+        out = mx.nd.Embedding(idx, w, input_dim=5, output_dim=3)
+        out.backward(mx.nd.array(np.ones((4, 3), np.float32)))
+    expect = np.zeros((5, 3), np.float32)
+    expect[1] = 3.0
+    expect[4] = 1.0
+    assert_almost_equal(w.grad, expect)
+
+
+# ------------------------------------------------------------------ slicing
+def test_slice_step_variants():
+    rng = RNG(47)
+    x = rng.uniform(-1, 1, (6, 8)).astype(np.float32)
+    nd = mx.nd.array(x)
+    out = mx.nd.slice(nd, begin=(1, 0), end=(5, 8), step=(2, 3))
+    assert_almost_equal(out, x[1:5:2, 0:8:3])
+    out = mx.nd.slice(nd, begin=(4, None), end=(0, None), step=(-2, 1))
+    assert_almost_equal(out, x[4:0:-2, :])
+    out = mx.nd.slice_axis(nd, axis=1, begin=-3, end=None)
+    assert_almost_equal(out, x[:, -3:])
+    like = mx.nd.array(np.zeros((3, 4), np.float32))
+    assert_almost_equal(mx.nd.slice_like(nd, like), x[:3, :4])
+    assert_almost_equal(mx.nd.slice_like(nd, like, axes=(1,)), x[:, :4])
+
+
+# ---------------------------------------------------------------- batch_dot
+@pytest.mark.parametrize("ta", [False, True])
+@pytest.mark.parametrize("tb", [False, True])
+def test_batch_dot_sweep(ta, tb):
+    rng = RNG(53)
+    a = rng.uniform(-1, 1, (4, 3, 5)).astype(np.float32)
+    b = rng.uniform(-1, 1, (4, 5, 2)).astype(np.float32)
+    an = a.transpose(0, 2, 1) if ta else a
+    bn = b.transpose(0, 2, 1) if tb else b
+    out = mx.nd.batch_dot(mx.nd.array(an), mx.nd.array(bn),
+                          transpose_a=ta, transpose_b=tb)
+    assert_almost_equal(out, np.matmul(a, b), rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------- batchnorm
+def test_batchnorm_channels_last_and_fix_gamma():
+    rng = RNG(59)
+    x = rng.uniform(-2, 2, (4, 5, 3)).astype(np.float32)  # (N, W, C), axis=-1
+    gamma = rng.uniform(0.5, 1.5, (3,)).astype(np.float32)
+    beta = rng.uniform(-0.5, 0.5, (3,)).astype(np.float32)
+    mm = np.zeros(3, np.float32)
+    mv = np.ones(3, np.float32)
+    from mxtpu import autograd as ag
+    with ag.record(train_mode=True):
+        out = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(gamma),
+                              mx.nd.array(beta), mx.nd.array(mm),
+                              mx.nd.array(mv), axis=-1, eps=1e-5,
+                              fix_gamma=False)
+    mean = x.mean((0, 1))
+    var = x.var((0, 1))
+    ref = (x - mean) / np.sqrt(var + 1e-5) * gamma + beta
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+    # fix_gamma=True (the reference's default): scale pinned to 1
+    with ag.record(train_mode=True):
+        out = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(gamma),
+                              mx.nd.array(beta), mx.nd.array(mm),
+                              mx.nd.array(mv), axis=-1, eps=1e-5,
+                              fix_gamma=True)
+    ref = (x - mean) / np.sqrt(var + 1e-5) + beta
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_batchnorm_use_global_stats():
+    rng = RNG(61)
+    x = rng.uniform(-2, 2, (2, 3, 4, 4)).astype(np.float32)
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mm = rng.uniform(-0.5, 0.5, 3).astype(np.float32)
+    mv = rng.uniform(0.5, 1.5, 3).astype(np.float32)
+    from mxtpu import autograd as ag
+    with ag.record(train_mode=True):  # use_global_stats overrides train mode
+        out = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(gamma),
+                              mx.nd.array(beta), mx.nd.array(mm),
+                              mx.nd.array(mv), eps=1e-5,
+                              use_global_stats=True)
+    ref = ((x - mm[None, :, None, None])
+           / np.sqrt(mv[None, :, None, None] + 1e-5))
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------------------------------------------- misc
+def test_pick_modes_and_keepdims():
+    rng = RNG(67)
+    x = rng.uniform(-1, 1, (3, 4)).astype(np.float32)
+    idx = np.array([0, 5, 2], np.float32)  # 5 out of range -> clip to 3
+    out = mx.nd.pick(mx.nd.array(x), mx.nd.array(idx), axis=1)
+    ii = np.clip(idx.astype(np.int64), 0, 3)
+    ref = x[np.arange(3), ii]
+    assert_almost_equal(out, ref)
+    out = mx.nd.pick(mx.nd.array(x), mx.nd.array(idx), axis=1, keepdims=True)
+    assert_almost_equal(out, ref[:, None])
+    out = mx.nd.pick(mx.nd.array(x), mx.nd.array(idx), axis=1, mode="wrap")
+    assert_almost_equal(out, x[np.arange(3), idx.astype(np.int64) % 4])
+
+
+def test_one_hot_values_and_dtype():
+    idx = mx.nd.array(np.array([0, 2, 1], np.float32))
+    out = mx.nd.one_hot(idx, 4, on_value=2.5, off_value=-1.0)
+    ref = np.full((3, 4), -1.0, np.float32)
+    for i, j in enumerate([0, 2, 1]):
+        ref[i, j] = 2.5
+    assert_almost_equal(out, ref)
+    assert mx.nd.one_hot(idx, 4, dtype="int32").dtype == np.int32

@@ -166,7 +166,6 @@ def phase_convs():
     time goes; this says WHICH conv class underperforms (1x1 vs 3x3 vs
     stem vs strided). 8 shapes ~ 95% of forward FLOPs; counts are the
     per-model multiplicities (resnet50_v1 bottleneck table)."""
-    import numpy as np
     import jax
     import jax.numpy as jnp
 
