@@ -315,17 +315,35 @@ class NDArray:
             self._set_data(self._data.at[key].set(v))
 
     # ------------------------------------------------------------- arithmetic
+    def _densify_operands(self, other):
+        """Storage fallback (ref: FInferStorageType dense fallback): a
+        sparse NDArray's _data is its VALUES buffer, which must never feed
+        elementwise math raw. BaseSparseNDArray overrides the common
+        dunders with sparse-preserving paths; any dunder it does NOT
+        override (mod, matmul, reflected pow, ...) lands in _binop/_rbinop
+        and both operands densify — after the cheap type check, so an
+        unsupported rhs can't trigger a large todense for nothing."""
+        if self.stype != "default":
+            self = self.todense()
+        if getattr(other, "stype", "default") != "default":
+            other = other.todense()
+        return self, other
+
     def _binop(self, other, fn, name):
         if isinstance(other, (NDArray, int, float, bool, _np.number)):
+            self, other = self._densify_operands(other)
             return _apply(fn, (self, other), name=name)
         if isinstance(other, _np.ndarray):
+            self, _ = self._densify_operands(None)
             return _apply(fn, (self, NDArray(other)), name=name)
         return NotImplemented
 
     def _rbinop(self, other, fn, name):
-        if isinstance(other, (int, float, bool, _np.number)):
+        if isinstance(other, (NDArray, int, float, bool, _np.number)):
+            self, other = self._densify_operands(other)
             return _apply(fn, (other, self), name=name)
         if isinstance(other, _np.ndarray):
+            self, _ = self._densify_operands(None)
             return _apply(fn, (NDArray(other), self), name=name)
         return NotImplemented
 

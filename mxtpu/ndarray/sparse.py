@@ -16,13 +16,26 @@ import jax.numpy as jnp
 import numpy as _np
 
 from ..base import MXNetError
-from .ndarray import NDArray, array
+from .ndarray import NDArray, _apply, array
 
 
 class BaseSparseNDArray(NDArray):
-    """Common behavior for sparse storage types."""
+    """Common behavior for sparse storage types.
+
+    Arithmetic follows the reference's storage dispatch
+    (python/mxnet/ndarray/sparse.py + FInferStorageType fallback rules):
+    zero-preserving scalar ops (``*``, ``/``, ``-x``, ``abs``, ``**k`` for
+    k>0) stay in the same sparse format by mapping over the stored values;
+    same-format ``+``/``-`` of two row_sparse merges sparsely; everything
+    else densifies BOTH operands first and returns a dense NDArray (the
+    reference's storage fallback). The base NDArray dunders would
+    otherwise operate on ``_data`` — the VALUES buffer — and silently
+    return wrong-shaped results.
+    """
 
     __slots__ = ("_aux",)
+
+    _SCALAR = (int, float, bool, _np.number)
 
     def asnumpy(self):
         return self.todense().asnumpy()
@@ -34,12 +47,152 @@ class BaseSparseNDArray(NDArray):
     def todense(self) -> NDArray:
         raise NotImplementedError
 
+    def _replace_values(self, vals):
+        """Same indices/shape, new values (zero-preserving maps only)."""
+        raise NotImplementedError
+
+    def attach_grad(self, grad_req="write", stype=None):
+        """Mark a sparse leaf; its gradient is a SAME-FORMAT sparse array
+        sharing this array's indices (ref: row_sparse weights receive
+        row_sparse grads — attach_grad(stype=...) in the reference). The
+        tape stores the sparse object itself as the op input, so leaf
+        cotangents arrive values-shaped; the grad buffer must therefore be
+        a sparse wrapper over a values-shaped buffer, not a dense
+        logical-shape array (which would crash 'add' accumulation and
+        silently mis-shape 'write')."""
+        if stype is not None and stype != self.stype:
+            raise MXNetError(
+                "grad stype %r unsupported for a %s leaf: its tape "
+                "cotangents are values-shaped" % (stype, self.stype))
+        self._ag_entry = None
+        self._grad = self._replace_values(jnp.zeros_like(self._data))
+        self._grad_req = grad_req
+
+    def _values_map(self, fn, name):
+        """Zero-preserving map over the stored values, routed through
+        ``_apply`` so it tapes under autograd.record() and emits profiler
+        events like every other op (a sparse NDArray IS an NDArray whose
+        _data is the values buffer, so ``self`` is the taped input); the
+        sparse result adopts the taped output's autograd entry."""
+        out = _apply(fn, (self,), name=name)
+        res = self._replace_values(out._data)
+        res._ag_entry = out._ag_entry
+        return res
+
     def tostype(self, stype):
         if stype == self.stype:
             return self
         if stype == "default":
             return self.todense()
         return cast_storage(self.todense(), stype)
+
+    # ------------------------------------------------------- arithmetic
+    def _dense_fallback(self, other, op):
+        rhs = other.todense() if isinstance(other, BaseSparseNDArray) else other
+        return getattr(self.todense(), op)(rhs)
+
+    def __mul__(self, o):
+        if isinstance(o, self._SCALAR):
+            return self._values_map(lambda v: v * o, "_mul_scalar")
+        return self._dense_fallback(o, "__mul__")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        if isinstance(o, self._SCALAR):
+            return self._values_map(lambda v: v / o, "_div_scalar")
+        return self._dense_fallback(o, "__truediv__")
+
+    def __rtruediv__(self, o):  # scalar / x maps zeros to inf: densify
+        return self._dense_fallback(o, "__rtruediv__")
+
+    def __pow__(self, o):
+        # 0**k==0 iff k>0 (real k only — complex exponents have no order
+        # and take the dense fallback like every other non-preserving case)
+        if isinstance(o, self._SCALAR) \
+                and not isinstance(o, (complex, _np.complexfloating)) \
+                and o > 0:
+            return self._values_map(lambda v: v ** o, "_power_scalar")
+        return self._dense_fallback(o, "__pow__")
+
+    def __neg__(self):
+        return self._values_map(jnp.negative, "negative")
+
+    def __abs__(self):
+        return self._values_map(jnp.abs, "abs")
+
+    def __add__(self, o):
+        merged = self._sparse_merge(o, 1.0)
+        return merged if merged is not None \
+            else self._dense_fallback(o, "__add__")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        merged = self._sparse_merge(o, -1.0)
+        return merged if merged is not None \
+            else self._dense_fallback(o, "__sub__")
+
+    def __rsub__(self, o):
+        return self._dense_fallback(o, "__rsub__")
+
+    def _sparse_merge(self, other, sign):
+        """Same-format sparse +/-; None means 'use the dense fallback'."""
+        return None
+
+    # in-place: only format-preserving updates may mutate; others would
+    # silently change the storage type under the caller (ref: sparse
+    # NDArrays reject kWriteInplace into a different stype). Like the dense
+    # in-place ops (ndarray.py), they rebind the payload + autograd entry,
+    # so they tape as ordinary ops while recording.
+    def _inplace_from(self, res, opname):
+        if res is None:
+            raise MXNetError("in-place %s on %s supports only a "
+                             "format-preserving rhs; use explicit "
+                             "tostype('default')" % (opname, self.stype))
+        self._ag_entry = res._ag_entry
+        self._set_data(res._data)
+        self._aux = dict(res._aux)
+        return self
+
+    def __imul__(self, o):
+        if not isinstance(o, self._SCALAR):
+            raise MXNetError("in-place *= on %s would densify; use explicit "
+                             "tostype('default')" % self.stype)
+        return self._inplace_from(self.__mul__(o), "*=")
+
+    def __itruediv__(self, o):
+        if not isinstance(o, self._SCALAR):
+            raise MXNetError("in-place /= on %s would densify; use explicit "
+                             "tostype('default')" % self.stype)
+        return self._inplace_from(self.__truediv__(o), "/=")
+
+    def __iadd__(self, o):
+        return self._inplace_from(self._sparse_merge(o, 1), "+=")
+
+    def __isub__(self, o):
+        return self._inplace_from(self._sparse_merge(o, -1), "-=")
+
+    # comparisons: never meaningful on the raw values buffer
+    def __eq__(self, o):
+        return self._dense_fallback(o, "__eq__")
+
+    def __ne__(self, o):
+        return self._dense_fallback(o, "__ne__")
+
+    def __gt__(self, o):
+        return self._dense_fallback(o, "__gt__")
+
+    def __ge__(self, o):
+        return self._dense_fallback(o, "__ge__")
+
+    def __lt__(self, o):
+        return self._dense_fallback(o, "__lt__")
+
+    def __le__(self, o):
+        return self._dense_fallback(o, "__le__")
+
+    __hash__ = NDArray.__hash__
 
 
 class RowSparseNDArray(BaseSparseNDArray):
@@ -70,9 +223,43 @@ class RowSparseNDArray(BaseSparseNDArray):
         return NDArray(self._data)
 
     def todense(self) -> NDArray:
-        dense = jnp.zeros(self.shape, self._data.dtype)
-        dense = dense.at[self._aux["indices"]].add(self._data)
-        return NDArray(dense)
+        indices, shape = self._aux["indices"], self.shape
+
+        def fn(v):
+            return jnp.zeros(shape, v.dtype).at[indices].add(v)
+
+        # through _apply: autograd-visible (grads gather back to the stored
+        # rows), profiler-visible — the dense-fallback arithmetic and every
+        # sparse->dense chain tape through here
+        return _apply(fn, (self,), name="cast_storage")
+
+    def _replace_values(self, vals):
+        return RowSparseNDArray(vals, self._aux["indices"], self.shape)
+
+    def _sparse_merge(self, other, sign):
+        """rsp ± rsp without densifying (the embedding-gradient workload:
+        (vocab, dim) arrays whose dense form must never materialize).
+        Union of row ids via unique + segment-add of both value blocks;
+        the index plumbing is computed eagerly (data-independent of the
+        VALUES) while the value math routes through _apply for taping."""
+        if not isinstance(other, RowSparseNDArray) or other.shape != self.shape:
+            return None
+        idx = jnp.concatenate([self._aux["indices"], other._aux["indices"]])
+        uidx, inv = jnp.unique(idx, return_inverse=True)
+        n_out = int(uidx.shape[0])
+        row_shape = self._data.shape[1:]
+        dtype = jnp.result_type(self._data.dtype, other._data.dtype)
+
+        def fn(va, vb):
+            vb = vb.astype(dtype)
+            cat = jnp.concatenate([va.astype(dtype),
+                                   -vb if sign < 0 else vb])
+            return jnp.zeros((n_out,) + row_shape, dtype).at[inv].add(cat)
+
+        out = _apply(fn, (self, other), name="elemwise_add")
+        res = RowSparseNDArray(out._data, uidx, self.shape)
+        res._ag_entry = out._ag_entry
+        return res
 
     def retain(self, row_ids):
         """Keep only the given rows (ref: sparse_retain op,
@@ -130,12 +317,29 @@ class CSRNDArray(BaseSparseNDArray):
 
     def todense(self) -> NDArray:
         m, n = self.shape
-        indptr = self._aux["indptr"]
+        rows = _csr_row_ids(self._aux["indptr"], self._data.shape[0])
         indices = self._aux["indices"]
-        rows = _csr_row_ids(indptr, self._data.shape[0])
-        dense = jnp.zeros((m, n), self._data.dtype)
-        dense = dense.at[rows, indices].add(self._data)
-        return NDArray(dense)
+
+        def fn(d):
+            return jnp.zeros((m, n), d.dtype).at[rows, indices].add(d)
+
+        return _apply(fn, (self,), name="cast_storage")
+
+    def _replace_values(self, vals):
+        return CSRNDArray(vals, self._aux["indptr"], self._aux["indices"],
+                          self.shape)
+
+    def _sparse_merge(self, other, sign):
+        """csr ± csr keeps the csr format; 2-D shapes are modest in the
+        csr workloads (batches), so merge via dense then re-compress.
+        The dense sum is taped (todense routes through _apply); only the
+        re-compression structure is computed on host."""
+        if not isinstance(other, CSRNDArray) or other.shape != self.shape:
+            return None
+        dense = self.todense() + (-other.todense() if sign < 0
+                                  else other.todense())
+        res = cast_storage(dense, "csr")
+        return res
 
     def __getitem__(self, key):
         if isinstance(key, slice):
@@ -198,17 +402,26 @@ def cast_storage(arr, stype):
         if a.ndim < 1:
             raise MXNetError("row_sparse requires ndim>=1")
         row_nz = _np.nonzero(a.reshape(a.shape[0], -1).any(axis=1))[0]
-        vals = a[row_nz]
-        return RowSparseNDArray(jnp.asarray(vals), jnp.asarray(row_nz), a.shape)
+        ridx = jnp.asarray(row_nz, jnp.int32)
+        # structure (which rows) comes from the host sync above; the VALUES
+        # are gathered through _apply so the cast stays autograd-visible
+        # (grads scatter back into the dense source)
+        vals = _apply(lambda d: d[ridx], (arr,), name="cast_storage")
+        res = RowSparseNDArray(vals._data, ridx, a.shape)
+        res._ag_entry = vals._ag_entry
+        return res
     if stype == "csr":
         if a.ndim != 2:
             raise MXNetError("csr requires 2D")
         rows, cols = _np.nonzero(a)
-        data = a[rows, cols]
         indptr = _np.zeros(a.shape[0] + 1, _np.int32)
         _np.add.at(indptr, rows + 1, 1)
         indptr = _np.cumsum(indptr).astype(_np.int32)
-        return CSRNDArray(jnp.asarray(data), jnp.asarray(indptr), jnp.asarray(cols), a.shape)
+        ri, ci = jnp.asarray(rows, jnp.int32), jnp.asarray(cols, jnp.int32)
+        vals = _apply(lambda d: d[ri, ci], (arr,), name="cast_storage")
+        res = CSRNDArray(vals._data, jnp.asarray(indptr), ci, a.shape)
+        res._ag_entry = vals._ag_entry
+        return res
     if stype == "default":
         return arr
     raise MXNetError("unknown stype " + stype)
@@ -239,7 +452,6 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     from ..ops.matrix import dot as dense_dot
     if isinstance(lhs, CSRNDArray) and not transpose_a \
             and not isinstance(rhs, BaseSparseNDArray) and rhs.ndim == 2:
-        from .ndarray import _apply
         num_rows = lhs.shape[0]
 
         def fn(data, indptr, indices, r):
@@ -248,8 +460,11 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
             return _csr_dns_dot(data, indptr, indices, num_rows, r)
 
         # through _apply so autograd tapes the call: grads flow to the csr
-        # values and to the dense rhs (the row-sparse rhs-grad workload)
-        return _apply(fn, (lhs.data, lhs.indptr, lhs.indices, rhs),
+        # values and to the dense rhs (the row-sparse rhs-grad workload).
+        # `lhs` itself is the first input — its _data IS the values buffer,
+        # and passing the object (not a fresh .data view) keeps the tape
+        # connected through any upstream sparse ops (e.g. `csr * 2.0`)
+        return _apply(fn, (lhs, lhs.indptr, lhs.indices, rhs),
                       name="dot_csr_dns")
     l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
     r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs

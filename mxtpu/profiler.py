@@ -37,6 +37,7 @@ class _Profiler:
         self._xla_max_s = 120.0         # hard bound on any device capture
         self._xla_watchdog = None
         self._xla_guard_installed = False
+        self._xla_last_error = None     # last swallowed stop_trace error
 
 
 _PROF = _Profiler()
@@ -75,8 +76,11 @@ def _stop_xla_trace():
     try:
         import jax
         jax.profiler.stop_trace()
-    except Exception:
-        pass
+        _PROF._xla_last_error = None
+    except Exception as e:  # noqa: BLE001 — a stop must never raise, but
+        # the swallowed reason stays inspectable (a failed stop usually
+        # means no xplane dump was written)
+        _PROF._xla_last_error = e
 
 
 def _install_xla_guards():
@@ -127,10 +131,17 @@ def start():
 def stop():
     _PROF.active = False
     if _PROF.profile_xla:
-        if _PROF._xla_watchdog is not None:
-            _PROF._xla_watchdog.cancel()
-            _PROF._xla_watchdog = None
+        w = _PROF._xla_watchdog
+        _PROF._xla_watchdog = None
+        if w is not None:
+            w.cancel()
         _stop_xla_trace()
+        if w is not None and w.is_alive():
+            # the watchdog may have fired and be mid-write inside
+            # stop_trace (it clears _xla_tracing BEFORE the write so later
+            # stoppers no-op); stop() is synchronous like the reference's
+            # profiler (src/profiler/profiler.h), so wait for the dump
+            w.join(30)
 
 
 def install_orphan_guard(poll_s=2.0):

@@ -55,7 +55,12 @@ def test_xla_trace_bounded_and_idempotent(tmp_path):
                         xla_trace_dir=d, xla_trace_max_s=1.0)
     profiler.start()
     mx.nd.ones((8, 8)).asnumpy()
-    time.sleep(2.5)  # watchdog fires at 1s while "workload" is stuck
+    # watchdog fires at 1s while the "workload" is stuck; poll rather than
+    # fixed-sleep — under an oversubscribed host (parallel suite runs) the
+    # timer thread can be scheduled well past its deadline
+    deadline = time.time() + 20
+    while profiler._PROF._xla_tracing and time.time() < deadline:
+        time.sleep(0.25)
     assert not profiler._PROF._xla_tracing
     profiler.stop()          # second stop: must not raise
     profiler._stop_xla_trace()  # third: still a no-op

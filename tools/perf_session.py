@@ -326,6 +326,16 @@ def phase_eager():
     out("eager", bench.bench_eager())
 
 
+def phase_bandwidth():
+    """h2d/d2h transfer bandwidth (tools/bandwidth.py link #1) inside the
+    shared session — no compiles, a few seconds."""
+    import bandwidth as bw
+    for mb in (16, 64):
+        h2d, d2h = bw.measure_transfer(mb << 20)
+        out("bandwidth", {"size_mb": mb, "h2d_gbps": round(h2d, 2),
+                          "d2h_gbps": round(d2h, 2)})
+
+
 def phase_ring():
     """Ring-flash lever (MXTPU_RING_FLASH) has no single-chip effect —
     covered by the bert config's flash kernel; placeholder for parity."""
@@ -344,6 +354,7 @@ PHASES = [
     ("bn", phase_bn),
     ("peak", phase_peak),
     ("eager", phase_eager),
+    ("bandwidth", phase_bandwidth),
     ("lstm", phase_lstm),
     ("bert", phase_bert),
 ]
