@@ -85,6 +85,8 @@ def main():
                              {"learning_rate": args.lr, "beta1": 0.5})
 
     b = args.batch_size
+    n = max(args.batches_per_epoch, 1)
+    sumD = sumG = 0.0
     real_label = mx.nd.ones((b,))
     fake_label = mx.nd.zeros((b,))
     for epoch in range(args.epochs):
@@ -110,7 +112,6 @@ def main():
             trainerG.step(b)
             sumD += float(lossD.mean().asnumpy())
             sumG += float(lossG.mean().asnumpy())
-        n = args.batches_per_epoch
         print("epoch %d lossD %.4f lossG %.4f" % (epoch, sumD / n, sumG / n))
     return sumD / n, sumG / n
 

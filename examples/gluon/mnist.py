@@ -87,6 +87,7 @@ def main():
                             {"learning_rate": args.lr})
 
     b = args.batch_size
+    acc = evaluate(net, vx, vy, b)
     for epoch in range(args.epochs):
         cum = 0.0
         nb = 0

@@ -76,9 +76,10 @@ def main():
             first = loss
         last = loss
         print("step %d loss %.4f" % (i, loss))
-    print("mesh %s  loss %.4f -> %.4f" % (dict(zip(mesh.axis_names,
-                                                   mesh.devices.shape)),
-                                          first, last))
+    if first is not None:
+        print("mesh %s  loss %.4f -> %.4f" % (dict(zip(mesh.axis_names,
+                                                       mesh.devices.shape)),
+                                              first, last))
     return first, last
 
 

@@ -1,0 +1,243 @@
+"""Numeric-gradient DEPTH tier: finite-difference checks across the op
+families the reference grinds through check_numeric_gradient in
+tests/python/unittest/test_operator.py. tests/test_operator.py spot-checks
+a handful; this module sweeps the ops whose vjp rules are hand-written or
+structurally risky (norm layers, indexing, orderings, contractions,
+losses), each at small shapes so central differences stay cheap.
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.test_utils import check_numeric_gradient
+
+RNG = np.random.RandomState
+
+
+def _u(shape, lo=-1.0, hi=1.0, seed=0):
+    return RNG(seed).uniform(lo, hi, shape).astype(np.float32)
+
+
+# ------------------------------------------------------------ norm layers
+def test_layernorm_grad_data_gamma_beta():
+    x = _u((3, 8), seed=1)
+    g = _u((8,), 0.5, 1.5, seed=2)
+    b = _u((8,), seed=3)
+    check_numeric_gradient(
+        lambda x_, g_, b_: mx.nd.LayerNorm(x_, g_, b_, axis=-1, eps=1e-4),
+        [x, g, b], rtol=2e-2, atol=2e-3)
+
+
+def test_batchnorm_train_grad_wrt_data():
+    from mxtpu import autograd as ag
+    x = _u((4, 3, 5), seed=4)
+    gamma = _u((3,), 0.5, 1.5, seed=5)
+    beta = _u((3,), seed=6)
+    mm, mv = np.zeros(3, np.float32), np.ones(3, np.float32)
+
+    def f(x_):
+        with ag.record(train_mode=True):
+            return mx.nd.BatchNorm(x_, mx.nd.array(gamma), mx.nd.array(beta),
+                                   mx.nd.array(mm), mx.nd.array(mv),
+                                   eps=1e-4, fix_gamma=False)
+    check_numeric_gradient(f, [x], rtol=3e-2, atol=3e-3)
+
+
+def test_instancenorm_and_lrn_grad():
+    x = _u((2, 3, 4, 4), 0.1, 1.0, seed=7)
+    g = _u((3,), 0.5, 1.5, seed=8)
+    b = _u((3,), seed=9)
+    # random head grad: with an all-ones head the normalization's
+    # mean-invariance makes the true gradient degenerately ~0, and the
+    # check compares rounding noise against rounding noise
+    hg = _u((2, 3, 4, 4), 0.2, 1.0, seed=40)
+    check_numeric_gradient(
+        lambda x_: mx.nd.InstanceNorm(x_, mx.nd.array(g), mx.nd.array(b),
+                                      eps=1e-4),
+        [x], rtol=3e-2, atol=3e-3, head_grad=hg)
+    check_numeric_gradient(lambda x_: mx.nd.LRN(x_, nsize=3), [x],
+                           rtol=2e-2, atol=2e-3, head_grad=hg)
+
+
+def test_l2_normalization_grad():
+    x = _u((3, 6), 0.2, 1.0, seed=10)
+    check_numeric_gradient(
+        lambda x_: mx.nd.L2Normalization(x_, mode="instance"), [x],
+        rtol=2e-2, atol=2e-3)
+
+
+# --------------------------------------------------------------- softmax
+@pytest.mark.parametrize("axis", [0, -1])
+def test_softmax_logsoftmax_grad(axis):
+    x = _u((4, 5), -2, 2, seed=11)
+    check_numeric_gradient(lambda x_: mx.nd.softmax(x_, axis=axis), [x],
+                           rtol=2e-2, atol=2e-3)
+    check_numeric_gradient(lambda x_: mx.nd.log_softmax(x_, axis=axis), [x],
+                           rtol=2e-2, atol=2e-3)
+
+
+def test_softmax_temperature_grad():
+    x = _u((3, 6), -2, 2, seed=12)
+    check_numeric_gradient(
+        lambda x_: mx.nd.softmax(x_, temperature=3.0), [x],
+        rtol=2e-2, atol=2e-3)
+
+
+# ------------------------------------------------------------ contraction
+def test_dot_transpose_grads():
+    a = _u((3, 4), seed=13)
+    b = _u((3, 5), seed=14)
+    check_numeric_gradient(
+        lambda a_, b_: mx.nd.dot(a_, b_, transpose_a=True), [a, b],
+        rtol=2e-2, atol=2e-3)
+
+
+def test_batch_dot_grad():
+    a = _u((2, 3, 4), seed=15)
+    b = _u((2, 4, 2), seed=16)
+    check_numeric_gradient(lambda a_, b_: mx.nd.batch_dot(a_, b_), [a, b],
+                           rtol=2e-2, atol=2e-3)
+
+
+def test_fully_connected_grad_all_inputs():
+    x = _u((4, 6), seed=17)
+    w = _u((3, 6), seed=18)
+    b = _u((3,), seed=19)
+    check_numeric_gradient(
+        lambda x_, w_, b_: mx.nd.FullyConnected(x_, w_, b_, num_hidden=3),
+        [x, w, b], rtol=2e-2, atol=2e-3)
+
+
+# -------------------------------------------------------------- indexing
+def test_take_and_embedding_grad():
+    w = _u((7, 4), seed=20)
+    idx = np.array([1, 3, 1, 6], np.float32)
+    check_numeric_gradient(
+        lambda w_: mx.nd.take(w_, mx.nd.array(idx), axis=0), [w],
+        rtol=2e-2, atol=2e-3)
+    check_numeric_gradient(
+        lambda w_: mx.nd.Embedding(mx.nd.array(idx), w_, input_dim=7,
+                                   output_dim=4), [w],
+        rtol=2e-2, atol=2e-3)
+
+
+def test_gather_nd_grad():
+    x = _u((4, 5), seed=21)
+    ind = mx.nd.array(np.array([[0, 2, 3], [1, 4, 0]], np.float32))
+    check_numeric_gradient(lambda x_: mx.nd.gather_nd(x_, ind), [x],
+                           rtol=2e-2, atol=2e-3)
+
+
+def test_slice_pad_reverse_grads():
+    x = _u((3, 6), seed=22)
+    check_numeric_gradient(
+        lambda x_: mx.nd.slice(x_, begin=(1, 0), end=(3, 6), step=(1, 2)),
+        [x], rtol=2e-2, atol=2e-3)
+    check_numeric_gradient(
+        lambda x_: mx.nd.reverse(x_, axis=1), [x], rtol=2e-2, atol=2e-3)
+    x4 = _u((1, 2, 3, 3), seed=23)
+    check_numeric_gradient(
+        lambda x_: mx.nd.pad(x_, mode="edge",
+                             pad_width=(0, 0, 0, 0, 1, 1, 1, 1)),
+        [x4], rtol=2e-2, atol=2e-3)
+
+
+# ------------------------------------------------------- pick / orderings
+def test_pick_grad():
+    x = _u((4, 5), seed=24)
+    idx = mx.nd.array(np.array([0, 2, 4, 1], np.float32))
+    check_numeric_gradient(lambda x_: mx.nd.pick(x_, idx, axis=1), [x],
+                           rtol=2e-2, atol=2e-3)
+
+
+def test_topk_value_and_sort_grads():
+    # unique, well-separated entries so finite differences don't cross
+    # the permutation's decision boundary
+    x = (np.arange(12, dtype=np.float32).reshape(3, 4) * 0.37 + 0.1)
+    x = RNG(25).permutation(x.ravel()).reshape(3, 4)
+    check_numeric_gradient(
+        lambda x_: mx.nd.topk(x_, k=2, ret_typ="value"), [x],
+        rtol=2e-2, atol=2e-3, eps=1e-2)
+    check_numeric_gradient(
+        lambda x_: mx.nd.sort(x_, axis=1), [x],
+        rtol=2e-2, atol=2e-3, eps=1e-2)
+
+
+# ----------------------------------------------------------------- losses
+def test_softmax_cross_entropy_grad():
+    x = _u((4, 5), -2, 2, seed=26)
+    lbl = mx.nd.array(np.array([0, 2, 4, 1], np.float32))
+    check_numeric_gradient(
+        lambda x_: mx.nd.softmax_cross_entropy(x_, lbl), [x],
+        rtol=2e-2, atol=2e-3)
+
+
+def test_smooth_l1_and_huber_region_grads():
+    # straddle the |x|=1 kink on purpose (away from the kink pointwise)
+    x = np.array([[-2.3, -0.4, 0.6, 1.9]], np.float32)
+    check_numeric_gradient(lambda x_: mx.nd.smooth_l1(x_, scalar=1.0), [x],
+                           rtol=2e-2, atol=2e-3)
+
+
+def test_gluon_loss_grads():
+    from mxtpu import gluon
+    pred = _u((4, 3), -2, 2, seed=27)
+    lbl_cls = mx.nd.array(np.array([0, 2, 1, 2], np.float32))
+    lbl_reg = mx.nd.array(_u((4, 3), seed=28))
+    for loss_blk, lbl in [
+            (gluon.loss.SoftmaxCrossEntropyLoss(), lbl_cls),
+            (gluon.loss.L2Loss(), lbl_reg),
+            (gluon.loss.HuberLoss(rho=0.7), lbl_reg),
+            (gluon.loss.LogisticLoss(), mx.nd.array(
+                np.sign(_u((4, 3), seed=29)))),
+    ]:
+        check_numeric_gradient(lambda p_: loss_blk(p_, lbl), [pred],
+                               rtol=2e-2, atol=2e-3)
+
+
+# ------------------------------------------------------------ activations
+@pytest.mark.parametrize("act", ["relu", "sigmoid", "tanh", "softrelu",
+                                 "softsign"])
+def test_activation_grads(act):
+    # keep away from relu's kink at 0
+    x = _u((3, 4), 0.2, 1.5, seed=30) * np.sign(_u((3, 4), seed=31) + 0.2)
+    x[np.abs(x) < 0.05] = 0.5
+    check_numeric_gradient(lambda x_: mx.nd.Activation(x_, act_type=act),
+                           [x], rtol=2e-2, atol=2e-3)
+
+
+def test_leaky_variants_grad():
+    x = _u((3, 4), 0.2, 1.5, seed=32) * np.sign(_u((3, 4), seed=33) + 0.3)
+    x[np.abs(x) < 0.05] = -0.5
+    for act, kw in [("leaky", {"slope": 0.1}), ("elu", {"slope": 0.3}),
+                    ("selu", {})]:
+        check_numeric_gradient(
+            lambda x_, act=act, kw=kw: mx.nd.LeakyReLU(x_, act_type=act,
+                                                       **kw),
+            [x], rtol=2e-2, atol=2e-3)
+
+
+# --------------------------------------------------------- linalg / misc
+def test_linalg_grads():
+    a = _u((3, 3), seed=34)
+    spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    check_numeric_gradient(lambda x_: mx.nd.linalg_potrf(x_), [spd],
+                           rtol=3e-2, atol=3e-3)
+    x = _u((3, 4), seed=35)
+    check_numeric_gradient(
+        lambda x_: mx.nd.linalg_syrk(x_, transpose=False, alpha=0.5), [x],
+        rtol=2e-2, atol=2e-3)
+
+
+def test_where_and_clip_grads():
+    c = mx.nd.array((RNG(36).uniform(size=(3, 4)) > 0.5)
+                    .astype(np.float32))
+    a = _u((3, 4), seed=37)
+    b = _u((3, 4), seed=38)
+    check_numeric_gradient(lambda a_, b_: mx.nd.where(c, a_, b_), [a, b],
+                           rtol=2e-2, atol=2e-3)
+    x = _u((3, 4), -2, 2, seed=39)
+    x[np.abs(np.abs(x) - 1.0) < 0.1] = 0.0  # keep away from clip edges
+    check_numeric_gradient(
+        lambda x_: mx.nd.clip(x_, a_min=-1.0, a_max=1.0), [x],
+        rtol=2e-2, atol=2e-3)
