@@ -141,3 +141,24 @@ def test_model_parallel_tp_mlp(capsys):
     first, last = out.strip().splitlines()[-1].split()[-3], \
         out.strip().splitlines()[-1].split()[-1]
     assert float(last) < float(first), out
+
+
+def test_cnn_text_classification_converges(capsys):
+    """Kim-2014 text CNN (ref: example/cnn_text_classification): parallel
+    Conv1D widths + max-over-time pooling must crack the keyword task."""
+    _run("examples/cnn_text_classification/text_cnn.py",
+         ["--epochs", "3", "--train-size", "512"])
+    out = capsys.readouterr().out
+    acc = float(out.strip().splitlines()[-1].split()[-1])
+    assert acc > 0.8, out
+
+
+def test_multi_task_both_heads_learn(capsys):
+    """Shared-trunk two-head training (ref: example/multi-task): summed
+    losses must teach BOTH heads above chance by a wide margin."""
+    _run("examples/multi_task/multitask_mlp.py",
+         ["--epochs", "6", "--train-size", "1024"])
+    out = capsys.readouterr().out
+    toks = out.strip().splitlines()[-1].split()
+    acc1, acc2 = float(toks[-3]), float(toks[-1])
+    assert acc1 > 0.6 and acc2 > 0.8, out
