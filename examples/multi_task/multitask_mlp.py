@@ -56,7 +56,7 @@ def main():
     nin, c1 = 32, args.classes
     w = rng.normal(0, 1, (nin, max(c1, 8))).astype(np.float32)
     tx, t1, t2 = make_data(rng, args.train_size, nin, c1, w)
-    vx, v1, v2 = make_data(rng, 512, nin, c1, w)
+    vx, v1, v2 = make_data(rng, max(512, args.batch_size), nin, c1, w)
 
     net = MultiTaskNet(args.hidden, c1, 2)
     net.initialize(mx.init.Xavier())

@@ -1,0 +1,297 @@
+"""Gluon DEPTH tier: parameter-lifecycle and Block-composition behaviors
+the reference grinds through tests/python/unittest/test_gluon.py
+(2,558 LoC) — sharing, partial save/load, grad_req semantics, hybridize
+cache behavior under shape/dtype changes, Constant params, apply/
+collect_params filtering, Trainer state round-trips.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, gluon
+from mxtpu.base import MXNetError
+from mxtpu.gluon import nn
+
+RNG = np.random.RandomState
+
+
+def _x(shape, seed=0):
+    return mx.nd.array(RNG(seed).uniform(-1, 1, shape).astype(np.float32))
+
+
+# ------------------------------------------------------- parameter sharing
+def test_shared_params_two_blocks():
+    """`params=` sharing (ref: gluon Block(params=...)): two Dense layers
+    share ONE weight; training through either moves both."""
+    a = nn.Dense(4, prefix="shared_")
+    b = nn.Dense(4, prefix="shared_", params=a.collect_params())
+    a.initialize()
+    x = _x((2, 3))
+    ya, yb = a(x), b(x)
+    np.testing.assert_allclose(ya.asnumpy(), yb.asnumpy(), rtol=1e-6)
+    assert a.weight.data() is b.weight.data() or np.allclose(
+        a.weight.data().asnumpy(), b.weight.data().asnumpy())
+    # gradient steps through `a` change `b`'s output too
+    trainer = gluon.Trainer(a.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    with autograd.record():
+        loss = (a(x) ** 2).sum()
+    loss.backward()
+    trainer.step(1)
+    np.testing.assert_allclose(a(x).asnumpy(), b(x).asnumpy(), rtol=1e-6)
+
+
+def test_constant_parameter_never_trains():
+    from mxtpu.gluon.block import HybridBlock
+
+    class WithConst(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.const = self.params.get_constant(
+                    "scale", np.array([2.0, 3.0], np.float32))
+                self.dense = nn.Dense(2)
+
+        def hybrid_forward(self, F, x, const):
+            return self.dense(x) * const
+
+    net = WithConst()
+    net.initialize()
+    x = _x((4, 3))
+    before = net.const.data().asnumpy()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(1)
+    np.testing.assert_allclose(net.const.data().asnumpy(), before)
+
+
+# -------------------------------------------------------- save/load depth
+def test_partial_load_allow_missing_ignore_extra(tmp_path):
+    big = nn.HybridSequential(prefix="net_")
+    with big.name_scope():
+        big.add(nn.Dense(8), nn.Dense(4))
+    big.initialize()
+    big(_x((2, 3)))
+    f = str(tmp_path / "p.params")
+    big.save_parameters(f)
+
+    # smaller net: the file has EXTRA keys -> must raise unless ignored
+    small = nn.HybridSequential(prefix="net_")
+    with small.name_scope():
+        small.add(nn.Dense(8))
+    small.initialize()
+    small(_x((2, 3)))
+    with pytest.raises(MXNetError):
+        small.load_parameters(f)
+    small.load_parameters(f, ignore_extra=True)
+    np.testing.assert_allclose(
+        small[0].weight.data().asnumpy(),
+        big[0].weight.data().asnumpy(), rtol=1e-6)
+
+    # bigger net: the file is MISSING keys -> must raise unless allowed
+    bigger = nn.HybridSequential(prefix="net_")
+    with bigger.name_scope():
+        bigger.add(nn.Dense(8), nn.Dense(4), nn.Dense(2))
+    bigger.initialize()
+    bigger(_x((2, 3)))
+    with pytest.raises(MXNetError):
+        bigger.load_parameters(f)
+    bigger.load_parameters(f, allow_missing=True)
+    np.testing.assert_allclose(
+        bigger[1].weight.data().asnumpy(),
+        big[1].weight.data().asnumpy(), rtol=1e-6)
+
+
+def test_setattr_broadcasts_to_params():
+    net = nn.Dense(4)
+    net.initialize()
+    net(_x((2, 3)))
+    net.collect_params().setattr("grad_req", "null")
+    assert all(p.grad_req == "null"
+               for p in net.collect_params().values())
+
+
+# ------------------------------------------------------- grad_req semantics
+def test_grad_req_add_accumulates_until_zero_grad():
+    net = nn.Dense(2, use_bias=False)
+    net.initialize()
+    x = _x((3, 4))
+    net(x)
+    net.weight.grad_req = "add"
+    net.collect_params().zero_grad()
+    for _ in range(3):
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+    g3 = net.weight.grad().asnumpy()
+    net.collect_params().zero_grad()
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    g1 = net.weight.grad().asnumpy()
+    np.testing.assert_allclose(g3, 3 * g1, rtol=1e-5)
+
+
+def test_grad_req_null_param_keeps_no_grad():
+    net = nn.Dense(2)
+    net.initialize()
+    x = _x((2, 3))
+    net(x)
+    net.bias.grad_req = "null"
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    assert net.weight.grad() is not None
+    with pytest.raises(MXNetError):
+        net.bias.grad()
+
+
+# ------------------------------------------------- hybridize cache behavior
+def test_hybridize_recompiles_on_shape_and_dtype():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, activation="relu"), nn.Dense(2))
+    net.initialize()
+    net.hybridize()
+    y1 = net(_x((2, 3)))
+    y2 = net(_x((5, 3), seed=1))          # new batch size: new cache entry
+    assert y1.shape == (2, 2) and y2.shape == (5, 2)
+    eager = nn.HybridSequential()
+    eager.add(nn.Dense(4, activation="relu"), nn.Dense(2))
+    eager.initialize()
+    for (k, p_src), (_, p_dst) in zip(net.collect_params().items(),
+                                      eager.collect_params().items()):
+        p_dst.set_data(p_src.data())
+    for shape, seed in [((2, 3), 0), ((5, 3), 1)]:
+        np.testing.assert_allclose(net(_x(shape, seed)).asnumpy(),
+                                   eager(_x(shape, seed)).asnumpy(),
+                                   rtol=1e-5)
+
+
+def test_hybridize_static_alloc_flags_accepted():
+    net = nn.Dense(2)
+    net.initialize()
+    net.hybridize(static_alloc=True, static_shape=True)
+    assert net(_x((2, 3))).shape == (2, 2)
+
+
+# ------------------------------------------------------- block composition
+def test_apply_walks_all_children():
+    seen = []
+    net = nn.HybridSequential()
+    net.add(nn.Dense(2), nn.HybridSequential())
+    net[1].add(nn.Dense(3))
+    net.apply(lambda b: seen.append(type(b).__name__))
+    assert seen.count("Dense") == 2
+    assert "HybridSequential" in seen
+
+
+def test_collect_params_regex_select():
+    net = nn.HybridSequential(prefix="net_")
+    with net.name_scope():
+        net.add(nn.Dense(2), nn.Dense(3))
+    net.initialize()
+    net(_x((2, 3)))
+    sel = net.collect_params(".*weight")
+    keys = list(sel.keys())
+    assert len(keys) == 2
+    assert all(k.endswith("weight") for k in keys)
+
+
+def test_sequential_len_getitem_iteration():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(2), nn.Dense(3), nn.Dense(4))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    assert [type(b).__name__ for b in net] == ["Dense"] * 3
+
+
+def test_name_scope_unique_prefixes():
+    a, b = nn.Dense(2), nn.Dense(2)
+    assert a.prefix != b.prefix  # auto-numbered
+    names = set()
+    net = nn.HybridSequential()
+    net.add(nn.Dense(2), nn.Dense(2))
+    net.initialize()
+    net(_x((2, 3)))
+    for k in net.collect_params():
+        assert k not in names
+        names.add(k)
+
+
+# ------------------------------------------------------------ cast / dtype
+def test_cast_changes_forward_dtype():
+    net = nn.Dense(4)
+    net.initialize()
+    net(_x((2, 3)))
+    net.cast("bfloat16")
+    out = net(_x((2, 3)).astype("bfloat16"))
+    assert "bfloat16" in str(out.dtype)
+    net.cast("float32")
+    out = net(_x((2, 3)))
+    assert out.dtype == np.float32
+
+
+# ---------------------------------------------------------------- trainer
+def test_trainer_save_load_states_resumes_momentum(tmp_path):
+    def make():
+        net = nn.Dense(2, use_bias=False, prefix="t_")
+        net.initialize(mx.init.Constant(0.5))
+        net(_x((2, 3)))
+        return net
+
+    x = _x((4, 3))
+
+    def steps(net, trainer, n):
+        for _ in range(n):
+            with autograd.record():
+                loss = (net(x) ** 2).sum()
+            loss.backward()
+            trainer.step(1)
+
+    # continuous run
+    net_a = make()
+    tr_a = gluon.Trainer(net_a.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    steps(net_a, tr_a, 4)
+
+    # interrupted + resumed run
+    net_b = make()
+    tr_b = gluon.Trainer(net_b.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    steps(net_b, tr_b, 2)
+    f = str(tmp_path / "trainer.states")
+    tr_b.save_states(f)
+    net_b.save_parameters(str(tmp_path / "p.params"))
+
+    net_c = make()
+    net_c.load_parameters(str(tmp_path / "p.params"))
+    tr_c = gluon.Trainer(net_c.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    tr_c.load_states(f)
+    steps(net_c, tr_c, 2)
+    np.testing.assert_allclose(net_c.weight.data().asnumpy(),
+                               net_a.weight.data().asnumpy(), rtol=1e-5)
+
+
+def test_trainer_lr_scheduler_applies():
+    from mxtpu.lr_scheduler import FactorScheduler
+    net = nn.Dense(2)
+    net.initialize()
+    net(_x((2, 3)))
+    sched = FactorScheduler(step=2, factor=0.5, base_lr=1.0)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "lr_scheduler": sched})
+    x = _x((2, 3))
+    lrs = []
+    for _ in range(4):
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        trainer.step(1)
+        lrs.append(trainer.learning_rate)
+    assert lrs[-1] < lrs[0]
