@@ -308,7 +308,8 @@ class NDArray:
             v = value
         else:
             v = jnp.asarray(value)
-        if key == slice(None) and not isinstance(v, (int, float, bool)) \
+        if isinstance(key, slice) and key == slice(None) \
+                and not isinstance(v, (int, float, bool)) \
                 and tuple(getattr(v, "shape", ())) == self.shape:
             self._set_data(jnp.asarray(v, dtype=self._data.dtype))
         else:
@@ -498,13 +499,19 @@ class NDArray:
 
 
 def _clean_index(key):
-    """Normalize an index: NDArray → jax array, tuples recursively."""
+    """Normalize an index: NDArray → jax array, tuples recursively.
+
+    Float index arrays cast to int32: the reference's convention is
+    float32 indices everywhere (take/Embedding/advanced indexing accept
+    them — python/mxnet/ndarray/ndarray.py advanced indexing casts)."""
     if isinstance(key, NDArray):
-        return key._data
-    if isinstance(key, tuple):
+        key = key._data
+    elif isinstance(key, tuple):
         return tuple(_clean_index(k) for k in key)
-    if isinstance(key, list):
-        return jnp.asarray(key)
+    elif isinstance(key, list):
+        key = jnp.asarray(key)
+    if hasattr(key, "dtype") and jnp.issubdtype(key.dtype, jnp.floating):
+        return key.astype(jnp.int32)
     return key
 
 

@@ -1,0 +1,157 @@
+"""NDArray indexing DEPTH tier vs NumPy semantics — the reference's
+tests/python/unittest/test_ndarray.py indexing battery (basic/advanced
+indexing, setitem variants, degenerate shapes). Oracle is NumPy itself:
+every get must equal the same expression on the backing numpy array, and
+every set must leave the array equal to numpy's result.
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.base import MXNetError
+
+RNG = np.random.RandomState
+
+
+def _pair(shape=(4, 5, 6), seed=0):
+    a = RNG(seed).uniform(-2, 2, shape).astype(np.float32)
+    return mx.nd.array(a), a
+
+
+GET_KEYS = [
+    1,
+    -1,
+    (2, 3),
+    slice(1, 3),
+    slice(None, None, 2),
+    slice(3, None, -1),
+    (slice(None), 2),
+    (slice(1, 3), slice(None), slice(None, None, 3)),
+    (Ellipsis, 1),
+    (1, Ellipsis),
+    (slice(None), None),          # new axis
+    None,
+    (0, slice(1, 4), -2),
+]
+
+
+@pytest.mark.parametrize("key", GET_KEYS, ids=[repr(k) for k in GET_KEYS])
+def test_getitem_matches_numpy(key):
+    nd, a = _pair()
+    out = nd[key]
+    ref = a[key]
+    assert out.shape == ref.shape, (out.shape, ref.shape)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-6)
+
+
+def test_getitem_integer_array_and_boolean():
+    nd, a = _pair()
+    idx = np.array([0, 2, 3])
+    np.testing.assert_allclose(nd[mx.nd.array(idx.astype(np.float32))]
+                               .asnumpy(), a[idx], rtol=1e-6)
+    np.testing.assert_allclose(nd[idx].asnumpy(), a[idx], rtol=1e-6)
+    # fancy on two axes
+    i = np.array([0, 1]), np.array([2, 3])
+    np.testing.assert_allclose(nd[i].asnumpy(), a[i], rtol=1e-6)
+
+
+def test_getitem_degenerate_and_scalar():
+    nd, a = _pair((3,), seed=1)
+    s = nd[1]
+    assert s.shape == ()
+    assert float(s.asnumpy()) == pytest.approx(float(a[1]))
+    z = nd[1:1]
+    assert z.shape == (0,)
+
+
+SET_CASES = [
+    (1, 7.5),
+    ((slice(None), 2), -1.0),
+    (slice(1, 3), "row"),             # broadcast a row
+    ((slice(None), slice(None), 0), "col"),
+    ((Ellipsis, -1), 0.0),
+    ((0, 1), 3.25),
+]
+
+
+@pytest.mark.parametrize("key,val", SET_CASES,
+                         ids=[repr(k) for k, _ in SET_CASES])
+def test_setitem_matches_numpy(key, val):
+    nd, a = _pair(seed=2)
+    a = a.copy()
+    if val == "row":
+        v = RNG(3).uniform(-1, 1, a[key].shape[-2:]).astype(np.float32)
+    elif val == "col":
+        v = RNG(4).uniform(-1, 1, a[key].shape).astype(np.float32)
+    else:
+        v = val
+    nd[key] = v
+    a[key] = v
+    np.testing.assert_allclose(nd.asnumpy(), a, rtol=1e-6)
+
+
+def test_setitem_with_ndarray_value_and_full_slice():
+    nd, a = _pair(seed=5)
+    v = RNG(6).uniform(-1, 1, a.shape).astype(np.float32)
+    nd[:] = mx.nd.array(v)
+    np.testing.assert_allclose(nd.asnumpy(), v, rtol=1e-6)
+    nd[1:3] = mx.nd.array(v[0:2])
+    v2 = v.copy()
+    v2[1:3] = v[0:2]
+    np.testing.assert_allclose(nd.asnumpy(), v2, rtol=1e-6)
+
+
+def test_setitem_integer_array_rows():
+    nd, a = _pair(seed=7)
+    a = a.copy()
+    rows = np.array([0, 3])
+    v = RNG(8).uniform(-1, 1, (2,) + a.shape[1:]).astype(np.float32)
+    nd[rows] = mx.nd.array(v)
+    a[rows] = v
+    np.testing.assert_allclose(nd.asnumpy(), a, rtol=1e-6)
+
+
+def test_setitem_under_recording_raises():
+    from mxtpu import autograd
+    nd, _ = _pair()
+    with pytest.raises(MXNetError):
+        with autograd.record():
+            nd[0] = 1.0
+
+
+def test_getitem_grad_flows_through_slice():
+    from mxtpu import autograd
+    nd, a = _pair(seed=9)
+    nd.attach_grad()
+    with autograd.record():
+        y = nd[1:3, ::2].sum()
+    y.backward()
+    g = np.zeros_like(a)
+    g[1:3, ::2] = 1.0
+    np.testing.assert_allclose(nd.grad.asnumpy(), g, rtol=1e-6)
+
+
+def test_views_do_not_alias_source():
+    """Value semantics (unlike numpy views): mutating a slice result must
+    not write back into the source (the reference copies on read-slice of
+    NDArray too)."""
+    nd, a = _pair(seed=10)
+    s = nd[0]
+    s[:] = 99.0
+    np.testing.assert_allclose(nd.asnumpy(), a, rtol=1e-6)
+
+
+def test_zero_size_and_newaxis_combos():
+    nd, a = _pair((2, 0, 3), seed=11)
+    assert nd.shape == (2, 0, 3)
+    assert nd[1].shape == (0, 3)
+    out = nd[:, :, None, 1]
+    assert out.shape == a[:, :, None, 1].shape
+
+
+def test_take_along_negative_and_step_mix():
+    nd, a = _pair((6, 7), seed=12)
+    for key in [(slice(-4, -1), slice(None)),
+                (slice(None, None, -2), slice(1, None, 3)),
+                (-2, slice(-3, None))]:
+        np.testing.assert_allclose(nd[key].asnumpy(), a[key], rtol=1e-6)

@@ -1,0 +1,152 @@
+"""Metric-zoo DEPTH tier: every EvalMetric checked against hand-computed
+or sklearn-free closed-form values (ref: tests/python/unittest/
+test_metric.py — each metric pinned on small literal cases).
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import metric
+
+ND = mx.nd.array
+
+
+def test_accuracy_from_logits_and_labels():
+    m = metric.Accuracy()
+    preds = ND(np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]],
+                        np.float32))
+    labels = ND(np.array([1, 1, 1], np.float32))
+    m.update([labels], [preds])
+    assert m.get()[1] == pytest.approx(2 / 3)
+    m.reset()
+    assert np.isnan(m.get()[1])
+
+
+def test_topk_accuracy():
+    m = metric.TopKAccuracy(top_k=2)
+    preds = ND(np.array([[0.6, 0.3, 0.1],       # top2 = {0,1}
+                         [0.1, 0.2, 0.7],       # top2 = {1,2}
+                         [0.2, 0.5, 0.3]],      # top2 = {1,2}
+                        np.float32))
+    labels = ND(np.array([1, 0, 2], np.float32))
+    m.update([labels], [preds])
+    assert m.get()[1] == pytest.approx(2 / 3)
+
+
+def test_f1_binary_closed_form():
+    m = metric.F1()
+    # preds prob of class1; threshold 0.5
+    preds = ND(np.array([[0.7, 0.3], [0.2, 0.8], [0.4, 0.6], [0.9, 0.1]],
+                        np.float32))
+    labels = ND(np.array([0, 1, 0, 1], np.float32))
+    m.update([labels], [preds])
+    # predictions: [0, 1, 1, 0]; tp=1 fp=1 fn=1 -> P=R=0.5 -> F1=0.5
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_mcc_matches_formula():
+    m = metric.MCC()
+    preds = ND(np.array([[0.2, 0.8], [0.7, 0.3], [0.3, 0.7], [0.6, 0.4],
+                         [0.1, 0.9]], np.float32))
+    labels = ND(np.array([1, 0, 0, 0, 1], np.float32))
+    m.update([labels], [preds])
+    tp, tn, fp, fn = 2, 2, 1, 0
+    want = (tp * tn - fp * fn) / np.sqrt(
+        (tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+    assert m.get()[1] == pytest.approx(want, rel=1e-6)
+
+
+def test_perplexity_uniform_is_vocab_size():
+    vocab = 8
+    m = metric.Perplexity(ignore_label=None)
+    preds = ND(np.full((5, vocab), 1.0 / vocab, np.float32))
+    labels = ND(np.arange(5, dtype=np.float32))
+    m.update([labels], [preds])
+    assert m.get()[1] == pytest.approx(vocab, rel=1e-5)
+
+
+def test_perplexity_ignore_label():
+    m = metric.Perplexity(ignore_label=0)
+    preds = ND(np.array([[0.5, 0.5], [1e-9, 1.0 - 1e-9]], np.float32))
+    labels = ND(np.array([0, 1], np.float32))  # first row ignored
+    m.update([labels], [preds])
+    assert m.get()[1] == pytest.approx(1.0, rel=1e-4)
+
+
+def test_regression_metrics_closed_form():
+    p = np.array([[1.0, 2.0], [3.0, 5.0]], np.float32)
+    t = np.array([[2.0, 2.0], [3.0, 1.0]], np.float32)
+    mae = metric.MAE()
+    mae.update([ND(t)], [ND(p)])
+    assert mae.get()[1] == pytest.approx(np.abs(p - t).mean())
+    mse = metric.MSE()
+    mse.update([ND(t)], [ND(p)])
+    assert mse.get()[1] == pytest.approx(((p - t) ** 2).mean())
+    rmse = metric.RMSE()
+    rmse.update([ND(t)], [ND(p)])
+    assert rmse.get()[1] == pytest.approx(
+        np.sqrt(((p - t) ** 2).mean()), rel=1e-6)
+
+
+def test_cross_entropy_and_nll():
+    preds = np.array([[0.25, 0.75], [0.9, 0.1]], np.float32)
+    labels = np.array([1, 0], np.float32)
+    ce = metric.CrossEntropy()
+    ce.update([ND(labels)], [ND(preds)])
+    want = -(np.log(0.75) + np.log(0.9)) / 2
+    assert ce.get()[1] == pytest.approx(want, rel=1e-5)
+    nll = metric.NegativeLogLikelihood()
+    nll.update([ND(labels)], [ND(preds)])
+    assert nll.get()[1] == pytest.approx(want, rel=1e-5)
+
+
+def test_pearson_correlation_exact():
+    x = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    y = 2 * x + 1  # perfectly correlated
+    m = metric.PearsonCorrelation()
+    m.update([ND(y)], [ND(x)])
+    assert m.get()[1] == pytest.approx(1.0, rel=1e-5)
+    m2 = metric.PearsonCorrelation()
+    m2.update([ND(-y)], [ND(x)])
+    assert m2.get()[1] == pytest.approx(-1.0, rel=1e-5)
+
+
+def test_loss_metric_averages_batches():
+    m = metric.Loss()
+    m.update(None, [ND(np.array([2.0, 4.0], np.float32))])
+    m.update(None, [ND(np.array([6.0], np.float32))])
+    assert m.get()[1] == pytest.approx(4.0)
+
+
+def test_custom_metric_and_composite():
+    def double_mae(label, pred):
+        return 2 * np.abs(label - pred).mean()
+
+    cm = metric.CustomMetric(double_mae, name="dmae")
+    lbl = np.array([1.0, 3.0], np.float32)
+    prd = np.array([2.0, 5.0], np.float32)
+    cm.update([ND(lbl)], [ND(prd)])
+    assert cm.get()[1] == pytest.approx(3.0)
+
+    comp = metric.CompositeEvalMetric()
+    comp.add(metric.MAE())
+    comp.add(metric.MSE())
+    comp.update([ND(lbl)], [ND(prd)])
+    names, vals = comp.get()
+    assert "mae" in names[0] and vals[0] == pytest.approx(1.5)
+    assert "mse" in names[1] and vals[1] == pytest.approx(2.5)
+
+
+def test_metric_create_by_name_registry():
+    for name, cls in [("acc", metric.Accuracy), ("mae", metric.MAE),
+                      ("mse", metric.MSE), ("rmse", metric.RMSE)]:
+        m = metric.create(name)
+        assert isinstance(m, cls), name
+
+
+def test_accuracy_with_flat_class_preds():
+    """Reference behavior: 1-D predictions are taken as class ids."""
+    m = metric.Accuracy()
+    m.update([ND(np.array([1, 0, 2], np.float32))],
+             [ND(np.array([1, 1, 2], np.float32))])
+    assert m.get()[1] == pytest.approx(2 / 3)
