@@ -104,9 +104,17 @@ class Parameter:
 
     def _finish_init(self, init, default_init):
         data = NDArray(jnp.zeros(self.shape, _as_jax_dtype(self.dtype)))
-        initializer = init_mod.create(init or self.init or default_init)
-        desc = init_mod.InitDesc(self.name)
-        initializer(desc, data)
+        chosen = init or self.init
+        if chosen is not None:
+            # reference mechanism (gluon/parameter.py _finish_deferred_init):
+            # an explicitly-chosen initializer rides the InitDesc attrs and
+            # the dispatcher forces it through _init_weight — otherwise the
+            # name dispatch would send e.g. bias_initializer=Constant(3)
+            # through the *bias → zeros rule and silently ignore it
+            desc = init_mod.InitDesc(self.name, attrs={"__init__": chosen})
+        else:
+            desc = init_mod.InitDesc(self.name)
+        init_mod.create(default_init)(desc, data)
         self._load_init_data(data)
         self._deferred_init = None
 

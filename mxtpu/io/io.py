@@ -128,6 +128,7 @@ class NDArrayIter(DataIter):
         else:
             self.num_batches = (self.num_data + batch_size - 1) // batch_size
         self._order = np.arange(self.num_data)
+        self._leftover = np.array([], dtype=np.int64)
         self.reset()
 
     @property
@@ -141,8 +142,21 @@ class NDArrayIter(DataIter):
                 for k, v in self.label]
 
     def reset(self):
+        base = np.arange(self.num_data)
         if self.shuffle:
-            np.random.shuffle(self._order)
+            np.random.shuffle(base)
+        if self.last_batch_handle == "roll_over":
+            # reference semantics: the incomplete tail batch is NOT
+            # emitted this epoch — it rolls over and leads the next
+            # epoch's stream (io.py NDArrayIter roll_over; what
+            # BucketSentenceIter round_batch relies on)
+            eff = np.concatenate([self._leftover, base])
+            n_full = len(eff) // self.batch_size
+            self.num_batches = n_full
+            self._leftover = eff[n_full * self.batch_size:]
+            self._order = eff[:n_full * self.batch_size]
+        else:
+            self._order = base
         self._cursor = -1
 
     def iter_next(self):
@@ -157,13 +171,13 @@ class NDArrayIter(DataIter):
             idx = self._order[start:end]
             chunk = v[idx]
             if chunk.shape[0] < self.batch_size:
-                if self.last_batch_handle == "roll_over":
-                    wrap = self._order[:self.batch_size - chunk.shape[0]]
-                    chunk = np.concatenate([chunk, v[wrap]], axis=0)
-                else:  # pad
-                    pad = np.zeros((self.batch_size - chunk.shape[0],)
-                                   + v.shape[1:], dtype=v.dtype)
-                    chunk = np.concatenate([chunk, pad], axis=0)
+                # pad policy (roll_over never reaches here: its epoch
+                # holds only full batches). Fill by WRAPPING from the
+                # epoch's start — the reference pads with real leading
+                # samples, not zeros; DataBatch.pad tells consumers how
+                # many trailing rows to ignore either way
+                wrap = self._order[:self.batch_size - chunk.shape[0]]
+                chunk = np.concatenate([chunk, v[wrap]], axis=0)
             out.append(array(chunk))
         return out
 

@@ -503,15 +503,33 @@ def _clean_index(key):
 
     Float index arrays cast to int32: the reference's convention is
     float32 indices everywhere (take/Embedding/advanced indexing accept
-    them — python/mxnet/ndarray/ndarray.py advanced indexing casts)."""
+    them — python/mxnet/ndarray/ndarray.py advanced indexing casts).
+
+    Boolean masks convert to concrete integer indices on host
+    (numpy's nonzero-expansion semantics). Indexing is an EAGER API
+    here — the mask's values are available — and the conversion keeps
+    the resulting gather static-shaped instead of handing jnp a
+    data-dependent-shape lowering."""
     if isinstance(key, NDArray):
         key = key._data
     elif isinstance(key, tuple):
-        return tuple(_clean_index(k) for k in key)
+        out = []
+        for k in key:
+            k = _clean_index(k)
+            if isinstance(k, tuple):   # an N-d bool expanded to N arrays
+                out.extend(k)
+            else:
+                out.append(k)
+        return tuple(out)
     elif isinstance(key, list):
         key = jnp.asarray(key)
-    if hasattr(key, "dtype") and jnp.issubdtype(key.dtype, jnp.floating):
-        return key.astype(jnp.int32)
+    if hasattr(key, "dtype"):
+        if jnp.issubdtype(key.dtype, jnp.floating):
+            return key.astype(jnp.int32)
+        if key.dtype == bool:
+            nz = _np.nonzero(_np.asarray(key))
+            return nz[0] if len(nz) == 1 else tuple(
+                jnp.asarray(i) for i in nz)
     return key
 
 

@@ -53,6 +53,15 @@ def test_getitem_integer_array_and_boolean():
     # fancy on two axes
     i = np.array([0, 1]), np.array([2, 3])
     np.testing.assert_allclose(nd[i].asnumpy(), a[i], rtol=1e-6)
+    # boolean masks: 1-D on an axis, full-shape, and mixed-in-tuple —
+    # converted host-side to nonzero indices (static-shape gathers)
+    m1 = np.array([True, False, True, False])
+    np.testing.assert_allclose(nd[m1].asnumpy(), a[m1], rtol=1e-6)
+    np.testing.assert_allclose(nd[(m1, 2)].asnumpy(), a[m1, 2], rtol=1e-6)
+    mfull = RNG(20).uniform(size=a.shape) > 0.5
+    np.testing.assert_allclose(nd[mfull].asnumpy(), a[mfull], rtol=1e-6)
+    m2 = RNG(21).uniform(size=a.shape[:2]) > 0.5
+    np.testing.assert_allclose(nd[m2].asnumpy(), a[m2], rtol=1e-6)
 
 
 def test_getitem_degenerate_and_scalar():
@@ -132,9 +141,12 @@ def test_getitem_grad_flows_through_slice():
 
 
 def test_views_do_not_alias_source():
-    """Value semantics (unlike numpy views): mutating a slice result must
-    not write back into the source (the reference copies on read-slice of
-    NDArray too)."""
+    """Deliberate divergence from the reference: MXNet's basic indexing
+    (_at/_slice) returns memory-SHARING views where ``s[:] = x`` writes
+    back; here slice results are functional copies (jax arrays are
+    immutable — write-back aliasing cannot be expressed), so mutating a
+    slice result must never touch the source. Pinned so the divergence
+    is documented behavior, not an accident."""
     nd, a = _pair(seed=10)
     s = nd[0]
     s[:] = 99.0

@@ -1,0 +1,142 @@
+"""Initializer-zoo DEPTH tier (ref: tests/python/unittest/test_init.py +
+the init checks inside test_gluon.py): deterministic initializers pinned
+exactly, stochastic ones by distribution statistics, and the
+pattern-dispatch machinery (Mixed, attrs) by behavior.
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import init
+from mxtpu.gluon import nn
+
+RNG = np.random.RandomState
+
+
+def _init_param(shape, initializer, name="weight"):
+    net = nn.Dense(shape[0], in_units=shape[1], use_bias=False)
+    net.initialize(initializer)
+    return net.weight.data().asnumpy()
+
+
+def test_zero_one_constant():
+    assert (_init_param((4, 3), init.Zero()) == 0).all()
+    assert (_init_param((4, 3), init.One()) == 1).all()
+    assert (_init_param((4, 3), init.Constant(2.5)) == 2.5).all()
+
+
+def test_uniform_and_normal_ranges():
+    mx.random.seed(0)
+    w = _init_param((64, 128), init.Uniform(0.2))
+    assert np.abs(w).max() <= 0.2 + 1e-6
+    assert np.abs(w).mean() > 0.05          # actually spread out
+    mx.random.seed(0)
+    w = _init_param((64, 128), init.Normal(0.05))
+    assert abs(w.std() - 0.05) < 0.005
+    assert abs(w.mean()) < 0.005
+
+
+@pytest.mark.parametrize("factor,expected_fan", [
+    ("in", "fan_in"), ("out", "fan_out"), ("avg", "avg")])
+def test_xavier_scale_matches_fan(factor, expected_fan):
+    mx.random.seed(0)
+    nin, nout, mag = 300, 150, 3.0
+    w = _init_param((nout, nin), init.Xavier(rnd_type="uniform",
+                                             factor_type=factor,
+                                             magnitude=mag))
+    fans = {"fan_in": nin, "fan_out": nout, "avg": (nin + nout) / 2}
+    scale = np.sqrt(mag / fans[expected_fan])
+    assert np.abs(w).max() <= scale + 1e-6
+    # a U(-s, s) sample of this size has std ~ s/sqrt(3)
+    assert abs(w.std() - scale / np.sqrt(3)) < 0.1 * scale
+
+
+def test_xavier_gaussian_and_msra():
+    mx.random.seed(0)
+    nin, nout = 400, 200
+    w = _init_param((nout, nin), init.Xavier(rnd_type="gaussian",
+                                             factor_type="in", magnitude=2))
+    assert abs(w.std() - np.sqrt(2.0 / nin)) < 0.1 * np.sqrt(2.0 / nin)
+    mx.random.seed(0)
+    slope = 0.25
+    w = _init_param((nout, nin), init.MSRAPrelu(factor_type="in",
+                                                slope=slope))
+    want = np.sqrt(2.0 / (1 + slope ** 2) / nin)
+    assert abs(w.std() - want) < 0.1 * want
+
+
+def test_orthogonal_rows_are_orthonormal():
+    mx.random.seed(0)
+    scale = 1.414
+    w = _init_param((16, 64), init.Orthogonal(scale=scale))
+    gram = (w / scale) @ (w / scale).T
+    np.testing.assert_allclose(gram, np.eye(16), atol=1e-4)
+
+
+def test_bilinear_kernel_is_separable_triangle():
+    from mxtpu.ndarray.ndarray import NDArray
+    import jax.numpy as jnp
+    arr = mx.nd.array(np.zeros((2, 1, 4, 4), np.float32))
+    init.Bilinear()("weight", arr)
+    w = arr.asnumpy()
+    f = np.ceil(4 / 2.0)
+    c = (2 * f - 1 - f % 2) / (2.0 * f)
+    tri = np.array([1 - abs(x / f - c) for x in range(4)])
+    np.testing.assert_allclose(w[0, 0], np.outer(tri, tri), rtol=1e-6)
+    np.testing.assert_allclose(w[1, 0], w[0, 0], rtol=1e-6)  # same per filter
+
+
+def test_lstm_bias_forget_gate_only():
+    """Per-param LSTMBias must survive the *bias -> zeros name dispatch:
+    the chosen initializer rides InitDesc attrs (reference mechanism),
+    regression for bias_initializer being silently zeroed."""
+    net = nn.Dense(8, in_units=2,
+                   bias_initializer=init.LSTMBias(forget_bias=1.0))
+    net.initialize()
+    b = net.bias.data().asnumpy()      # 4 gates x 2 hidden
+    np.testing.assert_allclose(b[2:4], 1.0)   # forget gate block
+    np.testing.assert_allclose(b[:2], 0.0)
+    np.testing.assert_allclose(b[4:], 0.0)
+
+
+def test_mixed_initializer_pattern_dispatch():
+    """Mixed maps name patterns to initializers (ref: Module init_params
+    usage; like the reference, Mixed is not itself an Initializer and is
+    called per-(name, array))."""
+    m = init.Mixed([".*special.*", ".*"],
+                   [init.Constant(7.0), init.One()])
+    a = mx.nd.array(np.zeros((3,), np.float32))
+    b = mx.nd.array(np.zeros((3,), np.float32))
+    m("special_weight", a)
+    m("plain_weight", b)
+    np.testing.assert_allclose(a.asnumpy(), 7.0)
+    np.testing.assert_allclose(b.asnumpy(), 1.0)
+    with pytest.raises(Exception):
+        init.Mixed(["nomatch"], [init.One()])("other_weight", a)
+
+
+def test_initializer_create_registry_and_repr():
+    for name, cls in [("zero", init.Zero), ("uniform", init.Uniform),
+                      ("xavier", init.Xavier), ("normal", init.Normal)]:
+        o = init.create(name) if hasattr(init, "create") else cls()
+        assert isinstance(o, cls)
+
+
+def test_parameter_init_override_beats_global():
+    """Per-parameter init= overrides the initialize(default) argument
+    (ref: Parameter(init=...) precedence)."""
+    net = nn.Dense(4, in_units=3, weight_initializer=init.One(),
+                   bias_initializer=init.Constant(3.0))
+    net.initialize(init.Zero())
+    np.testing.assert_allclose(net.weight.data().asnumpy(), 1.0)
+    np.testing.assert_allclose(net.bias.data().asnumpy(), 3.0)
+
+
+def test_force_reinit_changes_values():
+    net = nn.Dense(4, in_units=3)
+    net.initialize(init.One())
+    np.testing.assert_allclose(net.weight.data().asnumpy(), 1.0)
+    net.initialize(init.Zero())              # no-op without force_reinit
+    np.testing.assert_allclose(net.weight.data().asnumpy(), 1.0)
+    net.initialize(init.Zero(), force_reinit=True)
+    np.testing.assert_allclose(net.weight.data().asnumpy(), 0.0)

@@ -1,0 +1,146 @@
+"""IO iterator DEPTH tier (ref: tests/python/unittest/test_io.py):
+NDArrayIter's three last-batch policies, shuffle correctness, the
+DataBatch pad contract, dict/multi-input data, CSVIter parsing, and
+PrefetchingIter equivalence.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.base import MXNetError
+
+RNG = np.random.RandomState
+
+
+def _collect(it):
+    batches = []
+    for b in it:
+        batches.append((b.data[0].asnumpy().copy(),
+                        b.label[0].asnumpy().copy()
+                        if b.label else None, b.pad))
+    return batches
+
+
+def test_ndarrayiter_exact_division():
+    x = np.arange(12, dtype=np.float32).reshape(12, 1)
+    y = np.arange(12, dtype=np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=4)
+    bs = _collect(it)
+    assert len(bs) == 3
+    np.testing.assert_allclose(np.concatenate([b[0] for b in bs]), x)
+    assert all(b[2] == 0 for b in bs)
+
+
+def test_ndarrayiter_pad_policy():
+    """pad: the tail batch is filled up to batch_size by wrapping, and
+    DataBatch.pad reports how many samples are padding (ref: io.py
+    NDArrayIter pad semantics)."""
+    x = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = mx.io.NDArrayIter(x, None, batch_size=4, last_batch_handle="pad")
+    bs = _collect(it)
+    assert len(bs) == 3
+    assert [b[2] for b in bs] == [0, 0, 2]
+    assert bs[2][0].shape == (4, 1)
+    np.testing.assert_allclose(bs[2][0][:2], x[8:10])  # real tail samples
+    np.testing.assert_allclose(bs[2][0][2:], x[0:2])   # wrap-pad, not zeros
+
+
+def test_ndarrayiter_discard_policy():
+    x = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = mx.io.NDArrayIter(x, None, batch_size=4,
+                           last_batch_handle="discard")
+    bs = _collect(it)
+    assert len(bs) == 2
+    np.testing.assert_allclose(np.concatenate([b[0] for b in bs]), x[:8])
+
+
+def test_ndarrayiter_roll_over_policy():
+    """roll_over: the incomplete tail is NOT emitted this epoch; it
+    leads the next epoch's stream (ref: io.py roll_over semantics)."""
+    x = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = mx.io.NDArrayIter(x, None, batch_size=4,
+                           last_batch_handle="roll_over")
+    e1 = _collect(it)
+    assert len(e1) == 2                       # floor(10/4) full batches
+    np.testing.assert_allclose(
+        np.concatenate([b[0] for b in e1]), x[:8])
+    it.reset()
+    e2 = _collect(it)
+    # epoch 2 = [8, 9] rolled over + the fresh epoch: 12 samples, 3 full
+    assert len(e2) == 3
+    np.testing.assert_allclose(e2[0][0][:2], x[8:10])
+    np.testing.assert_allclose(e2[0][0][2:], x[0:2])
+    # across both epochs nothing is lost or duplicated beyond the policy
+    total = sum(b[0].shape[0] for b in e1 + e2)
+    assert total == 20
+
+
+def test_ndarrayiter_shuffle_is_permutation_and_aligned():
+    mx.random.seed(0)
+    x = np.arange(20, dtype=np.float32).reshape(20, 1)
+    y = np.arange(20, dtype=np.float32) * 10
+    it = mx.io.NDArrayIter(x, y, batch_size=5, shuffle=True)
+    bs = _collect(it)
+    xs = np.concatenate([b[0] for b in bs]).ravel()
+    ys = np.concatenate([b[1] for b in bs]).ravel()
+    assert sorted(xs.tolist()) == x.ravel().tolist()   # a permutation
+    np.testing.assert_allclose(ys, xs * 10)            # labels track data
+    it.reset()
+    xs2 = np.concatenate([b[0] for b in _collect(it)]).ravel()
+    assert sorted(xs2.tolist()) == x.ravel().tolist()
+
+
+def test_ndarrayiter_dict_inputs_and_provide_data():
+    x1 = np.zeros((8, 2), np.float32)
+    x2 = np.ones((8, 3), np.float32)
+    it = mx.io.NDArrayIter({"a": x1, "b": x2}, None, batch_size=4)
+    descs = {d.name: d.shape for d in it.provide_data}
+    assert descs == {"a": (4, 2), "b": (4, 3)}
+    b = next(iter(it))
+    assert len(b.data) == 2
+
+
+def test_ndarrayiter_length_mismatch_raises():
+    with pytest.raises(MXNetError):
+        mx.io.NDArrayIter(np.zeros((8, 2), np.float32),
+                          np.zeros((7,), np.float32), batch_size=4)
+
+
+def test_csviter_values_and_shapes(tmp_path):
+    data = RNG(0).uniform(-1, 1, (9, 4)).astype(np.float32)
+    lbl = RNG(1).randint(0, 3, (9, 1)).astype(np.float32)
+    dcsv = str(tmp_path / "d.csv")
+    lcsv = str(tmp_path / "l.csv")
+    np.savetxt(dcsv, data, delimiter=",", fmt="%.6f")
+    np.savetxt(lcsv, lbl, delimiter=",", fmt="%.0f")
+    it = mx.io.CSVIter(data_csv=dcsv, data_shape=(4,), label_csv=lcsv,
+                       label_shape=(1,), batch_size=3)
+    got_x, got_y = [], []
+    for b in it:
+        got_x.append(b.data[0].asnumpy())
+        got_y.append(b.label[0].asnumpy())
+    np.testing.assert_allclose(np.concatenate(got_x), data, rtol=1e-5)
+    np.testing.assert_allclose(np.concatenate(got_y).ravel(), lbl.ravel())
+
+
+def test_prefetching_iter_equivalence():
+    x = np.arange(48, dtype=np.float32).reshape(24, 2)
+    base = mx.io.NDArrayIter(x, None, batch_size=6)
+    plain = [b.data[0].asnumpy().copy() for b in base]
+    base.reset()
+    pre = mx.io.PrefetchingIter(base)
+    fetched = [b.data[0].asnumpy().copy() for b in pre]
+    assert len(plain) == len(fetched)
+    for p, f in zip(plain, fetched):
+        np.testing.assert_allclose(p, f)
+
+
+def test_iter_reset_mid_epoch():
+    x = np.arange(16, dtype=np.float32).reshape(16, 1)
+    it = mx.io.NDArrayIter(x, None, batch_size=4)
+    next(iter(it))
+    it.reset()
+    bs = _collect(it)
+    assert len(bs) == 4  # full epoch after reset
