@@ -29,6 +29,16 @@ for _name, _op in _ops.REGISTRY.items():
             setattr(contrib, _alias, _op.wrapper)
 _sys.modules[contrib.__name__] = contrib
 
+# mx.nd._internal.* — the reference's underscore-op namespace
+# (python/mxnet/ndarray/_internal.py; e.g. the doc example at
+# src/operator/tensor/square_sum.cc:61 calls mx.nd._internal._square_sum).
+# Every `_`-prefixed registry name (op or alias) is exposed here.
+_internal = _types.ModuleType(__name__ + "._internal")
+for _name, _op in _ops.REGISTRY.items():
+    if _name.startswith("_") and not hasattr(_internal, _name):
+        setattr(_internal, _name, _op.wrapper)
+_sys.modules[_internal.__name__] = _internal
+
 # creation helpers registered wrap=False already return NDArrays
 from ..ops.init_ops import arange, empty, eye, full, linspace, ones, zeros  # noqa: E402,F401
 from .utils import load, save  # noqa: E402,F401

@@ -103,21 +103,24 @@ def _b(name, fn, aliases=(), as_method=False):
 
 
 broadcast_add = _b("broadcast_add", lambda a, b: jnp.add(a, b),
-                   aliases=("elemwise_add", "_plus_scalar", "_add"))
+                   aliases=("elemwise_add", "_plus_scalar", "_add", "_grad_add"))
 broadcast_sub = _b("broadcast_sub", lambda a, b: jnp.subtract(a, b),
                    aliases=("elemwise_sub", "_minus_scalar", "_sub"))
 broadcast_mul = _b("broadcast_mul", lambda a, b: jnp.multiply(a, b),
                    aliases=("elemwise_mul", "_mul_scalar", "_mul"))
 broadcast_div = _b("broadcast_div", lambda a, b: jnp.divide(a, b),
                    aliases=("elemwise_div", "_div_scalar", "_div"))
-broadcast_mod = _b("broadcast_mod", lambda a, b: jnp.mod(a, b), aliases=("_mod_scalar",))
+broadcast_mod = _b("broadcast_mod", lambda a, b: jnp.mod(a, b),
+                   aliases=("_mod_scalar", "_mod"))
+_rmod_scalar = _b("_rmod_scalar", lambda a, b: jnp.mod(b, a))
 broadcast_power = _b("broadcast_power", lambda a, b: jnp.power(a, b),
                      aliases=("_power_scalar", "_power"))
 broadcast_maximum = _b("broadcast_maximum", lambda a, b: jnp.maximum(a, b),
                        aliases=("_maximum_scalar", "_maximum", "maximum"))
 broadcast_minimum = _b("broadcast_minimum", lambda a, b: jnp.minimum(a, b),
                        aliases=("_minimum_scalar", "_minimum", "minimum"))
-broadcast_hypot = _b("broadcast_hypot", lambda a, b: jnp.hypot(a, b))
+broadcast_hypot = _b("broadcast_hypot", lambda a, b: jnp.hypot(a, b),
+                     aliases=("_hypot", "_hypot_scalar"))
 _rminus_scalar = _b("_rminus_scalar", lambda a, b: jnp.subtract(b, a))
 _rdiv_scalar = _b("_rdiv_scalar", lambda a, b: jnp.divide(b, a))
 _rpower_scalar = _b("_rpower_scalar", lambda a, b: jnp.power(b, a))
@@ -140,13 +143,13 @@ broadcast_lesser_equal = _b("broadcast_lesser_equal",
                             aliases=("_lesser_equal", "_lesser_equal_scalar"))
 broadcast_logical_and = _b("broadcast_logical_and",
                            lambda a, b: jnp.logical_and(a, b).astype(_f32),
-                           aliases=("_logical_and",))
+                           aliases=("_logical_and", "_logical_and_scalar"))
 broadcast_logical_or = _b("broadcast_logical_or",
                           lambda a, b: jnp.logical_or(a, b).astype(_f32),
-                          aliases=("_logical_or",))
+                          aliases=("_logical_or", "_logical_or_scalar"))
 broadcast_logical_xor = _b("broadcast_logical_xor",
                            lambda a, b: jnp.logical_xor(a, b).astype(_f32),
-                           aliases=("_logical_xor",))
+                           aliases=("_logical_xor", "_logical_xor_scalar"))
 
 
 @register("smooth_l1")
@@ -185,3 +188,77 @@ def where(condition, x, y):
 def cast(x, dtype="float32"):
     from ..ndarray.ndarray import _as_jax_dtype
     return x.astype(_as_jax_dtype(dtype))
+
+
+@register("hard_sigmoid")
+def hard_sigmoid(x, alpha=0.2, beta=0.5):
+    """Piecewise-linear sigmoid ``max(0, min(1, alpha*x + beta))``
+    (ref: src/operator/tensor/elemwise_unary_op_basic.cc:109 hard_sigmoid,
+    HardSigmoidParam alpha=0.2 beta=0.5). Written as nested selects rather
+    than clip so the vjp is exactly the reference backward — grad = alpha
+    strictly inside the linear band, 0 at and beyond saturation (clip's
+    min/max vjp splits the gradient at exact boundary ties)."""
+    y = alpha * x + beta
+    return jnp.where(y <= 0.0, 0.0, jnp.where(y >= 1.0, 1.0, y))
+
+
+# ------------------------------------------------------ scatter-family ops
+# Reference: src/operator/tensor/elemwise_scatter_op.cc. Semantics: the op
+# is applied ONLY at the lhs's stored values when lhs is sparse (the result
+# keeps lhs's storage and sparsity pattern — a non-zero-preserving op like
+# `+ scalar` deliberately does NOT densify); dense lhs degenerates to the
+# ordinary elementwise op. Used by sparse optimizer updates.
+
+def _emit(res, out):
+    """Write a possibly-sparse result into ``out`` via copyto (which moves
+    aux indices/shape along with values — out._set_data alone would leave a
+    sparse out's indices stale) or return it."""
+    if out is None:
+        return res
+    return res.copyto(out)
+
+
+def _scatter_scalar(name, jfn):
+    @register(name, wrap=False)
+    def fn(lhs, scalar=0.0, out=None, **_ig):
+        from ..ndarray.ndarray import _apply as _ap
+        from ..ndarray.sparse import BaseSparseNDArray
+        vals = _ap(lambda a: jfn(a, scalar), (lhs,), name=name)
+        if isinstance(lhs, BaseSparseNDArray):
+            res = lhs._replace_values(vals._data)
+            res._ag_entry = vals._ag_entry
+        else:
+            res = vals
+        return _emit(res, out)
+    fn.__name__ = name
+    return fn
+
+
+_scatter_plus_scalar = _scatter_scalar("_scatter_plus_scalar",
+                                       lambda a, s: jnp.add(a, s))
+_scatter_minus_scalar = _scatter_scalar("_scatter_minus_scalar",
+                                        lambda a, s: jnp.subtract(a, s))
+
+
+@register("_scatter_elemwise_div", wrap=False)
+def _scatter_elemwise_div(lhs, rhs, out=None, **_ig):
+    """Divide, evaluated only at lhs's stored rows when lhs is row_sparse
+    (ref: elemwise_scatter_op.cc:69): result rows = lhs.values / rhs[row_ids],
+    keeping lhs's sparsity — the dense rhs never materializes a dense lhs."""
+    from ..ndarray.ndarray import _apply as _ap
+    from ..ndarray.sparse import BaseSparseNDArray, RowSparseNDArray
+    if isinstance(rhs, BaseSparseNDArray):
+        rhs = rhs.todense()  # storage fallback: rhs is read densely
+    if isinstance(lhs, RowSparseNDArray):
+        idx = lhs._aux["indices"]
+        vals = _ap(lambda v, d: v / d[idx], (lhs, rhs),
+                   name="_scatter_elemwise_div")
+        res = lhs._replace_values(vals._data)
+        res._ag_entry = vals._ag_entry
+    else:
+        if isinstance(lhs, BaseSparseNDArray):
+            # CSR lhs: the reference's storage rule falls back to dense
+            # (its values buffer is 1-D, not row-addressable)
+            lhs = lhs.todense()
+        res = _ap(jnp.divide, (lhs, rhs), name="_scatter_elemwise_div")
+    return _emit(res, out)

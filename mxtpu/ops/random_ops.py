@@ -148,6 +148,54 @@ def multinomial(data, shape=None, get_prob=False, dtype="int32", **_ig):
     return res
 
 
+@register("_sample_unique_zipfian", wrap=False, num_outputs=2)
+def _sample_unique_zipfian(range_max, shape=None, **_ig):
+    """Per-row unique samples from the approx-Zipfian (log-uniform)
+    distribution over [0, range_max): value = round(exp(u * ln(range_max)))-1
+    rejection-sampled without replacement, plus the per-row try counts used
+    to derive expected counts in candidate sampling / NCE (ref:
+    src/operator/random/unique_sample_op.{h,cc} UniqueSampleUniformKernel —
+    a CPU-only kernel there too; the data-dependent rejection loop is host
+    work by design, feeding device-side NCE training)."""
+    import numpy as _onp
+    shp = _shape(shape)
+    if len(shp) != 2:
+        raise ValueError("_sample_unique_zipfian needs a 2-D shape, got %r"
+                         % (shape,))
+    batch, num_sampled = shp
+    if num_sampled > range_max:
+        raise ValueError("cannot draw %d unique samples from range_max=%d"
+                         % (num_sampled, range_max))
+    if range_max >= 2**31:
+        # the reference emits int64; device arrays here are int32 under
+        # jax's default x64-off config, so huge id spaces would wrap
+        raise ValueError("range_max %d exceeds int32 id space" % range_max)
+    # derive a host RNG stream from the framework's functional key so runs
+    # seeded via mxtpu.random.seed reproduce
+    seed = int(jax.random.randint(next_key(), (), 0, 2**31 - 1))
+    rng = _onp.random.default_rng(seed)
+    log_range = _onp.log(range_max)
+    samples = _onp.empty((batch, num_sampled), dtype=_onp.int32)
+    tries = _onp.empty((batch,), dtype=_onp.int32)
+    for i in range(batch):
+        seen = set()
+        t = 0
+        while len(seen) < num_sampled:
+            # draw a chunk; rejection keeps only first-seen values
+            draw = _onp.floor(
+                _onp.exp(rng.random(max(num_sampled, 16)) * log_range) + 0.5
+            ).astype(_onp.int32) - 1
+            for v in draw:
+                t += 1
+                if v not in seen:
+                    samples[i, len(seen)] = v
+                    seen.add(int(v))
+                    if len(seen) == num_sampled:
+                        break
+        tries[i] = t
+    return [NDArray(jnp.asarray(samples)), NDArray(jnp.asarray(tries))]
+
+
 @register("shuffle", aliases=("_shuffle",), wrap=False)
 def shuffle(data, **_ig):
     """Shuffle along axis 0 (ref: src/operator/random/shuffle_op.cc)."""

