@@ -139,9 +139,16 @@ class BaseModule:
                     cb(epoch, self.symbol, arg_p, aux_p)
 
             if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
+                vmetric = _as_metric(validation_metric)
+                res = self.score(eval_data, vmetric,
                                  batch_end_callback=eval_batch_end_callback,
                                  epoch=epoch)
+                if eval_end_callback is not None:
+                    param = BatchEndParam(epoch=epoch, nbatch=0,
+                                          eval_metric=vmetric,
+                                          locals=locals())
+                    for cb in _as_list(eval_end_callback):
+                        cb(param)
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f",
                                      epoch, name, val)

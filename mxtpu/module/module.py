@@ -83,8 +83,17 @@ class Module(BaseModule):
 
     @property
     def output_shapes(self):
-        return [(n, tuple(o.shape))
-                for n, o in zip(self.output_names, self._exec.outputs)]
+        if self._exec is not None and self._exec.outputs:
+            return [(n, tuple(o.shape))
+                    for n, o in zip(self.output_names, self._exec.outputs)]
+        # before the first forward no output buffers exist (XLA allocates
+        # at dispatch, unlike the reference's bind-time output arrays) —
+        # infer symbolically from the bound input shapes so chained
+        # binding (SequentialModule) can wire shapes ahead of execution
+        assert self.binded, "bind first"
+        hints = dict(self._data_shapes + (self._label_shapes or []))
+        _args, outs, _auxs = self._symbol.infer_shape(**hints)
+        return list(zip(self.output_names, [tuple(s) for s in outs]))
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,

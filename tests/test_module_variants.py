@@ -1,0 +1,283 @@
+"""SequentialModule / PythonModule / FeedForward — the rest of the Module
+generation (VERDICT r4 missing #3/#4; ref: python/mxnet/module/
+sequential_module.py, python_module.py, model.py:451 FeedForward).
+"""
+import logging
+import warnings
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import symbol as sym
+from mxtpu.io import DataBatch, DataDesc, NDArrayIter
+from mxtpu.model import FeedForward
+from mxtpu.module import (Module, PythonLossModule, PythonModule,
+                          SequentialModule)
+
+
+def _toy_dataset(n=256, dim=8, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.normal(scale=3.0, size=(classes, dim))
+    y = rng.randint(0, classes, size=(n,))
+    x = centers[y] + rng.normal(scale=0.5, size=(n, dim))
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def _feature_symbol(num_hidden=32):
+    data = sym.var("data")
+    net = sym.FullyConnected(data, sym.var("fc1_weight"), sym.var("fc1_bias"),
+                             num_hidden=num_hidden, name="fc1")
+    return sym.Activation(net, act_type="relu", name="relu1")
+
+
+def _head_symbol(classes=4):
+    # second stage consumes the first stage's output by its output name
+    data = sym.var("relu1_output")
+    net = sym.FullyConnected(data, sym.var("fc2_weight"), sym.var("fc2_bias"),
+                             num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(net, sym.var("softmax_label"), name="softmax")
+
+
+# ------------------------------------------------------- SequentialModule
+def _seq_mod():
+    seq = SequentialModule()
+    seq.add(Module(_feature_symbol(), data_names=("data",), label_names=None))
+    seq.add(Module(_head_symbol(), data_names=("relu1_output",),
+                   label_names=("softmax_label",)), take_labels=True,
+            auto_wiring=True)
+    return seq
+
+
+def test_sequential_module_trains_toy_problem():
+    x, y = _toy_dataset()
+    train = NDArrayIter(x, y, batch_size=32, shuffle=True)
+    val = NDArrayIter(x, y, batch_size=32)
+    seq = _seq_mod()
+    seq.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            num_epoch=10, initializer=mx.init.Xavier())
+    score = seq.score(val, "acc")
+    assert score[0][1] > 0.95, score
+    # merged params span both layers
+    arg, _aux = seq.get_params()
+    assert {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"} <= set(arg)
+
+
+def test_sequential_module_shapes_and_wiring():
+    seq = _seq_mod()
+    seq.bind(data_shapes=[DataDesc("data", (16, 8))],
+             label_shapes=[DataDesc("softmax_label", (16,))])
+    assert seq.data_names == ["data"]
+    assert [s for _n, s in seq.output_shapes] == [(16, 4)]
+    # label_shapes kept because the head takes labels
+    assert seq.label_shapes is not None
+    seq.init_params(initializer=mx.init.Xavier())
+    batch = DataBatch(data=[mx.nd.ones((16, 8))],
+                      label=[mx.nd.zeros((16,))])
+    seq.forward(batch, is_train=False)
+    out = seq.get_outputs()[0]
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1), np.ones(16),
+                               rtol=1e-5)
+
+
+def test_sequential_module_duplicate_param_names_rejected():
+    seq = SequentialModule()
+    seq.add(Module(_feature_symbol(), data_names=("data",), label_names=None))
+    # same parameter names again in layer 1; auto_wiring renames the
+    # incoming relu1_output shape to this module's own "data" input
+    seq.add(Module(_feature_symbol(), data_names=("data",),
+                   label_names=None), auto_wiring=True)
+    seq.bind(data_shapes=[DataDesc("data", (4, 8))])
+    with pytest.raises(AssertionError, match="Duplicated parameter name"):
+        seq.init_params(initializer=mx.init.Xavier())
+
+
+def test_sequential_module_add_resets_binding():
+    seq = _seq_mod()
+    seq.bind(data_shapes=[DataDesc("data", (4, 8))],
+             label_shapes=[DataDesc("softmax_label", (4,))])
+    assert seq.binded
+    seq.add(Module(_feature_symbol(), data_names=("x",)))
+    assert not seq.binded and not seq.params_initialized
+
+
+# ----------------------------------------------------------- PythonModule
+def test_python_loss_module_in_chain_trains():
+    """Feature Module + host-side PythonLossModule with an explicit
+    softmax-CE grad_func — the reference's canonical PythonModule use
+    (python_module.py:243 docstring)."""
+    x, y = _toy_dataset()
+    classes = 4
+
+    feat = sym.var("data")
+    net = sym.FullyConnected(feat, sym.var("fc1_weight"), sym.var("fc1_bias"),
+                             num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, sym.var("fc2_weight"), sym.var("fc2_bias"),
+                             num_hidden=classes, name="fc2")
+    body = Module(net, data_names=("data",), label_names=None)
+
+    def softmax_ce_grad(scores, labels):
+        s = scores.asnumpy()
+        s = np.exp(s - s.max(axis=1, keepdims=True))
+        p = s / s.sum(axis=1, keepdims=True)
+        onehot = np.eye(classes, dtype=np.float32)[
+            labels.asnumpy().astype(np.int64)]
+        return (p - onehot) / p.shape[0]
+
+    loss = PythonLossModule(name="ce", data_names=("fc2_output",),
+                            label_names=("softmax_label",),
+                            grad_func=softmax_ce_grad)
+    seq = SequentialModule()
+    seq.add(body).add(loss, take_labels=True, auto_wiring=True)
+    train = NDArrayIter(x, y, batch_size=32, shuffle=True)
+    seq.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    seq.init_params(initializer=mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+
+    def accuracy():
+        val = NDArrayIter(x, y, batch_size=32)
+        correct = total = 0
+        for batch in val:
+            seq.forward(batch, is_train=False)
+            pred = seq.get_outputs()[0].asnumpy().argmax(axis=1)
+            lab = batch.label[0].asnumpy()
+            n = lab.shape[0] - batch.pad
+            correct += (pred[:n] == lab[:n]).sum()
+            total += n
+        return correct / total
+
+    before = accuracy()
+    for _epoch in range(8):
+        train.reset()
+        for batch in train:
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+    after = accuracy()
+    assert after > max(before, 0.9), (before, after)
+
+
+def test_python_module_bind_contract():
+    class Shapeless(PythonModule):
+        def _compute_output_shapes(self):
+            return [(self._output_names[0], self._data_shapes[0][1])]
+
+    m = Shapeless(["data"], ["softmax_label"], ["out"])
+    m.bind(data_shapes=[("data", (4, 3))],
+           label_shapes=[("softmax_label", (4,))])
+    assert m.output_shapes == [("out", (4, 3))]
+    assert m.get_params() == ({}, {})
+    with pytest.raises(AssertionError):
+        m2 = Shapeless(["data"], None, ["out"])
+        m2.bind(data_shapes=[("data", (4, 3))], grad_req="add")
+
+
+# ------------------------------------------------------------ FeedForward
+def _full_mlp():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, sym.var("fc1_weight"), sym.var("fc1_bias"),
+                             num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, sym.var("fc2_weight"), sym.var("fc2_bias"),
+                             num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, sym.var("softmax_label"), name="softmax")
+
+
+def test_feedforward_fit_predict_score(tmp_path):
+    x, y = _toy_dataset()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        model = FeedForward(_full_mlp(), num_epoch=10, optimizer="sgd",
+                            learning_rate=0.1, momentum=0.9,
+                            numpy_batch_size=32,
+                            initializer=mx.init.Xavier())
+    model.fit(x, y, logger=logging.getLogger("ff"))
+    # score takes a labeled iterator (bare numpy X carries no labels —
+    # reference model.py:742 same contract)
+    acc = model.score(NDArrayIter(x, y, batch_size=32))
+    assert acc > 0.95, acc
+    preds = model.predict(x)
+    assert preds.shape == (x.shape[0], 4)
+    assert (preds.argmax(axis=1) == y).mean() > 0.95
+    # return_data round-trips the inputs
+    p2, d2, l2 = model.predict(x, return_data=True)
+    np.testing.assert_allclose(p2, preds, rtol=1e-5)
+    assert d2.shape == x.shape
+
+    # checkpoint round trip through the reference file format
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, epoch=3)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        loaded = FeedForward.load(prefix, 3)
+    assert loaded.begin_epoch == 3
+    preds2 = loaded.predict(x)
+    np.testing.assert_allclose(preds2, preds, rtol=1e-4, atol=1e-5)
+
+
+def test_feedforward_create_and_iter_input():
+    x, y = _toy_dataset(n=128)
+    train = NDArrayIter(x, y, batch_size=32, shuffle=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        model = FeedForward.create(_full_mlp(), train, num_epoch=6,
+                                   optimizer="sgd", learning_rate=0.1,
+                                   momentum=0.9,
+                                   initializer=mx.init.Xavier())
+    assert model.arg_params and "fc1_weight" in model.arg_params
+    assert model.score(NDArrayIter(x, y, batch_size=32)) > 0.9
+
+
+def test_sequential_auto_wiring_accepts_datadesc_layer0():
+    # provide_data yields 4-field DataDesc namedtuples; auto_wiring on the
+    # FIRST module must unpack them (regression: 2-tuple unpack crashed)
+    x, y = _toy_dataset(n=64)
+    it = NDArrayIter(x, y, batch_size=16)
+    seq = SequentialModule()
+    seq.add(Module(_feature_symbol(), data_names=("data",),
+                   label_names=None), auto_wiring=True)
+    seq.bind(data_shapes=it.provide_data)
+    assert seq.output_shapes[0][1] == (16, 32)
+
+
+def test_fit_invokes_eval_end_callback():
+    x, y = _toy_dataset(n=64)
+    train = NDArrayIter(x, y, batch_size=16)
+    val = NDArrayIter(x, y, batch_size=16)
+    mod = Module(_full_mlp())
+    seen = []
+    mod.fit(train, eval_data=val, num_epoch=2,
+            initializer=mx.init.Xavier(),
+            eval_end_callback=lambda p: seen.append((p.epoch,
+                                                     p.eval_metric.get())))
+    assert [e for e, _ in seen] == [0, 1]
+
+
+def test_feedforward_predictor_is_cached():
+    x, y = _toy_dataset(n=64)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        model = FeedForward(_full_mlp(), num_epoch=2, optimizer="sgd",
+                            learning_rate=0.1, numpy_batch_size=16,
+                            initializer=mx.init.Xavier())
+    model.fit(x, y)
+    model.predict(x)
+    first = model._pred_module
+    model.predict(x)
+    assert model._pred_module is first          # same shapes: reused
+    model.predict(x[:10])
+    assert model._pred_module is not first      # new batch size: rebound
+
+
+def test_feedforward_deprecation_and_errors():
+    with pytest.warns(DeprecationWarning):
+        model = FeedForward(_full_mlp())
+    x, _y = _toy_dataset(n=32)
+    with pytest.raises(mx.MXNetError):
+        model.fit(x, None)          # y required for numpy X
+    with pytest.raises(mx.MXNetError):
+        model.predict(x)            # no params yet
