@@ -17,6 +17,7 @@
 #ifndef MXTPU_C_API_H_
 #define MXTPU_C_API_H_
 
+#include <stddef.h>
 #include <stdint.h>
 
 #ifdef __cplusplus
@@ -175,6 +176,119 @@ int MXTPUPredGetOutput(PredictorHandle handle, int index, float *dst,
                        int64_t size);
 
 int MXTPUPredFree(PredictorHandle handle);
+
+/* ---- DataIter (ref: MXListDataIters / MXDataIterCreateIter /
+ * MXDataIterNext / MXDataIterGetData / MXDataIterGetLabel /
+ * MXDataIterGetPadNum). Attr values are strings, parsed like op attrs
+ * (python literals: "(3,224,224)", "32", "data.rec"). ---- */
+
+typedef void *DataIterHandle;
+
+/* Registered iterator names; pointers valid until the next call on this
+ * thread. */
+int MXTPUListDataIters(int *out_num, const char ***out_names);
+
+int MXTPUDataIterCreate(const char *name, int num_attrs,
+                        const char **attr_keys, const char **attr_vals,
+                        DataIterHandle *out);
+
+/* Rewind to the epoch start (ref MXDataIterBeforeFirst). */
+int MXTPUDataIterBeforeFirst(DataIterHandle handle);
+
+/* Advance; *out = 1 if a batch is available, 0 at epoch end. */
+int MXTPUDataIterNext(DataIterHandle handle, int *out);
+
+/* Current batch's data / label as fresh NDArray handles (free them). */
+int MXTPUDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+int MXTPUDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+
+/* Trailing filler rows in the current batch (ref MXDataIterGetPadNum). */
+int MXTPUDataIterGetPadNum(DataIterHandle handle, int *out);
+
+int MXTPUDataIterFree(DataIterHandle handle);
+
+/* ---- RecordIO (ref: MXRecordIOWriter* / MXRecordIOReader*; wire format
+ * identical to the reference: magic 0xced7230a + LRecord header). ---- */
+
+typedef void *RecordIOHandle;
+
+int MXTPURecordIOWriterCreate(const char *path, RecordIOHandle *out);
+int MXTPURecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                   size_t size);
+int MXTPURecordIOWriterTell(RecordIOHandle handle, size_t *out);
+int MXTPURecordIOWriterFree(RecordIOHandle handle);
+
+int MXTPURecordIOReaderCreate(const char *path, RecordIOHandle *out);
+/* Next record; *out_buf == NULL at EOF (a zero-length RECORD returns a
+ * non-NULL pointer with *out_size == 0). Pointer valid until the next
+ * read on this thread. */
+int MXTPURecordIOReaderReadRecord(RecordIOHandle handle, const char **out_buf,
+                                  size_t *out_size);
+int MXTPURecordIOReaderSeek(RecordIOHandle handle, size_t pos);
+int MXTPURecordIOReaderTell(RecordIOHandle handle, size_t *out);
+int MXTPURecordIOReaderFree(RecordIOHandle handle);
+
+/* ---- Symbol attributes + breadth (ref: MXSymbolSetAttr / GetAttr /
+ * ListAttr / ListOutputs / ListAuxiliaryStates / MXSymbolInferShape /
+ * MXSymbolSaveToFile / MXSymbolCopy). String/list results are valid until
+ * the next such call on this thread. ---- */
+
+int MXTPUSymbolSetAttr(SymbolHandle handle, const char *key,
+                       const char *value);
+int MXTPUSymbolGetAttr(SymbolHandle handle, const char *key,
+                       const char **out);
+/* Flattened (key, value, key, value, ...); *out_num counts entries. */
+int MXTPUSymbolListAttr(SymbolHandle handle, int *out_num,
+                        const char ***out_kv);
+int MXTPUSymbolListOutputs(SymbolHandle handle, int *out_num,
+                           const char ***out_names);
+int MXTPUSymbolListAuxiliaryStates(SymbolHandle handle, int *out_num,
+                                   const char ***out_names);
+int MXTPUSymbolSaveToFile(SymbolHandle handle, const char *path);
+int MXTPUSymbolCopy(SymbolHandle handle, SymbolHandle *out);
+
+/* Output shapes from known input shapes. arg_shape_data packs each arg's
+ * dims back-to-back (arg_shape_ndim[i] dims each). *out_flat packs each
+ * output as (ndim, dims...); valid until the next call on this thread. */
+int MXTPUSymbolInferOutputShape(SymbolHandle handle, int num_args,
+                                const char **arg_names,
+                                const int64_t *arg_shape_data,
+                                const int *arg_shape_ndim, int *out_num,
+                                const int64_t **out_flat);
+
+/* ---- Executor monitor (ref: MXExecutorSetMonitorCallback). The callback
+ * fires for EVERY node output on monitored forwards; the NDArrayHandle is
+ * borrowed — valid only for the duration of the callback. ---- */
+
+typedef void (*ExecutorMonitorCallback)(const char *name,
+                                        NDArrayHandle array, void *ctx);
+
+int MXTPUExecutorSetMonitorCallback(ExecutorHandle handle,
+                                    ExecutorMonitorCallback callback,
+                                    void *callback_ctx);
+
+/* ---- KVStore breadth (ref: MXKVStoreGetRank / GetGroupSize / Barrier /
+ * PushPull). ---- */
+
+int MXTPUKVStoreGetRank(KVStoreHandle handle, int *out);
+int MXTPUKVStoreGetGroupSize(KVStoreHandle handle, int *out);
+int MXTPUKVStoreBarrier(KVStoreHandle handle);
+int MXTPUKVStorePushPull(KVStoreHandle handle, int num, const char **keys,
+                         NDArrayHandle *vals, NDArrayHandle *outs,
+                         int priority);
+
+/* ---- misc (ref: MXRandomSeed, MXNDArraySlice / Reshape /
+ * SyncCopyFromCPU / GetContext). ---- */
+
+int MXTPURandomSeed(int seed);
+int MXTPUNDArraySlice(NDArrayHandle handle, int64_t begin, int64_t end,
+                      NDArrayHandle *out);
+int MXTPUNDArrayReshape(NDArrayHandle handle, const int64_t *shape, int ndim,
+                        NDArrayHandle *out);
+/* Overwrite the array's contents from packed host bytes of its dtype. */
+int MXTPUNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                                size_t size);
+int MXTPUNDArrayGetContext(NDArrayHandle handle, const char **out);
 
 #ifdef __cplusplus
 }

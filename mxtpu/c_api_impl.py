@@ -21,6 +21,7 @@ if _PLATFORM_PIN:
     jax.config.update("jax_platforms", _PLATFORM_PIN)
 
 import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
 
 from . import ndarray as nd  # noqa: E402
 from . import ops  # noqa: E402
@@ -317,3 +318,226 @@ def ndarray_wait_all() -> None:
     # reference's MXNDArrayWaitAll semantics
     import jax
     jax.effects_barrier()
+
+
+# ---- DataIter surface (ref: MXListDataIters/MXDataIterCreateIter/
+# MXDataIterNext/MXDataIterGetData..., src/c_api/c_api.cc MXDataIter*) ----
+
+_DATA_ITERS = None
+
+
+def _data_iter_registry():
+    global _DATA_ITERS
+    if _DATA_ITERS is None:
+        from . import io as io_mod
+        from .image import ImageIter
+        _DATA_ITERS = {
+            "NDArrayIter": io_mod.NDArrayIter,
+            "CSVIter": io_mod.CSVIter,
+            "LibSVMIter": io_mod.LibSVMIter,
+            "ImageRecordIter": ImageIter,  # the reference's registered name
+            "ImageIter": ImageIter,
+        }
+    return _DATA_ITERS
+
+
+def list_data_iters() -> tuple:
+    return tuple(sorted(_data_iter_registry()))
+
+
+class _CIter:
+    """Iterator handle: owns the iter + the current batch (the reference's
+    MXDataIterNext caches the batch the Get* calls then read)."""
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+
+def data_iter_create(name: str, attrs: dict):
+    cls = _data_iter_registry().get(name)
+    if cls is None:
+        raise MXNetError("unknown data iter %r (have: %s)"
+                         % (name, ", ".join(sorted(_data_iter_registry()))))
+    kwargs = {k: _parse_attr(v) for k, v in attrs.items()}
+    return _CIter(cls(**kwargs))
+
+
+def data_iter_before_first(handle: "_CIter") -> None:
+    handle.it.reset()
+    handle.batch = None
+
+
+def data_iter_next(handle: "_CIter") -> int:
+    try:
+        handle.batch = handle.it.next()
+        return 1
+    except StopIteration:
+        handle.batch = None
+        return 0
+
+
+def _require_batch(handle):
+    if handle.batch is None:
+        raise MXNetError("no current batch: call MXTPUDataIterNext first")
+    return handle.batch
+
+
+def data_iter_get_data(handle: "_CIter") -> NDArray:
+    return _require_batch(handle).data[0]
+
+
+def data_iter_get_label(handle: "_CIter") -> NDArray:
+    return _require_batch(handle).label[0]
+
+
+def data_iter_get_pad_num(handle: "_CIter") -> int:
+    return int(_require_batch(handle).pad or 0)
+
+
+def data_iter_get_index(handle: "_CIter") -> tuple:
+    idx = _require_batch(handle).index
+    return tuple(int(i) for i in idx) if idx is not None else ()
+
+
+# ---- RecordIO surface (ref: MXRecordIOWriterCreate/WriteRecord/Tell,
+# MXRecordIOReaderCreate/ReadRecord/Seek, c_api.cc) ----
+
+def recordio_writer_create(path: str):
+    from .recordio import MXRecordIO
+    return MXRecordIO(path, "w")
+
+
+def recordio_writer_write(w, data: bytes) -> None:
+    w.write(data)
+
+
+def recordio_writer_tell(w) -> int:
+    return int(w.tell())
+
+
+def recordio_reader_create(path: str):
+    from .recordio import MXRecordIO
+    return MXRecordIO(path, "r")
+
+
+def recordio_reader_read(r):
+    """(has_record, payload): a zero-length RECORD is (1, b"") — distinct
+    from EOF (0, b""), which bare bytes could not express."""
+    out = r.read()
+    if out is None:
+        return (0, b"")
+    return (1, bytes(out))
+
+
+def recordio_reader_seek(r, pos: int) -> None:
+    r.seek(pos)
+
+
+def recordio_reader_tell(r) -> int:
+    return int(r.tell())
+
+
+def recordio_close(h) -> None:
+    h.close()
+
+
+# ---- Symbol attributes / breadth (ref: MXSymbolSetAttr/GetAttr/ListAttr,
+# MXSymbolListAuxiliaryStates, MXSymbolInferShape, MXSymbolSaveToFile) ----
+
+def symbol_set_attr(sym, key: str, value: str) -> None:
+    if len(sym._heads) != 1:
+        raise MXNetError("set_attr needs a single-output symbol")
+    sym._heads[0][0].attrs[key] = value
+
+
+def symbol_get_attr(sym, key: str) -> str:
+    v = sym.attr(key)
+    if v is None:
+        raise MXNetError("symbol has no attribute %r" % key)
+    return str(v)
+
+
+def symbol_list_attr(sym) -> tuple:
+    """Flattened (key, value, key, value, ...) like MXSymbolListAttr."""
+    flat = []
+    for k, v in sorted(sym.list_attr().items()):
+        flat += [str(k), str(v)]
+    return tuple(flat)
+
+
+def symbol_list_auxiliary_states(sym) -> tuple:
+    return tuple(sym.list_auxiliary_states())
+
+
+def symbol_save_to_file(sym, path: str) -> None:
+    sym.save(path)
+
+
+def symbol_copy(sym):
+    import copy
+    return copy.deepcopy(sym)
+
+
+def symbol_infer_shape(sym, names: tuple, shapes: tuple) -> tuple:
+    """Returns (arg_shapes, out_shapes, aux_shapes) each as a flat tuple of
+    ('name-free' nested) tuples; unknown shapes come back as ()."""
+    hints = {n: tuple(s) for n, s in zip(names, shapes)}
+    args, outs, auxs = sym.infer_shape(**hints)
+    def _clean(lst):
+        return tuple(tuple(s) if s is not None else () for s in (lst or []))
+    return _clean(args), _clean(outs), _clean(auxs)
+
+
+# ---- Executor monitor callback (ref: MXExecutorSetMonitorCallback,
+# src/executor/graph_executor.cc:104 monitor path; powers mx.monitor) ----
+
+def executor_set_monitor_callback(ex, pyfun) -> None:
+    """pyfun(name: str, ndarray) is invoked for every output each forward
+    — the C layer wraps the user's C function pointer in ``pyfun``."""
+    ex.set_monitor_callback(pyfun)
+
+
+# ---- KVStore breadth (ref: MXKVStoreGetRank/GetGroupSize/Barrier) ----
+
+def kvstore_get_rank(kv) -> int:
+    return int(kv.rank)
+
+
+def kvstore_get_group_size(kv) -> int:
+    return int(kv.num_workers)
+
+
+def kvstore_barrier(kv) -> None:
+    kv.barrier()
+
+
+def kvstore_pushpull(kv, keys: tuple, vals: tuple, outs: tuple,
+                     priority: int) -> None:
+    kv.push(list(keys), list(vals), priority=priority)
+    kv.pull(list(keys), list(outs), priority=priority)
+
+
+# ---- misc breadth ----
+
+def random_seed(seed: int) -> None:
+    from . import random as rnd
+    rnd.seed(int(seed))
+
+
+def ndarray_slice(handle: NDArray, begin: int, end: int) -> NDArray:
+    return handle[int(begin):int(end)]
+
+
+def ndarray_reshape(handle: NDArray, shape: tuple) -> NDArray:
+    return handle.reshape(tuple(int(s) for s in shape))
+
+
+def ndarray_sync_copy_from_cpu(handle: NDArray, data: bytes) -> None:
+    a = np.frombuffer(data, dtype=np.dtype(str(handle.dtype)))
+    handle._set_data(jnp.asarray(a.reshape(handle.shape),
+                                 dtype=handle._data.dtype))
+
+
+def ndarray_context(handle: NDArray) -> str:
+    return str(handle.context)

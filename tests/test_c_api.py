@@ -450,3 +450,249 @@ def test_version_opnames_waitall(lib):
     assert {"FullyConnected", "Convolution", "dot"} <= got
     assert n.value > 200
     assert lib.MXTPUNDArrayWaitAll() == 0
+
+
+def test_cpp_recordio_training_via_abi(lib, tmp_path):
+    """C++ writes a RecordIO dataset, reads it back, and trains through
+    the ABI (VERDICT r4 item 7: the frontend-completeness example)."""
+    src = os.path.join(REPO, "examples", "cpp", "train_recordio.cpp")
+    exe = tmp_path / "train_recordio"
+    _compile_against_abi(src, exe, "g++", extra=("-std=c++14",))
+    out = _run_smoke(exe, prefix=str(tmp_path / "data.rec"))
+    assert any("TRAIN_RECORDIO_OK" in line for line in out), out
+
+
+def test_data_iter_abi(lib):
+    """MXTPUDataIter*: create an NDArrayIter over host arrays? The C
+    surface creates by name with string attrs, so drive CSVIter instead
+    (file-based, C-friendly)."""
+    import tempfile
+    csv = tempfile.NamedTemporaryFile("w", suffix=".csv", delete=False)
+    for i in range(8):
+        csv.write("%d,%d,%d\n" % (i, i + 1, i + 2))
+    csv.close()
+    n = ctypes.c_int()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXTPUListDataIters(ctypes.byref(n), ctypes.byref(names)) == 0
+    have = {names[i].decode() for i in range(n.value)}
+    assert {"CSVIter", "NDArrayIter", "ImageRecordIter"} <= have
+
+    keys = (ctypes.c_char_p * 3)(b"data_csv", b"data_shape", b"batch_size")
+    vals = (ctypes.c_char_p * 3)(csv.name.encode(), b"(3,)", b"4")
+    h = ctypes.c_void_p()
+    rc = lib.MXTPUDataIterCreate(b"CSVIter", 3, keys, vals, ctypes.byref(h))
+    assert rc == 0, lib.MXTPUGetLastError()
+    batches = []
+    more = ctypes.c_int()
+    while True:
+        assert lib.MXTPUDataIterNext(h, ctypes.byref(more)) == 0
+        if not more.value:
+            break
+        d = ctypes.c_void_p()
+        assert lib.MXTPUDataIterGetData(h, ctypes.byref(d)) == 0
+        batches.append(_nd_to_numpy(lib, d))
+        lib.MXTPUNDArrayFree(d)
+        pad = ctypes.c_int()
+        assert lib.MXTPUDataIterGetPadNum(h, ctypes.byref(pad)) == 0
+        assert pad.value == 0
+    assert len(batches) == 2
+    np.testing.assert_allclose(batches[0][0], [0.0, 1.0, 2.0])
+    # reset replays the epoch
+    assert lib.MXTPUDataIterBeforeFirst(h) == 0
+    assert lib.MXTPUDataIterNext(h, ctypes.byref(more)) == 0
+    assert more.value == 1
+    lib.MXTPUDataIterFree(h)
+    os.unlink(csv.name)
+
+
+def test_recordio_abi_roundtrip(lib, tmp_path):
+    path = str(tmp_path / "abi.rec").encode()
+    w = ctypes.c_void_p()
+    assert lib.MXTPURecordIOWriterCreate(path, ctypes.byref(w)) == 0
+    payloads = [b"hello", b"", b"x" * 100, b"\x00\x01\x02"]
+    for p in payloads:
+        assert lib.MXTPURecordIOWriterWriteRecord(w, p, len(p)) == 0
+    pos = ctypes.c_size_t()
+    assert lib.MXTPURecordIOWriterTell(w, ctypes.byref(pos)) == 0
+    assert pos.value > 0
+    assert lib.MXTPURecordIOWriterFree(w) == 0
+
+    r = ctypes.c_void_p()
+    assert lib.MXTPURecordIOReaderCreate(path, ctypes.byref(r)) == 0
+    got = []
+    buf = ctypes.c_void_p()
+    size = ctypes.c_size_t()
+    while True:
+        assert lib.MXTPURecordIOReaderReadRecord(
+            r, ctypes.byref(buf), ctypes.byref(size)) == 0
+        if not buf.value:
+            break  # NULL buf = EOF; an empty RECORD has non-NULL buf
+        got.append(ctypes.string_at(buf, size.value) if size.value else b"")
+    assert got == payloads
+    assert lib.MXTPURecordIOReaderFree(r) == 0
+    # python reader agrees (wire-format interop)
+    from mxtpu import recordio
+    rr = recordio.MXRecordIO(path.decode(), "r")
+    assert rr.read() == payloads[0]
+    rr.close()
+
+
+def test_symbol_attr_abi(lib):
+    h = ctypes.c_void_p()
+    assert lib.MXTPUSymbolCreateVariable(b"x", ctypes.byref(h)) == 0
+    assert lib.MXTPUSymbolSetAttr(h, b"__lr_mult__", b"2.0") == 0
+    out = ctypes.c_char_p()
+    assert lib.MXTPUSymbolGetAttr(h, b"__lr_mult__", ctypes.byref(out)) == 0
+    assert out.value == b"2.0"
+    n = ctypes.c_int()
+    kv = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXTPUSymbolListAttr(h, ctypes.byref(n), ctypes.byref(kv)) == 0
+    flat = [kv[i].decode() for i in range(n.value)]
+    assert "__lr_mult__" in flat and "2.0" in flat
+    # missing attr is an error, not a crash
+    assert lib.MXTPUSymbolGetAttr(h, b"nope", ctypes.byref(out)) == -1
+    lib.MXTPUSymbolFree(h)
+
+
+def test_symbol_infer_shape_abi(lib):
+    data = ctypes.c_void_p()
+    w = ctypes.c_void_p()
+    assert lib.MXTPUSymbolCreateVariable(b"data", ctypes.byref(data)) == 0
+    assert lib.MXTPUSymbolCreateVariable(b"w", ctypes.byref(w)) == 0
+    keys = (ctypes.c_char_p * 2)(b"num_hidden", b"no_bias")
+    vals = (ctypes.c_char_p * 2)(b"7", b"True")
+    inputs = (ctypes.c_void_p * 2)(data, w)
+    fc = ctypes.c_void_p()
+    assert lib.MXTPUSymbolCompose(b"FullyConnected", b"fc", inputs, 2,
+                                  keys, vals, 2, ctypes.byref(fc)) == 0
+    names = (ctypes.c_char_p * 1)(b"data")
+    shape_data = (ctypes.c_int64 * 2)(5, 3)
+    ndims = (ctypes.c_int * 1)(2)
+    out_n = ctypes.c_int()
+    flat = ctypes.POINTER(ctypes.c_int64)()
+    assert lib.MXTPUSymbolInferOutputShape(
+        fc, 1, names, shape_data, ndims, ctypes.byref(out_n),
+        ctypes.byref(flat)) == 0
+    assert out_n.value == 1
+    assert flat[0] == 2 and flat[1] == 5 and flat[2] == 7
+    # list outputs / aux via the new surfaces
+    ln = ctypes.c_int()
+    lnames = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXTPUSymbolListOutputs(fc, ctypes.byref(ln),
+                                      ctypes.byref(lnames)) == 0
+    assert ln.value == 1 and lnames[0] == b"fc_output"
+    for hh in (data, w, fc):
+        lib.MXTPUSymbolFree(hh)
+
+
+def test_executor_monitor_callback_abi(lib, tmp_path):
+    """MXTPUExecutorSetMonitorCallback fires per node output with a
+    borrowed NDArray handle the C side can inspect."""
+    import mxtpu as mx
+    from mxtpu import symbol as sym
+
+    data = ctypes.c_void_p()
+    w = ctypes.c_void_p()
+    assert lib.MXTPUSymbolCreateVariable(b"data", ctypes.byref(data)) == 0
+    assert lib.MXTPUSymbolCreateVariable(b"w", ctypes.byref(w)) == 0
+    inputs = (ctypes.c_void_p * 2)(data, w)
+    keys = (ctypes.c_char_p * 2)(b"num_hidden", b"no_bias")
+    vals = (ctypes.c_char_p * 2)(b"4", b"True")
+    fc = ctypes.c_void_p()
+    assert lib.MXTPUSymbolCompose(b"FullyConnected", b"fc", inputs, 2,
+                                  keys, vals, 2, ctypes.byref(fc)) == 0
+    relu = ctypes.c_void_p()
+    rin = (ctypes.c_void_p * 1)(fc)
+    rkeys = (ctypes.c_char_p * 1)(b"act_type")
+    rvals = (ctypes.c_char_p * 1)(b"relu")
+    assert lib.MXTPUSymbolCompose(b"Activation", b"relu1", rin, 1,
+                                  rkeys, rvals, 1, ctypes.byref(relu)) == 0
+
+    a_data = _nd_from_blob(lib, np.ones((2, 3), np.float32))
+    a_w = _nd_from_blob(lib, np.full((4, 3), 0.5, np.float32))
+    arg_names = (ctypes.c_char_p * 2)(b"data", b"w")
+    arg_vals = (ctypes.c_void_p * 2)(a_data, a_w)
+    ex = ctypes.c_void_p()
+    assert lib.MXTPUExecutorBind(relu, 2, arg_names, arg_vals, b"write",
+                                 ctypes.byref(ex)) == 0, \
+        lib.MXTPUGetLastError()
+
+    seen = []
+    CB = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                          ctypes.c_void_p)
+
+    @CB
+    def monitor(name, nd_handle, _ctx):
+        shape = (ctypes.c_int64 * 8)()
+        ndim = ctypes.c_int()
+        lib.MXTPUNDArrayShape(nd_handle, ctypes.byref(ndim), shape)
+        seen.append((name.decode(), tuple(shape[:ndim.value])))
+
+    assert lib.MXTPUExecutorSetMonitorCallback(ex, monitor, None) == 0
+    assert lib.MXTPUExecutorForward(ex, 0) == 0, lib.MXTPUGetLastError()
+    names_seen = [n for n, _s in seen]
+    assert "fc_output" in names_seen and "relu1_output" in names_seen
+    assert dict(seen)["fc_output"] == (2, 4)
+    for hh in (data, w, fc, relu):
+        lib.MXTPUSymbolFree(hh)
+    lib.MXTPUExecutorFree(ex)
+    lib.MXTPUNDArrayFree(a_data)
+    lib.MXTPUNDArrayFree(a_w)
+
+
+def test_misc_breadth_abi(lib):
+    assert lib.MXTPURandomSeed(42) == 0
+    a = _nd_from_blob(lib, np.arange(12, dtype=np.float32).reshape(4, 3))
+    s = ctypes.c_void_p()
+    assert lib.MXTPUNDArraySlice(a, 1, 3, ctypes.byref(s)) == 0
+    np.testing.assert_allclose(_nd_to_numpy(lib, s),
+                               np.arange(12, dtype=np.float32)
+                               .reshape(4, 3)[1:3])
+    r = ctypes.c_void_p()
+    shape = (ctypes.c_int64 * 2)(3, 4)
+    assert lib.MXTPUNDArrayReshape(a, shape, 2, ctypes.byref(r)) == 0
+    assert _nd_to_numpy(lib, r).shape == (3, 4)
+    # sync copy from cpu overwrites in place
+    new = np.full(12, 7.0, np.float32)
+    assert lib.MXTPUNDArraySyncCopyFromCPU(
+        a, new.ctypes.data_as(ctypes.c_void_p), new.nbytes) == 0
+    np.testing.assert_allclose(_nd_to_numpy(lib, a), 7.0)
+    ctx = ctypes.c_char_p()
+    assert lib.MXTPUNDArrayGetContext(a, ctypes.byref(ctx)) == 0
+    assert ctx.value
+    for hh in (a, s, r):
+        lib.MXTPUNDArrayFree(hh)
+
+
+def test_kvstore_breadth_abi(lib):
+    kv = ctypes.c_void_p()
+    assert lib.MXTPUKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    rank = ctypes.c_int()
+    size = ctypes.c_int()
+    assert lib.MXTPUKVStoreGetRank(kv, ctypes.byref(rank)) == 0
+    assert lib.MXTPUKVStoreGetGroupSize(kv, ctypes.byref(size)) == 0
+    assert rank.value == 0 and size.value == 1
+    assert lib.MXTPUKVStoreBarrier(kv) == 0
+    # pushpull round trip
+    a = _nd_from_blob(lib, np.ones(3, np.float32))
+    out = _nd_from_blob(lib, np.zeros(3, np.float32))
+    keys = (ctypes.c_char_p * 1)(b"k")
+    vals = (ctypes.c_void_p * 1)(a)
+    outs = (ctypes.c_void_p * 1)(out)
+    assert lib.MXTPUKVStoreInit(kv, 1, keys, vals) == 0
+    two = _nd_from_blob(lib, np.full(3, 2.0, np.float32))
+    vals2 = (ctypes.c_void_p * 1)(two)
+    assert lib.MXTPUKVStorePushPull(kv, 1, keys, vals2, outs, 0) == 0
+    np.testing.assert_allclose(_nd_to_numpy(lib, out), 2.0)
+    for hh in (a, out, two):
+        lib.MXTPUNDArrayFree(hh)
+    lib.MXTPUKVStoreFree(kv)
+
+
+def test_abi_function_count_target():
+    """VERDICT r4 item 7: ABI >= 70 functions."""
+    import re
+    hdr = open(os.path.join(REPO, "include", "mxtpu", "c_api.h")).read()
+    fns = set(re.findall(r"int (MXTPU\w+)\(", hdr))
+    fns |= set(re.findall(r"const char \*(MXTPU\w+)\(", hdr))
+    assert len(fns) >= 70, len(fns)

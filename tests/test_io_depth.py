@@ -144,3 +144,59 @@ def test_iter_reset_mid_epoch():
     it.reset()
     bs = _collect(it)
     assert len(bs) == 4  # full epoch after reset
+
+
+def test_roll_over_mid_epoch_reset_drops_planned_tail():
+    """ADVICE r4: resetting before the epoch is consumed must not roll the
+    previously PLANNED tail into the next epoch."""
+    import numpy as np
+    from mxtpu.io import NDArrayIter
+    x = np.arange(10, dtype=np.float32)
+    it = NDArrayIter(x, np.zeros(10, np.float32), batch_size=4,
+                     last_batch_handle="roll_over")
+    # epoch 1 fully consumed: 2 full batches, tail {8, 9} carries
+    n = sum(1 for _ in it)
+    assert n == 2
+    it.reset()
+    assert it.num_batches == 3  # 2 carried + 10 = 12 -> 3 full batches
+    # abandon epoch 2 after ONE batch, reset: planned tail must be dropped
+    next(iter(it))
+    it.reset()
+    assert it.num_batches == 2  # fresh 10 samples -> 2 full batches only
+
+
+def test_roll_over_getpad_always_zero_documented():
+    import numpy as np
+    from mxtpu.io import NDArrayIter
+    x = np.arange(10, dtype=np.float32)
+    it = NDArrayIter(x, np.zeros(10, np.float32), batch_size=4,
+                     last_batch_handle="roll_over")
+    for _ in range(2):
+        for batch in it:
+            assert batch.pad == 0  # every roll_over batch is real samples
+        it.reset()
+
+
+def test_scalar_float_index_truncates():
+    import numpy as np
+    import mxtpu as mx
+    a = mx.nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+    np.testing.assert_array_equal(a[1.0].asnumpy(), a[1].asnumpy())
+    np.testing.assert_array_equal(a[2.7].asnumpy(), a[2].asnumpy())
+    b = mx.nd.array(np.arange(4, dtype=np.float32))
+    b[1.2] = 9.0
+    assert b.asnumpy()[1] == 9.0
+
+
+def test_roll_over_tail_carries_without_extra_failing_next():
+    """Consumers that read exactly num_batches batches (no StopIteration
+    probe) still count as a fully consumed epoch — the tail must carry."""
+    import numpy as np
+    from mxtpu.io import NDArrayIter
+    x = np.arange(10, dtype=np.float32)
+    it = NDArrayIter(x, np.zeros(10, np.float32), batch_size=4,
+                     last_batch_handle="roll_over")
+    for _ in range(it.num_batches):
+        it.next()
+    it.reset()
+    assert it.num_batches == 3  # tail {8,9} carried + 10 fresh = 3 batches
