@@ -5,3 +5,4 @@ from . import tensorboard  # noqa: F401
 from . import text  # noqa: F401
 from . import onnx  # noqa: F401
 from . import async_checkpoint  # noqa: F401
+from . import external_kernel  # noqa: F401

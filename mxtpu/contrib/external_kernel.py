@@ -1,0 +1,110 @@
+"""External-kernel hook: run externally-built kernels as first-class ops.
+
+Reference analog: the TVM bridge (src/nnvm/tvm_bridge.cc:54-178), which
+wraps TVM-compiled PackedFuncs as engine-scheduled async ops — external
+compute participating in MXNet's dependency graph with correct read/write
+vars and stream handoff.
+
+TPU-native re-design: the "engine" is XLA's program, so an external kernel
+joins the graph by being jax-traceable. Two classes cover the TVM bridge's
+use cases:
+
+* **device kernels** — anything jax-traceable (a Pallas ``pallas_call``,
+  an ``lax`` composition, a ``jax.ffi`` custom call): registering it makes
+  it a registry op, so it works through ``mx.nd.*``, NDArray autograd,
+  ``mx.sym`` composition, and ``hybridize`` (it inlines into the jitted
+  program the way TVM funcs joined the engine's graph).
+* **host kernels** — a numpy/cffi/ctypes function runs inside the compiled
+  program via ``jax.pure_callback`` (the async-dispatch handoff the bridge
+  did with stream synchronization); gradients come from an optional user
+  ``vjp``.
+
+Unlike the reference's bridge (forward-only PackedFuncs), a registered
+kernel may declare a gradient, making it usable in training graphs.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ops.registry import REGISTRY, register
+
+__all__ = ["register_external_kernel", "register_host_kernel"]
+
+
+def _attach_vjp(fn, vjp):
+    """Bind attrs BEFORE the custom_vjp boundary: jax.custom_vjp rejects
+    keyword arguments that cannot resolve to positions, so the
+    differentiable inner function must close over them."""
+
+    def kernel(*arrays, **attrs):
+        @jax.custom_vjp
+        def inner(*arrs):
+            return fn(*arrs, **attrs)
+
+        def fwd(*arrs):
+            return fn(*arrs, **attrs), arrs
+
+        def bwd(res, g):
+            grads = vjp(g, *res, **attrs)
+            if not isinstance(grads, (list, tuple)):
+                grads = (grads,)
+            if len(grads) != len(res):
+                raise MXNetError(
+                    "external kernel vjp returned %d gradients for %d "
+                    "inputs" % (len(grads), len(res)))
+            return tuple(grads)
+
+        inner.defvjp(fwd, bwd)
+        return inner(*arrays)
+
+    return kernel
+
+
+def register_external_kernel(name, fn, vjp=None, aliases=()):
+    """Register a jax-traceable kernel as a framework op.
+
+    ``fn(*arrays, **attrs)`` must be traceable (Pallas kernels, lax/jnp
+    compositions, ``jax.ffi`` custom calls). ``vjp(cotangent, *arrays,
+    **attrs) -> grads`` supplies the gradient; without it the op is a
+    non-differentiable leaf unless jax can differentiate ``fn`` itself.
+
+    Returns the NDArray-level callable (also reachable as ``mx.nd.<name>``
+    and via ``mx.sym.<name>`` composition).
+    """
+    for nm in (name,) + tuple(aliases):
+        if nm in REGISTRY:
+            raise MXNetError("op name %r is already registered" % nm)
+    kernel = fn if vjp is None else _attach_vjp(fn, vjp)
+    kernel = functools.wraps(fn)(kernel) if hasattr(fn, "__name__") else kernel
+    return register(name, aliases=aliases)(kernel)
+
+
+def register_host_kernel(name, fn, out_shape_fn=None, vjp=None, aliases=()):
+    """Register a HOST function (numpy/cffi/ctypes) as a framework op.
+
+    The function runs on the host inside the compiled program via
+    ``jax.pure_callback`` — the modern form of the bridge's async handoff
+    (XLA inserts the device<->host transfers and sequencing that
+    ``fset_stream`` managed manually). ``out_shape_fn(*shaped_inputs,
+    **attrs)`` returns a ShapeDtypeStruct (default: same shape/dtype as
+    the first input). ``fn`` itself must be pure (pure_callback may cache,
+    elide, or replay calls).
+    """
+
+    def device_side(*arrays, **attrs):
+        if out_shape_fn is None:
+            spec = jax.ShapeDtypeStruct(arrays[0].shape, arrays[0].dtype)
+        else:
+            spec = out_shape_fn(*[jax.ShapeDtypeStruct(a.shape, a.dtype)
+                                  for a in arrays], **attrs)
+        return jax.pure_callback(functools.partial(fn, **attrs), spec,
+                                 *arrays, vmap_method="sequential")
+
+    device_side.__name__ = name
+    device_side.__doc__ = fn.__doc__
+    return register_external_kernel(name, device_side, vjp=vjp,
+                                    aliases=aliases)

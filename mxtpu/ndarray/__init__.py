@@ -39,6 +39,31 @@ for _name, _op in _ops.REGISTRY.items():
         setattr(_internal, _name, _op.wrapper)
 _sys.modules[_internal.__name__] = _internal
 
+
+# PEP 562 __getattr__ on the synthetic sub-namespaces so ops registered
+# AFTER import (CustomOp, contrib.external_kernel) resolve there too —
+# the reference regenerates its namespaces on registration callbacks
+def _contrib_getattr(name):
+    op = _ops.REGISTRY.get("_contrib_" + name) or _ops.REGISTRY.get(name)
+    if op is not None and op.name.startswith("_contrib_"):
+        setattr(contrib, name, op.wrapper)
+        return op.wrapper
+    raise AttributeError("module %r has no attribute %r"
+                         % (contrib.__name__, name))
+
+
+def _internal_getattr(name):
+    op = _ops.REGISTRY.get(name)
+    if op is not None and name.startswith("_"):
+        setattr(_internal, name, op.wrapper)
+        return op.wrapper
+    raise AttributeError("module %r has no attribute %r"
+                         % (_internal.__name__, name))
+
+
+contrib.__getattr__ = _contrib_getattr
+_internal.__getattr__ = _internal_getattr
+
 # creation helpers registered wrap=False already return NDArrays
 from ..ops.init_ops import arange, empty, eye, full, linspace, ones, zeros  # noqa: E402,F401
 from .utils import load, save  # noqa: E402,F401
@@ -46,6 +71,17 @@ from . import random  # noqa: E402,F401
 from . import image  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from .sparse import CSRNDArray, RowSparseNDArray  # noqa: E402,F401
+
+
+def __getattr__(name):
+    """Ops registered AFTER import (CustomOp, contrib.external_kernel)
+    resolve lazily from the registry."""
+    op = _ops.REGISTRY.get(name)
+    if op is not None:
+        setattr(_mod, name, op.wrapper)
+        return op.wrapper
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))
 
 
 def concatenate(arrays, axis=0, always_copy=True):

@@ -125,6 +125,19 @@ for _name in _reg.list_ops():
         globals()[_name] = _make_sym_fn(_name)
 del _name
 
+def __getattr__(name):
+    """Ops registered AFTER import (CustomOp, contrib.external_kernel)
+    resolve lazily from the registry — the reference regenerates its
+    namespace on registration callbacks; a module __getattr__ is the
+    python-native equivalent."""
+    if name in _reg.REGISTRY:
+        fn = _make_sym_fn(name)
+        globals()[name] = fn
+        return fn
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))
+
+
 # mx.sym.contrib.* — symbolic twin of mx.nd.contrib (ref: symbol/contrib.py)
 import sys as _sys  # noqa: E402
 import types as _types  # noqa: E402
@@ -135,3 +148,17 @@ for _name in _reg.list_ops():
         setattr(contrib, _name[len("_contrib_"):], _make_sym_fn(_name))
 _sys.modules[contrib.__name__] = contrib
 del _name
+
+
+def _contrib_getattr(name):
+    # late-registered contrib ops (PEP 562 on the synthetic module)
+    full = "_contrib_" + name
+    if full in _reg.REGISTRY:
+        fn = _make_sym_fn(full)
+        setattr(contrib, name, fn)
+        return fn
+    raise AttributeError("module %r has no attribute %r"
+                         % (contrib.__name__, name))
+
+
+contrib.__getattr__ = _contrib_getattr

