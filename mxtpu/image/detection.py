@@ -168,6 +168,12 @@ class ImageDetIter(ImageIter):
         if aug_list is not None and det_kwargs:
             raise MXNetError("aug_list given; augmenter kwargs %s would be "
                              "ignored" % sorted(det_kwargs))
+        if int(kwargs.pop("preprocess_threads", 0) or 0) > 1:
+            # loud, not silent: the det iterator's box-aware batch loop is
+            # serial; accepting the knob would quietly drop the parallelism
+            raise MXNetError(
+                "ImageDetIter does not support preprocess_threads; wrap it "
+                "in mx.io.PrefetchingIter for decode-ahead instead")
         aug = aug_list if aug_list is not None else \
             CreateDetAugmenter(data_shape, **det_kwargs)
         super().__init__(batch_size, data_shape, label_width=1,

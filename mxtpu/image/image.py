@@ -264,8 +264,17 @@ class ImageIter(DataIter):
                  path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
                  aug_list=None, imglist=None, data_name="data",
                  label_name="softmax_label", last_batch_handle="pad",
-                 **kwargs):
+                 preprocess_threads=0, **kwargs):
         super().__init__(batch_size)
+        # decode+augment worker threads (ref: ImageRecordIter's
+        # preprocess_threads, src/io/iter_image_recordio_2.cc:672 — its
+        # fused multithreaded pipeline). cv2's decode releases the GIL, so
+        # threads genuinely parallelize the hot per-image work; RecordIO
+        # reads stay serialized (the underlying reader seeks one file).
+        # Combine with mx.io.PrefetchingIter for the reference's full
+        # decode-ahead double buffering.
+        self._threads = max(0, int(preprocess_threads))
+        self._pool = None
         if len(data_shape) != 3 or data_shape[0] != 3:
             raise MXNetError("data_shape must be (3, H, W)")
         self.data_shape = tuple(data_shape)
@@ -337,16 +346,21 @@ class ImageIter(DataIter):
             _pyrandom.shuffle(self._seq)
         self._cursor = 0
 
+    def _decode_blob(self, blob):
+        """RecordIO blob -> (label vector, RGB HWC image). Thread-safe
+        (no iterator state)."""
+        from ..recordio import unpack_img
+        header, img = unpack_img(blob)
+        # BGR -> RGB like the reference decode
+        return (np.asarray(header.label, np.float32).reshape(-1),
+                img[..., ::-1])
+
     def _read_record(self, key):
         """ONE read+decode of a sample -> (label vector, RGB HWC image).
         Shared with ImageDetIter; the RecordIO blob is read and unpacked
         exactly once per sample (the hot IO path)."""
         if self._record is not None:
-            from ..recordio import unpack_img
-            header, img = unpack_img(self._record.read_idx(key))
-            # BGR -> RGB like the reference decode
-            return (np.asarray(header.label, np.float32).reshape(-1),
-                    img[..., ::-1])
+            return self._decode_blob(self._record.read_idx(key))
         path, label = self._imglist[key]
         return (np.asarray(label, np.float32).reshape(-1),
                 imread(os.path.join(self._path_root, path)).asnumpy())
@@ -356,8 +370,9 @@ class ImageIter(DataIter):
         _read_record when the label is also needed)."""
         return self._read_record(key)[1]
 
-    def _read_sample(self, key):
-        label, img = self._read_record(key)
+    def _augment_sample(self, label, img):
+        """The ONE copy of the augment/layout pipeline — serial and
+        threaded paths both come through here, so they cannot diverge."""
         for aug in self.auglist:
             img = aug(img)
         img = _as_np(img)
@@ -365,6 +380,44 @@ class ImageIter(DataIter):
             img = img.transpose(2, 0, 1)  # HWC -> CHW
         label = np.asarray(label, np.float32).reshape(-1)[:self.label_width]
         return img.astype(np.float32), label
+
+    def _read_sample(self, key):
+        label, img = self._read_record(key)
+        return self._augment_sample(label, img)
+
+    def _batch_samples(self, keys):
+        """Decode+augment the batch's samples — threaded when
+        preprocess_threads > 1 (the v2 iterator's parallel decode stage)."""
+        if self._threads > 1 and len(keys) > 1:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._pool = ThreadPoolExecutor(self._threads)
+            if self._record is not None:
+                # reads stay serialized on THIS thread (the RecordIO
+                # reader seeks one file); submitting each blob as it is
+                # read overlaps blob i's decode with blob i+1's read
+                futs = [self._pool.submit(self._process_blob,
+                                          self._record.read_idx(k))
+                        for k in keys]
+                return [f.result() for f in futs]
+            return list(self._pool.map(self._read_sample, keys))
+        return [self._read_sample(k) for k in keys]
+
+    def _process_blob(self, blob):
+        """decode+augment one already-read RecordIO blob (thread-safe)."""
+        return self._augment_sample(*self._decode_blob(blob))
+
+    def close(self):
+        """Shut the decode pool down (idempotent)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def __del__(self):  # pragma: no cover - interpreter-exit timing
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def next(self):
         if self._cursor >= len(self._seq):
@@ -374,18 +427,14 @@ class ImageIter(DataIter):
         shape = (self.batch_size,) if self.label_width == 1 else \
             (self.batch_size, self.label_width)
         batch_label = np.zeros(shape, np.float32)
-        i = 0
-        pad = 0
-        while i < self.batch_size:
-            if self._cursor < len(self._seq):
-                img, label = self._read_sample(self._seq[self._cursor])
-                batch_data[i] = img
-                batch_label[i] = label if self.label_width > 1 else label[0]
-                self._cursor += 1
-            else:
-                pad += 1
-            i += 1
-        if pad == self.batch_size:
-            raise StopIteration
+        take = min(self.batch_size, len(self._seq) - self._cursor)
+        keys = [self._seq[self._cursor + j] for j in range(take)]
+        self._cursor += take
+        for i, (img, label) in enumerate(self._batch_samples(keys)):
+            batch_data[i] = img
+            batch_label[i] = label if self.label_width > 1 else label[0]
+        # take >= 1 here (the cursor check above raised otherwise), so a
+        # batch is never all-pad
+        pad = self.batch_size - take
         return DataBatch(data=[array(batch_data)],
                          label=[array(batch_label)], pad=pad)

@@ -333,3 +333,52 @@ def test_rec2idx_refuses_truncated_pack(tmp_path):
     import rec2idx
     with pytest.raises(RuntimeError, match="corrupt/truncated"):
         rec2idx.build_index(rec)
+
+
+def test_imageiter_preprocess_threads_match_serial(tmp_path):
+    """ImageIter(preprocess_threads=N) — the v2 iterator's parallel
+    decode stage (ref: src/io/iter_image_recordio_2.cc:672) — must
+    produce exactly the serial batches for deterministic augmenters."""
+    import numpy as np
+    import mxtpu as mx
+    from mxtpu import recordio
+
+    cv2 = pytest.importorskip("cv2")
+    rec = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(10):
+        img = rng.randint(0, 255, (40, 40, 3), np.uint8)
+        ok, buf = cv2.imencode(".png", img)  # png: lossless roundtrip
+        assert ok
+        hdr = recordio.IRHeader(0, float(i), i, 0)
+        w.write_idx(i, recordio.pack(hdr, buf.tobytes()))
+    w.close()
+
+    def run(threads):
+        it = mx.image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                                path_imgrec=rec, path_imgidx=idx,
+                                resize=32, preprocess_threads=threads)
+        out = []
+        for b in it:
+            out.append((b.data[0].asnumpy(), b.label[0].asnumpy(), b.pad))
+        return out
+
+    serial, threaded = run(0), run(4)
+    assert len(serial) == len(threaded) == 3
+    for (sd, sl, sp), (td, tl, tp) in zip(serial, threaded):
+        np.testing.assert_array_equal(sd, td)
+        np.testing.assert_array_equal(sl, tl)
+        assert sp == tp
+    assert serial[-1][2] == 2  # 10 samples, batch 4 -> last pad 2
+
+    # threaded iter under the PrefetchingIter double buffer still agrees
+    from mxtpu.io import PrefetchingIter
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                            path_imgrec=rec, path_imgidx=idx,
+                            resize=32, preprocess_threads=4)
+    pre = PrefetchingIter(it)
+    got = [b.data[0].asnumpy() for b in pre]
+    for s, g in zip(serial, got):
+        np.testing.assert_array_equal(s[0], g)

@@ -144,6 +144,19 @@ def main():
         imgiter_rate = _bench("imageiter_recordio", iter_all,
                               (args.n // args.batch) * args.batch)
 
+        itt = mx.image.ImageIter(batch_size=args.batch,
+                                 data_shape=(3, 224, 224),
+                                 path_imgrec=rec_path,
+                                 path_imgidx=idx_path, shuffle=False,
+                                 preprocess_threads=args.workers)
+
+        def iter_all_threaded():
+            itt.reset()
+            for _ in itt:
+                pass
+        _bench("imageiter_recordio_%dthreads" % args.workers,
+               iter_all_threaded, (args.n // args.batch) * args.batch)
+
     # 4. DataLoader with multiprocess workers
     from mxtpu.gluon.data import DataLoader
     dl = DataLoader(ds, batch_size=args.batch, num_workers=args.workers)
