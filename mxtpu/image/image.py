@@ -372,7 +372,11 @@ class ImageIter(DataIter):
 
     def _augment_sample(self, label, img):
         """The ONE copy of the augment/layout pipeline — serial and
-        threaded paths both come through here, so they cannot diverge."""
+        threaded paths both come through here, so the TRANSFORM code
+        cannot diverge. (Random augmenters draw from the shared RNG in
+        thread-interleaving order, so seeded reproducibility holds only
+        for serial/deterministic pipelines — same property as the
+        reference's decode threads.)"""
         for aug in self.auglist:
             img = aug(img)
         img = _as_np(img)
@@ -408,10 +412,14 @@ class ImageIter(DataIter):
         return self._augment_sample(*self._decode_blob(blob))
 
     def close(self):
-        """Shut the decode pool down (idempotent)."""
+        """Release the decode pool AND the RecordIO file handle
+        (idempotent; the iterator is done after this)."""
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
+        if self._record is not None:
+            self._record.close()
+            self._record = None
 
     def __del__(self):  # pragma: no cover - interpreter-exit timing
         try:
@@ -429,8 +437,12 @@ class ImageIter(DataIter):
         batch_label = np.zeros(shape, np.float32)
         take = min(self.batch_size, len(self._seq) - self._cursor)
         keys = [self._seq[self._cursor + j] for j in range(take)]
+        samples = self._batch_samples(keys)
+        # advance only after the batch decoded: a caller that catches a
+        # corrupt-record error and retries resumes at this batch rather
+        # than silently skipping its good samples
         self._cursor += take
-        for i, (img, label) in enumerate(self._batch_samples(keys)):
+        for i, (img, label) in enumerate(samples):
             batch_data[i] = img
             batch_label[i] = label if self.label_width > 1 else label[0]
         # take >= 1 here (the cursor check above raised otherwise), so a

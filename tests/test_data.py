@@ -380,5 +380,6 @@ def test_imageiter_preprocess_threads_match_serial(tmp_path):
                             resize=32, preprocess_threads=4)
     pre = PrefetchingIter(it)
     got = [b.data[0].asnumpy() for b in pre]
+    assert len(got) == len(serial)
     for s, g in zip(serial, got):
         np.testing.assert_array_equal(s[0], g)

@@ -16,9 +16,15 @@ died before that stage).
 """
 
 PROBE_SNIPPET = r"""
+import os
 import time
 t0 = time.perf_counter()
 import jax
+# honor the caller's platform pin: the axon sitecustomize overrides the
+# env var programmatically, which would probe the (possibly wedged) TPU
+# tunnel even when the caller explicitly asked for cpu
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 print("PROBE jax_imported %.2f" % (time.perf_counter() - t0), flush=True)
 devs = jax.devices()
 print("PROBE devices %.2f %s %s" % (time.perf_counter() - t0,
