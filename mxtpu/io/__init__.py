@@ -5,7 +5,9 @@ and the C++ iterator chain (SURVEY §2.4: src/io/ — source → augmenter →
 batch loader → prefetcher).
 """
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter, CSVIter, LibSVMIter)
+                 PrefetchingIter, CSVIter, LibSVMIter, MNISTIter,
+                 ImageRecordIter)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "LibSVMIter"]
+           "PrefetchingIter", "CSVIter", "LibSVMIter", "MNISTIter",
+           "ImageRecordIter"]

@@ -16,6 +16,7 @@ from ..base import MXNetError
 from ..ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "MNISTIter", "ImageRecordIter",
            "PrefetchingIter", "CSVIter", "LibSVMIter"]
 
 
@@ -504,3 +505,101 @@ class LibSVMIter(DataIter):
         if remaining < self.batch_size:
             return self.batch_size - remaining
         return 0
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST idx-ubyte iterator (ref: src/io/iter_mnist.cc:43-190).
+
+    Reads the standard ``*-images-idx3-ubyte`` / ``*-labels-idx1-ubyte``
+    files (gzipped accepted), normalizes pixels to [0, 1) by 1/256 like
+    the reference (:184), emits (batch, 1, 28, 28) float32 — or
+    (batch, 784) with ``flat=True`` — and supports the reference's
+    shuffle/seed/part sharding params. Incomplete tail batches are
+    dropped (the reference's Next() only serves full batches)."""
+
+    def __init__(self, image="./train-images-idx3-ubyte",
+                 label="./train-labels-idx1-ubyte", batch_size=128,
+                 shuffle=True, flat=False, seed=0, silent=False,
+                 num_parts=1, part_index=0, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        # loud, not silent (same policy as ImageIter's option check): a
+        # misspelled option must not quietly train with defaults
+        allowed = {"prefetch_buffer", "dtype"}  # reference-compat no-ops
+        unknown = set(kwargs) - allowed
+        if unknown:
+            raise MXNetError("MNISTIter: unknown options %s"
+                             % sorted(unknown))
+        import gzip
+        import struct
+
+        def _open(path):
+            return gzip.open(path, "rb") if path.endswith(".gz") \
+                else open(path, "rb")
+
+        with _open(label) as f:
+            struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), dtype=np.uint8) \
+                .astype(np.float32)
+        with _open(image) as f:
+            _, num, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), dtype=np.uint8) \
+                .reshape(num, 1, rows, cols).astype(np.float32) / 256.0
+        if flat:
+            images = images.reshape(num, rows * cols)
+        if shuffle:
+            order = np.random.RandomState(seed).permutation(num)
+            images, labels = images[order], labels[order]
+        per = num // num_parts
+        lo = part_index * per
+        hi = lo + per if num_parts > 1 else num
+        images, labels = images[lo:hi], labels[lo:hi]
+        if not silent:
+            import logging
+            logging.info("MNISTIter: load %d images, shuffle=%s, shape=%s",
+                         images.shape[0], shuffle, images.shape)
+        super().__init__(images, labels, batch_size, shuffle=False,
+                         last_batch_handle="discard", data_name=data_name,
+                         label_name=label_name)
+
+
+def ImageRecordIter(path_imgrec=None, path_imgidx=None, data_shape=None,
+                    batch_size=1, shuffle=False, preprocess_threads=0,
+                    part_index=0, num_parts=1, label_width=1,
+                    rand_crop=False, rand_mirror=False, resize=0,
+                    mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                    std_r=0.0, std_g=0.0, std_b=0.0,
+                    mean_img=None, data_name="data",
+                    label_name="softmax_label", **kwargs):
+    """The reference's registered ImageRecordIter spelling
+    (src/io/iter_image_recordio_2.cc:736) as a thin constructor over
+    :class:`mxtpu.image.ImageIter` — RecordIO shards + threaded
+    decode/augment + part sharding, with the C++ iterator's flat
+    per-channel mean/std params mapped onto the augmenter stack."""
+    from ..image import ImageIter
+    if mean_img is not None:
+        raise MXNetError("mean_img binary files are not supported: pass "
+                         "mean_r/mean_g/mean_b (or use mx.image.ImageIter "
+                         "with a mean array)")
+    aug_kwargs = {}
+    if any((mean_r, mean_g, mean_b)):
+        aug_kwargs["mean"] = np.array([mean_r, mean_g, mean_b], np.float32)
+    if any((std_r, std_g, std_b)):
+        aug_kwargs["std"] = np.array([std_r or 1.0, std_g or 1.0,
+                                      std_b or 1.0], np.float32)
+        # the normalize augmenter is keyed on mean; std alone must not
+        # be silently dropped
+        aug_kwargs.setdefault("mean", np.zeros(3, np.float32))
+    if resize:
+        aug_kwargs["resize"] = int(resize)
+    if rand_crop:
+        aug_kwargs["rand_crop"] = True
+    if rand_mirror:
+        aug_kwargs["rand_mirror"] = True
+    aug_kwargs.update(kwargs)  # remaining augmenter options pass through
+    return ImageIter(batch_size=batch_size, data_shape=data_shape,
+                     label_width=label_width, path_imgrec=path_imgrec,
+                     path_imgidx=path_imgidx, shuffle=shuffle,
+                     part_index=part_index, num_parts=num_parts,
+                     preprocess_threads=preprocess_threads,
+                     data_name=data_name, label_name=label_name,
+                     **aug_kwargs)

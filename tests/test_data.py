@@ -383,3 +383,101 @@ def test_imageiter_preprocess_threads_match_serial(tmp_path):
     assert len(got) == len(serial)
     for s, g in zip(serial, got):
         np.testing.assert_array_equal(s[0], g)
+
+
+def test_mnist_iter_idx_format(tmp_path):
+    """mx.io.MNISTIter over the standard idx-ubyte files
+    (ref: src/io/iter_mnist.cc — 1/256 normalization, flat option,
+    full-batch-only epochs, deterministic seeded shuffle)."""
+    import gzip
+    import struct
+
+    import numpy as np
+    import mxtpu as mx
+
+    n = 10
+    rng = np.random.RandomState(3)
+    imgs = rng.randint(0, 255, (n, 28, 28), np.uint8)
+    lbls = (np.arange(n) % 10).astype(np.uint8)
+    img_path = str(tmp_path / "imgs-idx3-ubyte.gz")
+    lbl_path = str(tmp_path / "lbls-idx1-ubyte.gz")
+    with gzip.open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(lbls.tobytes())
+
+    it = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=4,
+                         shuffle=False, silent=True)
+    batches = list(it)
+    assert len(batches) == 2              # tail of 2 dropped (full-batch only)
+    d = batches[0].data[0].asnumpy()
+    assert d.shape == (4, 1, 28, 28)
+    np.testing.assert_allclose(d, imgs[:4, None] / 256.0, rtol=1e-6)
+    np.testing.assert_array_equal(batches[0].label[0].asnumpy(), lbls[:4])
+
+    flat = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=4,
+                           shuffle=False, flat=True, silent=True)
+    assert next(iter(flat)).data[0].shape == (4, 784)
+
+    # seeded shuffle reproduces
+    a = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=4,
+                        shuffle=True, seed=7, silent=True)
+    b = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=4,
+                        shuffle=True, seed=7, silent=True)
+    np.testing.assert_array_equal(next(iter(a)).label[0].asnumpy(),
+                                  next(iter(b)).label[0].asnumpy())
+
+
+def test_image_record_iter_reference_spelling(tmp_path):
+    """mx.io.ImageRecordIter — the reference's registered name with its
+    flat mean_r/g/b params (src/io/iter_image_recordio_2.cc:736)."""
+    import numpy as np
+    import mxtpu as mx
+    from mxtpu import recordio
+
+    cv2 = pytest.importorskip("cv2")
+    rec, idx = str(tmp_path / "r.rec"), str(tmp_path / "r.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(6):
+        img = np.full((36, 36, 3), 30 * i, np.uint8)
+        ok, buf = cv2.imencode(".png", img)
+        w.write_idx(i, recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                     buf.tobytes()))
+    w.close()
+    it = mx.io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                               data_shape=(3, 32, 32), batch_size=3,
+                               resize=32, mean_r=10.0, mean_g=10.0,
+                               mean_b=10.0, preprocess_threads=2)
+    b = next(iter(it))
+    assert b.data[0].shape == (3, 3, 32, 32)
+    # mean was subtracted: first image is all zeros -> -10 after mean
+    np.testing.assert_allclose(b.data[0].asnumpy()[0], -10.0, atol=1e-5)
+    np.testing.assert_array_equal(b.label[0].asnumpy(), [0.0, 1.0, 2.0])
+
+
+def test_mnist_iter_rejects_unknown_options(tmp_path):
+    import mxtpu as mx
+    from mxtpu.base import MXNetError
+    with pytest.raises(MXNetError, match="unknown options"):
+        mx.io.MNISTIter(image="x", label="y", shufle=False)
+
+
+def test_image_record_iter_std_without_mean_not_dropped(tmp_path):
+    import numpy as np
+    import mxtpu as mx
+    from mxtpu import recordio
+    cv2 = pytest.importorskip("cv2")
+    rec, idx = str(tmp_path / "s.rec"), str(tmp_path / "s.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    img = np.full((32, 32, 3), 100, np.uint8)
+    ok, buf = cv2.imencode(".png", img)
+    w.write_idx(0, recordio.pack(recordio.IRHeader(0, 0.0, 0, 0),
+                                 buf.tobytes()))
+    w.close()
+    it = mx.io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                               data_shape=(3, 32, 32), batch_size=1,
+                               std_r=2.0, std_g=2.0, std_b=2.0)
+    b = next(iter(it))
+    np.testing.assert_allclose(b.data[0].asnumpy(), 50.0, atol=1e-4)
