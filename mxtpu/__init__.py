@@ -71,6 +71,7 @@ from . import monitor
 from .monitor import Monitor
 from . import module
 from . import module as mod  # mx.mod alias
+from . import executor  # mx.executor.Executor spelling (ref: executor.py)
 from .module import Module
 from . import gluon
 from . import operator

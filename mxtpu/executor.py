@@ -1,0 +1,9 @@
+"""``mx.executor`` namespace alias (ref: python/mxnet/executor.py — the
+Executor class over MXExecutor* C calls). The TPU-native Executor lives
+with the symbol layer (mxtpu/symbol/executor.py: jit-cached fwd/bwd over
+the same tape); this module keeps ``mx.executor.Executor`` spelling and
+isinstance checks working for code written against the reference.
+"""
+from .symbol.executor import Executor
+
+__all__ = ["Executor"]

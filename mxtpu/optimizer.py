@@ -665,3 +665,14 @@ class GroupAdaGrad(Optimizer):
         div = jnp.sqrt(h_new + self.float_stable_eps)
         weight._set_data(weight._data
                          - lr * g / div.reshape((-1,) + (1,) * (g.ndim - 1)))
+
+
+# mx.optimizer.contrib — the reference's contrib optimizer namespace
+# (python/mxnet/optimizer/contrib.py: GroupAdaGrad lives there)
+import sys as _sys
+import types as _types
+
+contrib = _types.ModuleType(__name__ + ".contrib")
+contrib.GroupAdaGrad = GroupAdaGrad
+contrib.__all__ = ["GroupAdaGrad"]
+_sys.modules[contrib.__name__] = contrib

@@ -226,3 +226,26 @@ def test_print_summary_no_data_inflation_and_shared_weight(capsys):
     grp = mx.sym.Group([a, b])
     total2 = mx.viz.print_summary(grp, shape={"x": (2, 8)})
     assert total2 == 8 * 8
+
+
+def test_mx_executor_namespace_alias():
+    """Reference code spells mx.executor.Executor (python/mxnet/
+    executor.py); isinstance checks against it must see the real class."""
+    import mxtpu as mx
+    from mxtpu import symbol as sym
+    assert mx.executor.Executor is not None
+    s = sym.FullyConnected(sym.var("data"), sym.var("w"), sym.var("b"),
+                           num_hidden=3, name="fc")
+    ex = s.bind(args={"data": mx.nd.ones((2, 4)),
+                      "w": mx.nd.ones((3, 4)), "b": mx.nd.zeros((3,))})
+    assert isinstance(ex, mx.executor.Executor)
+
+
+def test_optimizer_contrib_namespace():
+    """mx.optimizer.contrib.GroupAdaGrad — the reference spelling
+    (python/mxnet/optimizer/contrib.py)."""
+    import mxtpu as mx
+    from mxtpu.optimizer import contrib
+    assert contrib.GroupAdaGrad is mx.optimizer.GroupAdaGrad
+    import importlib
+    assert importlib.import_module("mxtpu.optimizer.contrib") is contrib
