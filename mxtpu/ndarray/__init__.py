@@ -70,6 +70,7 @@ from .utils import load, save  # noqa: E402,F401
 from . import random  # noqa: E402,F401
 from . import image  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401
 from .sparse import CSRNDArray, RowSparseNDArray  # noqa: E402,F401
 
 

@@ -1,0 +1,22 @@
+"""``mx.nd.linalg`` namespace (ref: python/mxnet/ndarray/linalg.py — the
+``linalg_*`` registry ops exposed without their prefix: gemm2, potrf,
+syrk, ...). Generated from the registry like the reference's codegen.
+"""
+import sys as _sys
+
+from ..ops import registry as _reg
+
+_PREFIX = "linalg_"
+_mod = _sys.modules[__name__]
+for _name, _op in list(_reg.REGISTRY.items()):
+    if _name.startswith(_PREFIX):
+        setattr(_mod, _name[len(_PREFIX):], _op.wrapper)
+del _name, _op
+
+
+def __getattr__(name):
+    op = _reg.REGISTRY.get(_PREFIX + name)
+    if op is not None:
+        setattr(_mod, name, op.wrapper)
+        return op.wrapper
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

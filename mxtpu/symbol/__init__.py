@@ -162,3 +162,67 @@ def _contrib_getattr(name):
 
 
 contrib.__getattr__ = _contrib_getattr
+
+
+def _prefixed_sym_module(mod_name, prefix):
+    """Synthetic mx.sym.<mod_name> exposing registry ops whose names start
+    with ``prefix``, unprefixed — the reference's gen_linalg/gen_image
+    codegen modules (python/mxnet/symbol/linalg.py etc.)."""
+    m = _types.ModuleType(__name__ + "." + mod_name)
+    for nm in _reg.list_ops():
+        if nm.startswith(prefix):
+            setattr(m, nm[len(prefix):], _make_sym_fn(nm))
+
+    def _getattr(name, _p=prefix, _m=m):
+        if _p + name in _reg.REGISTRY:
+            fn = _make_sym_fn(_p + name)
+            setattr(_m, name, fn)
+            return fn
+        raise AttributeError("module %r has no attribute %r"
+                             % (_m.__name__, name))
+
+    m.__getattr__ = _getattr
+    _sys.modules[m.__name__] = m
+    return m
+
+
+linalg = _prefixed_sym_module("linalg", "linalg_")
+image = _prefixed_sym_module("image", "_image_")
+
+# mx.sym.random — symbolic sampling twins (ref: python/mxnet/symbol/
+# random.py). Conventions mirror mx.nd.random: exponential takes
+# mean=scale (the registry op is rate-parameterized).
+random = _types.ModuleType(__name__ + ".random")
+for _rn in ("uniform", "normal", "poisson", "negative_binomial",
+            "generalized_negative_binomial", "multinomial", "randint",
+            "shuffle"):
+    setattr(random, _rn, _make_sym_fn(_rn))
+random.gamma = _make_sym_fn("_random_gamma")
+
+
+def _sym_random_exponential(scale=1.0, **kwargs):
+    return _make_sym_fn("exponential")(lam=1.0 / scale, **kwargs)
+
+
+random.exponential = _sym_random_exponential
+_sys.modules[random.__name__] = random
+del _rn
+
+# mx.sym.sparse — symbolic spellings of the sparse-aware op set (ref:
+# python/mxnet/symbol/sparse.py re-exports the gen_sparse ops). The graph
+# here executes with dense storage (sparse STORAGE lives on NDArray /
+# kvstore row_sparse paths); these spellings keep reference code
+# composing, with dense-lowered semantics.
+sparse = _types.ModuleType(__name__ + ".sparse")
+for _sn in ("dot", "add_n", "elemwise_add", "elemwise_sub", "elemwise_mul",
+            "elemwise_div", "zeros_like", "ones_like", "where", "Embedding",
+            "LinearRegressionOutput", "make_loss", "relu", "sigmoid",
+            "square", "sqrt", "abs", "sum", "mean", "broadcast_add",
+            "broadcast_sub", "broadcast_mul", "broadcast_div", "clip",
+            "negative"):
+    if _sn in _reg.REGISTRY:
+        setattr(sparse, _sn, _make_sym_fn(_sn))
+# sparse retain/cast_storage live on NDArray (RowSparseNDArray.retain,
+# .tostype) — no graph-op twin exists, so mx.sym.sparse has no `retain`
+_sys.modules[sparse.__name__] = sparse
+del _sn

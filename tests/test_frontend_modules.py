@@ -249,3 +249,65 @@ def test_optimizer_contrib_namespace():
     assert contrib.GroupAdaGrad is mx.optimizer.GroupAdaGrad
     import importlib
     assert importlib.import_module("mxtpu.optimizer.contrib") is contrib
+
+
+def test_nd_linalg_and_sym_subnamespaces():
+    """Reference sub-namespace spellings: mx.nd.linalg.*, mx.sym.linalg/
+    image/random/sparse (python/mxnet/{ndarray,symbol}/linalg.py etc.)."""
+    import numpy as np
+    import mxtpu as mx
+
+    a = mx.nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    np.testing.assert_allclose(
+        mx.nd.linalg.gemm2(a, a).asnumpy(), a.asnumpy() @ a.asnumpy(),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        mx.nd.linalg.syrk(a).asnumpy(), a.asnumpy() @ a.asnumpy().T,
+        rtol=1e-5)
+
+    # symbolic twins compose and execute
+    s = mx.sym.linalg.gemm2(mx.sym.var("x"), mx.sym.var("y"))
+    ex = s.bind(args={"x": a, "y": a})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(),
+                               a.asnumpy() @ a.asnumpy(), rtol=1e-5)
+    sd = mx.sym.sparse.dot(mx.sym.var("x"), mx.sym.var("y"))
+    ex2 = sd.bind(args={"x": a, "y": a})
+    np.testing.assert_allclose(ex2.forward()[0].asnumpy(),
+                               a.asnumpy() @ a.asnumpy(), rtol=1e-5)
+    assert mx.sym.image.resize is not None
+    assert mx.sym.random.uniform is not None
+
+
+def test_rnn_checkpoint_roundtrip(tmp_path):
+    """mx.rnn.save/load_rnn_checkpoint: fused blob unpacks on disk and
+    re-packs on load (ref: python/mxnet/rnn/rnn.py:32-96)."""
+    import numpy as np
+    import mxtpu as mx
+    from mxtpu import rnn
+
+    H, I_ = 4, 3
+    fused = rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="f_")
+    out, _ = fused.unroll(2, inputs=mx.sym.var("data"),
+                          begin_state=fused.begin_state(batch_size=2),
+                          merge_outputs=True)
+    n_params = 4 * H * (I_ + H) + 8 * H
+    blob = np.random.RandomState(0).rand(n_params).astype(np.float32)
+    args = {"f_parameters": mx.nd.array(blob)}
+    prefix = str(tmp_path / "rnnckpt")
+    rnn.save_rnn_checkpoint(fused, prefix, 3, out, dict(args), {})
+    # on-disk params are UNPACKED per-gate arrays, not the runtime blob
+    import mxtpu.model as model
+    _sym, disk_args, _aux = model.load_checkpoint(prefix, 3)
+    assert "f_parameters" not in disk_args
+    assert any(k.endswith("weight") or "i2h" in k for k in disk_args)
+    # load re-packs to the fused blob exactly
+    _sym2, arg2, _aux2 = rnn.load_rnn_checkpoint(fused, prefix, 3)
+    np.testing.assert_allclose(arg2["f_parameters"].asnumpy(), blob,
+                               rtol=1e-6)
+    # do_rnn_checkpoint callback writes on period boundaries only
+    cb = rnn.do_rnn_checkpoint(fused, str(tmp_path / "cbck"), period=2)
+    cb(0, out, dict(args), {})   # epoch 1: skipped
+    import os
+    assert not os.path.exists(str(tmp_path / "cbck-0001.params"))
+    cb(1, out, dict(args), {})   # epoch 2: written
+    assert os.path.exists(str(tmp_path / "cbck-0002.params"))
