@@ -6,3 +6,13 @@ from . import text  # noqa: F401
 from . import onnx  # noqa: F401
 from . import async_checkpoint  # noqa: F401
 from . import external_kernel  # noqa: F401
+from . import autograd  # noqa: F401
+from . import io  # noqa: F401
+from . import quantization as quant  # noqa: F401  (ref alias)
+
+# mx.contrib.ndarray / mx.contrib.symbol (+ nd/sym aliases): the contrib
+# op namespaces (ref: python/mxnet/contrib/__init__.py:21-25)
+from ..ndarray import contrib as ndarray  # noqa: F401
+from ..ndarray import contrib as nd  # noqa: F401
+from ..symbol import contrib as symbol  # noqa: F401
+from ..symbol import contrib as sym  # noqa: F401

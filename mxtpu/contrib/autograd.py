@@ -1,0 +1,79 @@
+"""mx.contrib.autograd (ref: python/mxnet/contrib/autograd.py): the
+pre-1.0 experimental autograd spellings, kept as thin delegates to
+:mod:`mxtpu.autograd` so old tutorials/scripts run unmodified."""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+from ..ndarray import NDArray
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """(ref: contrib/autograd.py:32) — returns the previous state."""
+    prev_t = _ag.set_training(bool(is_train))
+    _ag.set_recording(bool(is_train))
+    return prev_t
+
+
+def train_section():
+    """``with train_section():`` == ``with autograd.record():``
+    (ref: contrib/autograd.py:74)."""
+    return _ag.record()
+
+
+def test_section():
+    """(ref: contrib/autograd.py:88)"""
+    return _ag.pause()
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to leaves (ref: contrib/autograd.py:102) —
+    the single-NDArray convenience form over autograd.mark_variables."""
+    if isinstance(variables, NDArray):
+        variables, gradients = [variables], [gradients]
+    _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    """(ref: contrib/autograd.py:123)"""
+    _ag.backward(outputs, head_grads=out_grads, retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """(ref: contrib/autograd.py:158)"""
+    backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Returns fn computing (gradients, loss) (ref: contrib/autograd.py:163)."""
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            argnums = [argnum] if isinstance(argnum, int) else list(argnum)
+            variables = [args[i] for i in argnums]
+        for v in variables:
+            assert isinstance(v, NDArray), "type of autograd input should "\
+                "be NDArray."
+            v.attach_grad()
+        with train_section():
+            outputs = func(*args)
+        backward([outputs] if isinstance(outputs, NDArray) else outputs)
+        grads = [v.grad for v in variables]
+        return grads, outputs
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Returns fn computing just the gradients (ref: contrib/autograd.py:195)."""
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    @functools.wraps(grad_with_loss_func)
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+    return wrapped

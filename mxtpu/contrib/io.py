@@ -1,0 +1,64 @@
+"""mx.contrib.io (ref: python/mxnet/contrib/io.py): bridge a gluon
+DataLoader into the DataIter interface so classic Module code can consume
+gluon datasets (incl. the multiprocess shared-memory loader)."""
+from __future__ import annotations
+
+from ..io import DataDesc, DataIter
+from ..ndarray import zeros
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Iterator over a ``gluon.data.DataLoader`` (ref: contrib/io.py:25).
+    The first batch is drawn at construction to learn shapes; short final
+    batches are zero-padded with ``DataBatch.pad`` reporting the filler
+    rows."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label",
+                 dtype="float32"):
+        super().__init__()
+        self._loader = loader
+        self._iter = iter(self._loader)
+        try:
+            data, label = next(self._iter)
+        except StopIteration:
+            raise ValueError("DataLoaderIter needs a non-empty DataLoader "
+                             "(shapes are learned from its first batch)") \
+                from None
+        self.batch_size = data.shape[0]
+        self.dtype = dtype
+        self.provide_data = [DataDesc(data_name, data.shape, dtype)]
+        self.provide_label = [DataDesc(label_name, label.shape, dtype)]
+        self._current_batch = None
+        self.reset()
+
+    def reset(self):
+        self._iter = iter(self._loader)
+
+    def iter_next(self):
+        try:
+            self._current_batch = next(self._iter)
+        except StopIteration:
+            self._current_batch = None
+        return self._current_batch is not None
+
+    def _padded(self, arr):
+        if not self.getpad():
+            return [arr.astype(self.dtype)]
+        full = zeros((self.batch_size,) + tuple(arr.shape[1:]),
+                     dtype=self.dtype)
+        full[:arr.shape[0]] = arr.astype(self.dtype)
+        return [full]
+
+    def getdata(self):
+        return self._padded(self._current_batch[0])
+
+    def getlabel(self):
+        return self._padded(self._current_batch[1])
+
+    def getpad(self):
+        return self.batch_size - self._current_batch[0].shape[0]
+
+    def getindex(self):
+        return None

@@ -204,7 +204,13 @@ def _sym_random_exponential(scale=1.0, **kwargs):
     return _make_sym_fn("exponential")(lam=1.0 / scale, **kwargs)
 
 
+def _sym_random_randn(*shape, **kwargs):
+    # ref: symbol/random.py randn — normal with *shape positional dims
+    return _make_sym_fn("normal")(shape=shape or None, **kwargs)
+
+
 random.exponential = _sym_random_exponential
+random.randn = _sym_random_randn
 _sys.modules[random.__name__] = random
 del _rn
 

@@ -209,3 +209,73 @@ def square(x_ref, out_ref):
     import pytest as _pytest
     with _pytest.raises(Exception, match="not in module"):
         mod.get_kernel("nope")
+
+
+def test_contrib_namespace_aliases():
+    """mx.contrib.{ndarray,nd,symbol,sym,quant} (ref:
+    python/mxnet/contrib/__init__.py:21-35)."""
+    import mxtpu as mx
+    assert mx.contrib.nd is mx.contrib.ndarray
+    assert mx.contrib.sym is mx.contrib.symbol
+    assert mx.contrib.nd.box_nms is not None
+    assert mx.contrib.sym.quadratic is not None
+    assert mx.contrib.quant is mx.contrib.quantization
+
+
+def test_contrib_autograd_legacy_api():
+    """Old experimental autograd spellings (ref: contrib/autograd.py)."""
+    import numpy as np
+    import mxtpu as mx
+    from mxtpu.contrib import autograd as cag
+
+    x = mx.nd.array(np.array([3.0, -1.0], np.float32))
+    grads, loss = cag.grad_and_loss(lambda a: (a * a).sum())(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), [6.0, -2.0])
+    assert float(loss.asnumpy()) == 10.0
+    g = cag.grad(lambda a: (2 * a).sum())(x)
+    np.testing.assert_allclose(g[0].asnumpy(), [2.0, 2.0])
+    # mark_variables + train_section + backward
+    y = mx.nd.array(np.ones(2, np.float32))
+    cag.mark_variables(y, mx.nd.zeros(2))
+    with cag.train_section():
+        out = (y * 3).sum()
+    cag.backward(out)
+    np.testing.assert_allclose(y.grad.asnumpy(), [3.0, 3.0])
+
+
+def test_contrib_dataloader_iter_bridge():
+    """gluon DataLoader -> Module-style DataIter (ref: contrib/io.py:25):
+    shapes learned from the first batch, short tail zero-padded with
+    honest pad count, reset replays."""
+    import numpy as np
+    import mxtpu as mx
+    from mxtpu.gluon.data import ArrayDataset, DataLoader
+
+    ds = ArrayDataset(np.arange(14, dtype=np.float32).reshape(7, 2),
+                      np.arange(7, dtype=np.float32))
+    it = mx.contrib.io.DataLoaderIter(
+        DataLoader(ds, batch_size=3, last_batch="keep"))
+    assert it.provide_data[0].shape == (3, 2)
+    batches = list(it)
+    assert [b.pad for b in batches] == [0, 0, 2]
+    # padded tail: real rows then zeros
+    tail = batches[-1].data[0].asnumpy()
+    np.testing.assert_allclose(tail[0], [12.0, 13.0])
+    np.testing.assert_allclose(tail[1:], 0.0)
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_contrib_fix_regressions():
+    import numpy as np
+    import pytest as _pt
+    import mxtpu as mx
+    from mxtpu.gluon.data import ArrayDataset, DataLoader
+    # empty loader is a clear error, not a stray StopIteration
+    with _pt.raises(ValueError, match="non-empty"):
+        mx.contrib.io.DataLoaderIter(
+            DataLoader(ArrayDataset(np.zeros((0, 2), np.float32),
+                                    np.zeros(0, np.float32)), batch_size=2))
+    # sym.random.randn parity with nd.random.randn
+    s = mx.sym.random.randn(2, 3)
+    assert s is not None
