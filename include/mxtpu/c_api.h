@@ -280,6 +280,196 @@ int MXTPUKVStorePushPull(KVStoreHandle handle, int num, const char **keys,
 /* ---- misc (ref: MXRandomSeed, MXNDArraySlice / Reshape /
  * SyncCopyFromCPU / GetContext). ---- */
 
+/* ---- autograd breadth (ref: MXAutogradIsRecording / IsTraining /
+ * MarkVariables / MXAutogradBackwardEx). grad_reqs flags: 0 null,
+ * 1 write, 2 add (the reference's OpReqType subset for leaves). ---- */
+
+int MXTPUAutogradIsRecording(int *out);
+int MXTPUAutogradIsTraining(int *out);
+int MXTPUAutogradMarkVariables(int num, NDArrayHandle *vars,
+                               const int *grad_reqs);
+/* Backward from several heads; ograds may be NULL (ones-like seeds). */
+int MXTPUAutogradBackward(int num, NDArrayHandle *heads,
+                          NDArrayHandle *ograds, int retain_graph);
+
+/* ---- CachedOp (ref: MXCreateCachedOpEx / MXInvokeCachedOpEx /
+ * MXFreeCachedOp — gluon hybridize from C). Inputs are positional in
+ * symbol.list_inputs() order; each distinct input signature jit-compiles
+ * once and is reused (the XLA analog of cached_op.cc's static plan). ---- */
+
+typedef void *CachedOpHandle;
+
+int MXTPUCreateCachedOp(SymbolHandle sym, int num_flags,
+                        const char **flag_keys, const char **flag_vals,
+                        CachedOpHandle *out);
+int MXTPUInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                        NDArrayHandle *inputs, int *num_outputs,
+                        NDArrayHandle *outputs);
+int MXTPUFreeCachedOp(CachedOpHandle handle);
+
+/* ---- NDArray breadth (ref: MXNDArrayCreateNone / At / Detach /
+ * WaitToRead / WaitToWrite / GetStorageType / SaveRawBytes /
+ * LoadFromRawBytes / LoadFromBuffer / SyncCopyFromNDArray /
+ * SyncCheckFormat / CreateSparseEx / GetAux* / GetDataNDArray). ---- */
+
+int MXTPUNDArrayCreateNone(NDArrayHandle *out);
+int MXTPUNDArrayAt(NDArrayHandle handle, int64_t idx, NDArrayHandle *out);
+int MXTPUNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out);
+int MXTPUNDArrayWaitToRead(NDArrayHandle handle);
+int MXTPUNDArrayWaitToWrite(NDArrayHandle handle);
+/* storage type flags: 0 default(dense), 1 row_sparse, 2 csr
+ * (ref include/mxnet/ndarray.h:61 NDArrayStorageType). */
+int MXTPUNDArrayGetStorageType(NDArrayHandle handle, int *out);
+/* One dense array as a single V2 record (no 0x112 list header). Buffer
+ * valid until the next SaveRawBytes on this thread. */
+int MXTPUNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                             const char **out_buf);
+int MXTPUNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                                 NDArrayHandle *out);
+/* A whole .params file image from memory; same output contract as
+ * MXTPUNDArrayLoad. */
+int MXTPUNDArrayLoadFromBuffer(const void *buf, size_t size, int *out_num,
+                               NDArrayHandle **out_handles,
+                               int *out_num_names, const char ***out_names);
+int MXTPUNDArraySyncCopyFromNDArray(NDArrayHandle dst, NDArrayHandle src);
+int MXTPUNDArraySyncCheckFormat(NDArrayHandle handle, int full_check);
+/* Sparse create: stype 1 (row_sparse) takes aux = {indices}; stype 2
+ * (csr) takes aux = {indptr, indices}. */
+int MXTPUNDArrayCreateSparseEx(int stype, NDArrayHandle data, int num_aux,
+                               NDArrayHandle *aux, const int64_t *shape,
+                               int ndim, NDArrayHandle *out);
+int MXTPUNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out);
+int MXTPUNDArrayGetAuxNDArray(NDArrayHandle handle, int i,
+                              NDArrayHandle *out);
+int MXTPUNDArrayGetAuxType(NDArrayHandle handle, int i, int *out_flag);
+
+/* ---- Symbol breadth II (ref: MXSymbolCreateAtomicSymbol / CreateGroup /
+ * GetInternals / GetOutput / GetNumOutputs / GetName / GetChildren /
+ * InferType / InferShapePartial / ListAtomicSymbolCreators / Print /
+ * SaveToJSON). ---- */
+
+/* Uncomposed atomic op symbol; missing inputs become auto-created
+ * argument variables at bind time. */
+int MXTPUSymbolCreateAtomicSymbol(const char *op_name, int num_attrs,
+                                  const char **attr_keys,
+                                  const char **attr_vals, SymbolHandle *out);
+int MXTPUSymbolCreateGroup(int num, SymbolHandle *syms, SymbolHandle *out);
+int MXTPUSymbolGetInternals(SymbolHandle handle, SymbolHandle *out);
+int MXTPUSymbolGetOutput(SymbolHandle handle, int index, SymbolHandle *out);
+int MXTPUSymbolGetNumOutputs(SymbolHandle handle, int *out);
+/* *success = 0 for multi-output groups (they have no single name). */
+int MXTPUSymbolGetName(SymbolHandle handle, const char **out, int *success);
+int MXTPUSymbolGetChildren(SymbolHandle handle, SymbolHandle *out);
+/* Type inference. dtype flags as in CreateFromBlobEx; unknown = -1.
+ * The three out arrays live until the next InferType on this thread. */
+int MXTPUSymbolInferType(SymbolHandle handle, int num_args,
+                         const char **arg_names, const int *arg_type_flags,
+                         int *out_arg_num, const int **out_arg_flags,
+                         int *out_out_num, const int **out_out_flags,
+                         int *out_aux_num, const int **out_aux_flags);
+/* Tolerant shape inference: unknowable outputs come back with ndim 0
+ * instead of failing (ref MXSymbolInferShapePartial). Same packing as
+ * MXTPUSymbolInferOutputShape. */
+int MXTPUSymbolInferShapePartial(SymbolHandle handle, int num_args,
+                                 const char **arg_names,
+                                 const int64_t *arg_shape_data,
+                                 const int *arg_shape_ndim, int *out_num,
+                                 const int64_t **out_flat);
+int MXTPUSymbolListAtomicSymbolCreators(int *out_num,
+                                        const char ***out_names);
+/* Human-readable description (ref MXSymbolPrint). */
+int MXTPUSymbolPrint(SymbolHandle handle, const char **out);
+/* Name-parity alias of MXTPUSymbolToJSON (ref MXSymbolSaveToJSON). */
+int MXTPUSymbolSaveToJSON(SymbolHandle handle, const char **out_json);
+
+/* ---- Executor breadth (ref: MXExecutorSimpleBind / Reshape / Print /
+ * Outputs). SimpleBind infers every shape from the named input shapes and
+ * allocates args/auxs itself (grad_req applies to all arguments). ---- */
+
+int MXTPUExecutorSimpleBind(SymbolHandle sym, int num_inputs,
+                            const char **input_names,
+                            const int64_t *shape_data, const int *shape_ndim,
+                            const char *grad_req, ExecutorHandle *out);
+/* Rebind to new input shapes; returns a NEW executor sharing nothing
+ * (XLA recompiles per shape; ref MXExecutorReshape). */
+int MXTPUExecutorReshape(ExecutorHandle handle, int num_inputs,
+                         const char **input_names, const int64_t *shape_data,
+                         const int *shape_ndim, ExecutorHandle *out);
+int MXTPUExecutorPrint(ExecutorHandle handle, const char **out);
+/* All outputs at once; *num is the capacity in, count out. */
+int MXTPUExecutorOutputs(ExecutorHandle handle, int *num,
+                         NDArrayHandle *outs);
+
+/* ---- KVStore breadth II (ref: MXKVStoreGetType / SetUpdater /
+ * SetGradientCompression / PullRowSparse / GetNumDeadNode /
+ * IsWorkerNode / IsServerNode / IsSchedulerNode). ---- */
+
+typedef void (*MXTPUKVStoreUpdater)(int key, NDArrayHandle recv,
+                                    NDArrayHandle local, void *ctx);
+typedef void (*MXTPUKVStoreStrUpdater)(const char *key, NDArrayHandle recv,
+                                       NDArrayHandle local, void *ctx);
+
+int MXTPUKVStoreGetType(KVStoreHandle handle, const char **out);
+/* The updater runs on every push-merge; recv/local handles are BORROWED
+ * (valid only during the call). The int-key variant requires numeric
+ * keys — a push with a named key (e.g. "fc1_weight") fails loudly; use
+ * SetUpdaterEx for string keys (ref MXKVStoreSetUpdaterEx). */
+int MXTPUKVStoreSetUpdater(KVStoreHandle handle, MXTPUKVStoreUpdater updater,
+                           void *ctx);
+int MXTPUKVStoreSetUpdaterEx(KVStoreHandle handle,
+                             MXTPUKVStoreStrUpdater updater, void *ctx);
+int MXTPUKVStoreSetGradientCompression(KVStoreHandle handle, int num,
+                                       const char **keys, const char **vals);
+int MXTPUKVStorePullRowSparse(KVStoreHandle handle, int num,
+                              const char **keys, NDArrayHandle *outs,
+                              NDArrayHandle *row_ids, int priority);
+int MXTPUKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id, int *out);
+/* Role queries (DMLC_ROLE env; symmetric-worker runtime: every process
+ * is a worker unless the env says otherwise). */
+int MXTPUKVStoreIsWorkerNode(int *out);
+int MXTPUKVStoreIsServerNode(int *out);
+int MXTPUKVStoreIsSchedulerNode(int *out);
+
+/* ---- profiler (ref: MXSetProfilerConfig / MXSetProfilerState /
+ * MXDumpProfile / MXProfilePause — mx.profiler chrome-trace capture). ---- */
+
+int MXTPUSetProfilerConfig(int num, const char **keys, const char **vals);
+int MXTPUSetProfilerState(int state); /* 1 run, 0 stop */
+int MXTPUDumpProfile(int finished);
+int MXTPUProfilePause(int paused);
+
+/* ---- runtime/introspection breadth (ref: MXGetGPUCount /
+ * MXGetGPUMemoryInformation64 / MXNotifyShutdown / MXEngineSetBulkSize /
+ * MXSetNumOMPThreads / MXRandomSeedContext). ---- */
+
+/* Visible accelerator count (the reference counts GPUs; here PJRT
+ * devices). */
+int MXTPUGetDeviceCount(int *out);
+/* (free, total) HBM bytes; fails honestly when the backend exposes no
+ * memory stats. */
+int MXTPUGetMemoryInformation(int dev_id, uint64_t *free_bytes,
+                              uint64_t *total_bytes);
+/* Flush pending async work before exit (ref MXNotifyShutdown tears the
+ * engine down; PJRT clients close at process exit). */
+int MXTPUNotifyShutdown(void);
+/* Engine bulking is subsumed by XLA fusion — the call is the documented
+ * no-op of mxtpu/engine.py and returns the previous size. */
+int MXTPUEngineSetBulkSize(int size, int *prev);
+/* XLA:CPU fixes its pool at backend init; accepted for compatibility. */
+int MXTPUSetNumOMPThreads(int num);
+/* Seed one device's stream (one functional PRNG: equivalent to
+ * MXTPURandomSeed; ref MXRandomSeedContext). */
+int MXTPURandomSeedContext(int seed, int dev_type, int dev_id);
+
+/* ---- DataIter breadth (ref: MXDataIterGetIndex / GetIterInfo). ---- */
+
+/* Sample indices of the current batch; array valid until the next call
+ * on this thread. */
+int MXTPUDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                          uint64_t *out_size);
+int MXTPUDataIterGetIterInfo(const char *name, const char **out_name,
+                             const char **out_desc);
+
 int MXTPURandomSeed(int seed);
 int MXTPUNDArraySlice(NDArrayHandle handle, int64_t begin, int64_t end,
                       NDArrayHandle *out);

@@ -541,3 +541,455 @@ def ndarray_sync_copy_from_cpu(handle: NDArray, data: bytes) -> None:
 
 def ndarray_context(handle: NDArray) -> str:
     return str(handle.context)
+
+
+# ---- autograd breadth (ref: MXAutogradIsRecording / IsTraining /
+# MarkVariables / MXAutogradBackwardEx, src/c_api/c_api_ndarray.cc) ----
+
+def autograd_is_recording() -> int:
+    from . import autograd
+    return int(autograd.is_recording())
+
+
+def autograd_is_training() -> int:
+    from . import autograd
+    return int(autograd.is_training())
+
+
+_GRAD_REQ_FLAGS = {0: "null", 1: "write", 2: "add"}
+
+
+def autograd_mark_variables(variables: tuple, grad_reqs: tuple) -> None:
+    for v, r in zip(variables, grad_reqs):
+        v.attach_grad(grad_req=_GRAD_REQ_FLAGS.get(int(r), "write"))
+
+
+def autograd_backward(heads: tuple, ograds: tuple, retain_graph: int) -> None:
+    from . import autograd
+    hg = list(ograds) if ograds else None
+    autograd.backward(list(heads), head_grads=hg,
+                      retain_graph=bool(retain_graph))
+
+
+# ---- CachedOp (ref: MXCreateCachedOpEx / MXInvokeCachedOpEx /
+# MXFreeCachedOp, src/c_api/c_api_ndarray.cc; the engine-side analog is
+# src/imperative/cached_op.cc — here the cache entry is a jit-compiled
+# Executor per input-signature, XLA being the static planner). ----
+
+class _CCachedOp:
+    """Inputs are positional in ``symbol.list_inputs()`` order."""
+
+    def __init__(self, sym, flags):
+        self.sym = sym
+        self.flags = dict(flags)        # static_alloc etc.: jit subsumes
+        self.input_names = list(sym.list_inputs())
+        self._aux_names = set(sym.list_auxiliary_states())
+        self._cache = {}                # (shapes, dtypes) -> Executor
+
+    def invoke(self, inputs):
+        from . import autograd
+        if len(inputs) != len(self.input_names):
+            raise MXNetError(
+                "CachedOp expects %d inputs (%s), got %d"
+                % (len(self.input_names), ", ".join(self.input_names),
+                   len(inputs)))
+        feed = dict(zip(self.input_names, inputs))
+        if autograd.is_recording():
+            # eager per-op run: outputs land on the global tape so
+            # MXTPUAutogradBackward works (ref MXInvokeCachedOpEx records
+            # when Imperative::is_recording, c_api_ndarray.cc)
+            return tuple(self.sym._execute(
+                feed, is_train=autograd.is_training()))
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in inputs)
+        ex = self._cache.get(sig)
+        args = {n: v for n, v in feed.items() if n not in self._aux_names}
+        aux = {n: v for n, v in feed.items() if n in self._aux_names}
+        if ex is None:
+            ex = self.sym.bind(None, args, aux_states=aux, grad_req="null")
+            self._cache[sig] = ex
+        else:
+            for n, v in aux.items():  # refresh aux on a cache hit
+                ex.aux_dict[n]._set_data(v._data)
+        return tuple(ex.forward(is_train=False, **args))
+
+
+def cached_op_create(sym, flag_keys: tuple, flag_vals: tuple):
+    return _CCachedOp(sym, zip(flag_keys, flag_vals))
+
+
+def cached_op_invoke(op: _CCachedOp, inputs: tuple) -> tuple:
+    return op.invoke(list(inputs))
+
+
+# ---- NDArray breadth (ref: MXNDArrayCreateNone / At / Detach /
+# WaitToRead / WaitToWrite / GetStorageType / SaveRawBytes /
+# LoadFromRawBytes / LoadFromBuffer / SyncCopyFromNDArray /
+# SyncCheckFormat / CreateSparseEx / GetAux* / GetDataNDArray) ----
+
+def ndarray_create_none() -> NDArray:
+    # the reference's deferred-alloc placeholder; here a 0-d f32 zero that
+    # SyncCopyFromCPU / op outputs may later replace
+    return nd.zeros(())
+
+
+def ndarray_at(handle: NDArray, idx: int) -> NDArray:
+    return handle[int(idx)]
+
+
+def ndarray_detach(handle: NDArray) -> NDArray:
+    return handle.detach()
+
+
+def ndarray_wait_to_read(handle: NDArray) -> None:
+    handle.wait_to_read()
+
+
+def ndarray_wait_to_write(handle: NDArray) -> None:
+    # one PJRT stream: readiness-to-write == readiness-to-read (the
+    # reference separates them because its engine queues reads/writes
+    # independently, threaded_engine.h:115)
+    handle.wait_to_read()
+
+
+_STYPE_FLAGS = {"default": 0, "row_sparse": 1, "csr": 2}  # ndarray.h:61
+_STYPE_NAMES = {v: k for k, v in _STYPE_FLAGS.items()}
+
+
+def ndarray_storage_type(handle) -> int:
+    return _STYPE_FLAGS[getattr(handle, "stype", "default")]
+
+
+def ndarray_save_raw_bytes(handle) -> bytes:
+    """One NDArray as a single V2 record (ref MXNDArraySaveRawBytes —
+    the chunk format without the 0x112 list header)."""
+    from .ndarray import mxnet_format
+    out = []
+    if getattr(handle, "stype", "default") == "default":
+        mxnet_format._write_dense(out, handle.asnumpy())
+    else:
+        raise MXNetError("save_raw_bytes: sparse handles unsupported; use "
+                         "MXTPUNDArraySave")
+    return b"".join(out)
+
+
+def ndarray_load_from_raw_bytes(data: bytes):
+    from .ndarray import mxnet_format
+    r = mxnet_format._Reader(data)
+    stype, payload = mxnet_format._read_ndarray(r)
+    if stype != "default":
+        raise MXNetError("load_from_raw_bytes: sparse record; use "
+                         "MXTPUNDArrayLoad")
+    return nd.array(payload)
+
+
+def ndarray_load_from_buffer(data: bytes):
+    """A whole .params file image from memory (ref MXNDArrayLoadFromBuffer;
+    parsed in place — no filesystem round-trip)."""
+    import struct
+    from .ndarray import mxnet_format
+    from .ndarray.utils import _load_mxnet
+    if struct.unpack("<Q", data[:8].ljust(8, b"\0"))[0] == \
+            mxnet_format.LIST_MAGIC:
+        out = _load_mxnet(data)
+        if isinstance(out, dict):
+            return tuple(out.values()), tuple(out.keys())
+        return tuple(out), ()
+    # native MXTPU001 images are file-addressed; go through a temp file
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".params", delete=False) as f:
+        f.write(data)
+        path = f.name
+    try:
+        return ndarray_load(path)
+    finally:
+        os.unlink(path)
+
+
+def ndarray_sync_copy_from_ndarray(dst: NDArray, src: NDArray) -> None:
+    if tuple(dst.shape) != tuple(src.shape):
+        raise MXNetError("SyncCopyFromNDArray: shape mismatch %s vs %s"
+                         % (tuple(dst.shape), tuple(src.shape)))
+    dst._set_data(jnp.asarray(src._data, dtype=dst._data.dtype))
+
+
+def ndarray_sync_check_format(handle, full_check: int) -> None:
+    if hasattr(handle, "check_format"):
+        handle.check_format(full_check=bool(full_check))
+
+
+def ndarray_create_sparse(stype_flag: int, data: NDArray,
+                          aux: tuple, shape: tuple):
+    from .ndarray import sparse as sp
+    stype = _STYPE_NAMES.get(int(stype_flag))
+    shape = tuple(int(s) for s in shape)
+    if stype == "row_sparse":
+        (indices,) = aux
+        return sp.row_sparse_array((data, indices), shape=shape)
+    if stype == "csr":
+        indptr, indices = aux
+        return sp.csr_matrix((data, indices, indptr), shape=shape)
+    raise MXNetError("CreateSparseEx: unsupported stype flag %d" % stype_flag)
+
+
+def ndarray_get_data_ndarray(handle) -> NDArray:
+    if not hasattr(handle, "data"):
+        raise MXNetError("GetDataNDArray: dense array has no data blob")
+    return handle.data
+
+
+def ndarray_get_aux_ndarray(handle, i: int) -> NDArray:
+    names = (["indices"] if getattr(handle, "stype", None) == "row_sparse"
+             else ["indptr", "indices"])
+    if not hasattr(handle, "_aux") or i >= len(names):
+        raise MXNetError("GetAuxNDArray: no aux %d" % i)
+    return getattr(handle, names[i])
+
+
+def ndarray_get_aux_type(handle, i: int) -> int:
+    return ndarray_dtype_flag(ndarray_get_aux_ndarray(handle, i))
+
+
+# ---- Symbol breadth (ref: MXSymbolCreateAtomicSymbol / CreateGroup /
+# GetInternals / GetOutput / GetNumOutputs / GetName / GetChildren /
+# InferType / InferShapePartial / ListAtomicSymbolCreators / Print) ----
+
+def symbol_create_atomic(op_name: str, attrs: dict):
+    """Uncomposed atomic symbol: compose with no inputs — argument
+    variables are auto-created at compose time like the reference's
+    nnvm lazy compose (c_api_symbolic.cc MXSymbolCreateAtomicSymbol)."""
+    return symbol_invoke(op_name, attrs, "", ())
+
+
+def symbol_create_group(syms: tuple):
+    from .symbol import Group
+    return Group(list(syms))
+
+
+def symbol_get_internals(sym):
+    return sym.get_internals()
+
+
+def symbol_get_output(sym, index: int):
+    return sym[int(index)]
+
+
+def symbol_get_num_outputs(sym) -> int:
+    return len(sym.list_outputs())
+
+
+def symbol_get_name(sym) -> tuple:
+    n = sym.name
+    return (1, n) if n is not None else (0, "")
+
+
+def symbol_get_children(sym):
+    """Direct-input symbol group (ref MXSymbolGetChildren)."""
+    from .symbol.symbol import Symbol
+    kids = []
+    seen = set()
+    for node, _ in sym._heads:
+        for child in getattr(node, "inputs", ()):  # (node, idx) pairs
+            cn = child[0] if isinstance(child, tuple) else child
+            if id(cn) not in seen:
+                seen.add(id(cn))
+                kids.append((cn, 0))
+    return Symbol(kids)
+
+
+def symbol_infer_type(sym, names: tuple, dtype_flags: tuple) -> tuple:
+    """Unknowable slots are -1 (jax abstract-eval needs shapes to type
+    nodes, symbol.py:_infer — hinted arguments always report their hint,
+    so shape-less partial inference still answers for the inputs)."""
+    hints = {n: _DTYPE_FLAGS[int(f)] for n, f in zip(names, dtype_flags)}
+    args, outs, auxs = sym.infer_type(**hints)
+    def _flags(lst):
+        return [_FLAGS_BY_NAME.get(str(t), -1) if t is not None else -1
+                for t in (lst or [])]
+    arg_flags = _flags(args)
+    arg_names = sym.list_arguments()
+    if len(arg_flags) < len(arg_names):
+        arg_flags += [-1] * (len(arg_names) - len(arg_flags))
+    for i, n in enumerate(arg_names):
+        if arg_flags[i] == -1 and n in hints:
+            arg_flags[i] = _FLAGS_BY_NAME[hints[n]]
+    return tuple(arg_flags), tuple(_flags(outs)), tuple(_flags(auxs))
+
+
+def symbol_infer_shape_partial(sym, names: tuple, shapes: tuple) -> tuple:
+    """Tolerant inference: unknown shapes come back () instead of raising
+    (ref MXSymbolInferShapePartial). The out tuple always has one entry
+    per symbol output so C callers can iterate positionally."""
+    try:
+        return symbol_infer_shape(sym, names, shapes)
+    except Exception:
+        known = {n: tuple(s) for n, s in zip(names, shapes)}
+        args = tuple(known.get(n, ()) for n in sym.list_arguments())
+        outs = tuple(() for _ in sym.list_outputs())
+        return args, outs, ()
+
+
+def symbol_list_atomic_creators() -> tuple:
+    return list_all_op_names()
+
+
+def symbol_print(sym) -> str:
+    lines = ["Symbol Outputs:"]
+    for o in sym.list_outputs():
+        lines.append("\toutput[%s]" % o)
+    for n in sym.list_arguments():
+        lines.append("Variable:%s" % n)
+    return "\n".join(lines)
+
+
+# ---- Executor breadth (ref: MXExecutorSimpleBind / Reshape / Print) ----
+
+def executor_simple_bind(sym, names: tuple, shapes: tuple, grad_req: str):
+    from .symbol.executor import Executor
+    hints = {n: tuple(int(d) for d in s) for n, s in zip(names, shapes)}
+    return Executor.simple_bind(sym, grad_req=grad_req or "write", **hints)
+
+
+def executor_reshape(ex, names: tuple, shapes: tuple):
+    hints = {n: tuple(int(d) for d in s) for n, s in zip(names, shapes)}
+    return ex.reshape(**hints)
+
+
+def executor_print(ex) -> str:
+    lines = ["Executor:"]
+    for k, v in ex.arg_dict.items():
+        lines.append("  arg %s %s %s" % (k, tuple(v.shape), v.dtype))
+    for i, o in enumerate(ex.outputs or ()):
+        lines.append("  out[%d] %s %s" % (i, tuple(o.shape), o.dtype))
+    return "\n".join(lines)
+
+
+# ---- KVStore breadth (ref: MXKVStoreGetType / SetUpdater /
+# SetGradientCompression / PullRowSparse / GetNumDeadNode /
+# IsWorkerNode / IsServerNode / IsSchedulerNode) ----
+
+def kvstore_get_type(kv) -> str:
+    return str(kv.type)
+
+
+def kvstore_set_updater(kv, pyfun) -> None:
+    """pyfun(key: str, recv: NDArray, local: NDArray) — the C layer wraps
+    the user's function pointer; local is updated in place."""
+    kv.set_updater(pyfun)
+
+
+def kvstore_set_gradient_compression(kv, keys: tuple, vals: tuple) -> None:
+    kv.set_gradient_compression(
+        {k: _parse_attr(v) for k, v in zip(keys, vals)})
+
+
+def kvstore_pull_row_sparse(kv, keys: tuple, outs: tuple, row_ids: tuple,
+                            priority: int) -> None:
+    kv.row_sparse_pull(list(keys), out=list(outs), priority=priority,
+                       row_ids=list(row_ids))
+
+
+def kvstore_get_num_dead_node(kv, node_id: int) -> int:
+    return int(kv.get_num_dead_node(node_id))
+
+
+def kvstore_is_worker_node() -> int:
+    # symmetric-worker design: every process is a worker (the reference's
+    # role env DMLC_ROLE decides; servers were ADR'd out, kvstore.py:272)
+    return int(os.environ.get("DMLC_ROLE", "worker") == "worker")
+
+
+def kvstore_is_server_node() -> int:
+    return int(os.environ.get("DMLC_ROLE", "worker") == "server")
+
+
+def kvstore_is_scheduler_node() -> int:
+    return int(os.environ.get("DMLC_ROLE", "worker") == "scheduler")
+
+
+# ---- profiler (ref: MXSetProfilerConfig / MXSetProfilerState /
+# MXDumpProfile / MXProfilePause, src/c_api/c_api_profile.cc) ----
+
+def profiler_set_config(keys: tuple, vals: tuple) -> None:
+    from . import profiler
+    kw = {}
+    for k, v in zip(keys, vals):
+        k = {"file_name": "filename", "filename": "filename",
+             "profile_all": "profile_all"}.get(k, k)
+        kw[k] = _parse_attr(v)
+    profiler.set_config(**kw)
+
+
+def profiler_set_state(state: int) -> None:
+    from . import profiler
+    if state:
+        profiler.start()
+    else:
+        profiler.stop()
+
+
+def profiler_dump(finished: int) -> None:
+    from . import profiler
+    profiler.dump(finished=bool(finished))
+
+
+def profiler_pause(paused: int) -> None:
+    from . import profiler
+    if paused:
+        profiler.pause()
+    else:
+        profiler.resume()
+
+
+# ---- misc breadth (ref: MXGetGPUCount / MXGetGPUMemoryInformation64 /
+# MXNotifyShutdown / MXEngineSetBulkSize / MXSetNumOMPThreads /
+# MXRandomSeedContext / MXDataIterGetIterInfo) ----
+
+def get_device_count() -> int:
+    import jax
+    return len(jax.devices())
+
+
+def get_memory_information(dev_id: int) -> tuple:
+    """(free, total) bytes for the device (ref MXGetGPUMemoryInformation64;
+    here PJRT memory stats — absent stats raise, they don't guess)."""
+    import jax
+    devs = jax.devices()
+    if dev_id >= len(devs):
+        raise MXNetError("no device %d (have %d)" % (dev_id, len(devs)))
+    stats = devs[dev_id].memory_stats()
+    if not stats or "bytes_limit" not in stats:
+        raise MXNetError("device %d exposes no memory stats" % dev_id)
+    total = int(stats["bytes_limit"])
+    used = int(stats.get("bytes_in_use", 0))
+    return total - used, total
+
+
+def notify_shutdown() -> None:
+    # the reference tears its engine down (MXNotifyShutdown); PJRT clients
+    # shut down at process exit — flush pending work so exit is clean
+    ndarray_wait_all()
+
+
+def engine_set_bulk_size(size: int) -> int:
+    from . import engine
+    prev = engine.set_bulk_size(int(size))
+    return int(prev)
+
+
+def set_num_omp_threads(n: int) -> None:
+    # XLA:CPU fixes its thread pool at backend init; honor the call as the
+    # documented no-op the engine module explains (engine.py bulk ADR)
+    return None
+
+
+def random_seed_context(seed: int, dev_type: int, dev_id: int) -> None:
+    # one functional PRNG stream regardless of device (random.py design)
+    random_seed(seed)
+
+
+def data_iter_get_iter_info(name: str) -> tuple:
+    cls = _data_iter_registry().get(name)
+    if cls is None:
+        raise MXNetError("unknown data iter %r" % name)
+    doc = (cls.__doc__ or "").strip().split("\n")[0]
+    return name, doc

@@ -474,17 +474,16 @@ int MXTPUNDArraySave(const char *fname, int num, NDArrayHandle *handles,
       Py_BuildValue("(sNN)", fname, HandleTuple(handles, num), names));
 }
 
-int MXTPUNDArrayLoad(const char *fname, int *out_num,
-                     NDArrayHandle **out_handles, int *out_num_names,
-                     const char ***out_names) {
-  if (!EnsureInterpreter()) return -1;
-  GilScope gil;
-  PyObject *res = CallImpl("ndarray_load", Py_BuildValue("(s)", fname));
+namespace {
+/* Shared (arrays, names)-tuple unmarshalling for MXTPUNDArrayLoad and
+ * MXTPUNDArrayLoadFromBuffer. Both own the SAME private stores, so the
+ * documented lifetime is "until the next load-family call on this
+ * thread". Consumes `res`. */
+int LoadResultOut(PyObject *res, int *out_num, NDArrayHandle **out_handles,
+                  int *out_num_names, const char ***out_names) {
   if (res == nullptr) return -1;
   PyObject *arrays = PyTuple_GetItem(res, 0);
   PyObject *names = PyTuple_GetItem(res, 1);
-  // Load owns PRIVATE stores: sharing g_str_store with the Symbol calls
-  // would break both functions' documented name lifetimes
   static thread_local std::vector<void *> handle_store;
   static thread_local std::vector<std::string> name_store;
   static thread_local std::vector<const char *> name_ptrs;
@@ -507,6 +506,16 @@ int MXTPUNDArrayLoad(const char *fname, int *out_num,
   *out_num_names = static_cast<int>(name_ptrs.size());
   *out_names = name_ptrs.empty() ? nullptr : name_ptrs.data();
   return 0;
+}
+}  // namespace
+
+int MXTPUNDArrayLoad(const char *fname, int *out_num,
+                     NDArrayHandle **out_handles, int *out_num_names,
+                     const char ***out_names) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return LoadResultOut(CallImpl("ndarray_load", Py_BuildValue("(s)", fname)),
+                       out_num, out_handles, out_num_names, out_names);
 }
 
 int MXTPUAutogradSetRecording(int is_recording, int *prev) {
@@ -1200,6 +1209,769 @@ int MXTPUNDArrayGetContext(NDArrayHandle handle, const char **out) {
       CallImpl("ndarray_context",
                PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle))),
       out);
+}
+
+/* ---- autograd breadth ---- */
+
+namespace {
+int IntResult(PyObject *res, int *out) {
+  if (res == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+}  // namespace
+
+int MXTPUAutogradIsRecording(int *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return IntResult(CallImpl("autograd_is_recording", PyTuple_New(0)), out);
+}
+
+int MXTPUAutogradIsTraining(int *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return IntResult(CallImpl("autograd_is_training", PyTuple_New(0)), out);
+}
+
+int MXTPUAutogradMarkVariables(int num, NDArrayHandle *vars,
+                               const int *grad_reqs) {
+  GilScope gil;
+  PyObject *reqs = PyTuple_New(num);
+  for (int i = 0; i < num; ++i)
+    PyTuple_SetItem(reqs, i, PyLong_FromLong(grad_reqs[i]));
+  return CallNoResult(
+      "autograd_mark_variables",
+      Py_BuildValue("(NN)", HandleTuple(vars, num), reqs));
+}
+
+int MXTPUAutogradBackward(int num, NDArrayHandle *heads,
+                          NDArrayHandle *ograds, int retain_graph) {
+  GilScope gil;
+  PyObject *og = ograds == nullptr ? PyTuple_New(0)
+                                   : HandleTuple(ograds, num);
+  return CallNoResult(
+      "autograd_backward",
+      Py_BuildValue("(NNi)", HandleTuple(heads, num), og, retain_graph));
+}
+
+/* ---- CachedOp ---- */
+
+int MXTPUCreateCachedOp(SymbolHandle sym, int num_flags,
+                        const char **flag_keys, const char **flag_vals,
+                        CachedOpHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "cached_op_create",
+      Py_BuildValue("(ONN)", reinterpret_cast<PyObject *>(sym),
+                    StrTuple(flag_keys, num_flags),
+                    StrTuple(flag_vals, num_flags)),
+      out);
+}
+
+int MXTPUInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                        NDArrayHandle *inputs, int *num_outputs,
+                        NDArrayHandle *outputs) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "cached_op_invoke",
+      Py_BuildValue("(ON)", reinterpret_cast<PyObject *>(handle),
+                    HandleTuple(inputs, num_inputs)));
+  if (res == nullptr) return -1;
+  Py_ssize_t n = PyTuple_Size(res);
+  if (n > *num_outputs) {
+    Py_DECREF(res);
+    SetError("MXTPUInvokeCachedOp: output capacity too small");
+    return -1;
+  }
+  *num_outputs = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyTuple_GetItem(res, i);
+    Py_INCREF(o);
+    outputs[i] = o;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUFreeCachedOp(CachedOpHandle handle) { return FreeHandle(handle); }
+
+/* ---- NDArray breadth ---- */
+
+int MXTPUNDArrayCreateNone(NDArrayHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallToHandle("ndarray_create_none", PyTuple_New(0), out);
+}
+
+int MXTPUNDArrayAt(NDArrayHandle handle, int64_t idx, NDArrayHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "ndarray_at",
+      Py_BuildValue("(OL)", reinterpret_cast<PyObject *>(handle),
+                    static_cast<long long>(idx)),
+      out);
+}
+
+int MXTPUNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "ndarray_detach",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)), out);
+}
+
+int MXTPUNDArrayWaitToRead(NDArrayHandle handle) {
+  GilScope gil;
+  return CallNoResult(
+      "ndarray_wait_to_read",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+}
+
+int MXTPUNDArrayWaitToWrite(NDArrayHandle handle) {
+  GilScope gil;
+  return CallNoResult(
+      "ndarray_wait_to_write",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+}
+
+int MXTPUNDArrayGetStorageType(NDArrayHandle handle, int *out) {
+  GilScope gil;
+  return IntResult(
+      CallImpl("ndarray_storage_type",
+               PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle))),
+      out);
+}
+
+namespace {
+thread_local std::string g_raw_bytes_store;
+}  // namespace
+
+int MXTPUNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                             const char **out_buf) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "ndarray_save_raw_bytes",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+  if (res == nullptr) return -1;
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &len) != 0) {
+    SetErrorFromPython();
+    Py_DECREF(res);
+    return -1;
+  }
+  g_raw_bytes_store.assign(buf, static_cast<size_t>(len));
+  Py_DECREF(res);
+  *out_size = g_raw_bytes_store.size();
+  *out_buf = g_raw_bytes_store.data();
+  return 0;
+}
+
+int MXTPUNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                                 NDArrayHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(buf), static_cast<Py_ssize_t>(size));
+  return CallToHandle("ndarray_load_from_raw_bytes",
+                      Py_BuildValue("(N)", bytes), out);
+}
+
+int MXTPUNDArrayLoadFromBuffer(const void *buf, size_t size, int *out_num,
+                               NDArrayHandle **out_handles,
+                               int *out_num_names, const char ***out_names) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(buf), static_cast<Py_ssize_t>(size));
+  return LoadResultOut(CallImpl("ndarray_load_from_buffer",
+                                Py_BuildValue("(N)", bytes)),
+                       out_num, out_handles, out_num_names, out_names);
+}
+
+int MXTPUNDArraySyncCopyFromNDArray(NDArrayHandle dst, NDArrayHandle src) {
+  GilScope gil;
+  return CallNoResult(
+      "ndarray_sync_copy_from_ndarray",
+      PyTuple_Pack(2, reinterpret_cast<PyObject *>(dst),
+                   reinterpret_cast<PyObject *>(src)));
+}
+
+int MXTPUNDArraySyncCheckFormat(NDArrayHandle handle, int full_check) {
+  GilScope gil;
+  return CallNoResult(
+      "ndarray_sync_check_format",
+      Py_BuildValue("(Oi)", reinterpret_cast<PyObject *>(handle),
+                    full_check));
+}
+
+int MXTPUNDArrayCreateSparseEx(int stype, NDArrayHandle data, int num_aux,
+                               NDArrayHandle *aux, const int64_t *shape,
+                               int ndim, NDArrayHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "ndarray_create_sparse",
+      Py_BuildValue("(iONN)", stype, reinterpret_cast<PyObject *>(data),
+                    HandleTuple(aux, num_aux), ShapeTuple(shape, ndim)),
+      out);
+}
+
+int MXTPUNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "ndarray_get_data_ndarray",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)), out);
+}
+
+int MXTPUNDArrayGetAuxNDArray(NDArrayHandle handle, int i,
+                              NDArrayHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "ndarray_get_aux_ndarray",
+      Py_BuildValue("(Oi)", reinterpret_cast<PyObject *>(handle), i), out);
+}
+
+int MXTPUNDArrayGetAuxType(NDArrayHandle handle, int i, int *out_flag) {
+  GilScope gil;
+  return IntResult(
+      CallImpl("ndarray_get_aux_type",
+               Py_BuildValue("(Oi)", reinterpret_cast<PyObject *>(handle),
+                             i)),
+      out_flag);
+}
+
+/* ---- Symbol breadth II ---- */
+
+int MXTPUSymbolCreateAtomicSymbol(const char *op_name, int num_attrs,
+                                  const char **attr_keys,
+                                  const char **attr_vals, SymbolHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallToHandle(
+      "symbol_create_atomic",
+      Py_BuildValue("(sN)", op_name,
+                    AttrDict(attr_keys, attr_vals, num_attrs)),
+      out);
+}
+
+int MXTPUSymbolCreateGroup(int num, SymbolHandle *syms, SymbolHandle *out) {
+  GilScope gil;
+  return CallToHandle("symbol_create_group",
+                      Py_BuildValue("(N)", HandleTuple(syms, num)), out);
+}
+
+int MXTPUSymbolGetInternals(SymbolHandle handle, SymbolHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "symbol_get_internals",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)), out);
+}
+
+int MXTPUSymbolGetOutput(SymbolHandle handle, int index, SymbolHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "symbol_get_output",
+      Py_BuildValue("(Oi)", reinterpret_cast<PyObject *>(handle), index),
+      out);
+}
+
+int MXTPUSymbolGetNumOutputs(SymbolHandle handle, int *out) {
+  GilScope gil;
+  return IntResult(
+      CallImpl("symbol_get_num_outputs",
+               PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle))),
+      out);
+}
+
+int MXTPUSymbolGetName(SymbolHandle handle, const char **out, int *success) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "symbol_get_name",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+  if (res == nullptr) return -1;
+  *success = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 0)));
+  const char *c = PyUnicode_AsUTF8(PyTuple_GetItem(res, 1));
+  g_attr_buf = c == nullptr ? "" : c;
+  Py_DECREF(res);
+  *out = g_attr_buf.c_str();
+  return 0;
+}
+
+int MXTPUSymbolGetChildren(SymbolHandle handle, SymbolHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "symbol_get_children",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)), out);
+}
+
+namespace {
+thread_local std::vector<int> g_type_args, g_type_outs, g_type_auxs;
+
+void FillFlags(PyObject *t, std::vector<int> *dst) {
+  dst->clear();
+  for (Py_ssize_t i = 0; i < PyTuple_Size(t); ++i)
+    dst->push_back(static_cast<int>(PyLong_AsLong(PyTuple_GetItem(t, i))));
+}
+}  // namespace
+
+int MXTPUSymbolInferType(SymbolHandle handle, int num_args,
+                         const char **arg_names, const int *arg_type_flags,
+                         int *out_arg_num, const int **out_arg_flags,
+                         int *out_out_num, const int **out_out_flags,
+                         int *out_aux_num, const int **out_aux_flags) {
+  GilScope gil;
+  PyObject *flags = PyTuple_New(num_args);
+  for (int i = 0; i < num_args; ++i)
+    PyTuple_SetItem(flags, i, PyLong_FromLong(arg_type_flags[i]));
+  PyObject *res = CallImpl(
+      "symbol_infer_type",
+      Py_BuildValue("(ONN)", reinterpret_cast<PyObject *>(handle),
+                    StrTuple(arg_names, num_args), flags));
+  if (res == nullptr) return -1;
+  FillFlags(PyTuple_GetItem(res, 0), &g_type_args);
+  FillFlags(PyTuple_GetItem(res, 1), &g_type_outs);
+  FillFlags(PyTuple_GetItem(res, 2), &g_type_auxs);
+  Py_DECREF(res);
+  *out_arg_num = static_cast<int>(g_type_args.size());
+  *out_arg_flags = g_type_args.data();
+  *out_out_num = static_cast<int>(g_type_outs.size());
+  *out_out_flags = g_type_outs.data();
+  *out_aux_num = static_cast<int>(g_type_auxs.size());
+  *out_aux_flags = g_type_auxs.data();
+  return 0;
+}
+
+namespace {
+thread_local std::vector<int64_t> g_partial_shape_flat;
+
+PyObject *PackShapes(int num, const char **names, const int64_t *shape_data,
+                     const int *shape_ndim, PyObject **out_names) {
+  *out_names = StrTuple(names, num);
+  PyObject *shapes = PyTuple_New(num);
+  int off = 0;
+  for (int i = 0; i < num; ++i) {
+    PyObject *shp = PyTuple_New(shape_ndim[i]);
+    for (int d = 0; d < shape_ndim[i]; ++d)
+      PyTuple_SetItem(shp, d, PyLong_FromLongLong(shape_data[off + d]));
+    off += shape_ndim[i];
+    PyTuple_SetItem(shapes, i, shp);
+  }
+  return shapes;
+}
+}  // namespace
+
+int MXTPUSymbolInferShapePartial(SymbolHandle handle, int num_args,
+                                 const char **arg_names,
+                                 const int64_t *arg_shape_data,
+                                 const int *arg_shape_ndim, int *out_num,
+                                 const int64_t **out_flat) {
+  GilScope gil;
+  PyObject *names = nullptr;
+  PyObject *shapes = PackShapes(num_args, arg_names, arg_shape_data,
+                                arg_shape_ndim, &names);
+  PyObject *res = CallImpl(
+      "symbol_infer_shape_partial",
+      Py_BuildValue("(ONN)", reinterpret_cast<PyObject *>(handle), names,
+                    shapes));
+  if (res == nullptr) return -1;
+  PyObject *outs = PyTuple_GetItem(res, 1);
+  g_partial_shape_flat.clear();
+  int n = static_cast<int>(PyTuple_Size(outs));
+  for (int i = 0; i < n; ++i) {
+    PyObject *shp = PyTuple_GetItem(outs, i);
+    g_partial_shape_flat.push_back(static_cast<int64_t>(PyTuple_Size(shp)));
+    for (Py_ssize_t d = 0; d < PyTuple_Size(shp); ++d)
+      g_partial_shape_flat.push_back(
+          PyLong_AsLongLong(PyTuple_GetItem(shp, d)));
+  }
+  Py_DECREF(res);
+  *out_num = n;
+  *out_flat = g_partial_shape_flat.data();
+  return 0;
+}
+
+int MXTPUSymbolListAtomicSymbolCreators(int *out_num,
+                                        const char ***out_names) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return StrListResult(
+      CallImpl("symbol_list_atomic_creators", PyTuple_New(0)), out_num,
+      out_names);
+}
+
+int MXTPUSymbolPrint(SymbolHandle handle, const char **out) {
+  GilScope gil;
+  return StringResult(
+      CallImpl("symbol_print",
+               PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle))),
+      out);
+}
+
+int MXTPUSymbolSaveToJSON(SymbolHandle handle, const char **out_json) {
+  return MXTPUSymbolToJSON(handle, out_json);
+}
+
+/* ---- Executor breadth ---- */
+
+int MXTPUExecutorSimpleBind(SymbolHandle sym, int num_inputs,
+                            const char **input_names,
+                            const int64_t *shape_data, const int *shape_ndim,
+                            const char *grad_req, ExecutorHandle *out) {
+  GilScope gil;
+  PyObject *names = nullptr;
+  PyObject *shapes = PackShapes(num_inputs, input_names, shape_data,
+                                shape_ndim, &names);
+  return CallToHandle(
+      "executor_simple_bind",
+      Py_BuildValue("(ONNs)", reinterpret_cast<PyObject *>(sym), names,
+                    shapes, grad_req == nullptr ? "write" : grad_req),
+      out);
+}
+
+int MXTPUExecutorReshape(ExecutorHandle handle, int num_inputs,
+                         const char **input_names, const int64_t *shape_data,
+                         const int *shape_ndim, ExecutorHandle *out) {
+  GilScope gil;
+  PyObject *names = nullptr;
+  PyObject *shapes = PackShapes(num_inputs, input_names, shape_data,
+                                shape_ndim, &names);
+  return CallToHandle(
+      "executor_reshape",
+      Py_BuildValue("(ONN)", reinterpret_cast<PyObject *>(handle), names,
+                    shapes),
+      out);
+}
+
+int MXTPUExecutorPrint(ExecutorHandle handle, const char **out) {
+  GilScope gil;
+  return StringResult(
+      CallImpl("executor_print",
+               PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle))),
+      out);
+}
+
+int MXTPUExecutorOutputs(ExecutorHandle handle, int *num,
+                         NDArrayHandle *outs) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "executor_outputs",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+  if (res == nullptr) return -1;
+  Py_ssize_t n = PyTuple_Size(res);
+  if (n > *num) {
+    Py_DECREF(res);
+    SetError("MXTPUExecutorOutputs: capacity too small");
+    return -1;
+  }
+  *num = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyTuple_GetItem(res, i);
+    Py_INCREF(o);
+    outs[i] = o;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---- KVStore breadth II ---- */
+
+int MXTPUKVStoreGetType(KVStoreHandle handle, const char **out) {
+  GilScope gil;
+  return StringResult(
+      CallImpl("kvstore_get_type",
+               PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle))),
+      out);
+}
+
+namespace {
+struct UpdaterCtx {
+  MXTPUKVStoreUpdater fn;
+  void *ctx;
+};
+
+PyObject *UpdaterTrampoline(PyObject *self, PyObject *args) {
+  auto *uc = static_cast<UpdaterCtx *>(
+      PyCapsule_GetPointer(self, "mxtpu.updater"));
+  PyObject *keyobj = nullptr, *recv = nullptr, *local = nullptr;
+  if (!PyArg_ParseTuple(args, "OOO", &keyobj, &recv, &local)) return nullptr;
+  /* kvstore.py passes int-convertible keys as int and keeps named keys
+   * as str — an int-key C updater cannot receive "fc1_weight" */
+  long key = 0;
+  if (PyLong_Check(keyobj)) {
+    key = PyLong_AsLong(keyobj);
+  } else {
+    PyObject *as_int = PyNumber_Long(keyobj);
+    if (as_int == nullptr) {
+      PyErr_Clear();
+      PyErr_Format(PyExc_TypeError,
+                   "non-numeric kvstore key %R reached the int-key "
+                   "updater; register MXTPUKVStoreSetUpdaterEx for "
+                   "string keys",
+                   keyobj);
+      return nullptr;
+    }
+    key = PyLong_AsLong(as_int);
+    Py_DECREF(as_int);
+  }
+  if (uc != nullptr && uc->fn != nullptr) {
+    /* recv/local are BORROWED handles, valid for this call only */
+    uc->fn(static_cast<int>(key), static_cast<void *>(recv),
+           static_cast<void *>(local), uc->ctx);
+  }
+  Py_RETURN_NONE;
+}
+
+struct StrUpdaterCtx {
+  MXTPUKVStoreStrUpdater fn;
+  void *ctx;
+};
+
+PyObject *StrUpdaterTrampoline(PyObject *self, PyObject *args) {
+  auto *uc = static_cast<StrUpdaterCtx *>(
+      PyCapsule_GetPointer(self, "mxtpu.str_updater"));
+  PyObject *keyobj = nullptr, *recv = nullptr, *local = nullptr;
+  if (!PyArg_ParseTuple(args, "OOO", &keyobj, &recv, &local)) return nullptr;
+  PyObject *keystr = PyObject_Str(keyobj);
+  if (keystr == nullptr) return nullptr;
+  const char *key = PyUnicode_AsUTF8(keystr);
+  if (uc != nullptr && uc->fn != nullptr && key != nullptr) {
+    uc->fn(key, static_cast<void *>(recv), static_cast<void *>(local),
+           uc->ctx);
+  }
+  Py_DECREF(keystr);
+  Py_RETURN_NONE;
+}
+
+void UpdaterCapsuleDestruct(PyObject *capsule) {
+  delete static_cast<UpdaterCtx *>(
+      PyCapsule_GetPointer(capsule, "mxtpu.updater"));
+}
+
+void StrUpdaterCapsuleDestruct(PyObject *capsule) {
+  delete static_cast<StrUpdaterCtx *>(
+      PyCapsule_GetPointer(capsule, "mxtpu.str_updater"));
+}
+
+PyMethodDef g_updater_def = {"_mxtpu_updater", UpdaterTrampoline,
+                             METH_VARARGS, nullptr};
+PyMethodDef g_str_updater_def = {"_mxtpu_str_updater", StrUpdaterTrampoline,
+                                 METH_VARARGS, nullptr};
+}  // namespace
+
+int MXTPUKVStoreSetUpdater(KVStoreHandle handle, MXTPUKVStoreUpdater updater,
+                           void *ctx) {
+  GilScope gil;
+  auto *uc = new UpdaterCtx{updater, ctx};
+  PyObject *capsule =
+      PyCapsule_New(uc, "mxtpu.updater", UpdaterCapsuleDestruct);
+  if (capsule == nullptr) {
+    delete uc;
+    SetErrorFromPython();
+    return -1;
+  }
+  PyObject *pyfun = PyCFunction_New(&g_updater_def, capsule);
+  Py_DECREF(capsule);
+  if (pyfun == nullptr) {
+    SetErrorFromPython();
+    return -1;
+  }
+  return CallNoResult(
+      "kvstore_set_updater",
+      Py_BuildValue("(ON)", reinterpret_cast<PyObject *>(handle), pyfun));
+}
+
+int MXTPUKVStoreSetUpdaterEx(KVStoreHandle handle,
+                             MXTPUKVStoreStrUpdater updater, void *ctx) {
+  GilScope gil;
+  auto *uc = new StrUpdaterCtx{updater, ctx};
+  PyObject *capsule =
+      PyCapsule_New(uc, "mxtpu.str_updater", StrUpdaterCapsuleDestruct);
+  if (capsule == nullptr) {
+    delete uc;
+    SetErrorFromPython();
+    return -1;
+  }
+  PyObject *pyfun = PyCFunction_New(&g_str_updater_def, capsule);
+  Py_DECREF(capsule);
+  if (pyfun == nullptr) {
+    SetErrorFromPython();
+    return -1;
+  }
+  return CallNoResult(
+      "kvstore_set_updater",
+      Py_BuildValue("(ON)", reinterpret_cast<PyObject *>(handle), pyfun));
+}
+
+int MXTPUKVStoreSetGradientCompression(KVStoreHandle handle, int num,
+                                       const char **keys,
+                                       const char **vals) {
+  GilScope gil;
+  return CallNoResult(
+      "kvstore_set_gradient_compression",
+      Py_BuildValue("(ONN)", reinterpret_cast<PyObject *>(handle),
+                    StrTuple(keys, num), StrTuple(vals, num)));
+}
+
+int MXTPUKVStorePullRowSparse(KVStoreHandle handle, int num,
+                              const char **keys, NDArrayHandle *outs,
+                              NDArrayHandle *row_ids, int priority) {
+  GilScope gil;
+  return CallNoResult(
+      "kvstore_pull_row_sparse",
+      Py_BuildValue("(ONNNi)", reinterpret_cast<PyObject *>(handle),
+                    StrTuple(keys, num), HandleTuple(outs, num),
+                    HandleTuple(row_ids, num), priority));
+}
+
+int MXTPUKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id, int *out) {
+  GilScope gil;
+  return IntResult(
+      CallImpl("kvstore_get_num_dead_node",
+               Py_BuildValue("(Oi)", reinterpret_cast<PyObject *>(handle),
+                             node_id)),
+      out);
+}
+
+int MXTPUKVStoreIsWorkerNode(int *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return IntResult(CallImpl("kvstore_is_worker_node", PyTuple_New(0)), out);
+}
+
+int MXTPUKVStoreIsServerNode(int *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return IntResult(CallImpl("kvstore_is_server_node", PyTuple_New(0)), out);
+}
+
+int MXTPUKVStoreIsSchedulerNode(int *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return IntResult(CallImpl("kvstore_is_scheduler_node", PyTuple_New(0)),
+                   out);
+}
+
+/* ---- profiler ---- */
+
+int MXTPUSetProfilerConfig(int num, const char **keys, const char **vals) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallNoResult(
+      "profiler_set_config",
+      Py_BuildValue("(NN)", StrTuple(keys, num), StrTuple(vals, num)));
+}
+
+int MXTPUSetProfilerState(int state) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallNoResult("profiler_set_state", Py_BuildValue("(i)", state));
+}
+
+int MXTPUDumpProfile(int finished) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallNoResult("profiler_dump", Py_BuildValue("(i)", finished));
+}
+
+int MXTPUProfilePause(int paused) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallNoResult("profiler_pause", Py_BuildValue("(i)", paused));
+}
+
+/* ---- runtime/introspection breadth ---- */
+
+int MXTPUGetDeviceCount(int *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return IntResult(CallImpl("get_device_count", PyTuple_New(0)), out);
+}
+
+int MXTPUGetMemoryInformation(int dev_id, uint64_t *free_bytes,
+                              uint64_t *total_bytes) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  PyObject *res = CallImpl("get_memory_information",
+                           Py_BuildValue("(i)", dev_id));
+  if (res == nullptr) return -1;
+  *free_bytes = PyLong_AsUnsignedLongLong(PyTuple_GetItem(res, 0));
+  *total_bytes = PyLong_AsUnsignedLongLong(PyTuple_GetItem(res, 1));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNotifyShutdown(void) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallNoResult("notify_shutdown", PyTuple_New(0));
+}
+
+int MXTPUEngineSetBulkSize(int size, int *prev) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  PyObject *res = CallImpl("engine_set_bulk_size",
+                           Py_BuildValue("(i)", size));
+  if (res == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUSetNumOMPThreads(int num) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallNoResult("set_num_omp_threads", Py_BuildValue("(i)", num));
+}
+
+int MXTPURandomSeedContext(int seed, int dev_type, int dev_id) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallNoResult("random_seed_context",
+                      Py_BuildValue("(iii)", seed, dev_type, dev_id));
+}
+
+/* ---- DataIter breadth ---- */
+
+namespace {
+thread_local std::vector<uint64_t> g_iter_index_store;
+}  // namespace
+
+int MXTPUDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                          uint64_t *out_size) {
+  GilScope gil;
+  PyObject *res = CallImpl(
+      "data_iter_get_index",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+  if (res == nullptr) return -1;
+  g_iter_index_store.clear();
+  for (Py_ssize_t i = 0; i < PyTuple_Size(res); ++i)
+    g_iter_index_store.push_back(
+        PyLong_AsUnsignedLongLong(PyTuple_GetItem(res, i)));
+  Py_DECREF(res);
+  *out_size = g_iter_index_store.size();
+  *out_index = g_iter_index_store.data();
+  return 0;
+}
+
+namespace {
+thread_local std::string g_iter_info_name, g_iter_info_desc;
+}  // namespace
+
+int MXTPUDataIterGetIterInfo(const char *name, const char **out_name,
+                             const char **out_desc) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  PyObject *res = CallImpl("data_iter_get_iter_info",
+                           Py_BuildValue("(s)", name));
+  if (res == nullptr) return -1;
+  const char *n = PyUnicode_AsUTF8(PyTuple_GetItem(res, 0));
+  const char *d = PyUnicode_AsUTF8(PyTuple_GetItem(res, 1));
+  g_iter_info_name = n == nullptr ? "" : n;
+  g_iter_info_desc = d == nullptr ? "" : d;
+  Py_DECREF(res);
+  *out_name = g_iter_info_name.c_str();
+  *out_desc = g_iter_info_desc.c_str();
+  return 0;
 }
 
 }  // extern "C"
