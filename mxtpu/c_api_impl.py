@@ -565,8 +565,13 @@ def autograd_mark_variables(variables: tuple, grad_reqs: tuple) -> None:
 
 
 def autograd_backward(heads: tuple, ograds: tuple, retain_graph: int) -> None:
+    """ograds may be empty (all ones-like seeds) or per-head entries where
+    None means a ones-like seed for that head (ref MXAutogradBackwardEx
+    NULL-entry semantics)."""
     from . import autograd
     hg = list(ograds) if ograds else None
+    if hg is not None and all(g is None for g in hg):
+        hg = None
     autograd.backward(list(heads), head_grads=hg,
                       retain_graph=bool(retain_graph))
 
@@ -594,12 +599,20 @@ class _CCachedOp:
                 % (len(self.input_names), ", ".join(self.input_names),
                    len(inputs)))
         feed = dict(zip(self.input_names, inputs))
+        is_train = autograd.is_training()
         if autograd.is_recording():
             # eager per-op run: outputs land on the global tape so
             # MXTPUAutogradBackward works (ref MXInvokeCachedOpEx records
-            # when Imperative::is_recording, c_api_ndarray.cc)
-            return tuple(self.sym._execute(
-                feed, is_train=autograd.is_training()))
+            # when Imperative::is_recording, c_api_ndarray.cc). Train-mode
+            # BN aux updates write back into the CALLER's arrays (the
+            # reference mutates aux in-kernel, batch_norm.cc).
+            aux_updates = {} if is_train else None
+            outs = tuple(self.sym._execute(feed, is_train=is_train,
+                                           collect_aux=aux_updates))
+            if aux_updates:
+                for n, v in aux_updates.items():
+                    feed[n]._set_data(v._data.astype(feed[n]._data.dtype))
+            return outs
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in inputs)
         ex = self._cache.get(sig)
         args = {n: v for n, v in feed.items() if n not in self._aux_names}
@@ -610,7 +623,13 @@ class _CCachedOp:
         else:
             for n, v in aux.items():  # refresh aux on a cache hit
                 ex.aux_dict[n]._set_data(v._data)
-        return tuple(ex.forward(is_train=False, **args))
+        outs = tuple(ex.forward(is_train=is_train, **args))
+        if is_train:
+            # executor collected BN stat updates into its aux_dict;
+            # propagate them to the caller's arrays
+            for n, v in aux.items():
+                v._set_data(ex.aux_dict[n]._data.astype(v._data.dtype))
+        return outs
 
 
 def cached_op_create(sym, flag_keys: tuple, flag_vals: tuple):
@@ -783,16 +802,18 @@ def symbol_get_name(sym) -> tuple:
 
 
 def symbol_get_children(sym):
-    """Direct-input symbol group (ref MXSymbolGetChildren)."""
+    """Direct-input symbol group (ref MXSymbolGetChildren). Each input's
+    (node, output-index) pair is preserved — two distinct outputs of one
+    multi-output child are two children."""
     from .symbol.symbol import Symbol
     kids = []
     seen = set()
     for node, _ in sym._heads:
-        for child in getattr(node, "inputs", ()):  # (node, idx) pairs
-            cn = child[0] if isinstance(child, tuple) else child
-            if id(cn) not in seen:
-                seen.add(id(cn))
-                kids.append((cn, 0))
+        for cn, idx in getattr(node, "inputs", ()):  # (node, idx) pairs
+            key = (id(cn), idx)
+            if key not in seen:
+                seen.add(key)
+                kids.append((cn, idx))
     return Symbol(kids)
 
 

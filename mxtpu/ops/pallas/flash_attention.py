@@ -249,19 +249,38 @@ def _resolve_blocks(q, k, block_q, block_k):
                     "flash_attention falling back to the XLA softmax path "
                     "(%s; q[T=%d] k[T=%d] D=%d): the [T,T] score matrix "
                     "will materialize in HBM — pad T to a multiple of 8 "
-                    "(q) / 128 (k) and D to a multiple of 128 for the "
-                    "fused kernel" % (reason, t, tk, d))
+                    "(q) / 128 (k) for the fused kernel (head dims are "
+                    "padded to the 128-lane granule automatically)"
+                    % (reason, t, tk, d))
         return None
 
     if not on_tpu:
         return None  # expected off-TPU; not a cliff worth warning about
-    if d % 128 != 0:
-        return _fallback("head dim not a multiple of 128")
+    # head dims off the 128-lane granule (64 for BERT-base et al.) are
+    # zero-padded to the next multiple by _pad_head_dim — scores and lse
+    # are invariant to zero columns, so no fallback needed.
+    # MXTPU_FLASH_PAD_D=0 restores the old fallback (perf A/B only).
+    import os
+    if d % 128 != 0 and os.environ.get("MXTPU_FLASH_PAD_D") == "0":
+        return _fallback("head dim not a multiple of 128 (padding "
+                         "disabled by MXTPU_FLASH_PAD_D=0)")
     bq = _pick_block(t, block_q, 8)       # sublane granularity
     bk = _pick_block(tk, block_k, 128)    # lane granularity
     if bq is None or bk is None:
         return _fallback("sequence length has no TPU-tileable block")
     return bq, bk
+
+
+def _pad_head_dim(q, k, v):
+    """Zero-pad [B, H, T, D] operands to the 128-lane granule. Zero key/
+    query columns contribute nothing to scores and zero value columns are
+    sliced off the output, so attention is exact under this padding."""
+    d = q.shape[-1]
+    d_pad = -(-d // 128) * 128
+    if d_pad == d:
+        return q, k, v, d
+    pad = [(0, 0)] * 3 + [(0, d_pad - d)]
+    return jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad), d
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -280,7 +299,10 @@ def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
     if blocks is None:
         out = _xla_attention(q, k, v, causal, scale)
         return out, (q, k, v, out, None)
-    out, lse = _fa_forward_pallas(q, k, v, causal, scale, *blocks)
+    qp, kp, vp, d = _pad_head_dim(q, k, v)
+    out, lse = _fa_forward_pallas(qp, kp, vp, causal, scale, *blocks)
+    if qp is not q:
+        out = out[..., :d]
     return out, (q, k, v, out, lse)
 
 
@@ -322,7 +344,10 @@ def _fa_lse_fwd_impl(q, k, v, causal, scale, block_q, block_k):
     if blocks is None:
         out, lse = _xla_attention_lse(q, k, v, causal, scale)
         return out, lse, (q, k, v, out, None)
-    out, lse = _fa_forward_pallas(q, k, v, causal, scale, *blocks)
+    qp, kp, vp, d = _pad_head_dim(q, k, v)
+    out, lse = _fa_forward_pallas(qp, kp, vp, causal, scale, *blocks)
+    if qp is not q:
+        out = out[..., :d]
     return out, lse, (q, k, v, out, lse)
 
 

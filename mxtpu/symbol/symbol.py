@@ -230,7 +230,13 @@ class Symbol:
                 kwargs["output_mean_var"] = True
                 out, mean, var = op.wrapper(*pos, **kwargs)
                 momentum = float(kwargs.get("momentum", 0.9))
-                rm, rv = pos[3], pos[4]  # moving_mean, moving_var inputs
+                # moving_mean/var arrive positionally (explicit 5-input
+                # compose) or as kw_arrays (data-only compose with
+                # auto-created params)
+                if "moving_mean" in kwargs:
+                    rm, rv = kwargs["moving_mean"], kwargs["moving_var"]
+                else:
+                    rm, rv = pos[3], pos[4]
                 collect_aux[node.inputs[3][0].name] = \
                     rm * momentum + mean * (1 - momentum)
                 collect_aux[node.inputs[4][0].name] = \

@@ -1248,8 +1248,21 @@ int MXTPUAutogradMarkVariables(int num, NDArrayHandle *vars,
 int MXTPUAutogradBackward(int num, NDArrayHandle *heads,
                           NDArrayHandle *ograds, int retain_graph) {
   GilScope gil;
-  PyObject *og = ograds == nullptr ? PyTuple_New(0)
-                                   : HandleTuple(ograds, num);
+  PyObject *og;
+  if (ograds == nullptr) {
+    og = PyTuple_New(0);
+  } else {
+    /* individual NULL entries mean a ones-like seed for that head (ref
+     * MXAutogradBackwardEx) — marshal them as None, never Py_INCREF(0) */
+    og = PyTuple_New(num);
+    for (int i = 0; i < num; ++i) {
+      PyObject *o = ograds[i] == nullptr
+                        ? Py_None
+                        : reinterpret_cast<PyObject *>(ograds[i]);
+      Py_INCREF(o);
+      PyTuple_SetItem(og, i, o);
+    }
+  }
   return CallNoResult(
       "autograd_backward",
       Py_BuildValue("(NNi)", HandleTuple(heads, num), og, retain_graph));

@@ -1139,3 +1139,75 @@ def test_infer_shape_partial_output_contract(lib):
         c, ("a", "b"), ((2,), (3,)))  # conflicting shapes
     assert len(outs) == len(c.list_outputs())
     assert outs[0] == ()
+
+
+def test_cached_op_train_mode_and_bn_aux(lib):
+    """Train-mode CachedOp updates the caller's BN moving stats on BOTH
+    paths (recording: eager tape; not recording: cached executor), and
+    honors train_mode for the executor path (review r5)."""
+    import mxtpu.c_api_impl as impl
+    import mxtpu.symbol as msym
+    from mxtpu import autograd
+    x = msym.var("x")
+    bn = msym.BatchNorm(x, name="bn")
+    co = impl.cached_op_create(bn, (), ())
+    names = bn.list_inputs()
+
+    def fresh_feed():
+        return {"x": mx.nd.array(
+                    np.random.RandomState(0).randn(64, 3).astype(np.float32)
+                    * 5 + 2),
+                "bn_gamma": mx.nd.ones((3,)),
+                "bn_beta": mx.nd.zeros((3,)),
+                "bn_moving_mean": mx.nd.zeros((3,)),
+                "bn_moving_var": mx.nd.ones((3,))}
+
+    feed = fresh_feed()
+    with autograd.record(train_mode=True):
+        impl.cached_op_invoke(co, tuple(feed[n] for n in names))
+    assert np.abs(feed["bn_moving_mean"].asnumpy()).sum() > 0
+
+    feed2 = fresh_feed()
+    prev = autograd.set_training(True)
+    try:
+        impl.cached_op_invoke(co, tuple(feed2[n] for n in names))
+    finally:
+        autograd.set_training(prev)
+    assert np.abs(feed2["bn_moving_mean"].asnumpy()).sum() > 0
+
+
+def test_autograd_backward_null_entry_ograds(lib):
+    """Per-entry NULL ograds = ones-like seed for that head (ref
+    MXAutogradBackwardEx); must not crash the process (review r5)."""
+    x = _nd_from_blob(lib, np.ones((3,), np.float32))
+    reqs = (ctypes.c_int * 1)(1)
+    assert lib.MXTPUAutogradMarkVariables(1, ctypes.byref(x), reqs) == 0
+    prev = ctypes.c_int()
+    assert lib.MXTPUAutogradSetRecording(1, ctypes.byref(prev)) == 0
+    outs1 = (ctypes.c_void_p * 1)()
+    n1 = ctypes.c_int(1)
+    assert lib.MXTPUImperativeInvoke(b"square", ctypes.byref(x), 1, None,
+                                     None, 0, outs1, ctypes.byref(n1)) == 0
+    outs2 = (ctypes.c_void_p * 1)()
+    n2 = ctypes.c_int(1)
+    assert lib.MXTPUImperativeInvoke(b"square", ctypes.byref(x), 1, None,
+                                     None, 0, outs2, ctypes.byref(n2)) == 0
+    assert lib.MXTPUAutogradSetRecording(0, ctypes.byref(prev)) == 0
+    two = _nd_from_blob(lib, np.full(3, 2.0, np.float32))
+    heads = (ctypes.c_void_p * 2)(outs1[0], outs2[0])
+    ograds = (ctypes.c_void_p * 2)(None, two)  # first entry NULL
+    assert lib.MXTPUAutogradBackward(2, heads, ograds, 0) == 0
+    g = ctypes.c_void_p()
+    assert lib.MXTPUNDArrayGetGrad(x, ctypes.byref(g)) == 0
+    # d/dx (x^2 * 1) + d/dx (x^2 * 2) at x=1 -> 2 + 4
+    np.testing.assert_allclose(_nd_to_numpy(lib, g), 6.0)
+
+
+def test_symbol_get_children_keeps_output_index(lib):
+    import mxtpu.c_api_impl as impl
+    import mxtpu.symbol as msym
+    s = msym.var("s")
+    parts = msym.SliceChannel(s, num_outputs=2, name="split")
+    h = parts[1] * 2
+    kids = impl.symbol_get_children(h)
+    assert "split_output1" in kids.list_outputs()

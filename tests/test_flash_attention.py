@@ -152,7 +152,8 @@ def test_tpu_shaped_fallback_warns_once_and_stays_correct(monkeypatch):
     monkeypatch.setattr(fa, "_platform", lambda: "tpu")
     fa._warned_fallbacks.clear()
     rng = np.random.RandomState(0)
-    # head dim 64 is not a multiple of 128 -> fallback on "TPU"
+    # T=16 has no 128-lane k block -> fallback on "TPU" (head dim 64 no
+    # longer falls back: it pads to the lane granule, r5)
     q = jnp.asarray(rng.randn(1, 2, 16, 64), jnp.float32)
     k = jnp.asarray(rng.randn(1, 2, 16, 64), jnp.float32)
     v = jnp.asarray(rng.randn(1, 2, 16, 64), jnp.float32)
@@ -219,3 +220,40 @@ def test_pick_block_rounds_small_requests_up_to_granule():
     assert fa._pick_block(512, 64, 128) == 128
     assert fa._pick_block(512, 4, 8) == 8
     assert fa._pick_block(64, 64, 128) is None  # n itself below granule
+
+
+def test_head_dim_64_pads_instead_of_falling_back(monkeypatch):
+    """BERT-base head dim (64) must take the fused kernel via zero-padding
+    to the 128-lane granule, not the HBM-cliff fallback (r5)."""
+    import importlib
+    fa = importlib.import_module("mxtpu.ops.pallas.flash_attention")
+    monkeypatch.setattr(fa, "_platform", lambda: "tpu")
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 2, 512, 64), jnp.float32)
+    blocks = fa._resolve_blocks(q, q, 512, 512)
+    assert blocks is not None  # no fallback for D=64
+    # padding invariance of the attention math the kernel relies on:
+    # zero-padded q/k leave scores unchanged, zero-padded v adds zero
+    # output columns
+    k = jnp.asarray(rng.randn(1, 2, 512, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 2, 512, 64), jnp.float32)
+    scale = 64 ** -0.5
+    qp, kp, vp, d = fa._pad_head_dim(q, k, v)
+    assert d == 64 and qp.shape[-1] == 128
+    base = fa._xla_attention(q, k, v, False, scale)
+    padded = fa._xla_attention(qp, kp, vp, False, scale)[..., :64]
+    np.testing.assert_allclose(np.asarray(base), np.asarray(padded),
+                               rtol=1e-5, atol=1e-5)
+    # lse is invariant too (ring attention merges on it)
+    _, lse_base = fa._xla_attention_lse(q, k, v, False, scale)
+    _, lse_pad = fa._xla_attention_lse(qp, kp, vp, False, scale)
+    np.testing.assert_allclose(np.asarray(lse_base), np.asarray(lse_pad),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pad_head_dim_noop_on_granule():
+    import importlib
+    fa = importlib.import_module("mxtpu.ops.pallas.flash_attention")
+    q = jnp.zeros((1, 1, 8, 128), jnp.float32)
+    qp, kp, vp, d = fa._pad_head_dim(q, q, q)
+    assert qp is q and kp is q and vp is q and d == 128

@@ -341,6 +341,145 @@ def phase_ring():
     covered by the bert config's flash kernel; placeholder for parity."""
 
 
+def phase_stem_breakdown():
+    """Name the stem sink. The prefix-stage data says stem fwd+bwd is
+    ~14 ms of the 50.6 ms step, vs ~1 ms of pure conv FLOPs — suspects:
+    the C=3 input conv (3 of 128 lanes live), the maxpool backward
+    (scatter), or BN. Times each stem variant fwd and fwd+bwd, b128."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from mxtpu.parallel import pure_forward
+    import mxtpu as mx
+
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    x = jax.random.normal(jax.random.PRNGKey(0), (batch, 224, 224, 3),
+                          jnp.bfloat16)
+
+    def build(kind):
+        with mx.layout("NHWC"):
+            blk = mx.gluon.nn.HybridSequential()
+            if kind == "conv":
+                blk.add(mx.gluon.nn.Conv2D(64, 7, strides=2, padding=3,
+                                           use_bias=False))
+            elif kind in ("conv_bn", "conv_bn_pool", "conv_bn_avgpool"):
+                blk.add(mx.gluon.nn.Conv2D(64, 7, strides=2, padding=3,
+                                           use_bias=False))
+                blk.add(mx.gluon.nn.BatchNorm())
+                blk.add(mx.gluon.nn.Activation("relu"))
+                if kind == "conv_bn_pool":
+                    blk.add(mx.gluon.nn.MaxPool2D(3, 2, 1))
+                elif kind == "conv_bn_avgpool":
+                    blk.add(mx.gluon.nn.AvgPool2D(3, 2, 1))
+            elif kind == "s2d_conv_bn_pool":
+                # ~the BENCH_S2D_STEM shape (contrib/s2d_stem.py): ONE 2x2
+                # s2d -> 112^2 x 12, then a 4x4 stride-1 conv. The real
+                # lever pads (2,1) asymmetrically (112^2 out); gluon pads
+                # symmetrically -> 113^2, +1.8% pixels — close enough for
+                # a sink-naming probe.
+                blk.add(mx.gluon.nn.Conv2D(64, 4, strides=1, padding=2,
+                                           use_bias=False))
+                blk.add(mx.gluon.nn.BatchNorm())
+                blk.add(mx.gluon.nn.Activation("relu"))
+                blk.add(mx.gluon.nn.MaxPool2D(3, 2, 1))
+        return blk
+
+    from mxtpu.contrib.s2d_stem import space_to_depth_nhwc
+
+    for kind in ("conv", "conv_bn", "conv_bn_pool", "conv_bn_avgpool",
+                 "s2d_conv_bn_pool"):
+        blk = build(kind)
+        xin = space_to_depth_nhwc(x) if kind.startswith("s2d") else x
+        blk.initialize()
+        blk(mx.nd.array(np.zeros(xin.shape, np.float32)))
+        blk.cast("bfloat16")
+        fn, params = pure_forward(blk, train=True)
+        dt_f = timed_scan(reinject(lambda t, fn=fn, p=params: fn(p, t)), xin)
+
+        def step(t, fn=fn, p=params):
+            g = jax.grad(lambda tt: jnp.sum(
+                fn(p, tt).astype(jnp.float32) ** 2))(t)
+            return t + 1e-6 * g.astype(t.dtype)
+        dt_fb = timed_scan(step, xin)
+        out("stem", {"case": kind, "fwd_ms": round(dt_f * 1e3, 3),
+                     "fwdbwd_ms": round(dt_fb * 1e3, 3)})
+
+
+def phase_resnet_best():
+    """The combo the battery never measured: BN one-pass + s2d stem
+    WITHOUT conv_acc (conv_acc alone measured -2.8% end-to-end)."""
+    _resnet("resnet_best", MXTPU_CONV_ACC="0", BENCH_S2D_STEM="1",
+            MXTPU_BN_ONEPASS="1")
+
+
+def phase_flash_pad():
+    """Head-dim-64 flash path: correctness (kernel vs XLA fallback, on
+    chip) and fwd+bwd step time with padding vs the old [T,T] fallback.
+    BERT-base attention shape: b16 h12 T512 D64 bf16."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from mxtpu.ops.pallas import flash_attention as fa_mod
+    fa = fa_mod.flash_attention
+
+    b, h, t, d = 16, 12, 512, 64
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (b, h, t, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, h, t, d), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, h, t, d), jnp.bfloat16)
+
+    # correctness on the real chip: padded kernel vs XLA reference
+    got = np.asarray(jax.device_get(fa(q, k, v)), np.float32)
+    ref = np.asarray(jax.device_get(
+        fa_mod._xla_attention(q, k, v, False, d ** -0.5)), np.float32)
+    max_err = float(np.max(np.abs(got - ref)))
+    out("flash_pad", {"case": "d64_correctness_maxerr", "value": max_err})
+    assert max_err < 0.05, "padded flash kernel diverges: %g" % max_err
+
+    def train_step(mode):
+        saved = os.environ.get("MXTPU_FLASH_PAD_D")
+        os.environ["MXTPU_FLASH_PAD_D"] = mode
+        try:
+            def loss(q_):
+                o = fa(q_, k, v)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+            g = jax.grad(loss)
+            dt = timed_scan(lambda q_: q_ + 1e-6 * g(q_).astype(q_.dtype), q)
+        finally:
+            if saved is None:
+                os.environ.pop("MXTPU_FLASH_PAD_D", None)
+            else:
+                os.environ["MXTPU_FLASH_PAD_D"] = saved
+        return dt
+
+    dt_pad = train_step("1")
+    out("flash_pad", {"case": "d64_fwd_bwd_padded_kernel",
+                      "ms": round(dt_pad * 1e3, 3)})
+    dt_fb = train_step("0")
+    out("flash_pad", {"case": "d64_fwd_bwd_xla_fallback",
+                      "ms": round(dt_fb * 1e3, 3),
+                      "speedup": round(dt_fb / dt_pad, 3)})
+
+
+def phase_bert_pad_ab():
+    """End-to-end bert A/B: flash D-64 padding ON (new default) vs the
+    old HBM-cliff fallback."""
+    import bench
+    saved = os.environ.get("MXTPU_FLASH_PAD_D")
+    try:
+        os.environ["MXTPU_FLASH_PAD_D"] = "1"
+        out("bert_pad", bench.bench_bert_base())
+        os.environ["MXTPU_FLASH_PAD_D"] = "0"
+        rec = bench.bench_bert_base()
+        rec["note"] = "old fallback (pad disabled)"
+        out("bert_nopad", rec)
+    finally:
+        if saved is None:
+            os.environ.pop("MXTPU_FLASH_PAD_D", None)
+        else:
+            os.environ["MXTPU_FLASH_PAD_D"] = saved
+
+
 PHASES = [
     ("probe", phase_probe),
     ("resnet_control", phase_resnet_control),
@@ -357,6 +496,10 @@ PHASES = [
     ("bandwidth", phase_bandwidth),
     ("lstm", phase_lstm),
     ("bert", phase_bert),
+    ("resnet_best", phase_resnet_best),
+    ("flash_pad", phase_flash_pad),
+    ("bert_pad_ab", phase_bert_pad_ab),
+    ("stem_breakdown", phase_stem_breakdown),
 ]
 
 
