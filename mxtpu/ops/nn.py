@@ -360,13 +360,15 @@ def _leaky_impl(x, gamma, act_type, slope):
 
 
 def _bn_onepass():
-    """MXTPU_BN_ONEPASS=1 enables single-read batch statistics (default
-    off until the end-to-end effect is measured on chip — the round-3
-    lesson: stage levers behind flags, flip on evidence). Baked into
-    compiled executables: registry.policy_key() puts it in jit cache
-    keys so mid-process flips recompile."""
+    """Single-read batch statistics, DEFAULT ON as of round 5: the
+    same-session on-chip A/B measured +7.8% end-to-end ResNet-50
+    throughput (2331.7 -> 2512.7 img/s, perf_watch.log 16:18) and -9.4%
+    on the conv+BN microbench; numerics are pinned eager+hybridized both
+    ways (tests/test_precision.py). MXTPU_BN_ONEPASS=0 restores two-pass
+    jnp.var stats. Baked into compiled executables: registry.policy_key()
+    puts it in jit cache keys so mid-process flips recompile."""
     import os
-    return os.environ.get("MXTPU_BN_ONEPASS", "0") == "1"
+    return os.environ.get("MXTPU_BN_ONEPASS", "1") == "1"
 
 
 def bn_batch_stats(xf, red):

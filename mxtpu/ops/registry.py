@@ -95,8 +95,12 @@ def policy_key():
     (an A/B measurement would then compare a lever with itself)."""
     import os
     return (os.environ.get("MXTPU_CONV_ACC", "1"),
-            os.environ.get("MXTPU_BN_ONEPASS", "0"),
-            os.environ.get("MXTPU_RING_FLASH", "0"))
+            # defaults must MIRROR their read sites (ops/nn.py:_bn_onepass,
+            # pallas/flash_attention.py:_resolve_blocks) — a mismatch would
+            # alias unset and the non-default value onto one cache key
+            os.environ.get("MXTPU_BN_ONEPASS", "1"),
+            os.environ.get("MXTPU_RING_FLASH", "0"),
+            os.environ.get("MXTPU_FLASH_PAD_D", "1"))
 
 
 # canonical op name -> fn(attrs) -> int: STATIC output count for ops whose

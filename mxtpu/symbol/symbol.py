@@ -231,15 +231,21 @@ class Symbol:
                 out, mean, var = op.wrapper(*pos, **kwargs)
                 momentum = float(kwargs.get("momentum", 0.9))
                 # moving_mean/var arrive positionally (explicit 5-input
-                # compose) or as kw_arrays (data-only compose with
-                # auto-created params)
-                if "moving_mean" in kwargs:
-                    rm, rv = kwargs["moving_mean"], kwargs["moving_var"]
+                # compose) or as kw_arrays (keyword compose, ANY order) —
+                # value and destination NAME must come from the same slot,
+                # or a reordered compose would write stats into gamma/beta
+                npos = sum(1 for a in node.pos_template if a is _ARG)
+                if "moving_mean" in node.kw_arrays:
+                    rm = kwargs["moving_mean"]
+                    rv = kwargs["moving_var"]
+                    mm_i = npos + node.kw_arrays.index("moving_mean")
+                    mv_i = npos + node.kw_arrays.index("moving_var")
                 else:
                     rm, rv = pos[3], pos[4]
-                collect_aux[node.inputs[3][0].name] = \
+                    mm_i, mv_i = 3, 4
+                collect_aux[node.inputs[mm_i][0].name] = \
                     rm * momentum + mean * (1 - momentum)
-                collect_aux[node.inputs[4][0].name] = \
+                collect_aux[node.inputs[mv_i][0].name] = \
                     rv * momentum + var * (1 - momentum)
                 res = out
             else:

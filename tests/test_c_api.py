@@ -1211,3 +1211,31 @@ def test_symbol_get_children_keeps_output_index(lib):
     h = parts[1] * 2
     kids = impl.symbol_get_children(h)
     assert "split_output1" in kids.list_outputs()
+
+
+def test_cached_op_bn_scrambled_keyword_compose(lib):
+    """Keyword BN compose in arbitrary order: stat updates must land on
+    moving_mean/var by NAME, never on gamma/beta (review r5 — value and
+    destination derived from the same kw slot)."""
+    import mxtpu.c_api_impl as impl
+    import mxtpu.symbol as msym
+    from mxtpu import autograd
+    x = msym.var("x")
+    g = msym.var("g")
+    b = msym.var("b")
+    mm = msym.var("mm")
+    mv = msym.var("mv")
+    bn = msym.BatchNorm(x, moving_var=mv, moving_mean=mm, gamma=g, beta=b,
+                        name="bn")
+    co = impl.cached_op_create(bn, (), ())
+    names = bn.list_inputs()
+    feed = {"x": mx.nd.array(
+                np.random.RandomState(0).randn(64, 3).astype(np.float32)
+                * 5 + 2),
+            "g": mx.nd.ones((3,)), "b": mx.nd.zeros((3,)),
+            "mm": mx.nd.zeros((3,)), "mv": mx.nd.ones((3,))}
+    with autograd.record(train_mode=True):
+        impl.cached_op_invoke(co, tuple(feed[n] for n in names))
+    np.testing.assert_allclose(feed["g"].asnumpy(), 1.0)
+    np.testing.assert_allclose(feed["b"].asnumpy(), 0.0)
+    assert np.abs(feed["mm"].asnumpy()).sum() > 0

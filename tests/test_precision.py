@@ -105,8 +105,8 @@ def test_bn_onepass_stats_match_twopass(monkeypatch):
         return out.asnumpy()
 
     for hyb in (False, True):
-        monkeypatch.delenv("MXTPU_BN_ONEPASS", raising=False)
-        two = run(hyb)
+        monkeypatch.setenv("MXTPU_BN_ONEPASS", "0")  # explicit two-pass
+        two = run(hyb)                               # (default is now 1)
         monkeypatch.setenv("MXTPU_BN_ONEPASS", "1")
         one = run(hyb)
         np.testing.assert_allclose(one, two, rtol=1e-4, atol=1e-5)

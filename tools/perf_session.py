@@ -134,29 +134,35 @@ def _resnet(tag, **env):
 
 def phase_resnet_control():
     # round-2 path: plain XLA convs, two-pass BN, plain stem — the
-    # same-session baseline every lever delta is measured against
-    _resnet("resnet_control", MXTPU_CONV_ACC="0")
+    # same-session baseline every lever delta is measured against.
+    # EVERY lever env is pinned explicitly: package defaults moved in
+    # round 5 (BN one-pass is now default-on), and a control that
+    # inherits defaults silently becomes the lever it controls for.
+    _resnet("resnet_control", MXTPU_CONV_ACC="0", MXTPU_BN_ONEPASS="0")
 
 
 def phase_resnet_conv_acc():
-    _resnet("resnet_conv_acc")          # package default (conv_acc on)
+    _resnet("resnet_conv_acc", MXTPU_CONV_ACC="1", MXTPU_BN_ONEPASS="0")
 
 
 def phase_resnet_s2d():
-    _resnet("resnet_s2d", BENCH_S2D_STEM="1")
+    _resnet("resnet_s2d", MXTPU_CONV_ACC="1", MXTPU_BN_ONEPASS="0",
+            BENCH_S2D_STEM="1")
 
 
 def phase_resnet_bn1p():
-    _resnet("resnet_bn_onepass", MXTPU_BN_ONEPASS="1")
+    _resnet("resnet_bn_onepass", MXTPU_CONV_ACC="1", MXTPU_BN_ONEPASS="1")
 
 
 def phase_resnet_all_levers():
-    _resnet("resnet_all_levers", BENCH_S2D_STEM="1", MXTPU_BN_ONEPASS="1")
+    _resnet("resnet_all_levers", MXTPU_CONV_ACC="1", MXTPU_BN_ONEPASS="1",
+            BENCH_S2D_STEM="1")
 
 
 def phase_resnet_nchw():
     # layout A/B: XLA:TPU may prefer a different im2col/tiling for NCHW
-    _resnet("resnet_nchw", BENCH_LAYOUT="NCHW")
+    _resnet("resnet_nchw", MXTPU_CONV_ACC="1", MXTPU_BN_ONEPASS="0",
+            BENCH_LAYOUT="NCHW")
 
 
 def phase_convs():
