@@ -101,10 +101,16 @@ conv_acc.defvjp(_fwd, _bwd)
 
 
 def _enabled():
-    """MXTPU_CONV_ACC=0 disables the custom path (escape hatch: revert to
-    plain autodiff convs without a code change)."""
+    """DEFAULT OFF as of round 5: the same-session on-chip A/B measured
+    the custom conv path at −2.8% end-to-end ResNet-50 (2331.7 control
+    vs 2267.2, perf_watch.log 16:16) and the best-known config excludes
+    it (resnet_best 2580.3 img/s, perf_followup.log) — the +10%
+    conv-stack microbench win does not survive the real mixed graph.
+    MXTPU_CONV_ACC=1 re-enables for A/Bs. The f32-accumulate MATMUL
+    policy (precision_util.contract_acc: dense/RNN/attention) is
+    unaffected by this flag and stays on."""
     import os
-    return os.environ.get("MXTPU_CONV_ACC", "1") != "0"
+    return os.environ.get("MXTPU_CONV_ACC", "0") == "1"
 
 
 def conv_fast(x, w, strides, padding, lhs_dilation, rhs_dilation, dims,

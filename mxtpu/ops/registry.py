@@ -94,7 +94,7 @@ def policy_key():
     mid-process silently reuses executables traced under the old policy
     (an A/B measurement would then compare a lever with itself)."""
     import os
-    return (os.environ.get("MXTPU_CONV_ACC", "1"),
+    return (os.environ.get("MXTPU_CONV_ACC", "0"),
             # defaults must MIRROR their read sites (ops/nn.py:_bn_onepass,
             # pallas/flash_attention.py:_resolve_blocks) — a mismatch would
             # alias unset and the non-default value onto one cache key

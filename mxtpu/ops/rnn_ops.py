@@ -32,15 +32,27 @@ def _gdot(x, W):
     return contract_acc(jnp.dot, x, W.T)
 
 
-def _cell_step(mode, W_ih, W_hh, b_ih, b_hh):
-    """Returns step(carry, x_t) -> (carry, h_t) for one direction of one layer."""
+def _precompute_xi(xs, W_ih, b_ih):
+    """Hoist the input-to-hidden projection for ALL timesteps out of the
+    scan: one [T*N, in] x [in, ng*H] MXU matmul instead of T small ones
+    inside the loop — the cuDNN persistent-RNN "input GEMM batching"
+    (cudnn_rnn-inl.h precedent), which both halves the in-scan matmul
+    count and runs the hoisted half at large-matmul efficiency."""
+    T, N, F = xs.shape
+    xi = _gdot(xs.reshape(T * N, F), W_ih) + b_ih
+    return xi.reshape(T, N, -1)
+
+
+def _cell_step(mode, W_hh, b_hh):
+    """Returns step(carry, xi_t) -> (carry, h_t) for one direction of one
+    layer. xi_t is the PRECOMPUTED input projection x_t @ W_ih.T + b_ih
+    (see _precompute_xi); only the recurrent matmul stays in the loop."""
     if mode == "lstm":
-        def step(carry, x):
+        def step(carry, xi):
             h, c = carry
             # precision from the ACTUAL operands (weights may be bf16 while
             # activations are f32 — then the honest-f32 global must win)
-            z = _gdot(x, W_ih) + b_ih \
-                + _gdot(h, W_hh) + b_hh
+            z = xi + _gdot(h, W_hh) + b_hh
             i, f, g, o = jnp.split(z, 4, axis=-1)
             i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
             g = jnp.tanh(g)
@@ -49,9 +61,8 @@ def _cell_step(mode, W_ih, W_hh, b_ih, b_hh):
             return (h_new, c_new), h_new
         return step
     if mode == "gru":
-        def step(carry, x):
+        def step(carry, xi):
             h = carry
-            xi = _gdot(x, W_ih) + b_ih
             hh = _gdot(h, W_hh) + b_hh
             xr, xz, xn = jnp.split(xi, 3, axis=-1)
             hr, hz, hn = jnp.split(hh, 3, axis=-1)
@@ -63,12 +74,9 @@ def _cell_step(mode, W_ih, W_hh, b_ih, b_hh):
         return step
     act = jnp.tanh if mode == "rnn_tanh" else (lambda v: jnp.maximum(v, 0))
 
-    def step(carry, x):
+    def step(carry, xi):
         h = carry
-        h_new = act(_gdot(x, W_ih)
-                    + b_ih
-                    + _gdot(h, W_hh)
-                    + b_hh)
+        h_new = act(xi + _gdot(h, W_hh) + b_hh)
         return h_new, h_new
     return step
 
@@ -139,15 +147,16 @@ def RNN(data, parameters, state, state_cell=None, state_size=None, num_layers=1,
         outs = []
         for d in range(dirs):
             W_ih, W_hh, b_ih, b_hh = weights[layer * dirs + d]
-            step = _cell_step(mode, W_ih, W_hh, b_ih, b_hh)
+            step = _cell_step(mode, W_hh, b_hh)
             xs = x if d == 0 else jnp.flip(x, axis=0)
+            xi = _precompute_xi(xs, W_ih, b_ih)
             hi = h0[layer * dirs + d]
             if mode == "lstm":
                 carry0 = (hi, c0[layer * dirs + d])
-                (hT, cT), ys = lax.scan(step, carry0, xs)
+                (hT, cT), ys = lax.scan(step, carry0, xi)
                 c_finals.append(cT)
             else:
-                hT, ys = lax.scan(step, hi, xs)
+                hT, ys = lax.scan(step, hi, xi)
             h_finals.append(hT)
             outs.append(ys if d == 0 else jnp.flip(ys, axis=0))
         x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)

@@ -235,14 +235,18 @@ class Symbol:
                 # value and destination NAME must come from the same slot,
                 # or a reordered compose would write stats into gamma/beta
                 npos = sum(1 for a in node.pos_template if a is _ARG)
-                if "moving_mean" in node.kw_arrays:
-                    rm = kwargs["moving_mean"]
-                    rv = kwargs["moving_var"]
-                    mm_i = npos + node.kw_arrays.index("moving_mean")
-                    mv_i = npos + node.kw_arrays.index("moving_var")
-                else:
-                    rm, rv = pos[3], pos[4]
-                    mm_i, mv_i = 3, 4
+
+                def _stat_slot(kw_name, pos_idx):
+                    # each stat independently: positional (data, gamma,
+                    # beta, moving_mean, moving_var) order, or kw_arrays
+                    # at any position — mixed composes are legal
+                    if kw_name in node.kw_arrays:
+                        return (kwargs[kw_name],
+                                npos + node.kw_arrays.index(kw_name))
+                    return pos[pos_idx], pos_idx
+
+                rm, mm_i = _stat_slot("moving_mean", 3)
+                rv, mv_i = _stat_slot("moving_var", 4)
                 collect_aux[node.inputs[mm_i][0].name] = \
                     rm * momentum + mean * (1 - momentum)
                 collect_aux[node.inputs[mv_i][0].name] = \

@@ -1239,3 +1239,28 @@ def test_cached_op_bn_scrambled_keyword_compose(lib):
     np.testing.assert_allclose(feed["g"].asnumpy(), 1.0)
     np.testing.assert_allclose(feed["b"].asnumpy(), 0.0)
     assert np.abs(feed["mm"].asnumpy()).sum() > 0
+
+
+def test_cached_op_bn_mixed_positional_keyword_compose(lib):
+    """4 positional + 1 keyword BN compose must update stats, not raise
+    IndexError from the positional fallback (review r5)."""
+    import mxtpu.c_api_impl as impl
+    import mxtpu.symbol as msym
+    from mxtpu import autograd
+    x = msym.var("x")
+    g = msym.var("g")
+    b = msym.var("b")
+    mm = msym.var("mm")
+    mv = msym.var("mv")
+    bn = msym.BatchNorm(x, g, b, mm, moving_var=mv, name="bn")
+    co = impl.cached_op_create(bn, (), ())
+    names = bn.list_inputs()
+    feed = {"x": mx.nd.array(
+                np.random.RandomState(0).randn(64, 3).astype(np.float32)
+                * 5 + 2),
+            "g": mx.nd.ones((3,)), "b": mx.nd.zeros((3,)),
+            "mm": mx.nd.zeros((3,)), "mv": mx.nd.ones((3,))}
+    with autograd.record(train_mode=True):
+        impl.cached_op_invoke(co, tuple(feed[n] for n in names))
+    np.testing.assert_allclose(feed["g"].asnumpy(), 1.0)
+    assert np.abs(feed["mm"].asnumpy()).sum() > 0

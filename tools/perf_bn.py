@@ -84,7 +84,8 @@ def analyze_hlo(train=True):
             stat_fusions += 1
     print("optimized-HLO summary (%s, MXTPU_BN_ONEPASS=%s):"
           % ("train" if train else "eval",
-             os.environ.get("MXTPU_BN_ONEPASS", "0")))
+             # default mirrors ops/nn.py:_bn_onepass (1 as of round 5)
+             os.environ.get("MXTPU_BN_ONEPASS", "1")))
     print("  fusion ops:          %d" % len(fusions))
     print("  fusions w/ reduce:   %d  (1 = mean+var share one stats read)"
           % stat_fusions)

@@ -138,11 +138,13 @@ def phase_resnet_control():
     # EVERY lever env is pinned explicitly: package defaults moved in
     # round 5 (BN one-pass is now default-on), and a control that
     # inherits defaults silently becomes the lever it controls for.
-    _resnet("resnet_control", MXTPU_CONV_ACC="0", MXTPU_BN_ONEPASS="0")
+    _resnet("resnet_control", MXTPU_CONV_ACC="0", MXTPU_BN_ONEPASS="0",
+            BENCH_S2D_STEM="0")
 
 
 def phase_resnet_conv_acc():
-    _resnet("resnet_conv_acc", MXTPU_CONV_ACC="1", MXTPU_BN_ONEPASS="0")
+    _resnet("resnet_conv_acc", MXTPU_CONV_ACC="1", MXTPU_BN_ONEPASS="0",
+            BENCH_S2D_STEM="0")
 
 
 def phase_resnet_s2d():
@@ -151,7 +153,8 @@ def phase_resnet_s2d():
 
 
 def phase_resnet_bn1p():
-    _resnet("resnet_bn_onepass", MXTPU_CONV_ACC="1", MXTPU_BN_ONEPASS="1")
+    _resnet("resnet_bn_onepass", MXTPU_CONV_ACC="1", MXTPU_BN_ONEPASS="1",
+            BENCH_S2D_STEM="0")
 
 
 def phase_resnet_all_levers():
@@ -422,10 +425,13 @@ def phase_flash_pad():
     """Head-dim-64 flash path: correctness (kernel vs XLA fallback, on
     chip) and fwd+bwd step time with padding vs the old [T,T] fallback.
     BERT-base attention shape: b16 h12 T512 D64 bf16."""
+    import importlib
     import numpy as np
     import jax
     import jax.numpy as jnp
-    from mxtpu.ops.pallas import flash_attention as fa_mod
+    # NOT `from mxtpu.ops.pallas import flash_attention` — the package
+    # re-exports the FUNCTION under that name, shadowing the module
+    fa_mod = importlib.import_module("mxtpu.ops.pallas.flash_attention")
     fa = fa_mod.flash_attention
 
     b, h, t, d = 16, 12, 512, 64
