@@ -461,6 +461,31 @@ int MXTPUSetNumOMPThreads(int num);
  * MXTPURandomSeed; ref MXRandomSeedContext). */
 int MXTPURandomSeedContext(int seed, int dev_type, int dev_id);
 
+/* ---- DLPack interchange (ref: MXNDArrayToDLPack / MXNDArrayFromDLPack
+ * / MXNDArrayCallDLPackDeleter). The void* is a standard
+ * DLManagedTensor*; any DLPack consumer (torch, numpy, tvm) accepts it.
+ * ToDLPack transfers ownership to the caller: hand it to a consumer or
+ * release with CallDLPackDeleter. FromDLPack CONSUMES the tensor on
+ * success (its deleter fires when the runtime drops it). ---- */
+
+int MXTPUNDArrayToDLPack(NDArrayHandle handle, void **out_dlmanaged);
+int MXTPUNDArrayFromDLPack(void *dlmanaged, NDArrayHandle *out);
+int MXTPUNDArrayCallDLPackDeleter(void *dlmanaged);
+
+/* ---- shared-memory NDArrays (ref: MXNDArrayCreateFromSharedMem /
+ * MXNDArrayGetSharedMemHandle). POSIX shared memory is NAME-addressed,
+ * so this ABI exchanges segment names where the reference exchanges
+ * (pid, fd) ints. GetSharedMemHandle copies into a fresh segment whose
+ * ownership transfers to the receiver; CreateFromSharedMem attaches,
+ * copies out, and unlinks (one-shot transfer). The name pointer is
+ * valid until the next call on this thread. ---- */
+
+int MXTPUNDArrayGetSharedMemHandle(NDArrayHandle handle,
+                                   const char **out_name);
+int MXTPUNDArrayCreateFromSharedMem(const char *name, int dtype_flag,
+                                    const int64_t *shape, int ndim,
+                                    NDArrayHandle *out);
+
 /* ---- DataIter breadth (ref: MXDataIterGetIndex / GetIterInfo). ---- */
 
 /* Sample indices of the current batch; array valid until the next call

@@ -1008,6 +1008,63 @@ def random_seed_context(seed: int, dev_type: int, dev_id: int) -> None:
     random_seed(seed)
 
 
+def ndarray_to_dlpack(handle):
+    """NDArray -> "dltensor" capsule (the C layer unwraps the pointer)."""
+    from .ndarray.dlpack import to_dlpack_for_read
+    return to_dlpack_for_read(handle)
+
+
+def ndarray_from_dlpack(capsule):
+    from .ndarray.dlpack import from_dlpack
+    return from_dlpack(capsule)
+
+
+# ---- shared-memory NDArrays (ref: MXNDArrayCreateFromSharedMem /
+# MXNDArrayGetSharedMemHandle, src/c_api/c_api.cc:1375 — the reference
+# addresses segments by (pid, fd); POSIX shared memory is NAME-addressed,
+# so this ABI exchanges segment names instead. The gluon multiprocess
+# DataLoader workers use the same mechanism, gluon/data/_mp_worker.py.)
+
+def ndarray_get_shared_mem_handle(handle) -> str:
+    """Copy the array into a fresh POSIX shared-memory segment and return
+    its name. Ownership transfers to the receiving process: the creating
+    tracker is unregistered, and CreateFromSharedMem unlinks."""
+    from multiprocessing import shared_memory
+    a = np.ascontiguousarray(handle.asnumpy())
+    seg = shared_memory.SharedMemory(create=True, size=max(1, a.nbytes))
+    # direct memoryview copy — no tobytes() temporary (matters at GB sizes)
+    seg.buf[:a.nbytes] = memoryview(a).cast("B")
+    try:  # receiver owns the segment now (mirrors _mp_worker.to_shm)
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(seg._name, "shared_memory")
+    except Exception:
+        pass
+    name = seg.name
+    seg.close()
+    return name
+
+
+def ndarray_create_from_shared_mem(name: str, dtype_flag: int,
+                                   shape: tuple):
+    """Attach, copy to a device array, and unlink (one-shot transfer)."""
+    from multiprocessing import shared_memory
+    dt = _DTYPE_FLAGS.get(int(dtype_flag))
+    if dt is None:
+        raise MXNetError("unknown mshadow dtype flag %d" % dtype_flag)
+    seg = shared_memory.SharedMemory(name=name)
+    try:
+        n = int(np.prod(shape)) if shape else 1
+        a = np.frombuffer(seg.buf, dtype=np.dtype(dt),
+                          count=n).reshape(shape).copy()
+    finally:
+        seg.close()
+        try:
+            seg.unlink()
+        except FileNotFoundError:
+            pass
+    return nd.array(a, dtype=dt)
+
+
 def data_iter_get_iter_info(name: str) -> tuple:
     cls = _data_iter_registry().get(name)
     if cls is None:

@@ -67,6 +67,8 @@ _internal.__getattr__ = _internal_getattr
 # creation helpers registered wrap=False already return NDArrays
 from ..ops.init_ops import arange, empty, eye, full, linspace, ones, zeros  # noqa: E402,F401
 from .utils import load, save  # noqa: E402,F401
+from .dlpack import (from_dlpack, from_numpy, to_dlpack_for_read,  # noqa: E402,F401
+                     to_dlpack_for_write)
 from . import random  # noqa: E402,F401
 from . import image  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401

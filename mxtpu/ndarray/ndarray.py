@@ -276,6 +276,16 @@ class NDArray:
     def __dlpack__(self, *a, **kw):
         return self._data.__dlpack__(*a, **kw)
 
+    def to_dlpack_for_read(self):
+        """One-shot "dltensor" capsule (ref: NDArray.to_dlpack_for_read,
+        python/mxnet/ndarray/ndarray.py:2216)."""
+        from .dlpack import to_dlpack_for_read
+        return to_dlpack_for_read(self)
+
+    def to_dlpack_for_write(self):
+        from .dlpack import to_dlpack_for_write
+        return to_dlpack_for_write(self)
+
     # --------------------------------------------------------------- autograd
     def attach_grad(self, grad_req="write", stype=None):
         """Allocate a grad buffer; marks this array as an autograd leaf

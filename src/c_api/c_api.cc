@@ -1943,6 +1943,104 @@ int MXTPURandomSeedContext(int seed, int dev_type, int dev_id) {
                       Py_BuildValue("(iii)", seed, dev_type, dev_id));
 }
 
+/* ---- DLPack interchange (ref: MXNDArrayToDLPack / MXNDArrayFromDLPack
+ * / MXNDArrayCallDLPackDeleter, src/c_api/c_api.cc) ---- */
+
+extern "C" {
+/* minimal stable DLPack v0.x layout (dlpack/dlpack.h) */
+typedef struct {
+  void *data;
+  struct {
+    int32_t device_type;
+    int32_t device_id;
+  } device;
+  int32_t ndim;
+  struct {
+    uint8_t code;
+    uint8_t bits;
+    uint16_t lanes;
+  } dtype;
+  int64_t *shape;
+  int64_t *strides;
+  uint64_t byte_offset;
+} MXTPUDLTensor;
+
+typedef struct MXTPUDLManagedTensor {
+  MXTPUDLTensor dl_tensor;
+  void *manager_ctx;
+  void (*deleter)(struct MXTPUDLManagedTensor *self);
+} MXTPUDLManagedTensor;
+}
+
+int MXTPUNDArrayToDLPack(NDArrayHandle handle, void **out_dlmanaged) {
+  GilScope gil;
+  PyObject *capsule = CallImpl(
+      "ndarray_to_dlpack",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+  if (capsule == nullptr) return -1;
+  void *ptr = PyCapsule_GetPointer(capsule, "dltensor");
+  if (ptr == nullptr) {
+    SetErrorFromPython();
+    Py_DECREF(capsule);
+    return -1;
+  }
+  /* ownership moves to the caller: rename so the capsule destructor
+   * (if any) will not double-free, then drop the capsule */
+  PyCapsule_SetName(capsule, "used_dltensor");
+  Py_DECREF(capsule);
+  *out_dlmanaged = ptr;
+  return 0;
+}
+
+int MXTPUNDArrayFromDLPack(void *dlmanaged, NDArrayHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  PyObject *capsule = PyCapsule_New(dlmanaged, "dltensor", nullptr);
+  if (capsule == nullptr) {
+    SetErrorFromPython();
+    return -1;
+  }
+  /* the importer renames the capsule and takes ownership (calls the
+   * deleter when done); on failure ownership stays with the caller */
+  int rc = CallToHandle("ndarray_from_dlpack",
+                        PyTuple_Pack(1, capsule), out);
+  Py_DECREF(capsule);
+  return rc;
+}
+
+int MXTPUNDArrayCallDLPackDeleter(void *dlmanaged) {
+  if (dlmanaged == nullptr) return 0;
+  /* the deleter may be numpy's (host-copy fallback export) and touch
+   * refcounts — hold the GIL like every other entry point */
+  GilScope gil;
+  auto *dlm = static_cast<MXTPUDLManagedTensor *>(dlmanaged);
+  if (dlm->deleter != nullptr) dlm->deleter(dlm);
+  return 0;
+}
+
+/* ---- shared-memory NDArrays (name-addressed POSIX segments; the
+ * reference's (pid, fd) addressing is Linux-ashmem-specific) ---- */
+
+int MXTPUNDArrayGetSharedMemHandle(NDArrayHandle handle,
+                                   const char **out_name) {
+  GilScope gil;
+  return StringResult(
+      CallImpl("ndarray_get_shared_mem_handle",
+               PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle))),
+      out_name);
+}
+
+int MXTPUNDArrayCreateFromSharedMem(const char *name, int dtype_flag,
+                                    const int64_t *shape, int ndim,
+                                    NDArrayHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallToHandle(
+      "ndarray_create_from_shared_mem",
+      Py_BuildValue("(siN)", name, dtype_flag, ShapeTuple(shape, ndim)),
+      out);
+}
+
 /* ---- DataIter breadth ---- */
 
 namespace {

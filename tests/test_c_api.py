@@ -1264,3 +1264,33 @@ def test_cached_op_bn_mixed_positional_keyword_compose(lib):
         impl.cached_op_invoke(co, tuple(feed[n] for n in names))
     np.testing.assert_allclose(feed["g"].asnumpy(), 1.0)
     assert np.abs(feed["mm"].asnumpy()).sum() > 0
+
+
+def test_dlpack_abi(lib):
+    """C-level DLPack: export a DLManagedTensor*, re-import it, release
+    an unconsumed one via the deleter (ref MXNDArrayToDLPack family)."""
+    x = _nd_from_blob(lib, np.arange(6, dtype=np.float32).reshape(2, 3))
+    dlm = ctypes.c_void_p()
+    assert lib.MXTPUNDArrayToDLPack(x, ctypes.byref(dlm)) == 0
+    assert dlm.value
+    h2 = ctypes.c_void_p()
+    assert lib.MXTPUNDArrayFromDLPack(dlm, ctypes.byref(h2)) == 0
+    np.testing.assert_allclose(_nd_to_numpy(lib, h2),
+                               np.arange(6).reshape(2, 3))
+    dlm2 = ctypes.c_void_p()
+    assert lib.MXTPUNDArrayToDLPack(x, ctypes.byref(dlm2)) == 0
+    assert lib.MXTPUNDArrayCallDLPackDeleter(dlm2) == 0
+
+
+def test_shared_mem_abi(lib):
+    """Name-addressed shared-memory transfer (ref
+    MXNDArrayCreateFromSharedMem with POSIX-name semantics)."""
+    x = _nd_from_blob(lib, np.arange(6, dtype=np.float32).reshape(2, 3))
+    nm = ctypes.c_char_p()
+    assert lib.MXTPUNDArrayGetSharedMemHandle(x, ctypes.byref(nm)) == 0
+    shp = (ctypes.c_int64 * 2)(2, 3)
+    h = ctypes.c_void_p()
+    assert lib.MXTPUNDArrayCreateFromSharedMem(nm.value, 0, shp, 2,
+                                               ctypes.byref(h)) == 0
+    np.testing.assert_allclose(_nd_to_numpy(lib, h),
+                               np.arange(6).reshape(2, 3))

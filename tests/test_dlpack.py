@@ -1,0 +1,59 @@
+"""DLPack interchange (ref: python/mxnet/ndarray/ndarray.py:3925
+to_dlpack_for_read/to_dlpack_for_write/from_dlpack; dlpack tests in
+tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+
+
+def test_capsule_round_trip():
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = mx.nd.from_dlpack(mx.nd.to_dlpack_for_read(x))
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+    y2 = mx.nd.from_dlpack(x.to_dlpack_for_write())
+    np.testing.assert_allclose(y2.asnumpy(), x.asnumpy())
+
+
+def test_for_write_is_a_loud_host_copy():
+    """XLA buffers are immutable: the write variant delivers a host copy
+    and warns ONCE that consumer writes do not propagate (review r5)."""
+    import warnings
+    from mxtpu.ndarray import dlpack as dlp
+    dlp._warned_write = False
+    x = mx.nd.array(np.zeros(3, np.float32))
+    with pytest.warns(UserWarning, match="do not propagate"):
+        cap = x.to_dlpack_for_write()
+    torch = pytest.importorskip("torch")
+    t = torch.utils.dlpack.from_dlpack(cap)
+    t.add_(5.0)  # writes land in the copy...
+    np.testing.assert_allclose(x.asnumpy(), 0.0)  # ...never in x
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        x.to_dlpack_for_write()  # warned once only
+
+
+def test_torch_both_directions():
+    torch = pytest.importorskip("torch")
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    t = torch.utils.dlpack.from_dlpack(mx.nd.to_dlpack_for_read(x))
+    assert tuple(t.shape) == (2, 3) and float(t.sum()) == 15.0
+    z = mx.nd.from_dlpack(torch.utils.dlpack.to_dlpack(torch.arange(4.0)))
+    np.testing.assert_allclose(z.asnumpy(), [0, 1, 2, 3])
+    # modern object protocol too (no capsule in user code)
+    z2 = mx.nd.from_dlpack(torch.full((2,), 7.0))
+    np.testing.assert_allclose(z2.asnumpy(), 7.0)
+
+
+def test_from_numpy():
+    w = mx.nd.from_numpy(np.ones((2, 2), np.float32))
+    assert w.shape == (2, 2)
+    with pytest.raises(mx.base.MXNetError):
+        mx.nd.from_numpy(np.ones((4, 4), np.float32).T)  # non-contiguous
+
+
+def test_int_dtype_round_trip():
+    x = mx.nd.array(np.arange(4), dtype="int32")
+    y = mx.nd.from_dlpack(mx.nd.to_dlpack_for_read(x))
+    assert str(y.dtype) == "int32"
+    np.testing.assert_array_equal(y.asnumpy(), [0, 1, 2, 3])
