@@ -1,0 +1,69 @@
+// CachedOp + DLPack + shared-memory from pure C++ — the interop trio of
+// the mxtpu C ABI (ref: the reference reaches CachedOp only through
+// Gluon's Python frontend, and its DLPack bridge lives in
+// src/c_api/c_api.cc MXNDArrayToDLPack).
+//
+// Build/run: see tests/test_c_api.py::test_cpp_interop_via_abi.
+#include <mxtpu/mxtpu-cpp.hpp>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+namespace mc = mxtpu::cpp;
+
+int main() {
+  // hybridize from C++: compile (a + b) * a once, reuse it
+  mc::Symbol a = mc::Symbol::Variable("a");
+  mc::Symbol b = mc::Symbol::Variable("b");
+  mc::Symbol sum = mc::Symbol::Compose("elemwise_add", "sum0", {&a, &b});
+  mc::Symbol prod = mc::Symbol::Compose("elemwise_mul", "prod0", {&sum, &a});
+  mc::CachedOp op(prod);
+
+  std::vector<float> av = {1.f, 2.f, 3.f}, bv = {4.f, 5.f, 6.f};
+  mc::NDArray na({3}, av.data()), nb({3}, bv.data());
+  // inputs in list_inputs() order: a then b (a appears first in the graph)
+  std::vector<mc::NDArray> ins;
+  ins.emplace_back(mc::NDArray({3}, av.data()));
+  ins.emplace_back(mc::NDArray({3}, bv.data()));
+  std::vector<mc::NDArray> outs = op(ins);
+  std::vector<float> host = outs[0].CopyToHost();
+  for (int i = 0; i < 3; ++i) {
+    float want = (av[i] + bv[i]) * av[i];
+    if (host[i] != want) {
+      std::fprintf(stderr, "cachedop mismatch at %d: %f != %f\n", i,
+                   host[i], want);
+      return 1;
+    }
+  }
+  // second invoke hits the compiled cache
+  std::vector<mc::NDArray> outs2 = op(ins);
+  if (outs2[0].CopyToHost() != host) return 1;
+  std::printf("CACHEDOP OK\n");
+
+  // DLPack: export, inspect the standard header, re-import, release
+  void *dlm = mc::ToDLPack(na);
+  // DLManagedTensor begins with DLTensor{void* data; {i32,i32} device;
+  // i32 ndim; ...}; ndim sits after data+device
+  const char *base = static_cast<const char *>(dlm);
+  std::int32_t ndim = 0;
+  std::memcpy(&ndim, base + sizeof(void *) + 2 * sizeof(std::int32_t),
+              sizeof(ndim));
+  if (ndim != 1) {
+    std::fprintf(stderr, "dlpack ndim %d != 1\n", ndim);
+    return 1;
+  }
+  mc::NDArray back = mc::FromDLPack(dlm);  // consumes dlm
+  if (back.CopyToHost() != av) return 1;
+  void *dlm2 = mc::ToDLPack(nb);
+  mc::ReleaseDLPack(dlm2);  // unconsumed export: manual release
+  std::printf("DLPACK OK\n");
+
+  // shared memory: one-shot transfer through a named POSIX segment
+  std::string seg = mc::ToSharedMem(na);
+  mc::NDArray from_shm = mc::FromSharedMem(seg, /*dtype_flag=*/0, {3});
+  if (from_shm.CopyToHost() != av) return 1;
+  std::printf("SHAREDMEM OK\n");
+  return 0;
+}

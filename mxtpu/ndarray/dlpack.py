@@ -25,6 +25,14 @@ __all__ = ["to_dlpack_for_read", "to_dlpack_for_write", "from_dlpack",
 _DLTENSOR = b"dltensor"
 
 
+def _host_export(data: NDArray):
+    """ONE copy of the host-copy export recipe. copy=True: device_get
+    often returns READONLY views, which numpy refuses to export (DLPack
+    cannot signal readonly)."""
+    host = _np.array(data.asnumpy(), copy=True)
+    return host.__dlpack__()
+
+
 def _capsule_from(data: NDArray):
     if not isinstance(data, NDArray):
         raise MXNetError("to_dlpack expects an NDArray, got %s"
@@ -35,11 +43,8 @@ def _capsule_from(data: NDArray):
     except Exception:
         # backends without direct buffer export (e.g. tunneled PJRT
         # plugins): stage through a host copy — the consumer gets a CPU
-        # DLPack tensor, matching torch_interop's copy-always policy.
-        # copy=True: device_get often returns READONLY views, which
-        # numpy refuses to export (DLPack cannot signal readonly)
-        host = _np.array(data.asnumpy(), copy=True)
-        return host.__dlpack__()
+        # DLPack tensor, matching torch_interop's copy-always policy
+        return _host_export(data)
 
 
 def to_dlpack_for_read(data):
@@ -73,8 +78,7 @@ def to_dlpack_for_write(data):
         raise MXNetError("to_dlpack expects an NDArray, got %s"
                          % type(data).__name__)
     data.wait_to_read()
-    host = _np.array(data.asnumpy(), copy=True)
-    return host.__dlpack__()
+    return _host_export(data)
 
 
 class _CapsuleDLPack:

@@ -1294,3 +1294,14 @@ def test_shared_mem_abi(lib):
                                                ctypes.byref(h)) == 0
     np.testing.assert_allclose(_nd_to_numpy(lib, h),
                                np.arange(6).reshape(2, 3))
+
+
+def test_cpp_interop_via_abi(lib, tmp_path):
+    """C++ drives CachedOp (hybridize), DLPack exchange, and shared-memory
+    transfer through the header-only frontend (round-5 interop trio)."""
+    src = os.path.join(REPO, "examples", "cpp", "interop.cpp")
+    exe = tmp_path / "interop"
+    _compile_against_abi(src, exe, "g++", extra=("-std=c++14",))
+    out = _run_smoke(exe)
+    for marker in ("CACHEDOP OK", "DLPACK OK", "SHAREDMEM OK"):
+        assert any(marker in line for line in out), (marker, out)
