@@ -130,14 +130,20 @@ def bench_resnet50():
     # disables for A/Bs.
     s2d_flag = os.environ.get("BENCH_S2D_STEM",
                               "1" if layout == "NHWC" else "0")
-    if s2d_flag == "1" and layout != "NHWC":
-        raise RuntimeError("BENCH_S2D_STEM=1 requires BENCH_LAYOUT=NHWC "
+    if s2d_flag not in ("0", "1", "2"):
+        # a typo must not silently measure the plain stem under an s2d
+        # label on intermittently-healthy hardware
+        raise RuntimeError("BENCH_S2D_STEM=%r: valid values are 0 (plain "
+                           "stem), 1 (s2d), 2 (double-s2d)" % s2d_flag)
+    if s2d_flag in ("1", "2") and layout != "NHWC":
+        raise RuntimeError("BENCH_S2D_STEM requires BENCH_LAYOUT=NHWC "
                            "(refusing to report a plain-stem number as s2d)")
-    if s2d_flag == "1":
-        # MLPerf space-to-depth stem: exactly-equivalent 4x4 conv on 12
-        # channels instead of the MXU-hostile 7x7 on 3 (contrib/s2d_stem.py)
+    if s2d_flag in ("1", "2"):
+        # MLPerf space-to-depth stem, exactly equivalent: mode 1 = 4x4
+        # conv on 12 channels; mode 2 = double s2d -> MXU-shaped 3x3 conv
+        # on 48->256 channels + depth-to-space (contrib/s2d_stem.py)
         from mxtpu.contrib import s2d_stem
-        s2d_stem.apply_to_resnet(net)
+        s2d_stem.apply_to_resnet(net, mode=int(s2d_flag))
     if dtype != "float32":
         net.cast(dtype)
         x = x.astype(dtype)

@@ -59,29 +59,98 @@ def embed_stem_weight(w):
     return out
 
 
-class _StemFn:
-    """Callable forward for the wrapped stem (kept tiny and pickle-free)."""
+def space_to_depth4_nhwc(x):
+    """(N, H, W, C) -> (N, H/4, W/4, 16C), channel-major in (rho, sigma)."""
+    n, h, w, c = x.shape
+    y = x.reshape(n, h // 4, 4, w // 4, 4, c)
+    y = y.transpose(0, 1, 3, 2, 4, 5)
+    return y.reshape(n, h // 4, w // 4, 16 * c)
 
-    def __init__(self, weight_param, bias_param):
+
+def depth_to_space2_nhwc(y, f):
+    """(N, H, W, 4F) with channel layout (py, px, f) -> (N, 2H, 2W, F)."""
+    n, h, w, _ = y.shape
+    y = y.reshape(n, h, w, 2, 2, f)
+    y = y.transpose(0, 1, 3, 2, 4, 5)
+    return y.reshape(n, 2 * h, 2 * w, f)
+
+
+def embed_stem_weight4(w):
+    """Zero-embed a (7, 7, C, F) stem kernel into the (3, 3, 16C, 4F)
+    kernel of the DOUBLE-s2d stem (mode 2).
+
+    Derivation: output row Y = 2y + py (py in {0,1}) reads input rows
+    R = 2Y + ky - 3 = 4y + t with t = 2py + ky - 3 in [-3, 5]. Writing
+    R = 4(y + a - 1) + rho gives a = t//4 + 1 in {0,1,2} and rho = t % 4
+    — a 3-tap kernel over 4-row input blocks at stride 1 with SYMMETRIC
+    padding 1 (t = -4, i.e. block row -1 tap 0, never occurs, so no
+    asymmetric padding is needed, unlike mode 1). Columns identically.
+    The output packs the 2x2 output-pixel block into channels
+    (py*2 + px)*F + f, un-packed by depth_to_space2_nhwc.
+
+    Why: mode 1's conv is K=192 (im2col), N=64 — both underfill the MXU
+    (half the lanes, 1.5 contraction passes) and it measured no faster
+    than the plain 7x7 in isolation (perf_followup.log stem phase). This
+    shape is K=432, N=256: full lanes both sides, ~3.4 contraction
+    passes, at 56x56 spatial. ~2.9x padded FLOPs, but at large-matmul
+    efficiency the net is the win the stem needs (PERF.md stem table)."""
+    kh, kw, c, f = w.shape
+    if (kh, kw) != (7, 7):
+        raise MXNetError("s2d stem embedding expects a 7x7 kernel, got %s"
+                         % ((kh, kw),))
+    out = jnp.zeros((3, 3, 16 * c, 4 * f), w.dtype)
+    for py in range(2):
+        for ky in range(7):
+            t = 2 * py + ky - 3
+            a, rho = t // 4 + 1, t % 4
+            for px in range(2):
+                for kx in range(7):
+                    u = 2 * px + kx - 3
+                    b, sig = u // 4 + 1, u % 4
+                    ch = (rho * 4 + sig) * c
+                    fo = (py * 2 + px) * f
+                    out = out.at[a, b, ch:ch + c, fo:fo + f].set(w[ky, kx])
+    return out
+
+
+class _StemFn:
+    """Callable forward for the wrapped stem (kept tiny and pickle-free).
+    mode 1: single 2x2 s2d + 4x4 conv; mode 2: 4x4 s2d + 3x3 conv +
+    2x2 depth-to-space (see embed_stem_weight4)."""
+
+    def __init__(self, weight_param, bias_param, mode=1):
         self._w = weight_param
         self._b = bias_param
+        self._mode = mode
 
     def __call__(self, x):
         from ..ops.conv_acc import conv_fast
-        s = space_to_depth_nhwc(x)
-        w4 = embed_stem_weight(self._w)
-        out = conv_fast(s, w4, strides=(1, 1), padding=[(2, 1), (2, 1)],
-                        lhs_dilation=(1, 1), rhs_dilation=(1, 1),
-                        dims=("NHWC", "HWIO", "NHWC"), groups=1)
+        if self._mode == 2:
+            s = space_to_depth4_nhwc(x)
+            w2 = embed_stem_weight4(self._w)
+            out = conv_fast(s, w2, strides=(1, 1),
+                            padding=[(1, 1), (1, 1)],
+                            lhs_dilation=(1, 1), rhs_dilation=(1, 1),
+                            dims=("NHWC", "HWIO", "NHWC"), groups=1)
+            out = depth_to_space2_nhwc(out, self._w.shape[-1])
+        else:
+            s = space_to_depth_nhwc(x)
+            w4 = embed_stem_weight(self._w)
+            out = conv_fast(s, w4, strides=(1, 1), padding=[(2, 1), (2, 1)],
+                            lhs_dilation=(1, 1), rhs_dilation=(1, 1),
+                            dims=("NHWC", "HWIO", "NHWC"), groups=1)
         if self._b is not None:
             out = out + self._b
         return out
 
 
-def apply_to_resnet(net):
+def apply_to_resnet(net, mode=1):
     """Swap the stem Conv2D of an NHWC zoo resnet for the s2d-equivalent
     path, in place. The conv's Parameters are untouched — only its forward
-    is re-routed — so checkpoints and trainers keep working. Returns net."""
+    is re-routed — so checkpoints and trainers keep working. Returns net.
+    mode 1 = single s2d (112^2 x 12 conv4x4); mode 2 = double s2d
+    (56^2 x 48 conv3x3 -> 256ch -> depth-to-space; MXU-shaped, see
+    embed_stem_weight4)."""
     feats = list(net.features._children.values())
     conv = feats[0]
     if type(conv).__name__ != "Conv2D":
@@ -114,7 +183,8 @@ def apply_to_resnet(net):
 
     def hybrid_forward(self, F, x, weight=None, bias=None):
         return _apply(
-            lambda xd, wd, *rest: _StemFn(wd, rest[0] if rest else None)(xd),
+            lambda xd, wd, *rest: _StemFn(wd, rest[0] if rest else None,
+                                          mode=mode)(xd),
             (x, weight) + (() if bias is None else (bias,)),
             name="s2d_stem")
 

@@ -28,8 +28,16 @@ sleep 20
 #    control → each lever → stage attribution → BN microtiming → peak →
 #    eager/lstm/bert). stdbuf keeps the tee line-live so a killed run
 #    still shows where it died.
+# explicit value-ranked phase order (arg order = run order): the new
+# staged lever and the headline configs first, known-stable re-checks
+# last, so a mid-session wedge costs the least valuable tail
 timeout "${SESSION_TIMEOUT:-3600}" stdbuf -oL -eL \
-  python -u tools/perf_session.py 2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
+  python -u tools/perf_session.py \
+    probe resnet_s2d2 resnet_best bert_pad_ab flash_pad lstm \
+    resnet_control resnet_bn_onepass resnet_all_levers stem_breakdown \
+    resnet_conv_acc resnet_s2d stages convs resnet_nchw bn peak eager \
+    bandwidth bert \
+    2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
 
 # 2. lower-priority extras, each its own session, spaced by a release
 #    grace period (observed: back-to-back claims correlate with wedges)

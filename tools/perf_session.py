@@ -421,6 +421,14 @@ def phase_resnet_best():
             MXTPU_BN_ONEPASS="1")
 
 
+def phase_resnet_s2d2():
+    """Double-s2d stem (mode 2: MXU-shaped 56^2 x 48 -> 256ch 3x3 conv +
+    depth-to-space) on top of the best-known config — the staged answer
+    to the stem-breakdown finding that mode 1 does not fix the stem."""
+    _resnet("resnet_s2d2", MXTPU_CONV_ACC="0", BENCH_S2D_STEM="2",
+            MXTPU_BN_ONEPASS="1")
+
+
 def phase_flash_pad():
     """Head-dim-64 flash path: correctness (kernel vs XLA fallback, on
     chip) and fwd+bwd step time with padding vs the old [T,T] fallback.
@@ -509,6 +517,7 @@ PHASES = [
     ("lstm", phase_lstm),
     ("bert", phase_bert),
     ("resnet_best", phase_resnet_best),
+    ("resnet_s2d2", phase_resnet_s2d2),
     ("flash_pad", phase_flash_pad),
     ("bert_pad_ab", phase_bert_pad_ab),
     ("stem_breakdown", phase_stem_breakdown),
@@ -517,15 +526,16 @@ PHASES = [
 
 def main():
     want = sys.argv[1:]
-    known = {n for n, _ in PHASES}
-    bad = [w for w in want if w not in known]
+    by_name = dict(PHASES)
+    bad = [w for w in want if w not in by_name]
     if bad:
         # a typo must not silently burn the rare healthy-chip session
         sys.exit("unknown phase(s) %s; valid: %s"
-                 % (bad, " ".join(sorted(known))))
-    for name, fn in PHASES:
-        if want and name not in want:
-            continue
+                 % (bad, " ".join(sorted(by_name))))
+    # ARGUMENT order is execution order: the caller ranks phases by value
+    # so a mid-session wedge costs the tail, not the headline number
+    run = [(n, by_name[n]) for n in want] if want else PHASES
+    for name, fn in run:
         say("phase %s" % name)
         try:
             fn()
