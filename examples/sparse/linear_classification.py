@@ -44,26 +44,42 @@ def make_synthetic_libsvm(path, num_rows=2000, num_features=10000,
             f.write("%d %s\n" % (label, toks))
 
 
-def _sparse_linear_grads(x, dlogits):
-    """Row-sparse weight gradient of logits = csr_x @ W: only the feature
-    rows this batch touched get a gradient row (the reference's row_sparse
-    grad of sparse.dot, dot-inl.h DotCsrDnsRspImpl) — gather/segment-sum,
-    never a dense (num_features, C) array."""
-    import jax.numpy as jnp
+def _fused_step():
+    """One jitted forward+loss+grad program: logits via gather/segment-sum
+    (= sparse.dot), softmax CE, per-nnz weight-grad contributions — so the
+    training loop performs a SINGLE device fetch per batch. On a tunneled
+    chip each host<->device sync is a full RTT (~66 ms, PERF.md timing
+    methodology); the original loop's ~5 syncs/batch were the entire cost
+    of this workload (its math is ~0.2 MFLOP/batch)."""
     import jax
+    import jax.numpy as jnp
 
     from mxtpu.ndarray.sparse import _csr_row_ids
 
-    data = x._data
-    indices = x._aux["indices"]
-    nnz = data.shape[0]
-    rows = np.asarray(_csr_row_ids(x._aux["indptr"], nnz))
-    uniq, inv = np.unique(np.asarray(indices), return_inverse=True)
-    contrib = np.asarray(data)[:, None] * dlogits[rows]  # (nnz, C)
-    vals = jax.ops.segment_sum(jnp.asarray(contrib), jnp.asarray(inv),
-                               num_segments=len(uniq))
-    return RowSparseNDArray(vals, uniq.astype(np.int32),
-                            (x.shape[1], dlogits.shape[1]))
+    @jax.jit
+    def step(weight, bias, data, indices, indptr, y):
+        nnz = data.shape[0]
+        batch = y.shape[0]
+        # padded nnz tail: row ids land past the last row; clip and rely
+        # on data==0 there to contribute nothing (row derivation shared
+        # with todense/csr-dot: sparse.py:_csr_row_ids)
+        rows = jnp.clip(_csr_row_ids(indptr, nnz), 0, batch - 1)
+        wrows = jnp.take(weight, indices, axis=0)            # (nnz, C)
+        logits = jax.ops.segment_sum(data[:, None] * wrows, rows,
+                                     num_segments=batch) + bias
+        zmax = jnp.max(logits, axis=1, keepdims=True)
+        ez = jnp.exp(logits - zmax)
+        p = ez / jnp.sum(ez, axis=1, keepdims=True)
+        yi = y.astype(jnp.int32)
+        picked = jnp.clip(p[jnp.arange(batch), yi], 1e-12, None)
+        loss = -jnp.mean(jnp.log(picked))
+        correct = jnp.sum(jnp.argmax(logits, axis=1) == yi)
+        d = (p - jax.nn.one_hot(yi, logits.shape[1],
+                                dtype=p.dtype)) / batch
+        contrib = data[:, None] * jnp.take(d, rows, axis=0)  # (nnz, C)
+        return loss, correct, jnp.sum(d, axis=0), contrib
+
+    return step
 
 
 def train(data_path, num_features, batch_size=256, epochs=3, lr=0.05,
@@ -88,6 +104,10 @@ def train(data_path, num_features, batch_size=256, epochs=3, lr=0.05,
     bias_updater = mx.optimizer.get_updater(
         mx.optimizer.create("adam", learning_rate=lr))
 
+    import jax
+    import jax.numpy as jnp
+    step = _fused_step()
+
     loss_hist = []
     measured = 0
     for ep in range(epochs):
@@ -100,27 +120,37 @@ def train(data_path, num_features, batch_size=256, epochs=3, lr=0.05,
         for batch in it:
             x = batch.data[0]          # CSRNDArray
             y = batch.label[0]
-            logits = mx.nd.sparse.dot(x, weight) + bias
-            lg = logits.asnumpy()
-            yv = y.asnumpy().astype(int)
-            p = np.exp(lg - lg.max(1, keepdims=True))
-            p /= p.sum(1, keepdims=True)
-            loss = float(-np.log(np.maximum(
-                p[np.arange(len(yv)), yv], 1e-12)).mean())
-            dlogits = p.copy()
-            dlogits[np.arange(len(yv)), yv] -= 1.0
-            dlogits /= batch_size
-
-            wgrad = _sparse_linear_grads(x, dlogits)
+            # bucket nnz so real LibSVM data (varying nnz/batch) reuses a
+            # few compiled programs; zero-padded entries contribute nothing
+            nnz = x._data.shape[0]
+            pad = (-nnz) % 4096
+            data = jnp.pad(x._data, (0, pad))
+            indices = jnp.pad(x._aux["indices"], (0, pad))
+            loss_d, correct_d, bgrad_d, contrib_d = step(
+                weight._data, bias._data, data, indices,
+                x._aux["indptr"], y._data)
+            # THE one device fetch of the batch (everything above is
+            # async dispatch; everything below is host-side numpy)
+            loss, ncorrect, contrib, idx_host = jax.device_get(
+                (loss_d, correct_d, contrib_d, indices))
+            # unique over the REAL entries only: a padded index would put
+            # a phantom zero-grad row in the row-sparse grad, and lazy
+            # Adam's momentum would then drift that row on every batch
+            uniq, inv = np.unique(idx_host[:nnz], return_inverse=True)
+            vals = np.zeros((len(uniq), contrib.shape[1]), np.float32)
+            np.add.at(vals, inv, contrib[:nnz])
+            wgrad = RowSparseNDArray(jnp.asarray(vals),
+                                     uniq.astype(np.int32),
+                                     (x.shape[1], contrib.shape[1]))
             updater(0, wgrad, weight)
-            bias_updater(1, mx.nd.array(dlogits.sum(0)), bias)
+            bias_updater(1, mx.nd.from_jax(bgrad_d), bias)
             if kv is not None:
                 kv.push("weight", weight)
                 kv.row_sparse_pull("weight", out=weight,
                                    row_ids=x.indices)
-            correct += int((lg.argmax(1) == yv).sum())
+            correct += int(ncorrect)
             total += batch_size
-            lsum += loss
+            lsum += float(loss)
             nb += 1
         loss_hist.append(lsum / nb)
     if measure:

@@ -119,6 +119,8 @@ class _StemFn:
     2x2 depth-to-space (see embed_stem_weight4)."""
 
     def __init__(self, weight_param, bias_param, mode=1):
+        if mode not in (1, 2):  # strings/typos must not silently run mode 1
+            raise MXNetError("s2d stem mode must be 1 or 2, got %r" % (mode,))
         self._w = weight_param
         self._b = bias_param
         self._mode = mode
@@ -151,6 +153,8 @@ def apply_to_resnet(net, mode=1):
     mode 1 = single s2d (112^2 x 12 conv4x4); mode 2 = double s2d
     (56^2 x 48 conv3x3 -> 256ch -> depth-to-space; MXU-shaped, see
     embed_stem_weight4)."""
+    if mode not in (1, 2):
+        raise MXNetError("s2d stem mode must be 1 or 2, got %r" % (mode,))
     feats = list(net.features._children.values())
     conv = feats[0]
     if type(conv).__name__ != "Conv2D":

@@ -30,13 +30,14 @@ sleep 20
 #    still shows where it died.
 # explicit value-ranked phase order (arg order = run order): the new
 # staged lever and the headline configs first, known-stable re-checks
-# last, so a mid-session wedge costs the least valuable tail
+# last, so a mid-session wedge costs the least valuable tail. The
+# trailing 'rest' sentinel expands to any phase not named above, so a
+# phase added to perf_session.py is never silently unmeasured.
 timeout "${SESSION_TIMEOUT:-3600}" stdbuf -oL -eL \
   python -u tools/perf_session.py \
     probe resnet_s2d2 resnet_best bert_pad_ab flash_pad lstm \
     resnet_control resnet_bn_onepass resnet_all_levers stem_breakdown \
-    resnet_conv_acc resnet_s2d stages convs resnet_nchw bn peak eager \
-    bandwidth bert \
+    rest \
     2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
 
 # 2. lower-priority extras, each its own session, spaced by a release

@@ -527,14 +527,25 @@ PHASES = [
 def main():
     want = sys.argv[1:]
     by_name = dict(PHASES)
-    bad = [w for w in want if w not in by_name]
+    bad = [w for w in want if w not in by_name and w != "rest"]
     if bad:
         # a typo must not silently burn the rare healthy-chip session
-        sys.exit("unknown phase(s) %s; valid: %s"
+        sys.exit("unknown phase(s) %s; valid: %s (+ the sentinel 'rest')"
                  % (bad, " ".join(sorted(by_name))))
     # ARGUMENT order is execution order: the caller ranks phases by value
-    # so a mid-session wedge costs the tail, not the headline number
-    run = [(n, by_name[n]) for n in want] if want else PHASES
+    # so a mid-session wedge costs the tail, not the headline number. The
+    # sentinel 'rest' expands to every phase not named earlier — so a
+    # ranked list can never silently drop a newly added phase.
+    if want:
+        run = []
+        for n in want:
+            if n == "rest":
+                run += [(pn, fn) for pn, fn in PHASES
+                        if pn not in [r[0] for r in run]]
+            elif n not in [r[0] for r in run]:
+                run.append((n, by_name[n]))
+    else:
+        run = PHASES
     for name, fn in run:
         say("phase %s" % name)
         try:
