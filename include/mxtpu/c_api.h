@@ -438,6 +438,38 @@ int MXTPUSetProfilerState(int state); /* 1 run, 0 stop */
 int MXTPUDumpProfile(int finished);
 int MXTPUProfilePause(int paused);
 
+/* ---- profiler object family (ref: MXProfileCreateDomain / CreateTask /
+ * CreateFrame / CreateEvent / CreateCounter / MXProfileDestroyHandle /
+ * DurationStart / DurationStop / SetCounter / AdjustCounter / SetMarker /
+ * MXAggregateProfileStatsPrint). Scoped user timing: create an object,
+ * bracket work with DurationStart/Stop (or fire SetMarker), and read the
+ * aggregate table. Counter values appear in the aggregate stream as
+ * zero-duration "name=value" instants. Free objects with
+ * MXTPUProfileDestroyHandle. ---- */
+
+typedef void *ProfileHandle;
+
+int MXTPUProfileCreateDomain(const char *name, ProfileHandle *out);
+int MXTPUProfileCreateTask(ProfileHandle domain, const char *name,
+                           ProfileHandle *out);
+int MXTPUProfileCreateFrame(ProfileHandle domain, const char *name,
+                            ProfileHandle *out);
+int MXTPUProfileCreateEvent(const char *name, ProfileHandle *out);
+int MXTPUProfileCreateCounter(ProfileHandle domain, const char *name,
+                              ProfileHandle *out);
+int MXTPUProfileDestroyHandle(ProfileHandle handle);
+int MXTPUProfileDurationStart(ProfileHandle handle);
+int MXTPUProfileDurationStop(ProfileHandle handle);
+int MXTPUProfileSetCounter(ProfileHandle handle, uint64_t value);
+int MXTPUProfileAdjustCounter(ProfileHandle handle, int64_t delta);
+/* scope may be NULL (= "process"). */
+int MXTPUProfileSetMarker(ProfileHandle domain, const char *name,
+                          const char *scope);
+/* Aggregate stats table (ref MXAggregateProfileStatsPrint); string valid
+ * until the next string-returning call on this thread. reset=1 clears
+ * the accumulated events. */
+int MXTPUAggregateProfileStatsPrint(const char **out_str, int reset);
+
 /* ---- runtime/introspection breadth (ref: MXGetGPUCount /
  * MXGetGPUMemoryInformation64 / MXNotifyShutdown / MXEngineSetBulkSize /
  * MXSetNumOMPThreads / MXRandomSeedContext). ---- */

@@ -953,6 +953,89 @@ def profiler_dump(finished: int) -> None:
     profiler.dump(finished=bool(finished))
 
 
+# ---- profiler object family (ref: MXProfileCreateDomain / CreateTask /
+# CreateFrame / CreateEvent / CreateCounter / DurationStart / DurationStop
+# / SetCounter / AdjustCounter / SetMarker / MXAggregateProfileStatsPrint,
+# src/c_api/c_api_profile.cc — scoped user timing objects over
+# mxtpu/profiler.py ProfileTask/Frame/Event) ----
+
+class _ProfileDomain:
+    def __init__(self, name):
+        self.name = name
+
+
+class _ProfileCounter:
+    """Counter values land in the event stream as zero-duration
+    "name=value" instants under cat "counter" (the chrome-trace 'C'
+    phase is collapsed into the aggregate table the profiler keeps)."""
+
+    def __init__(self, domain, name):
+        self.name = ("%s:%s" % (domain.name, name)) if domain else name
+        self.value = 0
+
+    def _record(self):
+        import time as _t
+        from . import profiler
+        if profiler.is_active():
+            profiler.record_event("%s=%d" % (self.name, self.value),
+                                  "counter", _t.perf_counter_ns() // 1000, 0)
+
+
+def profile_create_domain(name: str):
+    return _ProfileDomain(name)
+
+
+def profile_create_task(domain, name: str):
+    from . import profiler
+    return profiler.ProfileTask(name, domain=domain)
+
+
+def profile_create_frame(domain, name: str):
+    from . import profiler
+    return profiler.ProfileFrame(name, domain=domain)
+
+
+def profile_create_event(name: str):
+    from . import profiler
+    return profiler.ProfileEvent(name)
+
+
+def profile_create_counter(domain, name: str):
+    return _ProfileCounter(domain, name)
+
+
+def profile_duration_start(obj) -> None:
+    obj.start()
+
+
+def profile_duration_stop(obj) -> None:
+    obj.stop()
+
+
+def profile_set_counter(counter, value: int) -> None:
+    counter.value = int(value)
+    counter._record()
+
+
+def profile_adjust_counter(counter, delta: int) -> None:
+    counter.value += int(delta)
+    counter._record()
+
+
+def profile_set_marker(domain, name: str, scope: str) -> None:
+    import time as _t
+    from . import profiler
+    if profiler.is_active():
+        nm = ("%s:%s" % (domain.name, name)) if domain else name
+        profiler.record_event(nm, "marker:%s" % (scope or "process"),
+                              _t.perf_counter_ns() // 1000, 0)
+
+
+def profile_aggregate_stats(reset: int) -> str:
+    from . import profiler
+    return profiler.dumps(reset=bool(reset))
+
+
 def profiler_pause(paused: int) -> None:
     from . import profiler
     if paused:

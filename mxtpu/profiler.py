@@ -232,8 +232,12 @@ class ProfileScope:
     def stop(self):
         if self._t0 is None:
             return
-        dur = (time.perf_counter_ns() - self._t0) // 1000
-        record_event(self.name, self.cat, self._t0 // 1000, dur)
+        # gate on the profiler state like counters/markers do: an app
+        # bracketing every batch with a scope while profiling is OFF must
+        # not grow the event list without bound
+        if is_active():
+            dur = (time.perf_counter_ns() - self._t0) // 1000
+            record_event(self.name, self.cat, self._t0 // 1000, dur)
         self._t0 = None
 
     def __enter__(self):
@@ -244,14 +248,21 @@ class ProfileScope:
         self.stop()
 
 
+def _domain_name(domain, name):
+    """Tasks/frames in different domains must stay distinct rows in the
+    aggregate table (ref MXProfileCreateTask keeps them apart)."""
+    dn = getattr(domain, "name", None)
+    return "%s:%s" % (dn, name) if dn else name
+
+
 class ProfileTask(ProfileScope):
     def __init__(self, name, domain=None):
-        super().__init__(name, cat="task")
+        super().__init__(_domain_name(domain, name), cat="task")
 
 
 class ProfileFrame(ProfileScope):
     def __init__(self, name, domain=None):
-        super().__init__(name, cat="frame")
+        super().__init__(_domain_name(domain, name), cat="frame")
 
 
 class ProfileEvent(ProfileScope):

@@ -1892,6 +1892,102 @@ int MXTPUProfilePause(int paused) {
   return CallNoResult("profiler_pause", Py_BuildValue("(i)", paused));
 }
 
+/* ---- profiler object family (ref: MXProfileCreate* / Duration* /
+ * SetCounter / AdjustCounter / SetMarker / MXAggregateProfileStatsPrint,
+ * src/c_api/c_api_profile.cc) ---- */
+
+int MXTPUProfileCreateDomain(const char *name, ProfileHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallToHandle("profile_create_domain", Py_BuildValue("(s)", name),
+                      out);
+}
+
+int MXTPUProfileCreateTask(ProfileHandle domain, const char *name,
+                           ProfileHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "profile_create_task",
+      Py_BuildValue("(Os)", reinterpret_cast<PyObject *>(domain), name),
+      out);
+}
+
+int MXTPUProfileCreateFrame(ProfileHandle domain, const char *name,
+                            ProfileHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "profile_create_frame",
+      Py_BuildValue("(Os)", reinterpret_cast<PyObject *>(domain), name),
+      out);
+}
+
+int MXTPUProfileCreateEvent(const char *name, ProfileHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return CallToHandle("profile_create_event", Py_BuildValue("(s)", name),
+                      out);
+}
+
+int MXTPUProfileCreateCounter(ProfileHandle domain, const char *name,
+                              ProfileHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "profile_create_counter",
+      Py_BuildValue("(Os)", reinterpret_cast<PyObject *>(domain), name),
+      out);
+}
+
+int MXTPUProfileDestroyHandle(ProfileHandle handle) {
+  return FreeHandle(handle);
+}
+
+int MXTPUProfileDurationStart(ProfileHandle handle) {
+  GilScope gil;
+  return CallNoResult(
+      "profile_duration_start",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+}
+
+int MXTPUProfileDurationStop(ProfileHandle handle) {
+  GilScope gil;
+  return CallNoResult(
+      "profile_duration_stop",
+      PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+}
+
+int MXTPUProfileSetCounter(ProfileHandle handle, uint64_t value) {
+  GilScope gil;
+  return CallNoResult(
+      "profile_set_counter",
+      Py_BuildValue("(OK)", reinterpret_cast<PyObject *>(handle),
+                    static_cast<unsigned long long>(value)));
+}
+
+int MXTPUProfileAdjustCounter(ProfileHandle handle, int64_t delta) {
+  GilScope gil;
+  return CallNoResult(
+      "profile_adjust_counter",
+      Py_BuildValue("(OL)", reinterpret_cast<PyObject *>(handle),
+                    static_cast<long long>(delta)));
+}
+
+int MXTPUProfileSetMarker(ProfileHandle domain, const char *name,
+                          const char *scope) {
+  GilScope gil;
+  return CallNoResult(
+      "profile_set_marker",
+      Py_BuildValue("(Osz)", reinterpret_cast<PyObject *>(domain), name,
+                    scope));
+}
+
+int MXTPUAggregateProfileStatsPrint(const char **out_str, int reset) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  return StringResult(
+      CallImpl("profile_aggregate_stats", Py_BuildValue("(i)", reset)),
+      out_str);
+}
+
 /* ---- runtime/introspection breadth ---- */
 
 int MXTPUGetDeviceCount(int *out) {

@@ -1305,3 +1305,38 @@ def test_cpp_interop_via_abi(lib, tmp_path):
     out = _run_smoke(exe)
     for marker in ("CACHEDOP OK", "DLPACK OK", "SHAREDMEM OK"):
         assert any(marker in line for line in out), (marker, out)
+
+
+def test_profile_object_family_abi(lib, tmp_path):
+    """Scoped profiler objects from C (ref MXProfileCreate* family):
+    task/frame/event durations, counters, markers, and the aggregate
+    stats table."""
+    import time
+    pk = (ctypes.c_char_p * 1)(b"filename")
+    pv = (ctypes.c_char_p * 1)(str(tmp_path / "pobj.json").encode())
+    assert lib.MXTPUSetProfilerConfig(1, pk, pv) == 0
+    assert lib.MXTPUSetProfilerState(1) == 0
+    try:
+        dom = ctypes.c_void_p()
+        assert lib.MXTPUProfileCreateDomain(b"dom", ctypes.byref(dom)) == 0
+        task = ctypes.c_void_p()
+        assert lib.MXTPUProfileCreateTask(dom, b"abi_task",
+                                          ctypes.byref(task)) == 0
+        assert lib.MXTPUProfileDurationStart(task) == 0
+        time.sleep(0.005)
+        assert lib.MXTPUProfileDurationStop(task) == 0
+        ctr = ctypes.c_void_p()
+        assert lib.MXTPUProfileCreateCounter(dom, b"abi_ctr",
+                                             ctypes.byref(ctr)) == 0
+        assert lib.MXTPUProfileSetCounter(ctr, 41) == 0
+        assert lib.MXTPUProfileAdjustCounter(ctr, 1) == 0
+        assert lib.MXTPUProfileSetMarker(dom, b"abi_mark", b"process") == 0
+        stats = ctypes.c_char_p()
+        assert lib.MXTPUAggregateProfileStatsPrint(ctypes.byref(stats),
+                                                   1) == 0
+        s = stats.value.decode()
+        assert "abi_task" in s and "abi_ctr=42" in s and "abi_mark" in s
+        for h in (task, ctr, dom):
+            assert lib.MXTPUProfileDestroyHandle(h) == 0
+    finally:
+        lib.MXTPUSetProfilerState(0)

@@ -534,14 +534,18 @@ def main():
                  % (bad, " ".join(sorted(by_name))))
     # ARGUMENT order is execution order: the caller ranks phases by value
     # so a mid-session wedge costs the tail, not the headline number. The
-    # sentinel 'rest' expands to every phase not named earlier — so a
-    # ranked list can never silently drop a newly added phase.
+    # sentinel 'rest' expands (at its position) to every phase NOT named
+    # explicitly anywhere in argv — so a ranked list can never silently
+    # drop a newly added phase, and a phase named AFTER 'rest' keeps its
+    # explicit position instead of being swallowed by the expansion.
     if want:
+        explicit = {n for n in want if n != "rest"}
         run = []
         for n in want:
             if n == "rest":
                 run += [(pn, fn) for pn, fn in PHASES
-                        if pn not in [r[0] for r in run]]
+                        if pn not in explicit
+                        and pn not in [r[0] for r in run]]
             elif n not in [r[0] for r in run]:
                 run.append((n, by_name[n]))
     else:
