@@ -470,6 +470,30 @@ int MXTPUProfileSetMarker(ProfileHandle domain, const char *name,
  * the accumulated events. */
 int MXTPUAggregateProfileStatsPrint(const char **out_str, int reset);
 
+/* ---- runtime kernel compilation (ref: MXRtcCudaModuleCreate /
+ * MXRtcCudaKernelCreate / MXRtcCudaKernelCall / MXRtcCudaModuleFree /
+ * MXRtcCudaKernelFree over NVRTC). TPU-native reinterpretation: `source`
+ * is PYTHON text defining Pallas kernel function(s) over Refs
+ * (mxtpu/rtc.py PallasModule); the kernel compiles per launch signature
+ * and runs on the accelerator. exports may be NULL (= every function in
+ * the source). Output arrays are fresh caller-owned handles. ---- */
+
+typedef void *RtcHandle;
+
+int MXTPURtcModuleCreate(const char *source, int num_exports,
+                         const char **exports, RtcHandle *out);
+int MXTPURtcModuleFree(RtcHandle handle);
+int MXTPURtcKernelCreate(RtcHandle module, const char *name,
+                         int num_outputs, RtcHandle *out);
+int MXTPURtcKernelFree(RtcHandle handle);
+/* out_shape_data packs each output's dims back-to-back
+ * (out_shape_ndim[i] dims each); dtype flags as in CreateFromBlobEx. */
+int MXTPURtcKernelCall(RtcHandle kernel, int num_inputs,
+                       NDArrayHandle *inputs, int num_outputs,
+                       const int64_t *out_shape_data,
+                       const int *out_shape_ndim,
+                       const int *out_dtype_flags, NDArrayHandle *outputs);
+
 /* ---- runtime/introspection breadth (ref: MXGetGPUCount /
  * MXGetGPUMemoryInformation64 / MXNotifyShutdown / MXEngineSetBulkSize /
  * MXSetNumOMPThreads / MXRandomSeedContext). ---- */
@@ -532,6 +556,10 @@ int MXTPUNDArraySlice(NDArrayHandle handle, int64_t begin, int64_t end,
                       NDArrayHandle *out);
 int MXTPUNDArrayReshape(NDArrayHandle handle, const int64_t *shape, int ndim,
                         NDArrayHandle *out);
+/* Name-parity alias of Reshape (this ABI is int64 throughout; ref
+ * MXNDArrayReshape64). */
+int MXTPUNDArrayReshape64(NDArrayHandle handle, const int64_t *shape,
+                          int ndim, NDArrayHandle *out);
 /* Overwrite the array's contents from packed host bytes of its dtype. */
 int MXTPUNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
                                 size_t size);

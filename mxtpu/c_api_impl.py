@@ -965,20 +965,29 @@ class _ProfileDomain:
 
 
 class _ProfileCounter:
-    """Counter values land in the event stream as zero-duration
-    "name=value" instants under cat "counter" (the chrome-trace 'C'
-    phase is collapsed into the aggregate table the profiler keeps)."""
+    """One aggregate row per counter (its CURRENT value) — per-update
+    events would make a 100k-update counter a 100k-row table. Updates are
+    lock-guarded: += spans two bytecodes and the GIL may switch between
+    them, so concurrent C threads would otherwise lose increments (the
+    reference's MXProfileAdjustCounter is atomic for exactly this)."""
 
     def __init__(self, domain, name):
+        import threading
         self.name = ("%s:%s" % (domain.name, name)) if domain else name
         self.value = 0
+        self._lock = threading.Lock()
+        _LIVE_COUNTERS[self.name] = self
 
-    def _record(self):
-        import time as _t
-        from . import profiler
-        if profiler.is_active():
-            profiler.record_event("%s=%d" % (self.name, self.value),
-                                  "counter", _t.perf_counter_ns() // 1000, 0)
+    def set(self, value):
+        with self._lock:
+            self.value = int(value)
+
+    def adjust(self, delta):
+        with self._lock:
+            self.value += int(delta)
+
+
+_LIVE_COUNTERS = {}  # name -> _ProfileCounter (aggregate-stats rows)
 
 
 def profile_create_domain(name: str):
@@ -1013,13 +1022,11 @@ def profile_duration_stop(obj) -> None:
 
 
 def profile_set_counter(counter, value: int) -> None:
-    counter.value = int(value)
-    counter._record()
+    counter.set(value)
 
 
 def profile_adjust_counter(counter, delta: int) -> None:
-    counter.value += int(delta)
-    counter._record()
+    counter.adjust(delta)
 
 
 def profile_set_marker(domain, name: str, scope: str) -> None:
@@ -1033,7 +1040,13 @@ def profile_set_marker(domain, name: str, scope: str) -> None:
 
 def profile_aggregate_stats(reset: int) -> str:
     from . import profiler
-    return profiler.dumps(reset=bool(reset))
+    table = profiler.dumps(reset=bool(reset))
+    if _LIVE_COUNTERS:
+        lines = ["", "Counters:"]
+        for name in sorted(_LIVE_COUNTERS):
+            lines.append("%s=%d" % (name, _LIVE_COUNTERS[name].value))
+        table += "\n".join(lines)
+    return table
 
 
 def profiler_pause(paused: int) -> None:
@@ -1042,6 +1055,29 @@ def profiler_pause(paused: int) -> None:
         profiler.pause()
     else:
         profiler.resume()
+
+
+# ---- runtime kernel compilation (ref: MXRtcCudaModuleCreate /
+# MXRtcCudaKernelCreate / MXRtcCudaKernelCall, src/c_api/c_api.cc over
+# src/common/rtc.cc NVRTC — here mxtpu/rtc.py PallasModule: the source
+# string is Python defining Pallas kernel functions) ----
+
+def rtc_module_create(source: str, exports: tuple):
+    from .rtc import PallasModule
+    return PallasModule(source, exports=list(exports) if exports else None)
+
+
+def rtc_kernel_create(module, name: str, num_outputs: int):
+    return module.get_kernel(name, num_outputs=num_outputs)
+
+
+def rtc_kernel_call(kernel, inputs: tuple, out_shapes: tuple,
+                    out_dtype_flags: tuple):
+    dts = [_DTYPE_FLAGS[int(f)] for f in out_dtype_flags]
+    outs = kernel.launch(list(inputs),
+                         [tuple(int(d) for d in s) for s in out_shapes],
+                         out_dtypes=dts)
+    return tuple(outs) if isinstance(outs, list) else (outs,)
 
 
 # ---- misc breadth (ref: MXGetGPUCount / MXGetGPUMemoryInformation64 /

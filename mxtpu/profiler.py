@@ -227,17 +227,18 @@ class ProfileScope:
         self._t0 = None
 
     def start(self):
-        self._t0 = time.perf_counter_ns()
+        # gate at START: a scope opened while profiling is OFF records
+        # nothing (no unbounded event growth from always-on bracketing),
+        # while a scope opened during an active window is recorded even
+        # if the profiler stops before the bracket closes (teardown must
+        # not silently drop an in-flight measurement)
+        self._t0 = time.perf_counter_ns() if is_active() else None
 
     def stop(self):
         if self._t0 is None:
             return
-        # gate on the profiler state like counters/markers do: an app
-        # bracketing every batch with a scope while profiling is OFF must
-        # not grow the event list without bound
-        if is_active():
-            dur = (time.perf_counter_ns() - self._t0) // 1000
-            record_event(self.name, self.cat, self._t0 // 1000, dur)
+        dur = (time.perf_counter_ns() - self._t0) // 1000
+        record_event(self.name, self.cat, self._t0 // 1000, dur)
         self._t0 = None
 
     def __enter__(self):

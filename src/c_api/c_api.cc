@@ -1203,6 +1203,13 @@ int MXTPUNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
       Py_BuildValue("(ON)", reinterpret_cast<PyObject *>(handle), bytes));
 }
 
+int MXTPUNDArrayReshape64(NDArrayHandle handle, const int64_t *shape,
+                          int ndim, NDArrayHandle *out) {
+  /* the reference splits 32/64-bit shape variants; this ABI is int64
+   * throughout, so Reshape64 is a name-parity alias */
+  return MXTPUNDArrayReshape(handle, shape, ndim, out);
+}
+
 int MXTPUNDArrayGetContext(NDArrayHandle handle, const char **out) {
   GilScope gil;
   return StringResult(
@@ -1890,6 +1897,69 @@ int MXTPUProfilePause(int paused) {
   if (!EnsureInterpreter()) return -1;
   GilScope gil;
   return CallNoResult("profiler_pause", Py_BuildValue("(i)", paused));
+}
+
+/* ---- runtime kernel compilation (ref: MXRtcCudaModuleCreate /
+ * MXRtcCudaKernelCreate / MXRtcCudaKernelCall over NVRTC; here the
+ * source is Python defining Pallas kernels — mxtpu/rtc.py) ---- */
+
+int MXTPURtcModuleCreate(const char *source, int num_exports,
+                         const char **exports, RtcHandle *out) {
+  if (!EnsureInterpreter()) return -1;
+  GilScope gil;
+  PyObject *exp = exports == nullptr ? PyTuple_New(0)
+                                     : StrTuple(exports, num_exports);
+  return CallToHandle("rtc_module_create",
+                      Py_BuildValue("(sN)", source, exp), out);
+}
+
+int MXTPURtcModuleFree(RtcHandle handle) { return FreeHandle(handle); }
+
+int MXTPURtcKernelCreate(RtcHandle module, const char *name,
+                         int num_outputs, RtcHandle *out) {
+  GilScope gil;
+  return CallToHandle(
+      "rtc_kernel_create",
+      Py_BuildValue("(Osi)", reinterpret_cast<PyObject *>(module), name,
+                    num_outputs),
+      out);
+}
+
+int MXTPURtcKernelFree(RtcHandle handle) { return FreeHandle(handle); }
+
+int MXTPURtcKernelCall(RtcHandle kernel, int num_inputs,
+                       NDArrayHandle *inputs, int num_outputs,
+                       const int64_t *out_shape_data,
+                       const int *out_shape_ndim,
+                       const int *out_dtype_flags, NDArrayHandle *outputs) {
+  GilScope gil;
+  PyObject *shapes = PyTuple_New(num_outputs);
+  int off = 0;
+  for (int i = 0; i < num_outputs; ++i) {
+    PyTuple_SetItem(shapes, i,
+                    ShapeTuple(out_shape_data + off, out_shape_ndim[i]));
+    off += out_shape_ndim[i];
+  }
+  PyObject *flags = PyTuple_New(num_outputs);
+  for (int i = 0; i < num_outputs; ++i)
+    PyTuple_SetItem(flags, i, PyLong_FromLong(out_dtype_flags[i]));
+  PyObject *res = CallImpl(
+      "rtc_kernel_call",
+      Py_BuildValue("(ONNN)", reinterpret_cast<PyObject *>(kernel),
+                    HandleTuple(inputs, num_inputs), shapes, flags));
+  if (res == nullptr) return -1;
+  if (PyTuple_Size(res) != num_outputs) {
+    Py_DECREF(res);
+    SetError("MXTPURtcKernelCall: output count mismatch");
+    return -1;
+  }
+  for (int i = 0; i < num_outputs; ++i) {
+    PyObject *o = PyTuple_GetItem(res, i);
+    Py_INCREF(o);
+    outputs[i] = o;
+  }
+  Py_DECREF(res);
+  return 0;
 }
 
 /* ---- profiler object family (ref: MXProfileCreate* / Duration* /

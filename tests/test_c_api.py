@@ -1340,3 +1340,41 @@ def test_profile_object_family_abi(lib, tmp_path):
             assert lib.MXTPUProfileDestroyHandle(h) == 0
     finally:
         lib.MXTPUSetProfilerState(0)
+
+
+def test_rtc_abi(lib):
+    """Runtime Pallas-kernel compilation from C (ref MXRtcCudaModule* /
+    MXRtcCudaKernel* — source here is Python defining Pallas kernels)."""
+    src = (b"def saxpy(x_ref, y_ref, o_ref):\n"
+           b"    o_ref[...] = 2.0 * x_ref[...] + y_ref[...]\n")
+    mod = ctypes.c_void_p()
+    assert lib.MXTPURtcModuleCreate(src, 0, None, ctypes.byref(mod)) == 0
+    k = ctypes.c_void_p()
+    assert lib.MXTPURtcKernelCreate(mod, b"saxpy", 1, ctypes.byref(k)) == 0
+    x = _nd_from_blob(lib, np.arange(8, dtype=np.float32))
+    y = _nd_from_blob(lib, np.ones(8, np.float32))
+    ins = (ctypes.c_void_p * 2)(x, y)
+    shp = (ctypes.c_int64 * 1)(8)
+    nd1 = (ctypes.c_int * 1)(1)
+    dt = (ctypes.c_int * 1)(0)
+    outs = (ctypes.c_void_p * 1)()
+    assert lib.MXTPURtcKernelCall(k, 2, ins, 1, shp, nd1, dt, outs) == 0
+    np.testing.assert_allclose(
+        _nd_to_numpy(lib, ctypes.c_void_p(outs[0])),
+        2 * np.arange(8) + 1)
+    # unknown kernel name errors loudly
+    k2 = ctypes.c_void_p()
+    assert lib.MXTPURtcKernelCreate(mod, b"nope", 1, ctypes.byref(k2)) == -1
+    lib.MXTPUGetLastError.restype = ctypes.c_char_p
+    assert b"nope" in lib.MXTPUGetLastError()
+    assert lib.MXTPURtcKernelFree(k) == 0
+    assert lib.MXTPURtcModuleFree(mod) == 0
+
+
+def test_reshape64_alias_abi(lib):
+    h = _nd_from_blob(lib, np.arange(6, dtype=np.float32))
+    shp = (ctypes.c_int64 * 2)(2, 3)
+    out = ctypes.c_void_p()
+    assert lib.MXTPUNDArrayReshape64(h, shp, 2, ctypes.byref(out)) == 0
+    np.testing.assert_allclose(_nd_to_numpy(lib, out),
+                               np.arange(6).reshape(2, 3))
