@@ -154,6 +154,10 @@ int MXTPUExecutorNumOutputs(ExecutorHandle handle, int *num);
 int MXTPUExecutorOutput(ExecutorHandle handle, int index,
                         NDArrayHandle *out);
 int MXTPUExecutorBackward(ExecutorHandle handle);
+/* Backward with explicit head gradients; NULL ograds = ones-like seeds
+ * (ref MXExecutorBackwardEx). */
+int MXTPUExecutorBackwardEx(ExecutorHandle handle, int num_ograds,
+                            NDArrayHandle *ograds);
 int MXTPUExecutorArgGrad(ExecutorHandle handle, const char *arg_name,
                          NDArrayHandle *out);
 int MXTPUExecutorFree(ExecutorHandle handle);
@@ -240,6 +244,9 @@ int MXTPUSymbolGetAttr(SymbolHandle handle, const char *key,
 /* Flattened (key, value, key, value, ...); *out_num counts entries. */
 int MXTPUSymbolListAttr(SymbolHandle handle, int *out_num,
                         const char ***out_kv);
+/* Name-parity alias: this runtime's ListAttr is already shallow. */
+int MXTPUSymbolListAttrShallow(SymbolHandle handle, int *out_num,
+                               const char ***out_kv);
 int MXTPUSymbolListOutputs(SymbolHandle handle, int *out_num,
                            const char ***out_names);
 int MXTPUSymbolListAuxiliaryStates(SymbolHandle handle, int *out_num,
@@ -339,6 +346,10 @@ int MXTPUNDArrayCreateSparseEx(int stype, NDArrayHandle data, int num_aux,
                                NDArrayHandle *aux, const int64_t *shape,
                                int ndim, NDArrayHandle *out);
 int MXTPUNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out);
+/* fresh-grad bookkeeping bit (ref MXNDArraySetGradState/GetGradState —
+ * the NDArray.fresh_grad frontend flag, stored verbatim). */
+int MXTPUNDArraySetGradState(NDArrayHandle handle, int state);
+int MXTPUNDArrayGetGradState(NDArrayHandle handle, int *out);
 int MXTPUNDArrayGetAuxNDArray(NDArrayHandle handle, int i,
                               NDArrayHandle *out);
 int MXTPUNDArrayGetAuxType(NDArrayHandle handle, int i, int *out_flag);
@@ -469,6 +480,16 @@ int MXTPUProfileSetMarker(ProfileHandle domain, const char *name,
  * until the next string-returning call on this thread. reset=1 clears
  * the accumulated events. */
 int MXTPUAggregateProfileStatsPrint(const char **out_str, int reset);
+
+/* Process-variant aliases (ref: MXSetProcessProfilerConfig / State /
+ * MXDumpProcessProfile / MXProcessProfilePause). Symmetric single-role
+ * runtime: profile_process selects nothing (README ADR — no server
+ * processes exist); these alias the worker-profiler calls. */
+int MXTPUSetProcessProfilerConfig(int num, const char **keys,
+                                  const char **vals, int profile_process);
+int MXTPUSetProcessProfilerState(int state, int profile_process);
+int MXTPUDumpProcessProfile(int finished, int profile_process);
+int MXTPUProcessProfilePause(int paused, int profile_process);
 
 /* ---- runtime kernel compilation (ref: MXRtcCudaModuleCreate /
  * MXRtcCudaKernelCreate / MXRtcCudaKernelCall / MXRtcCudaModuleFree /

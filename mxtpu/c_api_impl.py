@@ -1038,6 +1038,15 @@ def profile_set_marker(domain, name: str, scope: str) -> None:
                               _t.perf_counter_ns() // 1000, 0)
 
 
+def profile_destroy(obj) -> None:
+    """Deregister (ref MXProfileDestroyHandle): a destroyed counter must
+    leave the aggregate table — the registry's strong ref would otherwise
+    keep every per-phase counter alive and listed forever."""
+    name = getattr(obj, "name", None)
+    if name is not None and _LIVE_COUNTERS.get(name) is obj:
+        del _LIVE_COUNTERS[name]
+
+
 def profile_aggregate_stats(reset: int) -> str:
     from . import profiler
     table = profiler.dumps(reset=bool(reset))
@@ -1055,6 +1064,27 @@ def profiler_pause(paused: int) -> None:
         profiler.pause()
     else:
         profiler.resume()
+
+
+def executor_backward_ex(ex, ograds: tuple) -> None:
+    """Backward with explicit head gradients; per-entry None = ones-like
+    seed for that output (ref MXExecutorBackwardEx NULL entries)."""
+    og = list(ograds) if ograds else None
+    if og is not None and any(g is None for g in og):
+        outs = ex.outputs or []
+        og = [g if g is not None else nd.ones(tuple(outs[i].shape))
+              for i, g in enumerate(og)]
+    ex.backward(out_grads=og)
+
+
+def ndarray_set_grad_state(handle, state: int) -> None:
+    """fresh-grad marker (ref MXNDArraySetGradState / NDArray.fresh_grad:
+    a frontend bookkeeping bit, stored as-is)."""
+    handle._fresh_grad = bool(state)
+
+
+def ndarray_get_grad_state(handle) -> int:
+    return int(getattr(handle, "_fresh_grad", False))
 
 
 # ---- runtime kernel compilation (ref: MXRtcCudaModuleCreate /

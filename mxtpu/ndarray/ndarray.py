@@ -109,7 +109,8 @@ class NDArray:
     """Multi-dimensional array with MXNet NDArray semantics on a PJRT device."""
 
     __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_ag_entry", "_version",
-                 "__weakref__")
+                 "_fresh_grad",  # NDArray.fresh_grad bookkeeping bit
+                 "__weakref__")  # (ref MXNDArraySetGradState)
 
     # make `ndarray op numpy_array` use our reflected ops, not numpy's
     __array_priority__ = 1000.0

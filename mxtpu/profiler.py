@@ -28,6 +28,7 @@ class _Profiler:
     def __init__(self):
         self.active = False
         self.events = []          # (name, cat, ts_us, dur_us, tid)
+        self.clear_gen = 0        # bumped whenever events are cleared
         self.lock = threading.Lock()
         self.filename = "profile.json"
         self.aggregate = True
@@ -188,6 +189,7 @@ def dumps(reset=False):
         events = list(_PROF.events)
         if reset:
             _PROF.events.clear()
+            _PROF.clear_gen += 1
     for name, _cat, _ts, dur, _tid in events:
         s = stats[name]
         s[0] += 1
@@ -232,13 +234,21 @@ class ProfileScope:
         # while a scope opened during an active window is recorded even
         # if the profiler stops before the bracket closes (teardown must
         # not silently drop an in-flight measurement)
-        self._t0 = time.perf_counter_ns() if is_active() else None
+        if is_active():
+            self._t0 = time.perf_counter_ns()
+            self._gen = _PROF.clear_gen
+        else:
+            self._t0 = None
 
     def stop(self):
         if self._t0 is None:
             return
-        dur = (time.perf_counter_ns() - self._t0) // 1000
-        record_event(self.name, self.cat, self._t0 // 1000, dur)
+        # in-flight events survive a profiler STOP, but not a window
+        # CLEAR (dumps(reset=True)): an event from before the clear would
+        # leak into the next, unrelated window's table
+        if is_active() or self._gen == _PROF.clear_gen:
+            dur = (time.perf_counter_ns() - self._t0) // 1000
+            record_event(self.name, self.cat, self._t0 // 1000, dur)
         self._t0 = None
 
     def __enter__(self):

@@ -2008,6 +2008,16 @@ int MXTPUProfileCreateCounter(ProfileHandle domain, const char *name,
 }
 
 int MXTPUProfileDestroyHandle(ProfileHandle handle) {
+  if (handle != nullptr) {
+    GilScope gil;
+    /* deregister counters from the aggregate table before dropping the
+     * ref (best-effort: a failure here must not block the free) */
+    PyObject *res = CallImpl(
+        "profile_destroy",
+        PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle)));
+    Py_XDECREF(res);
+    if (res == nullptr) PyErr_Clear();
+  }
   return FreeHandle(handle);
 }
 
@@ -2056,6 +2066,79 @@ int MXTPUAggregateProfileStatsPrint(const char **out_str, int reset) {
   return StringResult(
       CallImpl("profile_aggregate_stats", Py_BuildValue("(i)", reset)),
       out_str);
+}
+
+int MXTPUSymbolListAttrShallow(SymbolHandle handle, int *out_num,
+                               const char ***out_kv) {
+  /* this runtime's ListAttr is already shallow (per-node attrs only) —
+   * name-parity alias (ref MXSymbolListAttrShallow) */
+  return MXTPUSymbolListAttr(handle, out_num, out_kv);
+}
+
+int MXTPUExecutorBackwardEx(ExecutorHandle handle, int num_ograds,
+                            NDArrayHandle *ograds) {
+  GilScope gil;
+  PyObject *og;
+  if (ograds == nullptr) {
+    og = PyTuple_New(0);
+  } else {
+    /* per-entry NULL = ones-like seed (ref MXExecutorBackwardEx); never
+     * Py_INCREF(0) — same nullable marshaling as MXTPUAutogradBackward */
+    og = PyTuple_New(num_ograds);
+    for (int i = 0; i < num_ograds; ++i) {
+      PyObject *o = ograds[i] == nullptr
+                        ? Py_None
+                        : reinterpret_cast<PyObject *>(ograds[i]);
+      Py_INCREF(o);
+      PyTuple_SetItem(og, i, o);
+    }
+  }
+  return CallNoResult(
+      "executor_backward_ex",
+      Py_BuildValue("(ON)", reinterpret_cast<PyObject *>(handle), og));
+}
+
+int MXTPUNDArraySetGradState(NDArrayHandle handle, int state) {
+  GilScope gil;
+  return CallNoResult(
+      "ndarray_set_grad_state",
+      Py_BuildValue("(Oi)", reinterpret_cast<PyObject *>(handle), state));
+}
+
+int MXTPUNDArrayGetGradState(NDArrayHandle handle, int *out) {
+  GilScope gil;
+  return IntResult(
+      CallImpl("ndarray_get_grad_state",
+               PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle))),
+      out);
+}
+
+/* ---- process-profiler variants (ref: MXSetProcessProfilerConfig /
+ * MXSetProcessProfilerState / MXDumpProcessProfile /
+ * MXProcessProfilePause). The reference routes these to a server
+ * process by id; this runtime is symmetric single-role (every process
+ * is a worker — README ADR), so profile_process selects nothing and
+ * the variants alias the worker-profiler calls. ---- */
+
+int MXTPUSetProcessProfilerConfig(int num, const char **keys,
+                                  const char **vals, int profile_process) {
+  (void)profile_process;
+  return MXTPUSetProfilerConfig(num, keys, vals);
+}
+
+int MXTPUSetProcessProfilerState(int state, int profile_process) {
+  (void)profile_process;
+  return MXTPUSetProfilerState(state);
+}
+
+int MXTPUDumpProcessProfile(int finished, int profile_process) {
+  (void)profile_process;
+  return MXTPUDumpProfile(finished);
+}
+
+int MXTPUProcessProfilePause(int paused, int profile_process) {
+  (void)profile_process;
+  return MXTPUProfilePause(paused);
 }
 
 /* ---- runtime/introspection breadth ---- */

@@ -1378,3 +1378,47 @@ def test_reshape64_alias_abi(lib):
     assert lib.MXTPUNDArrayReshape64(h, shp, 2, ctypes.byref(out)) == 0
     np.testing.assert_allclose(_nd_to_numpy(lib, out),
                                np.arange(6).reshape(2, 3))
+
+
+def test_executor_backward_ex_and_grad_state_abi(lib):
+    """Explicit-ograd backward + the fresh-grad bookkeeping bit
+    (ref MXExecutorBackwardEx / MXNDArraySetGradState)."""
+    a = ctypes.c_void_p()
+    b = ctypes.c_void_p()
+    lib.MXTPUSymbolCreateVariable(b"a", ctypes.byref(a))
+    lib.MXTPUSymbolCreateVariable(b"b", ctypes.byref(b))
+    comp = ctypes.c_void_p()
+    assert lib.MXTPUSymbolCompose(b"elemwise_mul", b"m0",
+                                  (ctypes.c_void_p * 2)(a, b), 2, None,
+                                  None, 0, ctypes.byref(comp)) == 0
+    av = _nd_from_blob(lib, np.full(3, 2.0, np.float32))
+    bv = _nd_from_blob(lib, np.full(3, 5.0, np.float32))
+    names = (ctypes.c_char_p * 2)(b"a", b"b")
+    vals = (ctypes.c_void_p * 2)(av, bv)
+    ex = ctypes.c_void_p()
+    assert lib.MXTPUExecutorBind(comp, 2, names, vals, b"write",
+                                 ctypes.byref(ex)) == 0
+    assert lib.MXTPUExecutorForward(ex, 1) == 0
+    og = _nd_from_blob(lib, np.full(3, 3.0, np.float32))
+    assert lib.MXTPUExecutorBackwardEx(ex, 1,
+                                       (ctypes.c_void_p * 1)(og)) == 0
+    g = ctypes.c_void_p()
+    assert lib.MXTPUExecutorArgGrad(ex, b"a", ctypes.byref(g)) == 0
+    np.testing.assert_allclose(_nd_to_numpy(lib, g), 15.0)  # b * ograd
+    st = ctypes.c_int()
+    assert lib.MXTPUNDArrayGetGradState(av, ctypes.byref(st)) == 0
+    assert st.value == 0
+    assert lib.MXTPUNDArraySetGradState(av, 1) == 0
+    assert lib.MXTPUNDArrayGetGradState(av, ctypes.byref(st)) == 0
+    assert st.value == 1
+
+
+def test_process_profiler_aliases_abi(lib, tmp_path):
+    pk = (ctypes.c_char_p * 1)(b"filename")
+    pv = (ctypes.c_char_p * 1)(str(tmp_path / "pp.json").encode())
+    assert lib.MXTPUSetProcessProfilerConfig(1, pk, pv, 0) == 0
+    assert lib.MXTPUSetProcessProfilerState(1, 0) == 0
+    assert lib.MXTPUProcessProfilePause(1, 0) == 0
+    assert lib.MXTPUProcessProfilePause(0, 0) == 0
+    assert lib.MXTPUSetProcessProfilerState(0, 0) == 0
+    assert lib.MXTPUDumpProcessProfile(1, 0) == 0
