@@ -113,11 +113,57 @@ def _enabled():
     return os.environ.get("MXTPU_CONV_ACC", "0") == "1"
 
 
+def _im2col_enabled():
+    """MXTPU_CONV_IM2COL=1 lowers qualifying convs (NHWC, stride 1, no
+    dilation, groups 1, C_in <= 128) through explicit patch extraction +
+    ONE matmul instead of XLA's conv path. Why (round-5 measurement,
+    PERF.md): the early resnet stages' small-channel convs run at ~7
+    TFLOP/s on the conv path while the same chip's MATMUL path measures
+    102-135 TFLOP/s — im2col trades ~k^2 x input HBM traffic (~1 ms at
+    these shapes) for matmul-path compute. STAGED off by default pending
+    the on-chip A/B (the auto-battery's resnet_im2col phase); in the jit
+    policy cache key (registry.policy_key)."""
+    import os
+    return os.environ.get("MXTPU_CONV_IM2COL", "0") == "1"
+
+
+def _im2col_applicable(x, w, strides, padding, lhs_dilation, rhs_dilation,
+                       dims, groups):
+    if dims != ("NHWC", "HWIO", "NHWC") or groups != 1:
+        return False
+    if tuple(strides) != (1, 1) or tuple(lhs_dilation) != (1, 1) \
+            or tuple(rhs_dilation) != (1, 1):
+        return False
+    kh, kw, cin, _ = w.shape
+    if kh == 1 and kw == 1:
+        return False        # 1x1 IS already a matmul to XLA
+    return cin <= 128       # where the conv path measured slow
+
+
+def conv_im2col(x, w, padding):
+    """NHWC stride-1 conv as patch-extraction + one matmul (exact).
+    lax.conv_general_dilated_patches emits channel-major (c, kh, kw)
+    patch features; weights are transposed to match."""
+    kh, kw, cin, cout = w.shape
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (1, 1), list(map(tuple, padding)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))   # [..., cin*kh*kw]
+    wmat = jnp.transpose(w, (2, 0, 1, 3)).reshape(cin * kh * kw, cout)
+    from .precision_util import contract_acc
+    n, oh, ow, k = patches.shape
+    out = contract_acc(jnp.dot, patches.reshape(n * oh * ow, k), wmat)
+    return out.reshape(n, oh, ow, cout).astype(x.dtype)
+
+
 def conv_fast(x, w, strides, padding, lhs_dilation, rhs_dilation, dims,
               groups):
     """Dispatch: the f32-accumulate custom-vjp path for all-low-precision
     operands (when the private transpose helpers imported), else plain
     conv_general_dilated under the package precision policy."""
+    if _im2col_enabled() and _im2col_applicable(
+            x, w, strides, padding, lhs_dilation, rhs_dilation, dims,
+            groups):
+        return conv_im2col(x, w, padding)
     if (HAVE_ACC_VJP and _enabled() and x.dtype in _LOW and w.dtype in _LOW):
         return conv_acc(x, w, tuple(strides), tuple(map(tuple, padding)),
                         tuple(lhs_dilation), tuple(rhs_dilation), dims,

@@ -100,7 +100,8 @@ def policy_key():
             # alias unset and the non-default value onto one cache key
             os.environ.get("MXTPU_BN_ONEPASS", "1"),
             os.environ.get("MXTPU_RING_FLASH", "0"),
-            os.environ.get("MXTPU_FLASH_PAD_D", "1"))
+            os.environ.get("MXTPU_FLASH_PAD_D", "1"),
+            os.environ.get("MXTPU_CONV_IM2COL", "0"))
 
 
 # canonical op name -> fn(attrs) -> int: STATIC output count for ops whose

@@ -139,33 +139,33 @@ def phase_resnet_control():
     # round 5 (BN one-pass is now default-on), and a control that
     # inherits defaults silently becomes the lever it controls for.
     _resnet("resnet_control", MXTPU_CONV_ACC="0", MXTPU_BN_ONEPASS="0",
-            BENCH_S2D_STEM="0")
+            BENCH_S2D_STEM="0", MXTPU_CONV_IM2COL="0")
 
 
 def phase_resnet_conv_acc():
     _resnet("resnet_conv_acc", MXTPU_CONV_ACC="1", MXTPU_BN_ONEPASS="0",
-            BENCH_S2D_STEM="0")
+            BENCH_S2D_STEM="0", MXTPU_CONV_IM2COL="0")
 
 
 def phase_resnet_s2d():
     _resnet("resnet_s2d", MXTPU_CONV_ACC="1", MXTPU_BN_ONEPASS="0",
-            BENCH_S2D_STEM="1")
+            BENCH_S2D_STEM="1", MXTPU_CONV_IM2COL="0")
 
 
 def phase_resnet_bn1p():
     _resnet("resnet_bn_onepass", MXTPU_CONV_ACC="1", MXTPU_BN_ONEPASS="1",
-            BENCH_S2D_STEM="0")
+            BENCH_S2D_STEM="0", MXTPU_CONV_IM2COL="0")
 
 
 def phase_resnet_all_levers():
     _resnet("resnet_all_levers", MXTPU_CONV_ACC="1", MXTPU_BN_ONEPASS="1",
-            BENCH_S2D_STEM="1")
+            BENCH_S2D_STEM="1", MXTPU_CONV_IM2COL="0")
 
 
 def phase_resnet_nchw():
     # layout A/B: XLA:TPU may prefer a different im2col/tiling for NCHW
     _resnet("resnet_nchw", MXTPU_CONV_ACC="1", MXTPU_BN_ONEPASS="0",
-            BENCH_LAYOUT="NCHW")
+            BENCH_LAYOUT="NCHW", MXTPU_CONV_IM2COL="0")
 
 
 def phase_convs():
@@ -418,7 +418,7 @@ def phase_resnet_best():
     """The combo the battery never measured: BN one-pass + s2d stem
     WITHOUT conv_acc (conv_acc alone measured -2.8% end-to-end)."""
     _resnet("resnet_best", MXTPU_CONV_ACC="0", BENCH_S2D_STEM="1",
-            MXTPU_BN_ONEPASS="1")
+            MXTPU_BN_ONEPASS="1", MXTPU_CONV_IM2COL="0")
 
 
 def phase_resnet_s2d2():
@@ -426,7 +426,16 @@ def phase_resnet_s2d2():
     depth-to-space) on top of the best-known config — the staged answer
     to the stem-breakdown finding that mode 1 does not fix the stem."""
     _resnet("resnet_s2d2", MXTPU_CONV_ACC="0", BENCH_S2D_STEM="2",
-            MXTPU_BN_ONEPASS="1")
+            MXTPU_BN_ONEPASS="1", MXTPU_CONV_IM2COL="0")
+
+
+def phase_resnet_im2col():
+    """Small-channel convs via explicit im2col + matmul (staged,
+    MXTPU_CONV_IM2COL): the conv path measured ~7 TFLOP/s on the early
+    3x3s while the matmul path measures 102-135 — this phase prices the
+    trade end to end on the best-known config."""
+    _resnet("resnet_im2col", MXTPU_CONV_ACC="0", BENCH_S2D_STEM="1",
+            MXTPU_BN_ONEPASS="1", MXTPU_CONV_IM2COL="1")
 
 
 def phase_flash_pad():
@@ -518,6 +527,7 @@ PHASES = [
     ("bert", phase_bert),
     ("resnet_best", phase_resnet_best),
     ("resnet_s2d2", phase_resnet_s2d2),
+    ("resnet_im2col", phase_resnet_im2col),
     ("flash_pad", phase_flash_pad),
     ("bert_pad_ab", phase_bert_pad_ab),
     ("stem_breakdown", phase_stem_breakdown),
