@@ -152,7 +152,11 @@ def conv_im2col(x, w, padding):
     from .precision_util import contract_acc
     n, oh, ow, k = patches.shape
     out = contract_acc(jnp.dot, patches.reshape(n * oh * ow, k), wmat)
-    return out.reshape(n, oh, ow, cout).astype(x.dtype)
+    # match the conv path's output dtype (operand promotion, NOT x.dtype:
+    # bf16 activations x f32 master weights must stay f32 either way or
+    # the im2col A/B would compare different-precision programs)
+    return out.reshape(n, oh, ow, cout).astype(
+        jnp.promote_types(x.dtype, w.dtype))
 
 
 def conv_fast(x, w, strides, padding, lhs_dilation, rhs_dilation, dims,

@@ -19,7 +19,23 @@ import numpy as _np
 from .base import MXNetError
 from .ndarray import NDArray
 
-__all__ = ["PallasModule", "Kernel"]
+__all__ = ["PallasModule", "Kernel", "CudaModule"]
+
+
+def CudaModule(source, options=(), exports=()):
+    """Reference-name entry point (ref: rtc.py:CudaModule). CUDA C++
+    source cannot run on a TPU; raises with the migration path unless the
+    source is actually Python (then it routes to PallasModule)."""
+    head = source.lstrip()[:64]
+    looks_like_cuda = ("__global__" in source or "#include" in head
+                       or "extern \"C\"" in source)
+    if looks_like_cuda:
+        raise MXNetError(
+            "mx.rtc.CudaModule received CUDA C++ source; this runtime has "
+            "no NVRTC/GPU. Rewrite the kernel as a Pallas function (Refs "
+            "in, last args are outputs) and use mx.rtc.PallasModule — see "
+            "mxtpu/rtc.py and the examples in tests/test_contrib_python.py.")
+    return PallasModule(source, exports=list(exports) or None)
 
 
 class PallasModule:

@@ -279,3 +279,19 @@ def test_contrib_fix_regressions():
     # sym.random.randn parity with nd.random.randn
     s = mx.sym.random.randn(2, 3)
     assert s is not None
+
+
+def test_rtc_cudamodule_reference_name():
+    """mx.rtc.CudaModule (reference spelling): CUDA C++ source raises
+    with migration guidance; Python/Pallas source routes to
+    PallasModule."""
+    import pytest
+    import mxtpu as mx
+    with pytest.raises(mx.base.MXNetError, match="Pallas"):
+        mx.rtc.CudaModule("__global__ void k(float* x) { x[0] = 1.f; }")
+    mod = mx.rtc.CudaModule(
+        "def double(x_ref, o_ref):\n    o_ref[...] = 2.0 * x_ref[...]\n")
+    k = mod.get_kernel("double")
+    import numpy as np
+    out = k.launch([mx.nd.array(np.arange(4, dtype=np.float32))], (4,))
+    np.testing.assert_allclose(out.asnumpy(), [0, 2, 4, 6])

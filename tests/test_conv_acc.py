@@ -130,61 +130,3 @@ def test_f32_operands_keep_plain_path():
     args = ((1, 1), [(1, 1), (1, 1)], (1, 1), (1, 1), DN, 1)
     txt = jax.jit(lambda x, w: conv_fast(x, w, *args)).lower(x, w).as_text()
     assert "HIGHEST" in txt
-
-
-@pytest.mark.parametrize("cin,cout,k,hw", [(64, 64, 3, 14), (3, 8, 7, 16),
-                                           (128, 32, 5, 10)])
-def test_im2col_path_exact(cin, cout, k, hw):
-    """The staged im2col lowering (MXTPU_CONV_IM2COL) must equal the conv
-    path exactly, forward and weight-gradient (round-5 lever for the
-    slow small-channel conv classes, PERF.md)."""
-    import numpy as np
-    from mxtpu.ops.conv_acc import conv_im2col
-    rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.randn(2, hw, hw, cin), jnp.float32)
-    w = jnp.asarray(rng.randn(k, k, cin, cout) * 0.1, jnp.float32)
-    pad = [(k // 2, k // 2)] * 2
-    ref = lax.conv_general_dilated(
-        x, w, (1, 1), pad, dimension_numbers=DN)
-    got = conv_im2col(x, w, pad)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
-                               rtol=1e-4, atol=1e-4)
-    g1 = jax.grad(lambda w_: jnp.sum(conv_im2col(x, w_, pad) ** 2))(w)
-    g2 = jax.grad(lambda w_: jnp.sum(lax.conv_general_dilated(
-        x, w_, (1, 1), pad, dimension_numbers=DN) ** 2))(w)
-    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
-                               rtol=1e-3, atol=1e-3)
-
-
-def test_im2col_dispatch_gating(monkeypatch):
-    """Only stride-1 / groups-1 / k>1 / C_in<=128 NHWC convs qualify, and
-    the env flag genuinely routes conv_fast through the matmul lowering
-    (the staged lever must not be silently dead when the auto-battery
-    measures it)."""
-    from mxtpu.ops.conv_acc import _im2col_applicable
-    x = jnp.zeros((1, 8, 8, 16), jnp.bfloat16)
-    w3 = jnp.zeros((3, 3, 16, 8), jnp.bfloat16)
-    ok = ("NHWC", "HWIO", "NHWC")
-    assert _im2col_applicable(x, w3, (1, 1), None, (1, 1), (1, 1), ok, 1)
-    assert not _im2col_applicable(x, w3, (2, 2), None, (1, 1), (1, 1), ok, 1)
-    assert not _im2col_applicable(x, jnp.zeros((1, 1, 16, 8)), (1, 1),
-                                  None, (1, 1), (1, 1), ok, 1)
-    assert not _im2col_applicable(x, jnp.zeros((3, 3, 256, 8)), (1, 1),
-                                  None, (1, 1), (1, 1), ok, 1)
-    assert not _im2col_applicable(x, w3, (1, 1), None, (1, 1), (1, 1),
-                                  ok, 2)
-    assert not _im2col_applicable(x, w3, (1, 1), None, (2, 2), (1, 1),
-                                  ok, 1)
-
-
-    args = ((1, 1), [(1, 1), (1, 1)], (1, 1), (1, 1), ok, 1)
-    monkeypatch.delenv("MXTPU_CONV_IM2COL", raising=False)
-    hlo_off = jax.jit(lambda a, b: conv_fast(a, b, *args)).lower(
-        jnp.zeros((1, 8, 8, 16), jnp.bfloat16), w3).as_text()
-    assert "convolution" in hlo_off
-    monkeypatch.setenv("MXTPU_CONV_IM2COL", "1")
-    hlo_on = jax.jit(lambda a, b: conv_fast(a, b, *args)).lower(
-        jnp.zeros((1, 8, 8, 16), jnp.bfloat16), w3).as_text()
-    # patches extraction lowers to a conv against an identity kernel on
-    # some jax versions; the CONTRACTION itself must be a dot_general
-    assert "dot_general" in hlo_on and "dot_general" not in hlo_off
