@@ -101,7 +101,8 @@ def policy_key():
             os.environ.get("MXTPU_BN_ONEPASS", "1"),
             os.environ.get("MXTPU_RING_FLASH", "0"),
             os.environ.get("MXTPU_FLASH_PAD_D", "1"),
-            os.environ.get("MXTPU_CONV_IM2COL", "0"))
+            os.environ.get("MXTPU_CONV_IM2COL", "0"),
+            os.environ.get("MXTPU_RNN_HOIST", "1"))
 
 
 # canonical op name -> fn(attrs) -> int: STATIC output count for ops whose

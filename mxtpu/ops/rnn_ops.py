@@ -32,6 +32,17 @@ def _gdot(x, W):
     return contract_acc(jnp.dot, x, W.T)
 
 
+def _hoist_enabled():
+    """MXTPU_RNN_HOIST=0 keeps the input projection inside the scan body
+    (the pre-round-5 lowering) — escape hatch/perf A/B only; the hoist is
+    algebraically identical. Trace-time policy: participates in
+    registry.policy_key() so a mid-process flip recompiles long-lived
+    hybridized blocks / executors instead of silently reusing the stale
+    executable."""
+    import os
+    return os.environ.get("MXTPU_RNN_HOIST", "1") == "1"
+
+
 def _precompute_xi(xs, W_ih, b_ih):
     """Hoist the input-to-hidden projection for ALL timesteps out of the
     scan: one [T*N, in] x [in, ng*H] MXU matmul instead of T small ones
@@ -43,10 +54,18 @@ def _precompute_xi(xs, W_ih, b_ih):
     return xi.reshape(T, N, -1)
 
 
-def _cell_step(mode, W_hh, b_hh):
+def _cell_step(mode, W_hh, b_hh, W_ih=None, b_ih=None):
     """Returns step(carry, xi_t) -> (carry, h_t) for one direction of one
     layer. xi_t is the PRECOMPUTED input projection x_t @ W_ih.T + b_ih
-    (see _precompute_xi); only the recurrent matmul stays in the loop."""
+    (see _precompute_xi); only the recurrent matmul stays in the loop.
+    When W_ih/b_ih are given (MXTPU_RNN_HOIST=0 A/B leg), the scanned
+    value is the RAW x_t and the projection runs inside the body."""
+    if W_ih is not None:
+        inner = _cell_step(mode, W_hh, b_hh)
+
+        def unhoisted(carry, x):
+            return inner(carry, _gdot(x, W_ih) + b_ih)
+        return unhoisted
     if mode == "lstm":
         def step(carry, xi):
             h, c = carry
@@ -147,9 +166,13 @@ def RNN(data, parameters, state, state_cell=None, state_size=None, num_layers=1,
         outs = []
         for d in range(dirs):
             W_ih, W_hh, b_ih, b_hh = weights[layer * dirs + d]
-            step = _cell_step(mode, W_hh, b_hh)
             xs = x if d == 0 else jnp.flip(x, axis=0)
-            xi = _precompute_xi(xs, W_ih, b_ih)
+            if _hoist_enabled():
+                step = _cell_step(mode, W_hh, b_hh)
+                xi = _precompute_xi(xs, W_ih, b_ih)
+            else:
+                step = _cell_step(mode, W_hh, b_hh, W_ih, b_ih)
+                xi = xs
             hi = h0[layer * dirs + d]
             if mode == "lstm":
                 carry0 = (hi, c0[layer * dirs + d])

@@ -215,3 +215,28 @@ def test_cell_weight_sharing_via_params():
     stack.add(c1)
     stack.add(c2)
     assert "s0_i2h_weight" in stack.params._params
+
+
+def test_rnn_hoist_ab_legs_identical(monkeypatch):
+    """MXTPU_RNN_HOIST=0 (input GEMM inside the scan, the pre-round-5
+    lowering) must equal the hoisted default bit-for-bit in f32 — the
+    perf A/B compares identical math."""
+    import numpy as np
+    import mxtpu as mx
+    from mxtpu.ops import invoke as op_invoke
+    from mxtpu.ops.rnn_ops import rnn_param_size
+    rng = np.random.RandomState(0)
+    for mode in ("lstm", "gru", "rnn_tanh"):
+        size = rnn_param_size(mode, 2, 6, 5, bidirectional=True)
+        params = mx.nd.array(rng.randn(size).astype(np.float32) * 0.1)
+        data = mx.nd.array(rng.randn(7, 3, 6).astype(np.float32))
+        state = mx.nd.zeros((4, 3, 5))
+        kw = dict(state_size=5, num_layers=2, mode=mode,
+                  bidirectional=True)
+        if mode == "lstm":
+            kw["state_cell"] = mx.nd.zeros((4, 3, 5))
+        monkeypatch.setenv("MXTPU_RNN_HOIST", "1")
+        hoisted = op_invoke("RNN", data, params, state, **kw).asnumpy()
+        monkeypatch.setenv("MXTPU_RNN_HOIST", "0")
+        inscan = op_invoke("RNN", data, params, state, **kw).asnumpy()
+        np.testing.assert_allclose(hoisted, inscan, rtol=1e-5, atol=1e-6)

@@ -320,9 +320,41 @@ def phase_bn():
             os.environ["MXTPU_BN_ONEPASS"] = saved
 
 
+_LSTM_MEASURED = False
+
+
 def phase_lstm():
     import bench
+    if _LSTM_MEASURED:
+        # the hoist A/B already emitted the canonical "lstm" record this
+        # session — don't spend healthy-chip time re-measuring it via the
+        # battery's 'rest' sentinel
+        say("lstm already measured by lstm_hoist_ab; skipping")
+        return
     out("lstm", bench.bench_lstm_ptb())
+
+
+def phase_lstm_hoist_ab():
+    """Same-session A/B of the round-5 input-GEMM hoist: the cross-
+    session delta (151,009 -> 143,137 tok/s) was inside the day's
+    variance envelope and unattributable. The hoisted leg IS the
+    canonical "lstm" record (package default config)."""
+    global _LSTM_MEASURED
+    import bench
+    saved = os.environ.get("MXTPU_RNN_HOIST")
+    try:
+        os.environ["MXTPU_RNN_HOIST"] = "1"
+        out("lstm", bench.bench_lstm_ptb())
+        _LSTM_MEASURED = True
+        os.environ["MXTPU_RNN_HOIST"] = "0"
+        rec = bench.bench_lstm_ptb()
+        rec["note"] = "input GEMM inside the scan (pre-hoist lowering)"
+        out("lstm_nohoist", rec)
+    finally:
+        if saved is None:
+            os.environ.pop("MXTPU_RNN_HOIST", None)
+        else:
+            os.environ["MXTPU_RNN_HOIST"] = saved
 
 
 def phase_bert():
@@ -528,6 +560,7 @@ PHASES = [
     ("resnet_best", phase_resnet_best),
     ("resnet_s2d2", phase_resnet_s2d2),
     ("resnet_im2col", phase_resnet_im2col),
+    ("lstm_hoist_ab", phase_lstm_hoist_ab),
     ("flash_pad", phase_flash_pad),
     ("bert_pad_ab", phase_bert_pad_ab),
     ("stem_breakdown", phase_stem_breakdown),
