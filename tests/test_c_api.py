@@ -1010,6 +1010,11 @@ def test_profiler_and_misc_abi(lib, tmp_path):
     assert lib.MXTPUNotifyShutdown() == 0
     prev = ctypes.c_int()
     assert lib.MXTPUEngineSetBulkSize(8, ctypes.byref(prev)) == 0
+    # the embedded impl shares THIS interpreter: restore the bulk size or
+    # later engine tests see the mutated global
+    restored = ctypes.c_int()
+    assert lib.MXTPUEngineSetBulkSize(prev.value, ctypes.byref(restored)) == 0
+    assert restored.value == 8
     assert lib.MXTPUSetNumOMPThreads(4) == 0
     assert lib.MXTPURandomSeedContext(42, 1, 0) == 0
     nm = ctypes.c_char_p()
