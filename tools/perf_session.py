@@ -326,6 +326,10 @@ _LSTM_MEASURED = False
 def phase_lstm():
     global _LSTM_MEASURED
     import bench
+    # the canonical record is the PACKAGE DEFAULT config: pin the hoist
+    # on so an inherited MXTPU_RNN_HOIST=0 cannot silently degenerate
+    # the A/B into two no-hoist measurements
+    os.environ["MXTPU_RNN_HOIST"] = "1"
     if _LSTM_MEASURED:
         # the hoist A/B already emitted the canonical "lstm" record this
         # session — don't spend healthy-chip time re-measuring it via the
