@@ -102,7 +102,7 @@ class TinySSD(gluon.HybridBlock):
         return mx.nd.concat(*ank, dim=1)
 
 
-def train(num_images=32, batch_size=8, epochs=12, lr=0.2, seed=0):
+def train(num_images=32, batch_size=8, epochs=12, lr=0.05, seed=0):
     imgs, labels = make_synthetic(num_images, seed=seed)
     net = TinySSD()
     net.initialize()
@@ -138,7 +138,10 @@ def train(num_images=32, batch_size=8, epochs=12, lr=0.2, seed=0):
                 l_box = box_loss(loc_preds * loc_m, loc_t * loc_m)
                 loss = l_cls.mean() + l_box.mean()
             loss.backward()
-            trainer.step(batch_size)
+            # mean losses => step(1): Trainer.step's rescale_grad is
+            # 1/batch, and mean+step(batch) would divide twice, silently
+            # coupling the learning rate to the batch size
+            trainer.step(1)
             total += float(loss.asnumpy())
         hist.append(total / max(1, num_images // batch_size))
     return net, anchors, hist

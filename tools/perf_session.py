@@ -326,18 +326,25 @@ _LSTM_MEASURED = False
 def phase_lstm():
     global _LSTM_MEASURED
     import bench
-    # the canonical record is the PACKAGE DEFAULT config: pin the hoist
-    # on so an inherited MXTPU_RNN_HOIST=0 cannot silently degenerate
-    # the A/B into two no-hoist measurements
-    os.environ["MXTPU_RNN_HOIST"] = "1"
     if _LSTM_MEASURED:
         # the hoist A/B already emitted the canonical "lstm" record this
         # session — don't spend healthy-chip time re-measuring it via the
         # battery's 'rest' sentinel
         say("lstm already measured by lstm_hoist_ab; skipping")
         return
-    out("lstm", bench.bench_lstm_ptb())
-    _LSTM_MEASURED = True
+    # the canonical record is the PACKAGE DEFAULT config: pin the hoist
+    # on (saved/restored like every sibling phase) so an inherited
+    # MXTPU_RNN_HOIST=0 cannot silently degenerate the A/B
+    saved = os.environ.get("MXTPU_RNN_HOIST")
+    os.environ["MXTPU_RNN_HOIST"] = "1"
+    try:
+        out("lstm", bench.bench_lstm_ptb())
+        _LSTM_MEASURED = True
+    finally:
+        if saved is None:
+            os.environ.pop("MXTPU_RNN_HOIST", None)
+        else:
+            os.environ["MXTPU_RNN_HOIST"] = saved
 
 
 def phase_lstm_hoist_ab():
@@ -468,6 +475,13 @@ def phase_resnet_s2d2():
             MXTPU_BN_ONEPASS="1", MXTPU_CONV_IM2COL="0")
 
 
+def phase_resnet_s2d2_im2col():
+    """Do the two staged levers stack? The mode-2 stem conv (3x3 s1,
+    C_in=48) itself qualifies for the im2col lowering."""
+    _resnet("resnet_s2d2_im2col", MXTPU_CONV_ACC="0", BENCH_S2D_STEM="2",
+            MXTPU_BN_ONEPASS="1", MXTPU_CONV_IM2COL="1")
+
+
 def phase_resnet_im2col():
     """Small-channel convs via explicit im2col + matmul (staged,
     MXTPU_CONV_IM2COL): the conv path measured ~7 TFLOP/s on the early
@@ -567,6 +581,7 @@ PHASES = [
     ("resnet_best", phase_resnet_best),
     ("resnet_s2d2", phase_resnet_s2d2),
     ("resnet_im2col", phase_resnet_im2col),
+    ("resnet_s2d2_im2col", phase_resnet_s2d2_im2col),
     ("lstm_hoist_ab", phase_lstm_hoist_ab),
     ("flash_pad", phase_flash_pad),
     ("bert_pad_ab", phase_bert_pad_ab),
