@@ -107,6 +107,16 @@ def _run(step, batch, n_items, model_flops_per_item=None):
     return rate, mfu, hfu
 
 
+def _default_s2d(layout):
+    """s2d stem DEFAULT ON for NHWC as of round 5 (exactly-equivalent
+    transform; measured positive in two on-chip sessions and part of the
+    best-known config, resnet_best 2580.3 img/s). BENCH_S2D_STEM=0
+    disables for A/Bs; the transform requires NHWC, so other layouts
+    default off."""
+    return os.environ.get("BENCH_S2D_STEM",
+                          "1" if layout == "NHWC" else "0")
+
+
 def bench_resnet50():
     import mxtpu as mx
     from mxtpu import gluon
@@ -124,12 +134,7 @@ def bench_resnet50():
     shape = (batch, 224, 224, 3) if layout == "NHWC" else (batch, 3, 224, 224)
     x = mx.nd.array(np.random.uniform(-1, 1, size=shape), dtype="float32")
     net(x)  # settle deferred shapes
-    # s2d stem DEFAULT ON for NHWC as of round 5 (exactly-equivalent
-    # transform; measured positive in two on-chip sessions and part of the
-    # best-known config, resnet_best 2580.3 img/s). BENCH_S2D_STEM=0
-    # disables for A/Bs.
-    s2d_flag = os.environ.get("BENCH_S2D_STEM",
-                              "1" if layout == "NHWC" else "0")
+    s2d_flag = _default_s2d(layout)
     if s2d_flag not in ("0", "1", "2"):
         # a typo must not silently measure the plain stem under an s2d
         # label on intermittently-healthy hardware

@@ -1,0 +1,49 @@
+"""The round-5 measured-best defaults, pinned (PERF.md lever table):
+BN one-pass ON (+7.8% end-to-end), conv_acc custom-vjp OFF (-2.8%),
+flash head-dim padding ON (+8.9% BERT), RNN hoist ON, staged levers
+(im2col, ring-flash) OFF until their on-chip A/B. A default drifting
+here silently changes every user's performance — this test makes that
+a visible decision, not an accident."""
+import os
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for var in ("MXTPU_CONV_ACC", "MXTPU_BN_ONEPASS", "MXTPU_RING_FLASH",
+                "MXTPU_FLASH_PAD_D", "MXTPU_CONV_IM2COL",
+                "MXTPU_RNN_HOIST", "BENCH_S2D_STEM", "BENCH_LAYOUT"):
+        monkeypatch.delenv(var, raising=False)
+
+
+def test_policy_key_defaults_are_the_measured_best():
+    from mxtpu.ops.registry import policy_key
+    # (conv_acc, bn_onepass, ring_flash, flash_pad_d, im2col, rnn_hoist)
+    assert policy_key() == ("0", "1", "0", "1", "0", "1")
+
+
+def test_read_sites_mirror_policy_key():
+    from mxtpu.ops.conv_acc import _enabled, _im2col_enabled
+    from mxtpu.ops.nn import _bn_onepass
+    from mxtpu.ops.rnn_ops import _hoist_enabled
+    assert _enabled() is False          # conv_acc: measured regression
+    assert _bn_onepass() is True        # measured +7.8%
+    assert _im2col_enabled() is False   # staged, awaiting on-chip A/B
+    assert _hoist_enabled() is True
+
+
+def test_bench_defaults_measure_the_best_config():
+    """A plain `python bench.py` resnet run must measure the best-known
+    config: the s2d stem defaults ON for NHWC (and off elsewhere —
+    the transform requires NHWC), overridable by BENCH_S2D_STEM."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import bench
+    assert bench._default_s2d("NHWC") == "1"
+    assert bench._default_s2d("NCHW") == "0"
+    os.environ["BENCH_S2D_STEM"] = "0"
+    try:
+        assert bench._default_s2d("NHWC") == "0"
+    finally:
+        del os.environ["BENCH_S2D_STEM"]
