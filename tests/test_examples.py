@@ -191,3 +191,19 @@ def test_ssd_detection_trains_and_detects():
     x0, y0, x1, y1 = best[2:6]
     assert x1 > x0 and y1 > y0
     assert x0 < gt[2] and x1 > gt[0]  # horizontal ranges intersect
+
+
+def test_bi_lstm_sort_learns():
+    """Bidirectional LSTM sorts integer sequences (ref
+    example/bi-lstm-sort): per-token accuracy far above the 1/vocab
+    chance level after a short hybridized training run."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "sort_lstm", os.path.join(ROOT, "examples", "bi_lstm_sort",
+                                  "sort_lstm.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    net, hist = m.train(num=512, epochs=15)
+    assert hist[-1] < hist[0] * 0.5, hist
+    tok_acc, _ = m.accuracy(net, num=64)
+    assert tok_acc > 0.4, tok_acc  # chance = 1/16

@@ -33,7 +33,7 @@ def test_read_sites_mirror_policy_key():
     assert _hoist_enabled() is True
 
 
-def test_bench_defaults_measure_the_best_config():
+def test_bench_defaults_measure_the_best_config(monkeypatch):
     """A plain `python bench.py` resnet run must measure the best-known
     config: the s2d stem defaults ON for NHWC (and off elsewhere —
     the transform requires NHWC), overridable by BENCH_S2D_STEM."""
@@ -42,8 +42,5 @@ def test_bench_defaults_measure_the_best_config():
     import bench
     assert bench._default_s2d("NHWC") == "1"
     assert bench._default_s2d("NCHW") == "0"
-    os.environ["BENCH_S2D_STEM"] = "0"
-    try:
-        assert bench._default_s2d("NHWC") == "0"
-    finally:
-        del os.environ["BENCH_S2D_STEM"]
+    monkeypatch.setenv("BENCH_S2D_STEM", "0")
+    assert bench._default_s2d("NHWC") == "0"
