@@ -327,6 +327,71 @@ def bench_eager():
     }
 
 
+def bench_optimizer_step():
+    """Weight-update hot path: params-updated/s through Trainer.step, eager
+    per-param loop vs the fused whole-model donated jit
+    (mxtpu/optimizer_fused.py, MXTPU_FUSED_OPTIMIZER). The fused number is
+    the headline value; ``vs_baseline`` is the fused/eager speedup — the
+    dispatch-amortization win this metric exists to track."""
+    import mxtpu as mx
+    from mxtpu.gluon.parameter import Parameter
+    from mxtpu.gluon.trainer import Trainer
+    from mxtpu import optimizer_fused as of
+
+    n_params = int(os.environ.get("BENCH_OPT_PARAMS", "80"))
+    size = int(os.environ.get("BENCH_OPT_PARAM_SIZE", "16384"))
+    steps = int(os.environ.get("BENCH_OPT_STEPS", "30"))
+    optimizer = os.environ.get("BENCH_OPT_OPTIMIZER", "adam")
+    rng = np.random.RandomState(0)
+
+    def measure(fused):
+        os.environ["MXTPU_FUSED_OPTIMIZER"] = "1" if fused else "0"
+        params = []
+        for j in range(n_params):
+            p = Parameter("bench_p%d" % j, shape=(size,), dtype="float32")
+            p.initialize()
+            p.grad()[:] = mx.nd.array(
+                rng.randn(size).astype(np.float32))
+            params.append(p)
+        tr = Trainer(params, optimizer, {"learning_rate": 1e-3},
+                     kvstore=None)
+        import jax
+
+        def sync():  # EVERY param: the eager path is n_params independent
+            jax.block_until_ready([p.data()._data for p in params])
+
+        tr.step(1)  # warmup + compile
+        sync()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            tr.step(1)
+        sync()  # async dispatches; syncing one would overstate its rate
+        return n_params * steps / (time.perf_counter() - t0)
+
+    prev = os.environ.get("MXTPU_FUSED_OPTIMIZER")
+    try:
+        eager_rate = measure(fused=False)
+        of.reset()
+        fused_rate = measure(fused=True)
+        fused_calls = of.FUSED_STATS["fused_steps"]
+    finally:
+        if prev is None:
+            os.environ.pop("MXTPU_FUSED_OPTIMIZER", None)
+        else:
+            os.environ["MXTPU_FUSED_OPTIMIZER"] = prev
+    return {
+        "metric": "optimizer_step_%s_p%d_n%d" % (optimizer, n_params, size),
+        "value": round(fused_rate, 1),
+        "unit": "params_updated/sec",
+        "vs_baseline": round(fused_rate / eager_rate, 3),  # fused speedup
+        "mfu": None,
+        "hfu": None,
+        "eager_params_per_s": round(eager_rate, 1),
+        "fused_params_per_s": round(fused_rate, 1),
+        "fused_jit_calls": fused_calls,  # == 1 + steps when fully fused
+    }
+
+
 def bench_sparse_linear():
     """BASELINE config 5: sparse linear classification samples/sec
     (examples/sparse/linear_classification.py — LibSVM CSR batches through
@@ -366,6 +431,7 @@ def bench_sparse_linear():
 # round's parsed headline metric (see BENCH_r0*.json "parsed")
 CONFIGS = {
     "eager": bench_eager,
+    "optimizer_step": bench_optimizer_step,
     "sparse_linear": bench_sparse_linear,
     "lstm_ptb": bench_lstm_ptb,
     "bert_base": bench_bert_base,

@@ -1072,7 +1072,10 @@ def executor_backward_ex(ex, ograds: tuple) -> None:
     og = list(ograds) if ograds else None
     if og is not None and any(g is None for g in og):
         outs = ex.outputs or []
-        og = [g if g is not None else nd.ones(tuple(outs[i].shape))
+        # seed in the HEAD's dtype (ones_like semantics): a float32 seed on
+        # a bf16/f16 head would promote every gradient downstream of it
+        og = [g if g is not None
+              else nd.ones(tuple(outs[i].shape), dtype=outs[i].dtype)
               for i, g in enumerate(og)]
     ex.backward(out_grads=og)
 

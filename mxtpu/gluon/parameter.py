@@ -187,9 +187,15 @@ class Parameter:
                 self._shape_resolved(data.shape)
             self._load_init_data(NDArray(data._data if isinstance(data, NDArray) else data))
         else:
-            self._data._set_data(jnp.asarray(
-                data._data if isinstance(data, NDArray) else data,
-                dtype=self._data._data.dtype))
+            src = data._data if isinstance(data, NDArray) else data
+            d = jnp.asarray(src, dtype=self._data._data.dtype)
+            if d is src:
+                # matching dtype aliases the caller's buffer zero-copy; the
+                # fused optimizer step DONATES parameter buffers in place
+                # (optimizer_fused.py), which would delete the caller's
+                # array on the next Trainer.step — take our own copy
+                d = d.copy()
+            self._data._set_data(d)
 
     def _update_aux(self, new_data):
         """Write mutable aux state (moving stats). Under a hybrid trace the update

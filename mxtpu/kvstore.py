@@ -58,7 +58,10 @@ class KVStore:
         for k, v in zip(keys, values):
             if k in self._store:
                 continue
-            self._store[k] = NDArray(v._data)
+            # OWN copy, not an alias of the caller's buffer: the store-side
+            # fused update (optimizer_fused.py) DONATES store weights to
+            # XLA, which would delete a buffer the caller still reads
+            self._store[k] = NDArray(jnp.asarray(v._data).copy())
 
     # -------------------------------------------------------------- push/pull
     def push(self, key, value, priority=0):
@@ -90,11 +93,20 @@ class KVStore:
             merged_list.append(merged)
         if self._kind.startswith("dist"):
             merged_list = self._dist_reduce(keys, merged_list)
-        for k, merged in zip(keys, merged_list):
-            if self._updater is not None:
-                self._updater(_int_key(k), NDArray(merged), self._store[k])
-            else:
+        if self._updater is None:
+            for k, merged in zip(keys, merged_list):
                 self._store[k]._set_data(merged)
+            return
+        if hasattr(self._updater, "update_batch"):
+            # grouped push + store-side update: the whole key group updates
+            # in ONE donated jit (FusedUpdater, mxtpu/optimizer_fused.py)
+            self._updater.update_batch(
+                [_int_key(k) for k in keys],
+                [NDArray(m) for m in merged_list],
+                [self._store[k] for k in keys])
+        else:  # raw set_updater callables keep per-key semantics
+            for k, merged in zip(keys, merged_list):
+                self._updater(_int_key(k), NDArray(merged), self._store[k])
 
     def _dist_reduce(self, keys, merged_list):
         """Sum each local contribution across worker processes.
@@ -157,11 +169,21 @@ class KVStore:
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Copy current value into out (ref: KVStoreLocal::PullImpl)."""
         keys, outs = _normalize_grouped(key, out)
+        donating = getattr(self._updater, "donates", False)
         for k, os_ in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %s has not been initialized" % k)
             for o in os_:
-                o._set_data(jnp.asarray(self._store[k]._data, dtype=o._data.dtype))
+                d = jnp.asarray(self._store[k]._data, dtype=o._data.dtype)
+                if donating and d is self._store[k]._data:
+                    # matching dtype aliases the store buffer zero-copy; the
+                    # store-side fused update DONATES store buffers on the
+                    # next push, which would delete the array handed out
+                    # here — give the caller its own copy instead. With a
+                    # non-donating updater (or none) keep the zero-copy
+                    # alias on the Trainer gradient hot path.
+                    d = d.copy()
+                o._set_data(d)
 
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)
@@ -193,7 +215,15 @@ class KVStore:
 
     # -------------------------------------------------------------- optimizer
     def set_updater(self, updater):
-        """Run this updater on merged gradients (ref: KVStore::set_updater)."""
+        """Run this updater on merged gradients (ref: KVStore::set_updater).
+
+        Installing a batch updater re-owns every stored buffer (one-time
+        copy): a prior no-updater push stores the caller's buffer as-is
+        (cheap on the gradient hot path), and the fused update would
+        otherwise DONATE — delete — an array the caller still holds."""
+        if getattr(updater, "donates", False):
+            for v in self._store.values():
+                v._set_data(jnp.asarray(v._data).copy())
         self._updater = updater
 
     def set_optimizer(self, optimizer):

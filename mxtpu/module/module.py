@@ -228,24 +228,29 @@ class Module(BaseModule):
         _update_params / _update_params_on_kvstore, model.py)."""
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
+        # grouped keys + ONE batched updater call: the kvstore fuses grouped
+        # pushes into one reduce, and FusedUpdater compiles the whole update
+        # into one donated jit (mxtpu/optimizer_fused.py)
+        keys, grads, weights = [], [], []
+        for i, name in enumerate(self._param_names):
+            g = self._exec.grad_dict.get(name)
+            if g is None:
+                continue
+            keys.append(i)
+            grads.append(g)
+            weights.append(self._exec.arg_dict[name])
+        if not keys:
+            return
         if self._kvstore is not None:
-            for i, name in enumerate(self._param_names):
-                w = self._exec.arg_dict[name]
-                g = self._exec.grad_dict.get(name)
-                if g is None:
-                    continue
-                if self._update_on_kvstore:
-                    self._kvstore.push(i, g, priority=-i)
-                    self._kvstore.pull(i, w, priority=-i)
-                else:
-                    self._kvstore.push(i, g, priority=-i)
-                    self._kvstore.pull(i, g, priority=-i)
-                    self._updater(i, g, w)
+            if self._update_on_kvstore:
+                self._kvstore.push(keys, grads)
+                self._kvstore.pull(keys, weights)
+            else:
+                self._kvstore.push(keys, grads)
+                self._kvstore.pull(keys, grads)
+                self._updater.update_batch(keys, grads, weights)
         else:
-            for i, name in enumerate(self._param_names):
-                g = self._exec.grad_dict.get(name)
-                if g is not None:
-                    self._updater(i, g, self._exec.arg_dict[name])
+            self._updater.update_batch(keys, grads, weights)
 
     def get_outputs(self, merge_multi_context=True):
         return self._exec.outputs

@@ -54,26 +54,34 @@ def to_dlpack_for_read(data):
     return _capsule_from(data)
 
 
-_warned_write = False
-
-
 def to_dlpack_for_write(data):
     """Reference-parity name; delivers a WRITABLE HOST COPY, and consumer
-    writes do NOT propagate back (warned once). XLA buffers are immutable
-    — handing a consumer a mutable pointer into one would corrupt
-    jit-cached/aliased computations, and the reference's in-place
-    write-back contract (ndarray.py:3956) cannot hold on a functional
-    runtime. Write into a fresh array and assign it back instead
-    (``x[:] = mx.nd.from_dlpack(...)``)."""
-    global _warned_write
-    if not _warned_write:
-        _warned_write = True
+    writes do NOT propagate back. XLA buffers are immutable — handing a
+    consumer a mutable pointer into one would corrupt jit-cached/aliased
+    computations, and the reference's in-place write-back contract
+    (ndarray.py:3956) cannot hold on a functional runtime. Write into a
+    fresh array and assign it back instead
+    (``x[:] = mx.nd.from_dlpack(...)``).
+
+    Warns on EVERY call — a ported write-back-dependent code path must fail
+    loudly each time, not only on its first buffer (ADVICE r5: the single
+    process-wide warning was suppressible by warning filters and then
+    silently lost writes). Set ``MXTPU_DLPACK_WRITE_COPY=1`` to acknowledge
+    the detached-copy semantics explicitly and silence the warning."""
+    import os
+    if os.environ.get("MXTPU_DLPACK_WRITE_COPY", "0") != "1":
         import warnings
-        warnings.warn(
+        # warn_explicit with a FRESH registry: plain warnings.warn is deduped
+        # per call site by the default filter, which is exactly the
+        # silently-lost-writes failure mode this warning exists to prevent
+        warnings.warn_explicit(
             "to_dlpack_for_write exports a host COPY on this runtime: "
             "consumer writes do not propagate back to the NDArray "
             "(XLA buffers are immutable). Assign results back with "
-            "x[:] = mx.nd.from_dlpack(...) instead.")
+            "x[:] = mx.nd.from_dlpack(...) instead, or set "
+            "MXTPU_DLPACK_WRITE_COPY=1 to acknowledge the copy semantics "
+            "and silence this warning.",
+            UserWarning, __file__, 0, registry={})
     if not isinstance(data, NDArray):
         raise MXNetError("to_dlpack expects an NDArray, got %s"
                          % type(data).__name__)
@@ -112,6 +120,18 @@ def from_dlpack(dlpack) -> NDArray:
     returned NDArray afterwards."""
     import jax.dlpack
 
+    if ctypes.pythonapi.PyCapsule_IsValid(
+            ctypes.py_object(dlpack), b"dltensor_versioned"):
+        # DLPack 1.0 renamed the capsule and prefixed the struct with a
+        # version/flags header (DLManagedTensorVersioned); the pre-1.0
+        # ctypes parsing below would misread it. Name the case instead of
+        # letting jax fail with an obscure "no __dlpack__" error.
+        raise MXNetError(
+            "from_dlpack got a DLPack-1.0 'dltensor_versioned' capsule; "
+            "this importer consumes the pre-1.0 'dltensor' layout. "
+            "Re-export from the producer without max_version (the legacy "
+            "protocol, e.g. tensor.__dlpack__()), or pass the producer "
+            "object itself so the exchange negotiates a version.")
     is_capsule = ctypes.pythonapi.PyCapsule_IsValid(
         ctypes.py_object(dlpack), _DLTENSOR)
     src = _CapsuleDLPack(dlpack) if is_capsule else dlpack

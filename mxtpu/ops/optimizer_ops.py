@@ -16,13 +16,19 @@ import jax.numpy as jnp
 from .registry import register
 
 
-def _rescale_clip(grad, rescale_grad, clip_gradient, wd=0.0, weight=None):
+def _rescale_clip(grad, rescale_grad, clip_gradient, wd=None, weight=None):
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
-    if wd and weight is not None:
-        g = g + wd * weight
-    return g
+    if wd is None or weight is None:
+        return g
+    if isinstance(wd, (int, float)) and wd == 0.0:
+        # eager callers pass a Python float: keep skipping the add like the
+        # pre-fused code (0*inf would turn a diverged weight into nan)
+        return g
+    # traced wd (fused step, optimizer_fused.py): no boolean short-circuit
+    # on a Tracer; wd=0 is then a numerical no-op for finite weights
+    return g + wd * weight
 
 
 def sgd_update_fn(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,

@@ -144,6 +144,18 @@ register = Optimizer.register
 create = Optimizer.create_optimizer
 
 
+def _zeros_like_state(weight):
+    """Factory for optimizer state slots: each call allocates a DISTINCT
+    zeros buffer. The fused update path (optimizer_fused.py) donates every
+    state leaf to XLA; slots sharing one array would donate the same buffer
+    twice and kick the whole step back to the eager loop."""
+    shape, dtype = weight.shape, weight._data.dtype
+
+    def make():
+        return NDArray(jnp.zeros(shape, dtype))
+    return make
+
+
 @register
 class SGD(Optimizer):
     """SGD ± momentum, multi-precision, lazy sparse update
@@ -246,8 +258,8 @@ class FTML(Optimizer):
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
-        return (NDArray(z), NDArray(z), NDArray(z))  # d, v, z
+        z = _zeros_like_state(weight)  # distinct buffers: the fused step
+        return (z(), z(), z())           # DONATES each leaf (d, v, z)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -325,8 +337,8 @@ class Adam(Optimizer):
         self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
-        return (NDArray(z), NDArray(z))
+        z = _zeros_like_state(weight)
+        return (z(), z())
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -393,10 +405,10 @@ class RMSProp(Optimizer):
         self.clip_weights = clip_weights
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
+        z = _zeros_like_state(weight)
         if self.centered:
-            return (NDArray(z), NDArray(z), NDArray(z))  # n, g, delta
-        return (NDArray(z),)
+            return (z(), z(), z())  # n, g, delta
+        return (z(),)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -422,8 +434,8 @@ class AdaDelta(Optimizer):
         self.rho, self.epsilon = rho, epsilon
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
-        return (NDArray(z), NDArray(z))  # acc_g, acc_delta
+        z = _zeros_like_state(weight)
+        return (z(), z())  # acc_g, acc_delta
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -450,8 +462,8 @@ class Ftrl(Optimizer):
         self.lamda1, self.beta = lamda1, beta
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
-        return (NDArray(z), NDArray(z))  # z, n
+        z = _zeros_like_state(weight)
+        return (z(), z())  # z, n
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -470,8 +482,8 @@ class Adamax(Optimizer):
         self.beta1, self.beta2 = beta1, beta2
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
-        return (NDArray(z), NDArray(z))
+        z = _zeros_like_state(weight)
+        return (z(), z())
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -502,8 +514,8 @@ class Nadam(Optimizer):
         self.m_schedule = 1.0
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
-        return (NDArray(z), NDArray(z))
+        z = _zeros_like_state(weight)
+        return (z(), z())
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -587,6 +599,13 @@ class Updater:
             self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
         self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
 
+    def update_batch(self, indices, grads, weights):
+        """Apply one step to many (index, grad, weight) triples. Here: the
+        eager per-index loop; FusedUpdater (optimizer_fused.py) overrides
+        this with ONE donated jit over the whole batch."""
+        for i, g, w in zip(indices, grads, weights):
+            self(i, g, w)
+
     def get_states(self, dump_optimizer=False):
         import pickle
         state = {}
@@ -623,7 +642,11 @@ def _state_from_numpy(v):
 
 
 def get_updater(optimizer: Optimizer) -> Updater:
-    return Updater(optimizer)
+    """An Updater whose batch path fuses the whole step into one donated jit
+    (optimizer_fused.FusedUpdater; MXTPU_FUSED_OPTIMIZER=0 keeps its batch
+    path on the eager loop). Per-index __call__ semantics are unchanged."""
+    from .optimizer_fused import FusedUpdater
+    return FusedUpdater(optimizer)
 
 
 @register

@@ -1,0 +1,520 @@
+"""Fused whole-model optimizer step: ONE donated jit per Trainer.step.
+
+The eager update path (Optimizer.update driven from Updater.__call__) issues
+3-10 tiny XLA dispatches *per parameter per step* — exactly the
+consecutive-small-ops anti-pattern the reference engine exists to bulk
+(SURVEY §1; "Operator Fusion in XLA" shows this elementwise chain is where
+fusion pays). This module is the update-path analog of CachedOp for
+forward/backward: every optimizer's update rule is restated as a pure
+``step(weight, grad, state, hyper, rescale, static) -> (new_w, new_state)``
+function; the whole parameter list is stacked into one pytree and compiled
+as a single ``jax.jit`` with ``donate_argnums`` on weights and states, so
+XLA updates every buffer in place with no copies and no per-param host
+round trips ("Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training" treats the weight update as the same first-class
+fusion target).
+
+Cache key = (optimizer class, static config like momentum/betas/clip,
+per-param shapes+dtypes+state structure). Hyperparameters that move between
+steps — lr (schedules!), wd, rescale_grad=1/batch, bias-correction terms of
+the update count t — enter as *traced* scalars, so an lr-schedule tick or a
+batch-size change never retriggers compilation.
+
+Fallback to the eager per-param loop: sparse (row_sparse) grads, optimizers
+with host-side control flow (SGLD's rng draw, LBSGD's norm-driven LARS
+ratio), aliased buffers (donation would invalidate a live input twice), or
+``MXTPU_FUSED_OPTIMIZER=0``.
+"""
+from __future__ import annotations
+
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray import NDArray
+from .ops import optimizer_ops as _uo
+from .optimizer import (SGD, Adam, AdaGrad, RMSProp, AdaDelta, Ftrl, Adamax,
+                        Nadam, NAG, Signum, FTML, DCASGD, Test, GroupAdaGrad,
+                        Updater)
+
+__all__ = ["FusedUpdater", "fused_enabled", "cache_size", "reset",
+           "FUSED_STATS"]
+
+
+def fused_enabled():
+    """Measured default ON; MXTPU_FUSED_OPTIMIZER=0 is the escape hatch
+    (read per call, so it can be flipped mid-process for A/Bs)."""
+    return os.environ.get("MXTPU_FUSED_OPTIMIZER", "1") != "0"
+
+
+# fused_steps: fused jit invocations; traces: actual retraces (bumped at
+# trace time INSIDE the jitted fn — the recompile counter tests assert on);
+# compiles: misses of the executable cache; eager_updates: per-param
+# fallback updates
+FUSED_STATS = {"fused_steps": 0, "traces": 0, "compiles": 0,
+               "eager_updates": 0}
+_JIT_CACHE = {}
+
+
+def cache_size():
+    return len(_JIT_CACHE)
+
+
+def reset():
+    """Test hook: drop compiled executables and zero the counters."""
+    _JIT_CACHE.clear()
+    for k in FUSED_STATS:
+        FUSED_STATS[k] = 0
+
+
+# --------------------------------------------------------------------- rules
+class _Rule:
+    """One optimizer class's pure functional update.
+
+    ``static(opt)`` -> hashable config baked into the trace (part of the jit
+    cache key); ``hyper(opt, index, t)`` -> per-param scalars traced as
+    arguments (lr/wd after lr_mult/wd_mult, bias-correction terms of t);
+    ``step(w, g, state, hyper, rescale, static)`` -> (new_w, new_state) with
+    ``state`` the same tuple/None structure the Updater stores.
+    """
+
+    __slots__ = ("static", "hyper", "step")
+
+    def __init__(self, static, hyper, step):
+        self.static = static
+        self.hyper = hyper
+        self.step = step
+
+
+def _clip_of(opt):
+    return float(opt.clip_gradient) if opt.clip_gradient else -1.0
+
+
+def _lr_wd(opt, index, _t=None):
+    return float(opt._get_lr(index)), float(opt._get_wd(index))
+
+
+def _sgd_static(opt):
+    return (float(opt.momentum), _clip_of(opt))
+
+
+def _sgd_step(w, g, state, hyper, rescale, static):
+    lr, wd = hyper
+    momentum, clip = static
+    if state is None:
+        return _uo.sgd_update_fn(w, g, lr, wd=wd, rescale_grad=rescale,
+                                 clip_gradient=clip), None
+    return _uo.sgd_mom_update_fn(w, g, state, lr, momentum=momentum, wd=wd,
+                                 rescale_grad=rescale, clip_gradient=clip)
+
+
+def _nag_step(w, g, state, hyper, rescale, static):
+    lr, wd = hyper
+    momentum, clip = static
+    if state is None:
+        return _uo.sgd_update_fn(w, g, lr, wd=wd, rescale_grad=rescale,
+                                 clip_gradient=clip), None
+    return _uo.nag_mom_update_fn(w, g, state, lr, momentum=momentum, wd=wd,
+                                 rescale_grad=rescale, clip_gradient=clip)
+
+
+def _signum_static(opt):
+    return (float(opt.momentum), float(opt.wd_lh), _clip_of(opt))
+
+
+def _signum_step(w, g, state, hyper, rescale, static):
+    lr, wd = hyper
+    momentum, wd_lh, clip = static
+    if state is None:
+        return _uo.signsgd_update_fn(w, g, lr, wd=wd, rescale_grad=rescale,
+                                     clip_gradient=clip), None
+    return _uo.signum_update_fn(w, g, state, lr, momentum=momentum, wd=wd,
+                                rescale_grad=rescale, clip_gradient=clip,
+                                wd_lh=wd_lh)
+
+
+def _beta_eps_static(opt):
+    return (float(opt.beta1), float(opt.beta2), float(opt.epsilon),
+            _clip_of(opt))
+
+
+def _ftml_hyper(opt, index, t):
+    lr, wd = _lr_wd(opt, index)
+    return (lr, wd, 1.0 - opt.beta1 ** t, 1.0 - opt.beta2 ** t)
+
+
+def _ftml_step(w, g, state, hyper, rescale, static):
+    lr, wd, bc1, bc2 = hyper  # 1 - beta1^t, 1 - beta2^t (host-computed)
+    beta1, beta2, eps, clip = static
+    d, v, z = state
+    g = _uo._rescale_clip(g, rescale, clip, wd, w)
+    v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_new = bc1 / lr * (jnp.sqrt(v_new / bc2) + eps)
+    sigma = d_new - beta1 * d
+    z_new = beta1 * z + (1 - beta1) * g - sigma * w
+    return -z_new / d_new, (d_new, v_new, z_new)
+
+
+def _dcasgd_static(opt):
+    return (float(opt.momentum), float(opt.lamda), _clip_of(opt))
+
+
+def _dcasgd_step(w, g, state, hyper, rescale, static):
+    lr, wd = hyper
+    momentum, lamda, clip = static
+    mom, prev = state
+    g = _uo._rescale_clip(g, rescale, clip, wd, w)
+    comp = g + lamda * g * g * (w - prev)
+    if mom is None:
+        new_mom, delta = None, -lr * comp
+    else:
+        new_mom = momentum * mom - lr * comp
+        delta = new_mom
+    return w + delta, (new_mom, w)  # prev <- pre-update weight, like eager
+
+
+def _adam_hyper(opt, index, t):
+    lr, wd = _lr_wd(opt, index)
+    lr_t = lr * math.sqrt(1.0 - opt.beta2 ** t) / (1.0 - opt.beta1 ** t)
+    return (lr_t, wd)
+
+
+def _adam_step(w, g, state, hyper, rescale, static):
+    lr_t, wd = hyper
+    beta1, beta2, eps, clip = static
+    mean, var = state
+    nw, nm, nv = _uo.adam_update_fn(w, g, mean, var, lr_t, beta1=beta1,
+                                    beta2=beta2, epsilon=eps, wd=wd,
+                                    rescale_grad=rescale, clip_gradient=clip)
+    return nw, (nm, nv)
+
+
+def _adagrad_static(opt):
+    return (float(opt.float_stable_eps), _clip_of(opt))
+
+
+def _adagrad_step(w, g, state, hyper, rescale, static):
+    lr, wd = hyper
+    eps, clip = static
+    return _uo.adagrad_update_fn(w, g, state, lr, epsilon=eps, wd=wd,
+                                 rescale_grad=rescale, clip_gradient=clip)
+
+
+def _rmsprop_static(opt):
+    return (float(opt.gamma1), float(opt.gamma2), float(opt.epsilon),
+            bool(opt.centered), _clip_of(opt),
+            float(opt.clip_weights) if opt.clip_weights else -1.0)
+
+
+def _rmsprop_step(w, g, state, hyper, rescale, static):
+    lr, wd = hyper
+    gamma1, gamma2, eps, centered, clip, clip_w = static
+    if centered:
+        n, g_avg, delta = state
+        nw, nn, ng, nd = _uo.rmspropalex_update_fn(
+            w, g, n, g_avg, delta, lr, gamma1=gamma1, gamma2=gamma2,
+            epsilon=eps, wd=wd, rescale_grad=rescale, clip_gradient=clip,
+            clip_weights=clip_w)
+        return nw, (nn, ng, nd)
+    (n,) = state
+    nw, nn = _uo.rmsprop_update_fn(w, g, n, lr, gamma1=gamma1, epsilon=eps,
+                                   wd=wd, rescale_grad=rescale,
+                                   clip_gradient=clip, clip_weights=clip_w)
+    return nw, (nn,)
+
+
+def _adadelta_static(opt):
+    return (float(opt.rho), float(opt.epsilon), _clip_of(opt))
+
+
+def _adadelta_hyper(opt, index, t):
+    return (float(opt._get_wd(index)),)  # AdaDelta has no lr
+
+
+def _adadelta_step(w, g, state, hyper, rescale, static):
+    (wd,) = hyper
+    rho, eps, clip = static
+    acc_g, acc_d = state
+    g = _uo._rescale_clip(g, rescale, clip, wd, w)
+    ag = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_d + eps) / jnp.sqrt(ag + eps) * g
+    ad = rho * acc_d + (1 - rho) * jnp.square(delta)
+    return w - delta, (ag, ad)
+
+
+def _ftrl_static(opt):
+    return (float(opt.lamda1), float(opt.beta), _clip_of(opt))
+
+
+def _ftrl_step(w, g, state, hyper, rescale, static):
+    lr, wd = hyper
+    lamda1, beta, clip = static
+    z, n = state
+    nw, nz, nn = _uo.ftrl_update_fn(w, g, z, n, lr, lamda1=lamda1, beta=beta,
+                                    wd=wd, rescale_grad=rescale,
+                                    clip_gradient=clip)
+    return nw, (nz, nn)
+
+
+def _adamax_static(opt):
+    return (float(opt.beta1), float(opt.beta2), _clip_of(opt))
+
+
+def _adamax_hyper(opt, index, t):
+    lr, wd = _lr_wd(opt, index)
+    return (lr / (1.0 - opt.beta1 ** t), wd)
+
+
+def _adamax_step(w, g, state, hyper, rescale, static):
+    lr_t, wd = hyper
+    beta1, beta2, clip = static
+    m, u = state
+    g = _uo._rescale_clip(g, rescale, clip, wd, w)
+    m_new = beta1 * m + (1 - beta1) * g
+    u_new = jnp.maximum(beta2 * u, jnp.abs(g))
+    return w - lr_t * m_new / (u_new + 1e-8), (m_new, u_new)
+
+
+def _nadam_hyper(opt, index, t):
+    lr, wd = _lr_wd(opt, index)
+    momentum_t = opt.beta1 * (1.0 - 0.5 * 0.96 ** (t * opt.schedule_decay))
+    momentum_t_1 = opt.beta1 * (
+        1.0 - 0.5 * 0.96 ** ((t + 1) * opt.schedule_decay))
+    opt.m_schedule *= momentum_t  # same host-side bookkeeping as eager
+    return (lr, wd, momentum_t, momentum_t_1, opt.m_schedule,
+            opt.m_schedule * momentum_t_1, 1.0 - opt.beta2 ** t)
+
+
+def _nadam_step(w, g, state, hyper, rescale, static):
+    lr, wd, momentum_t, momentum_t_1, m_sch, m_sch_next, bc2 = hyper
+    beta1, beta2, eps, clip = static
+    m, v = state
+    g = _uo._rescale_clip(g, rescale, clip, wd, w)
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+    g_prime = g / (1 - m_sch)
+    m_prime = m_new / (1 - m_sch_next)
+    v_prime = v_new / bc2
+    m_bar = (1 - momentum_t) * g_prime + momentum_t_1 * m_prime
+    return w - lr * m_bar / (jnp.sqrt(v_prime) + eps), (m_new, v_new)
+
+
+def _groupadagrad_static(opt):
+    return (float(opt.float_stable_eps), _clip_of(opt))
+
+
+def _groupadagrad_hyper(opt, index, t):
+    return (float(opt._get_lr(index)),)  # eager GroupAdaGrad ignores wd
+
+
+def _groupadagrad_step(w, g, state, hyper, rescale, static):
+    (lr,) = hyper
+    eps, clip = static
+    g = _uo._rescale_clip(g, rescale, clip)
+    red = tuple(range(1, w.ndim))
+    h_new = state + jnp.mean(jnp.square(g), axis=red)
+    div = jnp.sqrt(h_new + eps)
+    return w - lr * g / div.reshape((-1,) + (1,) * (g.ndim - 1)), h_new
+
+
+def _test_step(w, g, state, hyper, rescale, static):
+    nw = w + g * rescale
+    return nw, nw
+
+
+# SGLD (per-step rng draw) and LBSGD (host-side weight/grad norms for the
+# LARS trust ratio) keep the eager path: their updates are not pure
+# functions of (weight, grad, state, scalars). Exact-type lookup also sends
+# unknown Optimizer subclasses to the eager loop — a subclass overriding
+# update() must not silently get its base class's fused rule.
+_RULES = {
+    SGD: _Rule(_sgd_static, _lr_wd, _sgd_step),
+    NAG: _Rule(_sgd_static, _lr_wd, _nag_step),
+    Signum: _Rule(_signum_static, _lr_wd, _signum_step),
+    FTML: _Rule(_beta_eps_static, _ftml_hyper, _ftml_step),
+    DCASGD: _Rule(_dcasgd_static, _lr_wd, _dcasgd_step),
+    Adam: _Rule(_beta_eps_static, _adam_hyper, _adam_step),
+    AdaGrad: _Rule(_adagrad_static, _lr_wd, _adagrad_step),
+    RMSProp: _Rule(_rmsprop_static, _lr_wd, _rmsprop_step),
+    AdaDelta: _Rule(_adadelta_static, _adadelta_hyper, _adadelta_step),
+    Ftrl: _Rule(_ftrl_static, _lr_wd, _ftrl_step),
+    Adamax: _Rule(_adamax_static, _adamax_hyper, _adamax_step),
+    Nadam: _Rule(_beta_eps_static, _nadam_hyper, _nadam_step),
+    GroupAdaGrad: _Rule(_groupadagrad_static, _groupadagrad_hyper,
+                        _groupadagrad_step),
+    Test: _Rule(lambda opt: (), lambda opt, i, t: (), _test_step),
+}
+
+
+# ----------------------------------------------------- state pytree helpers
+def _tree_data(s):
+    if s is None:
+        return None
+    if isinstance(s, NDArray):
+        return s._data
+    return tuple(_tree_data(x) for x in s)
+
+
+def _tree_spec(s):
+    if s is None:
+        return None
+    if isinstance(s, tuple):
+        return tuple(_tree_spec(x) for x in s)
+    return (tuple(s.shape), str(s.dtype))
+
+
+def _tree_writeback(state, new):
+    if state is None:
+        return
+    if isinstance(state, NDArray):
+        state._set_data(new)
+        return
+    for s, n in zip(state, new):
+        _tree_writeback(s, n)
+
+
+def _split_aliased(items, states, eager_items):
+    """Donation invalidates input buffers; a jax.Array appearing under more
+    than one item (tied parameters, Test's state==weight aliasing) or under
+    an eager-bound item must not be donated — the other holder would read a
+    deleted buffer. EVERY item of such an alias group takes the eager loop
+    (where nothing is invalidated); the rest of the batch still fuses."""
+
+    def buf_key(arr):
+        # the DEVICE buffer, not the Python wrapper: XLA output aliasing can
+        # hand two distinct jax.Array objects one buffer (Test's
+        # state==weight contract does exactly that), and donating it twice
+        # is a runtime error on TPU. Sharded arrays have no single pointer —
+        # fall back to object identity there.
+        try:
+            return arr.unsafe_buffer_pointer()
+        except Exception:
+            return id(arr)
+
+    def leaves(x, acc):
+        if isinstance(x, NDArray):
+            acc.append(buf_key(x._data))
+        elif x is not None:
+            for c in x:
+                leaves(c, acc)
+        return acc
+
+    counts = {}      # donated leaves: weights + states of fused candidates
+    protected = set()  # must survive the call: grads + eager items' buffers
+    item_ids = []
+    for item in items:
+        ids = leaves(item[2], leaves(states[item[0]], []))
+        item_ids.append(ids)
+        for b in ids:
+            counts[b] = counts.get(b, 0) + 1
+        protected.update(leaves(item[1], []))
+    for i, g, w in eager_items:
+        protected.update(leaves(w, leaves(g, leaves(states.get(i), []))))
+    clean, aliased = [], []
+    for item, ids in zip(items, item_ids):
+        if all(counts[b] == 1 and b not in protected for b in ids):
+            clean.append(item)
+        else:
+            aliased.append(item)
+    return clean, aliased
+
+
+def _build(rule, static, mp_flags, out_dtypes):
+    def fused(w_list, g_list, s_list, h_list, rescale):
+        FUSED_STATS["traces"] += 1  # trace-time only: counts real recompiles
+        new_w, new_s = [], []
+        for w, g, s, h, mp, odt in zip(w_list, g_list, s_list, h_list,
+                                       mp_flags, out_dtypes):
+            if mp:
+                # multi-precision: state = (f32 master, base state); the
+                # update runs in f32 and storage keeps the bf16/f16 dtype
+                # (the reference's mp_sgd_update pattern, optimizer.py:500)
+                master, base = s
+                nm, nb = rule.step(master, g.astype(jnp.float32), base, h,
+                                   rescale, static)
+                new_w.append(nm.astype(odt))
+                new_s.append((nm, nb))
+            else:
+                nw, ns = rule.step(w, g, s, h, rescale, static)
+                new_w.append(nw)
+                new_s.append(ns)
+        return new_w, new_s
+
+    return jax.jit(fused, donate_argnums=(0, 2))
+
+
+class FusedUpdater(Updater):
+    """Updater whose ``update_batch`` compiles the whole optimizer step into
+    one donated jit (the update-path CachedOp). ``__call__`` keeps the
+    per-index eager semantics, so kvstore servers, serialization, and code
+    driving single-param updates behave exactly as before."""
+
+    # capability marker read by the kvstore's donation-safety copies: True
+    # even under MXTPU_FUSED_OPTIMIZER=0 — the env flag is read per call
+    # and may flip mid-process, so buffers must stay safe to donate
+    donates = True
+
+    def update_batch(self, indices, grads, weights):
+        opt = self.optimizer
+        rule = _RULES.get(type(opt)) if fused_enabled() else None
+        from .ndarray.sparse import RowSparseNDArray
+        fused, eager = [], []
+        for i, g, w in zip(indices, grads, weights):
+            if i not in self.states:
+                self.states[i] = opt.create_state_multi_precision(i, w)
+            if rule is None or isinstance(g, RowSparseNDArray) \
+                    or isinstance(w, RowSparseNDArray):
+                eager.append((i, g, w))
+            else:
+                fused.append((i, g, w))
+        if fused:
+            fused, aliased = _split_aliased(fused, self.states, eager)
+            eager.extend(aliased)
+        if fused and eager and isinstance(opt, Nadam):
+            # Nadam's m_schedule is ORDER-dependent host state (one multiply
+            # per param update): a mixed batch must keep the exact eager
+            # call order, so run the whole batch eagerly in index order
+            fused, eager = [], list(zip(indices, grads, weights))
+        if fused:
+            self._fused_apply(rule, fused)
+        for i, g, w in eager:
+            opt.update_multi_precision(i, w, g, self.states[i])
+            FUSED_STATS["eager_updates"] += 1
+
+    def _fused_apply(self, rule, items):
+        opt = self.optimizer
+        # bump every count first so _get_lr sees the post-step num_update for
+        # ALL params (the eager loop's first update already bumps it before
+        # any lr is read)
+        for i, _, _ in items:
+            opt._update_count(i)
+        w_datas, g_datas, s_datas, hypers = [], [], [], []
+        mp_flags, out_dtypes, specs = [], [], []
+        for i, g, w in items:
+            t = opt._index_update_count[i]
+            hypers.append(tuple(float(h) for h in rule.hyper(opt, i, t)))
+            mp = bool(opt.multi_precision
+                      and w.dtype in (jnp.float16, jnp.bfloat16))
+            sd = _tree_data(self.states[i])
+            w_datas.append(w._data)
+            g_datas.append(g._data)
+            s_datas.append(sd)
+            mp_flags.append(mp)
+            out_dtypes.append(w._data.dtype)
+            specs.append((tuple(w.shape), str(w.dtype), str(g.dtype),
+                          _tree_spec(sd), mp))
+        static = rule.static(opt)
+        key = (type(opt).__name__, static, tuple(specs))
+        fn = _JIT_CACHE.get(key)
+        if fn is None:
+            fn = _build(rule, static, tuple(mp_flags), tuple(out_dtypes))
+            _JIT_CACHE[key] = fn
+            FUSED_STATS["compiles"] += 1
+        new_w, new_s = fn(w_datas, g_datas, s_datas, hypers,
+                          float(opt.rescale_grad))
+        FUSED_STATS["fused_steps"] += 1
+        for (i, _, w), nw, ns in zip(items, new_w, new_s):
+            w._set_data(nw)
+            _tree_writeback(self.states[i], ns)

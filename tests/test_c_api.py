@@ -1385,6 +1385,26 @@ def test_reshape64_alias_abi(lib):
                                np.arange(6).reshape(2, 3))
 
 
+def test_executor_backward_ex_none_seed_keeps_head_dtype():
+    """A None ograd entry seeds with ones in the HEAD's dtype (ones_like
+    semantics, ref MXExecutorBackwardEx NULL entries): a float32 seed on a
+    bf16 head would promote every gradient downstream (ADVICE r5)."""
+    import mxtpu as mx
+    from mxtpu import c_api_impl
+    from mxtpu import symbol as sym
+
+    x = sym.var("x")
+    y = x * 2.0
+    w = mx.nd.ones((3,)).astype("bfloat16")
+    exe = y.bind(args={"x": w}, grad_req={"x": "write"})
+    exe.forward(is_train=True)
+    assert str(exe.outputs[0].dtype) == "bfloat16"
+    c_api_impl.executor_backward_ex(exe, (None,))
+    assert str(exe.grad_dict["x"].dtype) == "bfloat16"
+    np.testing.assert_allclose(
+        exe.grad_dict["x"].asnumpy().astype(np.float32), 2.0)
+
+
 def test_executor_backward_ex_and_grad_state_abi(lib):
     """Explicit-ograd backward + the fresh-grad bookkeeping bit
     (ref MXExecutorBackwardEx / MXNDArraySetGradState)."""

@@ -15,22 +15,41 @@ def test_capsule_round_trip():
     np.testing.assert_allclose(y2.asnumpy(), x.asnumpy())
 
 
-def test_for_write_is_a_loud_host_copy():
+def test_for_write_is_a_loud_host_copy(monkeypatch):
     """XLA buffers are immutable: the write variant delivers a host copy
-    and warns ONCE that consumer writes do not propagate (review r5)."""
+    and warns on EVERY call (ADVICE r5: the warn-once behavior silently
+    lost writes after filters ate the first warning).
+    MXTPU_DLPACK_WRITE_COPY=1 is the explicit opt-in that silences it."""
     import warnings
-    from mxtpu.ndarray import dlpack as dlp
-    dlp._warned_write = False
+    monkeypatch.delenv("MXTPU_DLPACK_WRITE_COPY", raising=False)
     x = mx.nd.array(np.zeros(3, np.float32))
     with pytest.warns(UserWarning, match="do not propagate"):
         cap = x.to_dlpack_for_write()
+    with pytest.warns(UserWarning, match="do not propagate"):
+        x.to_dlpack_for_write()  # ...and again on the next call
+    monkeypatch.setenv("MXTPU_DLPACK_WRITE_COPY", "1")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        x.to_dlpack_for_write()  # acknowledged: silent
+    monkeypatch.delenv("MXTPU_DLPACK_WRITE_COPY")
     torch = pytest.importorskip("torch")
     t = torch.utils.dlpack.from_dlpack(cap)
     t.add_(5.0)  # writes land in the copy...
     np.testing.assert_allclose(x.asnumpy(), 0.0)  # ...never in x
-    with warnings.catch_warnings():
-        warnings.simplefilter("error")
-        x.to_dlpack_for_write()  # warned once only
+
+
+def test_versioned_capsule_is_a_named_error():
+    """A DLPack-1.0 'dltensor_versioned' capsule must raise a clear
+    MXNetError naming the versioned-capsule case, not an obscure jax
+    failure (ADVICE r5)."""
+    import ctypes
+    from mxtpu.base import MXNetError
+    new = ctypes.pythonapi.PyCapsule_New
+    new.restype = ctypes.py_object
+    new.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p]
+    cap = new(ctypes.c_void_p(1), b"dltensor_versioned", None)
+    with pytest.raises(MXNetError, match="dltensor_versioned"):
+        mx.nd.from_dlpack(cap)
 
 
 def test_torch_both_directions():

@@ -1,0 +1,308 @@
+"""Fused whole-model optimizer step (mxtpu/optimizer_fused.py):
+
+ONE donated jit per Trainer.step instead of 3-10 eager dispatches per
+parameter. Pinned here: fused-vs-eager numerical parity for EVERY
+registered optimizer (f32 and bf16), the jit-cache contract (an lr change
+or batch-size change must NOT retrace), exactly one compiled update call
+per step on a >=50-parameter model, and the eager fallbacks (sparse grads,
+MXTPU_FUSED_OPTIMIZER=0, unfusable optimizers).
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import optimizer as opt
+from mxtpu import optimizer_fused as of
+from mxtpu.gluon.parameter import Parameter
+from mxtpu.gluon.trainer import Trainer
+
+STEPS = 4
+SHAPES = [(4, 3), (7,), (2, 5)]
+ALL_OPTIMIZERS = sorted(opt.Optimizer.opt_registry)
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("MXTPU_FUSED_OPTIMIZER", raising=False)
+    of.reset()
+    yield
+    of.reset()
+
+
+def _make_params(rng, shapes=SHAPES, dtype="float32"):
+    ws = []
+    for s in shapes:
+        w = mx.nd.array(rng.uniform(-1, 1, s).astype(np.float32))
+        ws.append(w.astype(dtype) if dtype != "float32" else w)
+    return ws
+
+
+def _run_traj(name, fused, monkeypatch, dtype="float32", **opt_kw):
+    """Drive update_batch for STEPS steps; return final weights."""
+    monkeypatch.setenv("MXTPU_FUSED_OPTIMIZER", "1" if fused else "0")
+    mx.random.seed(7)  # SGLD draws noise: both paths must see one stream
+    o = opt.create(name, learning_rate=0.05, wd=0.01, **opt_kw)
+    upd = opt.get_updater(o)
+    rng = np.random.RandomState(3)
+    ws = _make_params(rng, dtype=dtype)
+    for _ in range(STEPS):
+        gs = _make_params(rng, dtype=dtype)
+        upd.update_batch(list(range(len(ws))), gs, ws)
+    return [w.asnumpy() for w in ws]
+
+
+@pytest.mark.parametrize("name", ALL_OPTIMIZERS)
+def test_fused_eager_parity_f32(name, monkeypatch):
+    got = _run_traj(name, True, monkeypatch)
+    want = _run_traj(name, False, monkeypatch)
+    for x, y in zip(got, want):
+        np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("name", ALL_OPTIMIZERS)
+def test_fused_eager_parity_bf16(name, monkeypatch):
+    got = _run_traj(name, True, monkeypatch, dtype="bfloat16")
+    want = _run_traj(name, False, monkeypatch, dtype="bfloat16")
+    for x, y in zip(got, want):
+        np.testing.assert_allclose(x, y, rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("name", ["sgd", "adam"])
+def test_fused_multi_precision_parity(name, monkeypatch):
+    """bf16 weights + f32 master copy: the fused step must reproduce the
+    eager update_multi_precision path (master updated in f32, storage
+    recast to bf16)."""
+    kw = {"momentum": 0.9} if name == "sgd" else {}
+    got = _run_traj(name, True, monkeypatch, dtype="bfloat16",
+                    multi_precision=True, **kw)
+    fused_steps = of.FUSED_STATS["fused_steps"]
+    assert fused_steps == STEPS  # the mp path really fused
+    want = _run_traj(name, False, monkeypatch, dtype="bfloat16",
+                     multi_precision=True, **kw)
+    assert of.FUSED_STATS["fused_steps"] == fused_steps  # env=0 was eager
+    for x, y in zip(got, want):
+        np.testing.assert_allclose(x, y, rtol=2e-2, atol=2e-2)
+
+
+def _trainer_with(n_params, optimizer="sgd", opt_params=None, shape=(11,)):
+    rng = np.random.RandomState(0)
+    params = []
+    for j in range(n_params):
+        p = Parameter("fp%d" % j, shape=shape, dtype="float32")
+        p.initialize()
+        p.grad()[:] = mx.nd.array(rng.randn(*shape).astype(np.float32))
+        params.append(p)
+    opt_params = opt_params or {"learning_rate": 0.1, "momentum": 0.9}
+    return Trainer(params, optimizer, opt_params, kvstore=None), params
+
+
+def test_one_compiled_call_per_step_on_50_plus_params(monkeypatch):
+    """The acceptance criterion: Trainer.step on a >=50-parameter model is
+    exactly ONE compiled update invocation per step — no per-param
+    dispatches, no per-step retraces."""
+    monkeypatch.setenv("MXTPU_FUSED_OPTIMIZER", "1")
+    tr, params = _trainer_with(60)
+    of.reset()
+    for _ in range(3):
+        tr.step(1)
+    assert of.FUSED_STATS["fused_steps"] == 3
+    assert of.FUSED_STATS["traces"] == 1  # compiled once, reused per step
+    assert of.FUSED_STATS["eager_updates"] == 0
+    assert of.cache_size() == 1
+
+
+def test_lr_and_batch_change_do_not_recompile(monkeypatch):
+    """lr (schedules!) and rescale_grad=1/batch are traced scalars: moving
+    them must reuse the ONE cached executable."""
+    monkeypatch.setenv("MXTPU_FUSED_OPTIMIZER", "1")
+    tr, params = _trainer_with(5)
+    of.reset()
+    tr.step(1)
+    assert of.FUSED_STATS["traces"] == 1 and of.cache_size() == 1
+    tr.set_learning_rate(0.001)
+    tr.step(1)
+    tr.step(8)  # batch-size change -> different rescale_grad
+    assert of.FUSED_STATS["traces"] == 1
+    assert of.FUSED_STATS["compiles"] == 1
+    assert of.cache_size() == 1
+    assert of.FUSED_STATS["fused_steps"] == 3
+
+
+def test_sparse_grads_fall_back_to_eager(monkeypatch):
+    """row_sparse grads take the lazy eager update; dense params in the
+    same batch still fuse."""
+    from mxtpu.ndarray.sparse import row_sparse_array
+    monkeypatch.setenv("MXTPU_FUSED_OPTIMIZER", "1")
+    o = opt.SGD(learning_rate=0.1)
+    upd = opt.get_updater(o)
+    rng = np.random.RandomState(2)
+    w_dense = mx.nd.array(rng.randn(4, 3).astype(np.float32))
+    w_sparse = mx.nd.array(rng.randn(6, 3).astype(np.float32))
+    w_sparse_ref = w_sparse.asnumpy().copy()
+    g_dense = mx.nd.array(rng.randn(4, 3).astype(np.float32))
+    rows_data = rng.randn(2, 3).astype(np.float32)
+    g_sparse = row_sparse_array((rows_data, [1, 4]), shape=(6, 3))
+    of.reset()
+    upd.update_batch([0, 1], [g_dense, g_sparse], [w_dense, w_sparse])
+    assert of.FUSED_STATS["eager_updates"] == 1  # the sparse one
+    assert of.FUSED_STATS["fused_steps"] == 1    # the dense one still fused
+    # lazy semantics preserved: untouched rows did not move
+    got = w_sparse.asnumpy()
+    np.testing.assert_allclose(got[[0, 2, 3, 5]],
+                               w_sparse_ref[[0, 2, 3, 5]])
+    assert not np.allclose(got[[1, 4]], w_sparse_ref[[1, 4]])
+
+
+def test_env_escape_hatch_forces_eager(monkeypatch):
+    monkeypatch.setenv("MXTPU_FUSED_OPTIMIZER", "0")
+    tr, params = _trainer_with(4)
+    of.reset()
+    tr.step(1)
+    assert of.FUSED_STATS["fused_steps"] == 0
+    assert of.FUSED_STATS["eager_updates"] == 4
+
+
+def test_tied_parameters_fall_back_per_item(monkeypatch):
+    """Two Parameters sharing one buffer would donate it twice — those
+    items route to the eager loop; the rest of the batch fuses."""
+    monkeypatch.setenv("MXTPU_FUSED_OPTIMIZER", "1")
+    o = opt.SGD(learning_rate=0.1)
+    upd = opt.get_updater(o)
+    rng = np.random.RandomState(5)
+    w0 = mx.nd.array(rng.randn(3).astype(np.float32))
+    w_tied = mx.nd.NDArray(w0._data)  # same jax buffer
+    w1 = mx.nd.array(rng.randn(3).astype(np.float32))
+    gs = [mx.nd.array(rng.randn(3).astype(np.float32)) for _ in range(3)]
+    of.reset()
+    upd.update_batch([0, 1, 2], gs, [w0, w_tied, w1])
+    assert of.FUSED_STATS["fused_steps"] == 1   # w1 alone still fuses
+    assert of.FUSED_STATS["eager_updates"] == 2  # the whole alias group
+    w0.asnumpy()  # both halves of the tie stay readable (nothing donated)
+    w_tied.asnumpy()
+
+
+def test_kvstore_grouped_push_fuses(monkeypatch):
+    """The local kvstore's store-side update (set_optimizer + grouped push)
+    rides the same ONE-jit path."""
+    from mxtpu import kvstore as kv_mod
+    monkeypatch.setenv("MXTPU_FUSED_OPTIMIZER", "1")
+    kv = kv_mod.create("local")
+    kv.set_optimizer(opt.SGD(learning_rate=0.1, momentum=0.9))
+    rng = np.random.RandomState(4)
+    keys = list(range(6))
+    ws = [mx.nd.array(rng.randn(5).astype(np.float32)) for _ in keys]
+    for k, w in zip(keys, ws):
+        kv.init(k, w)
+    gs = [mx.nd.array(rng.randn(5).astype(np.float32)) for _ in keys]
+    of.reset()
+    kv.push(keys, gs)
+    assert of.FUSED_STATS["fused_steps"] == 1
+    assert of.FUSED_STATS["eager_updates"] == 0
+    outs = [mx.nd.zeros((5,)) for _ in keys]
+    kv.pull(keys, outs)
+    # sanity: the store moved (one SGD step applied)
+    assert not np.allclose(outs[0].asnumpy(), ws[0].asnumpy())
+
+
+def test_pulled_arrays_survive_store_side_fused_update(monkeypatch):
+    """pull() must hand out the caller's OWN buffer: the store-side fused
+    update DONATES store weights on the next push, which would delete a
+    zero-copy alias (real deletion on TPU; pinned here structurally)."""
+    from mxtpu import kvstore as kv_mod
+    monkeypatch.setenv("MXTPU_FUSED_OPTIMIZER", "1")
+    kv = kv_mod.create("local")
+    kv.set_optimizer(opt.SGD(learning_rate=0.1))
+    w = mx.nd.array(np.ones(4, np.float32))
+    kv.init(0, w)
+    pulled = mx.nd.zeros((4,))
+    kv.pull(0, pulled)
+    assert pulled._data is not kv._store["0"]._data  # no escaping alias
+    kv.push(0, mx.nd.array(np.full(4, 0.5, np.float32)))
+    np.testing.assert_allclose(pulled.asnumpy(), 1.0)  # survives donation
+    after = mx.nd.zeros((4,))
+    kv.pull(0, after)
+    np.testing.assert_allclose(after.asnumpy(), 0.95)  # 1 - 0.1*0.5
+
+
+def test_set_data_source_survives_fused_step(monkeypatch):
+    """Parameter.set_data must not alias the caller's array: the next
+    fused step donates the parameter buffer, which would delete the
+    caller's copy."""
+    monkeypatch.setenv("MXTPU_FUSED_OPTIMIZER", "1")
+    p = Parameter("sd", shape=(5,), dtype="float32")
+    p.initialize()
+    src = mx.nd.array(np.full(5, 2.0, np.float32))
+    p.set_data(src)
+    assert p.data()._data is not src._data
+    p.grad()[:] = mx.nd.array(np.ones(5, np.float32))
+    tr = Trainer([p], "sgd", {"learning_rate": 0.1}, kvstore=None)
+    tr.step(1)
+    np.testing.assert_allclose(src.asnumpy(), 2.0)   # caller's array alive
+    np.testing.assert_allclose(p.data().asnumpy(), 1.9)
+
+
+def test_nadam_mixed_batch_keeps_eager_order(monkeypatch):
+    """Nadam's m_schedule is order-dependent host state: a batch mixing
+    fused-eligible and eager-bound (here: tied/aliased) items must
+    reproduce the pure eager trajectory exactly — the whole batch runs
+    eagerly in index order."""
+
+    def run(fused):
+        monkeypatch.setenv("MXTPU_FUSED_OPTIMIZER", "1" if fused else "0")
+        o = opt.create("nadam", learning_rate=0.05)
+        upd = opt.get_updater(o)
+        rng = np.random.RandomState(9)
+        tied = mx.nd.array(rng.randn(5, 3).astype(np.float32))
+        ws = [tied, mx.nd.NDArray(tied._data),  # alias group -> eager
+              mx.nd.array(rng.randn(5, 3).astype(np.float32))]
+        for _ in range(3):
+            gs = [mx.nd.array(rng.randn(5, 3).astype(np.float32))
+                  for _ in ws]
+            upd.update_batch([0, 1, 2], gs, ws)
+        return [w.asnumpy() for w in ws], o.m_schedule
+
+    (got, ms_f), (want, ms_e) = run(True), run(False)
+    assert ms_f == ms_e  # identical host-side schedule bookkeeping
+    for x, y in zip(got, want):
+        np.testing.assert_allclose(x, y, rtol=1e-6, atol=1e-7)
+
+
+def test_set_optimizer_after_aliasing_push_re_owns_store(monkeypatch):
+    """A no-updater push stores the caller's buffer as-is (hot-path cheap);
+    installing the fused updater must then RE-OWN stored buffers, or the
+    next push would donate — delete — an array the caller still holds."""
+    from mxtpu import kvstore as kv_mod
+    monkeypatch.setenv("MXTPU_FUSED_OPTIMIZER", "1")
+    kv = kv_mod.create("local")
+    w = mx.nd.array(np.ones(4, np.float32))
+    kv.init(0, w)
+    g = mx.nd.array(np.full(4, 2.0, np.float32))
+    kv.push(0, g)  # no updater yet: store takes the merged value as-is
+    kv.set_optimizer(opt.SGD(learning_rate=0.1))
+    kv.push(0, mx.nd.array(np.full(4, 0.5, np.float32)))  # donates store
+    np.testing.assert_allclose(g.asnumpy(), 2.0)  # caller's buffer alive
+
+
+def test_updater_states_roundtrip_across_fused_steps(monkeypatch):
+    """get_states/set_states must serialize fused-updated state identically
+    to eager state (same trajectory after a save/load)."""
+    monkeypatch.setenv("MXTPU_FUSED_OPTIMIZER", "1")
+    o = opt.create("adam", learning_rate=0.01)
+    upd = opt.get_updater(o)
+    rng = np.random.RandomState(6)
+    ws = _make_params(rng)
+    upd.update_batch([0, 1, 2], _make_params(rng), ws)
+    blob = upd.get_states()
+    o2 = opt.create("adam", learning_rate=0.01)
+    o2._index_update_count = dict(o._index_update_count)
+    o2.num_update = o.num_update
+    upd2 = opt.get_updater(o2)
+    upd2.set_states(blob)
+    gs = _make_params(rng)
+    ws_a = [mx.nd.array(w.asnumpy()) for w in ws]
+    ws_b = [mx.nd.array(w.asnumpy()) for w in ws]
+    upd.update_batch([0, 1, 2], gs, ws_a)
+    upd2.update_batch([0, 1, 2], gs, ws_b)
+    for a, b in zip(ws_a, ws_b):
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy(),
+                                   rtol=1e-6, atol=1e-7)

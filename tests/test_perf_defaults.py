@@ -13,7 +13,8 @@ import pytest
 def _clean_env(monkeypatch):
     for var in ("MXTPU_CONV_ACC", "MXTPU_BN_ONEPASS", "MXTPU_RING_FLASH",
                 "MXTPU_FLASH_PAD_D", "MXTPU_CONV_IM2COL",
-                "MXTPU_RNN_HOIST", "BENCH_S2D_STEM", "BENCH_LAYOUT"):
+                "MXTPU_RNN_HOIST", "BENCH_S2D_STEM", "BENCH_LAYOUT",
+                "MXTPU_FUSED_OPTIMIZER"):
         monkeypatch.delenv(var, raising=False)
 
 
@@ -31,6 +32,40 @@ def test_read_sites_mirror_policy_key():
     assert _bn_onepass() is True        # measured +7.8%
     assert _im2col_enabled() is False   # staged, awaiting on-chip A/B
     assert _hoist_enabled() is True
+
+
+def test_fused_optimizer_is_the_measured_default():
+    """The fused whole-model optimizer step (one donated jit per
+    Trainer.step, mxtpu/optimizer_fused.py) is the measured default; the
+    eager per-param loop is reachable only via MXTPU_FUSED_OPTIMIZER=0."""
+    from mxtpu.optimizer_fused import FusedUpdater, fused_enabled
+    from mxtpu import optimizer as opt
+    assert fused_enabled() is True
+    assert isinstance(opt.get_updater(opt.SGD()), FusedUpdater)
+
+
+def test_optimizer_step_bench_emits_the_benchline_schema(monkeypatch):
+    """bench.py's optimizer_step config must emit the same JSON-line schema
+    the BENCH_r*.json harness parses ({metric, value, unit, vs_baseline,
+    mfu, hfu}), with the fused/eager comparison riding as extra keys."""
+    import json
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import bench
+    assert "optimizer_step" in bench.CONFIGS
+    monkeypatch.setenv("BENCH_OPT_PARAMS", "6")
+    monkeypatch.setenv("BENCH_OPT_PARAM_SIZE", "32")
+    monkeypatch.setenv("BENCH_OPT_STEPS", "2")
+    rec = bench.bench_optimizer_step()
+    assert {"metric", "value", "unit", "vs_baseline", "mfu",
+            "hfu"} <= set(rec)
+    assert rec["metric"].startswith("optimizer_step")
+    assert rec["unit"] == "params_updated/sec"
+    assert rec["fused_params_per_s"] == rec["value"]
+    assert rec["eager_params_per_s"] > 0
+    json.dumps(rec)  # one parseable JSON line
+    # the measurement must restore the ambient default (fused on)
+    assert os.environ.get("MXTPU_FUSED_OPTIMIZER") is None
 
 
 def test_bench_defaults_measure_the_best_config(monkeypatch):
