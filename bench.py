@@ -14,7 +14,11 @@ JSON line each, so the driver artifact captures all three):
 
 Every config prints ONE JSON line {"metric", "value", "unit", "vs_baseline",
 "mfu", "hfu"} (resnet50 adds "pct_of_achievable" — per-chip fraction of the
-measured 140 TFLOP/s achievable rate, the PERF.md gap statement):
+measured 140 TFLOP/s achievable rate, the PERF.md gap statement; the
+``conv_class`` config additionally emits one line per conv class x impl —
+XLA vs the Pallas implicit-GEMM kernel). EVERY printed line is stamped with
+the resolved ``platform`` and active ``policy_key`` so CPU-fallback or
+wedge-skip artifacts are distinguishable from real TPU measurements:
 
 * ``mfu`` — *model*-flops utilization in THE one convention used across
   BASELINE.md / PERF.md / this file (reconciled round 4): an analytic
@@ -42,6 +46,31 @@ import time
 import numpy as np
 
 STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+
+
+def _stamp(rec):
+    """Stamp the resolved platform and the active lever set into a JSON
+    record, in place. Every line bench.py prints carries these, so a
+    wedge-skipped or CPU-fallback artifact is distinguishable from a real
+    TPU measurement when BENCH_r*.json is read after the fact (and the
+    lever configuration each number was taken under is self-describing)."""
+    if "platform" not in rec:
+        try:
+            import jax
+            rec["platform"] = jax.devices()[0].platform
+        except Exception:  # noqa: BLE001 — a dead PJRT client still stamps
+            rec["platform"] = "unknown"
+    if "policy_key" not in rec:
+        try:
+            from mxtpu.ops.registry import policy_key
+            rec["policy_key"] = list(policy_key())
+        except Exception:  # noqa: BLE001
+            rec["policy_key"] = None
+    return rec
+
+
+def _emit(rec):
+    print(json.dumps(_stamp(rec)), flush=True)
 
 
 def _peak_flops():
@@ -143,26 +172,46 @@ def bench_resnet50():
     if s2d_flag in ("1", "2") and layout != "NHWC":
         raise RuntimeError("BENCH_S2D_STEM requires BENCH_LAYOUT=NHWC "
                            "(refusing to report a plain-stem number as s2d)")
-    if s2d_flag in ("1", "2"):
-        # MLPerf space-to-depth stem, exactly equivalent: mode 1 = 4x4
-        # conv on 12 channels; mode 2 = double s2d -> MXU-shaped 3x3 conv
-        # on 48->256 channels + depth-to-space (contrib/s2d_stem.py)
+    if layout == "NHWC":
+        # MLPerf space-to-depth stem, exactly equivalent, as a POLICY
+        # lever (round 7): the wrap is unconditional and mode None defers
+        # the variant choice to MXTPU_S2D_STEM at trace time (0 = the
+        # plain stem, so the wrap is free). The env rides
+        # registry.policy_key, so it recompiles per run and composes with
+        # the Pallas conv gate in one jit cache key. mode 1 = 4x4 conv on
+        # 12 channels; mode 2 = double s2d -> MXU-shaped 3x3 conv on
+        # 48->256 channels + depth-to-space (contrib/s2d_stem.py)
         from mxtpu.contrib import s2d_stem
-        s2d_stem.apply_to_resnet(net, mode=int(s2d_flag))
-    if dtype != "float32":
-        net.cast(dtype)
-        x = x.astype(dtype)
-    y = mx.nd.array(np.random.randint(0, 1000, size=(batch,)),
-                    dtype="float32")
+        s2d_stem.apply_to_resnet(net)
+    saved_s2d = os.environ.get("MXTPU_S2D_STEM")
+    os.environ["MXTPU_S2D_STEM"] = s2d_flag if layout == "NHWC" else "0"
+    try:
+        if dtype != "float32":
+            net.cast(dtype)
+            x = x.astype(dtype)
+        y = mx.nd.array(np.random.randint(0, 1000, size=(batch,)),
+                        dtype="float32")
 
-    loss = gluon.loss.SoftmaxCrossEntropyLoss()
-    step = ShardedTrainStep(net, loss, data_parallel_mesh(), optimizer="sgd",
-                            optimizer_params={"learning_rate": 0.01,
-                                              "momentum": 0.9})
-    # ResNet-50 @224: 4.089 GMAC/img forward = 8.18 GFLOP (MAC=2), train =
-    # 3x fwd = 24.5 GFLOP/img (the module-docstring north-star arithmetic)
-    rate, mfu, hfu = _run(step, (x, y), batch,
-                          model_flops_per_item=3 * 2 * 4.089e9)
+        loss = gluon.loss.SoftmaxCrossEntropyLoss()
+        step = ShardedTrainStep(net, loss, data_parallel_mesh(),
+                                optimizer="sgd",
+                                optimizer_params={"learning_rate": 0.01,
+                                                  "momentum": 0.9})
+        # ResNet-50 @224: 4.089 GMAC/img forward = 8.18 GFLOP (MAC=2),
+        # train = 3x fwd = 24.5 GFLOP/img (the module-docstring
+        # north-star arithmetic)
+        rate, mfu, hfu = _run(step, (x, y), batch,
+                              model_flops_per_item=3 * 2 * 4.089e9)
+        # capture the lever set the measurement actually ran under — the
+        # env restore below would otherwise let _stamp record the ambient
+        # (s2d-less) policy onto this line
+        from mxtpu.ops.registry import policy_key
+        active_policy = list(policy_key())
+    finally:
+        if saved_s2d is None:
+            os.environ.pop("MXTPU_S2D_STEM", None)
+        else:
+            os.environ["MXTPU_S2D_STEM"] = saved_s2d
     rec = {
         "metric": "resnet50_train_throughput_b%d_%s_%s"
                   % (batch, dtype, layout.lower()),
@@ -171,6 +220,7 @@ def bench_resnet50():
         "vs_baseline": round(rate / baseline, 3),
         "mfu": round(mfu, 4) if mfu else None,
         "hfu": round(hfu, 4) if hfu else None,
+        "policy_key": active_policy,
     }
     if mfu:
         # the gap statement PERF.md tracks: fraction of the chip's MEASURED
@@ -392,6 +442,113 @@ def bench_optimizer_step():
     }
 
 
+def _perf_common():
+    """The shared scan-fused timing harness (tools/perf_common.py —
+    ONE copy of the PERF.md methodology: K steps per dispatch,
+    host-fetch sync). Imported lazily so bench stays runnable from any
+    cwd."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import perf_common
+    return perf_common
+
+
+def bench_conv_class(emit=None):
+    """Per-conv-class TFLOP/s, XLA vs the Pallas implicit-GEMM kernel
+    (mxtpu/ops/pallas/conv.py) — the kernel-level numbers that previously
+    lived only in tools logs (tools/perf_session.py phase_convs), now a
+    bench config so the driver artifact records them. One JSON line per
+    (class, impl); classes are the PERF.md sinks: the 7x7s2 stem, a 1x1
+    bottleneck pointwise, a stage-2 3x3 spatial, plus an MXU-filled 1x1
+    control the gate must LEAVE on XLA. Scan-fused K-step timing with
+    host-fetch sync (methodology section). Returns a summary record in
+    the standard schema."""
+    import jax
+    import jax.numpy as jnp
+    from mxtpu.ops.conv_acc import conv_fast
+    from mxtpu.ops.pallas import conv as pconv
+
+    pcommon = _perf_common()
+    if emit is None:
+        emit = _emit
+    batch = int(os.environ.get("BENCH_CONV_BATCH",
+                               os.environ.get("BENCH_BATCH", "128")))
+    k_steps = int(os.environ.get("BENCH_CONV_STEPS", "16"))
+    dtype = (jnp.float32 if os.environ.get("BENCH_DTYPE") == "float32"
+             else jnp.bfloat16)
+    dn = ("NHWC", "HWIO", "NHWC")
+    # (label, HW_in, Cin, Cout, k, stride); the last is the XLA control —
+    # K=1024 and C_out=256 both fill the MXU, so Pallas must decline it
+    classes = [
+        ("stem_7x7s2", 224, 3, 64, 7, 2),
+        ("pw_1x1_256to64", 56, 256, 64, 1, 1),
+        ("spatial_3x3_64", 56, 64, 64, 3, 1),
+        ("pw_1x1_1024to256_xla_control", 14, 1024, 256, 1, 1),
+    ]
+    lines = []
+    saved = os.environ.get("MXTPU_PALLAS_CONV")
+    try:
+        for label, hw, cin, cout, k, s in classes:
+            x = jax.random.normal(jax.random.PRNGKey(0),
+                                  (batch, hw, hw, cin), dtype)
+            w = jax.random.normal(jax.random.PRNGKey(1),
+                                  (k, k, cin, cout), dtype) * 0.1
+            pad = [(k // 2, k // 2), (k // 2, k // 2)]
+            hw_out = (hw + 2 * (k // 2) - k) // s + 1
+            fl = 2 * batch * hw_out * hw_out * cin * cout * k * k
+            by_impl = {}
+            for impl in ("xla", "pallas"):
+                os.environ["MXTPU_PALLAS_CONV"] = \
+                    "1" if impl == "pallas" else "0"
+                pconv.reset_dispatch_stats()
+
+                f = pcommon.reinject(
+                    lambda xd, w=w, s=s, pad=pad: conv_fast(
+                        xd, w, (s, s), pad, (1, 1), (1, 1), dn, 1))
+                try:
+                    dt = pcommon.timed_scan(f, x, K=k_steps)
+                except Exception as e:  # noqa: BLE001 — keep the sweep
+                    emit({"metric": "conv_class_%s" % label, "impl": impl,
+                          "error": str(e)})
+                    continue
+                if pconv.DISPATCH_STATS["pallas"]:
+                    used = "pallas"
+                elif impl == "pallas":
+                    reasons = pconv.DISPATCH_STATS["fallback_reasons"]
+                    used = "xla_fallback(%s)" % "; ".join(sorted(reasons)) \
+                        if reasons else "xla_gate_declined"
+                else:
+                    used = "xla"
+                rec = {"metric": "conv_class_%s" % label, "impl": impl,
+                       "impl_used": used, "ms": round(dt * 1e3, 3),
+                       # 4 decimals: a CPU-fallback line must not round to
+                       # a flat 0.00 (the chip numbers are 1-100 TFLOP/s)
+                       "value": round(fl / dt / 1e12, 4),
+                       "unit": "TFLOP/s"}
+                by_impl[impl] = dt
+                if impl == "pallas" and "xla" in by_impl:
+                    rec["speedup_vs_xla"] = round(by_impl["xla"] / dt, 3)
+                emit(rec)
+                lines.append(rec)
+    finally:
+        if saved is None:
+            os.environ.pop("MXTPU_PALLAS_CONV", None)
+        else:
+            os.environ["MXTPU_PALLAS_CONV"] = saved
+    pallas_lines = [r for r in lines if r.get("impl") == "pallas"
+                    and r.get("impl_used") == "pallas"]
+    return {
+        "metric": "conv_class",
+        "value": len(lines),
+        "unit": "json_lines",
+        "vs_baseline": None,
+        "mfu": None,
+        "hfu": None,
+        "pallas_kernel_lines": len(pallas_lines),
+        "classes": [r["metric"] for r in lines],
+    }
+
+
 def bench_sparse_linear():
     """BASELINE config 5: sparse linear classification samples/sec
     (examples/sparse/linear_classification.py — LibSVM CSR batches through
@@ -432,6 +589,7 @@ def bench_sparse_linear():
 CONFIGS = {
     "eager": bench_eager,
     "optimizer_step": bench_optimizer_step,
+    "conv_class": bench_conv_class,
     "sparse_linear": bench_sparse_linear,
     "lstm_ptb": bench_lstm_ptb,
     "bert_base": bench_bert_base,
@@ -509,13 +667,13 @@ def main():
     timeout_s = int(os.environ.get("BENCH_CONFIG_TIMEOUT", "900"))
     if os.environ.get("BENCH_PREFLIGHT", "1") != "0":
         pre = _preflight()
-        print(json.dumps(pre), flush=True)
+        _emit(pre)
         if not pre["ok"]:
             names = list(CONFIGS) if name == "all" else [name]
             for cname in names:
-                print(json.dumps({"metric": cname, "error":
-                                  "skipped: chip/tunnel wedged (see "
-                                  "preflight record)"}), flush=True)
+                _emit({"metric": cname, "error":
+                       "skipped: chip/tunnel wedged (see "
+                       "preflight record)"})
             sys.exit(1)
     if name == "all":
         # per-config isolation: a failing config must not eat the headline
@@ -532,7 +690,7 @@ def main():
                     rec = {"metric": cname, "error":
                            "skipped: earlier config timed out "
                            "(chip/tunnel unresponsive)"}
-                    print(json.dumps(rec), flush=True)
+                    _emit(rec)
                     continue
                 if base_profile:
                     # one trace file per config — a shared file would be
@@ -542,7 +700,7 @@ def main():
                                                                ext or ".json")
                 rec = _run_config(cname, fn, timeout_s)
                 hung = hung or rec.get("timed_out", False)
-                print(json.dumps(rec), flush=True)
+                _emit(rec)
         finally:
             if base_profile:
                 os.environ["BENCH_PROFILE"] = base_profile
@@ -551,7 +709,7 @@ def main():
             os._exit(code)  # abandoned daemon threads would block exit
         sys.exit(code)
     rec = _run_config(name, CONFIGS[name], timeout_s)
-    print(json.dumps(rec), flush=True)
+    _emit(rec)
     if rec.get("timed_out"):
         os._exit(1)  # the abandoned daemon thread would block exit
     if "error" in rec:

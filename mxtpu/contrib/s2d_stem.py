@@ -21,13 +21,32 @@ channel of (py, px, c) is (py*2 + px)*3 + c.
 """
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
 
 from ..base import MXNetError
 
-__all__ = ["space_to_depth_nhwc", "embed_stem_weight", "apply_to_resnet"]
+__all__ = ["space_to_depth_nhwc", "embed_stem_weight", "apply_to_resnet",
+           "stem_mode"]
 
 _B = 2  # block size of the transform (fixed by the stride-2 stem)
+
+
+def stem_mode():
+    """The first-class stem lever, promoted from bench-env-only (round 7):
+    ``MXTPU_S2D_STEM`` = 0 (plain 7x7s2 stem), 1 (single s2d), 2 (double
+    s2d, the staged MXU-shaped variant). Read at TRACE time by a
+    policy-mode ``_StemFn`` (mode=None), and part of
+    ``registry.policy_key`` — so a per-run flip recompiles every jit
+    cache (CachedOp, executors) instead of silently reusing the other
+    stem's executable, and it composes with the MXTPU_PALLAS_CONV gate in
+    one cache key. bench.py maps its BENCH_S2D_STEM knob onto this env."""
+    v = os.environ.get("MXTPU_S2D_STEM", "0")
+    if v not in ("0", "1", "2"):
+        raise MXNetError("MXTPU_S2D_STEM=%r: valid values are 0 (plain "
+                         "stem), 1 (s2d), 2 (double-s2d)" % (v,))
+    return int(v)
 
 
 def space_to_depth_nhwc(x):
@@ -116,18 +135,33 @@ def embed_stem_weight4(w):
 class _StemFn:
     """Callable forward for the wrapped stem (kept tiny and pickle-free).
     mode 1: single 2x2 s2d + 4x4 conv; mode 2: 4x4 s2d + 3x3 conv +
-    2x2 depth-to-space (see embed_stem_weight4)."""
+    2x2 depth-to-space (see embed_stem_weight4); mode 0: the plain 7x7s2
+    conv (byte-identical semantics to the unwrapped stem, so a wrapped
+    net is a no-op at mode 0); mode None: POLICY mode — the mode is read
+    from MXTPU_S2D_STEM at trace time (stem_mode), making the stem a
+    per-run lever that recompiles through registry.policy_key."""
 
     def __init__(self, weight_param, bias_param, mode=1):
-        if mode not in (1, 2):  # strings/typos must not silently run mode 1
-            raise MXNetError("s2d stem mode must be 1 or 2, got %r" % (mode,))
+        # strings/typos must not silently run mode 1; None = policy mode
+        if mode not in (None, 0, 1, 2):
+            raise MXNetError("s2d stem mode must be None, 0, 1 or 2, "
+                             "got %r" % (mode,))
         self._w = weight_param
         self._b = bias_param
         self._mode = mode
 
     def __call__(self, x):
         from ..ops.conv_acc import conv_fast
-        if self._mode == 2:
+        mode = self._mode if self._mode is not None else stem_mode()
+        if mode == 0:
+            # the untransformed stem (the conv the wrap replaced) — bias
+            # rides conv_fast so the Pallas gate can fuse it
+            return conv_fast(x, self._w, strides=(2, 2),
+                             padding=[(3, 3), (3, 3)],
+                             lhs_dilation=(1, 1), rhs_dilation=(1, 1),
+                             dims=("NHWC", "HWIO", "NHWC"), groups=1,
+                             bias=self._b)
+        if mode == 2:
             s = space_to_depth4_nhwc(x)
             w2 = embed_stem_weight4(self._w)
             out = conv_fast(s, w2, strides=(1, 1),
@@ -146,15 +180,19 @@ class _StemFn:
         return out
 
 
-def apply_to_resnet(net, mode=1):
+def apply_to_resnet(net, mode=None):
     """Swap the stem Conv2D of an NHWC zoo resnet for the s2d-equivalent
     path, in place. The conv's Parameters are untouched — only its forward
     is re-routed — so checkpoints and trainers keep working. Returns net.
+    mode None (default) = POLICY mode: the variant is picked per trace
+    from MXTPU_S2D_STEM (0 = plain stem, so wrapping is free), letting
+    one wrapped net A/B all three stems through policy_key recompiles;
     mode 1 = single s2d (112^2 x 12 conv4x4); mode 2 = double s2d
     (56^2 x 48 conv3x3 -> 256ch -> depth-to-space; MXU-shaped, see
     embed_stem_weight4)."""
-    if mode not in (1, 2):
-        raise MXNetError("s2d stem mode must be 1 or 2, got %r" % (mode,))
+    if mode not in (None, 0, 1, 2):
+        raise MXNetError("s2d stem mode must be None, 0, 1 or 2, got %r"
+                         % (mode,))
     feats = list(net.features._children.values())
     conv = feats[0]
     if type(conv).__name__ != "Conv2D":

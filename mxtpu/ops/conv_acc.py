@@ -160,21 +160,60 @@ def conv_im2col(x, w, padding):
 
 
 def conv_fast(x, w, strides, padding, lhs_dilation, rhs_dilation, dims,
-              groups):
-    """Dispatch: the f32-accumulate custom-vjp path for all-low-precision
-    operands (when the private transpose helpers imported), else plain
-    conv_general_dilated under the package precision policy."""
+              groups, bias=None):
+    """Dispatch, highest-priority first: the Pallas implicit-GEMM kernel
+    for MXU-underfilled NHWC shapes (MXTPU_PALLAS_CONV — stem/1x1/small-C
+    convs, pallas/conv.py; the per-channel ``bias`` rides its fused
+    epilogue), then the staged im2col lowering, then the f32-accumulate
+    custom-vjp path for all-low-precision operands (when the private
+    transpose helpers imported), else plain conv_general_dilated under
+    the package precision policy. ``bias`` (a [C_out] vector) is applied
+    on every path so callers get one set of semantics."""
+    if _pallas_enabled():
+        from .pallas.conv import fused_conv, pallas_applicable
+        ok, _reason = pallas_applicable(x, w, strides, padding,
+                                        lhs_dilation, rhs_dilation, dims,
+                                        groups)
+        if ok:
+            # a bias whose dtype would promote the conv output (f32 bias
+            # on bf16 operands) must stay an external add — the fused
+            # epilogue keeps the conv dtype, and flipping the lever must
+            # never change a program's output dtype
+            out_dt = jnp.promote_types(x.dtype, w.dtype)
+            fuse_bias = (bias is not None
+                         and jnp.promote_types(out_dt, bias.dtype) == out_dt)
+            out = fused_conv(x, w, strides=tuple(strides),
+                             padding=tuple(map(tuple, padding)),
+                             bias=bias if fuse_bias else None)
+            return out if fuse_bias else _with_bias(out, bias, dims)
     if _im2col_enabled() and _im2col_applicable(
             x, w, strides, padding, lhs_dilation, rhs_dilation, dims,
             groups):
-        return conv_im2col(x, w, padding)
+        return _with_bias(conv_im2col(x, w, padding), bias, dims)
     if (HAVE_ACC_VJP and _enabled() and x.dtype in _LOW and w.dtype in _LOW):
-        return conv_acc(x, w, tuple(strides), tuple(map(tuple, padding)),
-                        tuple(lhs_dilation), tuple(rhs_dilation), dims,
-                        int(groups))
+        return _with_bias(
+            conv_acc(x, w, tuple(strides), tuple(map(tuple, padding)),
+                     tuple(lhs_dilation), tuple(rhs_dilation), dims,
+                     int(groups)), bias, dims)
     from .precision_util import mxu_precision
-    return lax.conv_general_dilated(
+    return _with_bias(lax.conv_general_dilated(
         x, w, window_strides=strides, padding=padding,
         lhs_dilation=lhs_dilation, rhs_dilation=rhs_dilation,
         dimension_numbers=dims, feature_group_count=groups,
-        precision=mxu_precision(x, w))
+        precision=mxu_precision(x, w)), bias, dims)
+
+
+def _with_bias(out, bias, dims):
+    if bias is None:
+        return out
+    if dims[2][-1] == "C":          # channels-last: trailing broadcast
+        return out + bias
+    return out + jnp.reshape(bias, (1, -1) + (1,) * (out.ndim - 2))
+
+
+def _pallas_enabled():
+    """MXTPU_PALLAS_CONV=1 routes MXU-underfilled shapes through the hand
+    kernel (read site: pallas/conv.py). STAGED off pending the on-chip
+    resnet_pallas battery phase; in registry.policy_key."""
+    import os
+    return os.environ.get("MXTPU_PALLAS_CONV", "0") == "1"

@@ -90,15 +90,18 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     grouped/depthwise via feature_group_count (the reference needed a dedicated
     TF-derived depthwise kernel, depthwise_convolution_tf.cuh — here it's the same
     HLO and XLA picks the kernel). bf16 operands take the f32-accumulate
-    custom-vjp fast path (conv_acc.py)."""
+    custom-vjp fast path (conv_acc.py); MXU-underfilled NHWC shapes (the
+    stem/1x1/small-C classes PERF.md attributes ~78%% of the ResNet step
+    to) route to the Pallas implicit-GEMM kernel when MXTPU_PALLAS_CONV
+    is on (pallas/conv.py), with the bias riding its fused epilogue —
+    the bias is handed to conv_fast so every dispatch path owns it."""
     ndim = data.ndim - 2
     kernel = _pair(kernel, ndim)
     stride = _pair(stride, ndim)
     dilate = _pair(dilate, ndim)
     pad = _pair(pad, ndim) if pad is not None else (0,) * ndim
     dims = _conv_dims(ndim, layout)
-    channels_last = dims[0][-1] == "C"
-    out = conv_fast(
+    return conv_fast(
         data, weight,
         strides=stride,
         padding=[(p, p) for p in pad],
@@ -106,13 +109,8 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         rhs_dilation=dilate,
         dims=dims,
         groups=num_group,
+        bias=bias if (bias is not None and not no_bias) else None,
     )
-    if bias is not None and not no_bias:
-        if channels_last:
-            out = out + bias
-        else:
-            out = out + jnp.reshape(bias, (1, -1) + (1,) * ndim)
-    return out
 
 
 @register("Deconvolution", aliases=("deconvolution",))
@@ -142,7 +140,7 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None
     for i in range(ndim):
         k = (kernel[i] - 1) * dilate[i]
         padding.append((k - pad[i], k - pad[i] + adj[i]))
-    out = conv_fast(
+    return conv_fast(
         data, w,
         strides=(1,) * ndim,
         padding=padding,
@@ -150,13 +148,8 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None
         rhs_dilation=dilate,
         dims=dims,
         groups=num_group,
+        bias=bias if (bias is not None and not no_bias) else None,
     )
-    if bias is not None and not no_bias:
-        if channels_last:
-            out = out + bias
-        else:
-            out = out + jnp.reshape(bias, (1, -1) + (1,) * ndim)
-    return out
 
 
 # ------------------------------------------------------------------ pooling

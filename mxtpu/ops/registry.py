@@ -102,7 +102,12 @@ def policy_key():
             os.environ.get("MXTPU_RING_FLASH", "0"),
             os.environ.get("MXTPU_FLASH_PAD_D", "1"),
             os.environ.get("MXTPU_CONV_IM2COL", "0"),
-            os.environ.get("MXTPU_RNN_HOIST", "1"))
+            os.environ.get("MXTPU_RNN_HOIST", "1"),
+            # conv_acc.py:_pallas_enabled / pallas/conv.py:_interpret
+            os.environ.get("MXTPU_PALLAS_CONV", "0"),
+            os.environ.get("MXTPU_PALLAS_CONV_INTERPRET", "0"),
+            # contrib/s2d_stem.py:stem_mode (policy-mode _StemFn)
+            os.environ.get("MXTPU_S2D_STEM", "0"))
 
 
 # canonical op name -> fn(attrs) -> int: STATIC output count for ops whose

@@ -40,6 +40,10 @@ def pytest_configure(config):
         "markers",
         "multidevice: needs the 8-device virtual CPU mesh or spawns a "
         "multi-process world; skipped on the single-chip TPU tier")
+    config.addinivalue_line(
+        "markers",
+        "slow: exceeds the tier-1 wall-clock budget (interpret-mode "
+        "Pallas kernels at real shapes etc.); tier-1 runs -m 'not slow'")
 
 
 def pytest_collection_modifyitems(config, items):

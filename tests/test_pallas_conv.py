@@ -1,0 +1,328 @@
+"""Pallas fused implicit-GEMM conv (mxtpu/ops/pallas/conv.py).
+
+Tier-1 runs the ACTUAL kernel through the Pallas interpreter
+(MXTPU_PALLAS_CONV_INTERPRET=1) on CPU — fwd, input-grad and weight-grad
+are pinned against ``lax.conv_general_dilated`` + jax autodiff, f32 at
+exact tolerance and bf16 at ulp tolerance, across odd spatial sizes and
+stride 2. The shape gate (route MXU-underfilled convs, leave filled ones
+on XLA) is asserted through ``pallas_applicable`` reasons and the
+``DISPATCH_STATS`` counters; the 0/1 lever A/B is pinned through
+``registry.policy_key`` and a hybridized CachedOp recompile."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+import pytest
+
+from mxtpu.ops.conv_acc import conv_fast
+from mxtpu.ops.pallas import conv as pc
+
+DN = ("NHWC", "HWIO", "NHWC")
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for var in ("MXTPU_PALLAS_CONV", "MXTPU_PALLAS_CONV_INTERPRET",
+                "MXTPU_CONV_ACC", "MXTPU_CONV_IM2COL"):
+        monkeypatch.delenv(var, raising=False)
+    pc.reset_dispatch_stats()
+
+
+@pytest.fixture
+def interp(monkeypatch):
+    """Run the real kernel via the Pallas interpreter on CPU."""
+    monkeypatch.setenv("MXTPU_PALLAS_CONV_INTERPRET", "1")
+
+
+def _ref(x, w, s, pad):
+    return lax.conv_general_dilated(x, w, (s, s), pad,
+                                    dimension_numbers=DN)
+
+
+# shapes: stem-like 7x7s2 odd-H, 3x3s1 odd, 1x1 (pure GEMM), strided 1x1
+# (downsample shortcut), strided 3x3 — every class the gate routes
+SHAPES = [
+    (15, 3, 8, 7, 2, 3),
+    (9, 4, 8, 3, 1, 1),
+    (8, 16, 8, 1, 1, 0),
+    (9, 8, 8, 1, 2, 0),
+    (11, 4, 8, 3, 2, 1),
+]
+
+
+@pytest.mark.parametrize("h,cin,cout,k,s,p", SHAPES)
+def test_kernel_fwd_and_grads_match_xla_f32(h, cin, cout, k, s, p, interp):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, h, h, cin), jnp.float32)
+    w = jnp.asarray(rng.randn(k, k, cin, cout) * 0.1, jnp.float32)
+    pad = ((p, p), (p, p))
+    out = pc.fused_conv(x, w, (s, s), pad)
+    assert pc.DISPATCH_STATS["pallas"] >= 1  # the kernel, not the fallback
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(x, w, s, pad)),
+                               rtol=1e-5, atol=1e-5)
+    gf = jax.grad(lambda x_, w_: jnp.sum(
+        pc.fused_conv(x_, w_, (s, s), pad) ** 2), argnums=(0, 1))(x, w)
+    gp = jax.grad(lambda x_, w_: jnp.sum(
+        _ref(x_, w_, s, pad) ** 2), argnums=(0, 1))(x, w)
+    for a, b in zip(gf, gp):  # input grad, then weight grad
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("h,cin,cout,k,s,p", [SHAPES[0], SHAPES[1],
+                                              SHAPES[3]])
+def test_kernel_fwd_and_grads_match_xla_bf16(h, cin, cout, k, s, p, interp):
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, h, h, cin), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(k, k, cin, cout) * 0.1, jnp.bfloat16)
+    pad = ((p, p), (p, p))
+    out = pc.fused_conv(x, w, (s, s), pad)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(_ref(x, w, s, pad), np.float32),
+                               rtol=2e-2, atol=2e-2)
+    gf = jax.grad(lambda x_, w_: jnp.sum(pc.fused_conv(
+        x_, w_, (s, s), pad).astype(jnp.float32) ** 2), argnums=(0, 1))(x, w)
+    gp = jax.grad(lambda x_, w_: jnp.sum(
+        _ref(x_, w_, s, pad).astype(jnp.float32) ** 2), argnums=(0, 1))(x, w)
+    for a, b in zip(gf, gp):
+        assert a.dtype == b.dtype
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=5e-2, atol=5e-2)
+
+
+def test_fused_epilogue_matches_composition(interp):
+    """conv + scale + bias + residual + relu in ONE kernel vs the op-by-op
+    composition, including gradients for every differentiable input."""
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(2, 9, 9, 4), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, 4, 8) * 0.1, jnp.float32)
+    sc = jnp.asarray(rng.randn(8), jnp.float32)
+    bi = jnp.asarray(rng.randn(8), jnp.float32)
+    res = jnp.asarray(rng.randn(2, 9, 9, 8), jnp.float32)
+    pad = ((1, 1), (1, 1))
+
+    def fused(x, w, sc, bi, res):
+        return pc.fused_conv(x, w, (1, 1), pad, scale=sc, bias=bi,
+                             residual=res, relu=True)
+
+    def ref(x, w, sc, bi, res):
+        return jnp.maximum(_ref(x, w, 1, pad) * sc + bi + res, 0.0)
+
+    np.testing.assert_allclose(np.asarray(fused(x, w, sc, bi, res)),
+                               np.asarray(ref(x, w, sc, bi, res)),
+                               rtol=1e-5, atol=1e-5)
+    g1 = jax.grad(lambda *a: jnp.sum(fused(*a) ** 2),
+                  argnums=(0, 1, 2, 3, 4))(x, w, sc, bi, res)
+    g2 = jax.grad(lambda *a: jnp.sum(ref(*a) ** 2),
+                  argnums=(0, 1, 2, 3, 4))(x, w, sc, bi, res)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_shape_gate_routes_underfilled_and_declines_filled():
+    """The PERF.md gate, executable: stem (C_out=64), 1x1 pointwise
+    (K=64 or C_out=64) and stage-2 small-C spatials route; a conv with
+    BOTH im2col K and C_out at/above the 128 lanes stays on XLA."""
+    def ok(shape_x, shape_w, strides=(1, 1)):
+        x = jnp.zeros(shape_x, jnp.bfloat16)
+        w = jnp.zeros(shape_w, jnp.bfloat16)
+        return pc.pallas_applicable(x, w, strides, ((0, 0), (0, 0)),
+                                    (1, 1), (1, 1), DN, 1)
+
+    assert ok((1, 224, 224, 3), (7, 7, 3, 64), (2, 2))[0]     # stem
+    assert ok((1, 56, 56, 256), (1, 1, 256, 64))[0]           # 1x1 down
+    assert ok((1, 56, 56, 64), (1, 1, 64, 256))[0]            # 1x1 up, K=64
+    assert ok((1, 56, 56, 64), (3, 3, 64, 64))[0]             # stage-2 3x3
+    routed, reason = ok((1, 14, 14, 1024), (1, 1, 1024, 256))
+    assert not routed and "MXU-filled" in reason              # stays on XLA
+    routed, reason = ok((1, 7, 7, 512), (3, 3, 512, 512))
+    assert not routed and "MXU-filled" in reason
+
+
+def test_gate_rejects_out_of_domain_convs():
+    x = jnp.zeros((1, 8, 8, 4), jnp.float32)
+    w = jnp.zeros((3, 3, 4, 8), jnp.float32)
+    z = ((0, 0), (0, 0))
+    assert not pc.pallas_applicable(x, w, (1, 1), z, (1, 1), (1, 1),
+                                    ("NCHW", "OIHW", "NCHW"), 1)[0]
+    assert not pc.pallas_applicable(x, jnp.zeros((3, 3, 2, 8)), (1, 1), z,
+                                    (1, 1), (1, 1), DN, 2)[0]   # grouped
+    assert not pc.pallas_applicable(x, w, (1, 1), z, (2, 2), (1, 1),
+                                    DN, 1)[0]                   # deconv
+    assert not pc.pallas_applicable(x, w, (1, 1), z, (1, 1), (2, 2),
+                                    DN, 1)[0]                   # dilated
+    assert not pc.pallas_applicable(x.astype(jnp.float64) if False else
+                                    jnp.zeros((1, 8, 8, 4), jnp.int32),
+                                    w, (1, 1), z, (1, 1), (1, 1), DN, 1)[0]
+
+
+def test_dispatch_counters_from_conv_fast(monkeypatch, interp):
+    """conv_fast must actually hand the gated shapes to the kernel (and
+    leave MXU-filled shapes on XLA) when the lever is on — counted, not
+    assumed."""
+    monkeypatch.setenv("MXTPU_PALLAS_CONV", "1")
+    rng = np.random.RandomState(3)
+    pad1 = [(1, 1), (1, 1)]
+    x = jnp.asarray(rng.randn(1, 9, 9, 4), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, 4, 8) * 0.1, jnp.float32)
+    ref = lax.conv_general_dilated(x, w, (1, 1), pad1,
+                                   dimension_numbers=DN)
+    got = conv_fast(x, w, (1, 1), pad1, (1, 1), (1, 1), DN, 1)
+    assert pc.DISPATCH_STATS["pallas"] == 1
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # MXU-filled control: K = 9*128 and C_out = 128 both fill the lanes
+    pc.reset_dispatch_stats()
+    xb = jnp.zeros((1, 6, 6, 128), jnp.float32)
+    wb = jnp.zeros((3, 3, 128, 128), jnp.float32)
+    conv_fast(xb, wb, (1, 1), pad1, (1, 1), (1, 1), DN, 1)
+    assert pc.DISPATCH_STATS["pallas"] == 0  # gate declined before launch
+
+
+def test_resolve_fallback_reasons(monkeypatch):
+    """Inside the gate, _resolve still declines: off-TPU without the
+    interpreter (quiet XLA fallback, counted), and a per-block VMEM plan
+    over budget even on 'tpu'."""
+    cfg = pc._Cfg((1, 1), ((1, 1), (1, 1)), False, False, False, False)
+    x = jnp.zeros((1, 9, 9, 4), jnp.float32)
+    w = jnp.zeros((3, 3, 4, 8), jnp.float32)
+    geom, reason = pc._resolve(x, w, cfg)
+    assert geom is None and "platform" in reason
+    # the fallback forward still computes (and counts) off-platform
+    pc.reset_dispatch_stats()
+    out = pc.fused_conv(jnp.ones((1, 5, 5, 4)), w, (1, 1), ((1, 1), (1, 1)))
+    assert out.shape == (1, 5, 5, 8)
+    assert pc.DISPATCH_STATS["xla"] == 1
+    assert any("platform" in r
+               for r in pc.DISPATCH_STATS["fallback_reasons"])
+    # VMEM budget: a single 1x1 conv row block of width 128k lanes
+    monkeypatch.setattr(pc, "_platform", lambda: "tpu")
+    xh = jnp.zeros((1, 1, 200000, 64), jnp.bfloat16)
+    wh = jnp.zeros((1, 1, 64, 64), jnp.bfloat16)
+    cfg1 = pc._Cfg((1, 1), ((0, 0), (0, 0)), False, False, False, False)
+    geom, reason = pc._resolve(xh, wh, cfg1)
+    assert geom is None and "VMEM" in reason
+    # a sane shape resolves on 'tpu' without the interpreter env
+    geom, reason = pc._resolve(x, w, cfg)
+    assert geom is not None and geom["bo"] >= 1
+
+
+def test_conv_fast_bias_fusion_matches_external_add(monkeypatch, interp):
+    """conv_fast(bias=...) must equal conv + bias on every dispatch path
+    (the Convolution op now hands its bias to conv_fast)."""
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(2, 9, 9, 4), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, 4, 8) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.randn(8), jnp.float32)
+    pad1 = [(1, 1), (1, 1)]
+    ref = lax.conv_general_dilated(x, w, (1, 1), pad1,
+                                   dimension_numbers=DN) + b
+    plain = conv_fast(x, w, (1, 1), pad1, (1, 1), (1, 1), DN, 1, bias=b)
+    np.testing.assert_allclose(np.asarray(plain), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    monkeypatch.setenv("MXTPU_PALLAS_CONV", "1")
+    fused = conv_fast(x, w, (1, 1), pad1, (1, 1), (1, 1), DN, 1, bias=b)
+    assert pc.DISPATCH_STATS["pallas"] == 1   # bias rode the kernel epilogue
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_policy_key_ab_recompiles(monkeypatch):
+    """MXTPU_PALLAS_CONV=0/1 must produce distinct policy keys (so every
+    jit cache keyed on it recompiles), and a hybridized conv block must
+    trace one executable per flag value — the A/B genuinely compares two
+    programs."""
+    from mxtpu.ops.registry import policy_key
+    monkeypatch.delenv("MXTPU_PALLAS_CONV", raising=False)
+    k0 = policy_key()
+    monkeypatch.setenv("MXTPU_PALLAS_CONV", "1")
+    k1 = policy_key()
+    assert k0 != k1
+
+    import mxtpu as mx
+    from mxtpu.gluon import nn
+
+    monkeypatch.setenv("MXTPU_PALLAS_CONV_INTERPRET", "1")
+    with mx.layout("NHWC"):
+        net = nn.Conv2D(8, 3, padding=1, in_channels=4)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(5).randn(1, 7, 7, 4)
+                    .astype(np.float32))
+    net.hybridize()
+    monkeypatch.setenv("MXTPU_PALLAS_CONV", "0")
+    y0 = net(x).asnumpy()
+    n_traces = len(net._cached_op._jits)
+    pc.reset_dispatch_stats()
+    monkeypatch.setenv("MXTPU_PALLAS_CONV", "1")
+    y1 = net(x).asnumpy()
+    assert len(net._cached_op._jits) == n_traces + 1  # recompiled, not reused
+    assert pc.DISPATCH_STATS["pallas"] >= 1           # and took the kernel
+    np.testing.assert_allclose(y0, y1, rtol=1e-5, atol=1e-5)
+
+
+def test_backward_multi_block_batch_order(monkeypatch, interp):
+    """Regression: when the per-block patches budget splits the batch
+    into MULTIPLE scan blocks, dX must land on the right batch elements
+    (the scan stacks [n_blocks, bn, ...] where block i IS batch
+    [i*bn, (i+1)*bn) — an axis swap there scrambled dx across the batch
+    while every single-block test still passed)."""
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(6, 9, 9, 4), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, 4, 8) * 0.1, jnp.float32)
+    pad = ((1, 1), (1, 1))
+    # budget for EXACTLY bn=2 -> 3 scan blocks: a bn of 1 would make any
+    # block/batch axis swap a no-op reshape and hide the scramble
+    per_item = 9 * 9 * (3 * 3 * 4) * x.dtype.itemsize
+    monkeypatch.setattr(pc, "_BWD_COLS_BUDGET", 2 * per_item)
+    # per-batch-element weighting makes any batch permutation visible
+    wt = jnp.asarray(np.arange(1, 7, dtype=np.float32)[:, None, None, None])
+    gf = jax.grad(lambda x_, w_: jnp.sum(
+        wt * pc.fused_conv(x_, w_, (1, 1), pad) ** 2), argnums=(0, 1))(x, w)
+    gp = jax.grad(lambda x_, w_: jnp.sum(
+        wt * _ref(x_, w_, 1, pad) ** 2), argnums=(0, 1))(x, w)
+    for a, b in zip(gf, gp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_bias_fusion_keeps_external_add_dtype(monkeypatch, interp):
+    """An f32 bias on bf16 operands promotes the output to f32 on the XLA
+    path (`out + bias`); the lever must not change that — conv_fast keeps
+    a dtype-promoting bias OUTSIDE the fused epilogue."""
+    rng = np.random.RandomState(8)
+    x = jnp.asarray(rng.randn(1, 7, 7, 4), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(1, 1, 4, 8) * 0.1, jnp.bfloat16)
+    b = jnp.asarray(rng.randn(8), jnp.float32)
+    args = ((1, 1), [(0, 0), (0, 0)], (1, 1), (1, 1), DN, 1)
+    off = conv_fast(x, w, *args, bias=b)
+    monkeypatch.setenv("MXTPU_PALLAS_CONV", "1")
+    on = conv_fast(x, w, *args, bias=b)
+    assert on.dtype == off.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(on, np.float32),
+                               np.asarray(off, np.float32),
+                               rtol=2e-2, atol=2e-2)
+    # same-dtype bias still rides the fused epilogue
+    pc.reset_dispatch_stats()
+    on16 = conv_fast(x, w, *args, bias=b.astype(jnp.bfloat16))
+    assert on16.dtype == jnp.bfloat16
+    assert pc.DISPATCH_STATS["pallas"] >= 1
+
+
+@pytest.mark.slow
+def test_interpret_kernel_on_real_stem_shape(interp):
+    """The actual ImageNet stem geometry (224^2, 7x7s2 pad3, 3->64) at
+    batch 1 through the interpreter — the full-size block/halo plumbing,
+    beyond the tier-1-sized shapes above."""
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(1, 224, 224, 3), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(7, 7, 3, 64) * 0.1, jnp.bfloat16)
+    pad = ((3, 3), (3, 3))
+    out = pc.fused_conv(x, w, (2, 2), pad)
+    assert out.shape == (1, 112, 112, 64)
+    assert pc.DISPATCH_STATS["pallas"] == 1
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(_ref(x, w, 2, pad), np.float32),
+                               rtol=3e-2, atol=3e-2)

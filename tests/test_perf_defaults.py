@@ -14,24 +14,32 @@ def _clean_env(monkeypatch):
     for var in ("MXTPU_CONV_ACC", "MXTPU_BN_ONEPASS", "MXTPU_RING_FLASH",
                 "MXTPU_FLASH_PAD_D", "MXTPU_CONV_IM2COL",
                 "MXTPU_RNN_HOIST", "BENCH_S2D_STEM", "BENCH_LAYOUT",
-                "MXTPU_FUSED_OPTIMIZER"):
+                "MXTPU_FUSED_OPTIMIZER", "MXTPU_PALLAS_CONV",
+                "MXTPU_PALLAS_CONV_INTERPRET", "MXTPU_S2D_STEM"):
         monkeypatch.delenv(var, raising=False)
 
 
 def test_policy_key_defaults_are_the_measured_best():
     from mxtpu.ops.registry import policy_key
-    # (conv_acc, bn_onepass, ring_flash, flash_pad_d, im2col, rnn_hoist)
-    assert policy_key() == ("0", "1", "0", "1", "0", "1")
+    # (conv_acc, bn_onepass, ring_flash, flash_pad_d, im2col, rnn_hoist,
+    #  pallas_conv, pallas_conv_interpret, s2d_stem)
+    assert policy_key() == ("0", "1", "0", "1", "0", "1", "0", "0", "0")
 
 
 def test_read_sites_mirror_policy_key():
-    from mxtpu.ops.conv_acc import _enabled, _im2col_enabled
+    from mxtpu.contrib.s2d_stem import stem_mode
+    from mxtpu.ops.conv_acc import (_enabled, _im2col_enabled,
+                                    _pallas_enabled)
     from mxtpu.ops.nn import _bn_onepass
+    from mxtpu.ops.pallas.conv import _interpret
     from mxtpu.ops.rnn_ops import _hoist_enabled
     assert _enabled() is False          # conv_acc: measured regression
     assert _bn_onepass() is True        # measured +7.8%
     assert _im2col_enabled() is False   # staged, awaiting on-chip A/B
     assert _hoist_enabled() is True
+    assert _pallas_enabled() is False   # staged: resnet_pallas battery
+    assert _interpret() is False        # test-only interpreter path
+    assert stem_mode() == 0             # plain stem until measured
 
 
 def test_fused_optimizer_is_the_measured_default():
@@ -66,6 +74,51 @@ def test_optimizer_step_bench_emits_the_benchline_schema(monkeypatch):
     json.dumps(rec)  # one parseable JSON line
     # the measurement must restore the ambient default (fused on)
     assert os.environ.get("MXTPU_FUSED_OPTIMIZER") is None
+
+
+def test_conv_class_bench_emits_per_class_lines(monkeypatch):
+    """bench.py's conv_class config must emit one stamped JSON line per
+    (conv class, impl) — at least 3 classes, XLA vs Pallas — plus a
+    summary record in the standard schema. On the CPU tier the 'pallas'
+    impl lines must SAY they fell back (impl_used), which is exactly the
+    artifact-readability property the platform/policy stamp exists for."""
+    import json
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import bench
+    assert "conv_class" in bench.CONFIGS
+    monkeypatch.setenv("BENCH_CONV_BATCH", "1")
+    monkeypatch.setenv("BENCH_CONV_STEPS", "2")
+    lines = []
+    rec = bench.bench_conv_class(emit=lambda r: lines.append(bench._stamp(r)))
+    assert {"metric", "value", "unit", "vs_baseline", "mfu", "hfu"} <= set(rec)
+    assert rec["unit"] == "json_lines"
+    classes = {l["metric"] for l in lines}
+    assert len(classes) >= 3
+    for l in lines:
+        json.dumps(l)                      # parseable artifact lines
+        # assert on ms, not the TFLOP/s rounding — a loaded CPU host can
+        # legitimately land below the value's printable resolution
+        assert l["unit"] == "TFLOP/s" and l["ms"] > 0 and l["value"] >= 0
+        assert l["impl"] in ("xla", "pallas")
+        assert "platform" in l and "policy_key" in l   # the round-7 stamp
+        if l["impl"] == "pallas" and l["platform"] != "tpu":
+            assert l["impl_used"].startswith("xla")    # honest fallback tag
+    # the A/B must restore the ambient default (lever off)
+    assert os.environ.get("MXTPU_PALLAS_CONV") is None
+
+
+def test_bench_lines_are_stamped_with_platform_and_policy(monkeypatch):
+    """Every bench.py JSON line carries the resolved platform + active
+    lever set — wedge-skips and CPU fallbacks must be distinguishable
+    from real TPU measurements in BENCH_r*.json after the fact."""
+    import bench
+    from mxtpu.ops.registry import policy_key
+    rec = bench._stamp({"metric": "x"})
+    assert rec["platform"] in ("cpu", "tpu", "unknown")
+    assert rec["policy_key"] == list(policy_key())
+    # pre-stamped records (the preflight probe knows its platform) win
+    assert bench._stamp({"platform": "tpu"})["platform"] == "tpu"
 
 
 def test_bench_defaults_measure_the_best_config(monkeypatch):

@@ -3,6 +3,7 @@ ResNet trick): exact functional equivalence and gradient flow to the
 original 7x7 parameter."""
 import numpy as np
 import jax.numpy as jnp
+import pytest
 from jax import lax
 
 import mxtpu as mx
@@ -35,7 +36,7 @@ def test_zoo_resnet_transform_preserves_function_and_trains():
                     .uniform(-1, 1, (2, 224, 224, 3)).astype(np.float32))
     y = mx.nd.array(np.array([1.0, 2.0], np.float32))
     ref = net(x).asnumpy()
-    apply_to_resnet(net)
+    apply_to_resnet(net, mode=1)
     np.testing.assert_allclose(net(x).asnumpy(), ref, rtol=2e-4, atol=2e-4)
     # training still updates the ORIGINAL 7x7 stem weight
     w = [p for n, p in net.collect_params().items()
@@ -93,3 +94,38 @@ def test_zoo_resnet_mode2_preserves_function():
     ref = net(x).asnumpy()
     apply_to_resnet(net, mode=2)
     np.testing.assert_allclose(net(x).asnumpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_policy_mode_lever_selects_stem_per_trace(monkeypatch):
+    """The round-7 promotion: apply_to_resnet() with no mode defers to
+    MXTPU_S2D_STEM at trace time — one wrapped net serves plain / s2d /
+    double-s2d, each mode preserving the function, with the flip
+    recompiling through registry.policy_key (not reusing a stale trace)."""
+    from mxtpu.contrib.s2d_stem import stem_mode
+    from mxtpu.gluon.model_zoo import vision
+    from mxtpu.ops.registry import policy_key
+
+    monkeypatch.delenv("MXTPU_S2D_STEM", raising=False)
+    assert stem_mode() == 0                      # default: plain stem
+    keys = set()
+    for mode in ("0", "1", "2"):
+        monkeypatch.setenv("MXTPU_S2D_STEM", mode)
+        keys.add(policy_key())
+    assert len(keys) == 3                        # each mode its own cache key
+    monkeypatch.setenv("MXTPU_S2D_STEM", "bogus")
+    with pytest.raises(Exception, match="MXTPU_S2D_STEM"):
+        stem_mode()
+    monkeypatch.delenv("MXTPU_S2D_STEM", raising=False)
+
+    mx.random.seed(0)
+    with mx.layout("NHWC"):
+        net = vision.resnet18_v1(classes=10)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(2)
+                    .uniform(-1, 1, (2, 224, 224, 3)).astype(np.float32))
+    ref = net(x).asnumpy()
+    apply_to_resnet(net)                         # policy mode (mode=None)
+    for mode in ("0", "1", "2"):
+        monkeypatch.setenv("MXTPU_S2D_STEM", mode)
+        np.testing.assert_allclose(net(x).asnumpy(), ref, rtol=2e-4,
+                                   atol=2e-4, err_msg="mode %s" % mode)

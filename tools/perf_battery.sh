@@ -35,7 +35,7 @@ sleep 20
 # phase added to perf_session.py is never silently unmeasured.
 timeout "${SESSION_TIMEOUT:-3600}" stdbuf -oL -eL \
   python -u tools/perf_session.py \
-    probe resnet_s2d2 resnet_im2col resnet_s2d2_im2col resnet_best bert_pad_ab flash_pad lstm_hoist_ab \
+    probe resnet_pallas conv_class resnet_s2d2 resnet_pallas_s2d2 resnet_im2col resnet_s2d2_im2col resnet_best bert_pad_ab flash_pad lstm_hoist_ab \
     resnet_control resnet_bn_onepass resnet_all_levers stem_breakdown \
     rest \
     2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"

@@ -29,6 +29,41 @@ def build_resnet(batch=None, layout=None, dtype="bfloat16"):
     return net, x, y
 
 
+def timed_scan(step_fn, x0, K=8):
+    """THE scan-fused timing harness (PERF.md methodology): K steps fused
+    into ONE dispatch via lax.scan (one compile, one RTT), synced by
+    fetching result elements to host — ``jax.block_until_ready`` does not
+    reliably wait through the tunnel. ``step_fn: carry -> carry``; returns
+    seconds per step. The single copy behind tools/perf_session.py and
+    bench.py's conv_class config — a sync-idiom fix lands everywhere."""
+    import jax
+
+    @jax.jit
+    def run(xd):
+        c, _ = jax.lax.scan(lambda c, _: (step_fn(c), None), xd, None,
+                            length=K)
+        return c
+
+    y = run(x0)
+    np.asarray(jax.device_get(y.ravel()[:2]))  # warmup + compile
+    t0 = time.perf_counter()
+    y = run(x0)
+    np.asarray(jax.device_get(y.ravel()[:2]))
+    return (time.perf_counter() - t0) / K
+
+
+def reinject(fn):
+    """Wrap a ``carry -> output`` fn as ``carry -> carry`` for timed_scan
+    by folding a cheap summary of the output back into the carry (keeps
+    every scan step live without changing shapes)."""
+    import jax.numpy as jnp
+
+    def step(c):
+        o = fn(c)
+        return c + 0 * jnp.mean(o.astype(jnp.float32)).astype(c.dtype)
+    return step
+
+
 def measure_rtt(n=10):
     """Dispatch+sync latency of a trivial jitted op — the tunnel RTT floor
     to subtract from single-shot timings. Measured, never hardcoded."""

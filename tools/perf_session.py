@@ -63,39 +63,9 @@ def say(msg):
 T0 = time.time()
 
 
-def timed_scan(step_fn, x0, K=8):
-    """ONE copy of the scan-fused timing harness (PERF.md methodology:
-    K steps in one dispatch, host-fetch sync — `block_until_ready` does
-    not reliably wait through the tunnel). ``step_fn: carry -> carry``;
-    returns seconds per step. Shared by the stages/bn/peak phases (and
-    mirrors tools/perf_stages.py:timed_scan)."""
-    import numpy as np
-    import jax
-
-    @jax.jit
-    def run(xd):
-        c, _ = jax.lax.scan(lambda c, _: (step_fn(c), None), xd, None,
-                            length=K)
-        return c
-
-    y = run(x0)
-    np.asarray(jax.device_get(y.ravel()[:2]))
-    t0 = time.perf_counter()
-    y = run(x0)
-    np.asarray(jax.device_get(y.ravel()[:2]))
-    return (time.perf_counter() - t0) / K
-
-
-def reinject(fn):
-    """Wrap a ``carry -> output`` fn as ``carry -> carry`` for timed_scan
-    by folding a cheap summary of the output back into the carry (keeps
-    every scan step live without changing shapes)."""
-    import jax.numpy as jnp
-
-    def step(c):
-        o = fn(c)
-        return c + 0 * jnp.mean(o.astype(jnp.float32)).astype(c.dtype)
-    return step
+# the scan-fused timing harness + carry reinjection live in ONE place
+# (tools/perf_common.py) shared with bench.py's conv_class config
+from perf_common import reinject, timed_scan  # noqa: E402
 
 
 def phase_probe():
@@ -122,7 +92,10 @@ def _resnet(tag, **env):
         saved[k] = os.environ.get(k)
         os.environ[k] = v
     try:
-        rec = bench.bench_resnet50()
+        # stamp platform+policy here too: a mid-battery tunnel wedge that
+        # drops jax to CPU must be visible on THESE lines, same as every
+        # line bench.py prints itself
+        rec = bench._stamp(bench.bench_resnet50())
         out(tag, rec)
     finally:
         for k, v in saved.items():
@@ -338,7 +311,7 @@ def phase_lstm():
     saved = os.environ.get("MXTPU_RNN_HOIST")
     os.environ["MXTPU_RNN_HOIST"] = "1"
     try:
-        out("lstm", bench.bench_lstm_ptb())
+        out("lstm", bench._stamp(bench.bench_lstm_ptb()))
         _LSTM_MEASURED = True
     finally:
         if saved is None:
@@ -358,10 +331,10 @@ def phase_lstm_hoist_ab():
     try:
         if not _LSTM_MEASURED:   # canonical record (skip if lstm ran first)
             os.environ["MXTPU_RNN_HOIST"] = "1"
-            out("lstm", bench.bench_lstm_ptb())
+            out("lstm", bench._stamp(bench.bench_lstm_ptb()))
             _LSTM_MEASURED = True
         os.environ["MXTPU_RNN_HOIST"] = "0"
-        rec = bench.bench_lstm_ptb()
+        rec = bench._stamp(bench.bench_lstm_ptb())
         rec["note"] = "input GEMM inside the scan (pre-hoist lowering)"
         out("lstm_nohoist", rec)
     finally:
@@ -373,12 +346,12 @@ def phase_lstm_hoist_ab():
 
 def phase_bert():
     import bench
-    out("bert", bench.bench_bert_base())
+    out("bert", bench._stamp(bench.bench_bert_base()))
 
 
 def phase_eager():
     import bench
-    out("eager", bench.bench_eager())
+    out("eager", bench._stamp(bench.bench_eager()))
 
 
 def phase_bandwidth():
@@ -482,6 +455,34 @@ def phase_resnet_s2d2_im2col():
             MXTPU_BN_ONEPASS="1", MXTPU_CONV_IM2COL="1")
 
 
+def phase_resnet_pallas():
+    """THE round-7 kernel, end to end: the Pallas implicit-GEMM conv
+    (mxtpu/ops/pallas/conv.py) on the MXU-underfilled stem/1x1/small-C
+    classes (PERF.md: stem + stage2 = 78% of the step at 15% of the
+    FLOPs), on top of the best-known flag set with the PLAIN stem so the
+    kernel sees the true 7x7s2 conv."""
+    _resnet("resnet_pallas", MXTPU_PALLAS_CONV="1", MXTPU_CONV_ACC="0",
+            MXTPU_BN_ONEPASS="1", BENCH_S2D_STEM="0", MXTPU_CONV_IM2COL="0")
+
+
+def phase_resnet_pallas_s2d2():
+    """Composition check: the double-s2d stem replaces the 7x7 (so Pallas
+    only sees the 1x1/small-C classes) — do the two levers stack? Both
+    ride one jit cache key (policy_key), so this is a clean in-session
+    A/B against resnet_pallas and resnet_s2d2."""
+    _resnet("resnet_pallas_s2d2", MXTPU_PALLAS_CONV="1", MXTPU_CONV_ACC="0",
+            MXTPU_BN_ONEPASS="1", BENCH_S2D_STEM="2", MXTPU_CONV_IM2COL="0")
+
+
+def phase_conv_class():
+    """Kernel-level attribution through the bench config (one JSON line
+    per conv class x impl, XLA vs Pallas) — the numbers that used to live
+    only in this tool's phase_convs now land in the driver artifact."""
+    import bench
+    out("conv_class", bench.bench_conv_class(
+        emit=lambda rec: out("conv_class", bench._stamp(rec))))
+
+
 def phase_resnet_im2col():
     """Small-channel convs via explicit im2col + matmul (staged,
     MXTPU_CONV_IM2COL): the conv path measured ~7 TFLOP/s on the early
@@ -550,9 +551,9 @@ def phase_bert_pad_ab():
     saved = os.environ.get("MXTPU_FLASH_PAD_D")
     try:
         os.environ["MXTPU_FLASH_PAD_D"] = "1"
-        out("bert_pad", bench.bench_bert_base())
+        out("bert_pad", bench._stamp(bench.bench_bert_base()))
         os.environ["MXTPU_FLASH_PAD_D"] = "0"
-        rec = bench.bench_bert_base()
+        rec = bench._stamp(bench.bench_bert_base())
         rec["note"] = "old fallback (pad disabled)"
         out("bert_nopad", rec)
     finally:
@@ -579,6 +580,9 @@ PHASES = [
     ("lstm", phase_lstm),
     ("bert", phase_bert),
     ("resnet_best", phase_resnet_best),
+    ("resnet_pallas", phase_resnet_pallas),
+    ("resnet_pallas_s2d2", phase_resnet_pallas_s2d2),
+    ("conv_class", phase_conv_class),
     ("resnet_s2d2", phase_resnet_s2d2),
     ("resnet_im2col", phase_resnet_im2col),
     ("resnet_s2d2_im2col", phase_resnet_s2d2_im2col),
