@@ -442,6 +442,116 @@ def bench_optimizer_step():
     }
 
 
+def bench_guard_overhead(emit=None):
+    """Numerics-sentinel + dynamic-loss-scaler cost (mxtpu/resilience.py):
+    steps/s with the guard ON (DynamicLossScaler attached — in-jit finite
+    flag, grad norm, skip-select, scaler update) vs OFF, for the
+    ``optimizer_step`` hot path and a small-resnet Trainer step. One JSON
+    line per (config, guard) plus a summary whose value is the worst
+    overhead fraction — the <2% acceptance bound (ISSUE 3) is read off
+    this artifact on the TPU tier. BENCH_GUARD_CONFIGS selects subsets."""
+    import jax
+
+    import mxtpu as mx
+    from mxtpu import autograd, gluon, resilience
+    from mxtpu.gluon.parameter import Parameter
+    from mxtpu.gluon.trainer import Trainer
+
+    if emit is None:
+        emit = _emit
+    which = [c.strip() for c in os.environ.get(
+        "BENCH_GUARD_CONFIGS", "optimizer_step,resnet").split(",") if c]
+    n_params = int(os.environ.get("BENCH_GUARD_PARAMS", "80"))
+    size = int(os.environ.get("BENCH_GUARD_PARAM_SIZE", "16384"))
+    steps = int(os.environ.get("BENCH_GUARD_STEPS", "30"))
+    batch = int(os.environ.get("BENCH_GUARD_BATCH", "8"))
+    img = int(os.environ.get("BENCH_GUARD_IMG", "64"))
+    rng = np.random.RandomState(0)
+
+    def time_steps(step_fn, sync, n):
+        step_fn()  # warmup + compile
+        sync()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            step_fn()
+        sync()
+        return n / (time.perf_counter() - t0)
+
+    def opt_step_rate(guard):
+        params = []
+        for j in range(n_params):
+            p = Parameter("guard_p%d" % j, shape=(size,), dtype="float32")
+            p.initialize()
+            p.grad()[:] = mx.nd.array(rng.randn(size).astype(np.float32))
+            params.append(p)
+        scaler = resilience.DynamicLossScaler() if guard else None
+        tr = Trainer(params, "adam", {"learning_rate": 1e-3}, kvstore=None,
+                     loss_scaler=scaler)
+
+        def sync():
+            jax.block_until_ready([p.data()._data for p in params])
+
+        return time_steps(lambda: tr.step(1), sync, steps)
+
+    def resnet_rate(guard):
+        from mxtpu.gluon.model_zoo import vision
+        net = vision.resnet18_v1()
+        net.initialize()
+        x = mx.nd.array(rng.uniform(-1, 1, (batch, 3, img, img))
+                        .astype(np.float32))
+        y = mx.nd.array(rng.randint(0, 10, (batch,)).astype(np.float32))
+        net(x)  # settle deferred shapes
+        net.hybridize()
+        loss = gluon.loss.SoftmaxCrossEntropyLoss()
+        scaler = resilience.DynamicLossScaler() if guard else None
+        tr = Trainer(net.collect_params(), "sgd",
+                     {"learning_rate": 0.01, "momentum": 0.9}, kvstore=None,
+                     loss_scaler=scaler)
+        params = list(net.collect_params().values())
+
+        def one():
+            with autograd.record():
+                l = loss(net(x), y)
+                if scaler is not None:
+                    l = scaler.scale(l)
+            l.backward()
+            tr.step(batch)
+
+        def sync():
+            jax.block_until_ready([p.data()._data for p in params])
+
+        return time_steps(one, sync, steps)
+
+    runners = {"optimizer_step": opt_step_rate, "resnet": resnet_rate}
+    bad = [c for c in which if c not in runners]
+    if bad or not which:
+        # fail BEFORE burning measurement time, naming the offending value
+        raise RuntimeError(
+            "BENCH_GUARD_CONFIGS=%r: expected a non-empty comma list from %s"
+            % (os.environ.get("BENCH_GUARD_CONFIGS"), sorted(runners)))
+    overheads = {}
+    for cname in which:
+        off_rate = runners[cname](False)
+        on_rate = runners[cname](True)
+        overheads[cname] = off_rate / on_rate - 1.0
+        emit({"metric": "guard_overhead_%s" % cname, "guard": "off",
+              "value": round(off_rate, 2), "unit": "steps/sec"})
+        emit({"metric": "guard_overhead_%s" % cname, "guard": "on",
+              "value": round(on_rate, 2), "unit": "steps/sec",
+              "overhead_frac": round(overheads[cname], 4)})
+    worst = max(overheads.values())
+    return {
+        "metric": "guard_overhead",
+        "value": round(worst, 4),
+        "unit": "overhead_frac",
+        # >=1.0 means the sentinel fits the 2% budget on this platform
+        "vs_baseline": round(0.02 / max(worst, 1e-9), 3),
+        "mfu": None,
+        "hfu": None,
+        "per_config": {k: round(v, 4) for k, v in overheads.items()},
+    }
+
+
 def _perf_common():
     """The shared scan-fused timing harness (tools/perf_common.py —
     ONE copy of the PERF.md methodology: K steps per dispatch,
@@ -589,6 +699,7 @@ def bench_sparse_linear():
 CONFIGS = {
     "eager": bench_eager,
     "optimizer_step": bench_optimizer_step,
+    "guard_overhead": bench_guard_overhead,
     "conv_class": bench_conv_class,
     "sparse_linear": bench_sparse_linear,
     "lstm_ptb": bench_lstm_ptb,

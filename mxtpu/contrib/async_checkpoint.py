@@ -30,7 +30,7 @@ import jax
 from ..base import MXNetError
 
 __all__ = ["save_block", "load_block", "save_train_step",
-           "load_train_step"]
+           "load_train_step", "save_trainer", "load_trainer"]
 
 
 def _param_tree(block):
@@ -56,8 +56,25 @@ def _checkpointer(async_save):
             import atexit
             _ASYNC_CKPTR = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
             atexit.register(_ASYNC_CKPTR.close)  # drain pending writes
+        # a background write that DIED must fail the next save loudly, not
+        # rot silently in the async thread: re-raise its exception here
+        # (wait_until_finished re-raises on its own)
+        check = getattr(_ASYNC_CKPTR, "check_for_errors", None)
+        if check is not None:
+            check()
         return _ASYNC_CKPTR
     return ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+
+
+def _guard_overwrite(step_dir, force):
+    """Refuse to clobber a finalized checkpoint step unless ``force=True``
+    — an interval save landing on a step that already exists is almost
+    always a bookkeeping bug, and the old bytes may be the only good copy."""
+    from etils import epath
+    if not force and epath.Path(step_dir).exists():
+        raise MXNetError(
+            "checkpoint step directory %s already exists; pass force=True "
+            "to overwrite it" % step_dir)
 
 
 def _step_dir(directory, step):
@@ -106,12 +123,15 @@ def _keyed(datas):
     return {"p%d" % j: d for j, d in enumerate(datas)}
 
 
-def save_block(block, directory, step=0, async_save=False):
+def save_block(block, directory, step=0, async_save=False, force=False):
     """Write the block's parameters sharded-per-process; returns the
     checkpointer (call ``wait_until_finished()`` on async saves before
-    relying on the files)."""
+    relying on the files). Overwriting an existing step requires
+    ``force=True``."""
+    sd = _step_dir(directory, step)
+    _guard_overwrite(sd, force)
     ckptr = _checkpointer(async_save)
-    ckptr.save(_step_dir(directory, step), _param_tree(block), force=True)
+    ckptr.save(sd, _param_tree(block), force=True)
     return ckptr
 
 
@@ -142,9 +162,11 @@ def load_block(block, directory, step=0):
     return block
 
 
-def save_train_step(train_step, directory, step=0, async_save=False):
+def save_train_step(train_step, directory, step=0, async_save=False,
+                    force=False):
     """Checkpoint a ShardedTrainStep: parameters AND optimizer state, each
-    written with its live sharding (ZeRO-1 state stays sharded on disk)."""
+    written with its live sharding (ZeRO-1 state stays sharded on disk).
+    Overwriting an existing step requires ``force=True``."""
     tree = {
         "params": _keyed(train_step._param_datas),
         "opt": {("p%d__%d" % (j, i)): s
@@ -152,6 +174,7 @@ def save_train_step(train_step, directory, step=0, async_save=False):
                 for i, s in enumerate(st)},
         "meta": {"num_update": train_step._num_update},
     }
+    _guard_overwrite(_step_dir(directory, step), force)
     ckptr = _checkpointer(async_save)
     ckptr.save(_step_dir(directory, step), tree, force=True)
     # state-structure fingerprint as a sidecar (read BEFORE restore so a
@@ -213,3 +236,98 @@ def load_train_step(train_step, directory, step=0):
         for j, st in enumerate(train_step._opt_states)]
     train_step._num_update = int(restored["meta"]["num_update"])
     return train_step
+
+
+# ----------------------------------------------------------- gluon Trainer
+def _trainer_updater(trainer):
+    if not trainer._kv_initialized:
+        trainer._init_kvstore()
+    if trainer._update_on_kvstore:
+        return trainer._kvstore._updater
+    return trainer._updaters[0]
+
+
+def save_trainer(trainer, directory, step=0, async_save=False, force=False):
+    """Checkpoint a gluon Trainer: parameters (sharded orbax arrays) + the
+    full updater/optimizer state blob (update counts, momentum/Adam state,
+    and — through FusedUpdater.get_states — the loss-scaler scale/streak
+    and the numerics guard's device step count) + the global RNG key.
+    Everything :class:`mxtpu.resilience.ResilientLoop` needs for bit-exact
+    resume, in one orbax step directory (finalized atomically, so a
+    present ``step_N`` dir is always durable)."""
+    import numpy as np
+
+    from .. import random as _random
+    from ..resilience import inject
+    if inject("ckpt_io"):
+        raise OSError("injected checkpoint IO failure (MXTPU_FAULT_INJECT)")
+    upd = _trainer_updater(trainer)
+    params = [p for p in trainer._params if p._data is not None]
+    if not params:
+        raise MXNetError("initialize the parameters before checkpointing")
+    blob = np.frombuffer(upd.get_states(dump_optimizer=True),
+                         np.uint8).copy()
+    tree = {
+        "params": _keyed([p.data()._data for p in params]),
+        "extra": {"updater": blob, "rng": _random.get_key_data()},
+    }
+    sd = _step_dir(directory, step)
+    _guard_overwrite(sd, force)
+    ckptr = _checkpointer(async_save)
+    ckptr.save(sd, tree, force=True)
+    _write_meta(sd, {"kind": "trainer", "n_params": len(params)})
+    return ckptr
+
+
+def load_trainer(trainer, directory, step=0):
+    """Restore a gluon Trainer in place from :func:`save_trainer` output —
+    params with their live shardings, optimizer + loss-scaler + guard
+    state, and the RNG key (bit-exact resume)."""
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    from .. import random as _random
+    upd = _trainer_updater(trainer)
+    params = [p for p in trainer._params if p._data is not None]
+    sd = _step_dir(directory, step)
+    meta = _read_meta(sd)
+    if meta is not None and meta.get("n_params") not in (None, len(params)):
+        raise MXNetError(
+            "trainer checkpoint at %s holds %s parameters, this trainer "
+            "has %d — the model that saved must match the one restoring "
+            "(positional keys)" % (sd, meta.get("n_params"), len(params)))
+
+    def _target(p):
+        d = p.data()._data
+        return jax.ShapeDtypeStruct(d.shape, d.dtype, sharding=d.sharding)
+
+    targets = {"params": _keyed([_target(p) for p in params]),
+               "extra": {"updater": 0, "rng": 0}}
+    restore_args = {
+        "params": {k: ocp.ArrayRestoreArgs(sharding=t.sharding,
+                                           global_shape=t.shape)
+                   for k, t in targets["params"].items()},
+        "extra": {"updater": ocp.RestoreArgs(), "rng": ocp.RestoreArgs()},
+    }
+    ckptr = _checkpointer(async_save=False)
+    restored = ckptr.restore(
+        sd, args=ocp.args.PyTreeRestore(restore_args=restore_args,
+                                        item=targets))
+    for j, p in enumerate(params):
+        p.data()._set_data(restored["params"]["p%d" % j])
+    upd.set_states(np.asarray(restored["extra"]["updater"],
+                              np.uint8).tobytes())
+    # the blob carried the pickled optimizer (counts, schedules, Nadam's
+    # m_schedule): rebind the live trainer to it, exactly like load_states
+    trainer._optimizer = upd.optimizer
+    trainer._optimizer.param_dict = {
+        i: p for i, p in enumerate(trainer._params)}
+    for u in trainer._updaters:
+        u.optimizer = trainer._optimizer
+    upd_scaler = getattr(upd, "scaler", None)
+    if trainer._loss_scaler is not None and upd_scaler is not None \
+            and upd_scaler is not trainer._loss_scaler:
+        trainer._loss_scaler.load_state_dict(upd_scaler.state_dict())
+        upd.scaler = trainer._loss_scaler
+    _random.set_key_data(np.asarray(restored["extra"]["rng"]))
+    return trainer

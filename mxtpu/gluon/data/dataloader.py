@@ -25,8 +25,10 @@ ForkingPickler (dataloader.py:26-120). The equivalent here:
 from __future__ import annotations
 
 import multiprocessing as _mp
+import os
 import queue as _queue
 import threading
+import time
 import warnings
 
 import numpy as np
@@ -135,29 +137,50 @@ class DataLoader:
         # epoch must never satisfy the next epoch's wait
         return self._pool
 
-    def close(self):
-        """Shut the persistent worker pool down (idempotent)."""
+    def _teardown_pool(self, task_q, result_q, workers, join_timeout,
+                       drain_timeout):
+        """ONE copy of the pool teardown shared by close() and the
+        worker-death rebuild: bounded joins (terminate stragglers), drain
+        published results reclaiming their shm segments, then close +
+        ``cancel_join_thread()`` both queues so a feeder thread can never
+        hang interpreter exit."""
+        # join BEFORE draining: a worker's queue feeder thread may still be
+        # flushing a result; draining first would miss it and leak its
+        # shared-memory segments (mp.Queue is unbounded, so joining here
+        # cannot deadlock on a full queue)
+        for w in workers:
+            w.join(timeout=join_timeout)
+            if w.is_alive():  # pragma: no cover - stuck worker
+                w.terminate()
+                w.join(timeout=1.0)
+        while True:
+            try:
+                _j, desc, err = result_q.get(timeout=drain_timeout)
+            except Exception:  # Empty, or a torn frame from a dead writer
+                break
+            if err is None:
+                self._discard_segments(desc)
+        for q in (task_q, result_q):  # pragma: no branch
+            try:
+                q.close()
+                q.cancel_join_thread()
+            except Exception:  # pragma: no cover - queue already torn down
+                pass
+
+    def close(self, timeout=5.0):
+        """Shut the persistent worker pool down (idempotent). Workers are
+        joined with a bounded ``timeout`` and terminated if still alive, and
+        both queues get ``cancel_join_thread()`` — a wedged worker or a
+        queue feeder thread must never hang interpreter exit (this runs
+        from ``__del__`` at teardown)."""
         if self._pool is None:
             return
         task_q, result_q, workers = self._pool
         self._pool = None
         for _ in workers:
             task_q.put(None)
-        # join BEFORE draining: a worker's queue feeder thread may still be
-        # flushing a result; draining first would miss it and leak its
-        # shared-memory segments (mp.Queue is unbounded, so joining here
-        # cannot deadlock on a full queue)
-        for w in workers:
-            w.join(timeout=5)
-            if w.is_alive():  # pragma: no cover - stuck worker
-                w.terminate()
-        while True:
-            try:
-                _j, desc, err = result_q.get(timeout=0.2)
-            except (_queue.Empty, OSError):
-                break
-            if err is None:
-                self._discard_segments(desc)
+        self._teardown_pool(task_q, result_q, workers, join_timeout=timeout,
+                            drain_timeout=0.2)
 
     def __del__(self):  # pragma: no cover - interpreter-exit timing
         try:
@@ -165,9 +188,38 @@ class DataLoader:
         except Exception:
             pass
 
+    def _rebuild_pool(self):
+        """Tear the WHOLE pool down and spawn a fresh one after a worker
+        death. A fresh pool (not an in-place replacement) is load-bearing:
+        a worker SIGKILLed inside ``task_q.get()`` dies HOLDING the queue's
+        shared reader lock — every surviving worker then blocks forever
+        acquiring it, so the old queues are poisoned and must be abandoned.
+        Already-published results are drained off the old result queue
+        (their shm segments reclaimed) before it is dropped."""
+        task_q, result_q, workers = self._pool
+        self._pool = None
+        for w in workers:
+            if w.is_alive():  # no sentinels: the queues may be poisoned
+                w.terminate()
+        self._teardown_pool(task_q, result_q, workers, join_timeout=1.0,
+                            drain_timeout=0.1)
+        seq = self._seq  # task ids must stay monotone across the rebuild
+        pool = self._ensure_pool()
+        self._seq = seq
+        return pool
+
     def _iter_multiprocess(self):
         """Spawned worker processes + shared-memory batch handoff (the
-        reference's _MultiWorkerIter, dataloader.py:157-231)."""
+        reference's _MultiWorkerIter, dataloader.py:157-231).
+
+        Worker DEATH (OOM-kill, segfault — distinct from a dataset
+        exception, which travels back as an error result) is survivable:
+        dead workers are restarted with backoff and their lost in-flight
+        tasks re-enqueued (duplicate deliveries are discarded), up to
+        MXTPU_DL_WORKER_RESTARTS (default 3) restarts per epoch; past that
+        the raise reports every exit code and the batch index so the
+        failure is attributable. A worker killed mid-publish can leak its
+        shared-memory segment — the price of surviving, noted here."""
         pool = self._ensure_pool()
         if pool is None:  # spawn failed: picklability fallback
             yield from self._iter_threads()
@@ -177,14 +229,23 @@ class DataLoader:
         base = self._seq
         self._seq += len(batches)
         bound = max(self._prefetch, self._num_workers, 1)
+        max_restarts = int(os.environ.get("MXTPU_DL_WORKER_RESTARTS", "3"))
         sent = 0
+        restarts = 0
         results = {}
+        from ...resilience import inject
         try:
             for i in range(len(batches)):
                 # keep at most `bound` batches in flight past the consumer
                 while sent < len(batches) and sent < i + bound:
                     task_q.put((base + sent, batches[sent]))
                     sent += 1
+                if inject("worker_death", i):
+                    import signal as _signal
+                    victim = next(
+                        (w for w in _workers if w.is_alive()), None)
+                    if victim is not None:
+                        os.kill(victim.pid, _signal.SIGKILL)
                 while base + i not in results:
                     try:
                         j, desc, err = result_q.get(timeout=1.0)
@@ -192,21 +253,54 @@ class DataLoader:
                         dead = [w for w in _workers
                                 if not w.is_alive()
                                 and w.exitcode not in (0, None)]
-                        if dead:
+                        if not dead:
+                            continue
+                        # ONE event per detection, however many workers an
+                        # OOM-killer sweep took — the budget counts pool
+                        # rebuild attempts, not corpses
+                        restarts += 1
+                        if restarts > max_restarts:
                             raise RuntimeError(
-                                "DataLoader worker died (exit code %s)"
-                                % dead[0].exitcode)
+                                "DataLoader worker(s) died (exit codes %s) "
+                                "while waiting for batch %d/%d; giving up "
+                                "after %d restart(s) "
+                                "(MXTPU_DL_WORKER_RESTARTS=%d). Repeated "
+                                "deaths usually mean the OOM killer — "
+                                "shrink the batch or worker count."
+                                % ([w.exitcode for w in dead], i,
+                                   len(batches), restarts - 1,
+                                   max_restarts))
+                        warnings.warn(
+                            "DataLoader worker died (exit codes %s) at "
+                            "batch %d; restarting the pool (%d/%d)"
+                            % ([w.exitcode for w in dead], i, restarts,
+                               max_restarts))
+                        time.sleep(0.05 * restarts)  # backoff
+                        pool = self._rebuild_pool()
+                        if pool is None:  # spawn broke: cannot recover
+                            raise RuntimeError(
+                                "DataLoader worker died and the pool could "
+                                "not be respawned")
+                        task_q, result_q, _workers = pool
+                        # in-flight work died with the old pool: re-enqueue
+                        # every outstanding id (completed drained results
+                        # for pending ids were reclaimed by the rebuild,
+                        # so a recompute is the only copy)
+                        for j2 in range(base + i, base + sent):
+                            if j2 not in results:
+                                task_q.put((j2, batches[j2 - base]))
                         continue
-                    if j < base:
-                        # stale batch from an abandoned epoch: discard —
-                        # including stale ERRORS, which belong to the epoch
-                        # the user walked away from, not this one
+                    if j < base + i or j in results:
+                        # stale epoch, already-yielded, or a post-restart
+                        # duplicate: discard — including stale ERRORS,
+                        # which belong to work the consumer moved past
                         if err is None:
                             self._discard_segments(desc)
                         continue
                     if err is not None:
                         raise RuntimeError(
-                            "DataLoader worker failed:\n%s" % err)
+                            "DataLoader worker failed at batch %d:\n%s"
+                            % (j - base, err))
                     results[j] = desc
                 yield _mp_worker.from_shm(results.pop(base + i), array)
         finally:

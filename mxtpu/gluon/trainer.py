@@ -20,8 +20,18 @@ __all__ = ["Trainer"]
 
 
 class Trainer:
+    """``loss_scaler``: an optional :class:`mxtpu.resilience.DynamicLossScaler`.
+    Attaching one (or setting ``MXTPU_NUMERICS_GUARD=1``) runs every step
+    under the in-jit numerics sentinel: non-finite gradient steps become
+    no-ops on params and optimizer state, the scale backs off / regrows
+    in-graph, and :meth:`step` returns the device ``step_ok`` scalar
+    (fetched asynchronously — no hot-loop host sync). Scale the loss with
+    ``scaler.scale(loss)`` before ``backward()``; the unscale happens
+    inside the fused update. Scaler state rides save_states/load_states."""
+
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):
+                 compression_params=None, update_on_kvstore=None,
+                 loss_scaler=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -39,7 +49,10 @@ class Trainer:
         self._compression_params = compression_params
         optimizer_params = optimizer_params or {}
         self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._loss_scaler = loss_scaler
         self._init_optimizer(optimizer, optimizer_params)
+        if loss_scaler is not None:
+            self._updaters[0].scaler = loss_scaler
         self._kv_initialized = False
         self._kvstore_kind = kvstore
         self._kvstore = None
@@ -74,6 +87,9 @@ class Trainer:
                     kv.init(i, param.data())
             if update_on_kvstore:
                 kv.set_optimizer(self._optimizer)
+                if self._loss_scaler is not None and \
+                        getattr(kv, "_updater", None) is not None:
+                    kv._updater.scaler = self._loss_scaler
             self._kvstore = kv
             self._update_on_kvstore = update_on_kvstore
         else:
@@ -94,12 +110,31 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """One optimization step (ref: trainer.py:254). rescale_grad is set to
-        1/batch_size on top of any user scale, like the reference."""
+        1/batch_size on top of any user scale, like the reference.
+
+        Under the numerics sentinel (loss_scaler attached or
+        MXTPU_NUMERICS_GUARD=1) returns the step's ``step_ok`` verdict as a
+        lazy device NDArray — fetched asynchronously, so reading it later
+        (or never) adds no hot-loop sync; unguarded steps return None."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+        return self._step_verdict()
+
+    def _active_updater(self):
+        if self._update_on_kvstore and self._kvstore is not None:
+            return getattr(self._kvstore, "_updater", None)
+        return self._updaters[0]
+
+    def _step_verdict(self):
+        import jax.numpy as jnp
+
+        from ..ndarray import NDArray
+        upd = self._active_updater()
+        ok = getattr(upd, "last_step_ok", None)
+        return None if ok is None else NDArray(jnp.asarray(ok))
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -138,6 +173,7 @@ class Trainer:
                              "is not supported")
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
+        return self._step_verdict()
 
     def _update(self, ignore_stale_grad=False):
         if self._update_on_kvstore:

@@ -109,6 +109,45 @@ class KVStore:
                 self._updater(_int_key(k), NDArray(merged), self._store[k])
 
     def _dist_reduce(self, keys, merged_list):
+        """Retrying wrapper over :meth:`_dist_reduce_once` for TRANSIENT
+        failures (MXTPU_KV_RETRIES; the attempt is deterministic and
+        side-effect-free locally, so re-running it is exact).
+
+        Retry discipline: a retry is only collectively safe when EVERY
+        participant observes the failure and retries in lockstep — a
+        one-sided retry would pair one worker's fresh allgather with its
+        peers' NEXT reduce and sum gradients across steps. Failures that
+        reach python here before entering the collective (quantize/pack
+        errors, injected faults, coordinator-reported aborts that raise on
+        all workers) are that kind; a mid-collective partial failure is
+        not. So multi-process worlds default to NO retries unless the
+        operator opts in by setting MXTPU_KV_RETRIES explicitly, accepting
+        that their failure mode raises everywhere (e.g. coordinator
+        barrier errors). Single-process (and the CPU test tier) default to
+        2. A persistent failure still raises — recovery is checkpoint +
+        restart (see get_num_dead_node)."""
+        import os as _os
+
+        import jax as _jax
+
+        from . import resilience
+        if self._compression is not None:
+            # NOT retry-safe: quantize folds the merged gradient into the
+            # per-key error-feedback residual IN PLACE, so a second attempt
+            # would double-count it — the compressed path fails fast
+            retries = 0
+        else:
+            env = _os.environ.get("MXTPU_KV_RETRIES")
+            if env is not None:
+                retries = int(env)
+            else:
+                retries = 0 if _jax.process_count() > 1 else 2
+        return resilience.with_retries(
+            lambda: self._dist_reduce_once(keys, merged_list),
+            what="kvstore dist gradient reduce",
+            retries=retries, backoff=0.1)
+
+    def _dist_reduce_once(self, keys, merged_list):
         """Sum each local contribution across worker processes.
 
         Keys pushed TOGETHER in one call are FUSED into one flattened DCN
@@ -123,7 +162,10 @@ class KVStore:
         stays local)."""
         import numpy as np
 
-        from . import distributed
+        from . import distributed, resilience
+        if resilience.inject("kv_fail"):
+            raise MXNetError(
+                "injected transient collective failure (MXTPU_FAULT_INJECT)")
         if self._compression is not None:
             out = []
             packed_all, meta = [], []

@@ -59,6 +59,8 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
         self._grad_req = "write"
+        self._loss_scaler = None
+        self.last_step_ok = None  # device verdict of the latest guarded update
 
     # ------------------------------------------------------------- binding
     @property
@@ -177,9 +179,17 @@ class Module(BaseModule):
     # ----------------------------------------------------------- optimizer
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
-                       force_init=False):
+                       force_init=False, loss_scaler=None):
         """(ref: module.py:init_optimizer; kvstore plumbing model.py
-        _create_kvstore)"""
+        _create_kvstore)
+
+        ``loss_scaler``: optional :class:`mxtpu.resilience.DynamicLossScaler`
+        — wires the in-jit numerics sentinel + dynamic loss scaling through
+        ``update()`` (non-finite steps skip; ``self.last_step_ok`` carries
+        the async verdict). ``backward()`` seeds the head gradients with
+        the live scale; heads that IGNORE output gradients (SoftmaxOutput-
+        style fused losses) need their own grad_scale instead — see
+        docs/resilience.md."""
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             return
@@ -195,6 +205,9 @@ class Module(BaseModule):
                 **opt_kw)
         self._optimizer = optimizer
         self._updater = opt_mod.get_updater(optimizer)
+        self._loss_scaler = loss_scaler
+        if loss_scaler is not None:
+            self._updater.scaler = loss_scaler
         if kvstore:
             from .. import kvstore as kv_mod
             kv = kv_mod.create(kvstore) if isinstance(kvstore, str) else kvstore
@@ -204,6 +217,9 @@ class Module(BaseModule):
                 kv.init(i, self._exec.arg_dict[name])
             if self._update_on_kvstore:
                 kv.set_optimizer(self._optimizer)
+                if loss_scaler is not None and \
+                        getattr(kv, "_updater", None) is not None:
+                    kv._updater.scaler = loss_scaler
         self.optimizer_initialized = True
 
     # ------------------------------------------------------------- running
@@ -221,6 +237,38 @@ class Module(BaseModule):
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
+        if self._loss_scaler is not None:
+            # loss scaling: head gradients are multiplied by the LIVE scale
+            # (an async device scalar — no sync, no recompile), default
+            # seeds and user-passed out_grads alike — the guarded updater
+            # unconditionally divides the scale back out in the fused
+            # update jit, so unscaled head grads here would silently
+            # shrink every update by the scale factor
+            import jax.numpy as jnp
+            s = self._loss_scaler.scale_array()
+            for o in self._exec.outputs:
+                dt = o._data.dtype
+                if jnp.issubdtype(dt, jnp.floating) and \
+                        self._loss_scaler.max_scale > \
+                        float(jnp.finfo(dt).max):
+                    # fail fast (statically — no device sync): once the
+                    # scale grows past the head dtype's max, the seed casts
+                    # to inf and every step is skipped — an invisible
+                    # scale ceiling. scale() avoids this by staying in f32;
+                    # seeds cannot (jax vjp needs cotangent dtype == primal)
+                    raise MXNetError(
+                        "loss scaler max_scale=%g exceeds %s's max (%g): "
+                        "construct DynamicLossScaler(max_scale=...) within "
+                        "the head dtype's range for Module training"
+                        % (self._loss_scaler.max_scale, dt,
+                           float(jnp.finfo(dt).max)))
+            if out_grads is None:
+                out_grads = [NDArray(jnp.broadcast_to(
+                    s.astype(o._data.dtype), o._data.shape))
+                    for o in self._exec.outputs]
+            else:
+                out_grads = [NDArray(o._data * s.astype(o._data.dtype))
+                             for o in out_grads]
         self._exec.backward(out_grads=out_grads)
 
     def update(self):
@@ -251,6 +299,9 @@ class Module(BaseModule):
                 self._updater.update_batch(keys, grads, weights)
         else:
             self._updater.update_batch(keys, grads, weights)
+        upd = self._kvstore._updater if self._update_on_kvstore \
+            else self._updater
+        self.last_step_ok = getattr(upd, "last_step_ok", None)
 
     def get_outputs(self, merge_multi_context=True):
         return self._exec.outputs

@@ -8,7 +8,7 @@ import re
 
 from .ndarray import NDArray
 
-__all__ = ["Monitor"]
+__all__ = ["Monitor", "TrainingHealthMonitor"]
 
 
 class Monitor:
@@ -69,3 +69,70 @@ class Monitor:
     def toc_print(self):
         for n, k, v in self.toc():
             logging.info("Batch: %7d %30s %s", n, k, v)
+
+
+class TrainingHealthMonitor:
+    """Surface the numerics sentinel's per-step verdicts without syncing
+    the hot loop (mxtpu/resilience.py).
+
+    The guarded fused updater buffers its async device scalars
+    (step index, step_ok, global grad norm) in ``updater.health``;
+    ``flush()`` materializes them in ONE batch (a single host sync, off
+    the step path) and logs every skipped step. ``after_step()`` flushes
+    every ``interval`` calls — the Monitor tic/toc cadence, applied to
+    training health instead of op stats."""
+
+    def __init__(self, interval=100, logger=None):
+        self.interval = int(interval)
+        self.logger = logger or logging.getLogger("mxtpu.resilience")
+        self._owner = None
+        self._count = 0
+        self.skipped = []  # [(step, grad_norm), ...] across flushes
+
+    def install(self, owner):
+        """Attach to a gluon Trainer, a Module, or a raw updater. The
+        ACTIVE updater is resolved lazily at flush time: with
+        update_on_kvstore the guarded steps run through the store's
+        updater, and which one that is isn't known until the kvstore
+        initializes on the first step."""
+        self._owner = owner
+        return self
+
+    def _updater_of(self):
+        owner = self._owner
+        active = getattr(owner, "_active_updater", None)  # gluon Trainer
+        if callable(active):
+            upd = active()
+            if upd is not None:
+                return upd
+            upds = getattr(owner, "_updaters", None)
+            return upds[0] if upds else None
+        if getattr(owner, "_update_on_kvstore", False) and \
+                getattr(owner, "_kvstore", None) is not None:  # Module
+            return owner._kvstore._updater
+        upds = getattr(owner, "_updaters", None)
+        if upds:
+            return upds[0]
+        return getattr(owner, "_updater", owner)  # Module local / raw updater
+
+    def after_step(self):
+        self._count += 1
+        if self._count % self.interval == 0:
+            return self.flush()
+        return []
+
+    def flush(self):
+        """Materialize buffered verdicts (syncs once); returns
+        [(step, ok, grad_norm)] and logs the skipped steps."""
+        health = getattr(self._updater_of(), "health", None)
+        if health is None or len(health) == 0:
+            return []
+        records = health.drain()
+        for step, ok, gnorm in records:
+            if not ok:
+                self.logger.warning(
+                    "step %d skipped: non-finite gradients "
+                    "(global grad norm %s) — params and optimizer state "
+                    "untouched, loss scale backed off", step, gnorm)
+        self.skipped.extend((s, g) for s, ok, g in records if not ok)
+        return records

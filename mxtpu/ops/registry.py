@@ -107,7 +107,12 @@ def policy_key():
             os.environ.get("MXTPU_PALLAS_CONV", "0"),
             os.environ.get("MXTPU_PALLAS_CONV_INTERPRET", "0"),
             # contrib/s2d_stem.py:stem_mode (policy-mode _StemFn)
-            os.environ.get("MXTPU_S2D_STEM", "0"))
+            os.environ.get("MXTPU_S2D_STEM", "0"),
+            # resilience.guard_enabled: the in-jit numerics sentinel — the
+            # skip-step `where` select is baked into the fused-update
+            # executable, so a guard flip must recompile (exactly once);
+            # the step_ok FLAG and loss-scale VALUE are traced and never do
+            os.environ.get("MXTPU_NUMERICS_GUARD", "0"))
 
 
 # canonical op name -> fn(attrs) -> int: STATIC output count for ops whose

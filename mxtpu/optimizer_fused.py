@@ -24,6 +24,16 @@ Fallback to the eager per-param loop: sparse (row_sparse) grads, optimizers
 with host-side control flow (SGLD's rng draw, LBSGD's norm-driven LARS
 ratio), aliased buffers (donation would invalidate a live input twice), or
 ``MXTPU_FUSED_OPTIMIZER=0``.
+
+Numerics sentinel (mxtpu/resilience.py): with ``MXTPU_NUMERICS_GUARD=1``
+or a :class:`~mxtpu.resilience.DynamicLossScaler` attached, the SAME
+donated jit additionally computes one fused all-params finite flag + the
+global grad norm and applies every update under ``jnp.where`` — a
+non-finite step is a no-op on params and optimizer state (including the
+bias-correction step count, which moves to a DEVICE scalar ``t_good`` so
+the skip costs no host sync), and the loss-scaler growth/backoff runs
+in-graph on traced scalars (flag flips never recompile; guard on/off is
+exactly one extra compile — the guard bit is part of the jit cache key).
 """
 from __future__ import annotations
 
@@ -33,6 +43,7 @@ import os
 import jax
 import jax.numpy as jnp
 
+from . import resilience
 from .ndarray import NDArray
 from .ops import optimizer_ops as _uo
 from .optimizer import (SGD, Adam, AdaGrad, RMSProp, AdaDelta, Ftrl, Adamax,
@@ -78,14 +89,22 @@ class _Rule:
     arguments (lr/wd after lr_mult/wd_mult, bias-correction terms of t);
     ``step(w, g, state, hyper, rescale, static)`` -> (new_w, new_state) with
     ``state`` the same tuple/None structure the Updater stores.
+
+    ``thyper(static, lr, wd, t)`` is the guarded-mode twin of ``hyper``: it
+    rebuilds the hyper tuple IN-GRAPH from traced (lr, wd, t) so the
+    effective update count can live on device (a skipped step must not
+    advance it, and fetching it per step would be a host sync). ``None``
+    marks optimizers whose hyper depends on order-dependent host state
+    (Nadam's m_schedule) — those take the guarded-eager path instead.
     """
 
-    __slots__ = ("static", "hyper", "step")
+    __slots__ = ("static", "hyper", "step", "thyper")
 
-    def __init__(self, static, hyper, step):
+    def __init__(self, static, hyper, step, thyper=None):
         self.static = static
         self.hyper = hyper
         self.step = step
+        self.thyper = thyper
 
 
 def _clip_of(opt):
@@ -324,27 +343,68 @@ def _test_step(w, g, state, hyper, rescale, static):
     return nw, nw
 
 
+# ------------------------------------------------- guarded (traced-t) hyper
+# Guarded-mode hyper twins: same tuples the host-side hyper fns produce, but
+# built from traced (lr, wd, t) so the bias-correction step count can stay
+# on device (resilience sentinel: a skipped step must not advance t, and a
+# host-side t would cost one sync per step to keep honest).
+def _t_lr_wd(static, lr, wd, t):
+    return (lr, wd)
+
+
+def _adam_thyper(static, lr, wd, t):
+    beta1, beta2, _eps, _clip = static
+    return (lr * jnp.sqrt(1.0 - beta2 ** t) / (1.0 - beta1 ** t), wd)
+
+
+def _ftml_thyper(static, lr, wd, t):
+    beta1, beta2, _eps, _clip = static
+    return (lr, wd, 1.0 - beta1 ** t, 1.0 - beta2 ** t)
+
+
+def _adadelta_thyper(static, lr, wd, t):
+    return (wd,)
+
+
+def _adamax_thyper(static, lr, wd, t):
+    beta1, _beta2, _clip = static
+    return (lr / (1.0 - beta1 ** t), wd)
+
+
+def _groupadagrad_thyper(static, lr, wd, t):
+    return (lr,)
+
+
+def _test_thyper(static, lr, wd, t):
+    return ()
+
+
 # SGLD (per-step rng draw) and LBSGD (host-side weight/grad norms for the
 # LARS trust ratio) keep the eager path: their updates are not pure
 # functions of (weight, grad, state, scalars). Exact-type lookup also sends
 # unknown Optimizer subclasses to the eager loop — a subclass overriding
 # update() must not silently get its base class's fused rule.
 _RULES = {
-    SGD: _Rule(_sgd_static, _lr_wd, _sgd_step),
-    NAG: _Rule(_sgd_static, _lr_wd, _nag_step),
-    Signum: _Rule(_signum_static, _lr_wd, _signum_step),
-    FTML: _Rule(_beta_eps_static, _ftml_hyper, _ftml_step),
-    DCASGD: _Rule(_dcasgd_static, _lr_wd, _dcasgd_step),
-    Adam: _Rule(_beta_eps_static, _adam_hyper, _adam_step),
-    AdaGrad: _Rule(_adagrad_static, _lr_wd, _adagrad_step),
-    RMSProp: _Rule(_rmsprop_static, _lr_wd, _rmsprop_step),
-    AdaDelta: _Rule(_adadelta_static, _adadelta_hyper, _adadelta_step),
-    Ftrl: _Rule(_ftrl_static, _lr_wd, _ftrl_step),
-    Adamax: _Rule(_adamax_static, _adamax_hyper, _adamax_step),
+    SGD: _Rule(_sgd_static, _lr_wd, _sgd_step, _t_lr_wd),
+    NAG: _Rule(_sgd_static, _lr_wd, _nag_step, _t_lr_wd),
+    Signum: _Rule(_signum_static, _lr_wd, _signum_step, _t_lr_wd),
+    FTML: _Rule(_beta_eps_static, _ftml_hyper, _ftml_step, _ftml_thyper),
+    DCASGD: _Rule(_dcasgd_static, _lr_wd, _dcasgd_step, _t_lr_wd),
+    Adam: _Rule(_beta_eps_static, _adam_hyper, _adam_step, _adam_thyper),
+    AdaGrad: _Rule(_adagrad_static, _lr_wd, _adagrad_step, _t_lr_wd),
+    RMSProp: _Rule(_rmsprop_static, _lr_wd, _rmsprop_step, _t_lr_wd),
+    AdaDelta: _Rule(_adadelta_static, _adadelta_hyper, _adadelta_step,
+                    _adadelta_thyper),
+    Ftrl: _Rule(_ftrl_static, _lr_wd, _ftrl_step, _t_lr_wd),
+    Adamax: _Rule(_adamax_static, _adamax_hyper, _adamax_step,
+                  _adamax_thyper),
+    # Nadam: m_schedule is ORDER-dependent host state — no traced-t twin;
+    # guarded mode routes Nadam through the guarded-eager path
     Nadam: _Rule(_beta_eps_static, _nadam_hyper, _nadam_step),
     GroupAdaGrad: _Rule(_groupadagrad_static, _groupadagrad_hyper,
-                        _groupadagrad_step),
-    Test: _Rule(lambda opt: (), lambda opt, i, t: (), _test_step),
+                        _groupadagrad_step, _groupadagrad_thyper),
+    Test: _Rule(lambda opt: (), lambda opt, i, t: (), _test_step,
+                _test_thyper),
 }
 
 
@@ -421,6 +481,16 @@ def _split_aliased(items, states, eager_items):
     return clean, aliased
 
 
+def _tree_where(ok, new, old):
+    """Per-leaf ``where(ok, new, old)`` over the Updater's tuple/None state
+    structure — the skip-step select that makes a non-finite step a no-op."""
+    if new is None:
+        return None
+    if isinstance(new, tuple):
+        return tuple(_tree_where(ok, n, o) for n, o in zip(new, old))
+    return jnp.where(ok, new, old)
+
+
 def _build(rule, static, mp_flags, out_dtypes):
     def fused(w_list, g_list, s_list, h_list, rescale):
         FUSED_STATS["traces"] += 1  # trace-time only: counts real recompiles
@@ -445,6 +515,67 @@ def _build(rule, static, mp_flags, out_dtypes):
     return jax.jit(fused, donate_argnums=(0, 2))
 
 
+def _build_guarded(rule, static, mp_flags, out_dtypes, scaler_cfg):
+    """The guarded twin of :func:`_build`: same donated whole-model update,
+    plus (inside the SAME jit, so the guard costs no extra dispatches or
+    host syncs) the fused finite flag, the global grad norm, the skip-step
+    ``where`` select on params/state/t, loss-scale unscaling, and the
+    scaler's growth/backoff. ``scaler_cfg`` is the STATIC policy tuple
+    (part of the jit cache key); the scale value itself is traced."""
+    thyper = rule.thyper
+
+    def fused(w_list, g_list, s_list, lw_list, rescale, gstate, ext_sq):
+        FUSED_STATS["traces"] += 1  # trace-time only: counts real recompiles
+        scale, streak, t_good = gstate
+        # ONE fused reduction serves flag AND norm: the sum of squares is
+        # finite iff every grad element is (an f32 overflow of the sum also
+        # trips it — a grad norm beyond f32 range is a skip-worthy step).
+        # ext_sq carries the eager-bound items' contribution (a device
+        # scalar, no sync), so both the flag and the reported norm are
+        # global across a mixed fused+eager batch.
+        sq = jnp.float32(0.0) + ext_sq
+        for g in g_list:
+            sq = sq + jnp.sum(jnp.square(g.astype(jnp.float32)))
+        ok = jnp.isfinite(sq)
+        inv = rescale / scale  # loss-scale unscaling folded into rescale
+        grad_norm = jnp.sqrt(sq) * inv
+        t_eff = (t_good + 1).astype(jnp.float32)
+        new_w, new_s = [], []
+        for w, g, s, lw, mp, odt in zip(w_list, g_list, s_list, lw_list,
+                                        mp_flags, out_dtypes):
+            lr, wd = lw
+            h = thyper(static, lr, wd, t_eff)
+            if mp:
+                master, base = s
+                nm, nb = rule.step(master, g.astype(jnp.float32), base, h,
+                                   inv, static)
+                nm = jnp.where(ok, nm, master)
+                nb = _tree_where(ok, nb, base)
+                new_w.append(nm.astype(odt))
+                new_s.append((nm, nb))
+            else:
+                nw, ns = rule.step(w, g, s, h, inv, static)
+                new_w.append(jnp.where(ok, nw, w))
+                new_s.append(_tree_where(ok, ns, s))
+        new_t = jnp.where(ok, t_good + 1, t_good)
+        if scaler_cfg is not None:
+            gf, bf, gi, max_s, min_s = scaler_cfg
+            streak2 = jnp.where(ok, streak + 1, 0)
+            grow = streak2 >= gi
+            new_scale = jnp.where(ok, jnp.where(grow, scale * gf, scale),
+                                  scale * bf)
+            new_scale = jnp.clip(new_scale, min_s, max_s)
+            new_streak = jnp.where(ok & grow, 0, streak2)
+        else:
+            new_scale, new_streak = scale, streak
+        return new_w, new_s, (new_scale, new_streak, new_t), ok, grad_norm
+
+    # gstate is NOT donated: the scale scalar is aliased by user code
+    # (DynamicLossScaler.scale multiplies the loss by it) and by the
+    # no-scaler cached constant — donating would delete a live buffer
+    return jax.jit(fused, donate_argnums=(0, 2))
+
+
 class FusedUpdater(Updater):
     """Updater whose ``update_batch`` compiles the whole optimizer step into
     one donated jit (the update-path CachedOp). ``__call__`` keeps the
@@ -456,9 +587,38 @@ class FusedUpdater(Updater):
     # and may flip mid-process, so buffers must stay safe to donate
     donates = True
 
+    def __init__(self, optimizer):
+        super().__init__(optimizer)
+        # resilience surface (mxtpu/resilience.py): attach a
+        # DynamicLossScaler (Trainer(loss_scaler=...)) and/or set
+        # MXTPU_NUMERICS_GUARD=1 to run every step under the in-jit
+        # sentinel. last_step_ok / last_grad_norm are DEVICE scalars from
+        # the latest guarded step, fetched asynchronously by callers.
+        self.scaler = None
+        self.health = resilience.StepHealth()
+        self.last_step_ok = None
+        self.last_grad_norm = None
+        self._t_good = None     # device good-step count (guarded mode)
+        self._noscaler_state = None  # cached (1.0, 0) scalars, never donated
+        self._step_count = 0    # dispatched update_batch calls (fault index)
+
+    def _guard_active(self):
+        return self.scaler is not None or resilience.guard_enabled()
+
     def update_batch(self, indices, grads, weights):
+        if not indices:
+            return  # no-op like the base Updater, guarded or not
         opt = self.optimizer
+        step_idx = self._step_count
+        self._step_count += 1
+        if grads and resilience.inject("nan_grad", step_idx):
+            # poison ONE gradient buffer — pure data, no retrace, and it
+            # flows through the exact production sentinel path
+            grads[0]._set_data(grads[0]._data * float("nan"))
+        guarded = self._guard_active()
         rule = _RULES.get(type(opt)) if fused_enabled() else None
+        if guarded and rule is not None and rule.thyper is None:
+            rule = None  # Nadam: t-hyper can't move in-graph -> guarded-eager
         from .ndarray.sparse import RowSparseNDArray
         fused, eager = [], []
         for i, g, w in zip(indices, grads, weights):
@@ -472,6 +632,10 @@ class FusedUpdater(Updater):
         if fused:
             fused, aliased = _split_aliased(fused, self.states, eager)
             eager.extend(aliased)
+        if guarded:
+            self._guarded_step(rule, fused, eager, step_idx)
+            return
+        self.last_step_ok = None  # unguarded steps report no verdict
         if fused and eager and isinstance(opt, Nadam):
             # Nadam's m_schedule is ORDER-dependent host state (one multiply
             # per param update): a mixed batch must keep the exact eager
@@ -483,18 +647,16 @@ class FusedUpdater(Updater):
             opt.update_multi_precision(i, w, g, self.states[i])
             FUSED_STATS["eager_updates"] += 1
 
-    def _fused_apply(self, rule, items):
+    def _gather_items(self, items, hyper_of):
+        """Per-item device buffers + the jit cache-key specs, ONE copy
+        shared by the plain and guarded fused paths — a spec change must
+        not silently fork the two cache-key semantics. ``hyper_of(i)``
+        builds the traced per-param hyper tuple."""
         opt = self.optimizer
-        # bump every count first so _get_lr sees the post-step num_update for
-        # ALL params (the eager loop's first update already bumps it before
-        # any lr is read)
-        for i, _, _ in items:
-            opt._update_count(i)
         w_datas, g_datas, s_datas, hypers = [], [], [], []
         mp_flags, out_dtypes, specs = [], [], []
         for i, g, w in items:
-            t = opt._index_update_count[i]
-            hypers.append(tuple(float(h) for h in rule.hyper(opt, i, t)))
+            hypers.append(hyper_of(i))
             mp = bool(opt.multi_precision
                       and w.dtype in (jnp.float16, jnp.bfloat16))
             sd = _tree_data(self.states[i])
@@ -505,16 +667,192 @@ class FusedUpdater(Updater):
             out_dtypes.append(w._data.dtype)
             specs.append((tuple(w.shape), str(w.dtype), str(g.dtype),
                           _tree_spec(sd), mp))
-        static = rule.static(opt)
-        key = (type(opt).__name__, static, tuple(specs))
+        return (w_datas, g_datas, s_datas, hypers, tuple(mp_flags),
+                tuple(out_dtypes), tuple(specs))
+
+    @staticmethod
+    def _cached_jit(key, build):
         fn = _JIT_CACHE.get(key)
         if fn is None:
-            fn = _build(rule, static, tuple(mp_flags), tuple(out_dtypes))
+            fn = build()
             _JIT_CACHE[key] = fn
             FUSED_STATS["compiles"] += 1
+        return fn
+
+    def _fused_apply(self, rule, items):
+        opt = self.optimizer
+        # bump every count first so _get_lr sees the post-step num_update for
+        # ALL params (the eager loop's first update already bumps it before
+        # any lr is read)
+        for i, _, _ in items:
+            opt._update_count(i)
+
+        def hyper_of(i):
+            t = opt._index_update_count[i]
+            return tuple(float(h) for h in rule.hyper(opt, i, t))
+
+        (w_datas, g_datas, s_datas, hypers, mp_flags, out_dtypes,
+         specs) = self._gather_items(items, hyper_of)
+        static = rule.static(opt)
+        key = (type(opt).__name__, static, specs)
+        fn = self._cached_jit(
+            key, lambda: _build(rule, static, mp_flags, out_dtypes))
         new_w, new_s = fn(w_datas, g_datas, s_datas, hypers,
                           float(opt.rescale_grad))
         FUSED_STATS["fused_steps"] += 1
         for (i, _, w), nw, ns in zip(items, new_w, new_s):
             w._set_data(nw)
             _tree_writeback(self.states[i], ns)
+
+    # ------------------------------------------------------- guarded stepping
+    def _guard_state(self):
+        """(scale, streak, t_good) device scalars threaded through the
+        guarded jit. Without a scaler the (1.0, 0) pair is cached — these
+        inputs are never donated, so reuse is safe."""
+        if self._t_good is None:
+            # warm start (guard enabled mid-run, or an unguarded checkpoint
+            # resumed with the guard on): seed from the host update clock so
+            # Adam-family bias correction continues at t=N+1 instead of
+            # restarting at 1
+            self._t_good = jnp.asarray(
+                int(getattr(self.optimizer, "num_update", 0)), jnp.int32)
+        if self.scaler is not None:
+            self.scaler._ensure()
+            return (self.scaler._scale, self.scaler._streak, self._t_good)
+        if self._noscaler_state is None:
+            self._noscaler_state = (jnp.float32(1.0), jnp.int32(0))
+        return self._noscaler_state + (self._t_good,)
+
+    def _guarded_step(self, rule, fused, eager, step_idx):
+        """One sentinel-guarded optimizer step over a fused+eager split.
+
+        The pure-fused hot path (every param fused — the common case) runs
+        with ZERO host syncs: flag, norm, skip select, t bump, and scaler
+        update all live inside the donated jit, and the step_ok scalar is
+        only fetched when a caller asks. Eager-bound items (sparse grads,
+        tied buffers, Nadam/SGLD-class optimizers) cost ONE host sync to
+        keep the skip decision global across both halves of the batch."""
+        opt = self.optimizer
+        scaler = self.scaler
+        gstate = self._guard_state()
+        scale_used = gstate[0]
+        scfg = scaler.config() if scaler is not None else None
+        sq_e = jnp.float32(0.0)
+        for _, g, _ in eager:
+            sq_e = sq_e + jnp.sum(jnp.square(g._data.astype(jnp.float32)))
+        if fused:
+            # eager items' sum-of-squares rides INTO the jit (async): the
+            # global flag/norm need no extra sync here — the one mixed-batch
+            # sync is the ok fetch below that gates the eager updates
+            ok, grad_norm = self._guarded_fused_apply(rule, fused, gstate,
+                                                      scfg, sq_e)
+        else:
+            # all-eager guarded step: the flag must reach the host anyway
+            # (it gates the eager updates); bookkeeping mirrors the in-jit
+            # rule, device math stays async
+            ok = bool(jnp.isfinite(sq_e))  # the documented eager sync
+            grad_norm = jnp.sqrt(sq_e) * (
+                jnp.float32(float(opt.rescale_grad)) / scale_used)
+            if scaler is not None:
+                scaler.host_update(ok)
+            if ok:
+                self._t_good = self._t_good + 1
+        self.last_step_ok = ok
+        self.last_grad_norm = grad_norm
+        self.health.append(step_idx, ok, grad_norm)
+        if eager:
+            ok_all = bool(ok) if fused else ok  # mixed batches sync once
+            if ok_all:
+                saved = opt.rescale_grad
+                try:
+                    if scaler is not None:
+                        # eager kernels know nothing of the loss scale:
+                        # fold the unscale into rescale_grad for this step
+                        opt.rescale_grad = saved / float(scale_used)
+                    for i, g, w in eager:
+                        opt.update_multi_precision(i, w, g, self.states[i])
+                        FUSED_STATS["eager_updates"] += 1
+                finally:
+                    opt.rescale_grad = saved
+            # skipped: eager per-index update counts stay untouched too
+            # (the count bumps inside Optimizer.update, which never ran)
+
+    def _guarded_fused_apply(self, rule, items, gstate, scfg, ext_sq):
+        opt = self.optimizer
+        # host update-count still ticks per DISPATCHED step: it is the lr
+        # SCHEDULE clock (and matches how schedules treat skipped steps
+        # elsewhere); the bias-correction t is the device t_good, which
+        # only good steps advance
+        for i, _, _ in items:
+            opt._update_count(i)
+        (w_datas, g_datas, s_datas, hypers, mp_flags, out_dtypes,
+         specs) = self._gather_items(
+            items, lambda i: (float(opt._get_lr(i)), float(opt._get_wd(i))))
+        static = rule.static(opt)
+        # the guard bit + scaler policy ride the cache key: guard on/off is
+        # exactly one extra compile, flag/scale flips are zero
+        key = (type(opt).__name__, static, specs, "guard", scfg)
+        fn = self._cached_jit(
+            key, lambda: _build_guarded(rule, static, mp_flags, out_dtypes,
+                                        scfg))
+        new_w, new_s, new_gstate, ok, grad_norm = fn(
+            w_datas, g_datas, s_datas, hypers, float(opt.rescale_grad),
+            gstate, ext_sq)
+        FUSED_STATS["fused_steps"] += 1
+        for (i, _, w), nw, ns in zip(items, new_w, new_s):
+            w._set_data(nw)
+            _tree_writeback(self.states[i], ns)
+        new_scale, new_streak, self._t_good = new_gstate
+        if self.scaler is not None:
+            self.scaler._scale = new_scale
+            self.scaler._streak = new_streak
+        return ok, grad_norm
+
+    # ----------------------------------------------------------- serialization
+    # Loss-scaler + guard scalars ride the optimizer-state blob so
+    # Trainer.save_states / contrib.async_checkpoint.save_trainer resume
+    # bit-exact. Plain (unguarded) updaters keep the base format.
+    _RESILIENCE_TAG = "__mxtpu_resilience_v1__"
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        import numpy as np
+        base = super().get_states(dump_optimizer)
+        if self.scaler is None and self._t_good is None:
+            return base
+        payload = {
+            "base": base,
+            "t_good": None if self._t_good is None
+            else np.asarray(self._t_good),
+            "scaler": None if self.scaler is None
+            else self.scaler.state_dict(),
+        }
+        return pickle.dumps((self._RESILIENCE_TAG, payload))
+
+    def set_states(self, states):
+        import pickle
+        obj = pickle.loads(states)
+        if not (isinstance(obj, tuple) and len(obj) == 2
+                and obj[0] == self._RESILIENCE_TAG):
+            super().set_states(states)
+            return
+        payload = obj[1]
+        if payload["t_good"] is not None:
+            self._t_good = jnp.asarray(payload["t_good"])
+        sc = payload["scaler"]
+        if sc is not None:
+            if self.scaler is None:
+                # do NOT auto-attach: the guarded jit would divide grads by
+                # the restored scale while nothing scales the loss — a
+                # silent stall. The user must pass the scaler explicitly
+                # (Trainer(loss_scaler=...)) so their loop scales too.
+                import logging
+                logging.getLogger("mxtpu.resilience").warning(
+                    "checkpoint carries DynamicLossScaler state (scale=%s) "
+                    "but no loss scaler is attached — continuing UNSCALED; "
+                    "pass loss_scaler= when building the Trainer to resume "
+                    "scaled training", float(sc["scale"]))
+            else:
+                self.scaler.load_state_dict(sc)
+        super().set_states(payload["base"])

@@ -29,7 +29,8 @@ import threading
 import jax
 import jax.numpy as jnp
 
-__all__ = ["seed", "next_key", "push_key_supply", "pop_key_supply"]
+__all__ = ["seed", "next_key", "push_key_supply", "pop_key_supply",
+           "get_key_data", "set_key_data"]
 
 
 class _RngState(threading.local):
@@ -75,6 +76,21 @@ def next_key():
         return _STATE.supply[-1].next()
     _STATE.key, sub = jax.random.split(_STATE.base_key())
     return sub
+
+
+def get_key_data():
+    """Host snapshot of the global PRNG key (the checkpointable RNG state —
+    resilience.ResilientLoop serializes this for bit-exact resume)."""
+    import numpy as np
+    return np.asarray(jax.random.key_data(_STATE.base_key()))
+
+
+def set_key_data(data):
+    """Restore the global PRNG key from :func:`get_key_data` output. Clears
+    any active key supplies (a restore mid-trace would be a bug anyway)."""
+    _STATE.key = jax.random.wrap_key_data(
+        jnp.asarray(data, dtype=jnp.uint32))
+    _STATE.supply = []
 
 
 def push_key_supply(base_key) -> _KeySupply:

@@ -1,0 +1,562 @@
+"""Resilient training runtime: numerics sentinel, loss scaling, preemption.
+
+The reference's async engine propagates operator errors lazily
+(src/engine/threaded_engine.cc) and has no story for non-finite gradients,
+preempted hosts, or flaky IO — acceptable for single-job GPU training,
+fatal on production TPU fleets where preemption and bf16 overflow are
+routine, not exceptional. This module is the guardrail layer woven through
+the existing hot path (not bolted on top of it):
+
+* **In-jit numerics sentinel** — the fused optimizer step
+  (:mod:`mxtpu.optimizer_fused`) computes ONE fused all-params finite flag
+  plus the global gradient norm *inside* its donated jit and applies the
+  update under ``jnp.where``: a non-finite step is a no-op on params and
+  optimizer state (including the bias-correction step count ``t`` and
+  momentum), with zero extra host syncs in the hot loop — the per-step
+  outcome is a device ``step_ok`` scalar fetched asynchronously (the
+  weight-update-sharding insight of arXiv:2004.13336, PAPERS.md: per-step
+  bookkeeping belongs INSIDE the compiled program). Enable with
+  ``MXTPU_NUMERICS_GUARD=1`` or by attaching a :class:`DynamicLossScaler`.
+* **Dynamic loss scaling** — :class:`DynamicLossScaler` state (scale,
+  good-step streak) is carried as traced device scalars through the same
+  jit, so growth/backoff never recompiles and never syncs.
+* **Preemption-safe checkpointing** — :class:`ResilientLoop` +
+  :class:`CheckpointPolicy` drive SIGTERM/interval-triggered async orbax
+  saves (``contrib/async_checkpoint.save_trainer``) with atomic
+  latest-step bookkeeping, bounded retry-with-backoff on transient IO
+  errors, and bit-exact resume of params + optimizer + loss-scaler + RNG.
+* **Deterministic fault injection** — ``MXTPU_FAULT_INJECT`` +
+  :func:`inject` hooks make every degradation path above testable on CPU
+  in tier-1 (NaN grads, checkpoint IO failures, SIGTERM mid-step, dead
+  dataloader workers, transient collective failures).
+
+See ``docs/resilience.md`` for the fault -> detection -> action matrix.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import signal
+import time
+
+from .base import MXNetError
+
+__all__ = ["guard_enabled", "default_loss_scale", "ckpt_retries",
+           "DynamicLossScaler", "StepHealth", "CheckpointPolicy",
+           "ResilientLoop", "inject", "reset_faults", "with_retries",
+           "FAULT_STATS"]
+
+_log = logging.getLogger("mxtpu.resilience")
+
+
+# ------------------------------------------------------------------ policies
+def guard_enabled():
+    """MXTPU_NUMERICS_GUARD=1 turns the in-jit sentinel on without a loss
+    scaler (read per step so it can be flipped mid-process for A/Bs; the
+    flip recompiles the update jit exactly once — it is part of the jit
+    cache key and of ``registry.policy_key``)."""
+    return os.environ.get("MXTPU_NUMERICS_GUARD", "0") == "1"
+
+
+def default_loss_scale():
+    """Initial loss scale (MXTPU_LOSS_SCALE, default 2**15 — the standard
+    bf16/f16 AMP starting point)."""
+    return float(os.environ.get("MXTPU_LOSS_SCALE", str(2.0 ** 15)))
+
+
+def ckpt_retries():
+    """Transient-IO retry budget for checkpoint writes (MXTPU_CKPT_RETRIES,
+    default 3)."""
+    return int(os.environ.get("MXTPU_CKPT_RETRIES", "3"))
+
+
+# ----------------------------------------------------------- fault injection
+# fired: [(kind, index), ...] in firing order — tests assert the schedule
+FAULT_STATS = {"fired": []}
+_FAULT_CACHE = {"spec": None, "faults": {}}
+_FAULT_COUNTERS = {}
+
+
+def _parse_faults(spec):
+    """``kind@i,j;kind2@k`` -> {kind: {i, j}, kind2: {k}}. Kinds in use:
+    ``nan_grad`` (optimizer-step index), ``ckpt_io`` (save-attempt index),
+    ``sigterm`` (loop step index), ``worker_death`` (dataloader batch
+    index), ``kv_fail`` (dist-reduce attempt index)."""
+    faults = {}
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "@" not in part:
+            raise MXNetError(
+                "MXTPU_FAULT_INJECT entry %r: expected kind@idx[,idx...]"
+                % part)
+        kind, idxs = part.split("@", 1)
+        try:
+            where = {int(s) for s in idxs.split(",") if s.strip()}
+        except ValueError:
+            raise MXNetError(
+                "MXTPU_FAULT_INJECT entry %r: indices must be ints" % part)
+        faults.setdefault(kind.strip(), set()).update(where)
+    return faults
+
+
+def inject(kind, index=None):
+    """Deterministic fault-injection point: True exactly ONCE per
+    (kind, index) named in ``MXTPU_FAULT_INJECT``. Call sites pass their
+    natural index (step / batch / attempt); with ``index=None`` an internal
+    per-kind call counter supplies it. Consuming semantics (each scheduled
+    fault fires once) keep retry loops convergent by construction."""
+    spec = os.environ.get("MXTPU_FAULT_INJECT", "")
+    if spec != _FAULT_CACHE["spec"]:
+        _FAULT_CACHE["spec"] = spec
+        _FAULT_CACHE["faults"] = _parse_faults(spec) if spec else {}
+        _FAULT_COUNTERS.clear()
+    faults = _FAULT_CACHE["faults"]
+    if index is None:
+        index = _FAULT_COUNTERS.get(kind, 0)
+        _FAULT_COUNTERS[kind] = index + 1
+    where = faults.get(kind)
+    if not where or index not in where:
+        return False
+    where.discard(index)
+    FAULT_STATS["fired"].append((kind, index))
+    _log.warning("fault injected: %s@%d", kind, index)
+    return True
+
+
+def reset_faults():
+    """Test hook: forget consumed faults and counters."""
+    _FAULT_CACHE["spec"] = None
+    _FAULT_CACHE["faults"] = {}
+    _FAULT_COUNTERS.clear()
+    FAULT_STATS["fired"] = []
+
+
+# ------------------------------------------------------------------- retries
+def with_retries(fn, what, retries=None, backoff=0.25, logger=None,
+                 exceptions=(Exception,)):
+    """Run ``fn`` with bounded retry-with-backoff on transient failures.
+
+    Used by the checkpoint driver and the kvstore's DCN reduce. Retries
+    ``retries`` times (default :func:`ckpt_retries`) with exponential
+    backoff starting at ``backoff`` seconds; the last failure re-raises so
+    hard errors stay loud."""
+    retries = ckpt_retries() if retries is None else int(retries)
+    retries = max(0, retries)  # a negative budget must still run fn once
+    delay = backoff
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except exceptions as e:
+            if attempt == retries:
+                raise
+            (logger or _log).warning(
+                "%s failed (%s: %s); retry %d/%d in %.2fs", what,
+                type(e).__name__, e, attempt + 1, retries, delay)
+            time.sleep(delay)
+            delay *= 2
+
+
+# --------------------------------------------------------------- loss scaler
+class DynamicLossScaler:
+    """Dynamic bf16/f16 loss scaling driven by the in-jit sentinel.
+
+    The scale and the good-step streak live as DEVICE scalars and are
+    updated inside the fused optimizer jit: on a non-finite step the scale
+    backs off by ``backoff_factor``; after ``growth_interval`` consecutive
+    good steps it grows by ``growth_factor`` (clamped to
+    [min_scale, max_scale]). No host syncs, and a schedule change never
+    recompiles — only the STATIC config tuple below is baked into the jit.
+
+    Usage with the gluon Trainer::
+
+        scaler = resilience.DynamicLossScaler()
+        trainer = gluon.Trainer(params, "sgd", {...}, loss_scaler=scaler)
+        with autograd.record():
+            loss = scaler.scale(loss_fn(net(x), y))
+        loss.backward()          # grads come out scale-times too large
+        trainer.step(batch)      # unscaled + guarded inside the fused jit
+
+    State is serialized with the optimizer state (Trainer.save_states /
+    contrib.async_checkpoint.save_trainer), so resume is bit-exact.
+    """
+
+    def __init__(self, init_scale=None, growth_factor=2.0,
+                 backoff_factor=0.5, growth_interval=2000,
+                 max_scale=2.0 ** 24, min_scale=1.0):
+        self._init = (default_loss_scale() if init_scale is None
+                      else float(init_scale))
+        self.growth_factor = float(growth_factor)
+        self.backoff_factor = float(backoff_factor)
+        self.growth_interval = int(growth_interval)
+        self.max_scale = float(max_scale)
+        self.min_scale = float(min_scale)
+        # lazy device scalars: materializing them would initialize the XLA
+        # backend at construction time (random.py has the same constraint)
+        self._scale = None
+        self._streak = None
+
+    def config(self):
+        """The STATIC policy tuple baked into the guarded jit (part of its
+        cache key — changing the schedule recompiles once; the scale value
+        itself is traced and never does)."""
+        return (self.growth_factor, self.backoff_factor,
+                self.growth_interval, self.max_scale, self.min_scale)
+
+    def _ensure(self):
+        if self._scale is None:
+            import jax.numpy as jnp
+            self._scale = jnp.float32(self._init)
+            self._streak = jnp.int32(0)
+
+    def scale_array(self):
+        """The live scale as a device scalar (async — no host sync)."""
+        self._ensure()
+        return self._scale
+
+    def scale_value(self):
+        """The live scale as a python float (SYNCS — debugging/tests)."""
+        return float(self.scale_array())
+
+    def scale(self, loss):
+        """``loss * scale`` (an async device multiply; record()-taped, so
+        gradients come out scale-times larger and the guarded updater
+        divides the scale back out in-jit). The multiply stays in the
+        scale's f32 — casting the scale into a float16 loss would overflow
+        to inf past 2**16 — so the scaled loss promotes to float32 (exact,
+        and .backward() is dtype-agnostic)."""
+        from .ndarray import NDArray
+        self._ensure()
+        return loss * NDArray(self._scale)
+
+    def host_update(self, ok):
+        """Eager-path bookkeeping (sparse/unfusable optimizers): the same
+        growth/backoff rule, driven by a host bool. Device arithmetic stays
+        async."""
+        import jax.numpy as jnp
+        self._ensure()
+        if ok:
+            self._streak = self._streak + 1
+            grown = jnp.clip(self._scale * self.growth_factor,
+                             self.min_scale, self.max_scale)
+            do_grow = self._streak >= self.growth_interval
+            self._scale = jnp.where(do_grow, grown, self._scale)
+            self._streak = jnp.where(do_grow, 0, self._streak)
+        else:
+            self._scale = jnp.clip(self._scale * self.backoff_factor,
+                                   self.min_scale, self.max_scale)
+            self._streak = jnp.int32(0)
+
+    # ------------------------------------------------------------- serialize
+    def state_dict(self):
+        import numpy as np
+        self._ensure()
+        return {"scale": np.asarray(self._scale),
+                "streak": np.asarray(self._streak),
+                "config": (self._init,) + self.config()}
+
+    def load_state_dict(self, state):
+        import jax.numpy as jnp
+        self._scale = jnp.float32(float(state["scale"]))
+        self._streak = jnp.int32(int(state["streak"]))
+
+    @classmethod
+    def from_state_dict(cls, state):
+        init, gf, bf, gi, mx, mn = state["config"]
+        scaler = cls(init_scale=init, growth_factor=gf, backoff_factor=bf,
+                     growth_interval=gi, max_scale=mx, min_scale=mn)
+        scaler.load_state_dict(state)
+        return scaler
+
+
+# ------------------------------------------------------------------- health
+class StepHealth:
+    """Ring buffer of per-step (step, step_ok, grad_norm) DEVICE scalars.
+
+    The guarded updater appends the not-yet-materialized jit outputs here;
+    nothing syncs until a reader asks (``ok_history``/``drain``), keeping
+    the hot loop transfer-free while still giving monitors and tests the
+    full skip history."""
+
+    def __init__(self, maxlen=4096):
+        self._buf = collections.deque(maxlen=maxlen)
+
+    def append(self, step, ok, grad_norm):
+        self._buf.append((step, ok, grad_norm))
+
+    def __len__(self):
+        return len(self._buf)
+
+    def steps(self):
+        return [s for s, _, _ in self._buf]
+
+    @staticmethod
+    def _fetch(values):
+        # ONE batched device_get instead of a blocking round trip per
+        # scalar — a flush over hundreds of buffered steps costs one stall
+        import jax
+        return jax.device_get(list(values))
+
+    def ok_history(self):
+        """Materialize the step_ok flags (SYNCS once — call off the hot
+        path)."""
+        return [bool(ok) for ok in self._fetch(o for _, o, _ in self._buf)]
+
+    def grad_norm_history(self):
+        return [float(g) for g in self._fetch(g for _, _, g in self._buf)]
+
+    def drain(self):
+        """Pop and materialize everything buffered: [(step, ok, gnorm)] —
+        one batched fetch, not one sync per step."""
+        steps = [s for s, _, _ in self._buf]
+        fetched = self._fetch((o, g) for _, o, g in self._buf)
+        self._buf.clear()
+        return [(s, bool(o), float(g))
+                for s, (o, g) in zip(steps, fetched)]
+
+    def clear(self):
+        self._buf.clear()
+
+
+# -------------------------------------------------------------- checkpoints
+class CheckpointPolicy:
+    """When and how :class:`ResilientLoop` checkpoints.
+
+    ``every_steps``/``every_secs`` trigger interval saves (either may be
+    None); ``retries``/``backoff`` bound the retry-with-backoff on
+    transient IO errors (default MXTPU_CKPT_RETRIES); ``async_save`` uses
+    the shared orbax AsyncCheckpointer so training continues while the
+    write completes."""
+
+    def __init__(self, directory, every_steps=None, every_secs=None,
+                 async_save=True, retries=None, backoff=0.25):
+        self.directory = str(directory)
+        self.every_steps = every_steps
+        self.every_secs = every_secs
+        self.async_save = bool(async_save)
+        self.retries = retries
+        self.backoff = float(backoff)
+
+    def due(self, step, last_save_step, last_save_time):
+        if self.every_steps and step - last_save_step >= self.every_steps:
+            return True
+        if self.every_secs and \
+                time.monotonic() - last_save_time >= self.every_secs:
+            return True
+        return False
+
+
+class ResilientLoop:
+    """Preemption-safe training driver around a gluon Trainer.
+
+    Installs SIGTERM handling (flag set in the handler, acted on at the
+    next step boundary: final async checkpoint, then a clean stop),
+    interval-triggered async checkpoints with bounded retry, atomic
+    latest-step bookkeeping (``latest.json`` written tmp+rename and
+    VALIDATED on read — an async save that never finalized falls back to
+    the newest finalized step directory), and bit-exact resume of
+    params + optimizer + loss-scaler + RNG state::
+
+        loop = resilience.ResilientLoop(trainer, CheckpointPolicy(
+            "/ckpt/run1", every_steps=100))
+        start = loop.resume()            # 0 on a fresh directory
+        loop.run(step_fn, num_steps, start_step=start)
+        if loop.preempted: ...           # stopped on SIGTERM, ckpt written
+    """
+
+    def __init__(self, trainer, policy, signals=(signal.SIGTERM,),
+                 logger=None):
+        self._trainer = trainer
+        self._policy = policy
+        self._signals = tuple(signals)
+        self._log = logger or _log
+        self._prev_handlers = {}
+        self._installed = False
+        self.preempted = False
+        self.last_saved_step = None
+        self._last_save_step = -1
+        self._last_save_time = time.monotonic()
+        self._last_ckptr = None
+        self._step = 0
+
+    # ---------------------------------------------------------------- signals
+    def install(self):
+        """Install signal handlers (idempotent; main thread only — off the
+        main thread python refuses handlers, so this degrades to manual
+        ``loop.preempted = True``)."""
+        if self._installed:
+            return self
+        try:
+            for sig in self._signals:
+                self._prev_handlers[sig] = signal.signal(sig, self._on_signal)
+            self._installed = True
+        except ValueError:  # not the main thread
+            self._log.warning(
+                "ResilientLoop: cannot install signal handlers off the main "
+                "thread; set loop.preempted=True manually to request a stop")
+        return self
+
+    def uninstall(self):
+        for sig, prev in self._prev_handlers.items():
+            signal.signal(sig, prev)
+        self._prev_handlers = {}
+        self._installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    def _on_signal(self, signum, frame):
+        # handler does the MINIMUM (no IO, no jax): the step boundary acts
+        self.preempted = True
+
+    # ---------------------------------------------------------------- saving
+    def save(self, step, final=False):
+        """Checkpoint now, with bounded retry-with-backoff. Interval saves
+        degrade gracefully (log + keep training) when every retry fails;
+        ``final=True`` (the preemption save) blocks until the write is
+        durable and re-raises on total failure."""
+        from .contrib import async_checkpoint as ackpt
+
+        def _save():
+            ck = ackpt.save_trainer(
+                self._trainer, self._policy.directory, step=step,
+                async_save=self._policy.async_save and not final, force=True)
+            if final and hasattr(ck, "wait_until_finished"):
+                ck.wait_until_finished()
+            return ck
+
+        try:
+            self._last_ckptr = with_retries(
+                _save, "checkpoint save (step %d)" % step,
+                retries=(ckpt_retries() if self._policy.retries
+                         is None else self._policy.retries),
+                backoff=self._policy.backoff, logger=self._log)
+        except Exception as e:
+            if final:
+                raise
+            self._log.error(
+                "checkpoint at step %d failed after retries (%s: %s); "
+                "training continues — the previous checkpoint stays latest "
+                "and the next attempt waits a full interval (a retry storm "
+                "on every step would stall training for the whole outage)",
+                step, type(e).__name__, e)
+            self._last_save_step = step
+            self._last_save_time = time.monotonic()
+            return False
+        self._write_latest(step)
+        self._last_save_step = step
+        self._last_save_time = time.monotonic()
+        self.last_saved_step = step
+        return True
+
+    def wait_for_pending(self):
+        """Block until the last async checkpoint write is durable (a
+        finalized step directory). Interval saves return before the write
+        completes; call this before shutdown or before trusting
+        :meth:`latest_step` in the same process."""
+        if self._last_ckptr is not None and \
+                hasattr(self._last_ckptr, "wait_until_finished"):
+            self._last_ckptr.wait_until_finished()
+
+    def _write_latest(self, step):
+        """Atomic latest-step pointer: a crash mid-write must never leave a
+        torn pointer. Local dirs use tmp + os.replace; URL-style dirs
+        (gs://, s3:// — the production checkpoint home) write the object
+        directly through epath, where a small-object PUT is itself atomic."""
+        payload = json.dumps({"step": int(step)})
+        directory = self._policy.directory
+        if "://" in directory:
+            from etils import epath
+            d = epath.Path(directory)
+            d.mkdir(parents=True, exist_ok=True)
+            (d / "latest.json").write_text(payload)
+            return
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, "latest.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def latest_step(self):
+        """Newest RESUMABLE step: latest.json if its step dir finalized
+        (async orbax materializes step dirs atomically, so existence ==
+        durable), else the newest finalized ``step_*`` directory. All
+        lookups go through epath so gs://-style directories resume too —
+        a preempted job rescheduled onto a fresh host has ONLY the bucket."""
+        from etils import epath
+        d = epath.Path(self._policy.directory)
+        try:
+            candidate = int(json.loads(
+                (d / "latest.json").read_text())["step"])
+        except Exception:  # missing, torn, or backend error: fall back
+            candidate = None
+        if candidate is not None and (d / ("step_%d" % candidate)).is_dir():
+            return candidate
+        steps = []
+        try:
+            for p in d.iterdir():
+                if p.name.startswith("step_") and p.is_dir():
+                    try:
+                        steps.append(int(p.name[5:]))
+                    except ValueError:
+                        pass
+        except Exception:
+            return None
+        return max(steps) if steps else None
+
+    def resume(self):
+        """Restore the newest checkpoint into the trainer (params +
+        optimizer + scaler + RNG, bit-exact) and return the step index to
+        continue FROM (0 on a fresh directory)."""
+        from .contrib import async_checkpoint as ackpt
+        step = self.latest_step()
+        if step is None:
+            return 0
+        ackpt.load_trainer(self._trainer, self._policy.directory, step=step)
+        self._step = step + 1
+        self._last_save_step = step
+        self._log.info("resumed from checkpoint step %d", step)
+        return step + 1
+
+    # --------------------------------------------------------------- driving
+    def after_step(self, step):
+        """Call once per completed optimizer step. Handles fault injection,
+        interval checkpoints, and the preemption save. Returns True when
+        the loop should stop (final checkpoint already written)."""
+        self._step = step + 1
+        if inject("sigterm", step):
+            os.kill(os.getpid(), signal.SIGTERM)  # handler runs immediately
+        if self.preempted:
+            self._log.warning(
+                "preemption signal received: writing final checkpoint at "
+                "step %d", step)
+            self.save(step, final=True)
+            return True
+        if self._policy.due(step, self._last_save_step,
+                            self._last_save_time):
+            self.save(step)
+        return False
+
+    def run(self, step_fn, num_steps, start_step=None):
+        """Drive ``step_fn(step)`` for ``range(start, num_steps)`` with
+        signal handlers installed; returns the last executed step index
+        (or start-1 when there was nothing to do)."""
+        start = self._step if start_step is None else int(start_step)
+        last = start - 1
+        with self:
+            for step in range(start, num_steps):
+                step_fn(step)
+                last = step
+                if self.after_step(step):
+                    break
+        return last

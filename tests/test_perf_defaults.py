@@ -15,15 +15,17 @@ def _clean_env(monkeypatch):
                 "MXTPU_FLASH_PAD_D", "MXTPU_CONV_IM2COL",
                 "MXTPU_RNN_HOIST", "BENCH_S2D_STEM", "BENCH_LAYOUT",
                 "MXTPU_FUSED_OPTIMIZER", "MXTPU_PALLAS_CONV",
-                "MXTPU_PALLAS_CONV_INTERPRET", "MXTPU_S2D_STEM"):
+                "MXTPU_PALLAS_CONV_INTERPRET", "MXTPU_S2D_STEM",
+                "MXTPU_NUMERICS_GUARD", "MXTPU_LOSS_SCALE",
+                "MXTPU_FAULT_INJECT", "MXTPU_CKPT_RETRIES"):
         monkeypatch.delenv(var, raising=False)
 
 
 def test_policy_key_defaults_are_the_measured_best():
     from mxtpu.ops.registry import policy_key
     # (conv_acc, bn_onepass, ring_flash, flash_pad_d, im2col, rnn_hoist,
-    #  pallas_conv, pallas_conv_interpret, s2d_stem)
-    assert policy_key() == ("0", "1", "0", "1", "0", "1", "0", "0", "0")
+    #  pallas_conv, pallas_conv_interpret, s2d_stem, numerics_guard)
+    assert policy_key() == ("0", "1", "0", "1", "0", "1", "0", "0", "0", "0")
 
 
 def test_read_sites_mirror_policy_key():
@@ -33,6 +35,7 @@ def test_read_sites_mirror_policy_key():
     from mxtpu.ops.nn import _bn_onepass
     from mxtpu.ops.pallas.conv import _interpret
     from mxtpu.ops.rnn_ops import _hoist_enabled
+    from mxtpu.resilience import guard_enabled
     assert _enabled() is False          # conv_acc: measured regression
     assert _bn_onepass() is True        # measured +7.8%
     assert _im2col_enabled() is False   # staged, awaiting on-chip A/B
@@ -40,6 +43,51 @@ def test_read_sites_mirror_policy_key():
     assert _pallas_enabled() is False   # staged: resnet_pallas battery
     assert _interpret() is False        # test-only interpreter path
     assert stem_mode() == 0             # plain stem until measured
+    # numerics sentinel OFF by default without a loss scaler: the guarded
+    # jit is a different executable, so the default must be a decision
+    # (guard_overhead bench tracks its <2% cost), not an accident
+    assert guard_enabled() is False
+
+
+def test_numerics_guard_and_loss_scale_defaults():
+    """The resilience levers' env defaults, pinned like every other lever:
+    guard off, initial loss scale 2**15, 3 checkpoint retries, no faults."""
+    import mxtpu.resilience as res
+    assert res.guard_enabled() is False
+    assert res.default_loss_scale() == 2.0 ** 15
+    assert res.ckpt_retries() == 3
+    assert res.DynamicLossScaler().config() == (2.0, 0.5, 2000, 2.0 ** 24,
+                                                1.0)
+
+
+def test_guard_overhead_bench_emits_the_benchline_schema(monkeypatch):
+    """bench.py's guard_overhead config must emit per-(config, guard) JSON
+    lines plus a summary in the standard schema — the artifact the <2%
+    sentinel-cost acceptance bound is read from."""
+    import json
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import bench
+    assert "guard_overhead" in bench.CONFIGS
+    monkeypatch.setenv("BENCH_GUARD_PARAMS", "4")
+    monkeypatch.setenv("BENCH_GUARD_PARAM_SIZE", "32")
+    monkeypatch.setenv("BENCH_GUARD_STEPS", "2")
+    monkeypatch.setenv("BENCH_GUARD_CONFIGS", "optimizer_step")
+    lines = []
+    rec = bench.bench_guard_overhead(
+        emit=lambda r: lines.append(bench._stamp(r)))
+    assert {"metric", "value", "unit", "vs_baseline", "mfu",
+            "hfu"} <= set(rec)
+    assert rec["metric"] == "guard_overhead"
+    assert rec["unit"] == "overhead_frac"
+    assert len(lines) == 2  # guard on + guard off for optimizer_step
+    for l in lines:
+        json.dumps(l)
+        assert l["guard"] in ("on", "off")
+        assert "platform" in l and "policy_key" in l
+        assert l["value"] > 0 and l["unit"] == "steps/sec"
+    # the A/B must restore the ambient defaults
+    assert os.environ.get("MXTPU_NUMERICS_GUARD") is None
 
 
 def test_fused_optimizer_is_the_measured_default():

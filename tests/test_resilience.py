@@ -1,0 +1,660 @@
+"""Resilient training runtime (mxtpu/resilience.py) — the fault-injection
+matrix of ISSUE 3:
+
+* injected-NaN steps SKIP (params + optimizer state + t bit-identical to
+  pre-step) and the dynamic loss scaler backs off then regrows;
+* step_ok history matches the injection schedule, fetched asynchronously
+  (a guarded hot loop runs under a device->host transfer-guard);
+* SIGTERM mid-train writes a final checkpoint and a fresh trainer resumes
+  bit-exact (params, optimizer state, loss scaler, RNG);
+* checkpoint IO failures retry with backoff then degrade gracefully;
+* a killed dataloader worker restarts and the epoch completes;
+* jit cache stability: guard on/off is ONE extra compile, flag flips are
+  ZERO (fused-update cache and CachedOp alike).
+"""
+import json
+import os
+import signal
+import sys
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import optimizer_fused as of
+from mxtpu import resilience
+from mxtpu.gluon.parameter import Parameter
+from mxtpu.gluon.trainer import Trainer
+
+sys.path.insert(0, os.path.dirname(__file__))  # _mp_light_datasets
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for var in ("MXTPU_NUMERICS_GUARD", "MXTPU_FAULT_INJECT",
+                "MXTPU_LOSS_SCALE", "MXTPU_CKPT_RETRIES",
+                "MXTPU_FUSED_OPTIMIZER", "MXTPU_DL_WORKER_RESTARTS"):
+        monkeypatch.delenv(var, raising=False)
+    resilience.reset_faults()
+    of.reset()
+    yield
+    resilience.reset_faults()
+    of.reset()
+
+
+def _make_trainer(n_params=3, shape=(5,), optimizer="sgd", opt_params=None,
+                  scaler=None, seed=0):
+    rng = np.random.RandomState(seed)
+    params = []
+    for j in range(n_params):
+        p = Parameter("rp%d" % j, shape=shape, dtype="float32")
+        p.initialize()
+        p.data()._set_data(mx.nd.array(
+            rng.uniform(-1, 1, shape).astype(np.float32))._data)
+        params.append(p)
+    opt_params = opt_params or {"learning_rate": 0.05, "momentum": 0.9}
+    tr = Trainer(params, optimizer, opt_params, kvstore=None,
+                 loss_scaler=scaler)
+    return tr, params, rng
+
+
+def _set_grads(params, rng, scale=1.0):
+    for p in params:
+        p.grad()[:] = mx.nd.array(
+            (rng.randn(*p.shape) * scale).astype(np.float32))
+
+
+def _snapshot(tr, params):
+    upd = tr._updaters[0]
+    weights = [p.data().asnumpy().copy() for p in params]
+    states = []
+    for i in sorted(upd.states):
+        s = upd.states[i]
+        states.append(of._tree_data(s))
+    flat = []
+
+    def leaves(x):
+        if x is None:
+            return
+        if isinstance(x, tuple):
+            for c in x:
+                leaves(c)
+        else:
+            flat.append(np.asarray(x).copy())
+    for s in states:
+        leaves(s)
+    return weights, flat
+
+
+# ------------------------------------------------------------ skip stepping
+def test_nan_step_skips_params_state_and_t(monkeypatch):
+    """An injected-NaN step is a NO-OP: params, momentum, and the device
+    bias-correction count t_good are bit-identical to pre-step."""
+    monkeypatch.setenv("MXTPU_NUMERICS_GUARD", "1")
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "nan_grad@1")
+    tr, params, rng = _make_trainer(optimizer="adam",
+                                    opt_params={"learning_rate": 0.05})
+    _set_grads(params, rng)
+    tr.step(1)
+    w_before, s_before = _snapshot(tr, params)
+    t_before = int(tr._updaters[0]._t_good)
+    _set_grads(params, rng)
+    ok = tr.step(1)  # the poisoned step
+    assert bool(ok.asnumpy()) is False
+    w_after, s_after = _snapshot(tr, params)
+    for a, b in zip(w_before, w_after):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(s_before, s_after):
+        np.testing.assert_array_equal(a, b)
+    assert int(tr._updaters[0]._t_good) == t_before
+    _set_grads(params, rng)
+    ok = tr.step(1)  # clean step moves again
+    assert bool(ok.asnumpy()) is True
+    w_next, _ = _snapshot(tr, params)
+    assert not np.array_equal(w_after[0], w_next[0])
+    assert int(tr._updaters[0]._t_good) == t_before + 1
+
+
+def test_step_ok_history_matches_injection_schedule(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "nan_grad@1,4")
+    scaler = resilience.DynamicLossScaler(init_scale=8.0, growth_interval=50)
+    tr, params, rng = _make_trainer(scaler=scaler)
+    verdicts = []
+    for _ in range(6):
+        _set_grads(params, rng)
+        verdicts.append(bool(tr.step(1).asnumpy()))
+    want = [True, False, True, True, False, True]
+    assert verdicts == want
+    # the async health buffer saw the same schedule
+    assert tr._updaters[0].health.ok_history() == want
+    assert resilience.FAULT_STATS["fired"] == [("nan_grad", 1),
+                                               ("nan_grad", 4)]
+
+
+def test_scaler_backs_off_then_regrows(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "nan_grad@2")
+    scaler = resilience.DynamicLossScaler(init_scale=16.0, growth_interval=3)
+    tr, params, rng = _make_trainer(scaler=scaler)
+    scales = []
+    for _ in range(9):
+        _set_grads(params, rng)
+        tr.step(1)
+        scales.append(scaler.scale_value())
+    # back off at the skip, regrow x2 after each 3-good-step streak
+    assert scales[:3] == [16.0, 16.0, 8.0]
+    assert scales[-1] >= 16.0  # regrown past the backoff
+    assert 8.0 in scales[3:]   # and it stayed down right after the skip
+
+
+def test_scaled_grads_unscale_exactly(monkeypatch):
+    """Power-of-two loss scaling is EXACT: a run with scale S applied to
+    the gradients must reproduce the unscaled run bit-for-bit."""
+    def run(scale):
+        scaler = resilience.DynamicLossScaler(
+            init_scale=scale, growth_interval=10 ** 6) if scale else None
+        if scale is None:
+            os.environ["MXTPU_NUMERICS_GUARD"] = "1"
+        tr, params, rng = _make_trainer(optimizer="adam",
+                                        opt_params={"learning_rate": 0.05},
+                                        scaler=scaler)
+        for _ in range(4):
+            _set_grads(params, rng, scale=1.0)
+            if scale:
+                for p in params:
+                    p.grad()[:] = p.grad() * scale
+            tr.step(1)
+        out = [p.data().asnumpy() for p in params]
+        os.environ.pop("MXTPU_NUMERICS_GUARD", None)
+        return out
+    base = run(None)
+    scaled = run(256.0)
+    for a, b in zip(base, scaled):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_guard_cache_stability_fused_and_cachedop(monkeypatch):
+    """Guard on/off = ONE extra compile of the update jit (and one CachedOp
+    retrace via policy_key); flag flips (finite vs non-finite grads) = ZERO
+    retraces anywhere."""
+    from mxtpu import gluon
+    from mxtpu.gluon import nn
+
+    tr, params, rng = _make_trainer()
+    _set_grads(params, rng)
+    tr.step(1)
+    assert of.FUSED_STATS["compiles"] == 1
+    monkeypatch.setenv("MXTPU_NUMERICS_GUARD", "1")
+    _set_grads(params, rng)
+    tr.step(1)
+    assert of.FUSED_STATS["compiles"] == 2  # exactly one more
+    traces = of.FUSED_STATS["traces"]
+    # flag flips: poison then clean — same executable both ways
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "nan_grad@2")
+    for _ in range(3):
+        _set_grads(params, rng)
+        tr.step(1)
+    assert of.FUSED_STATS["traces"] == traces
+    assert of.FUSED_STATS["compiles"] == 2
+
+    # CachedOp side: a guard flip is one new cache entry, steps are zero
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(2, 6).astype(np.float32))
+    net(x)
+    net.hybridize()
+    net(x)
+    n0 = len(net._cached_op._jits)
+    net(x)
+    assert len(net._cached_op._jits) == n0  # steady state
+    monkeypatch.setenv("MXTPU_NUMERICS_GUARD", "0")
+    net(x)
+    assert len(net._cached_op._jits) == n0 + 1  # policy flip: ONE retrace
+
+
+def test_guarded_hot_loop_has_no_host_sync(monkeypatch):
+    """The acceptance contract: sentinel+scaler add no per-step host sync.
+    After warmup, guarded Trainer.steps run under a device->host transfer
+    guard that hard-fails on any fetch."""
+    import jax
+    scaler = resilience.DynamicLossScaler(init_scale=4.0)
+    tr, params, rng = _make_trainer(optimizer="adam",
+                                    opt_params={"learning_rate": 0.01},
+                                    scaler=scaler)
+    _set_grads(params, rng)
+    tr.step(1)  # warmup + compile
+    with jax.transfer_guard_device_to_host("disallow"):
+        for _ in range(3):
+            _set_grads(params, rng)
+            ok = tr.step(1)
+            assert ok is not None  # verdict handed back, NOT fetched
+    assert tr._updaters[0].health.ok_history()[-3:] == [True] * 3
+
+
+def test_guard_enabled_on_warm_optimizer_continues_t(monkeypatch):
+    """Flipping the guard on after N unguarded steps must seed the device
+    bias-correction count from the host clock — Adam's effective lr would
+    otherwise transiently jump ~3x as if training restarted at t=1."""
+    tr, params, rng = _make_trainer(optimizer="adam",
+                                    opt_params={"learning_rate": 0.05})
+    for _ in range(3):
+        _set_grads(params, rng)
+        tr.step(1)
+    monkeypatch.setenv("MXTPU_NUMERICS_GUARD", "1")
+    _set_grads(params, rng)
+    tr.step(1)
+    assert int(tr._updaters[0]._t_good) == 4  # N+1, not 1
+
+
+def test_mixed_batch_grad_norm_is_global(monkeypatch):
+    """Eager-bound items (tied buffers here) must contribute to the
+    reported global grad norm, not just to the finite flag."""
+    monkeypatch.setenv("MXTPU_NUMERICS_GUARD", "1")
+    from mxtpu import optimizer as opt
+    upd = opt.get_updater(opt.SGD(learning_rate=0.1))
+    rng = np.random.RandomState(0)
+    tied = mx.nd.array(rng.randn(4).astype(np.float32))
+    ws = [tied, mx.nd.NDArray(tied._data),  # alias group -> eager
+          mx.nd.array(rng.randn(4).astype(np.float32))]  # fused
+    gs = [mx.nd.array(np.full(4, 100.0, np.float32)),  # huge eager grads
+          mx.nd.array(np.full(4, 100.0, np.float32)),
+          mx.nd.array(np.full(4, 0.01, np.float32))]   # tiny fused grad
+    upd.update_batch([0, 1, 2], gs, ws)
+    assert of.FUSED_STATS["fused_steps"] == 1  # really a mixed batch
+    got = float(upd.last_grad_norm)
+    want = float(np.sqrt(sum(float((g.asnumpy() ** 2).sum()) for g in gs)))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_guarded_multi_precision_skip_is_exact(monkeypatch):
+    """bf16 weights + f32 master copy under the guard: a skipped step
+    leaves BOTH the master and the bf16 storage bit-identical."""
+    monkeypatch.setenv("MXTPU_NUMERICS_GUARD", "1")
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "nan_grad@1")
+    from mxtpu import optimizer as opt
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9,
+                   multi_precision=True)
+    upd = opt.get_updater(o)
+    rng = np.random.RandomState(0)
+    ws = [mx.nd.array(rng.randn(6).astype(np.float32)).astype("bfloat16")
+          for _ in range(2)]
+
+    def step():
+        gs = [mx.nd.array(rng.randn(6).astype(np.float32))
+              .astype("bfloat16") for _ in range(2)]
+        upd.update_batch([0, 1], gs, ws)
+    step()
+    assert of.FUSED_STATS["fused_steps"] == 1  # the mp path really fused
+    w_before = [w.asnumpy().copy() for w in ws]
+    masters_before = [np.asarray(of._tree_data(upd.states[i])[0]).copy()
+                      for i in (0, 1)]
+    step()  # poisoned
+    assert bool(upd.last_step_ok) is False
+    for w, b in zip(ws, w_before):
+        np.testing.assert_array_equal(w.asnumpy(), b)
+    for i, m in zip((0, 1), masters_before):
+        np.testing.assert_array_equal(
+            np.asarray(of._tree_data(upd.states[i])[0]), m)
+
+
+def test_guarded_eager_optimizers_still_skip(monkeypatch):
+    """Optimizers without an in-graph t rule (Nadam) take the guarded-eager
+    path: one sync per step, but the skip/backoff semantics hold."""
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "nan_grad@1")
+    scaler = resilience.DynamicLossScaler(init_scale=8.0)
+    tr, params, rng = _make_trainer(optimizer="nadam",
+                                    opt_params={"learning_rate": 0.01},
+                                    scaler=scaler)
+    _set_grads(params, rng)
+    tr.step(1)
+    w_before = [p.data().asnumpy().copy() for p in params]
+    _set_grads(params, rng)
+    ok = tr.step(1)
+    assert bool(ok.asnumpy()) is False
+    for p, w in zip(params, w_before):
+        np.testing.assert_array_equal(p.data().asnumpy(), w)
+    assert scaler.scale_value() == 4.0
+    assert of.FUSED_STATS["fused_steps"] == 0  # really took the eager path
+
+
+def test_guarded_empty_update_batch_is_noop(monkeypatch):
+    """An empty batch no-ops under the guard exactly like the base
+    Updater — no crash, no recorded step."""
+    from mxtpu import optimizer as opt
+    monkeypatch.setenv("MXTPU_NUMERICS_GUARD", "1")
+    upd = opt.get_updater(opt.SGD(learning_rate=0.1))
+    upd.update_batch([], [], [])
+    assert upd.last_step_ok is None and len(upd.health) == 0
+
+
+def test_module_update_rides_the_sentinel(monkeypatch):
+    """module.Module.update drives the same guarded updater: a NaN step is
+    skipped, params untouched, and the async verdict lands on
+    module.last_step_ok."""
+    from mxtpu import symbol as sym
+    from mxtpu.io import DataBatch, DataDesc
+    from mxtpu.module import Module
+    monkeypatch.setenv("MXTPU_NUMERICS_GUARD", "1")
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "nan_grad@1")
+    data = sym.var("data")
+    net = sym.FullyConnected(data, sym.var("fc_weight"), sym.var("fc_bias"),
+                             num_hidden=4, name="fc")
+    net = sym.SoftmaxOutput(net, sym.var("softmax_label"), name="softmax")
+    mod = Module(net)
+    mod.bind(data_shapes=[DataDesc("data", (8, 6))],
+             label_shapes=[DataDesc("softmax_label", (8,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    rng = np.random.RandomState(0)
+    batch = DataBatch(data=[mx.nd.array(rng.randn(8, 6)
+                                        .astype(np.float32))],
+                      label=[mx.nd.array(rng.randint(0, 4, (8,))
+                                         .astype(np.float32))])
+
+    def one_step():
+        mod.forward(batch)
+        mod.backward()
+        mod.update()
+    one_step()
+    assert bool(mod.last_step_ok) is True
+    w_before = {n: mod._exec.arg_dict[n].asnumpy().copy()
+                for n in mod._param_names}
+    one_step()  # the poisoned step
+    assert bool(mod.last_step_ok) is False
+    for n in mod._param_names:
+        np.testing.assert_array_equal(mod._exec.arg_dict[n].asnumpy(),
+                                      w_before[n])
+    one_step()
+    assert bool(mod.last_step_ok) is True
+
+
+# --------------------------------------------------------------- monitoring
+def test_training_health_monitor_logs_skips(monkeypatch, caplog):
+    import logging
+
+    from mxtpu.monitor import TrainingHealthMonitor
+    monkeypatch.setenv("MXTPU_NUMERICS_GUARD", "1")
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "nan_grad@1")
+    tr, params, rng = _make_trainer()
+    mon = TrainingHealthMonitor(interval=3).install(tr)
+    with caplog.at_level(logging.WARNING, logger="mxtpu.resilience"):
+        for _ in range(3):
+            _set_grads(params, rng)
+            tr.step(1)
+            mon.after_step()
+    assert [s for s, _ in mon.skipped] == [1]
+    assert any("skipped" in r.message for r in caplog.records)
+
+
+# ------------------------------------------------------------- checkpointing
+def _loop_trainer(tmp_path, every_steps=100):
+    scaler = resilience.DynamicLossScaler(init_scale=16.0, growth_interval=4)
+    tr, params, _ = _make_trainer(optimizer="adam",
+                                  opt_params={"learning_rate": 0.05},
+                                  scaler=scaler, seed=3)
+    loop = resilience.ResilientLoop(
+        tr, resilience.CheckpointPolicy(str(tmp_path),
+                                        every_steps=every_steps))
+    return loop, tr, params, scaler
+
+
+def _deterministic_step(tr, params):
+    def step_fn(step):
+        rng = np.random.RandomState(1000 + step)
+        for p in params:
+            base = mx.nd.array(rng.randn(*p.shape).astype(np.float32))
+            noise = mx.nd.random_normal(shape=p.shape) * 0.1
+            p.grad()[:] = base + noise  # trajectory depends on GLOBAL RNG
+        tr.step(1)
+    return step_fn
+
+
+def test_sigterm_checkpoints_and_resumes_bitexact(tmp_path, monkeypatch):
+    """SIGTERM mid-train -> final checkpoint; a FRESH trainer resumes and
+    finishes with params/optimizer/scaler/RNG bit-identical to an
+    uninterrupted run."""
+    # uninterrupted reference
+    mx.random.seed(7)
+    loop_c, tr_c, params_c, scaler_c = _loop_trainer(tmp_path / "ref")
+    loop_c.run(_deterministic_step(tr_c, params_c), 8)
+
+    # interrupted run: SIGTERM injected after step 4
+    mx.random.seed(7)
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "sigterm@4")
+    loop_a, tr_a, params_a, _ = _loop_trainer(tmp_path / "run")
+    last = loop_a.run(_deterministic_step(tr_a, params_a), 8)
+    assert loop_a.preempted and last == 4
+    assert loop_a.latest_step() == 4
+    monkeypatch.delenv("MXTPU_FAULT_INJECT")
+    resilience.reset_faults()
+
+    # fresh process stand-in: new objects, scrambled RNG — resume fixes all
+    mx.random.seed(999)
+    loop_b, tr_b, params_b, scaler_b = _loop_trainer(tmp_path / "run")
+    start = loop_b.resume()
+    assert start == 5
+    loop_b.run(_deterministic_step(tr_b, params_b), 8, start_step=start)
+
+    for a, b in zip(params_c, params_b):
+        np.testing.assert_array_equal(a.data().asnumpy(),
+                                      b.data().asnumpy())
+    _, s_ref = _snapshot(tr_c, params_c)
+    _, s_res = _snapshot(tr_b, params_b)
+    for a, b in zip(s_ref, s_res):
+        np.testing.assert_array_equal(a, b)
+    assert scaler_b.scale_value() == scaler_c.scale_value()
+    assert int(tr_b._updaters[0]._t_good) == int(tr_c._updaters[0]._t_good)
+
+
+def test_ckpt_io_failure_retries_then_succeeds(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "ckpt_io@0")
+    loop, tr, params, _ = _loop_trainer(tmp_path)
+    rng = np.random.RandomState(0)
+    _set_grads(params, rng)
+    tr.step(1)
+    assert loop.save(0) is True  # first attempt failed, retry landed
+    assert resilience.FAULT_STATS["fired"] == [("ckpt_io", 0)]
+    loop.wait_for_pending()  # interval saves are async: drain before reading
+    assert loop.latest_step() == 0
+    with open(os.path.join(str(tmp_path), "latest.json")) as f:
+        assert json.load(f)["step"] == 0
+
+
+def test_ckpt_io_failure_degrades_gracefully(tmp_path, monkeypatch):
+    """Every retry failing must NOT kill training for an interval save —
+    only the final preemption save raises."""
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "ckpt_io@0,1,2,3,4,5")
+    monkeypatch.setenv("MXTPU_CKPT_RETRIES", "1")
+    loop, tr, params, _ = _loop_trainer(tmp_path)
+    rng = np.random.RandomState(0)
+    _set_grads(params, rng)
+    tr.step(1)
+    assert loop.save(0) is False  # logged, swallowed
+    assert loop.latest_step() is None
+    with pytest.raises(OSError):
+        loop.save(1, final=True)  # the preemption save stays loud
+
+
+def test_resume_ignores_unfinalized_latest(tmp_path):
+    """latest.json pointing at a step dir that never materialized (async
+    save died) falls back to the newest FINALIZED step."""
+    loop, tr, params, _ = _loop_trainer(tmp_path)
+    rng = np.random.RandomState(0)
+    _set_grads(params, rng)
+    tr.step(1)
+    assert loop.save(3) is True
+    loop.wait_for_pending()
+    loop._write_latest(9)  # simulate a crash after pointing at step 9
+    assert loop.latest_step() == 3
+
+
+def test_restore_without_scaler_warns_instead_of_resurrecting(caplog):
+    """Loading scaler-carrying states into a scaler-less trainer must NOT
+    silently activate the guard's unscale (nothing would scale the loss —
+    training would stall 32768x); it warns and continues unscaled."""
+    import logging
+    scaler = resilience.DynamicLossScaler(init_scale=128.0)
+    tr_a, params_a, rng = _make_trainer(scaler=scaler)
+    _set_grads(params_a, rng)
+    tr_a.step(1)
+    blob = tr_a._updaters[0].get_states(dump_optimizer=True)
+    tr_b, params_b, rng_b = _make_trainer()  # no scaler
+    with caplog.at_level(logging.WARNING, logger="mxtpu.resilience"):
+        tr_b._updaters[0].set_states(blob)
+    assert tr_b._updaters[0].scaler is None
+    assert any("no loss scaler is attached" in r.message
+               for r in caplog.records)
+    _set_grads(params_b, rng_b)
+    assert tr_b.step(1) is None  # really unguarded: no verdict
+
+
+def test_kvstore_dist_reduce_retries_transient_failure(monkeypatch):
+    from mxtpu import kvstore as kv_mod
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "kv_fail@0")
+    kv = kv_mod.KVStore("dist_sync")
+    out = kv._dist_reduce(["0"], [np.ones(3, np.float32)])
+    np.testing.assert_allclose(np.asarray(out[0]), 1.0)
+    assert resilience.FAULT_STATS["fired"] == [("kv_fail", 0)]
+
+    calls = {"n": 0}
+    from mxtpu import distributed
+
+    def flaky(x):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient DCN hiccup")
+        return x
+    monkeypatch.setattr(distributed, "allreduce_host", flaky)
+    out = kv._dist_reduce(["0"], [np.full(2, 2.0, np.float32)])
+    np.testing.assert_allclose(np.asarray(out[0]), 2.0)
+    assert calls["n"] == 2
+
+
+# ----------------------------------------------------- async checkpoint sat.
+def test_checkpoint_overwrite_requires_force(tmp_path):
+    from mxtpu.contrib import async_checkpoint as ackpt
+    from mxtpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4))
+    net.initialize()
+    net(mx.nd.ones((2, 3)))
+    ackpt.save_block(net, str(tmp_path), step=0)
+    with pytest.raises(mx.MXNetError, match="force=True"):
+        ackpt.save_block(net, str(tmp_path), step=0)
+    ackpt.save_block(net, str(tmp_path), step=0, force=True)  # explicit wins
+
+
+def test_async_background_error_surfaces_on_next_save(tmp_path, monkeypatch):
+    """An exception captured in the async checkpointer's background thread
+    must fail the NEXT save loudly instead of rotting silently."""
+    from mxtpu.contrib import async_checkpoint as ackpt
+    from mxtpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4))
+    net.initialize()
+    net(mx.nd.ones((2, 3)))
+    ck = ackpt.save_block(net, str(tmp_path), step=0, async_save=True)
+    ck.wait_until_finished()
+
+    def boom():
+        raise RuntimeError("background write died")
+    monkeypatch.setattr(ackpt._ASYNC_CKPTR, "check_for_errors", boom,
+                        raising=False)
+    with pytest.raises(RuntimeError, match="background write died"):
+        ackpt.save_block(net, str(tmp_path), step=1, async_save=True)
+
+
+# ------------------------------------------------------------ dataloader
+def test_killed_dataloader_worker_restarts_and_epoch_completes():
+    from _mp_light_datasets import PlainArrayPairDataset
+
+    from mxtpu.gluon.data import DataLoader
+    ds = PlainArrayPairDataset(n=64)
+    serial = [b[0].asnumpy() for b in DataLoader(ds, batch_size=4)]
+    # ONE worker: killing it guarantees the parent stalls and takes the
+    # restart path (with >1, a surviving worker can finish the epoch
+    # before the death is ever observed — correct, but unasserted)
+    dl = DataLoader(ds, batch_size=4, num_workers=1)
+    with pytest.warns(UserWarning, match="restarting"):
+        got = []
+        for i, b in enumerate(dl):
+            got.append(b[0].asnumpy())
+            if i == 0:  # kill the worker mid-epoch
+                workers = dl._pool[2]
+                os.kill(workers[0].pid, signal.SIGKILL)
+        # second epoch reuses the healed pool
+        got2 = [b[0].asnumpy() for b in dl]
+    dl.close()
+    assert len(got) == len(serial) and len(got2) == len(serial)
+    for s, g in zip(serial, got):
+        np.testing.assert_array_equal(s, g)
+    for s, g in zip(serial, got2):
+        np.testing.assert_array_equal(s, g)
+
+
+def test_dataloader_gives_up_with_exit_codes_and_batch_index(monkeypatch):
+    from _mp_light_datasets import PlainArrayPairDataset
+
+    from mxtpu.gluon.data import DataLoader
+    monkeypatch.setenv("MXTPU_DL_WORKER_RESTARTS", "0")
+    dl = DataLoader(PlainArrayPairDataset(n=64), batch_size=4, num_workers=2)
+    with pytest.raises(RuntimeError,
+                       match=r"exit codes \[-9.*batch \d+/16"):
+        for i, _b in enumerate(dl):
+            if i == 0:
+                for w in dl._pool[2]:
+                    os.kill(w.pid, signal.SIGKILL)
+    dl.close()
+
+
+def test_worker_death_injection_hook(monkeypatch):
+    """MXTPU_FAULT_INJECT=worker_death@N kills a live worker at batch N —
+    the same restart path, driven by the deterministic injection hook."""
+    from _mp_light_datasets import PlainArrayPairDataset
+
+    from mxtpu.gluon.data import DataLoader
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "worker_death@2")
+    ds = PlainArrayPairDataset(n=48)
+    serial = [b[0].asnumpy() for b in DataLoader(ds, batch_size=4)]
+    dl = DataLoader(ds, batch_size=4, num_workers=1)  # deterministic stall
+    with pytest.warns(UserWarning, match="restarting"):
+        got = [b[0].asnumpy() for b in dl]
+    dl.close()
+    assert resilience.FAULT_STATS["fired"] == [("worker_death", 2)]
+    for s, g in zip(serial, got):
+        np.testing.assert_array_equal(s, g)
+
+
+# ---------------------------------------------------------------- injection
+def test_fault_spec_parsing_and_consume_once(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "nan_grad@3,5;ckpt_io@0")
+    assert resilience.inject("nan_grad", 2) is False
+    assert resilience.inject("nan_grad", 3) is True
+    assert resilience.inject("nan_grad", 3) is False  # consumed
+    assert resilience.inject("nan_grad", 5) is True
+    assert resilience.inject("ckpt_io") is True       # counter-indexed
+    assert resilience.inject("ckpt_io") is False
+    assert resilience.inject("unknown") is False
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "broken")
+    with pytest.raises(mx.MXNetError, match="kind@idx"):
+        resilience.inject("nan_grad", 0)
+
+
+def test_with_retries_backs_off_and_reraises():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+    assert resilience.with_retries(flaky, "t", retries=3,
+                                   backoff=0.001) == "ok"
+    assert calls["n"] == 3
+
+    def hard():
+        raise OSError("hard failure")
+    with pytest.raises(OSError, match="hard failure"):
+        resilience.with_retries(hard, "t", retries=1, backoff=0.001)
