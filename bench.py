@@ -442,58 +442,42 @@ def bench_optimizer_step():
     }
 
 
-def bench_guard_overhead(emit=None):
-    """Numerics-sentinel + dynamic-loss-scaler cost (mxtpu/resilience.py):
-    steps/s with the guard ON (DynamicLossScaler attached — in-jit finite
-    flag, grad norm, skip-select, scaler update) vs OFF, for the
-    ``optimizer_step`` hot path and a small-resnet Trainer step. One JSON
-    line per (config, guard) plus a summary whose value is the worst
-    overhead fraction — the <2% acceptance bound (ISSUE 3) is read off
-    this artifact on the TPU tier. BENCH_GUARD_CONFIGS selects subsets."""
+def _overhead_workloads():
+    """ONE copy of the workload builders the two overhead benches
+    (``guard_overhead`` and ``telemetry_overhead``) measure — the same
+    optimizer-step and small-resnet shapes, read from the shared
+    ``BENCH_GUARD_*`` env knobs. Returns ``{name: make}`` where
+    ``make(scaler=None) -> (step_fn, sync)``; attaching a
+    DynamicLossScaler builds the guarded variant."""
     import jax
 
     import mxtpu as mx
-    from mxtpu import autograd, gluon, resilience
+    from mxtpu import autograd, gluon
     from mxtpu.gluon.parameter import Parameter
     from mxtpu.gluon.trainer import Trainer
 
-    if emit is None:
-        emit = _emit
-    which = [c.strip() for c in os.environ.get(
-        "BENCH_GUARD_CONFIGS", "optimizer_step,resnet").split(",") if c]
     n_params = int(os.environ.get("BENCH_GUARD_PARAMS", "80"))
     size = int(os.environ.get("BENCH_GUARD_PARAM_SIZE", "16384"))
-    steps = int(os.environ.get("BENCH_GUARD_STEPS", "30"))
     batch = int(os.environ.get("BENCH_GUARD_BATCH", "8"))
     img = int(os.environ.get("BENCH_GUARD_IMG", "64"))
     rng = np.random.RandomState(0)
 
-    def time_steps(step_fn, sync, n):
-        step_fn()  # warmup + compile
-        sync()
-        t0 = time.perf_counter()
-        for _ in range(n):
-            step_fn()
-        sync()
-        return n / (time.perf_counter() - t0)
-
-    def opt_step_rate(guard):
+    def make_opt_step(scaler=None):
         params = []
         for j in range(n_params):
-            p = Parameter("guard_p%d" % j, shape=(size,), dtype="float32")
+            p = Parameter("ovh_p%d" % j, shape=(size,), dtype="float32")
             p.initialize()
             p.grad()[:] = mx.nd.array(rng.randn(size).astype(np.float32))
             params.append(p)
-        scaler = resilience.DynamicLossScaler() if guard else None
         tr = Trainer(params, "adam", {"learning_rate": 1e-3}, kvstore=None,
                      loss_scaler=scaler)
 
         def sync():
             jax.block_until_ready([p.data()._data for p in params])
 
-        return time_steps(lambda: tr.step(1), sync, steps)
+        return (lambda: tr.step(1)), sync
 
-    def resnet_rate(guard):
+    def make_resnet(scaler=None):
         from mxtpu.gluon.model_zoo import vision
         net = vision.resnet18_v1()
         net.initialize()
@@ -503,7 +487,6 @@ def bench_guard_overhead(emit=None):
         net(x)  # settle deferred shapes
         net.hybridize()
         loss = gluon.loss.SoftmaxCrossEntropyLoss()
-        scaler = resilience.DynamicLossScaler() if guard else None
         tr = Trainer(net.collect_params(), "sgd",
                      {"learning_rate": 0.01, "momentum": 0.9}, kvstore=None,
                      loss_scaler=scaler)
@@ -520,19 +503,50 @@ def bench_guard_overhead(emit=None):
         def sync():
             jax.block_until_ready([p.data()._data for p in params])
 
-        return time_steps(one, sync, steps)
+        return one, sync
 
-    runners = {"optimizer_step": opt_step_rate, "resnet": resnet_rate}
-    bad = [c for c in which if c not in runners]
+    return {"optimizer_step": make_opt_step, "resnet": make_resnet}
+
+
+def _time_steps(step_fn, sync, n):
+    """The overhead benches' shared timing loop: warmup+compile, then n
+    async dispatches closed by one host-fetch sync."""
+    step_fn()
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        step_fn()
+    sync()
+    return n / (time.perf_counter() - t0)
+
+
+def bench_guard_overhead(emit=None):
+    """Numerics-sentinel + dynamic-loss-scaler cost (mxtpu/resilience.py):
+    steps/s with the guard ON (DynamicLossScaler attached — in-jit finite
+    flag, grad norm, skip-select, scaler update) vs OFF, for the
+    ``optimizer_step`` hot path and a small-resnet Trainer step. One JSON
+    line per (config, guard) plus a summary whose value is the worst
+    overhead fraction — the <2% acceptance bound (ISSUE 3) is read off
+    this artifact on the TPU tier. BENCH_GUARD_CONFIGS selects subsets."""
+    from mxtpu import resilience
+
+    if emit is None:
+        emit = _emit
+    which = [c.strip() for c in os.environ.get(
+        "BENCH_GUARD_CONFIGS", "optimizer_step,resnet").split(",") if c]
+    steps = int(os.environ.get("BENCH_GUARD_STEPS", "30"))
+    makers = _overhead_workloads()
+    bad = [c for c in which if c not in makers]
     if bad or not which:
         # fail BEFORE burning measurement time, naming the offending value
         raise RuntimeError(
             "BENCH_GUARD_CONFIGS=%r: expected a non-empty comma list from %s"
-            % (os.environ.get("BENCH_GUARD_CONFIGS"), sorted(runners)))
+            % (os.environ.get("BENCH_GUARD_CONFIGS"), sorted(makers)))
     overheads = {}
     for cname in which:
-        off_rate = runners[cname](False)
-        on_rate = runners[cname](True)
+        off_rate = _time_steps(*makers[cname](None), steps)
+        on_rate = _time_steps(
+            *makers[cname](resilience.DynamicLossScaler()), steps)
         overheads[cname] = off_rate / on_rate - 1.0
         emit({"metric": "guard_overhead_%s" % cname, "guard": "off",
               "value": round(off_rate, 2), "unit": "steps/sec"})
@@ -549,6 +563,86 @@ def bench_guard_overhead(emit=None):
         "mfu": None,
         "hfu": None,
         "per_config": {k: round(v, 4) for k, v in overheads.items()},
+    }
+
+
+def bench_telemetry_overhead(emit=None):
+    """Telemetry layer cost (mxtpu/telemetry.py): steps/s with
+    MXTPU_TELEMETRY=1 (step-phase spans + event ring + watchdog counter
+    reads) vs 0, for the ``optimizer_step`` hot path and a small-resnet
+    Trainer loop — the same shapes guard_overhead measures. One JSON line
+    per (config, telemetry) plus a summary whose value is the worst
+    overhead fraction; the ISSUE-4 acceptance bound is <1%
+    (``vs_baseline`` = 0.01 / worst, so >=1.0 means the layer fits).
+    BENCH_TELEMETRY_CONFIGS selects subsets.
+
+    Methodology: ONE workload per config, then off/on timings ALTERNATE
+    over BENCH_TELEMETRY_ROUNDS rounds and each mode takes its MEDIAN
+    rate — a single off-then-on pair measures host frequency/cache
+    warmup drift instead of the ~8 us/step the three spans actually cost
+    (measured: the span path is ~2.7 us each; per-rep spread on a shared
+    CPU host is +-10%, so the summary also carries ``noise_frac`` and the
+    <1% budget is judged on the low-variance TPU tier)."""
+    if emit is None:
+        emit = _emit
+    which = [c.strip() for c in os.environ.get(
+        "BENCH_TELEMETRY_CONFIGS", "optimizer_step,resnet").split(",") if c]
+    steps = int(os.environ.get("BENCH_GUARD_STEPS", "30"))
+    rounds = int(os.environ.get("BENCH_TELEMETRY_ROUNDS", "3"))
+    makers = _overhead_workloads()
+    bad = [c for c in which if c not in makers]
+    if bad or not which:
+        raise RuntimeError(
+            "BENCH_TELEMETRY_CONFIGS=%r: expected a non-empty comma list "
+            "from %s"
+            % (os.environ.get("BENCH_TELEMETRY_CONFIGS"), sorted(makers)))
+    prev = os.environ.get("MXTPU_TELEMETRY")
+    overheads = {}
+    noise = {}
+    try:
+        for cname in which:
+            step_fn, sync = makers[cname](None)
+            step_fn()  # warmup + compile (shared: one workload, both modes)
+            sync()
+            rates = {"0": [], "1": []}
+            for _ in range(rounds):
+                for tel in ("0", "1"):
+                    os.environ["MXTPU_TELEMETRY"] = tel
+                    t0 = time.perf_counter()
+                    for _ in range(steps):
+                        step_fn()
+                    sync()
+                    rates[tel].append(steps / (time.perf_counter() - t0))
+            med = {tel: float(np.median(rs)) for tel, rs in rates.items()}
+            for tel in ("0", "1"):
+                emit({"metric": "telemetry_overhead_%s" % cname,
+                      "telemetry": "on" if tel == "1" else "off",
+                      "value": round(med[tel], 2), "unit": "steps/sec",
+                      "rounds": [round(r, 2) for r in rates[tel]]})
+            overheads[cname] = med["0"] / med["1"] - 1.0
+            all_r = rates["0"] + rates["1"]
+            noise[cname] = (max(all_r) - min(all_r)) / med["0"]
+            emit({"metric": "telemetry_overhead_%s" % cname,
+                  "overhead_frac": round(overheads[cname], 4),
+                  "noise_frac": round(noise[cname], 4)})
+    finally:
+        if prev is None:
+            os.environ.pop("MXTPU_TELEMETRY", None)
+        else:
+            os.environ["MXTPU_TELEMETRY"] = prev
+    worst = max(overheads.values())
+    return {
+        "metric": "telemetry_overhead",
+        "value": round(worst, 4),
+        "unit": "overhead_frac",
+        # >=1.0 means the layer fits the 1% budget on this platform
+        # (floor at 1e-4 caps the ratio when overhead is below the
+        # measurement noise floor, incl. the "on measured faster" case)
+        "vs_baseline": round(0.01 / max(worst, 1e-4), 3),
+        "mfu": None,
+        "hfu": None,
+        "per_config": {k: round(v, 4) for k, v in overheads.items()},
+        "noise_frac": {k: round(v, 4) for k, v in noise.items()},
     }
 
 
@@ -621,10 +715,13 @@ def bench_conv_class(emit=None):
                     emit({"metric": "conv_class_%s" % label, "impl": impl,
                           "error": str(e)})
                     continue
-                if pconv.DISPATCH_STATS["pallas"]:
+                # dispatch routing now reads from the telemetry registry
+                # (the DISPATCH_STATS dict is a thin view over it)
+                from mxtpu import telemetry
+                if telemetry.value("pallas_conv.pallas"):
                     used = "pallas"
                 elif impl == "pallas":
-                    reasons = pconv.DISPATCH_STATS["fallback_reasons"]
+                    reasons = telemetry.tagged("pallas_conv.fallback")
                     used = "xla_fallback(%s)" % "; ".join(sorted(reasons)) \
                         if reasons else "xla_gate_declined"
                 else:
@@ -700,6 +797,7 @@ CONFIGS = {
     "eager": bench_eager,
     "optimizer_step": bench_optimizer_step,
     "guard_overhead": bench_guard_overhead,
+    "telemetry_overhead": bench_telemetry_overhead,
     "conv_class": bench_conv_class,
     "sparse_linear": bench_sparse_linear,
     "lstm_ptb": bench_lstm_ptb,

@@ -34,6 +34,9 @@ if _cache_dir:
 from . import base
 from . import context  # module alias (ref: mxnet/context.py)
 from .base import Context, MXNetError, cpu, current_context, gpu, num_gpus, tpu
+# stdlib-only, imported FIRST among the framework modules: every later
+# module (ndarray's d2h counter, the trainer's step phases) may hook it
+from . import telemetry
 from . import autograd
 from .layout import layout
 from . import random

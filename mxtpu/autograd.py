@@ -180,6 +180,15 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
     """Reverse-mode through the tape (ref: Imperative::Backward,
     src/imperative/imperative.cc:270-519). Gradients land in ``x.grad`` for every
     array with an attached grad buffer (``attach_grad``/``mark_variables``)."""
+    from . import telemetry
+    with telemetry.span("gluon.backward"):
+        return _backward_impl(heads, head_grads=head_grads,
+                              retain_graph=retain_graph,
+                              train_mode=train_mode)
+
+
+def _backward_impl(heads, head_grads=None, retain_graph=False,
+                   train_mode=True):
     from .ndarray import NDArray  # late import (cycle)
     import jax.numpy as jnp
 

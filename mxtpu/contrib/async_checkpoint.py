@@ -255,9 +255,12 @@ def save_trainer(trainer, directory, step=0, async_save=False, force=False):
     Everything :class:`mxtpu.resilience.ResilientLoop` needs for bit-exact
     resume, in one orbax step directory (finalized atomically, so a
     present ``step_N`` dir is always durable)."""
+    import time
+
     import numpy as np
 
     from .. import random as _random
+    from .. import telemetry
     from ..resilience import inject
     if inject("ckpt_io"):
         raise OSError("injected checkpoint IO failure (MXTPU_FAULT_INJECT)")
@@ -265,6 +268,7 @@ def save_trainer(trainer, directory, step=0, async_save=False, force=False):
     params = [p for p in trainer._params if p._data is not None]
     if not params:
         raise MXNetError("initialize the parameters before checkpointing")
+    t0 = time.perf_counter()
     blob = np.frombuffer(upd.get_states(dump_optimizer=True),
                          np.uint8).copy()
     tree = {
@@ -276,6 +280,11 @@ def save_trainer(trainer, directory, step=0, async_save=False, force=False):
     ckptr = _checkpointer(async_save)
     ckptr.save(sd, tree, force=True)
     _write_meta(sd, {"kind": "trainer", "n_params": len(params)})
+    # save latency into the registry: for async saves this is the
+    # serialize+dispatch cost training actually pays; the background
+    # write's durability cost shows up in wait_until_finished callers
+    telemetry.observe("checkpoint.save_s", time.perf_counter() - t0)
+    telemetry.inc("checkpoint.saves")
     return ckptr
 
 

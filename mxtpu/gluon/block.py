@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from .. import autograd
 from .. import random as _random
+from .. import telemetry
 from ..base import MXNetError, current_context, numeric_types
 from ..ndarray import NDArray
 from .parameter import (DeferredInitializationError, Parameter, ParameterDict,
@@ -398,6 +399,13 @@ class CachedOp:
         key = (fmt_key, train, policy_key())
         if key in self._jits:
             return self._jits[key]
+        # retrace watchdog: every CachedOp cache miss is one compile; the
+        # provenance names the policy levers active at trace time, so a
+        # steady-state recompile (policy env flipped mid-run, unstable
+        # input signature) is attributable from telemetry.report() alone
+        telemetry.record_retrace(
+            "cached_op", {"block": type(self._block).__name__,
+                          "train": train, "policy_key": list(key[2])})
         block, params = self._block, self._params
         cell = {}  # out_fmt discovered at trace time
 
@@ -455,7 +463,8 @@ class CachedOp:
         in_datas = [x._data for x in nd_in]
         param_datas = [p._data._data for p in self._params]
 
-        out_list, aux = jitted(rng_key, in_datas, param_datas)
+        with telemetry.span("gluon.forward"):
+            out_list, aux = jitted(rng_key, in_datas, param_datas)
         out_nds = [NDArray(d) for d in out_list]
 
         if autograd.is_recording():

@@ -98,6 +98,21 @@ class DataLoader:
         return self._batchify_fn([self._dataset[i] for i in batch_idx])
 
     def __iter__(self):
+        from ... import telemetry
+        it = self._iter_impl()
+        while True:
+            # data-wait phase of the step timeline: how long the consumer
+            # blocked on the input pipeline before each batch (span
+            # "data.wait" in telemetry/profiler.dump — the host-side
+            # analog of the reference profiler's engine queue time)
+            with telemetry.span("data.wait"):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+            yield batch
+
+    def _iter_impl(self):
         if self._num_workers == 0:
             for batch_idx in self._batch_sampler:
                 yield self._load(batch_idx)
@@ -259,6 +274,8 @@ class DataLoader:
                         # OOM-killer sweep took — the budget counts pool
                         # rebuild attempts, not corpses
                         restarts += 1
+                        from ... import telemetry
+                        telemetry.inc("dataloader.worker_restarts")
                         if restarts > max_restarts:
                             raise RuntimeError(
                                 "DataLoader worker(s) died (exit codes %s) "

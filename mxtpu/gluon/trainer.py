@@ -13,6 +13,7 @@ update), False runs the updater locally after the reduce.
 from __future__ import annotations
 
 from .. import optimizer as opt_mod
+from .. import telemetry
 from ..base import MXNetError
 from .parameter import Parameter, ParameterDict
 
@@ -115,13 +116,23 @@ class Trainer:
         Under the numerics sentinel (loss_scaler attached or
         MXTPU_NUMERICS_GUARD=1) returns the step's ``step_ok`` verdict as a
         lazy device NDArray — fetched asynchronously, so reading it later
-        (or never) adds no hot-loop sync; unguarded steps return None."""
+        (or never) adds no hot-loop sync; unguarded steps return None.
+
+        Step-phase timeline (mxtpu/telemetry.py): the whole step and its
+        allreduce/update phases are recorded as host spans — pure host
+        timers, zero device work, so the zero-sync contract above holds
+        with telemetry enabled. The outer span tracks the d2h counter:
+        a device->host sync inside a steady-state step trips the transfer
+        watchdog."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
-        return self._step_verdict()
+        with telemetry.span("trainer.step", d2h=True):
+            with telemetry.span("trainer.step.allreduce"):
+                self._allreduce_grads()
+            with telemetry.span("trainer.step.update"):
+                self._update(ignore_stale_grad)
+            return self._step_verdict()
 
     def _active_updater(self):
         if self._update_on_kvstore and self._kvstore is not None:
@@ -172,7 +183,8 @@ class Trainer:
             raise MXNetError("update() when parameters are updated on kvstore "
                              "is not supported")
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._update(ignore_stale_grad)
+        with telemetry.span("trainer.step.update"):
+            self._update(ignore_stale_grad)
         return self._step_verdict()
 
     def _update(self, ignore_stale_grad=False):

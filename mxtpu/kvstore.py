@@ -145,7 +145,7 @@ class KVStore:
         return resilience.with_retries(
             lambda: self._dist_reduce_once(keys, merged_list),
             what="kvstore dist gradient reduce",
-            retries=retries, backoff=0.1)
+            retries=retries, backoff=0.1, metric="retry.kvstore_reduce")
 
     def _dist_reduce_once(self, keys, merged_list):
         """Sum each local contribution across worker processes.

@@ -16,6 +16,7 @@ import logging
 import numpy as np
 
 from .. import optimizer as opt_mod
+from .. import telemetry
 from ..base import MXNetError
 from ..initializer import InitDesc
 from ..model import load_checkpoint, save_checkpoint
@@ -233,7 +234,8 @@ class Module(BaseModule):
         if data_batch.label is not None:
             for name, arr in zip(self._label_names, data_batch.label):
                 feed[name] = arr
-        self._exec.forward(is_train=is_train, **feed)
+        with telemetry.span("module.forward"):
+            self._exec.forward(is_train=is_train, **feed)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
@@ -269,7 +271,8 @@ class Module(BaseModule):
             else:
                 out_grads = [NDArray(o._data * s.astype(o._data.dtype))
                              for o in out_grads]
-        self._exec.backward(out_grads=out_grads)
+        with telemetry.span("module.backward"):
+            self._exec.backward(out_grads=out_grads)
 
     def update(self):
         """Optimizer step on accumulated grads (ref: module.py:update →
@@ -289,16 +292,17 @@ class Module(BaseModule):
             weights.append(self._exec.arg_dict[name])
         if not keys:
             return
-        if self._kvstore is not None:
-            if self._update_on_kvstore:
-                self._kvstore.push(keys, grads)
-                self._kvstore.pull(keys, weights)
+        with telemetry.span("module.update", d2h=True):
+            if self._kvstore is not None:
+                if self._update_on_kvstore:
+                    self._kvstore.push(keys, grads)
+                    self._kvstore.pull(keys, weights)
+                else:
+                    self._kvstore.push(keys, grads)
+                    self._kvstore.pull(keys, grads)
+                    self._updater.update_batch(keys, grads, weights)
             else:
-                self._kvstore.push(keys, grads)
-                self._kvstore.pull(keys, grads)
                 self._updater.update_batch(keys, grads, weights)
-        else:
-            self._updater.update_batch(keys, grads, weights)
         upd = self._kvstore._updater if self._update_on_kvstore \
             else self._updater
         self.last_step_ok = getattr(upd, "last_step_ok", None)

@@ -123,8 +123,17 @@ class TrainingHealthMonitor:
 
     def flush(self):
         """Materialize buffered verdicts (syncs once); returns
-        [(step, ok, grad_norm)] and logs the skipped steps."""
-        health = getattr(self._updater_of(), "health", None)
+        [(step, ok, grad_norm)] and logs the skipped steps.
+
+        Every drained verdict is also emitted through the telemetry
+        registry (``resilience.steps_ok`` / ``resilience.steps_skipped``
+        counters, last grad-norm and live loss-scale gauges), so
+        ``telemetry.report()`` shows guard activity without a log scrape.
+        All telemetry updates ride the ONE batched sync drain() already
+        performs — nothing extra touches the hot loop."""
+        from . import telemetry
+        updater = self._updater_of()
+        health = getattr(updater, "health", None)
         if health is None or len(health) == 0:
             return []
         records = health.drain()
@@ -134,5 +143,14 @@ class TrainingHealthMonitor:
                     "step %d skipped: non-finite gradients "
                     "(global grad norm %s) — params and optimizer state "
                     "untouched, loss scale backed off", step, gnorm)
+        n_skipped = sum(1 for _, ok, _ in records if not ok)
+        telemetry.inc("resilience.steps_ok", len(records) - n_skipped)
+        telemetry.inc("resilience.steps_skipped", n_skipped)
+        telemetry.gauge("resilience.grad_norm", records[-1][2])
+        scaler = getattr(updater, "scaler", None)
+        if scaler is not None:
+            # one more scalar on an already-syncing path (flush cadence,
+            # not step cadence)
+            telemetry.gauge("resilience.loss_scale", scaler.scale_value())
         self.skipped.extend((s, g) for s, ok, g in records if not ok)
         return records

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as _np
 
 from .. import autograd
+from .. import telemetry as _telemetry
 from ..base import Context, MXNetError, current_context, np_dtype
 
 __all__ = ["NDArray", "array", "_apply", "from_jax", "waitall"]
@@ -187,10 +188,16 @@ class NDArray:
         return self
 
     def asnumpy(self) -> _np.ndarray:
-        d = self._data
-        if d.dtype == _BFLOAT16:
-            return _np.asarray(d.astype(jnp.float32))
-        return _np.asarray(d)
+        # transfer watchdog: EVERY materialization is one d2h sync — spans
+        # opened with d2h=True (Trainer.step, Module.update) attribute the
+        # delta to their region, so a sync sneaking into the hot loop is
+        # visible without a jax transfer_guard
+        _telemetry.record_d2h()
+        with _telemetry.span("ndarray.asnumpy", cat="sync"):
+            d = self._data
+            if d.dtype == _BFLOAT16:
+                return _np.asarray(d.astype(jnp.float32))
+            return _np.asarray(d)
 
     def asscalar(self):
         if self.size != 1:

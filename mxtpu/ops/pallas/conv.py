@@ -69,14 +69,54 @@ _BWD_COLS_BUDGET = 32 << 20
 _LOW = (jnp.bfloat16, jnp.float32)
 
 # observability for tests and tools: how often the hand kernel actually
-# ran vs why it fell back, keyed the way flash attention's warn-once set is
-DISPATCH_STATS = {"pallas": 0, "xla": 0, "fallback_reasons": {}}
+# ran vs why it fell back. The SOURCE OF TRUTH is the telemetry registry
+# (``pallas_conv.pallas`` / ``pallas_conv.xla`` counters, reason-tagged
+# ``pallas_conv.fallback``) so bench/report/JSONL all see one copy;
+# this dict-shaped view keeps the original module-level surface alive
+# for existing tests and tools.
+class _DispatchStatsView:
+    """Read-only dict-shaped view over the telemetry counters."""
+
+    _KEYS = ("pallas", "xla", "fallback_reasons")
+
+    def __getitem__(self, key):
+        from ... import telemetry
+        if key == "fallback_reasons":
+            return telemetry.tagged("pallas_conv.fallback")
+        if key not in self._KEYS:
+            raise KeyError(key)
+        return int(telemetry.value("pallas_conv." + key))
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def __iter__(self):
+        return iter(self._KEYS)
+
+    def __len__(self):
+        return len(self._KEYS)
+
+    def keys(self):
+        return list(self._KEYS)
+
+    def items(self):
+        return [(k, self[k]) for k in self._KEYS]
+
+    def __repr__(self):
+        return repr(dict(self.items()))
+
+
+DISPATCH_STATS = _DispatchStatsView()
 
 
 def reset_dispatch_stats():
-    DISPATCH_STATS["pallas"] = 0
-    DISPATCH_STATS["xla"] = 0
-    DISPATCH_STATS["fallback_reasons"] = {}
+    from ... import telemetry
+    telemetry.reset_metric("pallas_conv.pallas")
+    telemetry.reset_metric("pallas_conv.xla")
+    telemetry.reset_metric("pallas_conv.fallback")
 
 
 def _interpret():
@@ -146,9 +186,9 @@ def pallas_applicable(x, w, strides, padding, lhs_dilation, rhs_dilation,
 
 
 def _count_fallback(reason):
-    DISPATCH_STATS["xla"] += 1
-    DISPATCH_STATS["fallback_reasons"][reason] = \
-        DISPATCH_STATS["fallback_reasons"].get(reason, 0) + 1
+    from ... import telemetry
+    telemetry.inc("pallas_conv.xla")
+    telemetry.inc("pallas_conv.fallback", tag=reason)
 
 
 def _divisor_block(n, want):
@@ -443,7 +483,8 @@ def _core_fwd_impl(x, w, scale, bias, residual, cfg):
         _count_fallback(reason)
         out, craw = _forward_xla(x, w, scale, bias, residual, cfg)
     else:
-        DISPATCH_STATS["pallas"] += 1
+        from ... import telemetry
+        telemetry.inc("pallas_conv.pallas")
         out, craw = _forward_pallas(x, w, scale, bias, residual, cfg, geom)
     # residuals carry only what the backward reads: `out` feeds the ReLU
     # mask alone, and d_residual is just the (cast) cotangent — saving

@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from . import resilience
+from . import telemetry
 from .ndarray import NDArray
 from .ops import optimizer_ops as _uo
 from .optimizer import (SGD, Adam, AdaGrad, RMSProp, AdaDelta, Ftrl, Adamax,
@@ -493,7 +494,10 @@ def _tree_where(ok, new, old):
 
 def _build(rule, static, mp_flags, out_dtypes):
     def fused(w_list, g_list, s_list, h_list, rescale):
-        FUSED_STATS["traces"] += 1  # trace-time only: counts real recompiles
+        # trace-time only (host-side): counts real recompiles, mirrored
+        # into the telemetry registry for report()/the JSONL sink
+        FUSED_STATS["traces"] += 1
+        telemetry.inc("fused_optimizer.retraces")
         new_w, new_s = [], []
         for w, g, s, h, mp, odt in zip(w_list, g_list, s_list, h_list,
                                        mp_flags, out_dtypes):
@@ -525,7 +529,10 @@ def _build_guarded(rule, static, mp_flags, out_dtypes, scaler_cfg):
     thyper = rule.thyper
 
     def fused(w_list, g_list, s_list, lw_list, rescale, gstate, ext_sq):
-        FUSED_STATS["traces"] += 1  # trace-time only: counts real recompiles
+        # trace-time only (host-side): counts real recompiles, mirrored
+        # into the telemetry registry for report()/the JSONL sink
+        FUSED_STATS["traces"] += 1
+        telemetry.inc("fused_optimizer.retraces")
         scale, streak, t_good = gstate
         # ONE fused reduction serves flag AND norm: the sum of squares is
         # finite iff every grad element is (an f32 overflow of the sum also
@@ -646,6 +653,7 @@ class FusedUpdater(Updater):
         for i, g, w in eager:
             opt.update_multi_precision(i, w, g, self.states[i])
             FUSED_STATS["eager_updates"] += 1
+            telemetry.inc("fused_optimizer.eager_updates")
 
     def _gather_items(self, items, hyper_of):
         """Per-item device buffers + the jit cache-key specs, ONE copy
@@ -677,6 +685,15 @@ class FusedUpdater(Updater):
             fn = build()
             _JIT_CACHE[key] = fn
             FUSED_STATS["compiles"] += 1
+            # retrace watchdog (mxtpu/telemetry.py): every executable-cache
+            # miss reports its cache-key provenance — optimizer class,
+            # guard bit, param count, and the policy levers active now —
+            # so a steady-state recompile is attributable without a rerun
+            from .ops.registry import policy_key
+            telemetry.record_retrace(
+                "fused_optimizer",
+                {"optimizer": key[0], "guard": len(key) > 3,
+                 "n_params": len(key[2]), "policy_key": list(policy_key())})
         return fn
 
     def _fused_apply(self, rule, items):
@@ -700,6 +717,7 @@ class FusedUpdater(Updater):
         new_w, new_s = fn(w_datas, g_datas, s_datas, hypers,
                           float(opt.rescale_grad))
         FUSED_STATS["fused_steps"] += 1
+        telemetry.inc("fused_optimizer.steps")
         for (i, _, w), nw, ns in zip(items, new_w, new_s):
             w._set_data(nw)
             _tree_writeback(self.states[i], ns)
@@ -772,6 +790,7 @@ class FusedUpdater(Updater):
                     for i, g, w in eager:
                         opt.update_multi_precision(i, w, g, self.states[i])
                         FUSED_STATS["eager_updates"] += 1
+                        telemetry.inc("fused_optimizer.eager_updates")
                 finally:
                     opt.rescale_grad = saved
             # skipped: eager per-index update counts stay untouched too
@@ -799,6 +818,7 @@ class FusedUpdater(Updater):
             w_datas, g_datas, s_datas, hypers, float(opt.rescale_grad),
             gstate, ext_sq)
         FUSED_STATS["fused_steps"] += 1
+        telemetry.inc("fused_optimizer.steps")
         for (i, _, w), nw, ns in zip(items, new_w, new_s):
             w._set_data(nw)
             _tree_writeback(self.states[i], ns)

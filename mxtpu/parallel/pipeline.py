@@ -55,7 +55,7 @@ def pipeline_apply(layer_fn, stacked_params, x, mesh, axis="pipe",
     Returns the output of the full layer stack for the full batch, ordered
     like ``x``.
     """
-    shard_map = jax.shard_map
+    from .shmap import shard_map
 
     n_stages = mesh.shape[axis]
     n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]

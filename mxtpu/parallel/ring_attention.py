@@ -178,8 +178,9 @@ def ring_self_attention(q, k, v, mesh=None, seq_axis="sp", batch_axis=None,
         if os.environ.get("MXTPU_RING_FLASH", "0") == "1" else ring_attention
     fn = functools.partial(body, axis_name=seq_axis, causal=causal,
                            scale=scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    from .shmap import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
 
 
 # mx.nd-level op so eager autograd tapes through attention like any other op

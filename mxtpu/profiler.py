@@ -39,6 +39,10 @@ class _Profiler:
         self._xla_watchdog = None
         self._xla_guard_installed = False
         self._xla_last_error = None     # last swallowed stop_trace error
+        # profiled-window bounds (us, perf_counter clock) — dump() scopes
+        # the always-on telemetry event ring to these
+        self.window_start_us = None
+        self.window_stop_us = None
 
 
 _PROF = _Profiler()
@@ -115,6 +119,8 @@ def _install_xla_guards():
 def start():
     """(ref: profiler.py:set_state('run'))"""
     _PROF.active = True
+    _PROF.window_start_us = time.perf_counter_ns() // 1000
+    _PROF.window_stop_us = None
     if _PROF.profile_xla:
         import jax
         _install_xla_guards()
@@ -131,6 +137,7 @@ def start():
 
 def stop():
     _PROF.active = False
+    _PROF.window_stop_us = time.perf_counter_ns() // 1000
     if _PROF.profile_xla:
         w = _PROF._xla_watchdog
         _PROF._xla_watchdog = None
@@ -207,9 +214,30 @@ def dumps(reset=False):
 
 def dump(finished=True, profile_process="worker"):
     """Write chrome://tracing JSON (ref: profiler.py:dump; C++ emitter
-    src/profiler/profiler.h:256-437)."""
+    src/profiler/profiler.h:256-437).
+
+    Telemetry spans (mxtpu/telemetry.py — trainer step phases, module
+    forward/backward/update, data-wait, blocking syncs) are merged in with
+    the same event shape and clock (``perf_counter_ns``-derived ts/dur),
+    so ONE file shows the host phase timeline alongside the op events —
+    and, with ``profile_xla``, alongside the XLA device trace."""
     with _PROF.lock:
         events = list(_PROF.events)
+    try:
+        from . import telemetry
+        tel = telemetry.events()
+        # telemetry's span ring is ALWAYS-ON (MXTPU_TELEMETRY default 1),
+        # unlike the window-gated op events — scope the merge to the
+        # profiled window, or a 5-step trace after a long run would carry
+        # the whole process lifetime on its time axis
+        lo = _PROF.window_start_us
+        hi = _PROF.window_stop_us
+        if lo is not None:
+            tel = [e for e in tel
+                   if e[2] >= lo and (hi is None or e[2] <= hi)]
+        events = events + tel
+    except Exception:  # noqa: BLE001 — the op trace must dump regardless
+        pass
     trace = {"traceEvents": [
         {"ph": "X", "name": name, "cat": cat, "ts": ts, "dur": dur,
          "pid": 0, "tid": tid}

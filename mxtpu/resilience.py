@@ -123,6 +123,8 @@ def inject(kind, index=None):
         return False
     where.discard(index)
     FAULT_STATS["fired"].append((kind, index))
+    from . import telemetry
+    telemetry.inc("faults.injected", tag=kind)
     _log.warning("fault injected: %s@%d", kind, index)
     return True
 
@@ -137,13 +139,20 @@ def reset_faults():
 
 # ------------------------------------------------------------------- retries
 def with_retries(fn, what, retries=None, backoff=0.25, logger=None,
-                 exceptions=(Exception,)):
+                 exceptions=(Exception,), metric=None):
     """Run ``fn`` with bounded retry-with-backoff on transient failures.
 
     Used by the checkpoint driver and the kvstore's DCN reduce. Retries
     ``retries`` times (default :func:`ckpt_retries`) with exponential
     backoff starting at ``backoff`` seconds; the last failure re-raises so
-    hard errors stay loud."""
+    hard errors stay loud.
+
+    Every retry counts into the telemetry registry: ``retry.total``
+    always, plus the caller's stable ``metric`` name (``what`` often
+    carries per-call detail like a step number — unusable as a metric
+    key), so transient-IO flakiness shows up in ``telemetry.report()``
+    without a log scrape."""
+    from . import telemetry
     retries = ckpt_retries() if retries is None else int(retries)
     retries = max(0, retries)  # a negative budget must still run fn once
     delay = backoff
@@ -153,6 +162,9 @@ def with_retries(fn, what, retries=None, backoff=0.25, logger=None,
         except exceptions as e:
             if attempt == retries:
                 raise
+            telemetry.inc("retry.total")
+            if metric:
+                telemetry.inc(metric)
             (logger or _log).warning(
                 "%s failed (%s: %s); retry %d/%d in %.2fs", what,
                 type(e).__name__, e, attempt + 1, retries, delay)
@@ -437,7 +449,8 @@ class ResilientLoop:
                 _save, "checkpoint save (step %d)" % step,
                 retries=(ckpt_retries() if self._policy.retries
                          is None else self._policy.retries),
-                backoff=self._policy.backoff, logger=self._log)
+                backoff=self._policy.backoff, logger=self._log,
+                metric="retry.checkpoint_save")
         except Exception as e:
             if final:
                 raise

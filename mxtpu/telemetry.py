@@ -1,0 +1,485 @@
+"""Unified runtime telemetry: metrics registry, step-phase timeline, watchdogs.
+
+The reference framework's ops-facing surface is its engine-level profiler
+(src/profiler/profiler.h: per-op events, queue time, chrome-trace dump,
+aggregate tables). On a jit-compiled TPU stack the signals that matter are
+different — recompiles, host syncs, kernel-dispatch routing, skip-steps,
+IO retries — and before this module they were scattered across five
+modules (``optimizer_fused.FUSED_STATS``, ``ops.pallas.conv.
+DISPATCH_STATS``, ``resilience.FAULT_STATS``, monitor logs, bench-only
+counters) with no common surface. This module is that surface:
+
+* **Registry** — process-global counters / gauges / histograms with
+  near-zero-overhead host-side updates (one short lock, no device work,
+  no syncs — safe inside a ``jax.transfer_guard``), ``snapshot()`` for a
+  structured view and ``report()`` for the aggregate table.
+* **Spans** — ``with telemetry.span("trainer.step"): ...`` times a host
+  region into a histogram AND a bounded event ring that
+  :func:`mxtpu.profiler.dump` merges into the chrome-trace JSON, so one
+  file shows the host step phases alongside the XLA trace.
+* **Retrace watchdog** — jit-cache owners (``optimizer_fused.
+  FusedUpdater``, gluon ``CachedOp``) report every compile with its
+  cache-key / ``registry.policy_key`` provenance via
+  :func:`record_retrace`; once a site exceeds ``MXTPU_RETRACE_BUDGET``
+  compiles the watchdog warns with the provenance — steady-state
+  recompiles are where jit-stack performance silently dies (PyGraph's
+  core lesson: graph-capture systems fail without first-class re-capture
+  accounting).
+* **Transfer watchdog** — ``NDArray.asnumpy``-class device->host syncs
+  bump a global counter; a ``span(..., d2h=True)`` attributes the delta
+  to its region (``<name>.d2h``) and warns when a steady-state hot-loop
+  region syncs at all. This generalizes the transfer-guard TEST machinery
+  of the resilience PR into an always-available production counter.
+* **JSON-lines sink** — ``MXTPU_TELEMETRY=<path>`` streams observations
+  (and cumulative counters at flush) to a JSONL file; flushing is
+  off-thread (``MXTPU_TELEMETRY_FLUSH_S``) and OFF by default — the hot
+  path only ever appends to an in-memory deque.
+  ``tools/telemetry_report.py`` turns the file into the aggregate table.
+
+Gating: ``MXTPU_TELEMETRY=0`` disables the span/event/sink machinery
+(timers, ring appends). Plain counter/gauge increments stay always-on —
+they are single dict updates, and the adopted stats views
+(``DISPATCH_STATS`` etc.) must keep working regardless of the lever.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+
+__all__ = ["enabled", "retrace_budget", "inc", "gauge", "observe", "value",
+           "tagged", "reset_metric", "span", "record_d2h", "d2h_count",
+           "record_retrace", "retrace_stats", "snapshot", "report",
+           "events", "flush", "jsonl_path", "reset"]
+
+_log = logging.getLogger("mxtpu.telemetry")
+
+# one short lock for every structural update; individual increments hold it
+# for nanoseconds (the "lock-cheap host-side increment" contract)
+_LOCK = threading.Lock()
+_COUNTERS = {}            # (name, tag-or-None) -> float
+_GAUGES = {}              # name -> float
+_HISTS = {}               # name -> [count, sum, min, max, reservoir-deque]
+_EVENTS = collections.deque(maxlen=65536)  # (name, cat, ts_us, dur_us, tid)
+_RESERVOIR = 2048         # per-histogram quantile sample bound
+
+# retrace watchdog: site -> {"compiles", "trips", "last"}
+_RETRACE = {}
+# transfer watchdog: hot-loop span names already warned about
+_D2H_WARNED = set()
+_D2H_WARMUP = 2           # first occurrences of a span may legitimately sync
+
+# JSONL sink: hot path appends to the queue; a flush (explicit, atexit, or
+# the off-thread timer) drains it to the file
+_SINK = {"queue": collections.deque(maxlen=1 << 20), "thread": None,
+         "atexit": False, "lock": threading.Lock()}
+
+
+# ------------------------------------------------------------------ policies
+def enabled():
+    """Span/event/sink machinery lever: ``MXTPU_TELEMETRY`` default ON
+    (read per call, like every other A/B lever, so bench can flip it
+    mid-process). ``0`` disables spans; bare counters stay always-on."""
+    return os.environ.get("MXTPU_TELEMETRY", "1") != "0"
+
+
+def jsonl_path():
+    """``MXTPU_TELEMETRY`` doubles as the sink switch: any value other
+    than ``0``/``1`` is a JSONL path observations stream to."""
+    v = os.environ.get("MXTPU_TELEMETRY", "1")
+    return v if v not in ("0", "1") else None
+
+
+def retrace_budget():
+    """Compiles a single jit-cache site may accumulate before the retrace
+    watchdog warns (``MXTPU_RETRACE_BUDGET``, default 64 — far above any
+    legitimate warmup, low enough to catch a per-step recompile within
+    the first minute)."""
+    return int(os.environ.get("MXTPU_RETRACE_BUDGET", "64"))
+
+
+def _flush_interval():
+    """Off-thread flush period in seconds (``MXTPU_TELEMETRY_FLUSH_S``);
+    0 (default) = no background thread — flush happens on
+    :func:`flush` and at interpreter exit."""
+    try:
+        return float(os.environ.get("MXTPU_TELEMETRY_FLUSH_S", "0"))
+    except ValueError:
+        return 0.0
+
+
+# ----------------------------------------------------------------- registry
+def inc(name, n=1, tag=None):
+    """Add ``n`` to a counter. ``tag`` keys a labeled sub-counter (e.g.
+    pallas fallback reasons). Always-on: a single locked dict update."""
+    k = (name, tag)
+    with _LOCK:
+        _COUNTERS[k] = _COUNTERS.get(k, 0) + n
+
+
+def gauge(name, v):
+    """Set a gauge to the latest value (last-write-wins)."""
+    with _LOCK:
+        _GAUGES[name] = float(v)
+
+
+def observe(name, v):
+    """Record one histogram observation (span durations land here)."""
+    v = float(v)
+    with _LOCK:
+        h = _HISTS.get(name)
+        if h is None:
+            h = [0, 0.0, v, v, collections.deque(maxlen=_RESERVOIR)]
+            _HISTS[name] = h
+        h[0] += 1
+        h[1] += v
+        h[2] = min(h[2], v)
+        h[3] = max(h[3], v)
+        h[4].append(v)
+    p = jsonl_path()
+    if p is not None:
+        _queue_line({"t": time.time(), "kind": "obs", "metric": name,
+                     "value": v}, p)
+
+
+def value(name, tag=None):
+    """Current counter value (0 when never incremented); with no ``tag``
+    and no untagged entry, the sum across tags."""
+    with _LOCK:
+        v = _COUNTERS.get((name, tag))
+        if v is not None or tag is not None:
+            return v or 0
+        return sum(v for (n, t), v in _COUNTERS.items()
+                   if n == name and t is not None) or 0
+
+
+def tagged(name):
+    """``{tag: value}`` over a labeled counter family."""
+    with _LOCK:
+        return {t: v for (n, t), v in _COUNTERS.items()
+                if n == name and t is not None}
+
+
+def reset_metric(name):
+    """Zero one metric (counters incl. tags, gauge, histogram) — the
+    adopted stats views (``reset_dispatch_stats``) use this; it must NOT
+    clear the rest of the registry."""
+    with _LOCK:
+        for k in [k for k in _COUNTERS if k[0] == name]:
+            del _COUNTERS[k]
+        _GAUGES.pop(name, None)
+        _HISTS.pop(name, None)
+
+
+def _quantile(sorted_vals, q):
+    n = len(sorted_vals)
+    if n == 0:
+        return None
+    pos = q * (n - 1)
+    lo = int(pos)
+    hi = min(lo + 1, n - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def snapshot():
+    """Structured aggregate view of everything the registry holds."""
+    with _LOCK:
+        by_name = {}
+        for (name, tag), v in _COUNTERS.items():
+            by_name.setdefault(name, {})[tag] = v
+        # pure-untagged collapses to a scalar; a name incremented BOTH
+        # ways keeps every entry (untagged under "_untagged") — mixing
+        # must not silently drop either form from the aggregate view
+        counters = {}
+        for name, tags in by_name.items():
+            if set(tags) == {None}:
+                counters[name] = tags[None]
+            else:
+                counters[name] = {
+                    ("_untagged" if t is None else t): v
+                    for t, v in tags.items()}
+        gauges = dict(_GAUGES)
+        hists = {}
+        for name, (cnt, total, mn, mx, res) in _HISTS.items():
+            vals = sorted(res)
+            hists[name] = {"count": cnt, "sum": total, "mean": total / cnt,
+                           "min": mn, "max": mx,
+                           "p50": _quantile(vals, 0.5),
+                           "p99": _quantile(vals, 0.99)}
+        retrace = {site: dict(st) for site, st in _RETRACE.items()}
+    return {"counters": counters, "gauges": gauges, "histograms": hists,
+            "retrace": retrace}
+
+
+def report():
+    """The aggregate table, profiler-dumps style: one call shows guard
+    activity, dispatch routing, retries, and the step-phase timing without
+    a log scrape."""
+    snap = snapshot()
+    lines = []
+    if snap["histograms"]:
+        lines.append("%-38s %8s %10s %10s %10s %10s" %
+                     ("Span/Histogram", "Count", "Mean(ms)", "P50(ms)",
+                      "P99(ms)", "Max(ms)"))
+        for name in sorted(snap["histograms"],
+                           key=lambda n: -snap["histograms"][n]["sum"]):
+            h = snap["histograms"][name]
+            lines.append("%-38s %8d %10.3f %10.3f %10.3f %10.3f" %
+                         (name, h["count"], h["mean"] * 1e3,
+                          (h["p50"] or 0) * 1e3, (h["p99"] or 0) * 1e3,
+                          h["max"] * 1e3))
+    if snap["counters"]:
+        lines.append("")
+        lines.append("%-38s %12s" % ("Counter", "Value"))
+        for name in sorted(snap["counters"]):
+            v = snap["counters"][name]
+            if isinstance(v, dict):
+                for tag in sorted(v):
+                    lines.append("%-38s %12g" %
+                                 ("%s{%s}" % (name, tag), v[tag]))
+            else:
+                lines.append("%-38s %12g" % (name, v))
+    if snap["gauges"]:
+        lines.append("")
+        lines.append("%-38s %12s" % ("Gauge", "Value"))
+        for name in sorted(snap["gauges"]):
+            lines.append("%-38s %12g" % (name, snap["gauges"][name]))
+    if snap["retrace"]:
+        lines.append("")
+        lines.append("%-20s %9s %6s  %s" %
+                     ("Retrace site", "Compiles", "Trips", "Last provenance"))
+        for site in sorted(snap["retrace"]):
+            st = snap["retrace"][site]
+            lines.append("%-20s %9d %6d  %s" %
+                         (site, st["compiles"], st["trips"],
+                          st["last"]))
+    return "\n".join(lines) if lines else "(telemetry registry empty)"
+
+
+def events():
+    """The bounded span-event ring — (name, cat, ts_us, dur_us, tid)
+    tuples on the ``time.perf_counter_ns`` clock, the SAME clock and
+    shape :mod:`mxtpu.profiler` records op events with, so
+    ``profiler.dump()`` merges them into one chrome trace."""
+    with _LOCK:
+        return list(_EVENTS)
+
+
+def reset():
+    """Test hook: clear the whole registry, event ring, and watchdog
+    state (the sink file, if any, is left alone)."""
+    with _LOCK:
+        _COUNTERS.clear()
+        _GAUGES.clear()
+        _HISTS.clear()
+        _EVENTS.clear()
+        _RETRACE.clear()
+        _D2H_WARNED.clear()
+
+
+# -------------------------------------------------------------------- spans
+class span:
+    """Context manager timing a host-side region into the histogram
+    ``name`` (seconds) and the chrome-trace event ring. ``d2h=True``
+    additionally attributes device->host syncs observed inside the region
+    to ``<name>.d2h`` and arms the transfer watchdog: a steady-state
+    occurrence (past the first ``_D2H_WARMUP``) that syncs at all warns
+    once — the guarded hot loop's contract is ZERO.
+
+    Pure host bookkeeping: no device ops, no syncs — safe under a
+    ``jax.transfer_guard`` and inside the zero-sync Trainer.step contract.
+    The enter/exit pair is hand-tuned for sub-millisecond hot loops: ONE
+    env read (lever + sink path resolved together), ONE lock acquisition
+    on exit (histogram + event ring inline), lock-free d2h snapshot.
+    """
+
+    __slots__ = ("name", "cat", "_d2h", "_t0", "_d0", "_sink")
+
+    def __init__(self, name, cat="phase", d2h=False):
+        self.name = name
+        self.cat = cat
+        self._d2h = d2h
+        self._t0 = None
+        self._d0 = None
+        self._sink = None
+
+    def __enter__(self):
+        lever = os.environ.get("MXTPU_TELEMETRY", "1")
+        if lever != "0":
+            self._sink = lever if lever != "1" else None
+            self._t0 = time.perf_counter_ns()
+            if self._d2h:
+                # lock-free read: a counter read races only with other
+                # increments, and a one-off-by-one delta is harmless here
+                self._d0 = _COUNTERS.get(("transfer.d2h", None), 0)
+        return self
+
+    def __exit__(self, *exc):
+        t0 = self._t0
+        if t0 is None:
+            return False
+        dur_ns = time.perf_counter_ns() - t0
+        v = dur_ns * 1e-9
+        name = self.name
+        with _LOCK:
+            h = _HISTS.get(name)
+            if h is None:
+                h = [0, 0.0, v, v, collections.deque(maxlen=_RESERVOIR)]
+                _HISTS[name] = h
+            h[0] += 1
+            h[1] += v
+            if v < h[2]:
+                h[2] = v
+            if v > h[3]:
+                h[3] = v
+            h[4].append(v)
+            occurrences = h[0]
+            _EVENTS.append((name, self.cat, t0 // 1000, dur_ns // 1000,
+                            threading.get_ident() & 0xFFFF))
+        if self._sink is not None:
+            _queue_line({"t": time.time(), "kind": "obs", "metric": name,
+                         "value": v}, self._sink)
+        if self._d0 is not None:
+            delta = _COUNTERS.get(("transfer.d2h", None), 0) - self._d0
+            if delta:
+                inc(name + ".d2h", delta)
+                self._watchdog(delta, occurrences)
+        self._t0 = None
+        return False
+
+    def _watchdog(self, delta, occurrences):
+        with _LOCK:
+            if occurrences <= _D2H_WARMUP or self.name in _D2H_WARNED:
+                return
+            _D2H_WARNED.add(self.name)
+        _log.warning(
+            "transfer watchdog: %d device->host sync(s) inside '%s' after "
+            "warmup (occurrence %d) — the hot loop should be transfer-free; "
+            "fetch verdicts/metrics asynchronously off the step path "
+            "(docs/observability.md)", delta, self.name, occurrences)
+
+
+# -------------------------------------------------------- transfer watchdog
+def record_d2h(n=1):
+    """Called from the NDArray sync points (``asnumpy`` and friends): one
+    global device->host sync counter, always on. Spans opened with
+    ``d2h=True`` attribute deltas of this counter to their region."""
+    inc("transfer.d2h", n)
+
+
+def d2h_count():
+    return value("transfer.d2h")
+
+
+# --------------------------------------------------------- retrace watchdog
+def record_retrace(site, provenance=None):
+    """Report one jit-cache compile at ``site`` with its cache-key
+    provenance (optimizer class, ``registry.policy_key`` tuple, ...).
+    Counts into ``retrace.<site>``; past :func:`retrace_budget` compiles
+    the watchdog warns with the provenance and bumps
+    ``retrace.watchdog_trips`` — a steady-state recompile means a policy
+    env flipped mid-run or a cache key is unstable (shapes/hyper leaking
+    into the static config), both of which silently serialize training
+    behind the compiler."""
+    inc("retrace." + site)
+    budget = retrace_budget()
+    with _LOCK:
+        st = _RETRACE.setdefault(site,
+                                 {"compiles": 0, "trips": 0, "last": None})
+        st["compiles"] += 1
+        st["last"] = provenance
+        over = st["compiles"] > budget
+        if over:
+            st["trips"] += 1
+        compiles = st["compiles"]
+        trips = st["trips"]
+    if over:
+        inc("retrace.watchdog_trips")
+        # rate-limit the LOG (the trip counter stays exact): the target
+        # pathology is a recompile every step — warning each time would
+        # flood hours of logs with the message meant to make them readable
+        if trips != 1 and trips % 100 != 0:
+            return
+        _log.warning(
+            "retrace watchdog: '%s' compiled %d times, over "
+            "MXTPU_RETRACE_BUDGET=%d. Last provenance: %s. Steady-state "
+            "recompiles usually mean a policy env var flipped mid-run or "
+            "an unstable cache key — each one stalls every step behind "
+            "the compiler (docs/observability.md)",
+            site, compiles, budget, provenance)
+
+
+def retrace_stats(site=None):
+    """Watchdog state: ``{site: {compiles, trips, last}}`` (or one
+    site's dict / None)."""
+    with _LOCK:
+        if site is not None:
+            st = _RETRACE.get(site)
+            return dict(st) if st else None
+        return {s: dict(st) for s, st in _RETRACE.items()}
+
+
+# --------------------------------------------------------------- JSONL sink
+def _queue_line(rec, path):
+    _SINK["queue"].append((path, rec))
+    if not _SINK["atexit"]:
+        with _SINK["lock"]:
+            if not _SINK["atexit"]:
+                _SINK["atexit"] = True
+                import atexit
+                atexit.register(flush)
+    interval = _flush_interval()
+    if interval > 0 and _SINK["thread"] is None:
+        with _SINK["lock"]:
+            if _SINK["thread"] is None:
+                t = threading.Thread(target=_flush_loop, args=(interval,),
+                                     daemon=True, name="mxtpu-telemetry")
+                _SINK["thread"] = t
+                t.start()
+
+
+def _flush_loop(interval):
+    while True:
+        time.sleep(interval)
+        try:
+            flush()
+        except Exception:  # noqa: BLE001 — a sink error must never kill
+            pass           # the flusher (next interval retries)
+
+
+def flush():
+    """Drain queued observations to the JSONL sink and append one
+    cumulative line per counter/gauge. Off the hot path by construction
+    (explicit call, atexit, or the off-thread timer)."""
+    path = jsonl_path()
+    lines_by_path = {}
+    while True:
+        try:
+            p, rec = _SINK["queue"].popleft()
+        except IndexError:
+            break
+        lines_by_path.setdefault(p, []).append(rec)
+    if path is not None:
+        now = time.time()
+        with _LOCK:
+            for (name, tag), v in _COUNTERS.items():
+                rec = {"t": now, "kind": "counter", "metric": name,
+                       "value": v}
+                if tag is not None:
+                    rec["tag"] = tag
+                lines_by_path.setdefault(path, []).append(rec)
+            for name, v in _GAUGES.items():
+                lines_by_path.setdefault(path, []).append(
+                    {"t": now, "kind": "gauge", "metric": name, "value": v})
+    with _SINK["lock"]:
+        for p, recs in lines_by_path.items():
+            try:
+                with open(p, "a") as f:
+                    for rec in recs:
+                        f.write(json.dumps(rec) + "\n")
+            except OSError as e:  # pragma: no cover - sink IO failure
+                _log.warning("telemetry sink write to %s failed: %s", p, e)

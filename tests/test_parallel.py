@@ -413,10 +413,11 @@ def test_ring_flash_attention_matches_dense(causal):
     spec = P(None, None, "sp", None)
 
     def ring(q_, k_, v_):
+        from mxtpu.parallel.shmap import shard_map
         body = lambda a, b, c: ring_flash_attention(  # noqa: E731
             a, b, c, axis_name="sp", causal=causal)
-        return jax.shard_map(body, mesh=mesh, in_specs=(spec,) * 3,
-                             out_specs=spec, check_vma=False)(q_, k_, v_)
+        return shard_map(body, mesh=mesh, in_specs=(spec,) * 3,
+                         out_specs=spec, check_vma=False)(q_, k_, v_)
 
     out = ring(q, k, v)
     ref = _dense_attention(q, k, v, causal=causal)

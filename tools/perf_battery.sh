@@ -13,6 +13,20 @@ set -u
 cd "$(dirname "$0")/.."
 LOG=${1:-perf_battery.log}
 export MXTPU_COMPILE_CACHE=${MXTPU_COMPILE_CACHE:-/tmp/mxtpu_compile_cache}
+# runtime telemetry (mxtpu/telemetry.py): every phase's spans/counters
+# stream to one JSONL artifact; tools/telemetry_report.py folds it into
+# the aggregate table after each session below. The periodic off-thread
+# flush matters HERE specifically: every session runs under `timeout`,
+# whose SIGTERM skips python atexit — without it a wedged/overrun session
+# (exactly the failure the timeouts exist for) would lose its telemetry.
+TELEMETRY_JSONL=${TELEMETRY_JSONL:-telemetry_battery.jsonl}
+export MXTPU_TELEMETRY="$TELEMETRY_JSONL"
+export MXTPU_TELEMETRY_FLUSH_S=${MXTPU_TELEMETRY_FLUSH_S:-30}
+
+telemetry_report() {
+  [ -s "$TELEMETRY_JSONL" ] && \
+    python tools/telemetry_report.py "$TELEMETRY_JSONL" 2>&1 | tee -a "$LOG"
+}
 
 # 0. is the chip alive? (90 s; bail early if wedged). This is the ONLY
 #    extra session besides the battery itself.
@@ -39,13 +53,16 @@ timeout "${SESSION_TIMEOUT:-3600}" stdbuf -oL -eL \
     resnet_control resnet_bn_onepass resnet_all_levers stem_breakdown \
     rest \
     2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
+telemetry_report
 
 # 2. lower-priority extras, each its own session, spaced by a release
 #    grace period (observed: back-to-back claims correlate with wedges)
 sleep 60
 timeout 1200 python tools/benchmark_score.py 2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
+telemetry_report
 sleep 60
 timeout 900 env PYTHONPATH=.:/root/.axon_site python tools/bandwidth.py \
   --sizes-mb 16,64 2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
+telemetry_report
 
 echo "battery complete -> $LOG"

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Summarize a telemetry JSONL file into the aggregate table.
+
+The sink (``MXTPU_TELEMETRY=<path>``, mxtpu/telemetry.py) streams one line
+per histogram observation plus cumulative counter/gauge lines at each
+flush. This tool folds a file of those lines into the per-metric table —
+count / mean / p50 / p99 / max for observations, the final cumulative
+value for counters, the last write for gauges — the telemetry analog of
+``profiler.dumps()``'s aggregate stats, runnable after the fact on a
+battery artifact (tools/perf_battery.sh runs it after each session).
+
+Usage::
+
+    python tools/telemetry_report.py telemetry.jsonl [--json]
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _quantile(sorted_vals, q):
+    n = len(sorted_vals)
+    if n == 0:
+        return None
+    pos = q * (n - 1)
+    lo = int(pos)
+    hi = min(lo + 1, n - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def aggregate(lines):
+    """Fold decoded JSONL records into {metric: summary-dict}.
+
+    Counters are cumulative WITHIN one process and repeat per flush, but
+    several sessions may append to one file (perf_battery.sh shares a
+    single MXTPU_TELEMETRY path across the battery, benchmark_score, and
+    bandwidth runs, each restarting at 0) — so they fold Prometheus-style:
+    a value that DROPS marks a process restart, banking the previous
+    session's total. Gauges take the last write; observation streams get
+    count/mean/p50/p99/min/max."""
+    obs = {}
+    counters = {}   # key -> [banked_total, last_seen_in_session]
+    gauges = {}
+    for rec in lines:
+        kind = rec.get("kind")
+        name = rec.get("metric")
+        if name is None:
+            continue
+        if kind == "obs":
+            obs.setdefault(name, []).append(float(rec["value"]))
+        elif kind == "counter":
+            tag = rec.get("tag")
+            key = "%s{%s}" % (name, tag) if tag else name
+            banked, last = counters.get(key, (0, 0))
+            if rec["value"] < last:  # process restart: bank the old run
+                banked += last
+            counters[key] = (banked, rec["value"])
+        elif kind == "gauge":
+            gauges[name] = float(rec["value"])
+    counters = {k: banked + last for k, (banked, last) in counters.items()}
+    out = {}
+    for name, vals in obs.items():
+        vals.sort()
+        out[name] = {"kind": "obs", "count": len(vals),
+                     "mean": sum(vals) / len(vals),
+                     "min": vals[0], "max": vals[-1],
+                     "p50": _quantile(vals, 0.5),
+                     "p99": _quantile(vals, 0.99)}
+    for name, v in counters.items():
+        out[name] = {"kind": "counter", "count": v, "value": v}
+    for name, v in gauges.items():
+        out[name] = {"kind": "gauge", "value": v}
+    return out
+
+
+def load(path):
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                # a torn final line from a killed process must not void
+                # the rest of the artifact
+                continue
+    return records
+
+
+def format_table(summary):
+    lines = []
+    obs = {n: s for n, s in summary.items() if s["kind"] == "obs"}
+    if obs:
+        lines.append("%-38s %8s %12s %12s %12s %12s" %
+                     ("Metric", "Count", "Mean", "P50", "P99", "Max"))
+        for name in sorted(obs, key=lambda n: -obs[n]["mean"] * obs[n]["count"]):
+            s = obs[name]
+            lines.append("%-38s %8d %12.6g %12.6g %12.6g %12.6g" %
+                         (name, s["count"], s["mean"], s["p50"], s["p99"],
+                          s["max"]))
+    rest = {n: s for n, s in summary.items() if s["kind"] != "obs"}
+    if rest:
+        if lines:
+            lines.append("")
+        lines.append("%-38s %8s %12s" % ("Counter/Gauge", "Kind", "Value"))
+        for name in sorted(rest):
+            s = rest[name]
+            lines.append("%-38s %8s %12g" % (name, s["kind"], s["value"]))
+    return "\n".join(lines) if lines else "(no telemetry records)"
+
+
+def main(argv):
+    paths = [a for a in argv if not a.startswith("-")]
+    if not paths or "-h" in argv or "--help" in argv:
+        print(__doc__)
+        return 0 if "-h" in argv or "--help" in argv else 1
+    as_json = "--json" in argv
+    path = paths[0]
+    summary = aggregate(load(path))
+    if as_json:
+        print(json.dumps(summary, sort_keys=True))
+    else:
+        print(format_table(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
