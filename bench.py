@@ -756,6 +756,48 @@ def bench_conv_class(emit=None):
     }
 
 
+def bench_serving(emit=None):
+    """Inference serving throughput (mxtpu/serving, ISSUE 5): the
+    ``tools/serve_bench.py`` phases driven in-process — direct Predictor
+    batch-bucket sweep (one line per bucket; items/s must be
+    monotonically non-decreasing from batch 1 to the max bucket) and a
+    closed-loop mixed-shape run through the MicroBatcher (one line:
+    items/s, client p50/p99, compile count at retrace site
+    ``serving.predict`` vs #buckets, watchdog trips, shed count). The
+    summary's ``vs_baseline`` is 1.0 only when BOTH acceptance gates hold
+    (monotonic sweep AND compiles <= buckets with zero trips)."""
+    if emit is None:
+        emit = _emit
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import serve_bench as sb
+
+    n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", "500"))
+    max_b = int(os.environ.get("BENCH_SERVE_MAX_BATCH", "8"))
+    wait_ms = float(os.environ.get("BENCH_SERVE_WAIT_MS", "2"))
+    pred, spec = sb.build_predictor(max_batch=max_b)
+    rates, monotonic = sb.run_sweep(pred, spec, emit=emit)
+    closed = sb.run_closed(pred, spec, n_requests=n_req,
+                           max_wait_ms=wait_ms, emit=emit)
+    gates_ok = monotonic and closed["compiles"] <= closed["buckets"] \
+        and closed["watchdog_trips"] == 0
+    return {
+        "metric": "serving",
+        "value": closed["value"],
+        "unit": "items/sec",
+        "vs_baseline": 1.0 if gates_ok else 0.0,
+        "mfu": None,
+        "hfu": None,
+        "p50_ms": closed["p50_ms"],
+        "p99_ms": closed["p99_ms"],
+        "compiles": closed["compiles"],
+        "buckets": closed["buckets"],
+        "watchdog_trips": closed["watchdog_trips"],
+        "sweep_monotonic": monotonic,
+        "sweep_items_per_s": [round(r, 1) for r in rates],
+    }
+
+
 def bench_sparse_linear():
     """BASELINE config 5: sparse linear classification samples/sec
     (examples/sparse/linear_classification.py — LibSVM CSR batches through
@@ -799,6 +841,7 @@ CONFIGS = {
     "guard_overhead": bench_guard_overhead,
     "telemetry_overhead": bench_telemetry_overhead,
     "conv_class": bench_conv_class,
+    "serving": bench_serving,
     "sparse_linear": bench_sparse_linear,
     "lstm_ptb": bench_lstm_ptb,
     "bert_base": bench_bert_base,

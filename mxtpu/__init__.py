@@ -81,6 +81,7 @@ from . import operator
 from . import contrib
 from . import rnn
 from . import parallel
+from . import serving
 from . import rtc
 from . import libinfo
 from .libinfo import __version__, feature_list

@@ -30,7 +30,8 @@ import jax
 from ..base import MXNetError
 
 __all__ = ["save_block", "load_block", "save_train_step",
-           "load_train_step", "save_trainer", "load_trainer"]
+           "load_train_step", "save_trainer", "load_trainer",
+           "latest_step", "load_trainer_params_into_block"]
 
 
 def _param_tree(block):
@@ -114,6 +115,38 @@ def _read_meta(step_dir):
         # checkpoint of its fingerprint.
         return None
     return json.loads(p.read_text())
+
+
+def latest_step(directory):
+    """Newest RESUMABLE step in a checkpoint directory (or None): the
+    ``latest.json`` pointer if its step dir finalized (async orbax
+    materializes step dirs atomically, so existence == durable), else the
+    newest finalized ``step_*`` directory. Shared by
+    :class:`mxtpu.resilience.ResilientLoop` (training resume) and
+    :meth:`mxtpu.serving.Predictor.from_trainer_checkpoint` (serving
+    restore); epath-routed so gs://-style directories resolve from a
+    fresh host too."""
+    import json
+
+    from etils import epath
+    d = epath.Path(str(directory))
+    try:
+        candidate = int(json.loads((d / "latest.json").read_text())["step"])
+    except Exception:  # missing, torn, or backend error: fall back to scan
+        candidate = None
+    if candidate is not None and (d / ("step_%d" % candidate)).is_dir():
+        return candidate
+    steps = []
+    try:
+        for p in d.iterdir():
+            if p.name.startswith("step_") and p.is_dir():
+                try:
+                    steps.append(int(p.name[5:]))
+                except ValueError:
+                    pass
+    except Exception:
+        return None
+    return max(steps) if steps else None
 
 
 def _keyed(datas):
@@ -288,23 +321,23 @@ def save_trainer(trainer, directory, step=0, async_save=False, force=False):
     return ckptr
 
 
-def load_trainer(trainer, directory, step=0):
-    """Restore a gluon Trainer in place from :func:`save_trainer` output —
-    params with their live shardings, optimizer + loss-scaler + guard
-    state, and the RNG key (bit-exact resume)."""
-    import numpy as np
-    import orbax.checkpoint as ocp
-
-    from .. import random as _random
-    upd = _trainer_updater(trainer)
-    params = [p for p in trainer._params if p._data is not None]
-    sd = _step_dir(directory, step)
+def _check_trainer_meta(sd, params, who):
     meta = _read_meta(sd)
     if meta is not None and meta.get("n_params") not in (None, len(params)):
         raise MXNetError(
-            "trainer checkpoint at %s holds %s parameters, this trainer "
-            "has %d — the model that saved must match the one restoring "
-            "(positional keys)" % (sd, meta.get("n_params"), len(params)))
+            "trainer checkpoint at %s holds %s parameters, this %s has %d "
+            "— the model that saved must match the one restoring "
+            "(positional keys)" % (sd, meta.get("n_params"), who,
+                                   len(params)))
+
+
+def _restore_trainer_tree(params, sd):
+    """The restore core shared by :func:`load_trainer` (training resume)
+    and :func:`load_trainer_params_into_block` (serving restore): read a
+    :func:`save_trainer` step, write the params back in place with their
+    live shardings, and return the full restored tree (the ``extra``
+    updater/RNG blobs ride along for the caller that wants them)."""
+    import orbax.checkpoint as ocp
 
     def _target(p):
         d = p.data()._data
@@ -324,6 +357,21 @@ def load_trainer(trainer, directory, step=0):
                                         item=targets))
     for j, p in enumerate(params):
         p.data()._set_data(restored["params"]["p%d" % j])
+    return restored
+
+
+def load_trainer(trainer, directory, step=0):
+    """Restore a gluon Trainer in place from :func:`save_trainer` output —
+    params with their live shardings, optimizer + loss-scaler + guard
+    state, and the RNG key (bit-exact resume)."""
+    import numpy as np
+
+    from .. import random as _random
+    upd = _trainer_updater(trainer)
+    params = [p for p in trainer._params if p._data is not None]
+    sd = _step_dir(directory, step)
+    _check_trainer_meta(sd, params, "trainer")
+    restored = _restore_trainer_tree(params, sd)
     upd.set_states(np.asarray(restored["extra"]["updater"],
                               np.uint8).tobytes())
     # the blob carried the pickled optimizer (counts, schedules, Nadam's
@@ -340,3 +388,35 @@ def load_trainer(trainer, directory, step=0):
         upd.scaler = trainer._loss_scaler
     _random.set_key_data(np.asarray(restored["extra"]["rng"]))
     return trainer
+
+
+def load_trainer_params_into_block(block, directory, step=None):
+    """Restore ONLY the parameter subtree of a :func:`save_trainer`
+    checkpoint into ``block`` — the serving restore path: a training run
+    promotes straight to a :class:`mxtpu.serving.Predictor` with no
+    format hop, and the optimizer/updater blob + RNG key stay on disk
+    (inference has no use for them, and overwriting the process RNG
+    under a live server would be hostile).
+
+    ``step=None`` resolves the newest finalized step via
+    :func:`latest_step`. The block must enumerate the SAME parameters in
+    the same order as the trainer that saved (positional keys — the
+    usual case: ``Trainer(net.collect_params(), ...)`` on this net's
+    architecture); the sidecar's ``n_params`` fingerprint is checked
+    before the restore so a mismatch refuses loudly."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise MXNetError("no finalized checkpoint step under %s"
+                             % directory)
+    params = list(block.collect_params().values())
+    if not params or any(p._data is None for p in params):
+        raise MXNetError(
+            "initialize the block (and settle deferred shapes with one "
+            "forward) before load_trainer_params_into_block — positional "
+            "keys only align when both sides enumerate every parameter")
+    sd = _step_dir(directory, step)
+    _check_trainer_meta(sd, params, "block")
+    # the restored "extra" (updater blob, RNG key) is deliberately dropped
+    _restore_trainer_tree(params, sd)
+    return step

@@ -55,9 +55,45 @@ class BaseModule:
                     cb(param)
         return eval_metric.get_name_value()
 
+    def _bound_batch_size(self):
+        """The batch size this module's executables were compiled for
+        (first dim of the first bound data shape; None when unbound)."""
+        shapes = getattr(self, "data_shapes", None)
+        if not shapes:
+            return None
+        first = shapes[0]
+        shape = first.shape if hasattr(first, "shape") else first[1]
+        return shape[0] if shape else None
+
+    def _pad_batch_to_bound(self, batch):
+        """Ragged batch -> the bound batch size, via the serving
+        pad-to-bucket helper: a final batch of n < bound rows pads
+        device-side up to bound (``pad`` bumped so output slicing drops
+        the filler) and reuses the existing compiled executable instead
+        of tracing a fresh one per ragged size — the `retrace` telemetry
+        at site ``executor`` stays flat across ragged tails."""
+        bound = self._bound_batch_size()
+        if bound is None or not getattr(batch, "data", None):
+            return batch
+        n = batch.data[0].shape[0]
+        if n >= bound:
+            return batch
+        from ..io import DataBatch
+        from ..serving.engine import pad_nd
+        data = [pad_nd(d, bound) for d in batch.data]
+        label = [pad_nd(l, bound) for l in batch.label] \
+            if batch.label else batch.label
+        return DataBatch(data=data, label=label,
+                         pad=batch.pad + (bound - n), index=batch.index,
+                         bucket_key=getattr(batch, "bucket_key", None),
+                         provide_data=getattr(batch, "provide_data", None),
+                         provide_label=getattr(batch, "provide_label", None))
+
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False):
-        """Collect outputs over an iterator (ref: base_module.py:predict)."""
+        """Collect outputs over an iterator (ref: base_module.py:predict).
+        Ragged batches route through the serving pad-to-bucket helper so
+        they reuse the bound-batch executable (see _pad_batch_to_bound)."""
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
@@ -65,6 +101,7 @@ class BaseModule:
         for nbatch, eval_batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
+            eval_batch = self._pad_batch_to_bound(eval_batch)
             self.forward(eval_batch, is_train=False)
             pad = eval_batch.pad
             outs = [o[0:o.shape[0] - pad] for o in self.get_outputs()]

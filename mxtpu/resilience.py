@@ -83,7 +83,9 @@ def _parse_faults(spec):
     """``kind@i,j;kind2@k`` -> {kind: {i, j}, kind2: {k}}. Kinds in use:
     ``nan_grad`` (optimizer-step index), ``ckpt_io`` (save-attempt index),
     ``sigterm`` (loop step index), ``worker_death`` (dataloader batch
-    index), ``kv_fail`` (dist-reduce attempt index)."""
+    index), ``kv_fail`` (dist-reduce attempt index), ``serve_timeout``
+    (serving batch dispatch index: that batch's requests all expire),
+    ``serve_overload`` (serving submit index: that submit sheds)."""
     faults = {}
     for part in spec.split(";"):
         part = part.strip()
@@ -501,31 +503,12 @@ class ResilientLoop:
         os.replace(tmp, path)
 
     def latest_step(self):
-        """Newest RESUMABLE step: latest.json if its step dir finalized
-        (async orbax materializes step dirs atomically, so existence ==
-        durable), else the newest finalized ``step_*`` directory. All
-        lookups go through epath so gs://-style directories resume too —
-        a preempted job rescheduled onto a fresh host has ONLY the bucket."""
-        from etils import epath
-        d = epath.Path(self._policy.directory)
-        try:
-            candidate = int(json.loads(
-                (d / "latest.json").read_text())["step"])
-        except Exception:  # missing, torn, or backend error: fall back
-            candidate = None
-        if candidate is not None and (d / ("step_%d" % candidate)).is_dir():
-            return candidate
-        steps = []
-        try:
-            for p in d.iterdir():
-                if p.name.startswith("step_") and p.is_dir():
-                    try:
-                        steps.append(int(p.name[5:]))
-                    except ValueError:
-                        pass
-        except Exception:
-            return None
-        return max(steps) if steps else None
+        """Newest RESUMABLE step (None on a fresh directory) — the shared
+        ``contrib.async_checkpoint.latest_step`` scan: latest.json when its
+        step dir finalized, else the newest finalized ``step_*`` dir,
+        epath-routed so gs://-style directories resume from a fresh host."""
+        from .contrib import async_checkpoint as ackpt
+        return ackpt.latest_step(self._policy.directory)
 
     def resume(self):
         """Restore the newest checkpoint into the trainer (params +

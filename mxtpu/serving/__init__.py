@@ -1,0 +1,23 @@
+"""mxtpu.serving: the inference half of the framework.
+
+The TPU-native successor to the reference's C predict API
+(src/c_api/c_predict_api.cc) — see docs/serving.md for the architecture:
+
+* :class:`BucketSpec` / :class:`Predictor` (``engine``) — declared shape
+  buckets, ONE donated AOT-compiled jit per bucket, pad-up / slice-back,
+  compile count pinned by the ``serving.predict`` retrace-watchdog site;
+* :class:`MicroBatcher` (``batcher``) — bounded-queue dynamic
+  micro-batching (coalesce by size or head-of-line wait), per-request
+  deadlines, load shedding, deterministic fault hooks;
+* :class:`ModelServer` (``server``) — stdlib-threaded HTTP front
+  (``/predict`` ``/healthz`` ``/metrics``) with 503 shedding and SIGTERM
+  graceful drain.
+"""
+from .batcher import (DeadlineExceeded, MicroBatcher, QueueFull,
+                      max_batch_default, max_wait_ms_default, queue_default)
+from .engine import BucketSpec, Predictor, pad_nd
+from .server import ModelServer
+
+__all__ = ["BucketSpec", "Predictor", "pad_nd", "MicroBatcher",
+           "QueueFull", "DeadlineExceeded", "ModelServer",
+           "max_batch_default", "max_wait_ms_default", "queue_default"]

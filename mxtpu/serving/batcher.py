@@ -1,0 +1,421 @@
+"""Dynamic micro-batching: coalesce requests into bucketed device batches.
+
+The throughput/latency tradeoff of serving a compiled accelerator model
+is entirely in WHEN you dispatch: per-request dispatch underfills the MXU
+(the serve_bench sweep shows items/s growing with batch), waiting forever
+fills it but blows the latency SLO. The :class:`MicroBatcher` is the
+standard answer (the batching core of every model server): a bounded FIFO
+queue, coalesce until ``max_batch_size`` items are waiting OR the head
+request has waited ``max_wait_ms`` — whichever comes first — then dispatch
+ONE padded bucket through the :class:`~mxtpu.serving.engine.Predictor`.
+
+Semantics:
+
+* **FIFO within bucket** — requests execute in arrival order among those
+  sharing a seq bucket; a different-bucket request never jumps the queue
+  it belongs to (it waits for its own bucket's dispatch).
+* **Bounded queue + load shedding** — ``submit`` on a full queue raises
+  :class:`QueueFull` immediately (the server maps it to 503 and the
+  ``serving.shed`` counter): shedding at admission keeps tail latency
+  bounded for the requests already admitted.
+* **Per-request deadlines** — a request whose deadline passed while it
+  queued is completed with :class:`DeadlineExceeded` at dispatch time
+  instead of burning a device slot on an answer nobody is waiting for.
+* **Deterministic failure paths** — ``MXTPU_FAULT_INJECT`` kinds
+  ``serve_timeout`` (batch dispatch index: that batch's requests all
+  expire) and ``serve_overload`` (submit index: that submit sheds) make
+  both degradation paths testable without wall-clock games.
+* **Testable time** — the clock is injected (``clock=``); tier-1 tests
+  drive a stopped batcher (``start=False``) with a fake clock through
+  :meth:`poll`, so coalesce-by-size vs coalesce-by-deadline are exact
+  assertions, not sleeps.
+
+Telemetry (all through :mod:`mxtpu.telemetry`, folded by
+``tools/telemetry_report.py`` with no changes): ``serving.requests`` /
+``serving.batches`` / ``serving.shed{reason}`` /
+``serving.deadline_expired`` counters, ``serving.queue_depth`` gauge,
+``serving.batch_fill`` + ``serving.latency_s`` (p50/p99 via snapshot)
+histograms, and the ``serving.predict`` / ``serving.fetch`` spans.
+"""
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+
+from .. import telemetry
+from ..base import MXNetError
+from ..resilience import inject
+
+__all__ = ["MicroBatcher", "QueueFull", "DeadlineExceeded",
+           "max_batch_default", "max_wait_ms_default", "queue_default"]
+
+_log = logging.getLogger("mxtpu.serving")
+
+
+# ------------------------------------------------------------------ policies
+def max_batch_default():
+    """Coalescing cap (``MXTPU_SERVE_MAX_BATCH``, default 8): at most this
+    many ITEMS per dispatched batch; normally the Predictor's max bucket."""
+    return int(os.environ.get("MXTPU_SERVE_MAX_BATCH", "8"))
+
+
+def max_wait_ms_default():
+    """Head-of-line wait bound (``MXTPU_SERVE_MAX_WAIT_MS``, default 5):
+    a queued head request dispatches after this many ms even if the batch
+    is not full — the latency half of the coalescing tradeoff."""
+    return float(os.environ.get("MXTPU_SERVE_MAX_WAIT_MS", "5"))
+
+
+def queue_default():
+    """Admission bound in ITEMS (``MXTPU_SERVE_QUEUE``, default 256):
+    beyond it submits shed (503) instead of growing tail latency."""
+    return int(os.environ.get("MXTPU_SERVE_QUEUE", "256"))
+
+
+class QueueFull(MXNetError):
+    """Request shed at admission (queue full / draining / injected
+    overload). The HTTP front maps this to 503."""
+
+
+class DeadlineExceeded(MXNetError):
+    """The request's deadline passed before its batch dispatched (or the
+    ``serve_timeout`` fault fired). The HTTP front maps this to 504."""
+
+
+class _Future:
+    """Minimal completion handle (threading.Event + value-or-error)."""
+
+    __slots__ = ("_event", "_value", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise DeadlineExceeded("no result within %ss" % timeout)
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _Request:
+    __slots__ = ("inputs", "n", "bucket_key", "deadline", "t_enq", "future")
+
+    def __init__(self, inputs, n, bucket_key, deadline, t_enq):
+        self.inputs = inputs
+        self.n = n
+        self.bucket_key = bucket_key
+        self.deadline = deadline
+        self.t_enq = t_enq
+        self.future = _Future()
+
+
+class MicroBatcher:
+    """See the module docstring. ``predictor`` is a warmed
+    :class:`~mxtpu.serving.engine.Predictor` (or any object with
+    ``predict_flat``); ``start=False`` leaves the worker thread off so
+    tests (and the fake clock) drive dispatch through :meth:`poll`."""
+
+    def __init__(self, predictor, max_batch_size=None, max_wait_ms=None,
+                 max_queue=None, clock=time.monotonic, start=True,
+                 allow_cold=False):
+        self._pred = predictor
+        self.max_batch = int(max_batch_size if max_batch_size is not None
+                             else max_batch_default())
+        self.max_wait_s = float(max_wait_ms if max_wait_ms is not None
+                                else max_wait_ms_default()) / 1e3
+        self.max_queue = int(max_queue if max_queue is not None
+                             else queue_default())
+        self._clock = clock
+        self._q = collections.deque()
+        self._items = 0
+        self._cond = threading.Condition()
+        self._draining = False
+        self._closed = False
+        self._batch_index = 0
+        self._inflight = 0     # requests popped from the queue, result not
+        self._thread = None    # yet delivered — drain() waits for BOTH
+        if start:
+            if not allow_cold and not getattr(predictor, "_jits", True):
+                # a cold predictor compiles in the serving hot path — the
+                # exact stall the AOT warmup exists to prevent
+                raise MXNetError(
+                    "MicroBatcher(start=True) on a cold Predictor: call "
+                    "predictor.warmup() first (or pass allow_cold=True)")
+            self.start()
+
+    # ------------------------------------------------------------- admission
+    def submit(self, inputs, deadline_ms=None):
+        """Enqueue one request — ``inputs`` is an array or tuple of arrays
+        sharing batch axis 0 (host numpy stays host-side until dispatch).
+        Returns a future; raises :class:`QueueFull` when shed."""
+        if not isinstance(inputs, (tuple, list)):
+            inputs = (inputs,)
+        if getattr(inputs[0], "ndim", 0) < 1:
+            raise MXNetError("submit: request inputs need a batch axis")
+        n = int(inputs[0].shape[0])
+        if n < 1:
+            raise MXNetError("submit: empty request")
+        if n > self.max_batch:
+            raise MXNetError(
+                "submit: request of %d items exceeds max_batch_size=%d — "
+                "chunk large offline batches through Predictor.predict"
+                % (n, self.max_batch))
+        spec = getattr(self._pred, "spec", None)
+        self._validate_shapes(inputs, spec)
+        bucket_key = None
+        if spec is not None and spec.seq_lens is not None:
+            bucket_key = spec.seq_bucket(
+                int(inputs[0].shape[spec.seq_axis])
+                if inputs[0].ndim > spec.seq_axis else 0)
+        if inject("serve_overload"):
+            self._shed("injected_overload")
+        now = self._clock()
+        deadline = None if deadline_ms is None else now + deadline_ms / 1e3
+        req = _Request(inputs, n, bucket_key, deadline, now)
+        with self._cond:
+            if self._draining or self._closed:
+                self._shed("draining")
+            if self._items + n > self.max_queue:
+                self._shed("queue_full")
+            self._q.append(req)
+            self._items += n
+            telemetry.gauge("serving.queue_depth", self._items)
+            self._cond.notify()
+        telemetry.inc("serving.requests")
+        return req.future
+
+    def _validate_shapes(self, inputs, spec):
+        """Admission-time template check: a malformed request must be
+        refused HERE (``MXNetError`` -> 400), not poison its coalesced
+        cohort (a bad concat fails EVERY co-batched request with a 500)
+        or sneak an off-template shape into a hot-path XLA compile."""
+        templates = getattr(self._pred, "input_templates", None)
+        if templates is None:
+            return
+        if len(inputs) != len(templates):
+            raise MXNetError(
+                "submit: model takes %d input(s), request has %d"
+                % (len(templates), len(inputs)))
+        seq_axis = spec.seq_axis if spec is not None and \
+            spec.seq_lens is not None else None
+        for i, (a, (trail, _dt)) in enumerate(zip(inputs, templates)):
+            if a.ndim != len(trail) + 1:
+                raise MXNetError(
+                    "submit: input %d has %d dims, model expects %d"
+                    % (i, a.ndim, len(trail) + 1))
+            for ax in range(1, a.ndim):
+                if ax == seq_axis:
+                    continue  # bucketed axis: length checked by seq_bucket
+                if a.shape[ax] != trail[ax - 1]:
+                    raise MXNetError(
+                        "submit: input %d axis %d is %d, model expects %d"
+                        % (i, ax, a.shape[ax], trail[ax - 1]))
+
+    def _shed(self, reason):
+        telemetry.inc("serving.shed", tag=reason)
+        raise QueueFull("request shed: %s" % reason)
+
+    @property
+    def queue_depth(self):
+        return self._items
+
+    @property
+    def draining(self):
+        return self._draining
+
+    # ------------------------------------------------------------ coalescing
+    def _gather_locked(self, now):
+        """Under the lock: the coalescing rule. Takes the head request's
+        bucket cohort in FIFO order up to ``max_batch`` items; dispatches
+        when full, when the head waited ``max_wait_s``, or when draining.
+        Returns the requests to dispatch, or None to keep waiting."""
+        if not self._q:
+            return None
+        head = self._q[0]
+        take, n = [], 0
+        for r in self._q:
+            if r.bucket_key != head.bucket_key:
+                continue  # FIFO within bucket: other cohorts keep queueing
+            if n + r.n > self.max_batch:
+                break
+            take.append(r)
+            n += r.n
+            if n == self.max_batch:
+                break
+        if n >= self.max_batch or self._draining or \
+                (now - head.t_enq) >= self.max_wait_s:
+            for r in take:
+                self._q.remove(r)  # O(queue) but queues are bounded-small
+            self._items -= n
+            telemetry.gauge("serving.queue_depth", self._items)
+            return take
+        return None
+
+    def poll(self):
+        """Dispatch at most one coalesced batch if the rule allows it NOW
+        (non-blocking — the fake-clock test hook and the drain helper).
+        Returns the number of requests dispatched."""
+        with self._cond:
+            batch = self._gather_locked(self._clock())
+            if batch:
+                self._inflight += len(batch)
+        if not batch:
+            return 0
+        try:
+            self._dispatch(batch)
+        finally:
+            with self._cond:
+                self._inflight -= len(batch)
+                self._cond.notify_all()
+        return len(batch)
+
+    # -------------------------------------------------------------- dispatch
+    def _dispatch(self, batch):
+        import numpy as np
+        idx = self._batch_index
+        self._batch_index += 1
+        now = self._clock()
+        live = []
+        for r in batch:
+            if r.deadline is not None and now > r.deadline:
+                self._expire(r)
+            else:
+                live.append(r)
+        if live and inject("serve_timeout", idx):
+            # deterministic timeout path: the whole batch expires as if the
+            # device never answered within anyone's deadline
+            for r in live:
+                self._expire(r)
+            live = []
+        if not live:
+            return
+        try:
+            n_inputs = len(live[0].inputs)
+            spec = getattr(self._pred, "spec", None)
+            seq = live[0].bucket_key  # the cohort's shared seq bucket
+            joined = []
+            for i in range(n_inputs):
+                parts = [np.asarray(r.inputs[i]) for r in live]
+                if seq is not None and spec is not None:
+                    # one cohort, one seq bucket — but raw lengths differ;
+                    # pad each request host-side to the cohort bucket so
+                    # the concat (and the device pad) see one shape
+                    ax = spec.seq_axis
+                    parts = [np.pad(p, [(0, seq - p.shape[ax])
+                                        if d == ax else (0, 0)
+                                        for d in range(p.ndim)],
+                                    constant_values=spec.pad_value)
+                             if p.ndim > ax and p.shape[ax] != seq else p
+                             for p in parts]
+                joined.append(parts[0] if len(parts) == 1
+                              else np.concatenate(parts, axis=0))
+            # device work: pad -> compiled forward -> slice (zero d2h)
+            flat, _fmt, _bucket = self._pred.predict_flat(tuple(joined))
+            # the ONE declared d2h of the serving loop: fetch outputs once
+            # per batch, split per request host-side
+            with telemetry.span("serving.fetch", cat="sync"):
+                host = [o.asnumpy() for o in flat]
+        except Exception as e:  # noqa: BLE001 — a bad batch must not kill
+            for r in live:      # the worker; every caller gets the error
+                self._fail(r, e)
+            telemetry.inc("serving.batch_errors")
+            _log.exception("serving batch %d failed", idx)
+            return
+        telemetry.inc("serving.batches")
+        off = 0
+        done = self._clock()
+        for r in live:
+            outs = [h[off:off + r.n] for h in host]
+            off += r.n
+            r.future._value = outs[0] if len(outs) == 1 else tuple(outs)
+            r.future._event.set()
+            telemetry.observe("serving.latency_s", done - r.t_enq)
+
+    def _expire(self, req):
+        telemetry.inc("serving.deadline_expired")
+        self._fail(req, DeadlineExceeded(
+            "deadline passed before dispatch (queued %.1f ms)"
+            % ((self._clock() - req.t_enq) * 1e3)))
+
+    @staticmethod
+    def _fail(req, error):
+        req.future._error = error
+        req.future._event.set()
+
+    # ---------------------------------------------------------------- worker
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="mxtpu-serving-batcher")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                batch = None
+                while batch is None:
+                    if self._closed and not self._q:
+                        return
+                    now = self._clock()
+                    batch = self._gather_locked(now)
+                    if batch is not None:
+                        break
+                    if self._draining and not self._q:
+                        # drained: park until new state (close or, never,
+                        # new work — submits reject while draining)
+                        self._cond.wait(0.05)
+                        continue
+                    if self._q:
+                        head_due = self._q[0].t_enq + self.max_wait_s - now
+                        self._cond.wait(max(head_due, 1e-4))
+                    else:
+                        self._cond.wait()
+                self._inflight += len(batch)
+            try:
+                self._dispatch(batch)
+            finally:
+                with self._cond:
+                    self._inflight -= len(batch)
+                    self._cond.notify_all()
+
+    # ----------------------------------------------------------------- drain
+    def drain(self, timeout=None):
+        """Stop admitting (submits shed with reason ``draining``), finish
+        everything queued and in flight, return True when empty. The
+        SIGTERM path of :class:`~mxtpu.serving.server.ModelServer`."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._thread is None or not self._thread.is_alive():
+                while self.poll():
+                    pass
+            with self._cond:
+                if not self._q and self._inflight == 0:
+                    return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.002)
+
+    def close(self, timeout=5.0):
+        """Drain, then stop the worker thread."""
+        self.drain(timeout=timeout)
+        with self._cond:
+            self._closed = True
+            self._draining = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        return self

@@ -1,0 +1,425 @@
+"""AOT-bucketed inference engine: BucketSpec + Predictor.
+
+The reference ships a dedicated inference surface — the C predict API
+(src/c_api/c_predict_api.cc: create from (symbol-json, params-blob),
+set-input, forward, get-output) — built so a deployed model never touches
+the training machinery. On a jit-compiled TPU stack the deployment problem
+is different: execution is already compiled, but every NEW request shape
+means a fresh XLA trace, and a serving box that compiles in the hot path
+is down for seconds at a time. The TPU-native answer (TVM's
+compile-for-deployment flow, arXiv:1802.04799; PyGraph's capture-once /
+replay-forever discipline for CUDA Graphs, arXiv:2503.19779):
+
+* a :class:`BucketSpec` declares the closed set of (batch x seq/spatial)
+  shapes the service will ever execute,
+* :class:`Predictor` ahead-of-time compiles ONE donated inference jit per
+  bucket at startup (``warmup()``), pads each request up to its bucket,
+  and slices outputs back — a device-side slice, so the only
+  device->host transfer is the caller's explicit output fetch,
+* every compile is reported to the PR-4 retrace watchdog at site
+  ``serving.predict``; after warmup the compile count at that site is
+  <= #buckets by construction, and a mid-traffic compile (off-template
+  request shape, policy env flipped under the server) is attributable
+  from ``telemetry.report()`` alone.
+
+Three load paths, mirroring the reference's predict-API inputs:
+
+* ``Predictor(block, spec)`` — a gluon ``HybridBlock`` (its compiled
+  forward is rebuilt per bucket from the same ``_run_traced`` machinery
+  ``CachedOp`` uses, gluon/block.py:375);
+* ``Predictor.from_checkpoint(prefix, epoch, spec)`` — symbol-json +
+  params checkpoint via ``SymbolBlock`` (the c_predict_api shape);
+* ``Predictor.from_trainer_checkpoint(block, directory, spec)`` — the
+  params subtree of a ``contrib.async_checkpoint.save_trainer`` orbax
+  checkpoint (a training run promotes straight to serving, no format
+  hop).
+
+The bf16/policy levers ride along: ``ops.registry.policy_key`` is part of
+every bucket's jit cache key, so ``net.cast('bfloat16')`` + policy envs
+serve exactly like they train.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import telemetry
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["BucketSpec", "Predictor", "pad_nd"]
+
+
+def pad_nd(arr, batch, seq_len=None, seq_axis=1, pad_value=0):
+    """Pad ``arr`` (NDArray / jax / numpy) with ``pad_value`` rows up to
+    ``batch`` along axis 0 — and, when ``seq_len`` is given and the array
+    has a ``seq_axis`` dimension, up to ``seq_len`` along that axis too.
+    Device-side (``jnp.pad``): no host sync, so it is safe inside the
+    zero-d2h predict span. Returns an NDArray."""
+    d = arr._data if isinstance(arr, NDArray) else jnp.asarray(arr)
+    pads = [(0, 0)] * d.ndim
+    if d.shape[0] > batch:
+        raise MXNetError("pad_nd: batch %d exceeds bucket %d"
+                         % (d.shape[0], batch))
+    pads[0] = (0, batch - d.shape[0])
+    if seq_len is not None and d.ndim > seq_axis:
+        if d.shape[seq_axis] > seq_len:
+            raise MXNetError("pad_nd: axis %d size %d exceeds bucket %d"
+                             % (seq_axis, d.shape[seq_axis], seq_len))
+        pads[seq_axis] = (0, seq_len - d.shape[seq_axis])
+    if not any(p[1] for p in pads):
+        return arr if isinstance(arr, NDArray) else NDArray(d)
+    return NDArray(jnp.pad(d, pads, constant_values=pad_value))
+
+
+def _as_nds(args):
+    return [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a))
+            for a in args]
+
+
+def _eager_forward(block, nds):
+    """One eager forward with taping off — settles deferred parameter
+    shapes (shared by Predictor._settle and the pre-restore settle in
+    from_trainer_checkpoint)."""
+    from .. import autograd
+    with autograd.pause():
+        block(*nds)
+
+
+class BucketSpec:
+    """The closed set of compiled shapes a Predictor serves.
+
+    ``batch_sizes`` are the batch buckets (ascending); a request of n
+    items executes at the smallest bucket >= n (requests larger than the
+    max bucket are chunked). ``seq_lens`` optionally adds a second bucket
+    axis for variable-length inputs (sequence length / spatial dim along
+    ``seq_axis`` of every input that has it); a request whose seq exceeds
+    the max seq bucket is refused — sequences, unlike batches, cannot be
+    chunked without changing the model's semantics.
+
+    Guidance (docs/serving.md): powers of two up to the throughput knee
+    of the model (``tools/serve_bench.py --mode sweep`` finds it);
+    #buckets is also the startup compile count and the per-model
+    executable-cache footprint, so keep it small (4-8 is typical).
+    """
+
+    def __init__(self, batch_sizes, seq_lens=None, seq_axis=1, pad_value=0):
+        sizes = sorted({int(b) for b in batch_sizes})
+        if not sizes or sizes[0] < 1:
+            raise MXNetError("BucketSpec: batch_sizes must be >= 1, got %r"
+                             % (batch_sizes,))
+        self.batch_sizes = tuple(sizes)
+        self.seq_lens = tuple(sorted({int(s) for s in seq_lens})) \
+            if seq_lens else None
+        self.seq_axis = int(seq_axis)
+        self.pad_value = pad_value
+
+    @classmethod
+    def pow2(cls, max_batch, seq_lens=None, seq_axis=1):
+        """1, 2, 4, ... up to (and including) ``max_batch``."""
+        sizes, b = [], 1
+        while b < int(max_batch):
+            sizes.append(b)
+            b *= 2
+        sizes.append(int(max_batch))
+        return cls(sizes, seq_lens=seq_lens, seq_axis=seq_axis)
+
+    @property
+    def max_batch(self):
+        return self.batch_sizes[-1]
+
+    def batch_bucket(self, n):
+        """Smallest batch bucket >= n (None when n exceeds the max — the
+        caller chunks)."""
+        for b in self.batch_sizes:
+            if n <= b:
+                return b
+        return None
+
+    def seq_bucket(self, s):
+        """Smallest seq bucket >= s; raises when s exceeds the max."""
+        if self.seq_lens is None:
+            return None
+        for L in self.seq_lens:
+            if s <= L:
+                return L
+        raise MXNetError(
+            "request seq length %d exceeds the largest declared bucket %d "
+            "(BucketSpec.seq_lens=%s) — sequences cannot be chunked"
+            % (s, self.seq_lens[-1], list(self.seq_lens)))
+
+    def buckets(self):
+        """Every (batch, seq-or-None) combo — the startup compile set."""
+        seqs = self.seq_lens or (None,)
+        return [(b, s) for b in self.batch_sizes for s in seqs]
+
+    def __len__(self):
+        return len(self.batch_sizes) * len(self.seq_lens or (None,))
+
+    def __repr__(self):
+        return "BucketSpec(batch=%s%s)" % (
+            list(self.batch_sizes),
+            ", seq=%s@axis%d" % (list(self.seq_lens), self.seq_axis)
+            if self.seq_lens else "")
+
+
+class Predictor:
+    """AOT-bucketed compiled inference over a gluon block.
+
+    One donated ``jax.jit`` per (bucket-shapes, ``policy_key``) — the
+    input buffers are freshly materialized padded arrays, so donating
+    them back to XLA is free memory headroom; parameters stay
+    un-donated and are reused across every call. ``warmup()`` compiles
+    the whole :class:`BucketSpec` up front (call it before taking
+    traffic; the :class:`~mxtpu.serving.batcher.MicroBatcher` refuses to
+    start on a cold predictor unless told otherwise).
+
+    ``predict()`` is thread-compatible after warmup: the jit cache is
+    only written on a miss (warmup fills it), and compiled executables
+    are safe to invoke concurrently.
+    """
+
+    def __init__(self, block, spec, example=None, warmup=False,
+                 name="predictor"):
+        if not hasattr(block, "_forward_eager"):
+            raise MXNetError(
+                "Predictor serves HybridBlock-family models (got %s); wrap "
+                "symbols via Predictor.from_checkpoint" % type(block).__name__)
+        self._block = block
+        self._spec = spec
+        self._name = name
+        self._params = None        # ordered list, fixed at first build
+        self._param_datas = None
+        self._templates = None     # [(trailing_shape, dtype)] per input
+        self._jits = {}            # (padded shapes+dtypes, policy) -> (fn, cell)
+        if example is not None:
+            self._settle(example if isinstance(example, (tuple, list))
+                         else (example,))
+        if warmup:
+            self.warmup()
+
+    # ------------------------------------------------------------ templates
+    def _settle(self, args):
+        """Record each input's trailing shape + dtype (the per-bucket zero
+        templates warmup compiles against) and fix the parameter list —
+        running one eager forward first only if deferred shapes are still
+        unsettled."""
+        nds = _as_nds(args)
+        params = list(self._block.collect_params().values())
+        if not params or any(p._data is None for p in params):
+            _eager_forward(self._block, nds)
+            params = list(self._block.collect_params().values())
+        if any(p._data is None for p in params):
+            raise MXNetError("Predictor: parameters still uninitialized "
+                             "after the example forward")
+        self._params = params
+        self._param_datas = [p.data()._data for p in params]
+        self._templates = [(tuple(a._data.shape[1:]), a._data.dtype)
+                           for a in nds]
+
+    @property
+    def spec(self):
+        return self._spec
+
+    @property
+    def input_templates(self):
+        """[(trailing_shape, dtype)] per input (None before settle)."""
+        return self._templates
+
+    def refresh_params(self):
+        """Re-snapshot parameter buffers (after an in-place reload) without
+        recompiling — the jits close over nothing, params are arguments."""
+        self._param_datas = [p.data()._data for p in self._params]
+
+    # ------------------------------------------------------------ compiling
+    def _get_jit(self, shape_key):
+        from ..ops.registry import policy_key
+        key = (shape_key, policy_key())
+        hit = self._jits.get(key)
+        if hit is not None:
+            return hit
+        # retrace watchdog: every serving compile is a served-request stall
+        # — after warmup this site MUST stay at #buckets (an off-template
+        # request shape or a policy env flip under the server shows up
+        # here with full provenance)
+        telemetry.record_retrace(
+            "serving.predict",
+            {"predictor": self._name, "block": type(self._block).__name__,
+             "shapes": [list(s) for s, _ in shape_key],
+             "policy_key": list(key[1])})
+        block, params = self._block, self._params
+        fixed_key = jax.random.PRNGKey(0)  # deterministic inference: no
+        # stochastic layers are live under train=False
+        cell = {}
+
+        def pure(in_datas, param_datas):
+            from ..gluon.block import _flatten_nd, _run_traced
+
+            def body():
+                return block(*[NDArray(d) for d in in_datas])
+
+            out, _aux = _run_traced(params, param_datas, fixed_key, False,
+                                    body)
+            fmt = []
+            flat = _flatten_nd(out, fmt)
+            cell["out_fmt"] = fmt
+            return [o._data for o in flat]
+
+        # donate the request buffers (fresh padded arrays) back to XLA —
+        # free memory headroom per in-flight bucket. The CPU backend does
+        # not implement donation and would warn per compile, so gate it.
+        donate = (0,) if jax.default_backend() != "cpu" else ()
+        jitted = jax.jit(pure, donate_argnums=donate)
+        self._jits[key] = (jitted, cell)
+        return jitted, cell
+
+    def warmup(self):
+        """AOT-compile every bucket in the spec (zero-filled template
+        inputs, one blocking call each). Returns self. Idempotent: warm
+        buckets are cache hits."""
+        if self._templates is None:
+            raise MXNetError("Predictor.warmup needs input templates: pass "
+                             "example= at construction")
+        for b, s in self._spec.buckets():
+            datas = [jnp.zeros((b,) + self._bucket_trailing(t, s), dt)
+                     for t, dt in self._templates]
+            flat, _ = self._run_padded(datas)
+            jax.block_until_ready([o._data for o in flat])
+        telemetry.gauge("serving.buckets", len(self._spec))
+        return self
+
+    def _bucket_trailing(self, trailing, seq):
+        if seq is None:
+            return trailing
+        ax = self._spec.seq_axis - 1  # trailing shape drops the batch dim
+        if ax < len(trailing):
+            t = list(trailing)
+            t[ax] = seq
+            return tuple(t)
+        return trailing
+
+    # ------------------------------------------------------------ predicting
+    def _run_padded(self, datas):
+        """Dispatch already-bucket-shaped jax arrays; returns (flat output
+        NDArrays at bucket batch, cell)."""
+        shape_key = tuple((tuple(d.shape), str(d.dtype)) for d in datas)
+        jitted, cell = self._get_jit(shape_key)
+        out = jitted(list(datas), self._param_datas)
+        return [NDArray(d) for d in out], cell
+
+    def predict_flat(self, args):
+        """Pad ``args`` (a tuple of per-input arrays sharing batch axis 0)
+        to their bucket, run the compiled forward, and slice back: returns
+        ``(flat_outputs, out_fmt, bucket_batch)`` where flat_outputs are
+        device NDArrays sliced to the request's n — NO host sync happens
+        here; fetching the outputs is the caller's declared d2h.
+
+        Requests larger than the max bucket are chunked through it and
+        re-concatenated on device."""
+        if self._templates is None:
+            self._settle(args)
+        spec = self._spec
+        # the jit DONATES its input buffers; a caller's live buffer reaching
+        # it un-padded (exact bucket fit) would be invalidated under the
+        # caller — protect every buffer the caller still holds a reference
+        # to (NDArray._data, and raw jax arrays where asarray is identity;
+        # numpy inputs become fresh device buffers and need no copy)
+        datas, user_bufs = [], set()
+        for a in args:
+            d = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+            if isinstance(a, NDArray) or d is a:
+                user_bufs.add(id(d))
+            datas.append(d)
+        n = int(datas[0].shape[0])
+        if n == 0:
+            raise MXNetError("predict on an empty batch")
+        seq = None
+        if spec.seq_lens is not None:
+            seq = spec.seq_bucket(int(datas[0].shape[spec.seq_axis])
+                                  if datas[0].ndim > spec.seq_axis else 0)
+        with telemetry.span("serving.predict", d2h=True):
+            b = spec.batch_bucket(n)
+            if b is None:
+                # chunk through the max bucket, concat on device
+                chunks, fmt, bucket = [], None, spec.max_batch
+                for lo in range(0, n, bucket):
+                    part = [d[lo:lo + bucket] for d in datas]
+                    flat, fmt, _ = self._dispatch_one(part, seq, bucket,
+                                                      user_bufs)
+                    chunks.append(flat)
+                flat_out = [NDArray(jnp.concatenate(
+                    [c[i]._data for c in chunks], axis=0))
+                    for i in range(len(chunks[0]))]
+                telemetry.inc("serving.items", n)
+                return flat_out, fmt, bucket
+            flat, fmt, _ = self._dispatch_one(datas, seq, b, user_bufs)
+            telemetry.inc("serving.items", n)
+            return flat, fmt, b
+
+    def _dispatch_one(self, datas, seq, bucket, protect=()):
+        n = int(datas[0].shape[0])
+        padded = [pad_nd(d, bucket, seq_len=seq, seq_axis=self._spec.seq_axis,
+                         pad_value=self._spec.pad_value)._data for d in datas]
+        padded = [jnp.copy(d) if id(d) in protect else d for d in padded]
+        flat, cell = self._run_padded(padded)
+        telemetry.observe("serving.batch_fill", n / float(bucket))
+        if n != bucket:
+            flat = [NDArray(o._data[:n]) for o in flat]
+        return flat, cell["out_fmt"], bucket
+
+    def predict(self, *args):
+        """The user-facing call: accepts NDArrays / numpy arrays, returns
+        the block's output structure (single NDArray or tuple) sliced to
+        the request batch. Device outputs — call ``.asnumpy()`` to fetch
+        (the one declared d2h of the serving hot path)."""
+        from ..gluon.block import _regroup
+        flat, fmt, _ = self.predict_flat(args)
+        out, _, _ = _regroup(flat, fmt)
+        return out
+
+    def compile_stats(self):
+        """The watchdog's view of this process's serving compiles:
+        {compiles, trips, last} (None before any compile)."""
+        return telemetry.retrace_stats("serving.predict")
+
+    # ------------------------------------------------------------ load paths
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, spec, input_names=("data",),
+                        example=None, warmup=False, name=None):
+        """The c_predict_api shape: (symbol-json, params) checkpoint on
+        disk -> a served SymbolBlock. ``prefix``/``epoch`` follow
+        ``model.save_checkpoint`` / ``HybridBlock.export`` naming."""
+        from .. import symbol as sym_mod
+        from ..gluon.block import SymbolBlock
+        from ..model import load_checkpoint
+        sym, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        if sym is None:
+            raise MXNetError("no symbol file at %s-symbol.json" % prefix)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        blk = SymbolBlock(sym, [sym_mod.var(n) for n in input_names])
+        pd = blk.collect_params()
+        for pname, arr in list(arg_params.items()) + list(aux_params.items()):
+            if pname in pd:
+                pd[pname].set_data(arr)
+        return cls(blk, spec, example=example, warmup=warmup,
+                   name=name or ("ckpt:" + str(prefix)))
+
+    @classmethod
+    def from_trainer_checkpoint(cls, block, directory, spec, step=None,
+                                example=None, warmup=False, name=None):
+        """Serve straight from a training run's orbax checkpoint: restores
+        ONLY the params subtree of a ``contrib.async_checkpoint.
+        save_trainer`` step (latest finalized step when ``step=None``)
+        into ``block`` — optimizer state and RNG stay untouched. The
+        block must be built + initialized with shapes settled, exactly
+        like the trainer that saved (positional keys)."""
+        from ..contrib import async_checkpoint as ackpt
+        if example is not None and any(
+                p._data is None for p in block.collect_params().values()):
+            # settle deferred shapes BEFORE the positional-key restore
+            _eager_forward(block, _as_nds(
+                example if isinstance(example, (tuple, list)) else (example,)))
+        ackpt.load_trainer_params_into_block(block, directory, step=step)
+        return cls(block, spec, example=example, warmup=warmup,
+                   name=name or ("trainer:" + str(directory)))

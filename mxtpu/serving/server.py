@@ -1,0 +1,232 @@
+"""Load-shedding HTTP model server over the Predictor + MicroBatcher.
+
+The reference's deployment story ends at the C predict API; the ROADMAP's
+north star is "serves heavy traffic from millions of users", which needs
+the three behaviours every production front has and no notebook loop
+does:
+
+* **admission control** — a full queue answers 503 *now* (the
+  ``serving.shed`` counter, by reason) instead of letting tail latency
+  grow without bound;
+* **observability** — ``/metrics`` returns ``telemetry.snapshot()``
+  (counters, latency histograms, the ``serving.predict`` retrace-watchdog
+  state) so the box is debuggable from the outside;
+* **graceful drain** — SIGTERM (the preemption signal, same discipline
+  as :class:`mxtpu.resilience.ResilientLoop`) flips the server to
+  draining: new work is rejected with 503, queued + in-flight batches
+  finish and deliver their responses, then the listener can be closed.
+
+Stdlib-threaded (``http.server.ThreadingHTTPServer``) on purpose: one
+request-handler thread parks per in-flight request while the single
+batcher worker owns all device dispatch, so concurrency never reaches
+jax. JSON in/out; this is the reference-quality front (and the thing
+load-balancers health-check), not a gRPC replacement.
+
+Endpoints::
+
+    POST /predict   {"data": [[...], ...], "deadline_ms": 250}
+                    -> 200 {"outputs": [...], "n": k}
+                    -> 503 shed/draining, 504 deadline, 400 bad request
+    GET  /healthz   {"status": "ok"|"draining", "queue_depth": d}
+    GET  /metrics   telemetry.snapshot() as JSON
+"""
+from __future__ import annotations
+
+import json
+import logging
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .. import telemetry
+from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
+
+__all__ = ["ModelServer"]
+
+_log = logging.getLogger("mxtpu.serving")
+
+
+class ModelServer:
+    """HTTP front for a :class:`~mxtpu.serving.batcher.MicroBatcher` (or a
+    bare Predictor, which gets a default batcher). ``port=0`` picks a free
+    port (tests); ``server.address`` is the bound (host, port)."""
+
+    def __init__(self, batcher, host="127.0.0.1", port=0,
+                 request_timeout_s=30.0):
+        if not isinstance(batcher, MicroBatcher):
+            batcher = MicroBatcher(batcher)
+        self._batcher = batcher
+        self._timeout = float(request_timeout_s)
+        self._httpd = ThreadingHTTPServer((host, port), _make_handler(self))
+        self._httpd.daemon_threads = True
+        self._thread = None
+        self._drain_thread = None
+        self._prev_handlers = {}
+        self.draining = False
+
+    @property
+    def address(self):
+        return self._httpd.server_address
+
+    @property
+    def batcher(self):
+        return self._batcher
+
+    # ---------------------------------------------------------------- running
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, kwargs={"poll_interval": 0.05},
+                daemon=True, name="mxtpu-serving-http")
+            self._thread.start()
+        return self
+
+    def serve_forever(self):
+        """Foreground mode (a real deployment's main thread)."""
+        self.install_signal_handlers()
+        self._httpd.serve_forever(poll_interval=0.05)
+
+    # ------------------------------------------------------------------ drain
+    def install_signal_handlers(self, signals=(signal.SIGTERM,)):
+        """SIGTERM -> graceful drain (main thread only; off it python
+        refuses handlers — call :meth:`begin_drain` yourself there, the
+        ResilientLoop degradation)."""
+        try:
+            for sig in signals:
+                self._prev_handlers[sig] = signal.signal(sig, self._on_signal)
+        except ValueError:
+            _log.warning("ModelServer: cannot install signal handlers off "
+                         "the main thread; call begin_drain() on shutdown")
+        return self
+
+    def uninstall_signal_handlers(self):
+        for sig, prev in self._prev_handlers.items():
+            signal.signal(sig, prev)
+        self._prev_handlers = {}
+
+    def _on_signal(self, signum, frame):
+        # the handler does the MINIMUM: flip the flag, hand the actual
+        # drain (IO, locks, device syncs) to a worker thread
+        self.draining = True
+        telemetry.inc("serving.drains")
+        t = threading.Thread(target=self.begin_drain, daemon=True,
+                             name="mxtpu-serving-drain")
+        self._drain_thread = t
+        t.start()
+
+    def begin_drain(self, timeout=None):
+        """Reject new work, finish queued + in-flight batches. The
+        listener stays up (503 + ``/healthz`` "draining") until
+        :meth:`close` — load balancers need the endpoint alive to observe
+        the drain. Returns True when fully drained."""
+        self.draining = True
+        return self._batcher.drain(timeout=timeout)
+
+    def close(self, timeout=5.0):
+        """Drain, stop the batcher worker, stop the listener."""
+        self.begin_drain(timeout=timeout)
+        self._batcher.close(timeout=timeout)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        self.uninstall_signal_handlers()
+        return self
+
+    # ---------------------------------------------------------------- request
+    def _handle_predict(self, body):
+        """Returns (status, payload-dict). Runs on the handler thread —
+        it parks on the future while the batcher coalesces."""
+        from ..base import MXNetError
+        if self.draining:
+            telemetry.inc("serving.shed", tag="draining")
+            return 503, {"error": "draining"}
+        raw = body.get("inputs")
+        if raw is None:
+            raw = [body.get("data")]
+        if not raw or raw[0] is None:
+            return 400, {"error": "missing 'data' (or 'inputs') field"}
+        templates = getattr(self._batcher._pred, "input_templates", None)
+        arrays = []
+        for i, a in enumerate(raw):
+            dtype = None
+            if templates is not None and i < len(templates):
+                dtype = templates[i][1]
+            try:
+                arrays.append(np.asarray(a, dtype=dtype))
+            except (ValueError, TypeError) as e:  # ragged/unconvertible JSON
+                return 400, {"error": "input %d not array-shaped: %s"
+                             % (i, e)}
+        try:
+            # default the batcher deadline to the handler timeout: once the
+            # handler answers 504 and walks away, the queued request would
+            # otherwise still dispatch and burn a device slot on an answer
+            # nobody is waiting for — exactly under the overload that made
+            # it time out
+            deadline_ms = body.get("deadline_ms", self._timeout * 1e3)
+            fut = self._batcher.submit(tuple(arrays),
+                                       deadline_ms=deadline_ms)
+            out = fut.result(timeout=self._timeout)
+        except QueueFull as e:
+            return 503, {"error": str(e)}
+        except DeadlineExceeded as e:
+            return 504, {"error": str(e)}
+        except MXNetError as e:
+            # submit's request-shape refusals (empty batch, > max_batch,
+            # seq past the largest bucket): the CLIENT's fault, not a 500
+            # — monitoring treats 5xx as server faults and would page/eject
+            # a healthy instance over one misbehaving caller
+            return 400, {"error": str(e)}
+        outs = list(out) if isinstance(out, tuple) else [out]
+        return 200, {"outputs": [o.tolist() for o in outs],
+                     "n": int(arrays[0].shape[0])}
+
+
+def _make_handler(srv):
+    class Handler(BaseHTTPRequestHandler):
+        server_version = "mxtpu-serving/1"
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # stdout silence; debug-level log
+            _log.debug("http %s", fmt % args)
+
+        def _reply(self, code, payload):
+            body = json.dumps(payload, default=str).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, {
+                    "status": "draining" if srv.draining else "ok",
+                    "queue_depth": srv._batcher.queue_depth})
+            elif self.path == "/metrics":
+                self._reply(200, telemetry.snapshot())
+            else:
+                self._reply(404, {"error": "unknown path %s" % self.path})
+
+        def do_POST(self):
+            if self.path != "/predict":
+                self._reply(404, {"error": "unknown path %s" % self.path})
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, TypeError) as e:
+                self._reply(400, {"error": "bad json: %s" % e})
+                return
+            try:
+                code, payload = srv._handle_predict(body)
+            except Exception as e:  # noqa: BLE001 — a handler crash must
+                _log.exception("predict handler failed")  # answer, not hang
+                code, payload = 500, {"error": "%s: %s"
+                                      % (type(e).__name__, e)}
+            self._reply(code, payload)
+
+    return Handler

@@ -176,6 +176,17 @@ class Executor:
         if key not in self._jits:
             sym = self._symbol
             names = sorted(feed)
+            # retrace watchdog: every executor cache miss is one compile.
+            # Ragged final predict batches pad to the bound batch size
+            # (BaseModule._pad_batch_to_bound) precisely so this site
+            # stays flat through an epoch tail
+            from .. import telemetry
+            telemetry.record_retrace(
+                "executor",
+                {"is_train": is_train,
+                 "inputs": [(n, tuple(feed[n].shape)) for n in names
+                            if n in getattr(self, "_input_names", ())],
+                 "policy_key": list(key[1])})
 
             def pure(datas):
                 fd = {n: NDArray(d) for n, d in zip(names, datas)}
@@ -222,6 +233,10 @@ class Executor:
         key = ("bwd", is_train, policy_key()) + tuple(
             (k, feed[k].shape, str(feed[k].dtype)) for k in names)
         if key not in self._jits:
+            from .. import telemetry
+            telemetry.record_retrace(
+                "executor.backward",
+                {"is_train": is_train, "policy_key": list(key[2])})
             def bwd(datas, cots):
                 def f(diff_datas):
                     full = dict(zip(names, datas))

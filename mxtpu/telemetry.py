@@ -72,6 +72,20 @@ _RETRACE = {}
 _D2H_WARNED = set()
 _D2H_WARMUP = 2           # first occurrences of a span may legitimately sync
 
+
+class _D2HLocal(threading.local):
+    """Per-thread d2h sync count. Span attribution reads THIS, not the
+    global counter: a span times a host region on its own thread, so a
+    concurrent server thread's ``asnumpy`` (the serving fetch path) must
+    not land in another thread's ``<name>.d2h`` delta. The global
+    ``transfer.d2h`` counter still aggregates every thread."""
+
+    def __init__(self):
+        self.count = 0
+
+
+_D2H_LOCAL = _D2HLocal()
+
 # JSONL sink: hot path appends to the queue; a flush (explicit, atexit, or
 # the off-thread timer) drains it to the file
 _SINK = {"queue": collections.deque(maxlen=1 << 20), "thread": None,
@@ -313,9 +327,10 @@ class span:
             self._sink = lever if lever != "1" else None
             self._t0 = time.perf_counter_ns()
             if self._d2h:
-                # lock-free read: a counter read races only with other
-                # increments, and a one-off-by-one delta is harmless here
-                self._d0 = _COUNTERS.get(("transfer.d2h", None), 0)
+                # thread-local snapshot: only syncs issued by THIS thread
+                # inside the region are attributed — concurrent server
+                # threads cannot corrupt another span's delta
+                self._d0 = _D2H_LOCAL.count
         return self
 
     def __exit__(self, *exc):
@@ -344,7 +359,7 @@ class span:
             _queue_line({"t": time.time(), "kind": "obs", "metric": name,
                          "value": v}, self._sink)
         if self._d0 is not None:
-            delta = _COUNTERS.get(("transfer.d2h", None), 0) - self._d0
+            delta = _D2H_LOCAL.count - self._d0
             if delta:
                 inc(name + ".d2h", delta)
                 self._watchdog(delta, occurrences)
@@ -366,9 +381,12 @@ class span:
 # -------------------------------------------------------- transfer watchdog
 def record_d2h(n=1):
     """Called from the NDArray sync points (``asnumpy`` and friends): one
-    global device->host sync counter, always on. Spans opened with
-    ``d2h=True`` attribute deltas of this counter to their region."""
+    global device->host sync counter, always on, plus a thread-local count
+    — spans opened with ``d2h=True`` attribute the THREAD-LOCAL delta to
+    their region, so concurrent server threads (``mxtpu.serving``) cannot
+    pollute the hot loop's per-region attribution."""
     inc("transfer.d2h", n)
+    _D2H_LOCAL.count += n
 
 
 def d2h_count():

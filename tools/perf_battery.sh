@@ -65,4 +65,12 @@ timeout 900 env PYTHONPATH=.:/root/.axon_site python tools/bandwidth.py \
   --sizes-mb 16,64 2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
 telemetry_report
 
+# 3. serving phase (ISSUE 5): batch-bucket sweep + closed-loop + offered-QPS
+#    overload curve against the in-process Predictor — the inference-side
+#    numbers (items/s per bucket, p99 under load, shed behaviour)
+sleep 60
+timeout 600 python tools/serve_bench.py --requests 500 \
+  2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
+telemetry_report
+
 echo "battery complete -> $LOG"
