@@ -261,7 +261,9 @@ def _resolve_blocks(q, k, block_q, block_k):
     # are invariant to zero columns, so no fallback needed.
     # MXTPU_FLASH_PAD_D=0 restores the old fallback (perf A/B only).
     import os
-    if d % 128 != 0 and os.environ.get("MXTPU_FLASH_PAD_D") == "0":
+    # default mirrors the registry.policy_key entry — a bare .get() here
+    # would alias unset (None) and "1" onto one compiled-cache key
+    if d % 128 != 0 and os.environ.get("MXTPU_FLASH_PAD_D", "1") == "0":
         return _fallback("head dim not a multiple of 128 (padding "
                          "disabled by MXTPU_FLASH_PAD_D=0)")
     bq = _pick_block(t, block_q, 8)       # sublane granularity

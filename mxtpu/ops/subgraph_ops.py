@@ -31,12 +31,24 @@ def _load_sym(subgraph_json):
 
 
 def _compiled(subgraph_json, input_names, n_outputs):
-    key = (subgraph_json, tuple(input_names))
+    from .registry import policy_key
+    # policy_key in the cache key: the sub-symbol executes registered ops
+    # whose trace-time gates (BN one-pass, conv accumulate, ...) get baked
+    # into this executable — a lever flip must recompile, not alias
+    key = (subgraph_json, tuple(input_names), policy_key())
     hit = _SUBGRAPH_CACHE.get(key)
     if hit is not None:
         return hit
     from ..ndarray import NDArray
     from .. import autograd
+    from .. import telemetry
+
+    # retrace watchdog: one compile per (sub-graph, policy) — steady-state
+    # recompiles here mean partition JSON churn or a mid-run policy flip
+    telemetry.record_retrace(
+        "subgraph_exec", {"inputs": list(input_names),
+                          "n_outputs": n_outputs,
+                          "policy_key": list(key[2])})
 
     sym = _load_sym(subgraph_json)
     names = list(input_names)

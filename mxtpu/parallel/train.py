@@ -218,6 +218,7 @@ class ShardedTrainStep:
             for st, plan in zip(self._opt_states, state_plans)]
         self._jit = None
         self._in_fmt = None
+        self._policy = None
         self._last_abstract = None
 
     # ------------------------------------------------------------- placement
@@ -270,6 +271,16 @@ class ShardedTrainStep:
 
     # ------------------------------------------------------------------ step
     def _build(self, in_fmt, n_inputs):
+        from .. import telemetry
+        from ..ops.registry import policy_key
+        # retrace watchdog: one compile per batch structure — after the
+        # first step this site must stay flat (an in_fmt change means the
+        # caller reshaped its batch pytree mid-run)
+        telemetry.record_retrace(
+            "parallel.train_step",
+            {"block": type(self._block).__name__, "n_inputs": n_inputs,
+             "donate": bool(self._donate),
+             "policy_key": list(policy_key())})
         params, trainable = self._params, self._trainable
         block, loss_blk, forward = self._block, self._loss, self._forward
         update_fn = self._update_fn
@@ -350,9 +361,17 @@ class ShardedTrainStep:
         flat = _flatten_nd(batch, in_fmt)
         in_datas = [x._data if isinstance(x, NDArray) else jnp.asarray(x)
                     for x in flat]
-        if self._jit is None or self._in_fmt != in_fmt:
+        # rebuild on a policy flip too: the traced block consults the
+        # registry.policy_key levers (BN one-pass, conv routing, ...) at
+        # trace time — reusing the old executable would silently run the
+        # stale policy (the aliasing hazard documented at registry.py:90)
+        from ..ops.registry import policy_key
+        policy = policy_key()
+        if self._jit is None or self._in_fmt != in_fmt \
+                or self._policy != policy:
             self._jit = self._build(in_fmt, len(in_datas))
             self._in_fmt = in_fmt
+            self._policy = policy
             self._last_abstract = None
         in_datas = [self._place(d, s, local=True)
                     for d, s in zip(in_datas, self._in_shardings)]

@@ -62,14 +62,16 @@ def guard_enabled():
 
 def default_loss_scale():
     """Initial loss scale (MXTPU_LOSS_SCALE, default 2**15 — the standard
-    bf16/f16 AMP starting point)."""
-    return float(os.environ.get("MXTPU_LOSS_SCALE", str(2.0 ** 15)))
+    bf16/f16 AMP starting point). Host-side: the scale VALUE lives on
+    device as a traced scalar and never bakes into an executable, so it
+    does not belong in registry.policy_key."""
+    return float(os.environ.get("MXTPU_LOSS_SCALE", str(2.0 ** 15)))  # graftlint: disable=policy-key-coverage
 
 
 def ckpt_retries():
     """Transient-IO retry budget for checkpoint writes (MXTPU_CKPT_RETRIES,
-    default 3)."""
-    return int(os.environ.get("MXTPU_CKPT_RETRIES", "3"))
+    default 3). Host-side IO control flow — nothing traced."""
+    return int(os.environ.get("MXTPU_CKPT_RETRIES", "3"))  # graftlint: disable=policy-key-coverage
 
 
 # ----------------------------------------------------------- fault injection
@@ -111,7 +113,9 @@ def inject(kind, index=None):
     natural index (step / batch / attempt); with ``index=None`` an internal
     per-kind call counter supplies it. Consuming semantics (each scheduled
     fault fires once) keep retry loops convergent by construction."""
-    spec = os.environ.get("MXTPU_FAULT_INJECT", "")
+    # host-side: faults fire in host control flow (raise/SIGTERM/skip) —
+    # the nan_grad kind mutates a traced VALUE, never the traced program
+    spec = os.environ.get("MXTPU_FAULT_INJECT", "")  # graftlint: disable=policy-key-coverage
     if spec != _FAULT_CACHE["spec"]:
         _FAULT_CACHE["spec"] = spec
         _FAULT_CACHE["faults"] = _parse_faults(spec) if spec else {}

@@ -136,6 +136,13 @@ class Kernel:
                tuple(str(d) for d in out_dtypes), repr(grid),
                bool(interpret), repr(in_specs), repr(out_specs))
         if key not in self._compiled:
+            from . import telemetry
+            # retrace watchdog: user kernels compile once per launch
+            # signature — a shape-unstable caller shows up here by name
+            telemetry.record_retrace(
+                "rtc", {"kernel": self.name,
+                        "args": [(tuple(a.shape), str(a.dtype))
+                                 for a in args]})
             kwargs = {"out_shape": out_shape if n_out > 1 else out_shape[0],
                       "interpret": interpret}
             if grid is not None:

@@ -1,0 +1,29 @@
+"""Known-bad fixture for use-after-donate: donated buffers read after the
+call, through a bound jit, a direct call, and donate_argnames. Never
+imported — parsed by the analyzer only."""
+import jax
+
+
+def bound_form(params, batch):
+    step = jax.jit(lambda w, b: w + b, donate_argnums=(0,))
+    out = step(params, batch)
+    return params.sum() + out         # params was donated on the call above
+
+
+def direct_form(a, b):
+    out = jax.jit(lambda x, y: x * y, donate_argnums=(0, 1))(a, b)
+    return out, b                     # b was donated too
+
+
+def argnames_form(state, grads):
+    upd = jax.jit(lambda state, g: state - g, donate_argnames=("state",))
+    new = upd(state, grads)
+    state.block_until_ready()         # donated by name
+    return new
+
+
+def multiline_form(params, batch):
+    step = jax.jit(lambda w, b: w + b, donate_argnums=(0,))
+    out = step(
+        params, batch)                # donation on a wrapped call
+    return params + out               # ...still a use-after-donate

@@ -1,0 +1,21 @@
+"""Known-good fixture for use-after-donate: the donated name is rebound to
+the call's result (the fused-updater idiom), or only the result is used."""
+import jax
+
+
+def rebind_form(params, batch):
+    step = jax.jit(lambda w, b: w + b, donate_argnums=(0,))
+    params = step(params, batch)      # rebinding clears the donation
+    return params.sum()
+
+
+def result_only(a, b):
+    out = jax.jit(lambda x, y: x * y, donate_argnums=(0,))(a, b)
+    return out + b                    # b was never donated
+
+
+def multiline_rebind(params, batch):
+    step = jax.jit(lambda w, b: w + b, donate_argnums=(0,))
+    params = step(                    # call spans lines: the arg load and
+        params, batch)                # rebind still order correctly
+    return params.sum()

@@ -1,0 +1,11 @@
+"""Known-bad fixture for env-var-catalog (vs env_doc_fixture.md): reads a
+lever with no catalog row; MXTPU_STALE is documented but never read."""
+import os
+
+
+def undocumented():
+    return os.environ.get("MXTPU_UNDOCUMENTED", "0") == "1"
+
+
+def documented():
+    return os.environ.get("MXTPU_DOCUMENTED", "0") == "1"

@@ -1,0 +1,11 @@
+"""Known-good fixture for env-var-catalog: every read has a row and the
+MXTPU_STALE row has a read here, so the fixture doc is fully reconciled."""
+import os
+
+
+def documented():
+    return os.environ.get("MXTPU_DOCUMENTED", "0") == "1"
+
+
+def stale_is_actually_read_here():
+    return os.environ.get("MXTPU_STALE", "0") == "1"

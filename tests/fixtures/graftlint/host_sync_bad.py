@@ -1,0 +1,33 @@
+"""Known-bad fixture for host-sync-in-traced-region: every flagged
+construct class, inside jit bodies reached three ways (argument, nested
+closure, decorator). Never imported — parsed by the analyzer only."""
+import jax
+import numpy as np
+
+
+def build(x):
+    def pure(a):
+        host = np.asarray(a)          # np.asarray on a traced value
+        scalar = float(a.sum())       # scalar coercion syncs
+        raw = a.asnumpy()             # the d2h sync spelled directly
+        one = a.item()                # item() syncs
+        return host, scalar, raw, one
+
+    return jax.jit(pure)(x)
+
+
+def build_nested(x):
+    def outer(a):
+        def inner(b):
+            return b.asnumpy()        # nested def inside a traced fn
+
+        return inner(a)
+
+    return jax.jit(outer)(x)
+
+
+@jax.jit
+def decorated(a):
+    if bool(a.sum() > 0):             # bool() on a traced predicate
+        return a
+    return -a

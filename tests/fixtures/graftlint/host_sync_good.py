@@ -1,0 +1,21 @@
+"""Known-good fixture for host-sync-in-traced-region: jit bodies stay on
+device; syncs and coercions happen only in host code outside the trace;
+shape arithmetic inside the trace is static and legal."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build(x):
+    def pure(a):
+        n = int(a.shape[0])           # shape arithmetic is static
+        m = float(len(a.shape))       # len() is static too
+        return jnp.sum(a) / (n * m)
+
+    return jax.jit(pure)(x)
+
+
+def host_side(x):
+    out = build(x)
+    host = np.asarray(out)            # legal: outside any traced region
+    return float(host.sum()), out.item()

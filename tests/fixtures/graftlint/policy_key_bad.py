@@ -1,0 +1,19 @@
+"""Known-bad fixture for policy-key-coverage (vs registry_fixture.py):
+one lever missing from the key, one default mismatch, one missing default.
+Never imported — parsed by the analyzer only."""
+import os
+
+
+def baz_enabled():
+    # MXTPU_BAZ is not in the fixture policy key at all
+    return os.environ.get("MXTPU_BAZ", "0") == "1"
+
+
+def bar_enabled():
+    # key says default "1"; this read site says "0"
+    return os.environ.get("MXTPU_BAR", "0") == "1"
+
+
+def foo_enabled():
+    # key says default "0"; this read has NO default (unset -> None)
+    return os.environ.get("MXTPU_FOO") == "1"

@@ -1,0 +1,11 @@
+"""Known-good fixture for policy-key-coverage: both levers read with
+defaults that mirror the fixture key exactly."""
+import os
+
+
+def foo_enabled():
+    return os.environ.get("MXTPU_FOO", "0") == "1"
+
+
+def bar_enabled():
+    return os.environ.get("MXTPU_BAR", "1") == "1"

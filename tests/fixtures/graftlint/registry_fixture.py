@@ -1,0 +1,15 @@
+"""Fixture policy-key module: the shape of mxtpu/ops/registry.py:policy_key
+reduced to two levers, for graftlint rule tests."""
+import os
+
+
+def policy_key():
+    return (os.environ.get("MXTPU_FOO", "0"),
+            os.environ.get("MXTPU_BAR", "1"))
+
+
+def stray_gate():
+    # OUTSIDE policy_key: the rule must still convict reads elsewhere in
+    # the registry module itself — only the key function's reads are
+    # exempt (they ARE the key)
+    return os.environ.get("MXTPU_STRAY", "0") == "1"

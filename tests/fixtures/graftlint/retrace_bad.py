@@ -1,0 +1,15 @@
+"""Known-bad fixture for retrace-site-registration: jit caches that never
+report compiles to the retrace watchdog. Never imported — parsed only."""
+import jax
+
+_CACHE = {}
+
+
+def compile_it(fn, key):
+    if key not in _CACHE:
+        _CACHE[key] = jax.jit(fn)     # unreported compile site
+    return _CACHE[key]
+
+
+def one_off(fn, x):
+    return jax.jit(fn)(x)             # unreported, not even cached

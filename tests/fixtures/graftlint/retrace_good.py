@@ -1,0 +1,13 @@
+"""Known-good fixture for retrace-site-registration: the cache-miss path
+reports every compile with provenance before building the executable."""
+import jax
+
+telemetry = None  # stand-in; the analyzer matches the call shape only
+_CACHE = {}
+
+
+def compile_it(fn, key):
+    if key not in _CACHE:
+        telemetry.record_retrace("fixture_site", {"key": key})
+        _CACHE[key] = jax.jit(fn)
+    return _CACHE[key]

@@ -1,0 +1,26 @@
+"""Suppression fixture: the same violations as the known-bad files, each
+carrying an inline `# graftlint: disable=<rule>` — every finding must land
+in the suppressed bucket, none in the active one."""
+import jax
+import os
+
+
+def host_side_lever():
+    return os.environ.get("MXTPU_BAZ", "0")  # graftlint: disable=policy-key-coverage
+
+
+def build(x):
+    def pure(a):
+        return a.asnumpy()  # graftlint: disable=host-sync-in-traced-region
+
+    return jax.jit(pure)(x)  # graftlint: disable=retrace-site-registration
+
+
+def donate_then_read(params, batch):
+    step = jax.jit(lambda w, b: w + b, donate_argnums=(0,))  # graftlint: disable=retrace-site-registration
+    out = step(params, batch)
+    return params.sum() + out  # graftlint: disable=use-after-donate
+
+
+def compile_it(fn, x):
+    return jax.jit(fn)(x)  # graftlint: disable=all

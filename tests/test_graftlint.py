@@ -1,0 +1,263 @@
+"""graftlint (tools/graftlint): fixture corpus per rule + the tier-1
+self-clean gate.
+
+Layout per rule: a known-bad fixture where the rule must fire (with the
+expected count), a known-good twin where it must stay silent, plus the
+shared suppression fixture. The self-clean gate — ``graftlint mxtpu/`` has
+zero unsuppressed findings — is the test every future PR inherits: add a
+trace-time lever without a policy_key entry, an unregistered jax.jit, or
+an undocumented env var, and this file fails before a chip ever sees the
+bug. No jax import needed: the analyzer is pure stdlib ast."""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # pytest rootdir variants
+    sys.path.insert(0, str(REPO))
+
+from tools.graftlint import LintConfig, run  # noqa: E402
+from tools.graftlint.rules import ALL_RULE_IDS  # noqa: E402
+
+FIXTURES = REPO / "tests" / "fixtures" / "graftlint"
+
+
+def fixture_config(**over):
+    base = dict(
+        root=FIXTURES,
+        policy_key_module="registry_fixture.py",
+        trace_scopes=("",),          # fixture tree: everything trace-time
+        env_doc="env_doc_fixture.md",
+        env_extra_roots=(),
+        exclude=(),
+        jit_allowlist={},
+    )
+    base.update(over)
+    return LintConfig(**base)
+
+
+def findings_of(path, rule, **over):
+    res = run(fixture_config(**over), [path], [rule])
+    return res
+
+
+# ------------------------------------------------------- policy-key-coverage
+def test_policy_key_bad_fires():
+    res = findings_of("policy_key_bad.py", "policy-key-coverage")
+    msgs = [f.message for f in res.findings]
+    assert len(msgs) == 3, msgs
+    assert any("MXTPU_BAZ" in m and "absent from" in m for m in msgs)
+    assert any("MXTPU_BAR" in m and "'0'" in m and "'1'" in m for m in msgs)
+    assert any("MXTPU_FOO" in m and "without a default" in m for m in msgs)
+
+
+def test_policy_key_good_silent():
+    res = findings_of("policy_key_good.py", "policy-key-coverage")
+    assert res.findings == []
+
+
+def test_policy_key_registry_module_not_blanket_exempt():
+    # only the policy_key() FUNCTION is exempt; a stray trace-time read
+    # elsewhere in the registry module itself must still fire
+    res = findings_of("registry_fixture.py", "policy-key-coverage")
+    msgs = [f.message for f in res.findings]
+    assert len(msgs) == 1, msgs
+    assert "MXTPU_STRAY" in msgs[0]
+    assert not any("MXTPU_FOO" in m or "MXTPU_BAR" in m for m in msgs)
+
+
+def test_policy_key_scope_gating():
+    # outside the configured trace scopes, a missing lever is NOT flagged
+    # (host-side trees may read MXTPU_* freely) but a default mismatch of
+    # a key member still is
+    res = findings_of("policy_key_bad.py", "policy-key-coverage",
+                      trace_scopes=("some/other/tree",))
+    msgs = [f.message for f in res.findings]
+    assert not any("MXTPU_BAZ" in m for m in msgs)
+    assert any("MXTPU_BAR" in m for m in msgs)
+
+
+# ------------------------------------------- host-sync-in-traced-region
+def test_host_sync_bad_fires():
+    res = findings_of("host_sync_bad.py", "host-sync-in-traced-region")
+    msgs = [f.message for f in res.findings]
+    # pure: np.asarray + float + asnumpy + item; nested: asnumpy; bool
+    assert len(msgs) == 6, msgs
+    assert sum("asnumpy" in m for m in msgs) == 2
+    assert any("np.asarray" in m for m in msgs)
+    assert any("'float(...)'" in m for m in msgs)
+    assert any("'.item()'" in m for m in msgs)
+    assert any("'bool(...)'" in m for m in msgs)
+
+
+def test_host_sync_good_silent():
+    # shape arithmetic inside the jit and real syncs outside it are legal
+    res = findings_of("host_sync_good.py", "host-sync-in-traced-region")
+    assert res.findings == []
+
+
+# ------------------------------------------------------------ use-after-donate
+def test_donation_bad_fires():
+    res = findings_of("donation_bad.py", "use-after-donate")
+    msgs = [f.message for f in res.findings]
+    assert len(msgs) == 4, msgs
+    assert sum("'params'" in m for m in msgs) == 2  # incl. multi-line call
+    assert any("'b'" in m for m in msgs)
+    assert any("'state'" in m for m in msgs)  # via donate_argnames
+
+
+def test_donation_good_silent():
+    res = findings_of("donation_good.py", "use-after-donate")
+    assert res.findings == []
+
+
+# ------------------------------------------------ retrace-site-registration
+def test_retrace_bad_fires():
+    res = findings_of("retrace_bad.py", "retrace-site-registration")
+    assert len(res.findings) == 2
+    assert all("record_retrace" in f.message for f in res.findings)
+    # the inventory names every site even when unregistered
+    assert len(res.jit_inventory) == 2
+    assert all(e["retrace_site"] is None for e in res.jit_inventory)
+
+
+def test_retrace_good_silent_and_inventoried():
+    res = findings_of("retrace_good.py", "retrace-site-registration")
+    assert res.findings == []
+    assert len(res.jit_inventory) == 1
+    assert res.jit_inventory[0]["retrace_site"] == "fixture_site"
+
+
+def test_retrace_allowlist():
+    allow = {("retrace_bad.py", "compile_it"):
+             {"site": "elsewhere", "reason": "counted by a caller",
+              "cache_key": "declared-in-allowlist"}}
+    res = findings_of("retrace_bad.py", "retrace-site-registration",
+                      jit_allowlist=allow)
+    # compile_it is allowlisted, one_off still fires
+    assert len(res.findings) == 1
+    assert "one_off" in res.findings[0].message
+    entry = [e for e in res.jit_inventory if e["function"] == "compile_it"][0]
+    assert entry["allowlisted"] and entry["retrace_site"] == "elsewhere"
+    assert entry["cache_key"] == "declared-in-allowlist"
+
+
+# ------------------------------------------------------------ env-var-catalog
+def test_env_catalog_bad_fires():
+    res = findings_of("env_catalog_bad.py", "env-var-catalog")
+    by_path = {(f.path, f.message.split()[0]) for f in res.findings}
+    assert ("env_catalog_bad.py", "MXTPU_UNDOCUMENTED") in by_path
+    assert ("env_doc_fixture.md", "MXTPU_STALE") in by_path
+    assert len(res.findings) == 2
+
+
+def test_env_catalog_good_silent():
+    res = findings_of("env_catalog_good.py", "env-var-catalog")
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------- suppressions
+@pytest.mark.parametrize("rule,expected_suppressed", [
+    ("policy-key-coverage", 1),
+    ("host-sync-in-traced-region", 1),
+    ("use-after-donate", 1),
+    ("retrace-site-registration", 3),  # two inline + one disable=all
+])
+def test_inline_suppressions(rule, expected_suppressed):
+    res = findings_of("suppressed.py", rule)
+    assert res.findings == [], [f.format() for f in res.findings]
+    assert len(res.suppressed) == expected_suppressed
+
+
+def test_unknown_rule_rejected():
+    with pytest.raises(ValueError, match="unknown rule"):
+        run(fixture_config(), ["policy_key_good.py"], ["no-such-rule"])
+
+
+# ------------------------------------------------------------ the tier-1 gate
+def _repo_result():
+    return run(LintConfig(root=REPO), ["mxtpu"])
+
+
+def test_self_clean_gate():
+    """`python -m tools.graftlint mxtpu/` has ZERO unsuppressed findings.
+
+    If this fails, fix the violation (or, for a genuinely host-side read /
+    externally-counted jit site, add the inline suppression or allowlist
+    entry WITH a reason) — do not baseline it."""
+    res = _repo_result()
+    assert res.findings == [], \
+        "graftlint found violations:\n" + \
+        "\n".join(f.format() for f in res.findings)
+
+
+def test_all_rules_ran_over_repo():
+    # the gate is only meaningful if every rule is registered and loaded
+    assert set(ALL_RULE_IDS) == {
+        "policy-key-coverage", "host-sync-in-traced-region",
+        "use-after-donate", "retrace-site-registration",
+        "env-var-catalog"}
+
+
+def test_jit_surface_inventory_lists_all_four_caches():
+    """The inventory is ROADMAP item 5's scouting report: all four jit
+    caches (FusedUpdater, CachedOp, symbol executor, serving Predictor)
+    must appear with their retrace sites, and no site may be anonymous."""
+    inv = _repo_result().jit_inventory
+    sites = {e["retrace_site"] for e in inv}
+    assert {"fused_optimizer", "cached_op", "executor",
+            "executor.backward", "serving.predict"} <= sites, sites
+    assert None not in sites
+    fused = [e for e in inv if e["retrace_site"] == "fused_optimizer"]
+    assert fused and all(e["donation"] == "donate_argnums=(0, 2)"
+                         for e in fused)
+    by_site = {e["retrace_site"]: e for e in inv}
+    assert by_site["cached_op"]["file"] == "mxtpu/gluon/block.py"
+    assert by_site["serving.predict"]["file"] == "mxtpu/serving/engine.py"
+    assert "policy_key" in (by_site["cached_op"]["cache_key"] or "")
+
+
+# ------------------------------------------------------------------------ CLI
+def _run_cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graftlint"] + args,
+        cwd=str(cwd), capture_output=True, text=True, timeout=120)
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n"
+                   "def f(fn):\n"
+                   "    return jax.jit(fn)\n")
+    good = tmp_path / "good.py"
+    good.write_text("import jax\n"
+                    "def f(fn):\n"
+                    "    telemetry.record_retrace('s', {})\n"
+                    "    return jax.jit(fn)\n")
+    out = tmp_path / "report.json"
+    proc = _run_cli(["bad.py", "--root", str(tmp_path),
+                     "--rules", "retrace-site-registration",
+                     "--json", str(out)], cwd=REPO)
+    assert proc.returncode == 1, proc.stderr
+    assert "retrace-site-registration" in proc.stdout
+    payload = json.loads(out.read_text())
+    assert len(payload["findings"]) == 1
+    assert len(payload["jit_inventory"]) == 1
+
+    proc = _run_cli(["good.py", "--root", str(tmp_path),
+                     "--rules", "retrace-site-registration"], cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_self_clean_and_inventory(tmp_path):
+    """The exact perf_battery.sh pre-flight invocation exits 0, and
+    --inventory lands the scouting-report JSON."""
+    inv = tmp_path / "jit_surfaces.json"
+    proc = _run_cli(["mxtpu/", "--inventory", str(inv)], cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    entries = json.loads(inv.read_text())
+    assert {e["retrace_site"] for e in entries} >= {
+        "fused_optimizer", "cached_op", "executor", "serving.predict"}

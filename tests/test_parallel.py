@@ -447,3 +447,36 @@ def test_ring_self_attention_flash_switch(monkeypatch):
     flash = ring_self_attention(q, q, q, mesh=mesh, causal=True)
     np.testing.assert_allclose(np.asarray(flash), np.asarray(base),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_sharded_train_step_policy_flip_recompiles(monkeypatch):
+    """A registry.policy_key lever flip must rebuild the step executable
+    (otherwise the trainer silently reuses an executable traced under the
+    stale policy — the aliasing hazard at registry.py:90), and every build
+    must report to the 'parallel.train_step' retrace site."""
+    from mxtpu import telemetry
+
+    np.random.seed(0)
+    x = np.random.uniform(size=(8, 10)).astype(np.float32)
+    y = np.random.randint(0, 4, size=(8,)).astype(np.float32)
+    mx.random.seed(0)
+    net = _mlp()
+    net(mx.nd.array(x))  # settle shapes
+    step = ShardedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                            data_parallel_mesh())
+
+    def compiles():
+        st = telemetry.retrace_stats("parallel.train_step")
+        return st["compiles"] if st else 0
+
+    before = compiles()
+    step(mx.nd.array(x), mx.nd.array(y)).asnumpy()
+    step(mx.nd.array(x), mx.nd.array(y)).asnumpy()
+    assert compiles() == before + 1  # steady state: one build, then cached
+
+    monkeypatch.setenv("MXTPU_BN_ONEPASS", "0")  # flip a policy_key lever
+    step(mx.nd.array(x), mx.nd.array(y)).asnumpy()
+    assert compiles() == before + 2  # exactly one rebuild per flip
+
+    step(mx.nd.array(x), mx.nd.array(y)).asnumpy()
+    assert compiles() == before + 2  # flipped policy is now the cached one

@@ -1,0 +1,50 @@
+"""graftlint: trace-discipline static analysis for the mxtpu runtime.
+
+The runtime watchdogs (mxtpu/telemetry.py) catch trace-discipline bugs
+*after* they have cost a recompile or a hot-loop sync; graftlint is their
+static twin — it convicts the same classes of bug at review time, before
+a chip session is burned on them:
+
+====================================  =====================================
+rule                                  runtime twin / contract
+====================================  =====================================
+policy-key-coverage                   retrace watchdog: a trace-time
+                                      ``MXTPU_*`` lever missing from
+                                      ``registry.policy_key`` (or whose
+                                      read-site default differs from the
+                                      key entry) silently aliases
+                                      executables compiled under different
+                                      policies (mxtpu/ops/registry.py:90)
+host-sync-in-traced-region            d2h transfer watchdog: ``.asnumpy``/
+                                      ``.item``/``float()``/``np.asarray``
+                                      inside a jitted function is a
+                                      trace-time host sync
+use-after-donate                      donated buffers are deleted by XLA —
+                                      reading one after the call is UB
+retrace-site-registration             every ``jax.jit`` site must report
+                                      compiles via
+                                      ``telemetry.record_retrace`` (or be
+                                      allowlisted); also emits the
+                                      jit-surface inventory JSON
+env-var-catalog                       every ``MXTPU_*``/``BENCH_*`` read
+                                      has a row in docs/env_vars.md and
+                                      vice versa
+====================================  =====================================
+
+Usage::
+
+    python -m tools.graftlint mxtpu/                  # lint, exit 1 on findings
+    python -m tools.graftlint mxtpu/ --json out.json  # findings + inventory
+    python -m tools.graftlint mxtpu/ --inventory jit_surfaces.json
+
+Inline suppression (same line as the finding)::
+
+    x = os.environ.get("MXTPU_HOST_ONLY")  # graftlint: disable=policy-key-coverage
+
+No dependencies beyond the stdlib ``ast`` module — safe to run as a
+pre-flight gate anywhere (no jax import, no device).
+"""
+from .core import Finding, LintResult, run  # noqa: F401
+from .config import LintConfig  # noqa: F401
+
+__all__ = ["Finding", "LintResult", "LintConfig", "run"]

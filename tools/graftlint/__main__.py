@@ -1,0 +1,83 @@
+"""graftlint CLI: ``python -m tools.graftlint [paths...]``.
+
+Exit status: 0 = no unsuppressed findings, 1 = findings, 2 = usage error.
+Stdout carries one ``file:line: [rule] message`` per finding; the summary
+and artifact paths go to stderr so stdout stays machine-parseable."""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .config import LintConfig
+from .core import run
+from .rules import ALL_RULES, ALL_RULE_IDS
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="Trace-discipline static analyzer for the mxtpu "
+                    "runtime (policy-key coverage, host-sync, donation "
+                    "safety, retrace registration, env-var catalog).")
+    p.add_argument("paths", nargs="*", default=["mxtpu"],
+                   help="files or directories to lint (default: mxtpu)")
+    p.add_argument("--root", default=".",
+                   help="repo root anchoring relative paths and the "
+                        "policy-key/env-doc lookups (default: cwd)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids (default: all)")
+    p.add_argument("--json", dest="json_path", default=None,
+                   help="write findings + jit-surface inventory as JSON")
+    p.add_argument("--inventory", dest="inventory_path", default=None,
+                   help="write ONLY the jit-surface inventory JSON "
+                        "(ROADMAP item 5's scouting report)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print rule ids + one-line summaries and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            doc = (cls.__module__ and sys.modules[cls.__module__].__doc__
+                   or "").strip().splitlines()
+            print("%-28s %s" % (cls.id, doc[0] if doc else ""))
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+
+    config = LintConfig(root=Path(args.root))
+    try:
+        result = run(config, args.paths, rule_ids)
+    except ValueError as e:
+        print("graftlint: %s" % e, file=sys.stderr)
+        return 2
+
+    for f in result.findings:
+        print(f.format())
+
+    if args.json_path:
+        payload = {
+            "findings": [f.as_dict() for f in result.findings],
+            "suppressed": [f.as_dict() for f in result.suppressed],
+            "jit_inventory": result.jit_inventory,
+            "files": result.files,
+        }
+        Path(args.json_path).write_text(json.dumps(payload, indent=2))
+        print("graftlint: wrote %s" % args.json_path, file=sys.stderr)
+    if args.inventory_path:
+        Path(args.inventory_path).write_text(
+            json.dumps(result.jit_inventory, indent=2))
+        print("graftlint: wrote %s" % args.inventory_path, file=sys.stderr)
+
+    print("graftlint: %d finding(s), %d suppressed, %d file(s), "
+          "%d jit site(s)"
+          % (len(result.findings), len(result.suppressed), result.files,
+             len(result.jit_inventory)), file=sys.stderr)
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

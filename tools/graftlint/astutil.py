@@ -1,0 +1,201 @@
+"""Shared AST pattern matchers: env-var reads, jax.jit call sites, scopes."""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# sentinels for EnvRead.default
+MISSING = object()      # .get(name) with no default / environ[name]
+NONCONST = object()     # default present but not a literal
+
+
+@dataclass
+class EnvRead:
+    name: str
+    line: int
+    default: object     # str literal, None literal, MISSING, or NONCONST
+    node: ast.AST
+
+
+def _is_environ_expr(node: ast.AST) -> bool:
+    """True for expressions that textually resolve to os.environ (os.environ,
+    _os.environ, bare ``environ`` from a from-import)."""
+    try:
+        text = ast.unparse(node)
+    except Exception:
+        return False
+    return text.endswith("environ") or text == "os.environ"
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def iter_env_reads(tree: ast.AST,
+                   prefixes: Tuple[str, ...] = ("MXTPU_", "BENCH_")
+                   ) -> Iterator[EnvRead]:
+    """Yield env-var READ sites (writes — ``os.environ[k] = v`` — do not
+    count). Recognized forms:
+
+    * ``os.environ.get(name[, default])`` (any spelling ending in
+      ``environ``, incl. ``env = os.environ; env.get(...)`` — any ``.get``
+      whose key literal matches a prefix is treated as an env read)
+    * ``os.getenv(name[, default])`` / bare ``getenv(...)``
+    * ``os.environ[name]`` in Load context
+    * ``name in os.environ``
+    """
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr == "get" \
+                    and node.args:
+                name = _const_str(node.args[0])
+                if name is None:
+                    continue
+                env_recv = _is_environ_expr(func.value)
+                if not env_recv and not name.startswith(tuple(prefixes)):
+                    continue
+                if len(node.args) > 1:
+                    d = _const_str(node.args[1])
+                    default = d if d is not None else (
+                        node.args[1].value
+                        if isinstance(node.args[1], ast.Constant)
+                        else NONCONST)
+                else:
+                    default = MISSING
+                yield EnvRead(name, node.lineno, default, node)
+            elif ((isinstance(func, ast.Attribute) and func.attr == "getenv")
+                  or (isinstance(func, ast.Name) and func.id == "getenv")) \
+                    and node.args:
+                name = _const_str(node.args[0])
+                if name is None:
+                    continue
+                if len(node.args) > 1:
+                    d = _const_str(node.args[1])
+                    default = d if d is not None else (
+                        node.args[1].value
+                        if isinstance(node.args[1], ast.Constant)
+                        else NONCONST)
+                else:
+                    default = MISSING
+                yield EnvRead(name, node.lineno, default, node)
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load) \
+                and _is_environ_expr(node.value):
+            name = _const_str(node.slice)
+            if name is not None:
+                yield EnvRead(name, node.lineno, MISSING, node)
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                and _is_environ_expr(node.comparators[0]):
+            name = _const_str(node.left)
+            if name is not None:
+                yield EnvRead(name, node.lineno, MISSING, node)
+
+
+# ------------------------------------------------------------------ jit sites
+def is_jit_func_expr(node: ast.AST) -> bool:
+    """``jax.jit`` (or a bare ``jit`` from-import) as an expression."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return True
+    if isinstance(node, ast.Name) and node.id == "jit":
+        return True
+    return False
+
+
+def is_jit_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and is_jit_func_expr(node.func)
+
+
+def jit_in_decorator(dec: ast.AST) -> bool:
+    """@jax.jit / @jit / @partial(jax.jit, ...) / @functools.partial(...)"""
+    if is_jit_func_expr(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if is_jit_func_expr(dec.func):
+            return True
+        fname = dec.func.attr if isinstance(dec.func, ast.Attribute) else (
+            dec.func.id if isinstance(dec.func, ast.Name) else "")
+        if fname == "partial":
+            return any(is_jit_func_expr(a) for a in dec.args)
+    return False
+
+
+def build_parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def enclosing_functions(node: ast.AST,
+                        parents: Dict[ast.AST, ast.AST]
+                        ) -> List[ast.AST]:
+    """FunctionDef/AsyncFunctionDef ancestors, innermost first."""
+    chain = []
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            chain.append(cur)
+        cur = parents.get(cur)
+    return chain
+
+
+def qualname_of(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> str:
+    """Dotted path of enclosing ClassDef/FunctionDef names, e.g.
+    ``Predictor._get_jit`` — for the jit-surface inventory."""
+    names = []
+    cur = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            names.append(cur.name)
+        cur = parents.get(cur)
+    return ".".join(reversed(names)) or "<module>"
+
+
+def iter_scope_nodes(scope_body: List[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements of one scope WITHOUT descending into nested
+    function/class bodies (their execution timing is unknown)."""
+    stack: List[ast.AST] = list(scope_body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def find_traced_functions(tree: ast.AST) -> List[ast.AST]:
+    """Function/Lambda nodes whose bodies execute under jax tracing:
+    arguments of ``jax.jit(...)`` calls, ``@jax.jit``-class decorators, and
+    (transitively) any function nested inside one of those."""
+    by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+
+    roots: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if is_jit_call(node) and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Name):
+                roots.extend(by_name.get(target.id, ()))
+            elif isinstance(target, ast.Lambda):
+                roots.append(target)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(jit_in_decorator(d) for d in node.decorator_list):
+                roots.append(node)
+    # dedupe, outermost roots are enough: ast.walk covers nested defs
+    seen = set()
+    out = []
+    for r in roots:
+        if id(r) not in seen:
+            seen.add(id(r))
+            out.append(r)
+    return out

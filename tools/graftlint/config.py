@@ -1,0 +1,87 @@
+"""graftlint configuration: scopes, doc locations, and the jit allowlist.
+
+Everything here is overridable per-``LintConfig`` so the fixture tests can
+point the rules at synthetic trees (tests/fixtures/graftlint/)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Tuple
+
+# Paths (repo-root-relative, posix) whose env reads are treated as
+# trace-time for policy-key-coverage: these trees hold the op/policy gates
+# that execute under jax tracing, so an MXTPU_* read here is baked into
+# compiled executables unless it is in registry.policy_key (or explicitly
+# suppressed as host-side at the read site).
+DEFAULT_TRACE_SCOPES: Tuple[str, ...] = (
+    "mxtpu/ops",
+    "mxtpu/contrib",
+    "mxtpu/parallel",
+    "mxtpu/resilience.py",
+)
+
+DEFAULT_POLICY_KEY_MODULE = "mxtpu/ops/registry.py"
+DEFAULT_ENV_DOC = "docs/env_vars.md"
+
+# Extra roots scanned (read-only) by env-var-catalog beyond the CLI paths:
+# docs/env_vars.md is a repo-global catalog, so BENCH_* rows read only by
+# the bench/tooling layer must not look stale when linting mxtpu/ alone.
+DEFAULT_ENV_EXTRA_ROOTS: Tuple[str, ...] = ("bench.py", "tools", "tests")
+
+# Never analyzed / never scanned: the lint fixtures are deliberately-bad
+# code, and would otherwise convict themselves in the self-clean gate.
+DEFAULT_EXCLUDE: Tuple[str, ...] = ("tests/fixtures/graftlint",)
+
+# retrace-site-registration allowlist: (repo-relative file, enclosing
+# function of the jax.jit call) -> entry. An entry declares WHERE the
+# site's compiles are actually counted and what its cache key is, so the
+# jit-surface inventory stays complete even for sites whose
+# record_retrace lives in a caller.
+JIT_ALLOWLIST: Dict[Tuple[str, str], Dict[str, str]] = {
+    ("mxtpu/optimizer_fused.py", "_build"): {
+        "site": "fused_optimizer",
+        "reason": "FusedUpdater._cached_jit is the single cache front door "
+                  "for this builder; it calls telemetry.record_retrace on "
+                  "every executable-cache miss before invoking _build",
+        "cache_key": "(optimizer class, static config, per-param specs) + "
+                     "registry.policy_key — FusedUpdater._cached_jit",
+    },
+    ("mxtpu/optimizer_fused.py", "_build_guarded"): {
+        "site": "fused_optimizer",
+        "reason": "same cache front door as _build; the guard bit and "
+                  "scaler_cfg join the cache key in _cached_jit",
+        "cache_key": "(optimizer class, static config, per-param specs, "
+                     "guard bit, scaler_cfg) + registry.policy_key — "
+                     "FusedUpdater._cached_jit",
+    },
+}
+
+
+@dataclass
+class LintConfig:
+    """Resolved analyzer configuration. ``root`` anchors every relative
+    path in this object (CLI paths, policy_key_module, env_doc, scopes)."""
+
+    root: Path
+    policy_key_module: str = DEFAULT_POLICY_KEY_MODULE
+    trace_scopes: Tuple[str, ...] = DEFAULT_TRACE_SCOPES
+    env_doc: str = DEFAULT_ENV_DOC
+    env_extra_roots: Tuple[str, ...] = DEFAULT_ENV_EXTRA_ROOTS
+    exclude: Tuple[str, ...] = DEFAULT_EXCLUDE
+    jit_allowlist: Dict[Tuple[str, str], Dict[str, str]] = field(
+        default_factory=lambda: dict(JIT_ALLOWLIST))
+
+    def __post_init__(self):
+        self.root = Path(self.root).resolve()
+
+    def is_excluded(self, rel: str) -> bool:
+        return any(rel == e or rel.startswith(e.rstrip("/") + "/")
+                   for e in self.exclude)
+
+    def in_trace_scope(self, rel: str) -> bool:
+        for s in self.trace_scopes:
+            if s in ("", "."):
+                return True
+            if rel == s or rel.startswith(s.rstrip("/") + "/"):
+                return True
+        return False

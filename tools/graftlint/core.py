@@ -1,0 +1,184 @@
+"""graftlint core: file model, inline suppressions, and the rule runner."""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .config import LintConfig
+
+# inline suppression: `# graftlint: disable=<rule>[,<rule>...]` (or `all`)
+# on the physical line the finding anchors to
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-root-relative, posix
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return "%s:%d: [%s] %s" % (self.path, self.line, self.rule,
+                                   self.message)
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+class FileContext:
+    """One parsed source file: AST + per-line suppression sets."""
+
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=str(path))
+        self._suppress: Dict[int, set] = {}
+        for i, ln in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(ln)
+            if m:
+                self._suppress[i] = {t.strip()
+                                     for t in m.group(1).split(",")
+                                     if t.strip()}
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self._suppress.get(line, ())
+        return rule in rules or "all" in rules
+
+
+class Project:
+    """Shared file loader/cache so cross-file rules (env-var-catalog's
+    extra-root scan) parse each file at most once."""
+
+    def __init__(self, config: LintConfig):
+        self.config = config
+        self._ctxs: Dict[str, Optional[FileContext]] = {}
+
+    def ctx_for(self, rel: str) -> Optional[FileContext]:
+        """FileContext for a repo-relative path, or None if the file is
+        missing or unparseable (generated/vendored files must not crash
+        the lint)."""
+        if rel not in self._ctxs:
+            path = self.config.root / rel
+            try:
+                source = path.read_text(encoding="utf-8", errors="replace")
+                self._ctxs[rel] = FileContext(path, rel, source)
+            except (OSError, SyntaxError, ValueError):
+                self._ctxs[rel] = None
+        return self._ctxs[rel]
+
+
+class Rule:
+    """Base rule: accumulate (Finding, ctx) pairs via :meth:`report`; the
+    runner partitions them into active vs suppressed using the ctx."""
+
+    id = "?"
+
+    def __init__(self, config: LintConfig):
+        self.config = config
+        self.results: List[Tuple[Finding, Optional[FileContext]]] = []
+
+    def report(self, ctx: Optional[FileContext], path: str, line: int,
+               message: str):
+        self.results.append(
+            (Finding(self.id, path, line, message), ctx))
+
+    def visit(self, ctx: FileContext, project: Project):
+        """Called once per analyzed python file."""
+
+    def finalize(self, project: Project):
+        """Called once after all files were visited (cross-file checks)."""
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    jit_inventory: List[dict] = field(default_factory=list)
+    files: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def _collect_files(config: LintConfig, paths: Sequence[str]) -> List[str]:
+    """Expand CLI paths (files or directories) into a sorted list of
+    repo-relative .py paths, honoring config.exclude."""
+    rels = []
+    seen = set()
+    for p in paths:
+        ap = Path(p)
+        if not ap.is_absolute():
+            ap = config.root / p
+        ap = ap.resolve()
+        if ap.is_dir():
+            cands = sorted(ap.rglob("*.py"))
+        else:
+            cands = [ap]
+        for c in cands:
+            if "__pycache__" in c.parts:
+                continue
+            try:
+                rel = c.relative_to(config.root).as_posix()
+            except ValueError:
+                rel = c.as_posix()
+            if config.is_excluded(rel) or rel in seen:
+                continue
+            seen.add(rel)
+            rels.append(rel)
+    return rels
+
+
+def run(config: LintConfig, paths: Sequence[str],
+        rule_ids: Optional[Sequence[str]] = None) -> LintResult:
+    """Run the (selected) rules over ``paths``; returns a LintResult with
+    active findings, suppressed findings, and the jit-surface inventory."""
+    from .rules import ALL_RULES
+
+    selected = []
+    known = {cls.id for cls in ALL_RULES}
+    if rule_ids is not None:
+        unknown = set(rule_ids) - known
+        if unknown:
+            raise ValueError("unknown rule id(s): %s (known: %s)"
+                             % (", ".join(sorted(unknown)),
+                                ", ".join(sorted(known))))
+    for cls in ALL_RULES:
+        if rule_ids is None or cls.id in rule_ids:
+            selected.append(cls(config))
+
+    project = Project(config)
+    rels = _collect_files(config, paths)
+    ctxs = []
+    for rel in rels:
+        ctx = project.ctx_for(rel)
+        if ctx is not None:
+            ctxs.append(ctx)
+
+    for rule in selected:
+        for ctx in ctxs:
+            rule.visit(ctx, project)
+        rule.finalize(project)
+
+    result = LintResult(files=len(ctxs))
+    for rule in selected:
+        for finding, ctx in rule.results:
+            if ctx is not None and ctx.suppressed(finding.rule,
+                                                  finding.line):
+                result.suppressed.append(finding)
+            else:
+                result.findings.append(finding)
+        inv = getattr(rule, "inventory", None)
+        if inv:
+            result.jit_inventory.extend(inv)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    result.suppressed.sort(key=lambda f: (f.path, f.line, f.rule))
+    result.jit_inventory.sort(key=lambda e: (e["file"], e["line"]))
+    return result

@@ -1,0 +1,18 @@
+"""graftlint rules, one module per rule."""
+from .policy_key import PolicyKeyCoverage
+from .host_sync import HostSyncInTracedRegion
+from .donation import UseAfterDonate
+from .retrace import RetraceSiteRegistration
+from .env_catalog import EnvVarCatalog
+
+ALL_RULES = [
+    PolicyKeyCoverage,
+    HostSyncInTracedRegion,
+    UseAfterDonate,
+    RetraceSiteRegistration,
+    EnvVarCatalog,
+]
+
+ALL_RULE_IDS = [cls.id for cls in ALL_RULES]
+
+__all__ = ["ALL_RULES", "ALL_RULE_IDS"]

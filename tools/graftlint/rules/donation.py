@@ -1,0 +1,200 @@
+"""use-after-donate: a buffer donated to a jit call must not be read after.
+
+``donate_argnums``/``donate_argnames`` hand the argument's buffer to XLA
+for in-place reuse — after the call the donated array is DELETED; reading
+it raises (or on some backends returns garbage). The runtime protects its
+own donation sites with defensive copies (kvstore grouped push, Predictor
+exact-fit inputs); this rule catches the raw pattern in new code:
+
+    f = jax.jit(step, donate_argnums=(0,))
+    out = f(params, batch)
+    params.block_until_ready()   # <-- flagged: params was donated
+
+Scope is intraprocedural (one function / module body at a time, matching
+the issue contract): a donated-jit binding and a call through it in the
+same scope, followed by a load of a Name that was passed at a donated
+position before it is rebound."""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..astutil import is_jit_call, iter_scope_nodes
+from ..core import Rule
+
+# events are emitted in EVALUATION order (not line order — a donated call
+# may span lines): a call's own arg loads precede its donation, and an
+# assignment's value is evaluated before its targets are bound, so the
+# call's RESULT (a fresh buffer) clears the donation — `a = f(a, b)` is
+# legal, even wrapped across lines, while `f(a, b); use(a)` is not
+_LOAD, _DONATE, _STORE = 0, 1, 2
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[List[int],
+                                                         List[str]]]:
+    """(argnums, argnames) literals of a jax.jit(...) call, or None if the
+    call donates nothing / non-literally."""
+    nums: List[int] = []
+    names: List[str] = []
+    found = False
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            found = True
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums.append(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, int):
+                        nums.append(el.value)
+        elif kw.arg == "donate_argnames":
+            found = True
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names.append(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, str):
+                        names.append(el.value)
+    return (nums, names) if found else None
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return [a.arg for a in fn.args.args]
+    return []
+
+
+class UseAfterDonate(Rule):
+    id = "use-after-donate"
+
+    def visit(self, ctx, project):
+        scopes = [("<module>", ctx.tree.body)]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append((node.name, node.body))
+        for _name, body in scopes:
+            self._check_scope(ctx, body)
+
+    def _check_scope(self, ctx, body):
+        # pass 1: donated-jit bindings in this scope (name -> (nums, names,
+        # param names of the traced fn if statically known))
+        donated_fns: Dict[str, Tuple[List[int], List[str], List[str]]] = {}
+        local_defs: Dict[str, ast.AST] = {}
+        for node in iter_scope_nodes(body):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs[node.name] = node
+        for node in iter_scope_nodes(body):
+            if isinstance(node, ast.Assign) and is_jit_call(node.value):
+                don = _donated_positions(node.value)
+                if don is None:
+                    continue
+                nums, names = don
+                params: List[str] = []
+                if node.value.args:
+                    tgt = node.value.args[0]
+                    if isinstance(tgt, ast.Lambda):
+                        params = _param_names(tgt)
+                    elif isinstance(tgt, ast.Name) \
+                            and tgt.id in local_defs:
+                        params = _param_names(local_defs[tgt.id])
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        donated_fns[t.id] = (nums, names, params)
+
+        # pass 2: evaluation-order load/store/donate events over plain Names
+        events = self._events(body, donated_fns)
+        live: Dict[str, int] = {}  # name -> donation line
+        for kind, name, line in events:
+            if kind == _DONATE:
+                live[name] = line
+            elif kind == _STORE:
+                live.pop(name, None)
+            elif kind == _LOAD and name in live:
+                self.report(
+                    ctx, ctx.rel, line,
+                    "'%s' was donated to the jit call on line %d — its "
+                    "buffer is deleted by XLA; reading it here is "
+                    "use-after-free. Use the call's result, or copy "
+                    "before donating" % (name, live[name]))
+                del live[name]  # one finding per donation
+
+    def _donations_of_call(self, node: ast.Call, donated_fns):
+        """(name, line) donation events of one Call, if it calls a
+        donated jit (bound name or direct ``jax.jit(...)(...)`` form)."""
+        don = None
+        if isinstance(node.func, ast.Name) and node.func.id in donated_fns:
+            don = donated_fns[node.func.id]
+        elif is_jit_call(node.func):
+            d = _donated_positions(node.func)
+            if d is not None:
+                params = []
+                if node.func.args \
+                        and isinstance(node.func.args[0], ast.Lambda):
+                    params = _param_names(node.func.args[0])
+                don = (d[0], d[1], params)
+        if don is None:
+            return []
+        nums, argnames, params = don
+        positions = list(nums)
+        for an in argnames:
+            if an in params:
+                positions.append(params.index(an))
+        out = []
+        for p in positions:
+            if p < len(node.args) and isinstance(node.args[p], ast.Name):
+                out.append((node.args[p].id, node.lineno))
+        for kw in node.keywords:
+            if kw.arg in argnames and isinstance(kw.value, ast.Name):
+                out.append((kw.value.id, node.lineno))
+        return out
+
+    def _events(self, body, donated_fns):
+        """Flatten one scope into (kind, name, line) events in evaluation
+        order: assignment values before their targets, a call's arguments
+        before its donation. Nested function/class bodies are opaque
+        (their execution timing is unknown)."""
+        events: List[Tuple[int, str, int]] = []
+
+        def visit(node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                return
+            if isinstance(node, ast.Assign):
+                visit(node.value)
+                for t in node.targets:
+                    visit(t)
+                return
+            if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                if node.value is not None:
+                    visit(node.value)
+                visit(node.target)
+                return
+            if isinstance(node, ast.NamedExpr):
+                visit(node.value)
+                visit(node.target)
+                return
+            if isinstance(node, ast.Call):
+                visit(node.func)
+                for a in node.args:
+                    visit(a)
+                for kw in node.keywords:
+                    visit(kw.value)
+                for name, line in self._donations_of_call(node,
+                                                          donated_fns):
+                    events.append((_DONATE, name, line))
+                return
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    events.append((_LOAD, node.id, node.lineno))
+                elif isinstance(node.ctx, (ast.Store, ast.Del)):
+                    events.append((_STORE, node.id, node.lineno))
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in body:
+            visit(stmt)
+        return events

@@ -1,0 +1,104 @@
+"""env-var-catalog: docs/env_vars.md and the code agree, both directions.
+
+Every ``MXTPU_*``/``BENCH_*`` env read must have a table row in the
+catalog (first cell, backticked), and every cataloged row must have a
+surviving read site — stale rows are flagged at their doc line. Because
+the catalog is repo-global while a lint run usually targets ``mxtpu/``,
+the rule additionally scans ``config.env_extra_roots`` (bench.py, tools/,
+tests/) for reads, so BENCH_* rows consumed only by the bench layer are
+neither stale nor invisible.
+
+Writes (``os.environ[k] = v``, monkeypatch.setenv) do not count as reads:
+a variable that is only ever SET is either dead or consumed elsewhere —
+the read site is what the row documents."""
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from ..astutil import iter_env_reads
+from ..core import Rule
+
+PREFIXES = ("MXTPU_", "BENCH_")
+_ROW_NAME_RE = re.compile(r"`((?:MXTPU|BENCH)_[A-Z0-9_]+)`")
+
+
+def parse_doc_rows(text: str):
+    """{name: line} for every prefixed, backticked name in the FIRST cell
+    of a markdown table row (names mentioned in prose or in the meaning
+    cell of another row do not count as documented)."""
+    rows = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        stripped = line.lstrip()
+        if not stripped.startswith("|"):
+            continue
+        cells = stripped.split("|")
+        if len(cells) < 3:
+            continue
+        for name in _ROW_NAME_RE.findall(cells[1]):
+            rows.setdefault(name, i)
+    return rows
+
+
+class EnvVarCatalog(Rule):
+    id = "env-var-catalog"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._reads = {}    # name -> (ctx, line) of first read seen
+        self._visited = set()
+
+    def visit(self, ctx, project):
+        self._visited.add(ctx.rel)
+        self._collect(ctx)
+
+    def _collect(self, ctx):
+        for read in iter_env_reads(ctx.tree):
+            if read.name.startswith(PREFIXES):
+                self._reads.setdefault(read.name, (ctx, read.line))
+
+    def _extra_files(self):
+        for root in self.config.env_extra_roots:
+            base = self.config.root / root
+            if base.is_file():
+                yield Path(root).as_posix()
+            elif base.is_dir():
+                for p in sorted(base.rglob("*.py")):
+                    if "__pycache__" in p.parts:
+                        continue
+                    yield p.relative_to(self.config.root).as_posix()
+
+    def finalize(self, project):
+        for rel in self._extra_files():
+            if rel in self._visited or self.config.is_excluded(rel):
+                continue
+            ctx = project.ctx_for(rel)
+            if ctx is not None:
+                self._collect(ctx)
+
+        doc_rel = self.config.env_doc
+        doc_path = self.config.root / doc_rel
+        try:
+            doc_text = doc_path.read_text(encoding="utf-8")
+        except OSError:
+            self.report(None, doc_rel, 1,
+                        "env-var catalog %s is missing — every MXTPU_*/"
+                        "BENCH_* read needs a documented row" % doc_rel)
+            return
+        rows = parse_doc_rows(doc_text)
+
+        for name in sorted(self._reads):
+            if name not in rows:
+                ctx, line = self._reads[name]
+                self.report(
+                    ctx, ctx.rel, line,
+                    "%s is read here but has no row in %s — add one "
+                    "(meaning, default, and whether it is in "
+                    "registry.policy_key)" % (name, doc_rel))
+        for name in sorted(rows):
+            if name not in self._reads:
+                self.report(
+                    None, doc_rel, rows[name],
+                    "%s is cataloged here but no read site survives in "
+                    "the scanned tree — stale row; delete it or restore "
+                    "the read" % name)

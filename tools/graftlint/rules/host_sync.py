@@ -1,0 +1,91 @@
+"""host-sync-in-traced-region: no device->host syncs inside jitted bodies.
+
+Static twin of the d2h transfer watchdog (docs/observability.md): the
+watchdog counts ``transfer.d2h`` at runtime and warns on a steady-state
+hot-loop sync; this rule convicts the construct at review time. Inside a
+function passed to ``jax.jit`` (or decorated with it, or nested in one —
+FusedUpdater step fns, CachedOp ``pure``/``bwd``, executor bodies,
+Predictor bucket fns), the flagged constructs either force a trace-time
+transfer or fail outright on tracers:
+
+* ``x.asnumpy()`` / ``x.item()`` / ``x.tolist()``
+* ``np.asarray(x)`` / ``np.array(x)``
+* ``jax.device_get(x)``
+* ``float(x)`` / ``int(x)`` / ``bool(x)`` on a non-constant (scalar
+  coercion syncs; ``bool`` on a traced predicate is the classic
+  ConcretizationTypeError). Shape arithmetic — args mentioning ``.shape``
+  or ``len(...)`` — is static under trace and NOT flagged.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import find_traced_functions
+from ..core import Rule
+
+SYNC_METHODS = {"asnumpy", "item", "tolist"}
+NP_MODULE_NAMES = {"np", "numpy", "onp", "_np"}
+NP_SYNC_FUNCS = {"asarray", "array"}
+COERCIONS = {"float", "int", "bool"}
+
+
+def _is_shape_like(node: ast.AST) -> bool:
+    """len(...)/x.shape[...] style expressions are static under trace."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("shape", "ndim",
+                                                           "size", "dtype"):
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                and sub.func.id == "len":
+            return True
+    return False
+
+
+class HostSyncInTracedRegion(Rule):
+    id = "host-sync-in-traced-region"
+
+    def visit(self, ctx, project):
+        traced = find_traced_functions(ctx.tree)
+        seen = set()
+        for root in traced:
+            for node in ast.walk(root):
+                if not isinstance(node, ast.Call):
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                msg = self._check_call(node)
+                if msg is not None:
+                    seen.add(key)
+                    self.report(ctx, ctx.rel, node.lineno, msg)
+
+    def _check_call(self, node: ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in SYNC_METHODS:
+                return ("'.%s()' inside a jit-traced function is a "
+                        "device->host sync at trace time (the d2h "
+                        "watchdog's static twin) — hoist it out of the "
+                        "traced region or keep the value on device"
+                        % func.attr)
+            if func.attr in NP_SYNC_FUNCS \
+                    and isinstance(func.value, ast.Name) \
+                    and func.value.id in NP_MODULE_NAMES:
+                return ("'%s.%s(...)' inside a jit-traced function "
+                        "materializes the operand on host — use jnp.%s "
+                        "or move this out of the traced region"
+                        % (func.value.id, func.attr, func.attr))
+            if func.attr == "device_get":
+                return ("'device_get' inside a jit-traced function is a "
+                        "device->host sync — hoist it out of the traced "
+                        "region")
+        elif isinstance(func, ast.Name) and func.id in COERCIONS \
+                and len(node.args) == 1 and not node.keywords:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) or _is_shape_like(arg):
+                return None
+            return ("'%s(...)' scalar coercion inside a jit-traced "
+                    "function syncs (or raises ConcretizationTypeError) "
+                    "on a traced value — keep it as a 0-d array, or "
+                    "compute it host-side before the jit" % func.id)
+        return None

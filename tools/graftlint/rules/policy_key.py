@@ -1,0 +1,100 @@
+"""policy-key-coverage: every trace-time MXTPU_* lever is in registry.policy_key
+with a read-site default that MIRRORS the key entry.
+
+The hazard (documented at the key itself, mxtpu/ops/registry.py:90): every
+jit cache in the runtime keys on ``registry.policy_key()``. A trace-time
+``MXTPU_*`` read that is absent from the key tuple means flipping that
+lever mid-process silently reuses executables traced under the old policy;
+a read-site default that differs from the key entry's default means *unset*
+and the non-default value alias onto one cache key — an A/B measurement
+would then compare a lever with itself.
+
+Scope: reads inside ``config.trace_scopes`` (mxtpu/ops/, mxtpu/contrib/,
+mxtpu/parallel/, mxtpu/resilience.py — the trees whose code executes under
+jax tracing) must be key members; default-mismatch checks apply to key
+members read ANYWHERE in the analyzed files. Genuinely host-side reads in
+a trace scope carry ``# graftlint: disable=policy-key-coverage`` with a
+reason at the read site.
+
+Runtime twin: the retrace watchdog (docs/observability.md) — it catches
+the recompile storm a *present* key member causes when flipped; this rule
+catches the silent aliasing of an *absent* one, which the watchdog by
+construction never sees."""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import MISSING, NONCONST, iter_env_reads
+from ..core import Rule
+
+
+def parse_policy_key(tree: ast.AST):
+    """Extract ``[(env_name, default_literal), ...]`` from the
+    ``policy_key()`` function of the registry module."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "policy_key":
+            return [(r.name, r.default) for r in iter_env_reads(node)]
+    return []
+
+
+class PolicyKeyCoverage(Rule):
+    id = "policy-key-coverage"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._key = None  # name -> default (loaded lazily via project)
+
+    def _key_map(self, project):
+        if self._key is None:
+            ctx = project.ctx_for(self.config.policy_key_module)
+            entries = parse_policy_key(ctx.tree) if ctx is not None else []
+            self._key = dict(entries)
+        return self._key
+
+    def visit(self, ctx, project):
+        skip_span = None
+        if ctx.rel == self.config.policy_key_module:
+            # the policy_key() function's own reads ARE the key — but the
+            # REST of the registry module gets no special treatment
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.FunctionDef) \
+                        and node.name == "policy_key":
+                    skip_span = (node.lineno, node.end_lineno)
+                    break
+        key = self._key_map(project)
+        in_scope = self.config.in_trace_scope(ctx.rel)
+        for read in iter_env_reads(ctx.tree):
+            if not read.name.startswith("MXTPU_"):
+                continue
+            if skip_span and skip_span[0] <= read.line <= skip_span[1]:
+                continue
+            if read.name not in key:
+                if in_scope:
+                    self.report(
+                        ctx, ctx.rel, read.line,
+                        "trace-time lever %s is read here but absent from "
+                        "registry.policy_key — executables compiled under "
+                        "different settings of it alias onto one cache "
+                        "key; add it to the key tuple, or mark this read "
+                        "host-side with '# graftlint: "
+                        "disable=policy-key-coverage' plus a reason"
+                        % read.name)
+                continue
+            kd = key[read.name]
+            if read.default is NONCONST or kd is NONCONST:
+                continue  # can't judge computed defaults statically
+            if read.default is MISSING:
+                self.report(
+                    ctx, ctx.rel, read.line,
+                    "%s is read without a default here but "
+                    "registry.policy_key defaults it to %r — when unset, "
+                    "this site sees None while the cache key records %r, "
+                    "aliasing unset and non-default runs; mirror the key "
+                    "default at this read site" % (read.name, kd, kd))
+            elif read.default != kd:
+                self.report(
+                    ctx, ctx.rel, read.line,
+                    "%s default %r here vs %r in registry.policy_key — "
+                    "defaults must MIRROR the key entry or unset-vs-set "
+                    "runs alias onto one compiled executable"
+                    % (read.name, read.default, kd))

@@ -1,0 +1,102 @@
+"""retrace-site-registration: every jax.jit site reports its compiles.
+
+The retrace watchdog (docs/observability.md) can only see compiles that
+are reported to it: a ``jax.jit`` call site must either call
+``telemetry.record_retrace(site, provenance)`` in an enclosing function
+(the cache-miss path) or carry an entry in
+``tools/graftlint/config.py:JIT_ALLOWLIST`` naming where its compiles ARE
+counted. An unregistered site is a blind spot — a recompile storm there
+serializes training behind the compiler with no watchdog warning.
+
+This rule is also the scout for ROADMAP item 5 (one compile-cache engine
+under all jit surfaces): it emits a **jit-surface inventory** — one JSON
+record per site with its enclosing qualname, donation discipline, cache-key
+expression (the ``key = ...`` assignment in the enclosing function, when
+present), and retrace site name — via ``--inventory`` / ``--json``."""
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..astutil import (build_parent_map, enclosing_functions, is_jit_call,
+                       qualname_of)
+from ..core import Rule
+
+
+def _find_record_retrace(fn: ast.AST) -> Optional[str]:
+    """First telemetry.record_retrace(...) call in ``fn``; returns the
+    site-name literal (or '<dynamic>' for a computed site)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "record_retrace":
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                return node.args[0].value
+            return "<dynamic>"
+    return None
+
+
+def _donation_of(call: ast.Call) -> Optional[str]:
+    parts = []
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            parts.append("%s=%s" % (kw.arg, ast.unparse(kw.value)))
+    return ", ".join(parts) or None
+
+
+def _cache_key_of(fn: Optional[ast.AST]) -> Optional[str]:
+    """The ``key = <expr>`` assignment in the enclosing function — by
+    convention every cache site builds its cache key under that name."""
+    if fn is None:
+        return None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "key":
+            return ast.unparse(node.value)
+    return None
+
+
+class RetraceSiteRegistration(Rule):
+    id = "retrace-site-registration"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.inventory = []
+
+    def visit(self, ctx, project):
+        parents = build_parent_map(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not is_jit_call(node):
+                continue
+            chain = enclosing_functions(node, parents)
+            site = None
+            for fn in chain:
+                site = _find_record_retrace(fn)
+                if site is not None:
+                    break
+            enclosing_name = chain[0].name if chain else "<module>"
+            allow = self.config.jit_allowlist.get((ctx.rel, enclosing_name))
+            entry = {
+                "file": ctx.rel,
+                "line": node.lineno,
+                "function": qualname_of(node, parents),
+                "donation": _donation_of(node),
+                "cache_key": _cache_key_of(chain[0] if chain else None),
+                "retrace_site": site or (allow["site"] if allow else None),
+                "allowlisted": bool(allow and site is None),
+            }
+            if allow and site is None and allow.get("cache_key"):
+                entry["cache_key"] = allow["cache_key"]
+            self.inventory.append(entry)
+            if site is None and allow is None:
+                self.report(
+                    ctx, ctx.rel, node.lineno,
+                    "jax.jit site (in %s) reports no compiles: call "
+                    "telemetry.record_retrace('<site>', provenance) on "
+                    "the cache-miss path, or add ('%s', '%s') to "
+                    "tools/graftlint/config.py:JIT_ALLOWLIST naming where "
+                    "its compiles are counted — unregistered sites are "
+                    "invisible to the retrace watchdog"
+                    % (entry["function"], ctx.rel, enclosing_name))

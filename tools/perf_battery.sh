@@ -28,6 +28,14 @@ telemetry_report() {
     python tools/telemetry_report.py "$TELEMETRY_JSONL" 2>&1 | tee -a "$LOG"
 }
 
+# -1. trace-discipline gate (pure-AST, no jax import, no TPU session): an
+#     unkeyed policy lever or an unregistered jit cache invalidates every
+#     A/B below — fail fast before burning a scarce chip session on it.
+python -m tools.graftlint mxtpu/ 2>&1 | tee -a "$LOG"
+[ "${PIPESTATUS[0]}" -eq 0 ] || {
+  echo "GRAFTLINT FAILED — fix findings before spending a TPU session" \
+    | tee -a "$LOG"; exit 1; }
+
 # 0. is the chip alive? (90 s; bail early if wedged). This is the ONLY
 #    extra session besides the battery itself.
 timeout 90 python -c "
