@@ -798,6 +798,125 @@ def bench_serving(emit=None):
     }
 
 
+def bench_multichip_resnet(emit=None):
+    """Mesh-native Trainer scaling (ISSUE 7): resnet18 data-parallel over
+    1..N devices through ``gluon.Trainer(mesh=...)`` with ZeRO-1 on, at a
+    FIXED global batch (strong scaling — every device count computes the
+    same mathematical step, which is what makes the parity gate below
+    meaningful). One JSON line per device count (items/s, ``vs_baseline``
+    = speedup over the 1-device plain-Trainer run) plus a summary line.
+
+    Tiered gating, like conv_class: on a real multi-chip platform the
+    summary's ``vs_baseline`` is the max-count scaling efficiency
+    (speedup / devices — the ROADMAP item 1 acceptance number). On the
+    forced-host-device tier the N "devices" share one socket, so scaling
+    numbers are meaningless; there the summary gates on parity (every
+    count's final loss tracks the 1-device run to reduce-order tolerance)
+    + compile budget (ZERO post-warmup compiles at the fused_optimizer
+    retrace site for every count) and reports 1.0/0.0."""
+    import jax
+
+    import mxtpu as mx
+    from mxtpu import autograd, gluon, telemetry
+    from mxtpu.gluon.model_zoo import vision
+    from mxtpu.parallel import make_mesh
+
+    if emit is None:
+        emit = _emit
+    ndev = len(jax.devices())
+    if ndev < 2:
+        return {"metric": "multichip_resnet_scaling",
+                "error": "skipped: needs >1 device (have %d) — run the "
+                         "host tier with XLA_FLAGS=--xla_force_host_"
+                         "platform_device_count=8" % ndev}
+    batch = int(os.environ.get("BENCH_MC_BATCH", "32"))
+    img = int(os.environ.get("BENCH_MC_IMG", "64"))
+    steps = int(os.environ.get("BENCH_MC_STEPS", "10"))
+    counts = [n for n in (1, 2, 4, 8, 16, 32, 64)
+              if n <= ndev and batch % n == 0]
+    rng = np.random.RandomState(0)
+    x_np = rng.uniform(-1, 1, (batch, 3, img, img)).astype(np.float32)
+    y_np = rng.randint(0, 10, (batch,)).astype(np.float32)
+    platform = jax.devices()[0].platform
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def measure(n):
+        mx.random.seed(0)  # identical init per count — parity is exact
+        net = vision.resnet18_v1()
+        net.initialize()
+        x, y = mx.nd.array(x_np), mx.nd.array(y_np)
+        net(x)  # settle deferred shapes
+        net.hybridize()
+        mesh = make_mesh({"data": n}, jax.devices()[:n]) if n > 1 else None
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.01, "momentum": 0.9},
+                           mesh=mesh, zero1=True)
+        xs, ys = (tr.shard_batch(x, y)) if mesh is not None else (x, y)
+        params = list(net.collect_params().values())
+
+        def one():
+            with autograd.record():
+                l = loss_fn(net(xs), ys).mean()
+            l.backward()
+            tr.step(1)
+            return l
+
+        warm = None
+        for _ in range(2):  # warmup: every compile lands here
+            warm = one()
+        jax.block_until_ready([p.data()._data for p in params])
+        # parity gates on the POST-WARMUP loss: two steps in, the value is
+        # O(log n_classes) and cross-device reduce-order ULPs have not yet
+        # been amplified by training dynamics (a fully-trained-down loss
+        # near zero diverges relatively even between correct runs)
+        warm_loss = float(warm.asnumpy())
+        # retrace_stats is None until the site's first recorded compile
+        # (e.g. MXTPU_FUSED_OPTIMIZER=0 takes the eager loop)
+        c0 = (telemetry.retrace_stats("fused_optimizer")
+              or {}).get("compiles", 0)
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(steps):
+            last = one()
+        jax.block_until_ready([p.data()._data for p in params])
+        dt = time.perf_counter() - t0
+        compiles = (telemetry.retrace_stats("fused_optimizer")
+                    or {}).get("compiles", 0) - c0
+        return steps * batch / dt, warm_loss, float(last.asnumpy()), compiles
+
+    rate1 = None
+    lines = []
+    for n in counts:
+        rate, warm_loss, final_loss, compiles = measure(n)
+        if rate1 is None:
+            rate1 = rate
+        line = {"metric": "multichip_resnet_n%d" % n, "devices": n,
+                "value": round(rate, 2), "unit": "images/sec",
+                "vs_baseline": round(rate / rate1, 3),
+                "warm_loss": warm_loss, "final_loss": final_loss,
+                "post_warmup_compiles": compiles}
+        lines.append(line)
+        emit(line)
+    parity_ok = all(abs(l["warm_loss"] - lines[0]["warm_loss"]) < 1e-3
+                    for l in lines)
+    compile_ok = all(l["post_warmup_compiles"] == 0 for l in lines)
+    top = lines[-1]
+    if platform == "cpu":
+        # host tier: the gate is parity + compile budget, not throughput
+        vs = 1.0 if (parity_ok and compile_ok) else 0.0
+    else:
+        vs = round(top["vs_baseline"] / top["devices"], 3)  # efficiency
+    return {
+        "metric": "multichip_resnet_scaling_b%d" % batch,
+        "value": top["value"], "unit": "images/sec",
+        "devices": top["devices"],
+        "speedup_vs_1dev": top["vs_baseline"],
+        "parity_ok": parity_ok, "compile_budget_ok": compile_ok,
+        "vs_baseline": vs,
+        "mfu": None, "hfu": None,
+    }
+
+
 def bench_sparse_linear():
     """BASELINE config 5: sparse linear classification samples/sec
     (examples/sparse/linear_classification.py — LibSVM CSR batches through
@@ -842,6 +961,7 @@ CONFIGS = {
     "telemetry_overhead": bench_telemetry_overhead,
     "conv_class": bench_conv_class,
     "serving": bench_serving,
+    "multichip_resnet": bench_multichip_resnet,
     "sparse_linear": bench_sparse_linear,
     "lstm_ptb": bench_lstm_ptb,
     "bert_base": bench_bert_base,

@@ -195,6 +195,16 @@ def load_block(block, directory, step=0):
     return block
 
 
+def _state_leaves(st):
+    """Flat leaves of one param's optimizer state. States live in the rule
+    registry's structure — None | array | tuple nest (ShardedTrainStep
+    shares mxtpu.optimizer_fused's update rules) — and the on-disk layout
+    keys them positionally (``p<j>__<i>``), which enumerates identically
+    for the old always-a-tuple layout, so pre-ISSUE-7 checkpoints restore
+    unchanged."""
+    return jax.tree_util.tree_leaves(st)
+
+
 def save_train_step(train_step, directory, step=0, async_save=False,
                     force=False):
     """Checkpoint a ShardedTrainStep: parameters AND optimizer state, each
@@ -204,7 +214,7 @@ def save_train_step(train_step, directory, step=0, async_save=False,
         "params": _keyed(train_step._param_datas),
         "opt": {("p%d__%d" % (j, i)): s
                 for j, st in enumerate(train_step._opt_states)
-                for i, s in enumerate(st)},
+                for i, s in enumerate(_state_leaves(st))},
         "meta": {"num_update": train_step._num_update},
     }
     _guard_overwrite(_step_dir(directory, step), force)
@@ -217,7 +227,7 @@ def save_train_step(train_step, directory, step=0, async_save=False,
     # (deleted on read), so a crashed background write cannot leave a
     # misleading fingerprint behind.
     _write_meta(_step_dir(directory, step),
-                {"state_counts": [len(st)
+                {"state_counts": [len(_state_leaves(st))
                                   for st in train_step._opt_states]})
     return ckptr
 
@@ -229,7 +239,7 @@ def load_train_step(train_step, directory, step=0):
     def _target(d):
         return jax.ShapeDtypeStruct(d.shape, d.dtype, sharding=d.sharding)
 
-    live_counts = [len(st) for st in train_step._opt_states]
+    live_counts = [len(_state_leaves(st)) for st in train_step._opt_states]
     meta = _read_meta(_step_dir(directory, step))
     if meta is not None and meta.get("state_counts") != live_counts:
         raise MXNetError(
@@ -242,7 +252,7 @@ def load_train_step(train_step, directory, step=0):
         "params": _keyed([_target(d) for d in train_step._param_datas]),
         "opt": {("p%d__%d" % (j, i)): _target(s)
                 for j, st in enumerate(train_step._opt_states)
-                for i, s in enumerate(st)},
+                for i, s in enumerate(_state_leaves(st))},
         "meta": {"num_update": 0},
     }
     def _ra(t):
@@ -264,8 +274,12 @@ def load_train_step(train_step, directory, step=0):
     train_step._param_datas = new_datas
     for p, d in zip(train_step._params, new_datas):
         p.data()._set_data(d)
+    # rebuild each state in its live structure from the flat leaves
     train_step._opt_states = [
-        tuple(restored["opt"]["p%d__%d" % (j, i)] for i in range(len(st)))
+        jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(st),
+            [restored["opt"]["p%d__%d" % (j, i)]
+             for i in range(len(_state_leaves(st)))])
         for j, st in enumerate(train_step._opt_states)]
     train_step._num_update = int(restored["meta"]["num_update"])
     return train_step
@@ -386,6 +400,13 @@ def load_trainer(trainer, directory, step=0):
             and upd_scaler is not trainer._loss_scaler:
         trainer._loss_scaler.load_state_dict(upd_scaler.state_dict())
         upd.scaler = trainer._loss_scaler
+    # re-place the restored state on the trainer's MeshPlan NOW that
+    # param_dict is rebound: set_states ran its placement pass against
+    # the blob's stripped param_dict, so ZeRO eligibility (which needs
+    # the weight's dim 0) could not be decided there
+    replace = getattr(upd, "_replace_states_on_plan", None)
+    if replace is not None:
+        replace()
     _random.set_key_data(np.asarray(restored["extra"]["rng"]))
     return trainer
 

@@ -9,8 +9,23 @@ so `_allreduce_grads` reduces across the mesh via the kvstore's XLA-collective
 push/pull rather than across per-GPU copies. ``update_on_kvstore`` semantics are
 preserved: True runs the optimizer inside the store (the reference's server-side
 update), False runs the updater locally after the reduce.
+
+Mesh-native mode (ISSUE 7): pass ``mesh=`` (or set ``MXTPU_MESH``) and the
+Trainer becomes the multi-chip fast path the reference's CommDevice/ps-lite
+machinery approximated — parameters and optimizer state get
+``NamedSharding``s at ``_init_kvstore`` time (ONE logical replicated copy;
+ZeRO-1 data-axis-sharded optimizer state where divisible, arXiv:2004.13336),
+:meth:`Trainer.shard_batch` lays the batch on the data axis, and
+:meth:`step` routes through the SAME donated FusedUpdater jit taking the
+sharded state — gradient reduction is GSPMD dataflow compiled into
+backward + the fused update, so the kvstore's device kind degrades to a
+thin control-plane view (init/broadcast/embedding pulls) over those
+collectives. The whole optimizer zoo, the numerics sentinel, loss scaling,
+and orbax checkpointing ride unchanged.
 """
 from __future__ import annotations
+
+import os
 
 from .. import optimizer as opt_mod
 from .. import telemetry
@@ -28,11 +43,19 @@ class Trainer:
     in-graph, and :meth:`step` returns the device ``step_ok`` scalar
     (fetched asynchronously — no hot-loop host sync). Scale the loss with
     ``scaler.scale(loss)`` before ``backward()``; the unscale happens
-    inside the fused update. Scaler state rides save_states/load_states."""
+    inside the fused update. Scaler state rides save_states/load_states.
+
+    ``mesh``: an optional ``jax.sharding.Mesh`` with a ``data_axis`` axis —
+    multi-chip data-parallel training through this Trainer's own step (see
+    module docstring). ``MXTPU_MESH=1|auto`` builds one over every visible
+    device when the argument is omitted; ``MXTPU_MESH=<n>`` over the first
+    n. ``zero1`` (default env ``MXTPU_ZERO1``, on) shards the optimizer
+    state and update compute over the data axis — per-replica state bytes
+    divide by the axis size, the loss trajectory is bit-identical."""
 
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
                  compression_params=None, update_on_kvstore=None,
-                 loss_scaler=None):
+                 loss_scaler=None, mesh=None, zero1=None, data_axis="data"):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -54,10 +77,48 @@ class Trainer:
         self._init_optimizer(optimizer, optimizer_params)
         if loss_scaler is not None:
             self._updaters[0].scaler = loss_scaler
+        self._mesh = self._resolve_mesh(mesh, data_axis)
+        self._data_axis = data_axis
+        if zero1 is None:
+            zero1 = os.environ.get("MXTPU_ZERO1", "1") != "0"
+        self._zero1 = bool(zero1) and self._mesh is not None
+        if self._mesh is not None:
+            if update_on_kvstore:
+                raise MXNetError(
+                    "update_on_kvstore=True is incompatible with mesh=: the "
+                    "mesh-native step IS the store-side update (one logical "
+                    "copy, GSPMD collectives inside the fused jit)")
+            set_mesh = getattr(self._updaters[0], "set_mesh", None)
+            if set_mesh is None:
+                raise MXNetError(
+                    "mesh= needs a mesh-capable updater (FusedUpdater); got "
+                    "%s" % type(self._updaters[0]).__name__)
+            set_mesh(self._mesh, data_axis, self._zero1)
         self._kv_initialized = False
         self._kvstore_kind = kvstore
         self._kvstore = None
         self._update_on_kvstore = update_on_kvstore
+
+    @staticmethod
+    def _resolve_mesh(mesh, data_axis):
+        if mesh is not None:
+            if data_axis not in mesh.shape:
+                raise MXNetError("mesh has no %r axis (axes: %s)"
+                                 % (data_axis, tuple(mesh.shape)))
+            return mesh
+        spec = os.environ.get("MXTPU_MESH", "0")
+        if spec in ("", "0"):
+            return None
+        from ..parallel import mesh as mesh_mod
+        if spec in ("1", "auto"):
+            return mesh_mod.data_parallel_mesh(axis=data_axis)
+        try:
+            n = int(spec)
+        except ValueError:
+            raise MXNetError(
+                "MXTPU_MESH=%r: use 1|auto (all visible devices on one "
+                "%r axis) or an integer device count" % (spec, data_axis))
+        return mesh_mod.make_mesh({data_axis: n})
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -74,15 +135,26 @@ class Trainer:
         self._updaters = [opt_mod.get_updater(self._optimizer)]
 
     def _init_kvstore(self):
+        if self._mesh is not None:
+            self._place_on_mesh()
         if self._kvstore_kind:
             from .. import kvstore as kv_mod
             kv = kv_mod.create(self._kvstore_kind) \
                 if isinstance(self._kvstore_kind, str) else self._kvstore_kind
+            if self._mesh is not None:
+                if "dist" in kv.type:
+                    raise MXNetError(
+                        "mesh= with a dist_* kvstore is contradictory: a "
+                        "multi-host mesh IS the distributed path (one mesh "
+                        "spanning jax.distributed processes, collectives "
+                        "over DCN) — use a device kvstore kind and a "
+                        "multi-process mesh instead")
+                kv.attach_mesh(self._mesh)
             if self._compression_params:
                 kv.set_gradient_compression(self._compression_params)
             update_on_kvstore = self._update_on_kvstore
             if update_on_kvstore is None:
-                update_on_kvstore = "dist" in kv.type
+                update_on_kvstore = self._mesh is None and "dist" in kv.type
             for i, param in enumerate(self._params):
                 if param._data is not None:
                     kv.init(i, param.data())
@@ -97,6 +169,52 @@ class Trainer:
             self._kvstore = None
             self._update_on_kvstore = False
         self._kv_initialized = True
+
+    def _place_on_mesh(self):
+        """Mesh-native placement (module docstring): every parameter (and
+        its gradient buffer) becomes ONE logical replicated array laid out
+        on the mesh, and the optimizer state is created NOW and placed by
+        the updater's MeshPlan — ZeRO-1 data-axis shards where dim 0
+        divides, replicated otherwise. Runs once, at kvstore-init time,
+        exactly where the reference bound parameters to its store."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        repl = NamedSharding(self._mesh, PartitionSpec())
+        updater = self._updaters[0]
+        ensure = getattr(updater, "ensure_state", None)
+        for i, param in enumerate(self._params):
+            if param._data is None:
+                continue
+            d = param.data()
+            d._set_data(jax.device_put(d._data, repl))
+            if d._grad is not None:
+                d._grad._set_data(jax.device_put(d._grad._data, repl))
+            if ensure is not None and param.grad_req != "null":
+                ensure(i, d)
+
+    def shard_batch(self, *arrays):
+        """Place batch array(s) sharded over the mesh data axis (dim 0) —
+        the per-step input layout of mesh-native training. Without a mesh
+        this is the identity, so loops can call it unconditionally.
+        Returns one NDArray per input (a single input returns a single
+        NDArray)."""
+        from ..ndarray import NDArray
+        if self._mesh is None:
+            return arrays[0] if len(arrays) == 1 else tuple(arrays)
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+        sh = NamedSharding(self._mesh, PartitionSpec(self._data_axis))
+        n = self._mesh.shape[self._data_axis]
+        out = []
+        for a in arrays:
+            d = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+            if not d.shape or d.shape[0] % n:
+                raise MXNetError(
+                    "batch dim %s does not divide the %r mesh axis (%d)"
+                    % (d.shape[:1] or "<scalar>", self._data_axis, n))
+            out.append(NDArray(jax.device_put(d, sh)))
+        return out[0] if len(out) == 1 else tuple(out)
 
     @property
     def learning_rate(self):
@@ -157,6 +275,14 @@ class Trainer:
 
     def _allreduce_grads(self):
         if self._kvstore is None:
+            return
+        if self._mesh is not None:
+            # mesh-native fast path: there is ONE logical mesh-laid-out
+            # copy of every gradient and the cross-device reduction is
+            # GSPMD dataflow compiled into backward + the fused update —
+            # the push/pull round trip through the store would only add
+            # host-driven copies. Push/pull stay available as the control
+            # plane (init/broadcast/embedding pulls) on the attached mesh.
             return
         # ONE grouped push per step: keys pushed together fuse into a
         # single flattened DCN allreduce per dtype inside the dist kvstore
@@ -229,3 +355,10 @@ class Trainer:
                 updater.set_states(states)
                 updater.optimizer = self._optimizer
         self._optimizer.param_dict = {i: p for i, p in enumerate(self._params)}
+        # with param_dict rebound, restored states can go back onto the
+        # MeshPlan (ZeRO eligibility needs the weight's dim 0, which the
+        # blob's stripped param_dict could not provide inside set_states)
+        for updater in self._updaters:
+            replace = getattr(updater, "_replace_states_on_plan", None)
+            if replace is not None:
+                replace()

@@ -15,9 +15,20 @@ collective spanning DCN via jax.distributed initialization — `dist_sync` and `
 therefore share one implementation. `dist_async`'s parameter-server semantics have no
 collective analog and raise (SURVEY §7 hard-part 5 scopes this to sync).
 
-The data-plane reduction for the *fast path* happens inside the jitted train step
-(mxtpu.parallel); this KVStore services the Trainer/Module API: Init/Push/Pull/
-set_updater/rank/num_workers/Barrier, so frontend training loops run unmodified.
+The data-plane reduction for the *fast path* happens inside jitted steps —
+``mxtpu.parallel.ShardedTrainStep`` and the mesh-native ``gluon.Trainer``
+(``Trainer(mesh=...)``), whose gradient reduction is GSPMD collectives
+compiled into the donated fused update. With a mesh attached
+(:meth:`KVStore.attach_mesh`, done by the Trainer at init) the device kind
+is therefore a THIN CONTROL-PLANE VIEW over those same collectives: stored
+values live as one logical replicated array on the mesh, so store-side
+arithmetic (tree-sum merges, updater steps, row-sparse pulls) lowers to
+the identical XLA collective layer, and the hot training loop never calls
+push/pull at all — they remain the API for parameter init/broadcast,
+occasional sync, and embedding pulls, exactly the reference's control
+plane. This KVStore services the Trainer/Module API: Init/Push/Pull/
+set_updater/rank/num_workers/Barrier, so frontend training loops run
+unmodified.
 """
 from __future__ import annotations
 
@@ -39,21 +50,30 @@ def _key_str(key):
 class KVStore:
     """Key-value store for parameter synchronization (ref: kvstore.h:59)."""
 
-    def __init__(self, kind="local"):
+    def __init__(self, kind="local", mesh=None):
         self._kind = kind
         self._store = {}      # key -> NDArray (the merged/authoritative copy)
         self._updater = None
         self._optimizer = None
         self._compression = None
+        self._mesh = mesh
 
     @property
     def type(self):
         return self._kind
 
+    def attach_mesh(self, mesh):
+        """Adopt a ``jax.sharding.Mesh``: subsequently-initialized keys are
+        stored as ONE logical replicated array laid out on it, making this
+        store a thin control-plane view over the mesh's collectives (module
+        docstring). Called by ``gluon.Trainer(mesh=...)`` before init."""
+        self._mesh = mesh
+
     # ------------------------------------------------------------------- init
     def init(self, key, value):
         """Initialize key(s) (ref: KVStore::Init; rank-0 broadcast semantics are
-        trivial single-logical-copy here)."""
+        trivial single-logical-copy here — on an attached mesh the stored
+        copy is laid out replicated, the literal broadcast)."""
         keys, values = _normalize(key, value)
         for k, v in zip(keys, values):
             if k in self._store:
@@ -61,6 +81,14 @@ class KVStore:
             # OWN copy, not an alias of the caller's buffer: the store-side
             # fused update (optimizer_fused.py) DONATES store weights to
             # XLA, which would delete a buffer the caller still reads
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                d = jax.device_put(v._data,
+                                   NamedSharding(self._mesh, PartitionSpec()))
+                if d is v._data:  # already placed: device_put short-circuits
+                    d = d.copy()
+                self._store[k] = NDArray(d)
+                continue
             self._store[k] = NDArray(jnp.asarray(v._data).copy())
 
     # -------------------------------------------------------------- push/pull
@@ -85,14 +113,23 @@ class KVStore:
         for k, vs in zip(keys, values):
             if k not in self._store:
                 raise MXNetError("key %s has not been initialized" % k)
-            # reduce across "devices": with one logical copy this is a tree-sum
-            # of the pushed list (ElementwiseSum, src/ndarray/ndarray.cc:1280)
-            merged = vs[0]._data
-            for v in vs[1:]:
-                merged = merged + v._data
+            # reduce across "devices": with one logical copy this is a
+            # tree-sum of the pushed list (ElementwiseSum,
+            # src/ndarray/ndarray.cc:1280) — ONE fused stack-and-sum, not a
+            # sequential a+b Python loop that would emit O(copies) adds
+            if len(vs) == 1:
+                merged = vs[0]._data
+            else:
+                merged = jnp.sum(jnp.stack([v._data for v in vs]), axis=0)
             merged_list.append(merged)
         if self._kind.startswith("dist"):
             merged_list = self._dist_reduce(keys, merged_list)
+        if self._mesh is not None:
+            # keep the store's invariant under pushes of un-placed values:
+            # stored copies are ONE logical replicated array on the mesh
+            from jax.sharding import NamedSharding, PartitionSpec
+            repl = NamedSharding(self._mesh, PartitionSpec())
+            merged_list = [jax.device_put(m, repl) for m in merged_list]
         if self._updater is None:
             for k, merged in zip(keys, merged_list):
                 self._store[k]._set_data(merged)
@@ -367,16 +404,23 @@ def _normalize_grouped(key, value):
     return [_key_str(key)], [list(vs)]
 
 
-def create(name="local"):
+def create(name="local", mesh=None):
     """Factory (ref: src/kvstore/kvstore.cc:40-72). `local`, `device`, and `nccl`
     collapse to the same XLA-collective store; `dist_sync*` requires
-    jax.distributed multi-process initialization."""
+    jax.distributed multi-process initialization. ``mesh`` pre-attaches a
+    ``jax.sharding.Mesh`` (see :meth:`KVStore.attach_mesh`)."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     if name in ("local", "local_update_cpu", "local_allreduce_cpu",
                 "local_allreduce_device", "device", "nccl"):
-        return KVStore(name)
+        return KVStore(name, mesh=mesh)
     if name in ("dist_sync", "dist_sync_device"):
+        if mesh is not None:
+            raise MXNetError(
+                "kvstore %r cannot pre-attach a mesh: a multi-host mesh "
+                "IS the distributed path (one mesh over jax.distributed "
+                "processes, collectives over DCN) — use a device kind "
+                "with the mesh instead" % name)
         from . import distributed
         if not distributed.is_initialized():
             raise MXNetError(

@@ -611,7 +611,17 @@ class Updater:
         state = {}
         for k, v in self.states.items():
             state[k] = _state_to_numpy(v)
-        return pickle.dumps((state, self.optimizer) if dump_optimizer else state)
+        if not dump_optimizer:
+            return pickle.dumps(state)
+        # the live param_dict holds Parameters wrapping device-placed
+        # buffers (on a mesh: NamedSharding -> Mesh -> Device, which
+        # pickle refuses); every load path rebinds it to the live params,
+        # so serialize the optimizer without it
+        pd, self.optimizer.param_dict = self.optimizer.param_dict, {}
+        try:
+            return pickle.dumps((state, self.optimizer))
+        finally:
+            self.optimizer.param_dict = pd
 
     def set_states(self, states):
         import pickle

@@ -34,6 +34,18 @@ bias-correction step count, which moves to a DEVICE scalar ``t_good`` so
 the skip costs no host sync), and the loss-scaler growth/backoff runs
 in-graph on traced scalars (flag flips never recompile; guard on/off is
 exactly one extra compile — the guard bit is part of the jit cache key).
+
+Mesh-native stepping (ISSUE 7): :meth:`FusedUpdater.set_mesh` adopts a
+:class:`MeshPlan` — parameters live as ONE logical replicated array on a
+``jax.sharding.Mesh`` and the cross-replica weight-update sharding of
+arXiv:2004.13336 (ZeRO-1) moves INTO this donated jit: the gradient is
+constrained to a data-axis shard (reduce-scatter, or a free slice when it
+arrives replicated from the eager backward), the optimizer update runs
+shard-local on 1/N of the rows, only the weight is all-gathered back, and
+the optimizer state STAYS sharded — state memory and update FLOPs divide
+by the replica count. The sharding layout (per-buffer tokens + the plan
+fingerprint) is part of the jit cache key, the down payment on ROADMAP
+item 5's one-compile-cache engine.
 """
 from __future__ import annotations
 
@@ -42,6 +54,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as _P
 
 from . import resilience
 from . import telemetry
@@ -51,8 +64,8 @@ from .optimizer import (SGD, Adam, AdaGrad, RMSProp, AdaDelta, Ftrl, Adamax,
                         Nadam, NAG, Signum, FTML, DCASGD, Test, GroupAdaGrad,
                         Updater)
 
-__all__ = ["FusedUpdater", "fused_enabled", "cache_size", "reset",
-           "FUSED_STATS"]
+__all__ = ["FusedUpdater", "MeshPlan", "fused_enabled", "cache_size",
+           "reset", "FUSED_STATS", "functional_rule", "traced_rule_names"]
 
 
 def fused_enabled():
@@ -409,6 +422,113 @@ _RULES = {
 }
 
 
+def functional_rule(optimizer):
+    """The pure functional update rule for an Optimizer INSTANCE (exact
+    class match — a subclass overriding ``update`` must not inherit its
+    base rule), or None for the eager-only set (sparse/SGLD/LBSGD/unknown).
+    ONE registry serves both jit surfaces: this module's fused Trainer
+    step and ``mxtpu.parallel.ShardedTrainStep``."""
+    return _RULES.get(type(optimizer))
+
+
+def traced_rule_names():
+    """Registry names of optimizers with a traced-t hyper twin — the set a
+    fully-in-graph step (guarded fused update, ShardedTrainStep) supports."""
+    return sorted(k.__name__.lower()
+                  for k, r in _RULES.items() if r.thyper is not None)
+
+
+# ------------------------------------------------------------ mesh placement
+class MeshPlan:
+    """Weight-update placement plan for the fused step on a mesh.
+
+    Parameters are ONE logical replicated array; ``zero1`` additionally
+    shards the optimizer state (and the update computation) over the
+    ``data_axis`` — the cross-replica weight-update sharding of
+    arXiv:2004.13336: reduce-scatter(grad) -> shard-local update ->
+    all-gather(weight), optimizer-state memory / replica count, loss
+    trajectory bit-identical. Params whose dim 0 does not divide the axis
+    keep replicated state (and a replicated update)."""
+
+    __slots__ = ("mesh", "data_axis", "zero1", "axis_size")
+
+    def __init__(self, mesh, data_axis="data", zero1=True):
+        if data_axis not in mesh.shape:
+            raise ValueError("data_axis %r not in mesh axes %s"
+                             % (data_axis, tuple(mesh.shape)))
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.zero1 = bool(zero1)
+        self.axis_size = int(mesh.shape[data_axis])
+
+    def fingerprint(self):
+        """Hashable jit-cache-key component: the SAME step traced for a
+        different mesh/axis/ZeRO setting — or the same axis shape over
+        DIFFERENT devices (the constraint shardings are closed over the
+        concrete mesh) — is a different executable."""
+        return (tuple(self.mesh.shape.items()), self.data_axis, self.zero1,
+                _mesh_dev_ids(self.mesh))
+
+    def replicated(self):
+        return NamedSharding(self.mesh, _P())
+
+    def shard0(self):
+        return NamedSharding(self.mesh, _P(self.data_axis))
+
+    def _dim0_ok(self, shape):
+        return bool(shape) and shape[0] % self.axis_size == 0
+
+    def zero_eligible(self, w_shape, state):
+        """ZeRO-1 eligibility for one param: dim 0 of the weight AND of
+        every state leaf must divide the data axis (GroupAdaGrad's (dim0,)
+        history and the mp f32 master both qualify with the weight)."""
+        if not (self.zero1 and self.axis_size > 1
+                and self._dim0_ok(tuple(w_shape))):
+            return False
+        shapes = []
+        _leaf_shapes(state, shapes)
+        return all(self._dim0_ok(s) for s in shapes)
+
+
+def _leaf_shapes(s, acc):
+    if s is None:
+        return acc
+    if isinstance(s, NDArray):
+        acc.append(tuple(s.shape))
+        return acc
+    if hasattr(s, "shape"):  # raw jax array leaf
+        acc.append(tuple(s.shape))
+        return acc
+    for x in s:
+        _leaf_shapes(x, acc)
+    return acc
+
+
+def _mesh_dev_ids(mesh):
+    return tuple(int(d.id) for d in mesh.devices.flat)
+
+
+def _shard_token(arr):
+    """Hashable sharding descriptor for the jit cache key: the layout is
+    part of the compiled executable's contract, so two steps over the same
+    shapes but different placements — including the same axis shape over
+    different device subsets — must not share an entry (ROADMAP item 5 —
+    sharding enters the key)."""
+    sh = getattr(arr, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        return (tuple(sh.mesh.shape.items()), str(sh.spec),
+                _mesh_dev_ids(sh.mesh))
+    return None
+
+
+def _tree_shard_token(s):
+    if s is None:
+        return None
+    if isinstance(s, tuple):
+        return tuple(_tree_shard_token(x) for x in s)
+    return _shard_token(s)
+
+
 # ----------------------------------------------------- state pytree helpers
 def _tree_data(s):
     if s is None:
@@ -492,15 +612,50 @@ def _tree_where(ok, new, old):
     return jnp.where(ok, new, old)
 
 
-def _build(rule, static, mp_flags, out_dtypes):
+def _zero_shards(plan, zf):
+    """The (shard, gather, tree-shard) constraint trio for one param under
+    the plan — identity functions when the param is not ZeRO-eligible.
+
+    ZeRO-1 inside the donated jit (arXiv:2004.13336): constrain grad,
+    weight, and state to the data-axis shard (a reduce-scatter when the
+    grad arrives sharded from an in-jit backward, a free dynamic-slice
+    when it arrives replicated from the eager autograd), run the update
+    rule shard-local, then all-gather ONLY the weight; the state keeps the
+    sharded layout, so its memory divides by the replica count."""
+    if plan is None or not zf:
+        ident = lambda x: x  # noqa: E731
+        return ident, ident, ident
+    sh0, repl = plan.shard0(), plan.replicated()
+
+    def shard(x):
+        return jax.lax.with_sharding_constraint(x, sh0)
+
+    def gather(x):
+        return jax.lax.with_sharding_constraint(x, repl)
+
+    def tree_shard(s):
+        if s is None:
+            return None
+        if isinstance(s, tuple):
+            return tuple(tree_shard(x) for x in s)
+        return shard(s)
+
+    return shard, gather, tree_shard
+
+
+def _build(rule, static, mp_flags, out_dtypes, plan=None, zflags=None):
+    zflags = zflags or (False,) * len(mp_flags)
+
     def fused(w_list, g_list, s_list, h_list, rescale):
         # trace-time only (host-side): counts real recompiles, mirrored
         # into the telemetry registry for report()/the JSONL sink
         FUSED_STATS["traces"] += 1
         telemetry.inc("fused_optimizer.retraces")
         new_w, new_s = [], []
-        for w, g, s, h, mp, odt in zip(w_list, g_list, s_list, h_list,
-                                       mp_flags, out_dtypes):
+        for w, g, s, h, mp, odt, zf in zip(w_list, g_list, s_list, h_list,
+                                           mp_flags, out_dtypes, zflags):
+            shard, gather, tshard = _zero_shards(plan, zf)
+            w, g, s = shard(w), shard(g), tshard(s)
             if mp:
                 # multi-precision: state = (f32 master, base state); the
                 # update runs in f32 and storage keeps the bf16/f16 dtype
@@ -508,25 +663,28 @@ def _build(rule, static, mp_flags, out_dtypes):
                 master, base = s
                 nm, nb = rule.step(master, g.astype(jnp.float32), base, h,
                                    rescale, static)
-                new_w.append(nm.astype(odt))
-                new_s.append((nm, nb))
+                new_w.append(gather(nm).astype(odt))
+                new_s.append((tshard(nm), tshard(nb)))
             else:
                 nw, ns = rule.step(w, g, s, h, rescale, static)
-                new_w.append(nw)
-                new_s.append(ns)
+                new_w.append(gather(nw))
+                new_s.append(tshard(ns))
         return new_w, new_s
 
     return jax.jit(fused, donate_argnums=(0, 2))
 
 
-def _build_guarded(rule, static, mp_flags, out_dtypes, scaler_cfg):
+def _build_guarded(rule, static, mp_flags, out_dtypes, scaler_cfg,
+                   plan=None, zflags=None):
     """The guarded twin of :func:`_build`: same donated whole-model update,
     plus (inside the SAME jit, so the guard costs no extra dispatches or
     host syncs) the fused finite flag, the global grad norm, the skip-step
     ``where`` select on params/state/t, loss-scale unscaling, and the
     scaler's growth/backoff. ``scaler_cfg`` is the STATIC policy tuple
-    (part of the jit cache key); the scale value itself is traced."""
+    (part of the jit cache key); the scale value itself is traced. The
+    ZeRO-1 constraints compose: the skip select runs shard-local too."""
     thyper = rule.thyper
+    zflags = zflags or (False,) * len(mp_flags)
 
     def fused(w_list, g_list, s_list, lw_list, rescale, gstate, ext_sq):
         # trace-time only (host-side): counts real recompiles, mirrored
@@ -548,22 +706,24 @@ def _build_guarded(rule, static, mp_flags, out_dtypes, scaler_cfg):
         grad_norm = jnp.sqrt(sq) * inv
         t_eff = (t_good + 1).astype(jnp.float32)
         new_w, new_s = [], []
-        for w, g, s, lw, mp, odt in zip(w_list, g_list, s_list, lw_list,
-                                        mp_flags, out_dtypes):
+        for w, g, s, lw, mp, odt, zf in zip(w_list, g_list, s_list, lw_list,
+                                            mp_flags, out_dtypes, zflags):
             lr, wd = lw
             h = thyper(static, lr, wd, t_eff)
+            shard, gather, tshard = _zero_shards(plan, zf)
+            w, g, s = shard(w), shard(g), tshard(s)
             if mp:
                 master, base = s
                 nm, nb = rule.step(master, g.astype(jnp.float32), base, h,
                                    inv, static)
                 nm = jnp.where(ok, nm, master)
                 nb = _tree_where(ok, nb, base)
-                new_w.append(nm.astype(odt))
-                new_s.append((nm, nb))
+                new_w.append(gather(nm).astype(odt))
+                new_s.append((tshard(nm), tshard(nb)))
             else:
                 nw, ns = rule.step(w, g, s, h, inv, static)
-                new_w.append(jnp.where(ok, nw, w))
-                new_s.append(_tree_where(ok, ns, s))
+                new_w.append(gather(jnp.where(ok, nw, w)))
+                new_s.append(tshard(_tree_where(ok, ns, s)))
         new_t = jnp.where(ok, t_good + 1, t_good)
         if scaler_cfg is not None:
             gf, bf, gi, max_s, min_s = scaler_cfg
@@ -608,9 +768,57 @@ class FusedUpdater(Updater):
         self._t_good = None     # device good-step count (guarded mode)
         self._noscaler_state = None  # cached (1.0, 0) scalars, never donated
         self._step_count = 0    # dispatched update_batch calls (fault index)
+        self._plan = None       # MeshPlan (Trainer(mesh=...) sets it)
 
     def _guard_active(self):
         return self.scaler is not None or resilience.guard_enabled()
+
+    # ------------------------------------------------------- mesh placement
+    def set_mesh(self, mesh, data_axis="data", zero1=True):
+        """Adopt a :class:`MeshPlan` (or drop it with ``mesh=None``).
+        Called by ``gluon.Trainer(mesh=...)`` at kvstore init; any state
+        that already exists is re-placed onto the plan."""
+        self._plan = MeshPlan(mesh, data_axis, zero1) \
+            if mesh is not None else None
+        for i in list(self.states):
+            self._place_state(i)
+
+    def ensure_state(self, index, weight):
+        """Create (and mesh-place) the optimizer state for one param now —
+        the Trainer calls this at ``_init_kvstore`` so every NamedSharding
+        lands before the first step, not lazily inside it."""
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+            self._place_state(index, weight)
+
+    def _place_state(self, index, weight=None):
+        """Lay one param's state out per the plan: data-axis sharded for
+        ZeRO-eligible params, replicated otherwise. In-place on the stored
+        NDArray leaves, so serialization and eager fallbacks see the same
+        objects."""
+        if self._plan is None:
+            return
+        st = self.states.get(index)
+        if st is None:
+            return
+        if weight is None:
+            weight = self.optimizer.param_dict.get(index) \
+                if isinstance(self.optimizer.param_dict, dict) else None
+        zok = weight is not None and getattr(weight, "shape", None) \
+            and self._plan.zero_eligible(tuple(weight.shape), st)
+        sh = self._plan.shard0() if zok else self._plan.replicated()
+
+        def put(x):
+            if x is None:
+                return
+            if isinstance(x, NDArray):
+                x._set_data(jax.device_put(x._data, sh))
+                return
+            for c in x:
+                put(c)
+
+        put(st)
 
     def update_batch(self, indices, grads, weights):
         if not indices:
@@ -629,8 +837,7 @@ class FusedUpdater(Updater):
         from .ndarray.sparse import RowSparseNDArray
         fused, eager = [], []
         for i, g, w in zip(indices, grads, weights):
-            if i not in self.states:
-                self.states[i] = opt.create_state_multi_precision(i, w)
+            self.ensure_state(i, w)
             if rule is None or isinstance(g, RowSparseNDArray) \
                     or isinstance(w, RowSparseNDArray):
                 eager.append((i, g, w))
@@ -661,22 +868,30 @@ class FusedUpdater(Updater):
         not silently fork the two cache-key semantics. ``hyper_of(i)``
         builds the traced per-param hyper tuple."""
         opt = self.optimizer
+        plan = self._plan
         w_datas, g_datas, s_datas, hypers = [], [], [], []
-        mp_flags, out_dtypes, specs = [], [], []
+        mp_flags, out_dtypes, specs, zflags = [], [], [], []
         for i, g, w in items:
             hypers.append(hyper_of(i))
             mp = bool(opt.multi_precision
                       and w.dtype in (jnp.float16, jnp.bfloat16))
             sd = _tree_data(self.states[i])
+            zf = plan is not None \
+                and plan.zero_eligible(tuple(w.shape), self.states[i])
             w_datas.append(w._data)
             g_datas.append(g._data)
             s_datas.append(sd)
             mp_flags.append(mp)
             out_dtypes.append(w._data.dtype)
+            zflags.append(zf)
+            # sharding tokens ride the spec: a layout change (mesh attach,
+            # ZeRO flip, a restored-replicated state) is a new executable,
+            # never a silent reuse of one traced for another placement
             specs.append((tuple(w.shape), str(w.dtype), str(g.dtype),
-                          _tree_spec(sd), mp))
+                          _tree_spec(sd), mp, zf, _shard_token(w._data),
+                          _tree_shard_token(sd)))
         return (w_datas, g_datas, s_datas, hypers, tuple(mp_flags),
-                tuple(out_dtypes), tuple(specs))
+                tuple(out_dtypes), tuple(specs), tuple(zflags))
 
     @staticmethod
     def _cached_jit(key, build):
@@ -692,8 +907,9 @@ class FusedUpdater(Updater):
             from .ops.registry import policy_key
             telemetry.record_retrace(
                 "fused_optimizer",
-                {"optimizer": key[0], "guard": len(key) > 3,
-                 "n_params": len(key[2]), "policy_key": list(policy_key())})
+                {"optimizer": key[0], "guard": "guard" in key,
+                 "n_params": len(key[2]), "mesh": key[3] is not None,
+                 "policy_key": list(policy_key())})
         return fn
 
     def _fused_apply(self, rule, items):
@@ -709,11 +925,14 @@ class FusedUpdater(Updater):
             return tuple(float(h) for h in rule.hyper(opt, i, t))
 
         (w_datas, g_datas, s_datas, hypers, mp_flags, out_dtypes,
-         specs) = self._gather_items(items, hyper_of)
+         specs, zflags) = self._gather_items(items, hyper_of)
         static = rule.static(opt)
-        key = (type(opt).__name__, static, specs)
+        plan = self._plan
+        key = (type(opt).__name__, static, specs,
+               plan.fingerprint() if plan else None)
         fn = self._cached_jit(
-            key, lambda: _build(rule, static, mp_flags, out_dtypes))
+            key, lambda: _build(rule, static, mp_flags, out_dtypes,
+                                plan, zflags))
         new_w, new_s = fn(w_datas, g_datas, s_datas, hypers,
                           float(opt.rescale_grad))
         FUSED_STATS["fused_steps"] += 1
@@ -805,15 +1024,17 @@ class FusedUpdater(Updater):
         for i, _, _ in items:
             opt._update_count(i)
         (w_datas, g_datas, s_datas, hypers, mp_flags, out_dtypes,
-         specs) = self._gather_items(
+         specs, zflags) = self._gather_items(
             items, lambda i: (float(opt._get_lr(i)), float(opt._get_wd(i))))
         static = rule.static(opt)
+        plan = self._plan
         # the guard bit + scaler policy ride the cache key: guard on/off is
         # exactly one extra compile, flag/scale flips are zero
-        key = (type(opt).__name__, static, specs, "guard", scfg)
+        key = (type(opt).__name__, static, specs,
+               plan.fingerprint() if plan else None, "guard", scfg)
         fn = self._cached_jit(
             key, lambda: _build_guarded(rule, static, mp_flags, out_dtypes,
-                                        scfg))
+                                        scfg, plan, zflags))
         new_w, new_s, new_gstate, ok, grad_norm = fn(
             w_datas, g_datas, s_datas, hypers, float(opt.rescale_grad),
             gstate, ext_sq)
@@ -856,6 +1077,7 @@ class FusedUpdater(Updater):
         if not (isinstance(obj, tuple) and len(obj) == 2
                 and obj[0] == self._RESILIENCE_TAG):
             super().set_states(states)
+            self._replace_states_on_plan()
             return
         payload = obj[1]
         if payload["t_good"] is not None:
@@ -876,3 +1098,21 @@ class FusedUpdater(Updater):
             else:
                 self.scaler.load_state_dict(sc)
         super().set_states(payload["base"])
+        self._replace_states_on_plan()
+
+    def _replace_states_on_plan(self):
+        """Restored states arrive as host-built single-device arrays; with
+        a MeshPlan active they must go back to their mesh layout (ZeRO
+        shard or replicated) or the next step would silently trace a new
+        executable for the foreign placement. A dump_optimizer blob
+        carries a STRIPPED param_dict (see Updater.get_states) under which
+        ZeRO eligibility cannot be decided — skip that pass entirely: the
+        load paths (Trainer.load_states, async_checkpoint.load_trainer)
+        re-invoke after rebinding the live params, and placing twice would
+        double the full-state transfers."""
+        if self._plan is None:
+            return
+        if not getattr(self.optimizer, "param_dict", None):
+            return
+        for i in list(self.states):
+            self._place_state(i)

@@ -18,6 +18,13 @@ reduction (src/kvstore/kvstore_local.h:184), and in-engine optimizer kernels
 The block's imperative forward is traced through the same `_TraceFrame`
 machinery as CachedOp (mxtpu/gluon/block.py), so BatchNorm moving-stat
 updates and Dropout RNG stay functional under the trace.
+
+Since ISSUE 7 this class is a thin wrapper over machinery shared with the
+mesh-native ``gluon.Trainer``: the optimizer update rules come from the
+``mxtpu.optimizer_fused`` registry (full zoo, traced-t hyper twins, one
+multi-precision storage rule), and the ZeRO-1 state-sharding plan mirrors
+``optimizer_fused.MeshPlan`` — the difference is only WHERE backward
+lives (inside this one jit vs the eager autograd tape).
 """
 from __future__ import annotations
 
@@ -27,12 +34,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .. import autograd
+from .. import optimizer as opt_mod
+from .. import optimizer_fused as _fused
 from .. import random as _random
 from ..base import MXNetError
 from ..gluon.block import _flatten_nd, _regroup, _run_traced
 from ..ndarray import NDArray
-from ..ops import optimizer_ops as _uo
+from ..optimizer_fused import _tree_data
 
 __all__ = ["ShardedTrainStep", "pure_forward"]
 
@@ -43,12 +51,15 @@ def pure_forward(block, train=False):
     """Extract the block's forward as a pure jittable function.
 
     Returns ``(fn, param_datas)`` where ``fn(param_datas, *input_arrays,
-    rng=None)`` maps raw jax arrays to raw jax array(s). Pass a fresh ``rng``
-    key per call for stochastic layers (Dropout) — with the default ``None``
-    a fixed key is used, which is only correct for deterministic inference
-    (every call would otherwise draw the SAME dropout mask). The block must
-    be initialized with shapes settled (run one eager forward first for
-    deferred init).
+    rng=None)`` maps raw jax arrays to raw jax array(s). For stochastic
+    layers (Dropout) in ``train=True`` mode, each ``rng=None`` call draws a
+    fresh key from ``mxtpu.random`` — two calls produce DIFFERENT dropout
+    masks, matching eager semantics (a fixed default key would silently
+    reuse one mask forever). Under an outer ``jax.jit`` the draw happens at
+    trace time and is baked into the executable: pass ``rng=`` explicitly
+    per call there. ``train=False`` keeps a fixed key — deterministic
+    inference needs no entropy. The block must be initialized with shapes
+    settled (run one eager forward first for deferred init).
     """
     params = list(block.collect_params().values())
     if any(p._data is None for p in params):
@@ -58,7 +69,10 @@ def pure_forward(block, train=False):
     param_datas = [p.data()._data for p in params]
 
     def fn(param_datas, *in_datas, rng=None):
-        key = jax.random.PRNGKey(0) if rng is None else rng
+        if rng is None:
+            key = _random.next_key() if train else jax.random.PRNGKey(0)
+        else:
+            key = rng
 
         def body():
             return block(*[NDArray(d) for d in in_datas])
@@ -70,43 +84,6 @@ def pure_forward(block, train=False):
     return fn, param_datas
 
 
-# --------------------------------------------------------------- optimizers
-# Functional (weight, grad, *states, **hyper) -> (weight, *states) adapters
-# over the same pure update kernels the imperative Optimizer zoo uses
-# (mxtpu/ops/optimizer_ops.py ~ src/operator/optimizer_op.cc).
-def _sgd(w, g, states, lr, wd, mom, t, clip_gradient=-1.0):
-    if mom == 0.0:
-        return _uo.sgd_update_fn(w, g, lr, wd=wd,
-                                 clip_gradient=clip_gradient), states
-    new_w, new_m = _uo.sgd_mom_update_fn(w, g, states[0], lr, momentum=mom,
-                                         wd=wd, clip_gradient=clip_gradient)
-    return new_w, (new_m,)
-
-
-def _adam(w, g, states, lr, wd, mom, t, beta1=0.9, beta2=0.999, epsilon=1e-8,
-          clip_gradient=-1.0):
-    # bias correction folded into lr, as the reference's Adam.update does
-    # (python/mxnet/optimizer/optimizer.py Adam)
-    coef1 = 1.0 - beta1 ** t
-    coef2 = 1.0 - beta2 ** t
-    lr_t = lr * jnp.sqrt(coef2) / coef1
-    new_w, new_mean, new_var = _uo.adam_update_fn(
-        w, g, states[0], states[1], lr_t, beta1=beta1, beta2=beta2,
-        epsilon=epsilon, wd=wd, clip_gradient=clip_gradient)
-    return new_w, (new_mean, new_var)
-
-
-# name -> (update_fn, state_init, accepted extra hyperparameter keys)
-_FUNCTIONAL_OPTS = {
-    "sgd": (_sgd,
-            lambda w, mom: () if mom == 0.0 else (jnp.zeros_like(w),),
-            ("clip_gradient",)),
-    "adam": (_adam,
-             lambda w, mom: (jnp.zeros_like(w), jnp.zeros_like(w)),
-             ("beta1", "beta2", "epsilon", "clip_gradient")),
-}
-
-
 class ShardedTrainStep:
     """One jitted, mesh-sharded training step for a gluon block.
 
@@ -115,10 +92,17 @@ class ShardedTrainStep:
     block : HybridBlock — initialized, shapes settled.
     loss : callable ``loss(out, label) -> NDArray`` (e.g. a gluon Loss).
     mesh : jax.sharding.Mesh with a data axis (and optionally model/sp axes).
-    optimizer : "sgd" | "adam".
-    optimizer_params : dict — learning_rate, momentum, wd (python-side; a
-        changed learning rate does NOT retrigger compilation: hyperparams are
-        traced scalars).
+    optimizer : registry name (or Optimizer instance) with a traced-t
+        functional rule in the ``mxtpu.optimizer_fused`` registry — the
+        whole zoo (sgd/adam/rmsprop/adagrad/adadelta/ftrl/adamax/nag/
+        signum/ftml/dcasgd/groupadagrad, ``optimizer_fused.
+        traced_rule_names()``), ONE registry shared with the fused Trainer
+        step so the two jit surfaces cannot drift. Host-state optimizers
+        (Nadam's m_schedule, SGLD's rng, LBSGD's norms) have no pure rule
+        and raise — use the eager ``gluon.Trainer`` for those.
+    optimizer_params : dict — learning_rate, momentum, wd, clip_gradient,
+        betas... (python-side; a changed learning rate does NOT retrigger
+        compilation: hyperparams are traced scalars).
     data_axis : mesh axis name the batch is sharded over.
     param_specs : list of ``(name_regex, PartitionSpec)`` — tensor-parallel
         placement rules; first match wins; default replicated. Shapes not
@@ -148,20 +132,41 @@ class ShardedTrainStep:
         self._batch_specs = batch_specs
 
         opt_params = dict(optimizer_params or {})
-        self._lr = float(opt_params.pop("learning_rate", 0.01))
-        self._mom = float(opt_params.pop("momentum", 0.0))
-        self._wd = float(opt_params.pop("wd", 0.0))
         self._lr_scheduler = opt_params.pop("lr_scheduler", None)
-        if optimizer not in _FUNCTIONAL_OPTS:
-            raise MXNetError("ShardedTrainStep supports %s; got %r"
-                             % (sorted(_FUNCTIONAL_OPTS), optimizer))
-        update_fn, state_init, extra_keys = _FUNCTIONAL_OPTS[optimizer]
-        extras = {k: opt_params.pop(k) for k in list(opt_params)
-                  if k in extra_keys}
-        if opt_params:
-            raise MXNetError("unknown optimizer_params for %r: %s"
-                             % (optimizer, sorted(opt_params)))
-        self._update_fn = (lambda *a, _f=update_fn, _e=extras: _f(*a, **_e))
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if opt_params:
+                raise MXNetError("optimizer_params must be empty when "
+                                 "optimizer is an Optimizer instance")
+            opt = optimizer
+        else:
+            try:
+                opt = opt_mod.create(optimizer, **opt_params)
+            except TypeError as e:
+                raise MXNetError("unknown optimizer_params for %r: %s"
+                                 % (optimizer, e))
+        # ONE functional-rule registry for both jit surfaces (ISSUE 7
+        # satellite): the fused Trainer step and this sharded step draw the
+        # same static/step/thyper triple, so the zoo and the multi-precision
+        # storage rule cannot fork between them
+        rule = _fused.functional_rule(opt)
+        if rule is None or rule.thyper is None:
+            raise MXNetError(
+                "ShardedTrainStep needs a pure traced-t update rule from "
+                "the mxtpu.optimizer_fused registry; %r has none "
+                "(supported: %s). Host-state optimizers (Nadam/SGLD/LBSGD) "
+                "keep their eager semantics on the gluon.Trainer path."
+                % (optimizer, _fused.traced_rule_names()))
+        if getattr(opt, "multi_precision", False):
+            raise MXNetError(
+                "ShardedTrainStep's in-jit update does not implement the "
+                "multi-precision (f32-master) storage rule — its states "
+                "would be (master, base) tuples the shared rule cannot "
+                "consume. Use the mesh-native gluon.Trainer, whose "
+                "FusedUpdater handles multi_precision sharded.")
+        self._opt = opt
+        self._rule = rule
+        self._static = rule.static(opt)
+        self._wd = float(opt.wd)
         self._num_update = 0
 
         params = list(block.collect_params().values())
@@ -187,6 +192,15 @@ class ShardedTrainStep:
             for p, s in zip(params, self._param_shardings)]
         for p, d in zip(params, self._param_datas):
             p.data()._set_data(d)
+        # optimizer state in the RULE's structure (None | array | tuple —
+        # exactly what the optimizer's create_state builds and the shared
+        # step fn consumes), materialized up front and placed on the mesh
+        raw_states = [
+            _tree_data(self._opt.create_state_multi_precision(
+                i, NDArray(d))) if t else None
+            for i, (d, t) in enumerate(zip(self._param_datas,
+                                           self._trainable))]
+
         # ZeRO-1 / cross-replica weight-update sharding (Xu et al. 2020,
         # arXiv:2004.13336 — PAPERS.md): optimizer state of replicated
         # params is sharded over the data axis; GSPMD then lowers the
@@ -194,28 +208,29 @@ class ShardedTrainStep:
         # all-gather(weight), cutting state memory and update FLOPs by the
         # replica count with bit-identical results (tests/test_parallel.py
         # asserts the loss trajectory matches the replicated run).
-        def _state_sharding(p_sh, d, t):
-            if not (shard_weight_update and t):
+        def _state_sharding(p_sh, d, st):
+            if not shard_weight_update:
                 return p_sh
             ax = mesh.shape.get(data_axis, 1)
-            if (p_sh.is_fully_replicated and d.ndim >= 1 and d.shape
-                    and d.shape[0] % ax == 0 and ax > 1):
+            leaves = jax.tree_util.tree_leaves(st)
+            if (p_sh.is_fully_replicated and ax > 1 and d.ndim >= 1
+                    and d.shape and d.shape[0] % ax == 0
+                    and all(l.ndim >= 1 and l.shape
+                            and l.shape[0] % ax == 0 for l in leaves)):
                 return NamedSharding(mesh, P(data_axis))
             return p_sh
 
         state_plans = [
-            _state_sharding(sh, d, t)
-            for d, t, sh in zip(self._param_datas, self._trainable,
-                                self._param_shardings)]
+            _state_sharding(sh, d, st)
+            for d, st, sh in zip(self._param_datas, raw_states,
+                                 self._param_shardings)]
         self._opt_states = [
-            tuple(self._place(s0, plan) for s0 in state_init(
-                jax.ShapeDtypeStruct(d.shape, d.dtype), self._mom))
-            if t else ()
-            for d, t, plan in zip(self._param_datas, self._trainable,
-                                  state_plans)]
+            jax.tree_util.tree_map(lambda s, _pl=plan: self._place(s, _pl),
+                                   st)
+            for st, plan in zip(raw_states, state_plans)]
         self._state_shardings = [
-            tuple(plan for _ in st)
-            for st, plan in zip(self._opt_states, state_plans)]
+            jax.tree_util.tree_map(lambda _s, _pl=plan: _pl, st)
+            for st, plan in zip(raw_states, state_plans)]
         self._jit = None
         self._in_fmt = None
         self._policy = None
@@ -283,10 +298,11 @@ class ShardedTrainStep:
              "policy_key": list(policy_key())})
         params, trainable = self._params, self._trainable
         block, loss_blk, forward = self._block, self._loss, self._forward
-        update_fn = self._update_fn
+        rule, static = self._rule, self._static
+        thyper = rule.thyper
         t_idx = [i for i, t in enumerate(trainable) if t]
 
-        wd, mom = self._wd, self._mom  # static: `if wd:` in the kernels
+        wd = self._wd  # static: `if wd:` in the kernels
 
         def step(param_datas, opt_states, hyper, rng, in_datas):
             lr, t = hyper  # traced scalars: lr schedule / step count don't recompile
@@ -318,17 +334,22 @@ class ShardedTrainStep:
                 loss_of, has_aux=True)(train_datas)
 
             new_datas = list(param_datas)
-            new_states = [list(s) for s in opt_states]
+            new_states = list(opt_states)
+            # the shared registry's traced-t hyper twin (optimizer_fused
+            # thyper): bias-correction terms are built IN-GRAPH from the
+            # traced (lr, wd, t), so schedules and step count never
+            # recompile — same tuples the fused Trainer step traces
+            h = thyper(static, lr, wd, t)
             for j, i in enumerate(t_idx):
-                w, st = update_fn(new_datas[i], grads[j], opt_states[i],
-                                  lr, wd, mom, t)
+                w, st = rule.step(new_datas[i], grads[j], opt_states[i],
+                                  h, 1.0, static)
                 # the f32 lr/state promote the arithmetic to f32 (precision),
                 # but storage keeps the parameter dtype (bf16 fast path) —
                 # the reference's multi-precision update pattern
-                # (optimizer.py:500 mp_sgd_update)
+                # (optimizer.py:500 mp_sgd_update), shared with FusedUpdater
                 new_datas[i] = w.astype(param_datas[i].dtype)
-                new_states[i] = [s.astype(o.dtype)
-                                 for s, o in zip(st, opt_states[i])]
+                new_states[i] = jax.tree_util.tree_map(
+                    lambda n, o: n.astype(o.dtype), st, opt_states[i])
             for i, a in enumerate(aux):
                 if a is not None:  # BatchNorm moving stats etc.
                     new_datas[i] = a.astype(new_datas[i].dtype)
@@ -346,10 +367,10 @@ class ShardedTrainStep:
         return jax.jit(
             step,
             in_shardings=(self._param_shardings,
-                          [list(s) for s in self._state_shardings],
+                          list(self._state_shardings),
                           None, None, self._in_shardings),
             out_shardings=(self._param_shardings,
-                           [list(s) for s in self._state_shardings],
+                           list(self._state_shardings),
                            repl),
             donate_argnums=donate)
 
@@ -377,20 +398,19 @@ class ShardedTrainStep:
                     for d, s in zip(in_datas, self._in_shardings)]
         self._num_update += 1
         lr = (self._lr_scheduler(self._num_update)
-              if self._lr_scheduler else self._lr)
+              if self._lr_scheduler else float(self._opt.learning_rate))
         hyper = (jnp.float32(lr), jnp.float32(self._num_update))
         rng = _random.next_key()
-        opt_states = [list(s) for s in self._opt_states]
         if self._last_abstract is None:
             # abstract shapes for compiled_step_flops; shapes are invariant
             # per (in_fmt, shapes) so capture once, off the per-step path
             self._last_abstract = jax.tree_util.tree_map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-                (self._param_datas, opt_states, hyper, rng, in_datas))
+                (self._param_datas, self._opt_states, hyper, rng, in_datas))
         new_datas, new_states, loss = self._jit(
-            self._param_datas, opt_states, hyper, rng, in_datas)
+            self._param_datas, self._opt_states, hyper, rng, in_datas)
         self._param_datas = new_datas
-        self._opt_states = [tuple(s) for s in new_states]
+        self._opt_states = new_states
         for p, d in zip(self._params, new_datas):
             p.data()._set_data(d)
         return NDArray(loss)
@@ -415,11 +435,11 @@ class ShardedTrainStep:
     def learning_rate(self):
         if self._lr_scheduler is not None:
             return self._lr_scheduler(max(self._num_update, 1))
-        return self._lr
+        return float(self._opt.learning_rate)
 
     def set_learning_rate(self, lr):
         if self._lr_scheduler is not None:
             # the reference Trainer raises here too (gluon/trainer.py)
             raise MXNetError(
                 "cannot set learning_rate: an lr_scheduler is active")
-        self._lr = float(lr)
+        self._opt.set_learning_rate(float(lr))

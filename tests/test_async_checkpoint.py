@@ -46,7 +46,10 @@ def test_train_step_roundtrip_with_zero1_state(tmp_path):
     ackpt.load_train_step(step2, str(tmp_path), step=3)
     assert step2._num_update == 3
     # momentum came back SHARDED, and the next step matches exactly
-    m = [s for st in step2._opt_states for s in st][0]
+    # (states live in the rule registry's structure — None | array | tuple)
+    import jax
+    m = [s for st in step2._opt_states
+         for s in jax.tree_util.tree_leaves(st)][0]
     assert m.sharding.spec[0] == "data"
     assert abs(float(step2(x, y).asnumpy()) - l_next) < 1e-6
 

@@ -205,7 +205,12 @@ def test_all_rules_ran_over_repo():
 def test_jit_surface_inventory_lists_all_four_caches():
     """The inventory is ROADMAP item 5's scouting report: all four jit
     caches (FusedUpdater, CachedOp, symbol executor, serving Predictor)
-    must appear with their retrace sites, and no site may be anonymous."""
+    must appear with their retrace sites, and no site may be anonymous.
+    Since ISSUE 7 the fused_optimizer cache is ALSO the mesh-native
+    Trainer's cache — its declared key must carry the sharding component
+    (MeshPlan fingerprint + per-buffer sharding tokens), the down payment
+    on the unified compile-cache engine's key = fn + shapes + policy_key
+    + sharding."""
     inv = _repo_result().jit_inventory
     sites = {e["retrace_site"] for e in inv}
     assert {"fused_optimizer", "cached_op", "executor",
@@ -214,6 +219,9 @@ def test_jit_surface_inventory_lists_all_four_caches():
     fused = [e for e in inv if e["retrace_site"] == "fused_optimizer"]
     assert fused and all(e["donation"] == "donate_argnums=(0, 2)"
                          for e in fused)
+    for e in fused:   # the merged mesh-trainer cache: sharding in the key
+        assert "MeshPlan" in e["cache_key"], e["cache_key"]
+        assert "sharding" in e["cache_key"], e["cache_key"]
     by_site = {e["retrace_site"]: e for e in inv}
     assert by_site["cached_op"]["file"] == "mxtpu/gluon/block.py"
     assert by_site["serving.predict"]["file"] == "mxtpu/serving/engine.py"

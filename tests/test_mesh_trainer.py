@@ -1,0 +1,360 @@
+"""Mesh-native gluon Trainer (ISSUE 7) — the multi-chip fast path.
+
+``Trainer(mesh=...)`` lays parameters/optimizer state out on a
+``jax.sharding.Mesh`` at kvstore-init time, shards the batch on the data
+axis, and routes :meth:`Trainer.step` through the SAME donated
+FusedUpdater jit — with ZeRO-1 weight-update sharding (arXiv:2004.13336)
+composed into it. Pins, on the 8-device virtual CPU mesh:
+
+* numeric transparency: a replicated-batch mesh run is BIT-exact vs the
+  plain single-device Trainer (losses AND params) for sgd+adam, ZeRO
+  on/off — the mesh machinery itself adds zero numeric drift;
+* ZeRO-1 on vs off under a data-sharded batch is bit-identical (the
+  arXiv:2004.13336 equivalence), and the sharded-batch run tracks the
+  single-device trajectory to reduce-order ULPs;
+* structure: per-replica optimizer-state shard bytes = replicated/8;
+* trace discipline: steady-state ``trainer.step`` keeps d2h == 0 and the
+  ``fused_optimizer`` retrace site flat after warmup; a guard-policy
+  flip costs exactly one recompile; the MeshPlan is part of the jit
+  cache key (a mesh attach never reuses a single-device executable);
+* checkpointing: orbax save/load round-trips the sharded state and
+  resumes bit-exact;
+* control plane: ``shard_batch`` validation, ``MXTPU_MESH`` auto-mesh,
+  mesh/kvstore incompatibility errors, grouped-push tree-sum on an
+  attached mesh;
+* the ``pure_forward`` RNG fix: ``train=True`` draws a fresh dropout
+  mask per call instead of silently replaying ``PRNGKey(0)``.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, telemetry
+from mxtpu import kvstore as kv_mod
+from mxtpu import optimizer_fused as of
+from mxtpu.base import MXNetError
+from mxtpu.gluon import nn
+from mxtpu.parallel import make_mesh, pure_forward
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for var in ("MXTPU_MESH", "MXTPU_ZERO1", "MXTPU_NUMERICS_GUARD",
+                "MXTPU_RETRACE_BUDGET", "MXTPU_FUSED_OPTIMIZER"):
+        monkeypatch.delenv(var, raising=False)
+    telemetry.reset()
+    of.reset()
+    yield
+    telemetry.reset()
+    of.reset()
+
+
+_OPTS = {"sgd": {"learning_rate": 0.1, "momentum": 0.9},
+         "adam": {"learning_rate": 0.01}}
+
+
+def _build(seed=0, hidden=32, out=8):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(hidden, activation="relu"), nn.Dense(out))
+    net.initialize()
+    return net
+
+
+def _data(n=16, d=16, classes=8):
+    x = mx.nd.array(np.random.RandomState(0).randn(n, d).astype(np.float32))
+    y = mx.nd.array(np.random.RandomState(1).randint(0, classes, (n,))
+                    .astype(np.float32))
+    return x, y
+
+
+def _run(mesh=None, zero1=False, opt="sgd", steps=6, shard=True, out=8,
+         fetch_loss=True):
+    """Train the reference MLP; returns (losses, params, trainer)."""
+    net = _build(out=out)
+    x, y = _data(classes=out)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), opt, dict(_OPTS[opt]),
+                       mesh=mesh, zero1=zero1)
+    losses = []
+    for _ in range(steps):
+        xs, ys = tr.shard_batch(x, y) if (mesh is not None and shard) \
+            else (x, y)
+        with autograd.record():
+            l = loss_fn(net(xs), ys).mean()
+        l.backward()
+        tr.step(1)
+        if fetch_loss:
+            losses.append(float(l.asnumpy()))
+    params = [p.data().asnumpy() for p in net.collect_params().values()]
+    return losses, params, tr
+
+
+def _state_leaves(tr):
+    upd = tr._updaters[0]
+    return [leaf._data if hasattr(leaf, "_data") else leaf
+            for i in sorted(upd.states)
+            for leaf in jax.tree_util.tree_leaves(upd.states[i])]
+
+
+# ------------------------------------------------------------ numeric parity
+@pytest.mark.multidevice
+@pytest.mark.parametrize("opt", ["sgd", "adam"])
+@pytest.mark.parametrize("zero1", [False, True])
+def test_mesh_trainer_bit_exact_vs_single_device(opt, zero1):
+    """A replicated-batch mesh run must be BIT-exact vs the plain
+    single-device Trainer: every collective the mesh step adds (ZeRO
+    reduce-scatter/all-gather included) is numerically transparent.
+    The data-sharded comparison lives in the next test — cross-device
+    gradient summation reorders fp adds, so THAT contract is ULP-tight,
+    not bitwise."""
+    base_l, base_p, _ = _run(None, opt=opt)
+    mesh = make_mesh({"data": 8})
+    mesh_l, mesh_p, _ = _run(mesh, zero1=zero1, opt=opt, shard=False)
+    assert mesh_l == base_l
+    for a, b in zip(mesh_p, base_p):
+        assert np.array_equal(a, b)
+
+
+@pytest.mark.multidevice
+@pytest.mark.parametrize("opt", ["sgd", "adam"])
+def test_data_sharded_zero1_on_off_bit_exact(opt):
+    """Under a data-sharded batch, ZeRO-1 on vs off is bit-identical
+    (the arXiv:2004.13336 equivalence: reduce-scatter + shard-local
+    update + all-gather == replicated update), and both track the
+    single-device trajectory to reduce-order ULPs."""
+    mesh = make_mesh({"data": 8})
+    l_off, p_off, _ = _run(mesh, zero1=False, opt=opt)
+    l_on, p_on, _ = _run(mesh, zero1=True, opt=opt)
+    assert l_on == l_off
+    for a, b in zip(p_on, p_off):
+        assert np.array_equal(a, b)
+    base_l, base_p, _ = _run(None, opt=opt)
+    np.testing.assert_allclose(l_on, base_l, rtol=0, atol=2e-6)
+    for a, b in zip(p_on, base_p):
+        np.testing.assert_allclose(a, b, rtol=0, atol=2e-6)
+
+
+# ----------------------------------------------------------- ZeRO structure
+@pytest.mark.multidevice
+def test_zero1_state_shard_shapes_are_one_eighth():
+    """Per-replica optimizer-state memory divides by the axis size: every
+    state leaf of the (all-dim0-divisible) net is laid out
+    P('data'), its addressable shard holds 1/8 of the rows, and summed
+    per-device state bytes == replicated/8."""
+    mesh = make_mesh({"data": 8})
+    _, _, tr_on = _run(mesh, zero1=True, opt="adam", steps=2)
+    _, _, tr_off = _run(mesh, zero1=False, opt="adam", steps=2)
+    on, off = _state_leaves(tr_on), _state_leaves(tr_off)
+    assert len(on) == len(off) and on
+    per_replica = replicated = 0
+    for a, b in zip(on, off):
+        assert a.sharding.spec == jax.sharding.PartitionSpec("data")
+        assert b.sharding.spec == jax.sharding.PartitionSpec()
+        shard = a.addressable_shards[0].data
+        assert shard.shape[0] * 8 == a.shape[0]
+        assert shard.shape[1:] == a.shape[1:]
+        per_replica += shard.nbytes
+        replicated += b.addressable_shards[0].data.nbytes
+    assert per_replica * 8 == replicated
+
+
+@pytest.mark.multidevice
+def test_zero1_indivisible_param_falls_back_replicated():
+    """dim 0 not divisible by the axis (out=10 on 8 devices) keeps that
+    param's state replicated — and the run still bit-matches the
+    single-device trajectory under a replicated batch."""
+    mesh = make_mesh({"data": 8})
+    base_l, base_p, _ = _run(None, opt="sgd", out=10)
+    mesh_l, mesh_p, tr = _run(mesh, zero1=True, opt="sgd", shard=False,
+                              out=10)
+    assert mesh_l == base_l
+    for a, b in zip(mesh_p, base_p):
+        assert np.array_equal(a, b)
+    specs = [l.sharding.spec for l in _state_leaves(tr)]
+    assert jax.sharding.PartitionSpec("data") in specs   # 32-row layer
+    assert jax.sharding.PartitionSpec() in specs         # 10-row layer
+
+
+# --------------------------------------------------------- trace discipline
+@pytest.mark.multidevice
+def test_step_d2h_zero_and_retrace_flat(monkeypatch):
+    """Steady-state contract on the mesh path: after warmup, more steps
+    add ZERO compiles at the fused_optimizer retrace site and ZERO d2h
+    syncs inside trainer.step; a guard-policy flip then costs exactly
+    one recompile (the policy bit is in the cache key)."""
+    mesh = make_mesh({"data": 8})
+    net = _build()
+    x, y = _data()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "adam", dict(_OPTS["adam"]),
+                       mesh=mesh, zero1=True)
+
+    def one_step():
+        xs, ys = tr.shard_batch(x, y)
+        with autograd.record():
+            l = loss_fn(net(xs), ys).mean()
+        l.backward()
+        tr.step(1)
+
+    for _ in range(2):   # warmup: the single mesh-step compile
+        one_step()
+    warm = telemetry.retrace_stats("fused_optimizer")["compiles"]
+    telemetry.reset_metric("trainer.step.d2h")
+    for _ in range(4):
+        one_step()
+    assert telemetry.retrace_stats("fused_optimizer")["compiles"] == warm
+    assert telemetry.value("trainer.step.d2h") == 0
+    monkeypatch.setenv("MXTPU_NUMERICS_GUARD", "1")   # induced policy flip
+    one_step()
+    assert telemetry.retrace_stats("fused_optimizer")["compiles"] == warm + 1
+
+
+@pytest.mark.multidevice
+def test_mesh_plan_is_part_of_jit_cache_key():
+    """The same optimizer/shapes stepped single-device, on a mesh, and
+    with ZeRO flipped are THREE distinct executables — sharding is part
+    of the fused-update cache key (ROADMAP item 5 down payment), never a
+    silent reuse across placements."""
+    of.reset()
+    _run(None, opt="sgd", steps=1)
+    assert of.cache_size() == 1
+    mesh = make_mesh({"data": 8})
+    _run(mesh, zero1=False, opt="sgd", steps=1)
+    assert of.cache_size() == 2
+    _run(mesh, zero1=True, opt="sgd", steps=1)
+    assert of.cache_size() == 3
+    # same axis shape over DIFFERENT devices: the ZeRO constraints close
+    # over the concrete mesh, so these must not share an executable either
+    _run(make_mesh({"data": 4}, jax.devices()[:4]), zero1=True, opt="sgd",
+         steps=1)
+    assert of.cache_size() == 4
+    _run(make_mesh({"data": 4}, jax.devices()[4:]), zero1=True, opt="sgd",
+         steps=1)
+    assert of.cache_size() == 5
+
+
+# ------------------------------------------------------------- checkpointing
+@pytest.mark.multidevice
+def test_trainer_checkpoint_roundtrip_sharded(tmp_path):
+    """save_trainer/load_trainer round-trip the ZeRO-sharded state: the
+    restored trainer's state goes back onto the MeshPlan layout and the
+    continued trajectory is bit-exact vs the uninterrupted run."""
+    from mxtpu.contrib import async_checkpoint as ackpt
+    mesh = make_mesh({"data": 8})
+    net = _build()
+    x, y = _data()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "adam", dict(_OPTS["adam"]),
+                       mesh=mesh, zero1=True)
+
+    def steps(trainer, model, n):
+        out = []
+        for _ in range(n):
+            xs, ys = trainer.shard_batch(x, y)
+            with autograd.record():
+                l = loss_fn(model(xs), ys).mean()
+            l.backward()
+            trainer.step(1)
+            out.append(float(l.asnumpy()))
+        return out
+
+    steps(tr, net, 3)
+    ackpt.save_trainer(tr, str(tmp_path), step=3)
+    ref = steps(tr, net, 2)   # the uninterrupted continuation
+
+    net2 = _build(seed=42)    # different init on purpose
+    tr2 = gluon.Trainer(net2.collect_params(), "adam", dict(_OPTS["adam"]),
+                        mesh=mesh, zero1=True)
+    steps(tr2, net2, 1)       # settle placement + state creation
+    ackpt.load_trainer(tr2, str(tmp_path), step=3)
+    leaves = _state_leaves(tr2)
+    assert any(l.sharding.spec == jax.sharding.PartitionSpec("data")
+               for l in leaves)
+    assert steps(tr2, net2, 2) == ref
+
+
+# -------------------------------------------------------------- control plane
+@pytest.mark.multidevice
+def test_shard_batch_layout_and_validation():
+    mesh = make_mesh({"data": 8})
+    net = _build()
+    tr = gluon.Trainer(net.collect_params(), "sgd", dict(_OPTS["sgd"]),
+                       mesh=mesh)
+    x, y = _data()
+    xs, ys = tr.shard_batch(x, y)
+    for a in (xs, ys):
+        assert a._data.sharding.spec == jax.sharding.PartitionSpec("data")
+    with pytest.raises(MXNetError):
+        tr.shard_batch(mx.nd.ones((15, 4)))   # 15 % 8 != 0
+    tr_plain = gluon.Trainer(_build().collect_params(), "sgd",
+                             dict(_OPTS["sgd"]))
+    assert tr_plain.shard_batch(x) is x       # identity without a mesh
+
+
+@pytest.mark.multidevice
+def test_mxtpu_mesh_env_auto(monkeypatch):
+    monkeypatch.setenv("MXTPU_MESH", "auto")
+    tr = gluon.Trainer(_build().collect_params(), "sgd", dict(_OPTS["sgd"]))
+    assert tr._mesh is not None
+    assert tr._mesh.shape["data"] == len(jax.devices())
+    monkeypatch.setenv("MXTPU_MESH", "bogus")
+    with pytest.raises(MXNetError):
+        gluon.Trainer(_build().collect_params(), "sgd", dict(_OPTS["sgd"]))
+
+
+@pytest.mark.multidevice
+def test_mesh_rejects_incompatible_modes():
+    mesh = make_mesh({"data": 8})
+    with pytest.raises(MXNetError):   # store-side update contradicts mesh
+        gluon.Trainer(_build().collect_params(), "sgd", dict(_OPTS["sgd"]),
+                      mesh=mesh, update_on_kvstore=True)
+    with pytest.raises(MXNetError):   # mesh must carry the data axis
+        gluon.Trainer(_build().collect_params(), "sgd", dict(_OPTS["sgd"]),
+                      mesh=make_mesh({"model": 8}))
+
+
+@pytest.mark.multidevice
+def test_kvstore_grouped_push_tree_sum_on_mesh():
+    """The control-plane store on an attached mesh: init lays values out
+    replicated, and a grouped push reduces its copies in ONE fused
+    stack-and-sum (not O(copies) sequential adds)."""
+    mesh = make_mesh({"data": 8})
+    kv = kv_mod.create("device")
+    kv.attach_mesh(mesh)
+    base = mx.nd.array(np.zeros((4, 2), np.float32))
+    kv.init("w", base)
+    vals = [mx.nd.array(np.full((4, 2), float(i + 1), np.float32))
+            for i in range(3)]
+    kv.push("w", vals)
+    out = mx.nd.zeros((4, 2))
+    kv.pull("w", out)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  np.full((4, 2), 6.0, np.float32))
+    assert kv._store["w"]._data.sharding.spec == jax.sharding.PartitionSpec()
+
+
+# ------------------------------------------------------------ pure_forward RNG
+def test_pure_forward_train_rng_draws_fresh_key():
+    """The RNG footgun pin: train=True with rng=None must NOT replay
+    PRNGKey(0) — two stochastic calls draw different dropout masks,
+    matching eager semantics; an explicit rng reproduces; train=False
+    stays deterministic."""
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64), nn.Dropout(0.5), nn.Dense(8))
+    net.initialize()
+    x = mx.nd.ones((4, 16))
+    net(x)  # settle shapes
+    fn, params = pure_forward(net, train=True)
+    a = np.asarray(fn(params, x._data))
+    b = np.asarray(fn(params, x._data))
+    assert not np.array_equal(a, b)
+    key = jax.random.PRNGKey(7)
+    np.testing.assert_array_equal(np.asarray(fn(params, x._data, rng=key)),
+                                  np.asarray(fn(params, x._data, rng=key)))
+    fn_eval, params = pure_forward(net, train=False)
+    np.testing.assert_array_equal(np.asarray(fn_eval(params, x._data)),
+                                  np.asarray(fn_eval(params, x._data)))

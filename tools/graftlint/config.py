@@ -43,16 +43,20 @@ JIT_ALLOWLIST: Dict[Tuple[str, str], Dict[str, str]] = {
         "reason": "FusedUpdater._cached_jit is the single cache front door "
                   "for this builder; it calls telemetry.record_retrace on "
                   "every executable-cache miss before invoking _build",
-        "cache_key": "(optimizer class, static config, per-param specs) + "
-                     "registry.policy_key — FusedUpdater._cached_jit",
+        "cache_key": "(optimizer class, static config, per-param specs "
+                     "incl. sharding tokens, MeshPlan fingerprint) + "
+                     "registry.policy_key — FusedUpdater._cached_jit; the "
+                     "mesh-native Trainer shares this cache",
     },
     ("mxtpu/optimizer_fused.py", "_build_guarded"): {
         "site": "fused_optimizer",
         "reason": "same cache front door as _build; the guard bit and "
                   "scaler_cfg join the cache key in _cached_jit",
-        "cache_key": "(optimizer class, static config, per-param specs, "
+        "cache_key": "(optimizer class, static config, per-param specs "
+                     "incl. sharding tokens, MeshPlan fingerprint, "
                      "guard bit, scaler_cfg) + registry.policy_key — "
-                     "FusedUpdater._cached_jit",
+                     "FusedUpdater._cached_jit; the mesh-native Trainer "
+                     "shares this cache",
     },
 }
 

@@ -81,4 +81,18 @@ timeout 600 python tools/serve_bench.py --requests 500 \
   2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
 telemetry_report
 
+# 4. multichip scaling phase (ISSUE 7): mesh-native gluon Trainer items/s
+#    per device count (strong scaling, ZeRO-1 on). Only meaningful with
+#    >1 device; on a single chip the check below skips the session. The
+#    scaling-number gate applies on-chip; the forced-host-device tier
+#    gates on parity + compile budget instead (see bench_multichip_resnet).
+sleep 60
+if timeout 90 python -c "import jax,sys; sys.exit(0 if len(jax.devices())>1 else 1)"; then
+  timeout 900 env BENCH_CONFIG=multichip_resnet BENCH_PREFLIGHT=0 \
+    python bench.py 2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
+  telemetry_report
+else
+  echo "multichip_resnet skipped: single device" | tee -a "$LOG"
+fi
+
 echo "battery complete -> $LOG"
