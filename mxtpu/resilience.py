@@ -87,7 +87,11 @@ def _parse_faults(spec):
     ``sigterm`` (loop step index), ``worker_death`` (dataloader batch
     index), ``kv_fail`` (dist-reduce attempt index), ``serve_timeout``
     (serving batch dispatch index: that batch's requests all expire),
-    ``serve_overload`` (serving submit index: that submit sheds)."""
+    ``serve_overload`` (serving submit index: that submit sheds),
+    ``replica_fail`` (serving dispatch index: the replica executing that
+    dispatch raises — counts toward its circuit breaker), ``replica_wedge``
+    (serving dispatch index: that dispatch never returns — the wedge
+    watchdog quarantines the replica and re-dispatches the batch once)."""
     faults = {}
     for part in spec.split(";"):
         part = part.strip()

@@ -9,15 +9,28 @@ The TPU-native successor to the reference's C predict API
 * :class:`MicroBatcher` (``batcher``) — bounded-queue dynamic
   micro-batching (coalesce by size or head-of-line wait), per-request
   deadlines, load shedding, deterministic fault hooks;
+* :class:`ReplicaSet` / :class:`ReplicaDispatcher` (``replicas``) — one
+  warmed Predictor per device behind per-replica dispatch workers:
+  least-loaded routing, per-dispatch wedge watchdog with exactly-once
+  re-dispatch, per-replica circuit breakers with half-open re-warm
+  probes — losing replicas degrades capacity instead of hanging;
 * :class:`ModelServer` (``server``) — stdlib-threaded HTTP front
-  (``/predict`` ``/healthz`` ``/metrics``) with 503 shedding and SIGTERM
-  graceful drain.
+  (``/predict`` ``/healthz`` ``/metrics``) with 503 shedding, per-replica
+  health reporting, and SIGTERM graceful drain.
 """
 from .batcher import (DeadlineExceeded, MicroBatcher, QueueFull,
                       max_batch_default, max_wait_ms_default, queue_default)
 from .engine import BucketSpec, Predictor, pad_nd
+from .replicas import (Replica, ReplicaDispatcher, ReplicaFailure,
+                       ReplicaSet, breaker_backoff_max_ms_default,
+                       breaker_backoff_ms_default, breaker_threshold_default,
+                       dispatch_timeout_ms_default, replica_count_default)
 from .server import ModelServer
 
 __all__ = ["BucketSpec", "Predictor", "pad_nd", "MicroBatcher",
            "QueueFull", "DeadlineExceeded", "ModelServer",
-           "max_batch_default", "max_wait_ms_default", "queue_default"]
+           "Replica", "ReplicaSet", "ReplicaDispatcher", "ReplicaFailure",
+           "max_batch_default", "max_wait_ms_default", "queue_default",
+           "replica_count_default", "dispatch_timeout_ms_default",
+           "breaker_threshold_default", "breaker_backoff_ms_default",
+           "breaker_backoff_max_ms_default"]

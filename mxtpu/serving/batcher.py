@@ -107,7 +107,8 @@ class _Future:
 
 
 class _Request:
-    __slots__ = ("inputs", "n", "bucket_key", "deadline", "t_enq", "future")
+    __slots__ = ("inputs", "n", "bucket_key", "deadline", "t_enq", "future",
+                 "redispatched")
 
     def __init__(self, inputs, n, bucket_key, deadline, t_enq):
         self.inputs = inputs
@@ -116,6 +117,9 @@ class _Request:
         self.deadline = deadline
         self.t_enq = t_enq
         self.future = _Future()
+        # set when a wedge-watchdog trip re-enqueues this request on a
+        # healthy replica: re-dispatch happens exactly ONCE (replicas.py)
+        self.redispatched = False
 
 
 class MicroBatcher:
@@ -140,6 +144,7 @@ class MicroBatcher:
         self._cond = threading.Condition()
         self._draining = False
         self._closed = False
+        self._crashed = False  # worker died on an unexpected exception
         self._batch_index = 0
         self._inflight = 0     # requests popped from the queue, result not
         self._thread = None    # yet delivered — drain() waits for BOTH
@@ -182,6 +187,10 @@ class MicroBatcher:
         deadline = None if deadline_ms is None else now + deadline_ms / 1e3
         req = _Request(inputs, n, bucket_key, deadline, now)
         with self._cond:
+            if self._crashed:
+                # crash barrier: a dead worker thread can never deliver —
+                # admitting would strand this future forever
+                self._shed("worker_crashed")
             if self._draining or self._closed:
                 self._shed("draining")
             if self._items + n > self.max_queue:
@@ -280,7 +289,6 @@ class MicroBatcher:
 
     # -------------------------------------------------------------- dispatch
     def _dispatch(self, batch):
-        import numpy as np
         idx = self._batch_index
         self._batch_index += 1
         now = self._clock()
@@ -299,25 +307,44 @@ class MicroBatcher:
         if not live:
             return
         try:
-            n_inputs = len(live[0].inputs)
-            spec = getattr(self._pred, "spec", None)
-            seq = live[0].bucket_key  # the cohort's shared seq bucket
-            joined = []
-            for i in range(n_inputs):
-                parts = [np.asarray(r.inputs[i]) for r in live]
-                if seq is not None and spec is not None:
-                    # one cohort, one seq bucket — but raw lengths differ;
-                    # pad each request host-side to the cohort bucket so
-                    # the concat (and the device pad) see one shape
-                    ax = spec.seq_axis
-                    parts = [np.pad(p, [(0, seq - p.shape[ax])
-                                        if d == ax else (0, 0)
-                                        for d in range(p.ndim)],
-                                    constant_values=spec.pad_value)
-                             if p.ndim > ax and p.shape[ax] != seq else p
-                             for p in parts]
-                joined.append(parts[0] if len(parts) == 1
-                              else np.concatenate(parts, axis=0))
+            joined = self._join(live)
+        except Exception as e:  # noqa: BLE001 — a bad batch must not kill
+            self._fail_batch(live, e, idx)
+            return
+        self._run_batch(live, joined, idx)
+
+    def _join(self, live):
+        """Host-side coalesce: one numpy array per model input, the
+        cohort's requests concatenated along the batch axis (raw seq
+        lengths padded host-side to the cohort's shared seq bucket)."""
+        import numpy as np
+        n_inputs = len(live[0].inputs)
+        spec = getattr(self._pred, "spec", None)
+        seq = live[0].bucket_key  # the cohort's shared seq bucket
+        joined = []
+        for i in range(n_inputs):
+            parts = [np.asarray(r.inputs[i]) for r in live]
+            if seq is not None and spec is not None:
+                # one cohort, one seq bucket — but raw lengths differ;
+                # pad each request host-side to the cohort bucket so
+                # the concat (and the device pad) see one shape
+                ax = spec.seq_axis
+                parts = [np.pad(p, [(0, seq - p.shape[ax])
+                                    if d == ax else (0, 0)
+                                    for d in range(p.ndim)],
+                                constant_values=spec.pad_value)
+                         if p.ndim > ax and p.shape[ax] != seq else p
+                         for p in parts]
+            joined.append(parts[0] if len(parts) == 1
+                          else np.concatenate(parts, axis=0))
+        return joined
+
+    def _run_batch(self, live, joined, idx):
+        """Execute ONE joined batch and deliver its results — the
+        single-predictor path. :class:`~mxtpu.serving.replicas.
+        ReplicaDispatcher` overrides this with routed, wedge-watchdogged,
+        breaker-guarded dispatch over a ReplicaSet."""
+        try:
             # device work: pad -> compiled forward -> slice (zero d2h)
             flat, _fmt, _bucket = self._pred.predict_flat(tuple(joined))
             # the ONE declared d2h of the serving loop: fetch outputs once
@@ -325,11 +352,19 @@ class MicroBatcher:
             with telemetry.span("serving.fetch", cat="sync"):
                 host = [o.asnumpy() for o in flat]
         except Exception as e:  # noqa: BLE001 — a bad batch must not kill
-            for r in live:      # the worker; every caller gets the error
-                self._fail(r, e)
-            telemetry.inc("serving.batch_errors")
-            _log.exception("serving batch %d failed", idx)
+            self._fail_batch(live, e, idx)
             return
+        self._deliver(live, host)
+
+    def _fail_batch(self, live, error, idx):
+        """Every caller of a failed batch gets the error; the worker
+        survives (and the ReplicaDispatcher's breaker counts it)."""
+        for r in live:
+            self._fail(r, error)
+        telemetry.inc("serving.batch_errors")
+        _log.exception("serving batch %d failed", idx)
+
+    def _deliver(self, live, host):
         telemetry.inc("serving.batches")
         off = 0
         done = self._clock()
@@ -361,6 +396,17 @@ class MicroBatcher:
         return self
 
     def _loop(self):
+        # crash barrier (ISSUE 8 satellite): _dispatch already catches
+        # per-batch errors, but an exception OUTSIDE it (a bug in
+        # _gather_locked, a corrupted queue) used to kill the daemon
+        # thread silently — every queued future then hung forever on a
+        # worker that no longer exists. Fail loud instead.
+        try:
+            self._worker_loop()
+        except Exception as e:  # noqa: BLE001 — barrier, not control flow
+            self._worker_crashed(e)
+
+    def _worker_loop(self):
         while True:
             with self._cond:
                 batch = None
@@ -389,25 +435,75 @@ class MicroBatcher:
                     self._inflight -= len(batch)
                     self._cond.notify_all()
 
+    def _worker_crashed(self, exc):
+        """A dispatch worker died on an unexpected exception: fail every
+        queued future (their worker is gone — ``result()`` would wait
+        forever) and refuse new submits (``serving.shed{worker_crashed}``)
+        so callers see a loud 503, not a hang."""
+        telemetry.inc("serving.worker_crashes")
+        _log.exception("serving dispatch worker crashed — failing queued "
+                       "futures and refusing new submits")
+        err = MXNetError("serving worker crashed: %s: %s"
+                         % (type(exc).__name__, exc))
+        with self._cond:
+            self._crashed = True
+            dead = list(self._q)
+            self._q.clear()
+            self._items = 0
+            dead += self._abort_extra_locked(err)
+            telemetry.gauge("serving.queue_depth", 0)
+            self._cond.notify_all()
+        for r in dead:
+            self._fail(r, err)
+
+    def _abort_extra_locked(self, err):
+        """Requests tracked outside the queue that a crash must also fail
+        (the ReplicaDispatcher's wedge-watchdog entries); base: none."""
+        return []
+
     # ----------------------------------------------------------------- drain
+    def _worker_alive(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    def _pending_extra(self):
+        """True while requests live outside queue+inflight accounting
+        (ReplicaDispatcher wedge entries awaiting their watchdog)."""
+        return False
+
     def drain(self, timeout=None):
         """Stop admitting (submits shed with reason ``draining``), finish
         everything queued and in flight, return True when empty. The
-        SIGTERM path of :class:`~mxtpu.serving.server.ModelServer`."""
+        SIGTERM path of :class:`~mxtpu.serving.server.ModelServer`.
+
+        Waits on the condition variable (the worker's post-dispatch
+        ``notify_all``) and measures the timeout on the INJECTED clock —
+        the old bare ``time.sleep`` poll raced fake-clock tests against
+        the wall clock. Without a live worker, outstanding work is
+        drained synchronously through :meth:`poll`; if poll can make no
+        progress (e.g. every replica quarantined under a fake clock that
+        nobody advances) drain returns False instead of spinning."""
         with self._cond:
             self._draining = True
             self._cond.notify_all()
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else self._clock() + timeout
         while True:
-            if self._thread is None or not self._thread.is_alive():
+            alive = self._worker_alive()
+            if not alive:
                 while self.poll():
                     pass
             with self._cond:
-                if not self._q and self._inflight == 0:
+                if not self._q and self._inflight == 0 \
+                        and not self._pending_extra():
                     return True
-            if deadline is not None and time.monotonic() > deadline:
-                return False
-            time.sleep(0.002)
+                if deadline is not None and self._clock() > deadline:
+                    return False
+                if not alive:
+                    # no worker and a full poll sweep made no progress:
+                    # nothing will change without external action
+                    return False
+                # woken by the worker's notify_all; the bounded wait
+                # guards against a missed wakeup, not a poll interval
+                self._cond.wait(0.05)
 
     def close(self, timeout=5.0):
         """Drain, then stop the worker thread."""

@@ -40,6 +40,8 @@ serve exactly like they train.
 """
 from __future__ import annotations
 
+import threading
+
 import jax
 import jax.numpy as jnp
 
@@ -48,6 +50,13 @@ from ..base import MXNetError
 from ..ndarray import NDArray
 
 __all__ = ["BucketSpec", "Predictor", "pad_nd"]
+
+# Serializes the FIRST invocation of a freshly-built jit (the trace):
+# tracing runs the block body, which temporarily binds tracers into the
+# SHARED Parameter objects — two replicas' Predictors compiling at once
+# (mxtpu/serving/replicas.py spawns one dispatch worker per replica)
+# would race on that binding. Warm-path calls never take this lock.
+_TRACE_LOCK = threading.RLock()
 
 
 def pad_nd(arr, batch, seq_len=None, seq_axis=1, pad_value=0):
@@ -177,10 +186,19 @@ class Predictor:
     ``predict()`` is thread-compatible after warmup: the jit cache is
     only written on a miss (warmup fills it), and compiled executables
     are safe to invoke concurrently.
+
+    ``device=`` pins the whole predictor — parameters are ``device_put``
+    there and every request buffer follows — so a
+    :class:`~mxtpu.serving.replicas.ReplicaSet` can run one independent
+    replica per device. ``site=`` names the retrace-watchdog site its
+    compiles report to (per-replica sites ``serving.predict.r<i>`` keep
+    each replica's post-warmup compile count pinned at #buckets; the
+    graftlint inventory declares this cache via
+    ``tools/graftlint/config.py:JIT_ALLOWLIST``).
     """
 
     def __init__(self, block, spec, example=None, warmup=False,
-                 name="predictor"):
+                 name="predictor", device=None, site="serving.predict"):
         if not hasattr(block, "_forward_eager"):
             raise MXNetError(
                 "Predictor serves HybridBlock-family models (got %s); wrap "
@@ -188,6 +206,8 @@ class Predictor:
         self._block = block
         self._spec = spec
         self._name = name
+        self._device = device
+        self._site = site
         self._params = None        # ordered list, fixed at first build
         self._param_datas = None
         self._templates = None     # [(trailing_shape, dtype)] per input
@@ -213,13 +233,29 @@ class Predictor:
             raise MXNetError("Predictor: parameters still uninitialized "
                              "after the example forward")
         self._params = params
-        self._param_datas = [p.data()._data for p in params]
+        self._param_datas = self._place([p.data()._data for p in params])
         self._templates = [(tuple(a._data.shape[1:]), a._data.dtype)
                            for a in nds]
+
+    def _place(self, datas):
+        """Commit buffers to this predictor's device (identity when no
+        device was pinned — the single-predictor PR-5 path)."""
+        if self._device is None:
+            return datas
+        return [jax.device_put(d, self._device) for d in datas]
 
     @property
     def spec(self):
         return self._spec
+
+    @property
+    def device(self):
+        return self._device
+
+    @property
+    def site(self):
+        """The retrace-watchdog site this predictor's compiles report to."""
+        return self._site
 
     @property
     def input_templates(self):
@@ -229,7 +265,8 @@ class Predictor:
     def refresh_params(self):
         """Re-snapshot parameter buffers (after an in-place reload) without
         recompiling — the jits close over nothing, params are arguments."""
-        self._param_datas = [p.data()._data for p in self._params]
+        self._param_datas = self._place(
+            [p.data()._data for p in self._params])
 
     # ------------------------------------------------------------ compiling
     def _get_jit(self, shape_key):
@@ -241,10 +278,14 @@ class Predictor:
         # retrace watchdog: every serving compile is a served-request stall
         # — after warmup this site MUST stay at #buckets (an off-template
         # request shape or a policy env flip under the server shows up
-        # here with full provenance)
+        # here with full provenance). The site name is per-instance so a
+        # ReplicaSet member reports at serving.predict.r<i>; the static
+        # lint declares this cache via JIT_ALLOWLIST (docs/serving.md).
         telemetry.record_retrace(
-            "serving.predict",
+            self._site,
             {"predictor": self._name, "block": type(self._block).__name__,
+             "device": str(self._device) if self._device is not None
+             else None,
              "shapes": [list(s) for s, _ in shape_key],
              "policy_key": list(key[1])})
         block, params = self._block, self._params
@@ -304,7 +345,13 @@ class Predictor:
         NDArrays at bucket batch, cell)."""
         shape_key = tuple((tuple(d.shape), str(d.dtype)) for d in datas)
         jitted, cell = self._get_jit(shape_key)
-        out = jitted(list(datas), self._param_datas)
+        if "out_fmt" not in cell:
+            # first invocation of this executable traces the shared block
+            # (see _TRACE_LOCK): serialize across replicas' predictors
+            with _TRACE_LOCK:
+                out = jitted(list(datas), self._param_datas)
+        else:
+            out = jitted(list(datas), self._param_datas)
         return [NDArray(d) for d in out], cell
 
     def predict_flat(self, args):
@@ -327,7 +374,16 @@ class Predictor:
         datas, user_bufs = [], set()
         for a in args:
             d = a._data if isinstance(a, NDArray) else jnp.asarray(a)
-            if isinstance(a, NDArray) or d is a:
+            protect = isinstance(a, NDArray) or d is a
+            if self._device is not None:
+                # pinned predictor (ReplicaSet member): commit the request
+                # buffers to the replica's device. device_put MAY alias
+                # the input buffer (uncommitted array already resident on
+                # this device), so protection is never dropped here — the
+                # worst case is one extra jnp.copy on an exact-bucket-fit
+                # caller buffer, never a donated-out-from-under caller
+                d = jax.device_put(d, self._device)
+            if protect:
                 user_bufs.add(id(d))
             datas.append(d)
         n = int(datas[0].shape[0])
@@ -378,9 +434,10 @@ class Predictor:
         return out
 
     def compile_stats(self):
-        """The watchdog's view of this process's serving compiles:
+        """The watchdog's view of THIS predictor's compiles — its own
+        retrace site (per-replica for ReplicaSet members):
         {compiles, trips, last} (None before any compile)."""
-        return telemetry.retrace_stats("serving.predict")
+        return telemetry.retrace_stats(self._site)
 
     # ------------------------------------------------------------ load paths
     @classmethod

@@ -27,7 +27,9 @@ Endpoints::
     POST /predict   {"data": [[...], ...], "deadline_ms": 250}
                     -> 200 {"outputs": [...], "n": k}
                     -> 503 shed/draining, 504 deadline, 400 bad request
-    GET  /healthz   {"status": "ok"|"draining", "queue_depth": d}
+    GET  /healthz   {"status": "ok"|"degraded"|"unhealthy"|"draining",
+                     "queue_depth": d, "replicas": [...]}  (replica fields
+                    only when serving through a ReplicaDispatcher)
     GET  /metrics   telemetry.snapshot() as JSON
 """
 from __future__ import annotations
@@ -42,6 +44,7 @@ import numpy as np
 
 from .. import telemetry
 from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
+from .replicas import ReplicaDispatcher, ReplicaSet
 
 __all__ = ["ModelServer"]
 
@@ -55,7 +58,9 @@ class ModelServer:
 
     def __init__(self, batcher, host="127.0.0.1", port=0,
                  request_timeout_s=30.0):
-        if not isinstance(batcher, MicroBatcher):
+        if isinstance(batcher, ReplicaSet):
+            batcher = ReplicaDispatcher(batcher)
+        elif not isinstance(batcher, MicroBatcher):
             batcher = MicroBatcher(batcher)
         self._batcher = batcher
         self._timeout = float(request_timeout_s)
@@ -203,9 +208,23 @@ def _make_handler(srv):
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._reply(200, {
+                payload = {
                     "status": "draining" if srv.draining else "ok",
-                    "queue_depth": srv._batcher.queue_depth})
+                    "queue_depth": srv._batcher.queue_depth}
+                states = getattr(srv._batcher, "replica_states", None)
+                if callable(states):
+                    # replicated serving: per-replica health so a load
+                    # balancer (and a human) can see partial capacity —
+                    # "degraded" = serving, but with quarantined replicas
+                    reps = states()
+                    payload["replicas"] = reps
+                    healthy = sum(1 for r in reps
+                                  if r["state"] == "healthy")
+                    payload["healthy_replicas"] = healthy
+                    if not srv.draining and healthy < len(reps):
+                        payload["status"] = ("degraded" if healthy
+                                             else "unhealthy")
+                self._reply(200, payload)
             elif self.path == "/metrics":
                 self._reply(200, telemetry.snapshot())
             else:

@@ -210,12 +210,15 @@ def test_jit_surface_inventory_lists_all_four_caches():
     Trainer's cache — its declared key must carry the sharding component
     (MeshPlan fingerprint + per-buffer sharding tokens), the down payment
     on the unified compile-cache engine's key = fn + shapes + policy_key
-    + sharding."""
+    + sharding. Since ISSUE 8 the serving Predictor's site is
+    per-INSTANCE (ReplicaSet members report at serving.predict.r<i>), so
+    its inventory entry resolves through the JIT_ALLOWLIST declaration —
+    which must name the per-replica caches to keep this report honest."""
     inv = _repo_result().jit_inventory
     sites = {e["retrace_site"] for e in inv}
     assert {"fused_optimizer", "cached_op", "executor",
             "executor.backward", "serving.predict"} <= sites, sites
-    assert None not in sites
+    assert None not in sites and "<dynamic>" not in sites
     fused = [e for e in inv if e["retrace_site"] == "fused_optimizer"]
     assert fused and all(e["donation"] == "donate_argnums=(0, 2)"
                          for e in fused)
@@ -226,6 +229,12 @@ def test_jit_surface_inventory_lists_all_four_caches():
     assert by_site["cached_op"]["file"] == "mxtpu/gluon/block.py"
     assert by_site["serving.predict"]["file"] == "mxtpu/serving/engine.py"
     assert "policy_key" in (by_site["cached_op"]["cache_key"] or "")
+    serving = by_site["serving.predict"]
+    assert serving["allowlisted"] is True
+    # the per-replica jit caches are declared, not anonymous: the entry
+    # names the serving.predict.r<i> site family and its bound
+    assert "serving.predict.r" in serving["cache_key"], serving
+    assert "policy_key" in serving["cache_key"], serving
 
 
 # ------------------------------------------------------------------------ CLI

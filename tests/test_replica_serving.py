@@ -1,0 +1,547 @@
+"""Fault-tolerant replica serving (mxtpu/serving/replicas) — ISSUE 8:
+
+* ReplicaSet: one AOT-warmed Predictor per device — per-replica retrace
+  sites pinned at #buckets each, params device_put per replica,
+  per-replica output parity vs the plain block;
+* least-loaded routing (quarantined/busy replicas are never picked);
+* the wedge watchdog (fake clock, zero sleeps): an injected
+  ``replica_wedge`` strands a dispatch -> the replica is quarantined, the
+  batch re-dispatches exactly ONCE on a healthy replica, every future
+  completes, and a half-open probe later restores the replica;
+* the circuit breaker: ``replica_fail`` x threshold opens it, shed
+  reason ``no_healthy_replica`` appears only when ALL replicas are down,
+  and a due probe closes it again;
+* MicroBatcher satellites: the worker crash barrier (queued futures fail
+  instead of hanging on a dead daemon thread) and the condvar drain (no
+  bare time.sleep against the real clock);
+* ModelServer: /healthz per-replica state + degraded status, /metrics
+  replica-tagged counters;
+* the threaded end-to-end run: per-replica workers serve a closed-loop
+  burst with zero hangs.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import resilience, telemetry
+from mxtpu.base import MXNetError
+from mxtpu.gluon import nn
+from mxtpu.serving import (BucketSpec, DeadlineExceeded, MicroBatcher,
+                           ModelServer, Predictor, QueueFull,
+                           ReplicaDispatcher, ReplicaSet)
+
+import jax
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2,
+    reason="replica serving tests need >= 2 (virtual) devices")
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for var in ("MXTPU_TELEMETRY", "MXTPU_RETRACE_BUDGET",
+                "MXTPU_FAULT_INJECT", "MXTPU_SERVE_MAX_BATCH",
+                "MXTPU_SERVE_MAX_WAIT_MS", "MXTPU_SERVE_QUEUE",
+                "MXTPU_SERVE_REPLICAS", "MXTPU_SERVE_DISPATCH_TIMEOUT_MS",
+                "MXTPU_SERVE_BREAKER_THRESHOLD",
+                "MXTPU_SERVE_BREAKER_BACKOFF_MS",
+                "MXTPU_SERVE_BREAKER_BACKOFF_MAX_MS"):
+        monkeypatch.delenv(var, raising=False)
+    telemetry.reset()
+    resilience.reset_faults()
+    yield
+    telemetry.reset()
+    resilience.reset_faults()
+
+
+IN_DIM, OUT_DIM = 12, 4
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _mlp():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(OUT_DIM))
+    net.initialize()
+    return net
+
+
+def _x(n, seed=0, dim=IN_DIM):
+    return np.random.RandomState(seed).randn(n, dim).astype(np.float32)
+
+
+def _rset(n=2, max_batch=4, **kw):
+    net = _mlp()
+    spec = BucketSpec.pow2(max_batch)
+    kw.setdefault("breaker_backoff_ms", 1000)
+    rs = ReplicaSet(net, spec, n=n,
+                    example=np.zeros((1, IN_DIM), np.float32),
+                    warmup=True, **kw)
+    return net, spec, rs
+
+
+def _disp(rs, clk, **kw):
+    kw.setdefault("max_batch_size", rs.spec.max_batch)
+    kw.setdefault("max_wait_ms", 5)
+    kw.setdefault("dispatch_timeout_ms", 2000)
+    return ReplicaDispatcher(rs, clock=clk, start=False, **kw)
+
+
+def _states(bat):
+    return [s["state"] for s in bat.replica_states()]
+
+
+# ------------------------------------------------------------------ ReplicaSet
+def test_replicaset_warmup_per_replica_sites_and_devices():
+    _, spec, rs = _rset(n=2)
+    assert len(rs) == 2
+    # one warmed executable cache per replica, each pinned at #buckets
+    # at its OWN retrace site
+    for i, rep in enumerate(rs.replicas):
+        st = telemetry.retrace_stats("serving.predict.r%d" % i)
+        assert st["compiles"] == len(spec), st
+        assert st["trips"] == 0
+    # the PR-5 site is untouched: no anonymous serving compiles
+    assert telemetry.retrace_stats("serving.predict") is None
+    # params committed per replica device
+    d0 = {str(d) for d in
+          (rs.replicas[0].predictor._param_datas[0].devices()
+           if hasattr(rs.replicas[0].predictor._param_datas[0], "devices")
+           else [rs.replicas[0].predictor._param_datas[0].device()])}
+    d1 = {str(d) for d in
+          (rs.replicas[1].predictor._param_datas[0].devices()
+           if hasattr(rs.replicas[1].predictor._param_datas[0], "devices")
+           else [rs.replicas[1].predictor._param_datas[0].device()])}
+    assert d0 != d1
+    assert telemetry.snapshot()["gauges"]["serving.replicas"] == 2
+
+
+def test_replicaset_per_replica_parity():
+    net, _, rs = _rset(n=2)
+    x = _x(3, seed=42)
+    ref = net(mx.nd.array(x)).asnumpy()
+    for rep in rs.replicas:
+        np.testing.assert_allclose(rep.predictor.predict(x).asnumpy(), ref,
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_replicaset_refuses_more_replicas_than_devices():
+    net = _mlp()
+    with pytest.raises(MXNetError):
+        ReplicaSet(net, BucketSpec.pow2(2), n=len(jax.devices()) + 1,
+                   example=np.zeros((1, IN_DIM), np.float32), warmup=False)
+
+
+def test_pick_least_loaded_skips_quarantined():
+    _, _, rs = _rset(n=2)
+    assert rs.pick().index == 0                 # tie -> lowest index
+    rs.acquire(rs.replicas[0])
+    assert rs.pick().index == 1                 # least loaded
+    rs.release(rs.replicas[0])
+    rs.force_quarantine(1, now=0.0)
+    assert rs.pick().index == 0                 # quarantined never picked
+    rs.force_quarantine(0, now=0.0)
+    assert rs.pick() is None                    # all down
+
+
+# -------------------------------------------------------------- wedge watchdog
+def test_wedge_recovery_full_cycle(monkeypatch):
+    """ISSUE-8 acceptance: with 2 replicas and an injected replica_wedge,
+    every submitted future completes (the wedged batch re-dispatches once
+    on the healthy replica), the wedged replica is quarantined and later
+    restored by a half-open probe — all under a fake clock, zero sleeps."""
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "replica_wedge@0")
+    resilience.reset_faults()
+    net, _, rs = _rset(n=2)
+    clk = FakeClock()
+    bat = _disp(rs, clk)
+    x = _x(2, seed=7)
+    f_wedged = bat.submit(x)
+    f_other = bat.submit(_x(1, seed=8))
+    clk.advance(0.006)
+    assert bat.poll() == 2        # dispatch 0 -> r0: wedges (no answer)
+    assert not f_wedged.done() and not f_other.done()
+    assert _states(bat) == ["healthy", "healthy"]  # not yet past deadline
+    clk.advance(2.5)              # past MXTPU_SERVE_DISPATCH_TIMEOUT_MS
+    assert bat.poll() == 2        # scan trips -> re-dispatch on r1
+    np.testing.assert_allclose(f_wedged.result(0),
+                               net(mx.nd.array(x)).asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+    assert f_other.done()
+    assert _states(bat) == ["quarantined", "healthy"]
+    assert telemetry.value("serving.replica.wedges", tag="r0") == 1
+    assert telemetry.value("serving.replica.quarantines", tag="r0") == 1
+    assert telemetry.value("serving.replica.redispatches", tag="r0") == 1
+    assert resilience.FAULT_STATS["fired"] == [("replica_wedge", 0)]
+    # half-open probe restores after the backoff (1000 ms)
+    clk.advance(1.2)
+    bat.poll()
+    assert _states(bat) == ["healthy", "healthy"]
+    assert telemetry.value("serving.replica.restores", tag="r0") == 1
+    # service fully healthy again: traffic round-trips on both replicas
+    f2 = bat.submit(_x(2, seed=9))
+    clk.advance(0.006)
+    assert bat.poll() == 1
+    assert f2.result(0).shape == (2, OUT_DIM)
+    # nothing ever hung: every future completed
+    for f in (f_wedged, f_other, f2):
+        assert f.done()
+
+
+def test_wedge_redispatch_exactly_once(monkeypatch):
+    """A re-dispatched batch that wedges AGAIN fails its futures loudly —
+    re-dispatch is exactly-once, never a loop."""
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "replica_wedge@0,1")
+    resilience.reset_faults()
+    _, _, rs = _rset(n=2)
+    clk = FakeClock()
+    bat = _disp(rs, clk)
+    f = bat.submit(_x(1, seed=0))
+    clk.advance(0.006)
+    assert bat.poll() == 1        # wedge on r0
+    clk.advance(2.5)
+    assert bat.poll() == 1        # re-dispatch on r1 -> wedges too
+    clk.advance(2.5)
+    bat.poll()                    # second trip: fail, don't re-dispatch
+    with pytest.raises(DeadlineExceeded):
+        f.result(0)
+    # r1 quarantined by its wedge; r0's earlier quarantine already cycled
+    # through a due half-open probe in the same maintenance pass
+    assert _states(bat) == ["healthy", "quarantined"]
+    assert telemetry.value("serving.replica.wedges") == 2
+
+
+def test_wedge_single_replica_sheds_instead_of_hanging(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "replica_wedge@0")
+    resilience.reset_faults()
+    _, _, rs = _rset(n=1)
+    clk = FakeClock()
+    bat = _disp(rs, clk)
+    f = bat.submit(_x(1, seed=0))
+    clk.advance(0.006)
+    assert bat.poll() == 1
+    clk.advance(2.5)
+    bat.poll()  # trip: no healthy replica left to re-dispatch on
+    with pytest.raises(QueueFull):
+        f.result(0)
+    assert telemetry.value("serving.shed", tag="no_healthy_replica") == 1
+
+
+# -------------------------------------------------------------- circuit breaker
+def test_breaker_opens_after_threshold(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "replica_fail@0,1,2")
+    resilience.reset_faults()
+    _, _, rs = _rset(n=2, breaker_threshold=3)
+    clk = FakeClock()
+    bat = _disp(rs, clk)
+    for i in range(3):  # idle set: least-loaded always routes to r0
+        f = bat.submit(_x(1, seed=i))
+        clk.advance(0.006)
+        assert bat.poll() == 1
+        with pytest.raises(MXNetError):
+            f.result(0)
+    assert _states(bat) == ["quarantined", "healthy"]
+    assert telemetry.value("serving.replica.failures", tag="r0") == 3
+    assert telemetry.value("serving.replica.quarantines", tag="r0") == 1
+    # traffic continues on the healthy replica
+    f = bat.submit(_x(1, seed=9))
+    clk.advance(0.006)
+    assert bat.poll() == 1
+    assert f.result(0).shape == (1, OUT_DIM)
+    assert telemetry.value("serving.replica.dispatches", tag="r1") == 1
+    # one isolated failure does NOT open the breaker
+    assert telemetry.value("serving.shed", tag="no_healthy_replica") == 0
+
+
+def test_breaker_success_resets_consecutive_count(monkeypatch):
+    """Failures must be CONSECUTIVE: a success in between closes the
+    window, so sporadic errors never quarantine a replica."""
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "replica_fail@0,2,4")
+    resilience.reset_faults()
+    _, _, rs = _rset(n=2, breaker_threshold=3)
+    clk = FakeClock()
+    bat = _disp(rs, clk)
+    for i in range(6):  # fail, ok, fail, ok, fail, ok — all on r0
+        f = bat.submit(_x(1, seed=i))
+        clk.advance(0.006)
+        assert bat.poll() == 1
+        if i % 2 == 0:
+            with pytest.raises(MXNetError):
+                f.result(0)
+        else:
+            assert f.result(0).shape == (1, OUT_DIM)
+    assert _states(bat) == ["healthy", "healthy"]
+
+
+def test_all_replicas_down_sheds_then_probe_restores_service(monkeypatch):
+    """The shed reason no_healthy_replica appears ONLY when all replicas
+    are down; a due half-open probe restores service — checked at the
+    next submit, no poll needed."""
+    monkeypatch.setenv("MXTPU_FAULT_INJECT",
+                       "replica_fail@0,1;replica_wedge@2")
+    resilience.reset_faults()
+    # backoff far past the wedge timeline so no probe restores a replica
+    # before the all-down assertion
+    _, _, rs = _rset(n=2, breaker_threshold=2, breaker_backoff_ms=10000)
+    clk = FakeClock()
+    bat = _disp(rs, clk)
+    for i in range(2):  # two consecutive failures open r0's breaker
+        f = bat.submit(_x(1, seed=i))
+        clk.advance(0.006)
+        bat.poll()
+        with pytest.raises(MXNetError):
+            f.result(0)
+    assert _states(bat) == ["quarantined", "healthy"]
+    assert telemetry.value("serving.shed", tag="no_healthy_replica") == 0
+    # k-of-N degraded: submits still admitted while ONE replica lives
+    f = bat.submit(_x(1, seed=5))
+    clk.advance(0.006)
+    bat.poll()                    # dispatch 2 -> r1: wedges
+    clk.advance(2.5)
+    bat.poll()                    # trip: r1 quarantined, no target -> shed
+    with pytest.raises(QueueFull):
+        f.result(0)
+    assert _states(bat) == ["quarantined", "quarantined"]
+    # ALL down: admission sheds with the dedicated reason
+    with pytest.raises(QueueFull):
+        bat.submit(_x(1, seed=6))
+    assert telemetry.value("serving.shed", tag="no_healthy_replica") >= 2
+    # past the backoff the NEXT submit triggers the half-open probes
+    # (admission runs maintenance before refusing) and service resumes
+    clk.advance(11.0)
+    f = bat.submit(_x(1, seed=7))
+    clk.advance(0.006)
+    assert bat.poll() == 1
+    assert f.result(0).shape == (1, OUT_DIM)
+    assert telemetry.value("serving.replica.restores") == 2
+
+
+def test_failed_probe_doubles_backoff(monkeypatch):
+    _, _, rs = _rset(n=2, breaker_threshold=1, breaker_backoff_ms=1000,
+                     breaker_backoff_max_ms=3000)
+    clk = FakeClock()
+    bat = _disp(rs, clk)
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "replica_fail@0")
+    resilience.reset_faults()
+    f = bat.submit(_x(1, seed=0))
+    clk.advance(0.006)
+    bat.poll()
+    with pytest.raises(MXNetError):
+        f.result(0)
+    assert _states(bat) == ["quarantined", "healthy"]
+    rep = rs.replicas[0]
+    # make the probe itself fail deterministically
+    monkeypatch.setattr(rs, "run_probe",
+                        lambda r: (_ for _ in ()).throw(RuntimeError("dead")))
+    clk.advance(1.2)
+    bat.poll()
+    assert _states(bat)[0] == "quarantined"
+    assert rep.backoff_s == pytest.approx(2.0)   # doubled
+    clk.advance(2.2)
+    bat.poll()
+    assert rep.backoff_s == pytest.approx(3.0)   # capped at the max
+    assert telemetry.value("serving.replica.restores") == 0
+
+
+# -------------------------------------------------------- batcher satellites
+def test_worker_crash_barrier_fails_queued_futures(monkeypatch):
+    """Satellite: a dispatch worker dying OUTSIDE _dispatch's try used to
+    strand every queued future on a dead daemon thread — now they all
+    fail, new submits shed, and serving.worker_crashes counts it."""
+    net = _mlp()
+    pred = Predictor(net, BucketSpec.pow2(4),
+                     example=np.zeros((1, IN_DIM), np.float32), warmup=True)
+    bat = MicroBatcher(pred, max_batch_size=4, max_wait_ms=1000, start=False)
+    f1 = bat.submit(_x(1, seed=0))
+    f2 = bat.submit(_x(1, seed=1))
+
+    def boom(now):
+        raise RuntimeError("gather bug")
+
+    monkeypatch.setattr(bat, "_gather_locked", boom)
+    bat.start()
+    with pytest.raises(MXNetError, match="worker crashed"):
+        f1.result(timeout=5)
+    with pytest.raises(MXNetError, match="worker crashed"):
+        f2.result(timeout=5)
+    assert telemetry.value("serving.worker_crashes") == 1
+    with pytest.raises(QueueFull):
+        bat.submit(_x(1, seed=2))
+    assert telemetry.value("serving.shed", tag="worker_crashed") == 1
+    assert bat.queue_depth == 0
+
+
+def test_drain_no_bare_sleep_and_fake_clock_timeout(monkeypatch):
+    """Satellite: drain waits on the condition variable and measures its
+    timeout on the injected clock — never a bare time.sleep poll."""
+    from mxtpu.serving import batcher as batcher_mod
+
+    def no_sleep(_s):
+        raise AssertionError("drain must not busy-wait on time.sleep")
+
+    monkeypatch.setattr(batcher_mod.time, "sleep", no_sleep)
+    net = _mlp()
+    pred = Predictor(net, BucketSpec.pow2(4),
+                     example=np.zeros((1, IN_DIM), np.float32), warmup=True)
+    # threaded drain: the worker's notify wakes drain, no sleep involved
+    bat = MicroBatcher(pred, max_batch_size=4, max_wait_ms=1)
+    f = bat.submit(_x(2, seed=0))
+    assert bat.drain(timeout=10) is True
+    assert f.done()
+    bat.close()
+    # fake-clock, no-worker drain: synchronous poll path, also sleep-free
+    clk = FakeClock()
+    bat2 = MicroBatcher(pred, max_batch_size=4, max_wait_ms=1000,
+                        clock=clk, start=False)
+    f2 = bat2.submit(_x(1, seed=1))
+    assert bat2.drain(timeout=5) is True  # draining forces the dispatch
+    assert f2.done()
+
+
+def test_dispatcher_drain_waits_for_wedged_entries(monkeypatch):
+    """A simulated-wedge batch is neither queued nor inflight — drain
+    must still refuse to report empty until the watchdog resolves it."""
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "replica_wedge@0")
+    resilience.reset_faults()
+    _, _, rs = _rset(n=2)
+    clk = FakeClock()
+    bat = _disp(rs, clk)
+    f = bat.submit(_x(1, seed=0))
+    clk.advance(0.006)
+    bat.poll()                       # wedged: future pending off-queue
+    assert bat.drain(timeout=1) is False
+    clk.advance(2.5)                 # now the scan can resolve it
+    assert bat.drain(timeout=1) is True
+    assert f.done()
+
+
+# ------------------------------------------------------------------ HTTP front
+def _http(addr, path, payload=None, timeout=10):
+    import json
+    import urllib.error
+    import urllib.request
+    url = "http://%s:%d%s" % (addr[0], addr[1], path)
+    if payload is None:
+        req = urllib.request.Request(url)
+    else:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_server_healthz_reports_replica_states():
+    net, _, rs = _rset(n=2)
+    srv = ModelServer(rs)  # a ReplicaSet auto-wraps in a ReplicaDispatcher
+    assert isinstance(srv.batcher, ReplicaDispatcher)
+    srv.start()
+    try:
+        x = _x(2, seed=5)
+        code, out = _http(srv.address, "/predict", {"data": x.tolist()})
+        assert code == 200 and out["n"] == 2
+        np.testing.assert_allclose(np.asarray(out["outputs"][0]),
+                                   net(mx.nd.array(x)).asnumpy(),
+                                   rtol=1e-4, atol=1e-5)
+        code, health = _http(srv.address, "/healthz")
+        assert code == 200 and health["status"] == "ok"
+        assert health["healthy_replicas"] == 2
+        assert [r["state"] for r in health["replicas"]] == \
+            ["healthy", "healthy"]
+        assert {r["device"] for r in health["replicas"]} \
+            == {str(d) for d in jax.devices()[:2]}
+        # lose one replica: still serving, /healthz says degraded
+        srv.batcher.quarantine_replica(0, backoff_s=3600)
+        code, health = _http(srv.address, "/healthz")
+        assert code == 200 and health["status"] == "degraded"
+        assert health["healthy_replicas"] == 1
+        code, out = _http(srv.address, "/predict", {"data": x.tolist()})
+        assert code == 200
+        # /metrics carries the replica-tagged counters + per-replica sites
+        code, m = _http(srv.address, "/metrics")
+        assert code == 200
+        assert "r0" in m["counters"]["serving.replica.quarantines"]
+        assert "serving.predict.r0" in m["retrace"]
+        assert "serving.predict.r1" in m["retrace"]
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------------- threaded tier
+def test_threaded_end_to_end_zero_hangs():
+    """Real per-replica workers: a closed-loop burst completes with zero
+    hangs and the work spreads across replicas."""
+    _, spec, rs = _rset(n=2, max_batch=4)
+    bat = ReplicaDispatcher(rs, max_batch_size=4, max_wait_ms=1,
+                            max_queue=4096)
+    errors = []
+
+    def client(k, n_req):
+        rng = np.random.RandomState(k)
+        for _ in range(n_req):
+            n = int(rng.randint(1, 4))
+            try:
+                out = bat.submit(
+                    rng.randn(n, IN_DIM).astype(np.float32)).result(
+                        timeout=60)
+                assert out.shape == (n, OUT_DIM)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=client, args=(k, 40))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    bat.close()
+    assert not errors, errors[:3]
+    assert telemetry.value("serving.requests") == 160
+    per = telemetry.tagged("serving.replica.dispatches")
+    assert sum(per.values()) == telemetry.value("serving.batches") \
+        + telemetry.value("serving.replica.stale_results")
+    assert len(per) == 2, "both replicas served: %s" % per
+    # post-warmup compile budget holds per replica
+    for i in range(2):
+        st = telemetry.retrace_stats("serving.predict.r%d" % i)
+        assert st["compiles"] <= len(spec) and st["trips"] == 0
+
+
+def test_serve_bench_replicas_smoke():
+    """tools/serve_bench.py --mode replicas: the kill-one-replica-mid-run
+    sweep completes with zero hangs and reports per-replica dispatches."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import serve_bench as sb
+
+    rset, spec = sb.build_replica_set(dim=32, width=32, depth=2,
+                                      max_batch=4, replicas=2)
+    rec = sb.run_replicas(rset, spec, n_requests=60, workers=3,
+                          max_wait_ms=1.0, kill_frac=0.5,
+                          emit=lambda r: None)
+    assert rec["hangs"] == 0
+    assert rec["errors"] == 0
+    assert rec["killed_replica"] == 0
+    assert rec["completed"] + rec["shed"] + rec["expired"] == 60
+    assert sum(rec["per_replica_dispatches"].values()) >= 1
+    assert rec["final_states"][0] == "quarantined"  # the killed replica

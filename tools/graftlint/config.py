@@ -48,6 +48,20 @@ JIT_ALLOWLIST: Dict[Tuple[str, str], Dict[str, str]] = {
                      "registry.policy_key — FusedUpdater._cached_jit; the "
                      "mesh-native Trainer shares this cache",
     },
+    ("mxtpu/serving/engine.py", "_get_jit"): {
+        "site": "serving.predict",
+        "reason": "Predictor._get_jit reports every compile itself via "
+                  "telemetry.record_retrace(self._site, ...); the site "
+                  "name is per-INSTANCE so each ReplicaSet member gets "
+                  "its own watchdog site (serving.predict.r<i>) — the "
+                  "static rule sees '<dynamic>' and this entry declares "
+                  "the base site for the inventory",
+        "cache_key": "(bucket padded shapes+dtypes) + registry.policy_key "
+                     "— one executable cache per Predictor instance; "
+                     "per-replica caches (sites serving.predict.r<i>, "
+                     "mxtpu/serving/replicas.py) are each bounded by "
+                     "#buckets, total compiles <= buckets x replicas",
+    },
     ("mxtpu/optimizer_fused.py", "_build_guarded"): {
         "site": "fused_optimizer",
         "reason": "same cache front door as _build; the guard bit and "

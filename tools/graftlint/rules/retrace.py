@@ -78,16 +78,23 @@ class RetraceSiteRegistration(Rule):
                     break
             enclosing_name = chain[0].name if chain else "<module>"
             allow = self.config.jit_allowlist.get((ctx.rel, enclosing_name))
+            # a "<dynamic>" site IS registered (record_retrace runs with a
+            # computed name — e.g. the serving Predictor's per-replica
+            # serving.predict.r<i> sites), but the static name is unknown;
+            # an allowlist entry resolves it for the inventory so the
+            # scouting report never shows an anonymous cache
+            unresolved = site in (None, "<dynamic>")
             entry = {
                 "file": ctx.rel,
                 "line": node.lineno,
                 "function": qualname_of(node, parents),
                 "donation": _donation_of(node),
                 "cache_key": _cache_key_of(chain[0] if chain else None),
-                "retrace_site": site or (allow["site"] if allow else None),
-                "allowlisted": bool(allow and site is None),
+                "retrace_site": (allow["site"] if allow and unresolved
+                                 else site),
+                "allowlisted": bool(allow and unresolved),
             }
-            if allow and site is None and allow.get("cache_key"):
+            if allow and unresolved and allow.get("cache_key"):
                 entry["cache_key"] = allow["cache_key"]
             self.inventory.append(entry)
             if site is None and allow is None:

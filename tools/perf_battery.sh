@@ -95,4 +95,17 @@ else
   echo "multichip_resnet skipped: single device" | tee -a "$LOG"
 fi
 
+# 5. replica serving phase (ISSUE 8): ReplicaSet router under a
+#    kill-one-replica-mid-run sweep — per-replica throughput + hang count
+#    JSON (the gate: hangs == 0 through the replica loss). Only
+#    meaningful with >1 device; a single chip has nothing to fail over to.
+sleep 60
+if timeout 90 python -c "import jax,sys; sys.exit(0 if len(jax.devices())>1 else 1)"; then
+  timeout 600 python tools/serve_bench.py --mode replicas --replicas 0 \
+    --requests 400 2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
+  telemetry_report
+else
+  echo "replica serving skipped: single device" | tee -a "$LOG"
+fi
+
 echo "battery complete -> $LOG"

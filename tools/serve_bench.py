@@ -19,13 +19,21 @@ line each (stamped with platform + policy_key like every bench artifact):
   rate, p50/p99, and mean batch fill — the overload-behaviour curve
   (shed rate should rise and p99 should stay bounded once offered QPS
   exceeds capacity; an unbounded p99 means admission control is broken).
+* ``replicas`` — ISSUE 8: closed-loop through a ReplicaSet router
+  (``--replicas N``, 0 = one per device) with a kill-one-replica-mid-run
+  sweep: halfway through, replica 0 is quarantined as if its chip died.
+  Reports per-replica dispatch counts, throughput, shed/expired counts,
+  and a **hang count** — futures that never completed. The acceptance
+  gate: hangs == 0 through the replica loss (requests re-route, shed, or
+  expire; none strand).
 
 Usage::
 
-    python tools/serve_bench.py [--mode sweep,closed,open]
+    python tools/serve_bench.py [--mode sweep,closed,open,replicas]
         [--requests 500] [--max-batch 8] [--dim 256] [--width 512]
         [--depth 3] [--max-wait-ms 2] [--workers 4]
         [--qps 100,300,1000] [--deadline-ms 100]
+        [--replicas 0] [--kill-replica 0]
 
 ``bench.py``'s ``serving`` config drives the same functions in-process,
 and ``tools/perf_battery.sh`` runs this script as its serving phase.
@@ -91,6 +99,26 @@ def build_predictor(dim=256, width=512, depth=3, out_dim=64, max_batch=8,
 def _as_nd(a):
     import mxtpu as mx
     return mx.nd.array(a)
+
+
+def build_replica_set(dim=256, width=512, depth=3, out_dim=64, max_batch=8,
+                      replicas=2, dtype="float32"):
+    """The bench model behind a ReplicaSet: one warmed Predictor per
+    device (``replicas=0`` = every visible device)."""
+    from mxtpu.gluon import nn
+    from mxtpu.serving import BucketSpec, ReplicaSet
+
+    net = nn.HybridSequential(prefix="servebench_")
+    with net.name_scope():
+        for _ in range(max(1, depth - 1)):
+            net.add(nn.Dense(width, activation="relu"))
+        net.add(nn.Dense(out_dim))
+    net.initialize()
+    spec = BucketSpec.pow2(max_batch)
+    rset = ReplicaSet(net, spec, n=replicas,
+                      example=np.zeros((1, dim), np.float32),
+                      warmup=True, name="serve_bench")
+    return rset, spec
 
 
 def _dim(pred):
@@ -241,6 +269,94 @@ def run_open(pred, spec, qps_list=(100.0, 300.0, 1000.0), n_requests=200,
     return recs
 
 
+def run_replicas(rset, spec, n_requests=400, workers=4, max_wait_ms=2.0,
+                 kill_frac=0.5, kill_replica=0, result_timeout=60.0,
+                 emit=_emit):
+    """The kill-one-replica-mid-run sweep (ISSUE 8 acceptance): a
+    closed-loop burst through the ReplicaDispatcher; at ``kill_frac`` of
+    the run, ``kill_replica`` is quarantined with an hour-long backoff —
+    a dead chip, as far as this run is concerned. Emits per-replica
+    dispatch counts and a hang count (futures that never completed
+    within ``result_timeout``): the gate is hangs == 0 — every request
+    re-routes, sheds, or expires, none strand."""
+    from mxtpu import telemetry
+    from mxtpu.serving import DeadlineExceeded, QueueFull
+    from mxtpu.serving.replicas import ReplicaDispatcher
+
+    n_rep = len(rset.replicas)
+    disp0 = dict(telemetry.tagged("serving.replica.dispatches"))
+    bat = ReplicaDispatcher(rset, max_batch_size=spec.max_batch,
+                            max_wait_ms=max_wait_ms, max_queue=4096)
+    dim = rset.input_templates[0][0][0]
+    lock = threading.Lock()
+    stats = {"completed": 0, "items": 0, "shed": 0, "expired": 0,
+             "errors": 0, "hangs": 0, "submitted": 0}
+    kill_at = max(1, int(n_requests * kill_frac))
+
+    def client(k, n):
+        rng = np.random.RandomState(300 + k)
+        for _ in range(n):
+            with lock:
+                stats["submitted"] += 1
+                fire_kill = stats["submitted"] == kill_at
+            if fire_kill and n_rep > 1:
+                bat.quarantine_replica(kill_replica, backoff_s=3600.0)
+            sz = int(rng.randint(1, max(2, spec.max_batch // 2)))
+            x = rng.randn(sz, dim).astype(np.float32)
+            try:
+                fut = bat.submit(x, deadline_ms=result_timeout * 1e3)
+            except QueueFull:
+                with lock:
+                    stats["shed"] += 1
+                continue
+            try:
+                fut.result(timeout=result_timeout)
+            except DeadlineExceeded:
+                with lock:
+                    # a future that timed out WITHOUT completing is a
+                    # hang — the exact failure this subsystem exists to
+                    # prevent; a completed-with-expiry is bounded behavior
+                    stats["hangs" if not fut.done() else "expired"] += 1
+            except Exception:  # noqa: BLE001 — shed-at-dispatch etc.
+                with lock:
+                    stats["errors" if fut.done() and not isinstance(
+                        fut._error, QueueFull) else "shed"] += 1
+            else:
+                with lock:
+                    stats["completed"] += 1
+                    stats["items"] += sz
+
+    per = [n_requests // workers] * workers
+    per[0] += n_requests - sum(per)
+    threads = [threading.Thread(target=client, args=(k, n))
+               for k, n in enumerate(per)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(result_timeout + 60)
+    wall = time.perf_counter() - t0
+    bat.close(timeout=10)
+    per_rep = {}
+    for tag, v in telemetry.tagged("serving.replica.dispatches").items():
+        d = v - disp0.get(tag, 0)
+        if d:
+            per_rep[tag] = d
+    rec = {"metric": "serve_replicas", "replicas": n_rep,
+           "value": round(stats["items"] / wall, 1), "unit": "items/sec",
+           "requests": n_requests,
+           "killed_replica": kill_replica if n_rep > 1 else None,
+           "killed_at_request": kill_at if n_rep > 1 else None,
+           "hangs": stats["hangs"], "errors": stats["errors"],
+           "completed": stats["completed"], "shed": stats["shed"],
+           "expired": stats["expired"],
+           "per_replica_dispatches": per_rep,
+           "wedges": telemetry.value("serving.replica.wedges"),
+           "final_states": [s["state"] for s in bat.replica_states()]}
+    emit(rec)
+    return rec
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", default="sweep,closed,open")
@@ -256,27 +372,63 @@ def main(argv=None):
     ap.add_argument("--qps", default="100,300,1000")
     ap.add_argument("--deadline-ms", type=float, default=100.0)
     ap.add_argument("--sweep-iters", type=int, default=50)
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="replica count for --mode replicas (0 = one per "
+                         "visible device)")
+    ap.add_argument("--kill-replica", type=int, default=0,
+                    help="replica quarantined mid-run by --mode replicas "
+                         "(-1 = no kill)")
     args = ap.parse_args(argv)
 
     modes = {m.strip() for m in args.mode.split(",") if m.strip()}
-    pred, spec = build_predictor(dim=args.dim, width=args.width,
-                                 depth=args.depth, max_batch=args.max_batch)
-    _emit({"metric": "serve_warmup", "buckets": len(spec),
-           "value": len(spec), "unit": "compiled_buckets"})
     ok = True
-    if "sweep" in modes:
-        _, monotonic = run_sweep(pred, spec, iters=args.sweep_iters)
-        ok = ok and monotonic
-    if "closed" in modes:
-        rec = run_closed(pred, spec, n_requests=args.requests,
-                         workers=args.workers, max_wait_ms=args.max_wait_ms)
-        ok = ok and rec["compiles"] <= rec["buckets"] \
-            and rec["watchdog_trips"] == 0
-    if "open" in modes:
-        run_open(pred, spec,
-                 qps_list=[float(q) for q in args.qps.split(",") if q],
-                 n_requests=args.requests, deadline_ms=args.deadline_ms,
-                 max_wait_ms=args.max_wait_ms)
+    single = modes - {"replicas"}
+    if single:
+        pred, spec = build_predictor(dim=args.dim, width=args.width,
+                                     depth=args.depth,
+                                     max_batch=args.max_batch)
+        _emit({"metric": "serve_warmup", "buckets": len(spec),
+               "value": len(spec), "unit": "compiled_buckets"})
+        if "sweep" in modes:
+            _, monotonic = run_sweep(pred, spec, iters=args.sweep_iters)
+            ok = ok and monotonic
+        if "closed" in modes:
+            rec = run_closed(pred, spec, n_requests=args.requests,
+                             workers=args.workers,
+                             max_wait_ms=args.max_wait_ms)
+            ok = ok and rec["compiles"] <= rec["buckets"] \
+                and rec["watchdog_trips"] == 0
+        if "open" in modes:
+            run_open(pred, spec,
+                     qps_list=[float(q) for q in args.qps.split(",") if q],
+                     n_requests=args.requests, deadline_ms=args.deadline_ms,
+                     max_wait_ms=args.max_wait_ms)
+    if "replicas" in modes:
+        import jax
+        n = args.replicas or len(jax.devices())
+        if n > len(jax.devices()):
+            _emit({"metric": "serve_replicas", "error":
+                   "%d replicas > %d devices" % (n, len(jax.devices()))})
+            return 1
+        if args.kill_replica >= n:
+            # an out-of-range kill would IndexError inside a client
+            # thread and let the gate pass on a truncated run
+            _emit({"metric": "serve_replicas", "error":
+                   "--kill-replica %d out of range for %d replicas"
+                   % (args.kill_replica, n)})
+            return 1
+        rset, spec = build_replica_set(dim=args.dim, width=args.width,
+                                       depth=args.depth,
+                                       max_batch=args.max_batch, replicas=n)
+        _emit({"metric": "serve_replicas_warmup", "replicas": n,
+               "value": n * len(spec), "unit": "compiled_buckets"})
+        rec = run_replicas(rset, spec, n_requests=args.requests,
+                           workers=args.workers,
+                           max_wait_ms=args.max_wait_ms,
+                           kill_replica=args.kill_replica,
+                           kill_frac=0.5 if args.kill_replica >= 0
+                           else 2.0)  # >1.0 frac: the kill never fires
+        ok = ok and rec["hangs"] == 0 and rec["errors"] == 0
     return 0 if ok else 1
 
 
