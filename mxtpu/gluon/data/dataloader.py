@@ -41,16 +41,29 @@ from .sampler import BatchSampler, RandomSampler, SequentialSampler
 __all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
 
 
-def default_batchify_fn(data):
-    """Stack samples into a batch (ref: dataloader.py:default_batchify_fn)."""
+def _prefetch_batchify_fn(data):
+    """Stacking WITHOUT the eager device placement: numpy samples stay
+    numpy so the DevicePrefetcher's async device_put onto the TARGET
+    sharding is the one H2D copy; NDArray samples (already
+    device-resident) still stack the normal way — the in-process paths
+    must keep accepting them (the mp pool rejects them regardless, in
+    the worker). `default_batchify_fn` is this plus the leaf wrap."""
     if isinstance(data[0], NDArray):
         from ...ndarray import stack
         return stack(*data)
     if isinstance(data[0], tuple):
         transposed = list(zip(*data))
-        return [default_batchify_fn(list(x)) for x in transposed]
-    data = np.asarray(data)
-    return array(data)
+        return [_prefetch_batchify_fn(list(x)) for x in transposed]
+    return np.asarray(data)
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (ref: dataloader.py:default_batchify_fn)."""
+    def wrap(x):
+        if isinstance(x, list):
+            return [wrap(v) for v in x]
+        return array(x) if isinstance(x, np.ndarray) else x
+    return wrap(_prefetch_batchify_fn(data))
 
 
 # worker-process internals (numpy-only, no mxtpu import) live in
@@ -64,10 +77,20 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False):
+                 thread_pool=False, prefetch_to_device=None):
         self._dataset = dataset
         self._thread_pool = thread_pool
         self._pool = None  # lazy persistent spawn-worker pool
+        # prefetch_to_device (ISSUE 9): None/False = classic host batches;
+        # True = double-buffered async device_put of batch N+1 while the
+        # consumer computes on batch N (mxtpu/io/stream.DevicePrefetcher,
+        # depth MXTPU_PREFETCH_DEPTH); a jax Sharding or a mesh
+        # gluon.Trainer lands each per-replica slice directly on its
+        # device (Trainer.batch_sharding) — no host-side gather. With it
+        # on, `data.wait` measures only TRUE starvation (buffer-empty)
+        # and `data.h2d` times the transfers (docs/data_pipeline.md).
+        self._prefetch_spec = prefetch_to_device \
+            if prefetch_to_device not in (None, False) else None
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError("batch_size is required when batch_sampler "
@@ -86,7 +109,14 @@ class DataLoader:
                 "when batch_sampler is specified")
         self._batch_sampler = batch_sampler
         self._user_batchify = batchify_fn is not None
-        self._batchify_fn = batchify_fn or default_batchify_fn
+        # with the device prefetcher on, default batchify keeps numpy
+        # leaves in numpy: the ONE host->device copy is the prefetcher's
+        # async device_put onto the target sharding (default_batchify_fn
+        # would eagerly place batches on the default device first — a
+        # wasted hop); NDArray-sample datasets still stack fine
+        self._batchify_fn = batchify_fn or (
+            _prefetch_batchify_fn if self._prefetch_spec is not None
+            else default_batchify_fn)
         self._num_workers = max(0, num_workers)
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
@@ -99,6 +129,19 @@ class DataLoader:
 
     def __iter__(self):
         from ... import telemetry
+        if self._prefetch_spec is not None:
+            # device-resident path: the prefetcher owns the data.wait /
+            # data.starved / data.h2d telemetry — data.wait then measures
+            # only TRUE starvation (consumer blocked on an empty buffer),
+            # not decode time the overlap already hid
+            from ...io.stream import DevicePrefetcher
+            pf = DevicePrefetcher(self._iter_impl(),
+                                  sharding=self._prefetch_spec)
+            try:
+                yield from pf
+            finally:
+                pf.close()
+            return
         it = self._iter_impl()
         while True:
             # data-wait phase of the step timeline: how long the consumer
@@ -319,7 +362,11 @@ class DataLoader:
                             "DataLoader worker failed at batch %d:\n%s"
                             % (j - base, err))
                     results[j] = desc
-                yield _mp_worker.from_shm(results.pop(base + i), array)
+                # device-prefetch path: leave leaves in numpy — the
+                # prefetcher's device_put is the one H2D copy
+                wrap = (lambda x: x) if self._prefetch_spec is not None \
+                    else array
+                yield _mp_worker.from_shm(results.pop(base + i), wrap)
         finally:
             # unlink any segments the consumer never mapped (early exit);
             # in-flight stale results are discarded by the next epoch/close

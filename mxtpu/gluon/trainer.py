@@ -192,6 +192,21 @@ class Trainer:
             if ensure is not None and param.grad_req != "null":
                 ensure(i, d)
 
+    @property
+    def batch_sharding(self):
+        """The mesh batch layout (``NamedSharding`` over the data axis,
+        dim 0) that :meth:`shard_batch` places inputs on — or None
+        without a mesh. The input pipeline's prefetch-to-device stage
+        (``mxtpu/io/stream.py``, ``DataLoader(prefetch_to_device=
+        trainer)``) device_puts each incoming batch directly onto THIS
+        sharding, so per-replica slices land on their devices with no
+        host-side gather and the training step sees the exact layout
+        ``shard_batch`` would have produced."""
+        if self._mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(self._mesh, PartitionSpec(self._data_axis))
+
     def shard_batch(self, *arrays):
         """Place batch array(s) sharded over the mesh data axis (dim 0) —
         the per-step input layout of mesh-native training. Without a mesh
@@ -203,8 +218,7 @@ class Trainer:
             return arrays[0] if len(arrays) == 1 else tuple(arrays)
         import jax
         import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec
-        sh = NamedSharding(self._mesh, PartitionSpec(self._data_axis))
+        sh = self.batch_sharding
         n = self._mesh.shape[self._data_axis]
         out = []
         for a in arrays:

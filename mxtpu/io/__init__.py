@@ -2,12 +2,18 @@
 
 Reference: ``python/mxnet/io/io.py`` (DataDesc/DataBatch/DataIter/NDArrayIter)
 and the C++ iterator chain (SURVEY §2.4: src/io/ — source → augmenter →
-batch loader → prefetcher).
+batch loader → prefetcher). The prefetcher stage is TPU-native here:
+``mxtpu/io/stream.py`` holds the sharded streaming reader and the
+double-buffered prefetch-to-device pipeline (ISSUE 9,
+docs/data_pipeline.md).
 """
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter, CSVIter, LibSVMIter, MNISTIter,
                  ImageRecordIter)
+from .stream import (DevicePrefetcher, ShardedRecordReader, StreamRecordIter,
+                     shard_keys)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "LibSVMIter", "MNISTIter",
-           "ImageRecordIter"]
+           "ImageRecordIter", "DevicePrefetcher", "ShardedRecordReader",
+           "StreamRecordIter", "shard_keys"]

@@ -1,13 +1,15 @@
 """Data iterators (ref: python/mxnet/io/io.py).
 
 TPU-native notes: batches are host numpy until the training step consumes
-them — device transfer happens once per batch at the jit boundary (the
-reference's PrefetcherIter double-buffering maps to PJRT async host→device
-copies; a threaded PrefetchingIter is still provided for expensive pipelines).
+them — device transfer happens once per batch at the jit boundary. The
+reference's PrefetcherIter double-buffering maps to the async
+``jax.device_put`` pipeline in ``mxtpu/io/stream.py`` (DevicePrefetcher):
+``PrefetchingIter`` here delegates to it, and ``StreamRecordIter`` is the
+sharded streaming RecordIO spelling of the same overlap (ISSUE 9,
+docs/data_pipeline.md).
 """
 from __future__ import annotations
 
-import threading
 from collections import namedtuple
 
 import numpy as np
@@ -255,39 +257,76 @@ class ResizeIter(DataIter):
 
 
 class PrefetchingIter(DataIter):
-    """Threaded double-buffering over one or more iterators
-    (ref: io.py:PrefetchingIter ~ the C++ PrefetcherIter, src/io/iter_prefetcher.h)."""
+    """Double-buffering over one or more iterators (ref:
+    io.py:PrefetchingIter ~ the C++ PrefetcherIter, src/io/
+    iter_prefetcher.h), delegating to :class:`mxtpu.io.stream.
+    DevicePrefetcher` (ISSUE 9).
 
-    def __init__(self, iters, rename_data=None, rename_label=None):
+    The previous implementation double-buffered on the HOST with one
+    bare thread + event pair per iterator, and its ``reset()`` waited on
+    a ``_ready`` event an exhausted/raising worker might never set again
+    — a deadlock. Delegating buys: prefetch **to device** (numpy leaves
+    upload while the consumer computes; pass ``prefetch_to_device=``
+    a mesh ``Trainer`` or ``Sharding`` to land per-replica slices
+    directly), depth > 1 (``MXTPU_PREFETCH_DEPTH``), worker errors
+    re-raised at the consumer instead of vanishing, and a ``reset()``
+    that joins the worker with a TIMEOUT and re-raises its pending
+    exception."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_to_device=None, depth=None):
         if not isinstance(iters, (list, tuple)):
             iters = [iters]
         super().__init__(iters[0].batch_size)
         self.iters = iters
         self.rename_data = rename_data
         self.rename_label = rename_label
-        self._batch = [None] * len(iters)
-        self._ready = [threading.Event() for _ in iters]
-        self._taken = [threading.Event() for _ in iters]
-        self._stop = False
-        for e in self._taken:
-            e.set()
+        self._sharding_spec = prefetch_to_device
+        self._depth = depth
+        self._pending = None
+        self._prefetcher = None
+        self._start()
 
-        def worker(i):
-            while not self._stop:
-                self._taken[i].wait()
-                if self._stop:
-                    return
-                self._taken[i].clear()
+    @staticmethod
+    def _pull(it):
+        while True:
+            try:
+                yield it.next()
+            except StopIteration:
+                return
+
+    def _merged(self, sources):
+        while True:
+            batches = []
+            for src in sources:
                 try:
-                    self._batch[i] = self.iters[i].next()
+                    batches.append(next(src))
                 except StopIteration:
-                    self._batch[i] = None
-                self._ready[i].set()
+                    return
+            data = sum((b.data for b in batches), [])
+            label = sum((b.label or [] for b in batches), [])
+            yield DataBatch(data=data, label=label or None,
+                            pad=batches[0].pad, index=batches[0].index)
 
-        self._threads = [threading.Thread(target=worker, args=(i,), daemon=True)
-                         for i in range(len(iters))]
-        for t in self._threads:
-            t.start()
+    def _start(self):
+        from .stream import DevicePrefetcher
+        self._pending = None
+        # cross-iterator parallelism (the old implementation's
+        # thread-per-iter, kept): with multiple sub-iterators each gets
+        # its own producer stage decoding ahead, so per-batch source
+        # latency is the MAX across iterators, not the SUM; the outer
+        # stage merges, owns the target-sharding placement, and carries
+        # the data.* telemetry
+        # to_device=False: sub stages buffer on the HOST — the one H2D
+        # copy (onto the target sharding) belongs to the outer stage, or
+        # numpy batches would upload to the default device here and then
+        # transfer AGAIN when the outer stage re-places them
+        self._sub = [DevicePrefetcher(self._pull(it), depth=self._depth,
+                                      site="data.sub", to_device=False)
+                     for it in self.iters] if len(self.iters) > 1 else None
+        self._prefetcher = DevicePrefetcher(
+            self._merged(self._sub or [self._pull(self.iters[0])]),
+            depth=self._depth, sharding=self._sharding_spec)
 
     @property
     def provide_data(self):
@@ -312,42 +351,54 @@ class PrefetchingIter(DataIter):
         return out
 
     def reset(self):
-        for e in self._ready:
-            e.wait()
+        # bounded join + reraise: an exhausted or raising underlying iter
+        # must never deadlock the reset path (the old event-pair bug); a
+        # worker error surfaces HERE rather than being dropped (sub-stage
+        # errors propagate through the outer producer, so the outer close
+        # carries them)
+        try:
+            self._prefetcher.close(timeout=5.0, reraise=True)
+        finally:
+            # even when the outer close raises, the sub producers must
+            # die: a leaked sub keeps pulling its iterator in the
+            # background (corrupting its cursor for any retry) and pins
+            # its buffered batches — and with them gone, a retried
+            # reset() starts from a clean slate
+            for sub in self._sub or ():
+                try:
+                    sub.close(timeout=5.0)
+                except Exception:  # noqa: BLE001 — teardown must not mask
+                    pass
         for it in self.iters:
             it.reset()
-        for e in self._ready:
-            e.clear()
-        for e in self._taken:
-            e.set()
+        self._start()
 
     def next(self):
-        for e in self._ready:
-            e.wait()
-        if any(b is None for b in self._batch):
-            for e in self._ready:
-                e.clear()
-            for e in self._taken:
-                e.set()
-            raise StopIteration
-        batches = list(self._batch)
-        for i in range(len(self.iters)):
-            self._ready[i].clear()
-            self._taken[i].set()
-        data = sum((b.data for b in batches), [])
-        label = sum((b.label or [] for b in batches), [])
-        return DataBatch(data=data, label=label or None, pad=batches[0].pad,
-                         index=batches[0].index)
+        if self._pending is not None:
+            batch, self._pending = self._pending, None
+            return batch
+        return next(self._prefetcher)
 
     def iter_next(self):
-        for e in self._ready:
-            e.wait()
-        return not any(b is None for b in self._batch)
+        if self._pending is not None:
+            return True
+        try:
+            self._pending = next(self._prefetcher)
+        except StopIteration:
+            return False
+        return True
 
-    def __del__(self):
-        self._stop = True
-        for e in self._taken:
-            e.set()
+    def close(self, timeout=5.0):
+        if self._prefetcher is not None:
+            self._prefetcher.close(timeout=timeout)
+        for sub in self._sub or ():
+            sub.close(timeout=timeout)
+
+    def __del__(self):  # pragma: no cover - interpreter-exit timing
+        try:
+            self.close(timeout=0.5)
+        except Exception:  # noqa: BLE001
+            pass
 
 
 class CSVIter(DataIter):

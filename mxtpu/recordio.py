@@ -65,11 +65,16 @@ class _PyReader:
 
     corrupt = False  # set when read() stops on damage rather than clean EOF
 
-    def read(self):
+    def _walk(self, read):
+        """ONE record-framing walk (magic check, cflag chunk state
+        machine, pad skip, corrupt flags) shared by the sequential and
+        positioned paths — ``read(n)`` supplies the next n bytes and owns
+        its own position, so the two readers can never diverge on
+        framing."""
         out = b""
         started = False
         while True:
-            head = self._f.read(8)
+            head = read(8)
             if len(head) == 0 and not started:
                 return None  # clean EOF at a record boundary
             if len(head) < 8:
@@ -80,13 +85,13 @@ class _PyReader:
                 self.corrupt = True  # lost sync
                 return None
             length, cflag = lrec & ((1 << 29) - 1), lrec >> 29
-            data = self._f.read(length)
+            data = read(length)
             if len(data) < length:
                 self.corrupt = True  # truncated mid-payload: NOT a record
                 return None
             pad = (4 - (length & 3)) & 3
             if pad:
-                self._f.read(pad)
+                read(pad)
             out += data
             if cflag == 0 or cflag == 3:
                 return out
@@ -95,6 +100,26 @@ class _PyReader:
             elif not started:
                 return None
             out += _MAGIC_BYTES  # re-insert elided magic between chunks
+
+    def read(self):
+        return self._walk(self._f.read)
+
+    def read_at(self, pos):
+        """Positioned read of ONE logical record starting at byte ``pos``
+        (pread-style: the handle's shared seek offset is never touched, so
+        any number of concurrent shard readers can share one open file
+        with no seek races and no lock). Same framing walk as
+        :meth:`read` by construction (``_walk``); the sequential path
+        stays byte-identical (pinned by round-trip test)."""
+        fd = self._f.fileno()
+        state = {"pos": pos}
+
+        def pread(n):
+            b = os.pread(fd, n, state["pos"])
+            state["pos"] += len(b)
+            return b
+
+        return self._walk(pread)
 
     def seek(self, pos):
         self._f.seek(pos)
@@ -257,6 +282,17 @@ class MXIndexedRecordIO(MXRecordIO):
         with self._rw_lock:
             self.seek(idx)
             return self.read()
+
+    def pread_idx(self, idx):
+        """Positioned keyed read. On the python reader this is a true
+        pread (``_PyReader.read_at`` — no shared offset mutated, no lock:
+        the streaming shard readers in ``mxtpu/io/stream.py`` fan any
+        number of threads over ONE open handle). The native reader keeps
+        its internal cursor, so it degrades to the locked seek+read."""
+        assert not self.writable
+        if self._lib is None:
+            return self.handle.read_at(self.idx[idx])
+        return self.read_idx(idx)
 
     def write_idx(self, idx, buf):
         assert self.writable

@@ -84,8 +84,10 @@ _FAULT_COUNTERS = {}
 def _parse_faults(spec):
     """``kind@i,j;kind2@k`` -> {kind: {i, j}, kind2: {k}}. Kinds in use:
     ``nan_grad`` (optimizer-step index), ``ckpt_io`` (save-attempt index),
-    ``sigterm`` (loop step index), ``worker_death`` (dataloader batch
-    index), ``kv_fail`` (dist-reduce attempt index), ``serve_timeout``
+    ``sigterm`` (loop step index), ``worker_death`` (dataloader/stream-reader
+    batch index), ``prefetch_death`` (DevicePrefetcher producer pull counter
+    — its own kind so composed pipelines route faults deterministically),
+    ``kv_fail`` (dist-reduce attempt index), ``serve_timeout``
     (serving batch dispatch index: that batch's requests all expire),
     ``serve_overload`` (serving submit index: that submit sheds),
     ``replica_fail`` (serving dispatch index: the replica executing that

@@ -1,0 +1,783 @@
+"""Device-resident input pipeline (ISSUE 9): sharded streaming readers +
+double-buffered prefetch-to-device (mxtpu/io/stream.py).
+
+Pins:
+
+* shard determinism — same seed => identical per-replica batch streams
+  across runs; epoch boundaries reshuffle; ``num_shards`` not dividing
+  the index drops/duplicates nothing (remainder-balanced);
+* ``_PyReader.read_at`` positioned reads are byte-identical to the
+  sequential reader (incl. multi-chunk records) and leave the shared
+  seek offset untouched, so concurrent shard readers share one handle;
+* the prefetcher survives an injected ``prefetch_death`` and a mid-epoch
+  close without hanging, and errors surface at the consumer;
+* ``PrefetchingIter`` (now delegating to DevicePrefetcher) no longer
+  deadlocks on reset over an exhausted underlying iter;
+* ACCEPTANCE (ISSUE 9): per-replica batches land pre-sharded on the
+  mesh — the device buffers' sharding equals ``Trainer.shard_batch``'s
+  NamedSharding, with no host-side gather.
+"""
+import os
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import recordio, resilience, telemetry
+from mxtpu.base import MXNetError
+from mxtpu.io import NDArrayIter, PrefetchingIter
+from mxtpu.io.stream import (DevicePrefetcher, ShardedRecordReader,
+                             StreamRecordIter, shard_keys)
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for var in ("MXTPU_FAULT_INJECT", "MXTPU_PREFETCH_DEPTH",
+                "MXTPU_STREAM_THREADS", "MXTPU_DL_WORKER_RESTARTS"):
+        monkeypatch.delenv(var, raising=False)
+    resilience.reset_faults()
+    telemetry.reset()
+    yield
+    resilience.reset_faults()
+    telemetry.reset()
+
+
+def _write_rec(tmp_path, n=23, shape=(3, 4, 4), name="s"):
+    rec = str(tmp_path / (name + ".rec"))
+    idx = str(tmp_path / (name + ".idx"))
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        payload = rng.randint(0, 255, shape).astype(np.uint8)
+        hdr = recordio.IRHeader(0, float(i), i, 0)
+        w.write_idx(i, recordio.pack(hdr, payload.tobytes()))
+    w.close()
+    return rec, idx
+
+
+def _decode(shape):
+    def fn(raw):
+        hdr, payload = recordio.unpack(raw)
+        data = np.frombuffer(payload, np.uint8).reshape(shape) \
+            .astype(np.float32)
+        return data, np.float32(hdr.label)
+    return fn
+
+
+# -------------------------------------------------------------- shard_keys
+def test_shard_keys_deterministic_and_balanced():
+    keys = list(range(23))
+    shards = [shard_keys(keys, 5, i, epoch=3, seed=11) for i in range(5)]
+    again = [shard_keys(keys, 5, i, epoch=3, seed=11) for i in range(5)]
+    assert shards == again                       # same (seed, epoch) => same
+    assert sorted(sum(shards, [])) == keys       # nothing dropped/duplicated
+    sizes = sorted(len(s) for s in shards)
+    assert sizes == [4, 4, 5, 5, 5]              # remainder-balanced
+
+
+def test_shard_keys_epoch_reshuffles_seed_separates():
+    keys = list(range(40))
+    e0 = shard_keys(keys, 1, 0, epoch=0, seed=2)
+    e1 = shard_keys(keys, 1, 0, epoch=1, seed=2)
+    other = shard_keys(keys, 1, 0, epoch=0, seed=3)
+    assert e0 != e1 and e0 != other
+    assert sorted(e0) == sorted(e1) == keys
+    # seed sequence, not seed+epoch arithmetic: (2,1) must not collide (3,0)
+    assert e1 != other
+
+
+def test_shard_keys_no_shuffle_and_validation():
+    keys = list(range(10))
+    assert shard_keys(keys, 3, 0, shuffle=False) == [0, 1, 2, 3]
+    assert shard_keys(keys, 3, 1, shuffle=False) == [4, 5, 6]
+    assert shard_keys(keys, 3, 2, shuffle=False) == [7, 8, 9]
+    with pytest.raises(MXNetError):
+        shard_keys(keys, 0, 0)
+    with pytest.raises(MXNetError):
+        shard_keys(keys, 2, 2)
+
+
+# ------------------------------------------------------------------ read_at
+def test_read_at_matches_sequential_and_keeps_offset(tmp_path):
+    """Positioned reads are byte-identical to the sequential walk — incl.
+    multi-chunk records (payloads containing the magic word) — and do not
+    move the shared cursor (the pread contract)."""
+    path = str(tmp_path / "chunks.rec")
+    records = [b"hello", b"x" * 1000, b"",
+               struct.pack("<I", 0xced7230a) * 3,
+               b"abcd" + struct.pack("<I", 0xced7230a) + b"efgh"]
+    w = recordio._PyWriter(path, "wb")
+    positions = []
+    for r in records:
+        positions.append(w.tell())
+        w.write(r)
+    w.close()
+    r = recordio._PyReader(path)
+    first = r.read()                       # cursor now mid-file
+    assert first == records[0]
+    for pos, want in zip(positions, records):
+        assert r.read_at(pos) == want
+    # the sequential path is untouched by the preads above
+    rest = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        rest.append(rec)
+    assert rest == records[1:]
+    r.close()
+
+
+def test_pread_idx_concurrent_shared_handle(tmp_path):
+    """Many threads pread the same open MXIndexedRecordIO with no seek
+    races — every thread sees every record intact."""
+    rec, idx = _write_rec(tmp_path, n=40)
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    expected = {k: r.read_idx(k) for k in r.keys}
+    errors = []
+
+    def hammer(seed):
+        rng = np.random.RandomState(seed)
+        for _ in range(120):
+            k = int(rng.randint(0, 40))
+            if r.pread_idx(k) != expected[k]:
+                errors.append(k)
+
+    threads = [threading.Thread(target=hammer, args=(s,)) for s in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    r.close()
+    assert not errors
+
+
+# ------------------------------------------------------ ShardedRecordReader
+def test_reader_same_seed_identical_streams(tmp_path):
+    rec, _ = _write_rec(tmp_path)
+    a = list(ShardedRecordReader(rec, batch_size=4, decode_fn=_decode(
+        (3, 4, 4)), seed=5))
+    b = list(ShardedRecordReader(rec, batch_size=4, decode_fn=_decode(
+        (3, 4, 4)), seed=5))
+    assert len(a) == len(b) == 6                # 23 records, keep tail
+    for (d1, l1), (d2, l2) in zip(a, b):
+        np.testing.assert_array_equal(d1, d2)
+        np.testing.assert_array_equal(l1, l2)
+
+
+def test_reader_epoch_reshuffles_and_inline_matches_pool(tmp_path):
+    rec, _ = _write_rec(tmp_path)
+    rd = ShardedRecordReader(rec, batch_size=4,
+                             decode_fn=_decode((3, 4, 4)), seed=5)
+    e0 = list(rd)
+    assert rd.epoch == 1                         # full consumption advances
+    e1 = list(rd)
+    labels0 = np.concatenate([b[1] for b in e0])
+    labels1 = np.concatenate([b[1] for b in e1])
+    assert not np.array_equal(labels0, labels1)  # epoch boundary reshuffled
+    np.testing.assert_array_equal(np.sort(labels0), np.sort(labels1))
+    # inline (num_threads=0) is the same stream as the pool
+    inline = ShardedRecordReader(rec, batch_size=4,
+                                 decode_fn=_decode((3, 4, 4)), seed=5,
+                                 num_threads=0)
+    for (d1, l1), (d2, l2) in zip(e0, inline):
+        np.testing.assert_array_equal(d1, d2)
+        np.testing.assert_array_equal(l1, l2)
+
+
+def test_reader_set_epoch_resume_replays(tmp_path):
+    """Resume contract: a fresh reader pinned at epoch e replays the run's
+    epoch-e stream exactly (what a restored loop needs)."""
+    rec, _ = _write_rec(tmp_path)
+    rd = ShardedRecordReader(rec, batch_size=4,
+                             decode_fn=_decode((3, 4, 4)), seed=9)
+    list(rd)                                     # epoch 0 consumed
+    second = list(rd)                            # epoch 1
+    fresh = ShardedRecordReader(rec, batch_size=4,
+                                decode_fn=_decode((3, 4, 4)), seed=9)
+    fresh.set_epoch(1)
+    for (d1, l1), (d2, l2) in zip(second, fresh):
+        np.testing.assert_array_equal(d1, d2)
+        np.testing.assert_array_equal(l1, l2)
+
+
+def test_reader_shards_cover_exactly_non_dividing(tmp_path):
+    """num_shards=3 over 23 records: per-epoch union across shards is
+    every record exactly once, shard sizes differ by <= 1."""
+    rec, _ = _write_rec(tmp_path)
+    seen = []
+    sizes = []
+    for s in range(3):
+        rd = ShardedRecordReader(rec, batch_size=4,
+                                 decode_fn=_decode((3, 4, 4)),
+                                 num_shards=3, shard_index=s, seed=4)
+        labels = np.concatenate([b[1] for b in rd])
+        sizes.append(len(labels))
+        seen.append(labels)
+    assert max(sizes) - min(sizes) <= 1
+    allseen = np.sort(np.concatenate(seen))
+    np.testing.assert_array_equal(allseen, np.arange(23, dtype=np.float32))
+
+
+def test_reader_last_batch_discard(tmp_path):
+    rec, _ = _write_rec(tmp_path)
+    rd = ShardedRecordReader(rec, batch_size=4,
+                             decode_fn=_decode((3, 4, 4)), seed=1,
+                             last_batch="discard")
+    batches = list(rd)
+    assert len(batches) == len(rd) == 5          # 23 // 4
+    assert all(b[0].shape[0] == 4 for b in batches)
+
+
+def test_reader_worker_death_recovers_identically(tmp_path, monkeypatch):
+    """An injected silent worker death restarts the pool worker under the
+    budget and the delivered stream is identical to an undisturbed run."""
+    rec, _ = _write_rec(tmp_path)
+    clean = list(ShardedRecordReader(rec, batch_size=4,
+                                     decode_fn=_decode((3, 4, 4)), seed=2))
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "worker_death@2")
+    injected = list(ShardedRecordReader(rec, batch_size=4,
+                                        decode_fn=_decode((3, 4, 4)),
+                                        seed=2))
+    assert resilience.FAULT_STATS["fired"] == [("worker_death", 2)]
+    assert telemetry.value("stream.worker_restarts") >= 1
+    for (d1, l1), (d2, l2) in zip(clean, injected):
+        np.testing.assert_array_equal(d1, d2)
+        np.testing.assert_array_equal(l1, l2)
+
+
+def test_reader_worker_death_budget_exhausted(tmp_path, monkeypatch):
+    rec, _ = _write_rec(tmp_path)
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "worker_death@0")
+    monkeypatch.setenv("MXTPU_DL_WORKER_RESTARTS", "0")
+    rd = ShardedRecordReader(rec, batch_size=4,
+                             decode_fn=_decode((3, 4, 4)), seed=2)
+    with pytest.raises(RuntimeError, match="giving up after"):
+        list(rd)
+
+
+def test_reader_decode_error_surfaces_with_batch_index(tmp_path):
+    rec, _ = _write_rec(tmp_path)
+
+    def bad(raw):
+        raise ValueError("boom")
+
+    rd = ShardedRecordReader(rec, batch_size=4, decode_fn=bad, seed=2)
+    with pytest.raises(RuntimeError, match="failed at batch 0"):
+        list(rd)
+
+
+# --------------------------------------------------------- DevicePrefetcher
+def test_prefetcher_parity_and_telemetry():
+    src = [(np.full((4, 3), float(i)), np.full((4,), float(i)))
+           for i in range(7)]
+    pf = DevicePrefetcher(iter(src), depth=2)
+    got = list(pf)
+    pf.close()
+    assert len(got) == 7
+    for i, (d, l) in enumerate(got):
+        assert isinstance(d, mx.nd.NDArray) and isinstance(l, mx.nd.NDArray)
+        np.testing.assert_array_equal(d.asnumpy(), src[i][0])
+        np.testing.assert_array_equal(l.asnumpy(), src[i][1])
+    snap = telemetry.snapshot()
+    assert snap["histograms"]["data.h2d"]["count"] == 7
+    assert snap["gauges"]["data.prefetch_depth"] == 2
+
+
+def test_prefetcher_starvation_is_counted_and_waited():
+    """data.wait measures TRUE starvation: a consumer blocked on an empty
+    buffer counts (and only then does data.starved move)."""
+    gate = threading.Event()
+
+    def slow():
+        for i in range(2):
+            gate.wait(timeout=10)
+            gate.clear()
+            yield np.full((2,), float(i))
+
+    pf = DevicePrefetcher(slow())
+    out = []
+    t = threading.Thread(target=lambda: out.append(next(pf)))
+    t.start()
+    deadline = time.perf_counter() + 10
+    while telemetry.value("data.starved") < 1:   # consumer provably blocked
+        assert time.perf_counter() < deadline
+        time.sleep(0.005)
+    gate.set()                                   # now let the producer feed
+    t.join(timeout=10)
+    assert out and float(out[0].asnumpy()[0]) == 0.0
+    assert telemetry.snapshot()["histograms"]["data.wait"]["count"] >= 1
+    gate.set()   # release the producer's NEXT pull so close joins instantly
+    pf.close()
+    assert not pf._thread.is_alive()
+
+
+def test_prefetcher_worker_death_restart_loses_nothing(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "prefetch_death@1")
+    src = [np.full((2,), float(i)) for i in range(5)]
+    pf = DevicePrefetcher(iter(src))
+    vals = [float(v.asnumpy()[0]) for v in pf]
+    pf.close()
+    assert vals == [0.0, 1.0, 2.0, 3.0, 4.0]
+    assert telemetry.value("data.prefetch_restarts") == 1
+
+
+def test_prefetcher_worker_death_budget_exhausted(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "prefetch_death@0")
+    monkeypatch.setenv("MXTPU_DL_WORKER_RESTARTS", "0")
+    pf = DevicePrefetcher(iter([np.zeros(2)]))
+    with pytest.raises(RuntimeError, match="giving up after"):
+        list(pf)
+    pf.close()
+
+
+def test_prefetcher_source_error_raises_at_consumer():
+    def src():
+        yield np.zeros(2)
+        raise ValueError("decode exploded")
+
+    pf = DevicePrefetcher(src())
+    next(pf)
+    with pytest.raises(ValueError, match="decode exploded"):
+        next(pf)
+    pf.close()
+
+
+def test_prefetcher_concurrent_close_unblocks_consumer_cleanly():
+    """close() from another thread while a consumer is blocked on a slow
+    source ends the stream as StopIteration — never a spurious
+    worker-death restart or a fake 'worker died' RuntimeError."""
+    gate = threading.Event()
+
+    def slow():
+        gate.wait(timeout=10)
+        yield np.zeros(2)
+
+    pf = DevicePrefetcher(slow())
+    result = {}
+
+    def consume():
+        try:
+            next(pf)
+            result["out"] = "item"
+        except StopIteration:
+            result["out"] = "stop"
+        except RuntimeError as e:
+            result["out"] = e
+
+    t = threading.Thread(target=consume)
+    t.start()
+    deadline = time.perf_counter() + 10
+    while telemetry.value("data.starved") < 1:   # consumer provably blocked
+        assert time.perf_counter() < deadline
+        time.sleep(0.005)
+    closer = threading.Thread(target=pf.close)
+    closer.start()           # producer still parked inside the source
+    t.join(timeout=10)       # consumer must unblock WITHOUT the producer
+    assert result["out"] == "stop"
+    gate.set()               # now release the producer so close joins fast
+    closer.join(timeout=10)
+    assert not closer.is_alive()
+    assert telemetry.value("data.prefetch_restarts") == 0
+
+
+def test_prefetcher_depth_zero_clamps_instead_of_hanging():
+    """An explicit depth=0 must clamp to 1: a zero-capacity buffer makes
+    the producer's backpressure check permanently true — it never
+    produces, never dies, and the consumer would hang forever."""
+    pf = DevicePrefetcher(iter([np.zeros(2), np.ones(2)]), depth=0)
+    got = list(pf)
+    pf.close()
+    assert len(got) == 2
+    assert pf._depth == 1
+
+
+def test_prefetcher_mid_epoch_close_is_bounded_and_cleans_source():
+    """close() mid-epoch: wakes a producer blocked on a full buffer,
+    joins within the timeout, and runs a generator source's finally."""
+    cleaned = []
+
+    def src():
+        try:
+            for i in range(1000):
+                yield np.full((2,), float(i))
+        finally:
+            cleaned.append(True)
+
+    pf = DevicePrefetcher(src(), depth=2)
+    next(pf)                                     # pipeline is flowing
+    t0 = time.perf_counter()
+    pf.close()
+    assert time.perf_counter() - t0 < 5.0
+    assert cleaned == [True]
+    assert not pf._thread.is_alive()
+
+
+# ----------------------------------------------------------- PrefetchingIter
+def _collect_batches(it):
+    out = []
+    for b in it:
+        out.append((b.data[0].asnumpy().copy(),
+                    b.label[0].asnumpy().copy() if b.label else None))
+    return out
+
+
+def test_prefetching_iter_equivalence_and_exhausted_reset():
+    """The old implementation could deadlock in reset() once the
+    underlying iter was exhausted (worker parked on an event never set
+    again); the DevicePrefetcher delegation joins with a timeout."""
+    x = np.arange(80, dtype=np.float32).reshape(20, 4)
+    y = np.arange(20, dtype=np.float32)
+    base_it = NDArrayIter(x, y, batch_size=5)
+    base = _collect_batches(base_it)
+    base_it.reset()
+    p = PrefetchingIter(base_it)
+    assert [d.name for d in p.provide_data] == ["data"]
+    got = _collect_batches(p)
+    assert len(got) == len(base)
+    for (d1, l1), (d2, l2) in zip(base, got):
+        np.testing.assert_array_equal(d1, d2)
+        np.testing.assert_array_equal(l1, l2)
+    for _ in range(2):                           # reset over EXHAUSTED iter
+        p.reset()
+        assert len(_collect_batches(p)) == len(base)
+    p.reset()                                    # mid-epoch reset too
+    p.next()
+    p.reset()
+    assert len(_collect_batches(p)) == len(base)
+    p.close()
+
+
+def test_prefetching_iter_multi_iter_merge_and_renames():
+    x1 = np.arange(12, dtype=np.float32).reshape(12, 1)
+    x2 = np.arange(12, 24, dtype=np.float32).reshape(12, 1)
+    p = PrefetchingIter(
+        [NDArrayIter(x1, batch_size=4), NDArrayIter(x2, batch_size=4)],
+        rename_data=[{"data": "a"}, {"data": "b"}])
+    assert [d.name for d in p.provide_data] == ["a", "b"]
+    n = 0
+    for b in p:
+        assert len(b.data) == 2
+        np.testing.assert_array_equal(b.data[1].asnumpy(),
+                                      b.data[0].asnumpy() + 12)
+        n += 1
+    assert n == 3
+    p.close()
+
+
+def test_prefetching_iter_multi_iter_single_h2d_and_error_cleanup():
+    """Multi-iter sub stages buffer on the HOST (the one H2D belongs to
+    the outer stage — no double transfer), and a failing sub-iterator
+    must not leak the OTHER iterator's sub producer through reset()."""
+    x1 = np.arange(12, dtype=np.float32).reshape(12, 1)
+    telemetry.reset()
+    p = PrefetchingIter([NDArrayIter(x1, batch_size=4),
+                         NDArrayIter(x1 + 12, batch_size=4)])
+    n = sum(1 for _ in p)
+    assert n == 3
+    snap = telemetry.snapshot()["histograms"]
+    # outer stage transferred each merged batch once; subs stayed host
+    assert snap["data.h2d"]["count"] == 3
+    assert "data.sub.h2d" not in snap
+    p.close()
+
+    class Exploding(NDArrayIter):
+        def next(self):
+            raise ValueError("sub iter exploded")
+
+    p2 = PrefetchingIter([Exploding(x1, batch_size=4),
+                          NDArrayIter(x1, batch_size=4)])
+    deadline = time.perf_counter() + 10   # outer producer dies on the error
+    while p2._prefetcher._thread.is_alive():
+        assert time.perf_counter() < deadline
+        time.sleep(0.005)
+    with pytest.raises(ValueError, match="sub iter exploded"):
+        p2.reset()   # pending producer error surfaces; subs closed anyway
+    for sub in p2._sub:
+        assert not sub._thread.is_alive()
+    p2.close()
+
+
+def test_prefetching_iter_worker_error_reraised():
+    class Exploding(NDArrayIter):
+        def next(self):
+            b = super().next()
+            if self._cursor == 1:
+                raise ValueError("iterator exploded")
+            return b
+
+    it = Exploding(np.zeros((12, 2), np.float32), batch_size=4)
+    p = PrefetchingIter(it)
+    with pytest.raises(ValueError, match="iterator exploded"):
+        _collect_batches(p)
+    p.close()
+
+
+# ------------------------------------------------------- DataLoader wiring
+def test_dataloader_prefetch_to_device_parity():
+    from mxtpu.gluon.data import ArrayDataset, DataLoader
+    x = np.arange(80, dtype=np.float32).reshape(20, 4)
+    y = np.arange(20, dtype=np.float32)
+    ds = ArrayDataset(x, y)
+    serial = [b[0].asnumpy() for b in DataLoader(ds, batch_size=4)]
+    telemetry.reset()
+    dl = DataLoader(ds, batch_size=4, prefetch_to_device=True)
+    for epoch in range(2):                       # re-iteration works
+        got = list(dl)
+        assert len(got) == len(serial)
+        for s, g in zip(serial, got):
+            assert isinstance(g[0], mx.nd.NDArray)
+            np.testing.assert_array_equal(s, g[0].asnumpy())
+    snap = telemetry.snapshot()
+    # the prefetcher owns the telemetry: one h2d per batch, and data.wait
+    # now measures only starvation (present, but not decode-sized)
+    assert snap["histograms"]["data.h2d"]["count"] == 2 * len(serial)
+    assert "data.wait" in snap["histograms"]
+
+
+def test_dataloader_prefetch_accepts_ndarray_samples():
+    """A dataset yielding NDArray samples must keep working on the
+    in-process paths with prefetch ON (the numpy-only batchify belongs
+    to the mp worker pool alone)."""
+    from mxtpu.gluon.data import DataLoader, SimpleDataset
+    ds = SimpleDataset([mx.nd.array(np.full((3,), float(i)))
+                        for i in range(8)])
+    serial = [b.asnumpy() for b in DataLoader(ds, batch_size=4)]
+    for kwargs in ({}, {"num_workers": 2, "thread_pool": True}):
+        dl = DataLoader(ds, batch_size=4, prefetch_to_device=True, **kwargs)
+        got = [b for b in dl]
+        assert all(isinstance(g, mx.nd.NDArray) for g in got)
+        for s, g in zip(serial, got):
+            np.testing.assert_array_equal(s, g.asnumpy())
+
+
+def test_dataloader_prefetch_with_worker_pool():
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _mp_light_datasets import PlainArrayPairDataset
+
+    from mxtpu.gluon.data import DataLoader
+    ds = PlainArrayPairDataset(n=24)
+    serial = [b[0].asnumpy() for b in DataLoader(ds, batch_size=4)]
+    dl = DataLoader(ds, batch_size=4, num_workers=2,
+                    prefetch_to_device=True)
+    got = [b[0] for b in dl]
+    dl.close()
+    assert all(isinstance(g, mx.nd.NDArray) for g in got)
+    for s, g in zip(serial, got):
+        np.testing.assert_array_equal(s, g.asnumpy())
+
+
+# ------------------------------------------------------------ StreamRecordIter
+def test_stream_record_iter_protocol_and_epochs(tmp_path):
+    rec, _ = _write_rec(tmp_path)
+    it = StreamRecordIter(rec, batch_size=4, decode_fn=_decode((3, 4, 4)),
+                          seed=3)
+    assert it.provide_data[0].shape == (4, 3, 4, 4)
+    assert it.provide_label[0].shape == (4,)
+    e0 = []
+    for b in it:
+        assert isinstance(b.data[0], mx.nd.NDArray)
+        e0.append(b.label[0].asnumpy().copy())
+    assert len(e0) == 6 and e0[-1].shape == (3,)  # keep tail, pad reported
+    it.reset()
+    e1 = [b.label[0].asnumpy().copy() for b in it]
+    assert not all(np.array_equal(a, b) for a, b in zip(e0, e1))
+    np.testing.assert_array_equal(np.sort(np.concatenate(e0)),
+                                  np.sort(np.concatenate(e1)))
+    it.close()
+
+
+@pytest.mark.parametrize("consume", [1, 5])
+def test_stream_record_iter_mid_epoch_reset_replays(tmp_path, consume):
+    """reset() after a mid-epoch abandon replays the SAME epoch — even
+    one batch from the end, where the prefetcher's read-ahead has already
+    exhausted the reader generator producer-side (the replay contract is
+    consumer-driven, not depth-dependent)."""
+    rec, _ = _write_rec(tmp_path)
+    it = StreamRecordIter(rec, batch_size=4, decode_fn=_decode((3, 4, 4)),
+                          seed=3)
+    full = [b.label[0].asnumpy().copy() for b in it]
+    it.close()
+    it2 = StreamRecordIter(rec, batch_size=4, decode_fn=_decode((3, 4, 4)),
+                           seed=3)
+    for _ in range(consume):                     # abandon mid-epoch
+        it2.next()
+    it2.reset()                                  # replays the SAME epoch
+    replay = [b.label[0].asnumpy().copy() for b in it2]
+    assert len(replay) == len(full)
+    for a, b in zip(full, replay):
+        np.testing.assert_array_equal(a, b)
+    it2.close()
+
+
+@pytest.mark.parametrize("kind,reader_hits", [("worker_death", True),
+                                              ("prefetch_death", False)])
+def test_composed_pipeline_fault_routing_is_deterministic(
+        tmp_path, monkeypatch, kind, reader_hits):
+    """In the composed pipeline (reader pool UNDER a prefetcher) each
+    fault kind fires in exactly its own stage — never scheduling-
+    dependent — and the stream still completes identically."""
+    rec, _ = _write_rec(tmp_path)
+    clean = [b.label[0].asnumpy().copy()
+             for b in StreamRecordIter(rec, batch_size=4,
+                                       decode_fn=_decode((3, 4, 4)),
+                                       seed=2)]
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "%s@1" % kind)
+    it = StreamRecordIter(rec, batch_size=4, decode_fn=_decode((3, 4, 4)),
+                          seed=2)
+    got = [b.label[0].asnumpy().copy() for b in it]
+    it.close()
+    for a, b in zip(clean, got):
+        np.testing.assert_array_equal(a, b)
+    assert resilience.FAULT_STATS["fired"] == [(kind, 1)]
+    if reader_hits:
+        assert telemetry.value("stream.worker_restarts") >= 1
+        assert telemetry.value("data.prefetch_restarts") == 0
+    else:
+        assert telemetry.value("data.prefetch_restarts") == 1
+        assert telemetry.value("stream.worker_restarts") == 0
+
+
+def test_stream_record_iter_requires_decode_fn(tmp_path):
+    """No decode_fn AND no batchify_fn = raw bytes with no shape to form
+    a DataBatch from — refused loudly at construction, not an
+    AttributeError from the producer thread later."""
+    rec, _ = _write_rec(tmp_path)
+    with pytest.raises(MXNetError, match="decode_fn"):
+        StreamRecordIter(rec, batch_size=4)
+
+
+def test_stream_threads_env_zero_selects_inline(tmp_path, monkeypatch):
+    """MXTPU_STREAM_THREADS=0 honors the inline synchronous path, same
+    as the num_threads=0 argument (the A/B baseline contract)."""
+    monkeypatch.setenv("MXTPU_STREAM_THREADS", "0")
+    rec, _ = _write_rec(tmp_path)
+    rd = ShardedRecordReader(rec, batch_size=4,
+                             decode_fn=_decode((3, 4, 4)), seed=5)
+    assert rd.num_threads == 0
+    assert len(list(rd)) == 6
+
+
+@pytest.mark.parametrize("prefetch", [True, False])
+def test_stream_record_iter_step_counted_epochs_progress(tmp_path, prefetch):
+    """A step-counted loop (`for _ in range(len(it)): it.next()`) never
+    observes StopIteration, but it consumed the whole epoch — reset()
+    must PROGRESS the shuffle (full consumption is judged by delivered
+    batches), not replay the same order forever."""
+    rec, _ = _write_rec(tmp_path)
+    it = StreamRecordIter(rec, batch_size=4, decode_fn=_decode((3, 4, 4)),
+                          seed=3, prefetch_to_device=prefetch)
+    def epoch_labels():
+        out = []
+        for _ in range(len(it._reader)):
+            b = it.next()
+            l = b.label[0]
+            out.append(l.asnumpy().copy() if hasattr(l, "asnumpy") else
+                       np.array(l))
+        it.reset()
+        return np.concatenate(out)
+    e0, e1 = epoch_labels(), epoch_labels()
+    assert not np.array_equal(e0, e1)            # reshuffled, not replayed
+    np.testing.assert_array_equal(np.sort(e0), np.sort(e1))
+    it.close()
+
+
+def test_stream_record_iter_host_mode(tmp_path):
+    """prefetch_to_device=False means HOST batches: numpy leaves, no
+    producer thread, no device placement."""
+    rec, _ = _write_rec(tmp_path)
+    it = StreamRecordIter(rec, batch_size=4, decode_fn=_decode((3, 4, 4)),
+                          seed=3, prefetch_to_device=False)
+    dev = StreamRecordIter(rec, batch_size=4, decode_fn=_decode((3, 4, 4)),
+                           seed=3)
+    n = 0
+    for hb, db in zip(it, dev):
+        assert isinstance(hb.data[0], np.ndarray)      # host numpy
+        assert isinstance(db.data[0], mx.nd.NDArray)   # device twin
+        np.testing.assert_array_equal(hb.data[0], db.data[0].asnumpy())
+        n += 1
+    assert n == 6
+    it.reset()                                   # host path resets too
+    assert sum(1 for _ in it) == 6
+    it.close()
+    dev.close()
+
+
+# -------------------------------------------------- mesh acceptance pins
+@pytest.mark.multidevice
+def test_prefetched_batches_land_on_trainer_sharding(tmp_path):
+    """ISSUE 9 acceptance: per-replica batches land PRE-SHARDED on the
+    mesh — the prefetched device buffers' sharding equals
+    Trainer.shard_batch's NamedSharding (no host-side gather), down to
+    identical per-device shards."""
+    import jax
+
+    from mxtpu import gluon
+    from mxtpu.gluon import nn
+    from mxtpu.parallel import make_mesh
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device mesh")
+    net = nn.Dense(4)
+    net.initialize()
+    net(mx.nd.array(np.ones((8, 6), np.float32)))
+    mesh = make_mesh({"data": len(jax.devices())})
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, mesh=mesh)
+    ref_sh = tr.batch_sharding
+    assert ref_sh is not None
+
+    n = len(jax.devices())
+    src = [(np.arange(8 * 6, dtype=np.float32).reshape(8, 6) + i,
+            np.arange(8, dtype=np.float32)) for i in range(3)]
+    pf = DevicePrefetcher(iter(src), sharding=tr)
+    got = list(pf)
+    pf.close()
+    for i, (d, l) in enumerate(got):
+        ref = tr.shard_batch(mx.nd.array(src[i][0]))
+        assert d._data.sharding == ref._data.sharding == ref_sh
+        assert l._data.sharding == ref_sh
+        shard_shapes = {s.data.shape for s in d._data.addressable_shards}
+        assert shard_shapes == {(8 // n, 6)}     # pre-sharded, no gather
+        np.testing.assert_array_equal(d.asnumpy(), src[i][0])
+
+
+@pytest.mark.multidevice
+def test_dataloader_and_stream_iter_mesh_path(tmp_path):
+    """Both front doors — DataLoader(prefetch_to_device=trainer) and
+    StreamRecordIter(sharding=trainer) — deliver mesh-sharded batches."""
+    import jax
+
+    from mxtpu import gluon
+    from mxtpu.gluon import nn
+    from mxtpu.gluon.data import ArrayDataset, DataLoader
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device mesh")
+    from mxtpu.parallel import make_mesh
+    net = nn.Dense(2)
+    net.initialize()
+    net(mx.nd.array(np.ones((8, 3), np.float32)))
+    mesh = make_mesh({"data": len(jax.devices())})
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, mesh=mesh)
+    sh = tr.batch_sharding
+
+    x = np.arange(48, dtype=np.float32).reshape(16, 3)
+    y = np.arange(16, dtype=np.float32)
+    dl = DataLoader(ArrayDataset(x, y), batch_size=8,
+                    prefetch_to_device=tr)
+    for d, l in dl:
+        assert d._data.sharding == sh and l._data.sharding == sh
+
+    rec, _ = _write_rec(tmp_path, n=24, shape=(3,), name="mesh")
+    it = StreamRecordIter(rec, batch_size=8, decode_fn=_decode((3,)),
+                          seed=0, sharding=tr, last_batch="discard")
+    count = 0
+    for b in it:
+        assert b.data[0]._data.sharding == sh
+        count += 1
+    assert count == 3
+    it.close()

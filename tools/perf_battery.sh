@@ -108,4 +108,15 @@ else
   echo "replica serving skipped: single device" | tee -a "$LOG"
 fi
 
+# 6. input pipeline phase (ISSUE 9): device-resident streaming reader +
+#    double-buffered prefetch-to-device vs the synchronous loop — batches/s
+#    and the data.wait fraction both ways (gate: parity + wait-frac drop;
+#    vs_baseline = overlapped speedup where the host has cores to overlap
+#    on). Host work dominates, so this phase is chip-safe even when the
+#    tunnel is suspect.
+sleep 60
+timeout 600 env BENCH_CONFIG=input_pipeline BENCH_PREFLIGHT=0 \
+  python bench.py 2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
+telemetry_report
+
 echo "battery complete -> $LOG"
