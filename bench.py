@@ -570,19 +570,22 @@ def bench_telemetry_overhead(emit=None):
     """Telemetry layer cost (mxtpu/telemetry.py): steps/s with
     MXTPU_TELEMETRY=1 (step-phase spans + event ring + watchdog counter
     reads) vs 0, for the ``optimizer_step`` hot path and a small-resnet
-    Trainer loop — the same shapes guard_overhead measures. One JSON line
-    per (config, telemetry) plus a summary whose value is the worst
-    overhead fraction; the ISSUE-4 acceptance bound is <1%
-    (``vs_baseline`` = 0.01 / worst, so >=1.0 means the layer fits).
-    BENCH_TELEMETRY_CONFIGS selects subsets.
+    Trainer loop — the same shapes guard_overhead measures. ISSUE 10
+    adds a third mode, ``trace`` (MXTPU_TELEMETRY=1 + MXTPU_TRACE=1):
+    per-step trace contexts, span-id allocation, and the flight-recorder
+    ring append, held to the SAME <1% budget. One JSON line per
+    (config, mode) plus a summary whose value is the worst overhead
+    fraction across modes (``vs_baseline`` = 0.01 / worst, so >=1.0
+    means the layer fits). BENCH_TELEMETRY_CONFIGS selects subsets.
 
-    Methodology: ONE workload per config, then off/on timings ALTERNATE
-    over BENCH_TELEMETRY_ROUNDS rounds and each mode takes its MEDIAN
-    rate — a single off-then-on pair measures host frequency/cache
+    Methodology: ONE workload per config, then off/on/trace timings
+    ALTERNATE over BENCH_TELEMETRY_ROUNDS rounds and each mode takes its
+    MEDIAN rate — a single off-then-on pair measures host frequency/cache
     warmup drift instead of the ~8 us/step the three spans actually cost
-    (measured: the span path is ~2.7 us each; per-rep spread on a shared
-    CPU host is +-10%, so the summary also carries ``noise_frac`` and the
-    <1% budget is judged on the low-variance TPU tier)."""
+    (measured: the span path is ~2.7 us each, the trace layer adds
+    ~1 us/span on a CPU host; per-rep spread on a shared CPU host is
+    +-10%, so the summary also carries ``noise_frac`` and the <1% budget
+    is judged on the low-variance TPU tier)."""
     if emit is None:
         emit = _emit
     which = [c.strip() for c in os.environ.get(
@@ -596,41 +599,52 @@ def bench_telemetry_overhead(emit=None):
             "BENCH_TELEMETRY_CONFIGS=%r: expected a non-empty comma list "
             "from %s"
             % (os.environ.get("BENCH_TELEMETRY_CONFIGS"), sorted(makers)))
+    # mode -> (MXTPU_TELEMETRY, MXTPU_TRACE); "1" pins trace OFF so the
+    # two levers' costs stay separately attributable
+    modes = {"0": ("0", "0"), "1": ("1", "0"), "trace": ("1", "1")}
     prev = os.environ.get("MXTPU_TELEMETRY")
+    prev_trace = os.environ.get("MXTPU_TRACE")
     overheads = {}
+    trace_overheads = {}
     noise = {}
     try:
         for cname in which:
             step_fn, sync = makers[cname](None)
-            step_fn()  # warmup + compile (shared: one workload, both modes)
+            step_fn()  # warmup + compile (shared: one workload, all modes)
             sync()
-            rates = {"0": [], "1": []}
+            rates = {m: [] for m in modes}
             for _ in range(rounds):
-                for tel in ("0", "1"):
+                for mode, (tel, trace) in modes.items():
                     os.environ["MXTPU_TELEMETRY"] = tel
+                    os.environ["MXTPU_TRACE"] = trace
                     t0 = time.perf_counter()
                     for _ in range(steps):
                         step_fn()
                     sync()
-                    rates[tel].append(steps / (time.perf_counter() - t0))
-            med = {tel: float(np.median(rs)) for tel, rs in rates.items()}
-            for tel in ("0", "1"):
+                    rates[mode].append(steps / (time.perf_counter() - t0))
+            med = {m: float(np.median(rs)) for m, rs in rates.items()}
+            for mode in modes:
                 emit({"metric": "telemetry_overhead_%s" % cname,
-                      "telemetry": "on" if tel == "1" else "off",
-                      "value": round(med[tel], 2), "unit": "steps/sec",
-                      "rounds": [round(r, 2) for r in rates[tel]]})
+                      "telemetry": {"0": "off", "1": "on",
+                                    "trace": "trace"}[mode],
+                      "value": round(med[mode], 2), "unit": "steps/sec",
+                      "rounds": [round(r, 2) for r in rates[mode]]})
             overheads[cname] = med["0"] / med["1"] - 1.0
-            all_r = rates["0"] + rates["1"]
+            trace_overheads[cname] = med["0"] / med["trace"] - 1.0
+            all_r = [r for rs in rates.values() for r in rs]
             noise[cname] = (max(all_r) - min(all_r)) / med["0"]
             emit({"metric": "telemetry_overhead_%s" % cname,
                   "overhead_frac": round(overheads[cname], 4),
+                  "trace_overhead_frac": round(trace_overheads[cname], 4),
                   "noise_frac": round(noise[cname], 4)})
     finally:
-        if prev is None:
-            os.environ.pop("MXTPU_TELEMETRY", None)
-        else:
-            os.environ["MXTPU_TELEMETRY"] = prev
-    worst = max(overheads.values())
+        for var, old in (("MXTPU_TELEMETRY", prev),
+                         ("MXTPU_TRACE", prev_trace)):
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
+    worst = max(list(overheads.values()) + list(trace_overheads.values()))
     return {
         "metric": "telemetry_overhead",
         "value": round(worst, 4),
@@ -642,6 +656,8 @@ def bench_telemetry_overhead(emit=None):
         "mfu": None,
         "hfu": None,
         "per_config": {k: round(v, 4) for k, v in overheads.items()},
+        "per_config_trace": {k: round(v, 4)
+                             for k, v in trace_overheads.items()},
         "noise_frac": {k: round(v, 4) for k, v in noise.items()},
     }
 

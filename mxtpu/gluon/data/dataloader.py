@@ -148,11 +148,14 @@ class DataLoader:
             # blocked on the input pipeline before each batch (span
             # "data.wait" in telemetry/profiler.dump — the host-side
             # analog of the reference profiler's engine queue time)
-            with telemetry.span("data.wait"):
+            with telemetry.span("data.wait", new_trace=True) as sp:
                 try:
                     batch = next(it)
                 except StopIteration:
                     return
+            # pend the wait for the consuming step's trace to link
+            # (telemetry.link_pending inside Trainer.step)
+            telemetry.pend_link("data.wait", sp.ctx)
             yield batch
 
     def _iter_impl(self):

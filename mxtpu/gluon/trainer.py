@@ -255,11 +255,24 @@ class Trainer:
         timers, zero device work, so the zero-sync contract above holds
         with telemetry enabled. The outer span tracks the d2h counter:
         a device->host sync inside a steady-state step trips the transfer
-        watchdog."""
+        watchdog.
+
+        Causal tracing (MXTPU_TRACE, default on): each step is a trace
+        ROOT — allreduce/update nest as children, and the input
+        pipeline's ``data.wait``/``data.h2d`` events for the batch this
+        step consumes (recorded on the loader/prefetch-producer threads,
+        pended at hand-over) attach as cross-thread links, so a slow step
+        is attributable to data vs compute from one tree. All of it is
+        host bookkeeping: the d2h==0 contract holds with tracing ON
+        (pinned by the transfer-guard test parametrized over MXTPU_TRACE)."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
-        with telemetry.span("trainer.step", d2h=True):
+        with telemetry.span("trainer.step", d2h=True, new_trace=True):
+            # attach the producer-thread data events (data.wait/data.h2d
+            # pended by the loader when it handed this batch over) to
+            # THIS step's trace as causal links
+            telemetry.link_pending()
             with telemetry.span("trainer.step.allreduce"):
                 self._allreduce_grads()
             with telemetry.span("trainer.step.update"):

@@ -477,14 +477,21 @@ class DevicePrefetcher:
                 except StopIteration:
                     break
                 if self._put:
-                    with telemetry.span("%s.h2d" % self._site):
+                    # the transfer gets its own trace on THIS (producer)
+                    # thread; its context rides the buffer entry so the
+                    # CONSUMER pends it — the training step that eats
+                    # this batch links the h2d that produced it
+                    with telemetry.span("%s.h2d" % self._site,
+                                        new_trace=True) as sp:
                         item = self._to_device(batch)
+                    h2d_ctx = sp.ctx
                 else:
                     item = batch  # host-only stage: no device placement
+                    h2d_ctx = None
                 with self._cv:
                     if self._stopped:
                         return
-                    self._buf.append(item)
+                    self._buf.append((item, h2d_ctx))
                     self._cv.notify_all()
             with self._cv:
                 self._finished = True
@@ -557,7 +564,8 @@ class DevicePrefetcher:
                 not self._stopped
             if starved:
                 telemetry.inc("%s.starved" % self._site)
-            with telemetry.span("%s.wait" % self._site):
+            with telemetry.span("%s.wait" % self._site,
+                                new_trace=True) as wait_sp:
                 while not self._buf and not self._finished and \
                         not self._stopped:
                     if not self._thread.is_alive():
@@ -586,8 +594,13 @@ class DevicePrefetcher:
                     err, self._error = self._error, None
                     raise err
                 raise StopIteration
-            item = self._buf.popleft()
+            item, h2d_ctx = self._buf.popleft()
             self._cv.notify_all()
+            # hand-over: the NEXT trainer.step trace adopts these as
+            # cross-thread causal links (telemetry.link_pending) — the
+            # step that consumes this batch owns its wait + transfer
+            telemetry.pend_link("%s.h2d" % self._site, h2d_ctx)
+            telemetry.pend_link("%s.wait" % self._site, wait_sp.ctx)
             return item
 
     def next(self):

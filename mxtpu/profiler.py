@@ -220,9 +220,14 @@ def dump(finished=True, profile_process="worker"):
     forward/backward/update, data-wait, blocking syncs) are merged in with
     the same event shape and clock (``perf_counter_ns``-derived ts/dur),
     so ONE file shows the host phase timeline alongside the op events —
-    and, with ``profile_xla``, alongside the XLA device trace."""
+    and, with ``profile_xla``, alongside the XLA device trace. Trace-tree
+    causality (parent/child span edges and explicit cross-thread links,
+    ``telemetry.trace_flows``) rides along as chrome flow events
+    (``ph: s/f``), so the timeline shows which thread's work BELONGS to
+    which request/step instead of mere temporal overlap."""
     with _PROF.lock:
         events = list(_PROF.events)
+    flows = []
     try:
         from . import telemetry
         tel = telemetry.events()
@@ -236,12 +241,13 @@ def dump(finished=True, profile_process="worker"):
             tel = [e for e in tel
                    if e[2] >= lo and (hi is None or e[2] <= hi)]
         events = events + tel
+        flows = telemetry.trace_flows(lo, hi)
     except Exception:  # noqa: BLE001 — the op trace must dump regardless
         pass
     trace = {"traceEvents": [
         {"ph": "X", "name": name, "cat": cat, "ts": ts, "dur": dur,
          "pid": 0, "tid": tid}
-        for name, cat, ts, dur, tid in events]}
+        for name, cat, ts, dur, tid in events] + flows}
     with open(_PROF.filename, "w") as f:
         json.dump(trace, f)
 

@@ -137,6 +137,13 @@ def inject(kind, index=None):
     FAULT_STATS["fired"].append((kind, index))
     from . import telemetry
     telemetry.inc("faults.injected", tag=kind)
+    # an injected fault is a flight-recorder trigger: the artifact tags
+    # the trace that owned the faulted call site (if any), so the
+    # post-mortem starts from the affected request/step, not from grep
+    ctx = telemetry.current_trace()
+    telemetry.flight_record(
+        "fault", trace_ids=[ctx.trace_id] if ctx is not None else [],
+        extra={"kind": kind, "index": index})
     _log.warning("fault injected: %s@%d", kind, index)
     return True
 
@@ -437,8 +444,15 @@ class ResilientLoop:
         return False
 
     def _on_signal(self, signum, frame):
-        # handler does the MINIMUM (no IO, no jax): the step boundary acts
+        # handler does the MINIMUM (no IO, no jax): the step boundary acts;
+        # the flight-recorder snapshot (a SIGTERM trigger) runs on its own
+        # daemon thread so the handler stays IO-free
         self.preempted = True
+        import threading
+
+        from . import telemetry
+        threading.Thread(target=telemetry.flight_record, args=("sigterm",),
+                         daemon=True, name="mxtpu-flight-sigterm").start()
 
     # ---------------------------------------------------------------- saving
     def save(self, step, final=False):

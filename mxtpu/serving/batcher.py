@@ -86,14 +86,21 @@ class DeadlineExceeded(MXNetError):
 
 
 class _Future:
-    """Minimal completion handle (threading.Event + value-or-error)."""
+    """Minimal completion handle (threading.Event + value-or-error).
+    Delivery also attaches the request's causal-trace identity: ``trace_id``
+    and the per-stage latency ``breakdown`` (``{stage: seconds}``, summing
+    to ~``e2e_s``) that the HTTP front returns in the ``/predict`` body."""
 
-    __slots__ = ("_event", "_value", "_error")
+    __slots__ = ("_event", "_value", "_error", "trace_id", "breakdown",
+                 "e2e_s")
 
     def __init__(self):
         self._event = threading.Event()
         self._value = None
         self._error = None
+        self.trace_id = None
+        self.breakdown = None
+        self.e2e_s = None
 
     def done(self):
         return self._event.is_set()
@@ -108,9 +115,9 @@ class _Future:
 
 class _Request:
     __slots__ = ("inputs", "n", "bucket_key", "deadline", "t_enq", "future",
-                 "redispatched")
+                 "redispatched", "trace")
 
-    def __init__(self, inputs, n, bucket_key, deadline, t_enq):
+    def __init__(self, inputs, n, bucket_key, deadline, t_enq, trace=None):
         self.inputs = inputs
         self.n = n
         self.bucket_key = bucket_key
@@ -120,6 +127,12 @@ class _Request:
         # set when a wedge-watchdog trip re-enqueues this request on a
         # healthy replica: re-dispatch happens exactly ONCE (replicas.py)
         self.redispatched = False
+        # the request's causal trace: created at submit on the caller's
+        # thread, handed to whichever dispatch worker runs its cohort
+        # (telemetry.trace_handoff), and carried THROUGH a wedge
+        # re-dispatch so the second dispatch's spans join the original
+        # tree instead of starting an unlinked one
+        self.trace = trace
 
 
 class MicroBatcher:
@@ -161,7 +174,24 @@ class MicroBatcher:
     def submit(self, inputs, deadline_ms=None):
         """Enqueue one request — ``inputs`` is an array or tuple of arrays
         sharing batch axis 0 (host numpy stays host-side until dispatch).
-        Returns a future; raises :class:`QueueFull` when shed."""
+        Returns a future; raises :class:`QueueFull` when shed.
+
+        Each admitted request starts a causal trace here (the
+        ``serving.submit`` stage covers validation + enqueue on the
+        caller's thread); everything downstream — queue wait, the cohort
+        pad, the device call, the fetch, delivery — is attributed to that
+        trace across every thread it crosses, and the final breakdown is
+        attached to the returned future."""
+        trace = telemetry.new_trace()
+        t0 = time.perf_counter()
+        with telemetry.trace_handoff(trace), \
+                telemetry.span("serving.submit"):
+            req = self._admit(inputs, deadline_ms, trace)
+        telemetry.add_stage(trace, "serving.submit",
+                            time.perf_counter() - t0)
+        return req.future
+
+    def _admit(self, inputs, deadline_ms, trace):
         if not isinstance(inputs, (tuple, list)):
             inputs = (inputs,)
         if getattr(inputs[0], "ndim", 0) < 1:
@@ -185,7 +215,7 @@ class MicroBatcher:
             self._shed("injected_overload")
         now = self._clock()
         deadline = None if deadline_ms is None else now + deadline_ms / 1e3
-        req = _Request(inputs, n, bucket_key, deadline, now)
+        req = _Request(inputs, n, bucket_key, deadline, now, trace)
         with self._cond:
             if self._crashed:
                 # crash barrier: a dead worker thread can never deliver —
@@ -200,7 +230,7 @@ class MicroBatcher:
             telemetry.gauge("serving.queue_depth", self._items)
             self._cond.notify()
         telemetry.inc("serving.requests")
-        return req.future
+        return req
 
     def _validate_shapes(self, inputs, spec):
         """Admission-time template check: a malformed request must be
@@ -294,6 +324,11 @@ class MicroBatcher:
         now = self._clock()
         live = []
         for r in batch:
+            # queue-wait is an interval BETWEEN threads (enqueue on the
+            # caller, pop on this worker) — credited from the injected
+            # clock so fake-clock tests see exact waits
+            telemetry.add_stage(r.trace, "serving.queue_wait",
+                                max(0.0, now - r.t_enq), event=True)
             if r.deadline is not None and now > r.deadline:
                 self._expire(r)
             else:
@@ -306,12 +341,30 @@ class MicroBatcher:
             live = []
         if not live:
             return
-        try:
-            joined = self._join(live)
-        except Exception as e:  # noqa: BLE001 — a bad batch must not kill
-            self._fail_batch(live, e, idx)
-            return
-        self._run_batch(live, joined, idx)
+        # the dispatch worker ADOPTS the cohort lead's trace for the
+        # batch-level stages; the other members get the same stage
+        # durations in their breakdowns (_share_stage) plus a cohort link
+        # so the chrome timeline shows whose batch carried them
+        with telemetry.trace_handoff(live[0].trace):
+            for r in live[1:]:
+                telemetry.link(r.trace, "serving.cohort")
+            t0 = time.perf_counter()
+            try:
+                with telemetry.span("serving.pad"):
+                    joined = self._join(live)
+            except Exception as e:  # noqa: BLE001 — bad batch must not kill
+                self._fail_batch(live, e, idx)
+                return
+            self._share_stage(live, "serving.pad",
+                              time.perf_counter() - t0)
+            self._run_batch(live, joined, idx)
+
+    @staticmethod
+    def _share_stage(live, name, dur_s):
+        """Credit one batch-level stage to EVERY cohort member's
+        breakdown (the trace tree records it once, under the lead)."""
+        for r in live:
+            telemetry.add_stage(r.trace, name, dur_s)
 
     def _join(self, live):
         """Host-side coalesce: one numpy array per model input, the
@@ -343,14 +396,23 @@ class MicroBatcher:
         """Execute ONE joined batch and deliver its results — the
         single-predictor path. :class:`~mxtpu.serving.replicas.
         ReplicaDispatcher` overrides this with routed, wedge-watchdogged,
-        breaker-guarded dispatch over a ReplicaSet."""
+        breaker-guarded dispatch over a ReplicaSet. Runs under the cohort
+        lead's trace (``_dispatch``): the engine's ``serving.predict``
+        span and the fetch nest into the request tree, and both stage
+        durations land in every member's breakdown."""
         try:
             # device work: pad -> compiled forward -> slice (zero d2h)
+            t0 = time.perf_counter()
             flat, _fmt, _bucket = self._pred.predict_flat(tuple(joined))
+            self._share_stage(live, "serving.predict",
+                              time.perf_counter() - t0)
             # the ONE declared d2h of the serving loop: fetch outputs once
             # per batch, split per request host-side
+            t0 = time.perf_counter()
             with telemetry.span("serving.fetch", cat="sync"):
                 host = [o.asnumpy() for o in flat]
+            self._share_stage(live, "serving.fetch",
+                              time.perf_counter() - t0)
         except Exception as e:  # noqa: BLE001 — a bad batch must not kill
             self._fail_batch(live, e, idx)
             return
@@ -369,9 +431,21 @@ class MicroBatcher:
         off = 0
         done = self._clock()
         for r in live:
-            outs = [h[off:off + r.n] for h in host]
-            off += r.n
-            r.future._value = outs[0] if len(outs) == 1 else tuple(outs)
+            t0 = time.perf_counter()
+            with telemetry.trace_handoff(r.trace), \
+                    telemetry.span("serving.deliver"):
+                outs = [h[off:off + r.n] for h in host]
+                off += r.n
+                r.future._value = outs[0] if len(outs) == 1 else tuple(outs)
+            telemetry.add_stage(r.trace, "serving.deliver",
+                                time.perf_counter() - t0)
+            # the breakdown rides the future BEFORE the event wakes the
+            # caller — by the time result() returns, trace_id/breakdown
+            # /e2e_s are complete and readable without a race
+            if r.trace is not None:
+                r.future.trace_id = r.trace.trace_id
+                r.future.breakdown = telemetry.trace_breakdown(r.trace)
+                r.future.e2e_s = done - r.t_enq
             r.future._event.set()
             telemetry.observe("serving.latency_s", done - r.t_enq)
 
@@ -453,6 +527,11 @@ class MicroBatcher:
             dead += self._abort_extra_locked(err)
             telemetry.gauge("serving.queue_depth", 0)
             self._cond.notify_all()
+        telemetry.flight_record(
+            "worker_crash",
+            trace_ids=[r.trace.trace_id for r in dead
+                       if r.trace is not None],
+            extra={"error": "%s: %s" % (type(exc).__name__, exc)})
         for r in dead:
             self._fail(r, err)
 

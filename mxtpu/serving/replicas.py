@@ -52,6 +52,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -379,6 +380,7 @@ class ReplicaDispatcher(MicroBatcher):
             dispatch_timeout_ms if dispatch_timeout_ms is not None
             else dispatch_timeout_ms_default()) / 1e3
         self._watch = []          # armed dispatch/probe watchdog entries
+        self._flight_pending = []  # dump payloads deferred out of the lock
         self._threads = []
         self._monitor = None
         self._stop = threading.Event()
@@ -432,8 +434,20 @@ class ReplicaDispatcher(MicroBatcher):
                          "released": True}
                 self._watch.append(entry)
                 due.append((rep, entry))
+        self._flush_flight()
         for rep, entry in due:
             self._probe(rep, entry)
+
+    def _flush_flight(self):
+        """Write dumps the wedge scan deferred — NEVER under self._cond
+        (callers invoke this right after releasing it). No-op when
+        nothing is pending or MXTPU_FLIGHT_DIR is unset."""
+        if not self._flight_pending:
+            return
+        with self._cond:
+            pending, self._flight_pending = self._flight_pending, []
+        for reason, tids, extra in pending:
+            telemetry.flight_record(reason, trace_ids=tids, extra=extra)
 
     def poll(self):
         self._maintain()
@@ -467,6 +481,24 @@ class ReplicaDispatcher(MicroBatcher):
                 "serving: dispatch %d wedged on replica %d (no answer in "
                 "%.0f ms) — replica quarantined, batch re-dispatching",
                 entry["idx"], rep.index, self._timeout_s * 1e3)
+            # the post-mortem artifact: the wedged dispatch's traces are
+            # the owning ones — a p99 investigation (or this watchdog's
+            # own trip) can match a request's trace_id to the exact
+            # dispatch + per-thread stacks without a live repro. The
+            # DUMP is deferred (self._flight_pending, flushed by the
+            # caller after releasing self._cond): flight_record does
+            # disk IO + an all-thread stack walk, and doing that under
+            # the serving lock would stall every submit/dispatch for
+            # the dump duration — during the exact incident being
+            # recorded
+            self._flight_pending.append(
+                ("replica_wedge",
+                 [r.trace.trace_id for r in entry["live"]
+                  if r.trace is not None],
+                 {"replica": rep.index, "dispatch": entry["idx"],
+                  "timeout_ms": self._timeout_s * 1e3}))
+            for r in entry["live"]:
+                telemetry.trace_mark(r.trace, "serving.wedged")
             fresh = [r for r in entry["live"] if not r.redispatched]
             burnt = [r for r in entry["live"] if r.redispatched]
             for r in burnt:
@@ -487,6 +519,10 @@ class ReplicaDispatcher(MicroBatcher):
                 continue
             for r in reversed(fresh):
                 r.redispatched = True
+                # same _Request, same .trace: the re-dispatch's spans and
+                # stages JOIN the original trace — the tree shows wedge ->
+                # re-dispatch -> delivery as one causal story
+                telemetry.trace_mark(r.trace, "serving.redispatch")
                 self._q.appendleft(r)  # head: it already waited its turn
                 self._items += r.n
             telemetry.inc("serving.replica.redispatches", tag=rep.tag)
@@ -516,6 +552,7 @@ class ReplicaDispatcher(MicroBatcher):
     # -------------------------------------------------------------- dispatch
     def _run_batch(self, live, joined, idx):
         now = self._clock()
+        t_route = time.perf_counter()
         rep = getattr(self._tls, "rep", None)  # a worker owns its replica
         if rep is not None and rep.state != "healthy":
             rep = None  # quarantined between gather and dispatch: re-route
@@ -537,8 +574,12 @@ class ReplicaDispatcher(MicroBatcher):
                  "done": False, "abandoned": False, "released": False}
         with self._cond:
             self._watch.append(entry)
+        # routing + watchdog arm = the "replica dispatch" stage of the
+        # per-request breakdown (runs under the cohort lead's trace)
+        self._share_stage(live, "serving.dispatch",
+                          time.perf_counter() - t_route)
         try:
-            host = self._execute(rep, joined, idx)
+            host = self._execute(rep, joined, idx, live)
         except Exception as e:  # noqa: BLE001 — breaker counts it
             with self._cond:
                 abandoned = entry["abandoned"]
@@ -548,8 +589,17 @@ class ReplicaDispatcher(MicroBatcher):
                 if not entry["released"]:
                     entry["released"] = True
                     self._set.release(rep)
-                self._set.record_failure(rep, self._clock())
+                opened = self._set.record_failure(rep, self._clock())
                 self._cond.notify_all()
+            if opened:
+                # the failure that OPENED the breaker: capture the moment
+                # with the owning traces tagged (flight-recorder trigger)
+                telemetry.flight_record(
+                    "breaker_open",
+                    trace_ids=[r.trace.trace_id for r in live
+                               if r.trace is not None],
+                    extra={"replica": rep.index, "dispatch": idx,
+                           "error": "%s: %s" % (type(e).__name__, e)})
             if not abandoned:
                 self._fail_batch(live, e, idx)
             return
@@ -574,16 +624,21 @@ class ReplicaDispatcher(MicroBatcher):
             return
         self._deliver(live, host)
 
-    def _execute(self, rep, joined, idx):
+    def _execute(self, rep, joined, idx, live=()):
         if inject("replica_fail", idx):
             raise ReplicaFailure(
                 "injected replica failure (dispatch %d, replica %d)"
                 % (idx, rep.index))
         if inject("replica_wedge", idx):
             return _WEDGED
+        t0 = time.perf_counter()
         flat, _fmt, _bucket = rep.predictor.predict_flat(tuple(joined))
+        self._share_stage(live, "serving.predict", time.perf_counter() - t0)
+        t0 = time.perf_counter()
         with telemetry.span("serving.fetch", cat="sync"):
-            return [o.asnumpy() for o in flat]
+            host = [o.asnumpy() for o in flat]
+        self._share_stage(live, "serving.fetch", time.perf_counter() - t0)
+        return host
 
     # ---------------------------------------------------------------- worker
     def start(self):
@@ -641,6 +696,7 @@ class ReplicaDispatcher(MicroBatcher):
                     else:
                         self._cond.wait(0.25)
                 self._inflight += len(batch)
+            self._flush_flight()
             try:
                 self._dispatch(batch)
             finally:
@@ -667,6 +723,7 @@ class ReplicaDispatcher(MicroBatcher):
                              "released": True}
                     self._watch.append(entry)
                     due.append((rep, entry))
+            self._flush_flight()
             for rep, entry in due:
                 threading.Thread(
                     target=self._probe, args=(rep, entry), daemon=True,

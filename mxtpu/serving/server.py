@@ -25,12 +25,18 @@ load-balancers health-check), not a gRPC replacement.
 Endpoints::
 
     POST /predict   {"data": [[...], ...], "deadline_ms": 250}
-                    -> 200 {"outputs": [...], "n": k}
+                    -> 200 {"outputs": [...], "n": k, "trace_id": ...,
+                            "e2e_ms": ..., "breakdown_ms": {stage: ms}}
+                       (trace fields present while MXTPU_TRACE is on; the
+                       stages sum to ~e2e_ms — queue wait vs pad vs device
+                       vs fetch attribution per request)
                     -> 503 shed/draining, 504 deadline, 400 bad request
     GET  /healthz   {"status": "ok"|"degraded"|"unhealthy"|"draining",
                      "queue_depth": d, "replicas": [...]}  (replica fields
                     only when serving through a ReplicaDispatcher)
-    GET  /metrics   telemetry.snapshot() as JSON
+    GET  /metrics   telemetry.snapshot() as JSON; with ``Accept:
+                    text/plain`` (a stock Prometheus scraper) the same
+                    registry in Prometheus text exposition format
 """
 from __future__ import annotations
 
@@ -116,10 +122,20 @@ class ModelServer:
         # drain (IO, locks, device syncs) to a worker thread
         self.draining = True
         telemetry.inc("serving.drains")
-        t = threading.Thread(target=self.begin_drain, daemon=True,
+        t = threading.Thread(target=self._drain_with_flight, daemon=True,
                              name="mxtpu-serving-drain")
         self._drain_thread = t
         t.start()
+
+    def _drain_with_flight(self):
+        # SIGTERM is a flight-recorder trigger: snapshot the in-flight
+        # traces + thread stacks BEFORE the drain tears the state down
+        # (the dump is on this worker thread — the signal handler itself
+        # stays IO-free). No-op unless MXTPU_FLIGHT_DIR is set.
+        telemetry.flight_record("sigterm",
+                                extra={"queue_depth":
+                                       self._batcher.queue_depth})
+        self.begin_drain()
 
     def begin_drain(self, timeout=None):
         """Reject new work, finish queued + in-flight batches. The
@@ -186,8 +202,19 @@ class ModelServer:
             # a healthy instance over one misbehaving caller
             return 400, {"error": str(e)}
         outs = list(out) if isinstance(out, tuple) else [out]
-        return 200, {"outputs": [o.tolist() for o in outs],
-                     "n": int(arrays[0].shape[0])}
+        payload = {"outputs": [o.tolist() for o in outs],
+                   "n": int(arrays[0].shape[0])}
+        if fut.trace_id is not None:
+            # the request's causal identity + latency attribution: stages
+            # sum to ~e2e_ms (serve_bench's closed-loop 5% gate), and the
+            # trace_id matches the flight-recorder artifact should this
+            # request's dispatch have wedged
+            payload["trace_id"] = fut.trace_id
+            payload["e2e_ms"] = round(fut.e2e_s * 1e3, 3)
+            payload["breakdown_ms"] = {
+                k: round(v * 1e3, 4)
+                for k, v in sorted(fut.breakdown.items())}
+        return 200, payload
 
 
 def _make_handler(srv):
@@ -226,7 +253,22 @@ def _make_handler(srv):
                                              else "unhealthy")
                 self._reply(200, payload)
             elif self.path == "/metrics":
-                self._reply(200, telemetry.snapshot())
+                accept = self.headers.get("Accept", "")
+                if "text/plain" in accept or "openmetrics" in accept:
+                    # content-negotiated Prometheus text exposition: a
+                    # stock scraper (which sends text/plain in Accept)
+                    # gets the standard format; everything else keeps
+                    # the structured JSON snapshot
+                    body = telemetry.prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._reply(200, telemetry.snapshot())
             else:
                 self._reply(404, {"error": "unknown path %s" % self.path})
 

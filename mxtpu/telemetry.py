@@ -35,6 +35,20 @@ counters) with no common surface. This module is that surface:
   off-thread (``MXTPU_TELEMETRY_FLUSH_S``) and OFF by default — the hot
   path only ever appends to an in-memory deque.
   ``tools/telemetry_report.py`` turns the file into the aggregate table.
+* **Causal tracing** — a :class:`TraceContext` (trace id + span id)
+  carried in a ``contextvars.ContextVar`` so nested :class:`span` calls
+  build per-request / per-step trees, with an EXPLICIT handoff API
+  (:func:`trace_handoff`) for crossing threads: batcher dispatch workers,
+  replica re-dispatches, and prefetch producers adopt the originating
+  trace instead of losing it at the thread boundary. A bounded trace
+  ring feeds the **flight recorder** (:func:`flight_record`): on
+  watchdog trips, breaker opens, injected faults, and SIGTERM a JSON
+  artifact with the recent trace events + per-thread stacks is written
+  to ``MXTPU_FLIGHT_DIR``, so post-mortems need no live repro.
+  ``MXTPU_TRACE=0`` turns the trace layer off (spans keep timing).
+* **Prometheus exposition** — :func:`prometheus` renders the whole
+  registry in the text exposition format; the model server
+  content-negotiates it on ``/metrics`` next to the JSON snapshot.
 
 Gating: ``MXTPU_TELEMETRY=0`` disables the span/event/sink machinery
 (timers, ring appends). Plain counter/gauge increments stay always-on —
@@ -44,6 +58,8 @@ they are single dict updates, and the adopted stats views
 from __future__ import annotations
 
 import collections
+import contextvars
+import itertools
 import json
 import logging
 import os
@@ -53,7 +69,11 @@ import time
 __all__ = ["enabled", "retrace_budget", "inc", "gauge", "observe", "value",
            "tagged", "reset_metric", "span", "record_d2h", "d2h_count",
            "record_retrace", "retrace_stats", "snapshot", "report",
-           "events", "flush", "jsonl_path", "reset"]
+           "events", "flush", "jsonl_path", "reset",
+           "tracing_enabled", "TraceContext", "new_trace", "current_trace",
+           "trace_handoff", "add_stage", "trace_mark", "link", "pend_link",
+           "link_pending", "trace_breakdown", "trace_events", "trace_flows",
+           "flight_record", "flight_snapshot", "prometheus"]
 
 _log = logging.getLogger("mxtpu.telemetry")
 
@@ -91,6 +111,40 @@ _D2H_LOCAL = _D2HLocal()
 _SINK = {"queue": collections.deque(maxlen=1 << 20), "thread": None,
          "atexit": False, "lock": threading.Lock()}
 
+# ---- causal tracing state ----
+# current trace context (None outside any trace); contextvars are
+# per-thread by construction, which is exactly the handoff discipline:
+# a trace crosses a thread boundary ONLY through trace_handoff()
+_TRACE_CV = contextvars.ContextVar("mxtpu_trace", default=None)
+_SPAN_IDS = itertools.count(1)   # process-global span ids (GIL-atomic)
+_TRACE_IDS = itertools.count(1)
+_TRACE_PREFIX = "%04x" % (os.getpid() & 0xFFFF)
+
+
+def _trace_ring_cap():
+    try:
+        return int(os.environ.get("MXTPU_TRACE_RING", "4096"))
+    except ValueError:
+        return 4096
+
+
+# flight-recorder ring: (kind, trace_id, span_id, parent, name, ts_us,
+# dur_us, tid) tuples; parent is a span id for kind=="span", a
+# (trace_id, span_id) source pair for kind=="link"
+_TRACE_EVENTS = collections.deque(maxlen=_trace_ring_cap())
+# consumer -> next-trace link handoffs (data.wait / data.h2d): the step
+# trace that CONSUMES a batch drains these into link events. THREAD-LOCAL:
+# both pend (loader __next__) and drain (Trainer.step) happen on the
+# consuming thread, and a process-global queue would let a background
+# thread's loader events misattribute to the foreground thread's step
+class _PendingLocal(threading.local):
+    def __init__(self):
+        self.q = collections.deque(maxlen=64)
+
+
+_PENDING_LINKS = _PendingLocal()
+_FLIGHT = {"count": 0, "lock": threading.Lock()}
+
 
 # ------------------------------------------------------------------ policies
 def enabled():
@@ -105,6 +159,34 @@ def jsonl_path():
     than ``0``/``1`` is a JSONL path observations stream to."""
     v = os.environ.get("MXTPU_TELEMETRY", "1")
     return v if v not in ("0", "1") else None
+
+
+def tracing_enabled():
+    """Causal-tracing lever: ``MXTPU_TRACE`` default ON (requires the
+    span machinery, so ``MXTPU_TELEMETRY=0`` implies off). Tracing is
+    pure host bookkeeping — an id allocation, a contextvar set, and a
+    bounded ring append per span — so the zero-host-sync and
+    ``trainer.step.d2h == 0`` contracts hold with it ON (pinned by the
+    transfer-guard test parametrized over this var)."""
+    return os.environ.get("MXTPU_TRACE", "1") != "0" and enabled()
+
+
+def flight_dir():
+    """Flight-recorder artifact directory (``MXTPU_FLIGHT_DIR``). Unset
+    or empty = no files are written (the in-memory ring and
+    :func:`flight_snapshot` still work); triggers call
+    :func:`flight_record` unconditionally and it no-ops here."""
+    return os.environ.get("MXTPU_FLIGHT_DIR") or None
+
+
+def flight_max():
+    """Dump cap per process (``MXTPU_FLIGHT_MAX``, default 16): a
+    repeatedly-tripping watchdog must not fill the disk with thousands
+    of near-identical artifacts."""
+    try:
+        return int(os.environ.get("MXTPU_FLIGHT_MAX", "16"))
+    except ValueError:
+        return 16
 
 
 def retrace_budget():
@@ -284,8 +366,10 @@ def events():
 
 
 def reset():
-    """Test hook: clear the whole registry, event ring, and watchdog
-    state (the sink file, if any, is left alone)."""
+    """Test hook: clear the whole registry, event ring, trace ring, and
+    watchdog state (the sink file, if any, is left alone). The trace
+    ring is re-created so a changed ``MXTPU_TRACE_RING`` takes effect."""
+    global _TRACE_EVENTS
     with _LOCK:
         _COUNTERS.clear()
         _GAUGES.clear()
@@ -293,6 +377,9 @@ def reset():
         _EVENTS.clear()
         _RETRACE.clear()
         _D2H_WARNED.clear()
+        _TRACE_EVENTS = collections.deque(maxlen=_trace_ring_cap())
+        _PENDING_LINKS.q.clear()  # the calling thread's (tests drain
+        _FLIGHT["count"] = 0      # their own; other threads' are bounded)
 
 
 # -------------------------------------------------------------------- spans
@@ -304,6 +391,14 @@ class span:
     occurrence (past the first ``_D2H_WARMUP``) that syncs at all warns
     once — the guarded hot loop's contract is ZERO.
 
+    Causal tracing: when a :class:`TraceContext` is active on this
+    thread (see :func:`new_trace` / :func:`trace_handoff`) the span joins
+    the trace tree — it allocates a span id, becomes the current context
+    for its body (children nest under it), and records one trace-ring
+    event with its parent linkage on exit. ``new_trace=True`` starts a
+    fresh trace when none is active (the per-request / per-step roots);
+    with one already active it simply nests, preserving causality.
+
     Pure host bookkeeping: no device ops, no syncs — safe under a
     ``jax.transfer_guard`` and inside the zero-sync Trainer.step contract.
     The enter/exit pair is hand-tuned for sub-millisecond hot loops: ONE
@@ -311,20 +406,34 @@ class span:
     on exit (histogram + event ring inline), lock-free d2h snapshot.
     """
 
-    __slots__ = ("name", "cat", "_d2h", "_t0", "_d0", "_sink")
+    __slots__ = ("name", "cat", "_d2h", "_t0", "_d0", "_sink",
+                 "_new_trace", "_parent", "_tok", "ctx")
 
-    def __init__(self, name, cat="phase", d2h=False):
+    def __init__(self, name, cat="phase", d2h=False, new_trace=False):
         self.name = name
         self.cat = cat
         self._d2h = d2h
+        self._new_trace = new_trace
         self._t0 = None
         self._d0 = None
         self._sink = None
+        self._parent = None
+        self._tok = None
+        self.ctx = None
 
     def __enter__(self):
         lever = os.environ.get("MXTPU_TELEMETRY", "1")
         if lever != "0":
             self._sink = lever if lever != "1" else None
+            parent = _TRACE_CV.get()
+            if parent is None and self._new_trace \
+                    and os.environ.get("MXTPU_TRACE", "1") != "0":
+                parent = new_trace()
+            if parent is not None:
+                self._parent = parent.span_id
+                self.ctx = TraceContext(parent.trace_id, next(_SPAN_IDS),
+                                        parent._stages)
+                self._tok = _TRACE_CV.set(self.ctx)
             self._t0 = time.perf_counter_ns()
             if self._d2h:
                 # thread-local snapshot: only syncs issued by THIS thread
@@ -340,6 +449,13 @@ class span:
         dur_ns = time.perf_counter_ns() - t0
         v = dur_ns * 1e-9
         name = self.name
+        if self._tok is not None:
+            _TRACE_CV.reset(self._tok)
+            self._tok = None
+            _TRACE_EVENTS.append(
+                ("span", self.ctx.trace_id, self.ctx.span_id, self._parent,
+                 name, t0 // 1000, dur_ns // 1000,
+                 threading.get_ident() & 0xFFFF))
         with _LOCK:
             h = _HISTS.get(name)
             if h is None:
@@ -356,8 +472,16 @@ class span:
             _EVENTS.append((name, self.cat, t0 // 1000, dur_ns // 1000,
                             threading.get_ident() & 0xFFFF))
         if self._sink is not None:
-            _queue_line({"t": time.time(), "kind": "obs", "metric": name,
-                         "value": v}, self._sink)
+            rec = {"t": time.time(), "kind": "obs", "metric": name,
+                   "value": v}
+            if self.ctx is not None:
+                # trace linkage rides the SAME obs line (old readers
+                # ignore the extra keys): tools/telemetry_report.py
+                # rebuilds per-trace critical paths from these
+                rec["trace"] = self.ctx.trace_id
+                rec["span"] = self.ctx.span_id
+                rec["parent"] = self._parent
+            _queue_line(rec, self._sink)
         if self._d0 is not None:
             delta = _D2H_LOCAL.count - self._d0
             if delta:
@@ -376,6 +500,352 @@ class span:
             "warmup (occurrence %d) — the hot loop should be transfer-free; "
             "fetch verdicts/metrics asynchronously off the step path "
             "(docs/observability.md)", delta, self.name, occurrences)
+
+
+# ----------------------------------------------------------- causal tracing
+class TraceContext:
+    """One position in a trace tree: ``trace_id`` (process-prefixed hex
+    string) + ``span_id`` (globally unique int; 0 = the trace root).
+    Contexts are immutable hand-off tokens: :class:`span` derives a child
+    for its body, :func:`trace_handoff` adopts one on another thread.
+    ``_stages`` is the per-TRACE accumulator shared by every context of
+    the trace — :func:`add_stage` appends (stage, seconds) pairs there
+    and :func:`trace_breakdown` folds them into the latency breakdown a
+    served request returns."""
+
+    __slots__ = ("trace_id", "span_id", "_stages")
+
+    def __init__(self, trace_id, span_id, stages):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self._stages = stages
+
+    def __repr__(self):
+        return "TraceContext(%s, span=%d)" % (self.trace_id, self.span_id)
+
+
+def new_trace():
+    """Root context for a fresh trace (None when tracing is off). The
+    per-request / per-step entry points call this; everything below them
+    nests via :class:`span` or joins via :func:`trace_handoff`."""
+    if not tracing_enabled():
+        return None
+    return TraceContext("%s-%x" % (_TRACE_PREFIX, next(_TRACE_IDS)), 0, [])
+
+
+def current_trace():
+    """This thread's active context (None outside any trace)."""
+    return _TRACE_CV.get()
+
+
+class trace_handoff:
+    """Adopt ``ctx`` as the current trace for a ``with`` body — THE way a
+    trace crosses a thread boundary (contextvars do not follow threads,
+    by design: implicit inheritance would attribute a worker's whole
+    lifetime to whichever request was live when it spawned). ``ctx`` may
+    be None (tracing off / untraced caller): the handoff is a no-op, so
+    call sites stay unconditional."""
+
+    __slots__ = ("_ctx", "_tok")
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+        self._tok = None
+
+    def __enter__(self):
+        if self._ctx is not None:
+            self._tok = _TRACE_CV.set(self._ctx)
+        return self._ctx
+
+    def __exit__(self, *exc):
+        if self._tok is not None:
+            _TRACE_CV.reset(self._tok)
+            self._tok = None
+        return False
+
+
+def add_stage(ctx, name, dur_s, event=False):
+    """Credit ``dur_s`` seconds of stage ``name`` to ``ctx``'s trace
+    breakdown (None-safe). ``event=True`` additionally records a trace
+    event under ``ctx`` — used for stages measured OUTSIDE a span body
+    (queue-wait is an interval between threads, not a code region).
+    Batch-level stages (pad/predict/fetch) are credited to every cohort
+    member's breakdown but recorded as ONE event under the lead trace:
+    each request's numbers stay per-request, the tree stays deduplicated."""
+    if ctx is None:
+        return
+    ctx._stages.append((name, float(dur_s)))
+    if event:
+        now_us = time.perf_counter_ns() // 1000
+        dur_us = int(dur_s * 1e6)
+        sid = next(_SPAN_IDS)
+        _TRACE_EVENTS.append(
+            ("span", ctx.trace_id, sid, ctx.span_id, name,
+             max(0, now_us - dur_us), dur_us,
+             threading.get_ident() & 0xFFFF))
+        p = jsonl_path()
+        if p is not None:
+            # interval stages reach the sink like span observations do,
+            # so the per-trace critical path (telemetry_report --traces)
+            # sees queue-wait next to the span stages
+            _queue_line({"t": time.time(), "kind": "obs", "metric": name,
+                         "value": float(dur_s), "trace": ctx.trace_id,
+                         "span": sid, "parent": ctx.span_id}, p)
+
+
+def trace_mark(ctx, name):
+    """Zero-duration marker event in ``ctx``'s trace (None-safe) — e.g.
+    ``serving.redispatch`` when a wedged batch re-enters the queue."""
+    if ctx is None:
+        return
+    _TRACE_EVENTS.append(
+        ("mark", ctx.trace_id, next(_SPAN_IDS), ctx.span_id, name,
+         time.perf_counter_ns() // 1000, 0,
+         threading.get_ident() & 0xFFFF))
+
+
+def link(src, name="link"):
+    """Causal edge from ``src`` (a TraceContext on ANOTHER trace/thread)
+    to the CURRENT context — rendered as a chrome-trace flow arrow by
+    ``profiler.dump()``. No-op when either side is absent."""
+    dst = _TRACE_CV.get()
+    if src is None or dst is None:
+        return
+    _TRACE_EVENTS.append(
+        ("link", dst.trace_id, dst.span_id, (src.trace_id, src.span_id),
+         name, time.perf_counter_ns() // 1000, 0,
+         threading.get_ident() & 0xFFFF))
+
+
+def pend_link(name, ctx):
+    """Queue a causal edge whose DESTINATION does not exist yet: the
+    loader's ``__next__`` (on the CONSUMING thread) records the batch's
+    ``data.h2d``/``data.wait`` contexts here, and the next
+    ``trainer.step`` trace ON THE SAME THREAD drains them via
+    :func:`link_pending` — the step that consumes a batch links the
+    transfer that produced it. The queue is thread-local, so a
+    background thread's loader can never pollute another thread's step;
+    within one thread, iteration that never reaches a step (e.g. an
+    interleaved un-stepped validation pass) attributes to the NEXT step
+    drained there — the bounded queue caps how far that can drift."""
+    if ctx is not None:
+        _PENDING_LINKS.q.append((name, ctx.trace_id, ctx.span_id))
+
+
+def link_pending():
+    """Drain this thread's pended edges into link events targeting the
+    current context. Returns the number of links emitted (0 outside a
+    trace — the queue is cleared either way so stale edges never attach
+    to an unrelated later step)."""
+    dst = _TRACE_CV.get()
+    q = _PENDING_LINKS.q
+    n = 0
+    while True:
+        try:
+            name, src_trace, src_span = q.popleft()
+        except IndexError:
+            break
+        if dst is None:
+            continue
+        _TRACE_EVENTS.append(
+            ("link", dst.trace_id, dst.span_id, (src_trace, src_span),
+             name, time.perf_counter_ns() // 1000, 0,
+             threading.get_ident() & 0xFFFF))
+        n += 1
+    return n
+
+
+def trace_breakdown(ctx):
+    """Fold ``ctx``'s stage accumulator into ``{stage: seconds}`` (empty
+    when untraced). The serving path returns this per request; its values
+    sum to ~the request's end-to-end latency (serve_bench's 5% gate)."""
+    if ctx is None:
+        return {}
+    out = {}
+    for name, dur in list(ctx._stages):
+        out[name] = out.get(name, 0.0) + dur
+    return out
+
+
+def trace_events(trace_id=None):
+    """Snapshot of the trace ring as dicts (optionally one trace's);
+    ``parent`` is a span id for tree edges, ``{"trace", "span"}`` for
+    cross-trace links."""
+    out = []
+    for kind, tr, sp, parent, name, ts, dur, tid in list(_TRACE_EVENTS):
+        if trace_id is not None and tr != trace_id:
+            continue
+        rec = {"kind": kind, "trace": tr, "span": sp, "name": name,
+               "ts_us": ts, "dur_us": dur, "tid": tid}
+        if kind == "link":
+            rec["parent"] = {"trace": parent[0], "span": parent[1]}
+        else:
+            rec["parent"] = parent
+        out.append(rec)
+    return out
+
+
+def trace_flows(lo=None, hi=None):
+    """Chrome-trace flow events (``ph: s/f`` pairs) for the trace ring's
+    causal edges — parent→child span edges (cat ``trace``, flow id = the
+    globally-unique child span id) and explicit cross-thread links (cat
+    ``trace.link``, a fresh id per link: several links may target the
+    SAME destination span, e.g. every cohort member linking the lead) —
+    scoped to a ``[lo, hi]`` ts window like the rest of
+    ``profiler.dump()``'s merge. A link whose source is a trace ROOT
+    (span 0 — roots have no ring event of their own) anchors to that
+    trace's earliest recorded event instead of being dropped."""
+    evs = list(_TRACE_EVENTS)
+    index = {}
+    first_of_trace = {}
+    for kind, tr, sp, parent, name, ts, dur, tid in evs:
+        if kind != "link":
+            index[(tr, sp)] = (ts, dur, tid)
+            best = first_of_trace.get(tr)
+            if best is None or ts < best[0]:
+                first_of_trace[tr] = (ts, dur, tid)
+    flows = []
+
+    def _in_window(ts):
+        return (lo is None or ts >= lo) and (hi is None or ts <= hi)
+
+    for i, (kind, tr, sp, parent, name, ts, dur, tid) in enumerate(evs):
+        if kind == "link":
+            src = index.get(parent)
+            if src is None and parent[1] == 0:
+                src = first_of_trace.get(parent[0])
+            if src is None or not _in_window(ts):
+                continue
+            s_ts, s_dur, s_tid = src
+            link_id = (1 << 32) + i  # disjoint from span-id flow ids
+            flows.append({"ph": "s", "cat": "trace.link", "name": name,
+                          "id": link_id, "ts": s_ts + s_dur, "pid": 0,
+                          "tid": s_tid})
+            flows.append({"ph": "f", "bp": "e", "cat": "trace.link",
+                          "name": name, "id": link_id, "ts": ts, "pid": 0,
+                          "tid": tid})
+        elif kind == "span" and parent:
+            src = index.get((tr, parent))
+            if src is None or not _in_window(ts):
+                continue
+            s_ts, _s_dur, s_tid = src
+            # the parent span's X event starts at s_ts; arrow from the
+            # parent's start to the child's start shows the causal tree
+            # even when the child ran on another thread
+            flows.append({"ph": "s", "cat": "trace", "name": name,
+                          "id": sp, "ts": s_ts, "pid": 0, "tid": s_tid})
+            flows.append({"ph": "f", "bp": "e", "cat": "trace",
+                          "name": name, "id": sp, "ts": ts, "pid": 0,
+                          "tid": tid})
+    return flows
+
+
+# ---------------------------------------------------------- flight recorder
+def flight_snapshot(reason, trace_ids=(), extra=None):
+    """The post-mortem dict: recent trace events, per-thread stacks, the
+    registry snapshot, and the owning trace ids the trigger tagged
+    (wedge/breaker/fault sites pass the affected requests' traces)."""
+    import sys
+    import traceback
+    names = {t.ident: t.name for t in threading.enumerate()}
+    stacks = []
+    for tid, frame in sys._current_frames().items():
+        stacks.append({"thread_id": tid,
+                       "thread_name": names.get(tid, "?"),
+                       "stack": traceback.format_stack(frame)})
+    snap = {"reason": reason, "t": time.time(), "pid": os.getpid(),
+            "trace_ids": list(trace_ids),
+            "events": trace_events(),
+            "threads": stacks,
+            "registry": snapshot()}
+    if extra:
+        snap["extra"] = dict(extra)
+    return snap
+
+
+def flight_record(reason, trace_ids=(), extra=None):
+    """Dump a :func:`flight_snapshot` JSON artifact to
+    ``MXTPU_FLIGHT_DIR`` (no-op returning None when unset). Triggers:
+    wedge-watchdog trips, circuit-breaker opens, retrace-watchdog first
+    trips, injected faults, serving worker crashes, and SIGTERM. Bounded
+    by ``MXTPU_FLIGHT_MAX`` dumps per process; the write is tmp+rename so
+    a dump interrupted by the dying process never leaves a torn artifact."""
+    d = flight_dir()
+    if d is None:
+        return None
+    with _FLIGHT["lock"]:
+        if _FLIGHT["count"] >= flight_max():
+            return None
+        _FLIGHT["count"] += 1
+        seq = _FLIGHT["count"]
+    try:
+        os.makedirs(d, exist_ok=True)
+        safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in str(reason))
+        path = os.path.join(d, "flight_%s_%d_%d.json"
+                            % (safe, os.getpid(), seq))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(flight_snapshot(reason, trace_ids, extra), f)
+        os.replace(tmp, path)
+    except OSError as e:  # pragma: no cover - dump IO failure
+        _log.warning("flight recorder dump failed: %s", e)
+        return None
+    inc("flight.dumps", tag=str(reason))
+    _log.warning("flight recorder: dumped %s (reason=%s, traces=%s)",
+                 path, reason, list(trace_ids) or "-")
+    return path
+
+
+# ------------------------------------------------------ prometheus rendering
+def _prom_name(name):
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch in "_:" else "_")
+    return "mxtpu_" + "".join(out)
+
+
+def _prom_label(v):
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def prometheus():
+    """The whole registry in Prometheus text exposition format 0.0.4:
+    counters (tag families as a ``tag`` label), gauges, and histograms as
+    summaries (``quantile`` 0.5/0.99 + ``_sum``/``_count``). The model
+    server serves this on ``/metrics`` under ``Accept: text/plain`` so a
+    stock Prometheus scraper needs no sidecar."""
+    snap = snapshot()
+    lines = []
+    for name in sorted(snap["counters"]):
+        v = snap["counters"][name]
+        pn = _prom_name(name)
+        lines.append("# TYPE %s counter" % pn)
+        if isinstance(v, dict):
+            for tag in sorted(v):
+                if tag == "_untagged":
+                    lines.append("%s %g" % (pn, v[tag]))
+                else:
+                    lines.append('%s{tag="%s"} %g'
+                                 % (pn, _prom_label(tag), v[tag]))
+        else:
+            lines.append("%s %g" % (pn, v))
+    for name in sorted(snap["gauges"]):
+        pn = _prom_name(name)
+        lines.append("# TYPE %s gauge" % pn)
+        lines.append("%s %g" % (pn, snap["gauges"][name]))
+    for name in sorted(snap["histograms"]):
+        h = snap["histograms"][name]
+        pn = _prom_name(name)
+        lines.append("# TYPE %s summary" % pn)
+        if h["p50"] is not None:
+            lines.append('%s{quantile="0.5"} %g' % (pn, h["p50"]))
+        if h["p99"] is not None:
+            lines.append('%s{quantile="0.99"} %g' % (pn, h["p99"]))
+        lines.append("%s_sum %g" % (pn, h["sum"]))
+        lines.append("%s_count %d" % (pn, h["count"]))
+    return "\n".join(lines) + "\n"
 
 
 # -------------------------------------------------------- transfer watchdog
@@ -417,6 +887,12 @@ def record_retrace(site, provenance=None):
         trips = st["trips"]
     if over:
         inc("retrace.watchdog_trips")
+        if trips == 1:
+            # first trip at this site: capture the moment (the provenance
+            # of the compile that blew the budget + who is on-stack)
+            flight_record("retrace_watchdog",
+                          extra={"site": site, "compiles": compiles,
+                                 "provenance": str(provenance)})
         # rate-limit the LOG (the trip counter stays exact): the target
         # pathology is a recompile every step — warning each time would
         # flood hours of logs with the message meant to make them readable

@@ -159,6 +159,42 @@ def test_env_catalog_good_silent():
     assert res.findings == []
 
 
+# ------------------------------------------------------- metric-name-catalog
+def _metric_findings(path):
+    return findings_of(path, "metric-name-catalog",
+                       metric_doc="metric_doc_fixture.md",
+                       metric_scopes=("",))
+
+
+def test_metric_catalog_bad_fires():
+    res = _metric_findings("metric_catalog_bad.py")
+    msgs = [(f.path, f.message) for f in res.findings]
+    assert len(msgs) == 3, msgs
+    assert any(p == "metric_catalog_bad.py" and
+               "'metric.undocumented'" in m for p, m in msgs)
+    assert any(p == "metric_catalog_bad.py" and
+               "'span.undocumented'" in m for p, m in msgs)
+    assert any(p == "metric_doc_fixture.md" and "'metric.stale'" in m
+               for p, m in msgs)
+
+
+def test_metric_catalog_good_silent():
+    # brace expansion, <i> placeholder vs %d pattern, tag annotation
+    # stripping, the span d2h twin, and the retrace.<site> prefix all
+    # reconcile — zero findings either direction
+    res = _metric_findings("metric_catalog_good.py")
+    assert res.findings == []
+
+
+def test_metric_catalog_out_of_scope_collects_nothing():
+    # with the default mxtpu/ scope the fixture file contributes no
+    # names — and crucially the rule then issues NO stale-row verdicts
+    # (a scoped-out run must not condemn the whole catalog)
+    res = findings_of("metric_catalog_bad.py", "metric-name-catalog",
+                      metric_doc="metric_doc_fixture.md")
+    assert res.findings == []
+
+
 # ---------------------------------------------------------------- suppressions
 @pytest.mark.parametrize("rule,expected_suppressed", [
     ("policy-key-coverage", 1),
@@ -199,7 +235,7 @@ def test_all_rules_ran_over_repo():
     assert set(ALL_RULE_IDS) == {
         "policy-key-coverage", "host-sync-in-traced-region",
         "use-after-donate", "retrace-site-registration",
-        "env-var-catalog"}
+        "env-var-catalog", "metric-name-catalog"}
 
 
 def test_jit_surface_inventory_lists_all_four_caches():

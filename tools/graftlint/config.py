@@ -22,6 +22,12 @@ DEFAULT_TRACE_SCOPES: Tuple[str, ...] = (
 
 DEFAULT_POLICY_KEY_MODULE = "mxtpu/ops/registry.py"
 DEFAULT_ENV_DOC = "docs/env_vars.md"
+DEFAULT_METRIC_DOC = "docs/observability.md"
+
+# Trees whose telemetry writer calls feed metric-name-catalog: the
+# runtime package is the metric namespace the catalog documents (bench /
+# tools consume metrics, they do not declare new names).
+DEFAULT_METRIC_SCOPES: Tuple[str, ...] = ("mxtpu",)
 
 # Extra roots scanned (read-only) by env-var-catalog beyond the CLI paths:
 # docs/env_vars.md is a repo-global catalog, so BENCH_* rows read only by
@@ -85,6 +91,8 @@ class LintConfig:
     trace_scopes: Tuple[str, ...] = DEFAULT_TRACE_SCOPES
     env_doc: str = DEFAULT_ENV_DOC
     env_extra_roots: Tuple[str, ...] = DEFAULT_ENV_EXTRA_ROOTS
+    metric_doc: str = DEFAULT_METRIC_DOC
+    metric_scopes: Tuple[str, ...] = DEFAULT_METRIC_SCOPES
     exclude: Tuple[str, ...] = DEFAULT_EXCLUDE
     jit_allowlist: Dict[Tuple[str, str], Dict[str, str]] = field(
         default_factory=lambda: dict(JIT_ALLOWLIST))

@@ -4,6 +4,7 @@ from .host_sync import HostSyncInTracedRegion
 from .donation import UseAfterDonate
 from .retrace import RetraceSiteRegistration
 from .env_catalog import EnvVarCatalog
+from .metric_catalog import MetricNameCatalog
 
 ALL_RULES = [
     PolicyKeyCoverage,
@@ -11,6 +12,7 @@ ALL_RULES = [
     UseAfterDonate,
     RetraceSiteRegistration,
     EnvVarCatalog,
+    MetricNameCatalog,
 ]
 
 ALL_RULE_IDS = [cls.id for cls in ALL_RULES]

@@ -75,11 +75,24 @@ telemetry_report
 
 # 3. serving phase (ISSUE 5): batch-bucket sweep + closed-loop + offered-QPS
 #    overload curve against the in-process Predictor — the inference-side
-#    numbers (items/s per bucket, p99 under load, shed behaviour)
+#    numbers (items/s per bucket, p99 under load, shed behaviour; ISSUE 10:
+#    the closed-loop line carries the per-stage p99 breakdown + the
+#    stages-sum-to-e2e 5% gate)
 sleep 60
 timeout 600 python tools/serve_bench.py --requests 500 \
   2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
 telemetry_report
+
+# 3b. telemetry/tracing overhead phase (ISSUE 4 + ISSUE 10): steps/s with
+#     the layer off vs spans-on vs spans+causal-tracing-on, alternating
+#     rounds — the <1% budget judged where it matters, on the chip. The
+#     per-trace critical-path view of the battery's own artifact follows.
+sleep 60
+timeout 900 env BENCH_CONFIG=telemetry_overhead BENCH_PREFLIGHT=0 \
+  python bench.py 2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
+[ -s "$TELEMETRY_JSONL" ] && \
+  python tools/telemetry_report.py "$TELEMETRY_JSONL" --traces 10 \
+    2>&1 | tee -a "$LOG"
 
 # 4. multichip scaling phase (ISSUE 7): mesh-native gluon Trainer items/s
 #    per device count (strong scaling, ZeRO-1 on). Only meaningful with

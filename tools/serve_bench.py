@@ -162,7 +162,12 @@ def run_sweep(pred, spec, iters=50, repeats=3, emit=_emit):
 def run_closed(pred, spec, n_requests=500, workers=4, max_wait_ms=2.0,
                sizes=(1, 2, 3), emit=_emit):
     """Closed-loop mixed-shape run through the MicroBatcher; the
-    acceptance record: compiles <= #buckets, zero watchdog trips."""
+    acceptance record: compiles <= #buckets, zero watchdog trips — and,
+    with causal tracing on (MXTPU_TRACE, default 1), the per-request
+    latency BREAKDOWN: p99 per stage (queue-wait vs pad vs device vs
+    fetch vs deliver) plus the honesty gate that each request's stages
+    sum to within 5% of its measured end-to-end latency (median ratio
+    error across the run; ``breakdown_ok``)."""
     from mxtpu import telemetry
     from mxtpu.serving import MicroBatcher
 
@@ -174,6 +179,7 @@ def run_closed(pred, spec, n_requests=500, workers=4, max_wait_ms=2.0,
                        max_wait_ms=max_wait_ms, max_queue=4096)
     lat, lock = [], threading.Lock()
     items = [0]
+    breakdowns = []   # (breakdown dict, e2e_s) per traced request
 
     def client(k, n):
         rng = np.random.RandomState(100 + k)
@@ -181,11 +187,14 @@ def run_closed(pred, spec, n_requests=500, workers=4, max_wait_ms=2.0,
             sz = int(sizes[rng.randint(len(sizes))])
             x = rng.randn(sz, dim).astype(np.float32)
             t0 = time.perf_counter()
-            bat.submit(x).result(timeout=60)
+            fut = bat.submit(x)
+            fut.result(timeout=60)
             dt = time.perf_counter() - t0
             with lock:
                 lat.append(dt)
                 items[0] += sz
+                if fut.breakdown is not None:
+                    breakdowns.append((fut.breakdown, fut.e2e_s))
     per = [n_requests // workers] * workers
     per[0] += n_requests - sum(per)
     threads = [threading.Thread(target=client, args=(k, n))
@@ -209,8 +218,33 @@ def run_closed(pred, spec, n_requests=500, workers=4, max_wait_ms=2.0,
            "buckets": len(spec),
            "watchdog_trips": st.get("trips", 0) - trips0,
            "shed": telemetry.value("serving.shed") - shed0}
+    rec.update(_breakdown_summary(breakdowns))
     emit(rec)
     return rec
+
+
+def _breakdown_summary(breakdowns):
+    """p99 per breakdown stage + the sum-vs-e2e honesty gate. Empty dict
+    when tracing was off (no breakdowns to judge)."""
+    if not breakdowns:
+        return {"stage_p99_ms": None, "breakdown_err_median": None,
+                "breakdown_ok": None}
+    stages = {}
+    errs = []
+    for bd, e2e in breakdowns:
+        for name, v in bd.items():
+            stages.setdefault(name, []).append(v)
+        if e2e and e2e > 1e-6:
+            errs.append(abs(sum(bd.values()) - e2e) / e2e)
+    p99 = {name: round(float(np.percentile(np.array(v) * 1e3, 99)), 4)
+           for name, v in sorted(stages.items())}
+    med = float(np.median(errs)) if errs else None
+    return {"stage_p99_ms": p99,
+            "breakdown_err_median": round(med, 4) if med is not None
+            else None,
+            # the ISSUE-10 acceptance bound: a request's returned stages
+            # sum to within 5% of its measured end-to-end latency
+            "breakdown_ok": (med is not None and med <= 0.05)}
 
 
 def run_open(pred, spec, qps_list=(100.0, 300.0, 1000.0), n_requests=200,
@@ -398,6 +432,8 @@ def main(argv=None):
                              max_wait_ms=args.max_wait_ms)
             ok = ok and rec["compiles"] <= rec["buckets"] \
                 and rec["watchdog_trips"] == 0
+            if rec["breakdown_ok"] is not None:
+                ok = ok and rec["breakdown_ok"]
         if "open" in modes:
             run_open(pred, spec,
                      qps_list=[float(q) for q in args.qps.split(",") if q],

@@ -9,9 +9,17 @@ value for counters, the last write for gauges — the telemetry analog of
 ``profiler.dumps()``'s aggregate stats, runnable after the fact on a
 battery artifact (tools/perf_battery.sh runs it after each session).
 
+With causal tracing on (``MXTPU_TRACE``, default 1), span observations
+carry their trace linkage (``trace``/``span``/``parent`` keys) and
+``--traces [K]`` adds the per-trace critical-path view: the top-K traces
+by total latency, each with its span count and SLOWEST stage — the
+"which stage made this request/step slow" question answered from the
+artifact alone, no live repro.
+
 Usage::
 
     python tools/telemetry_report.py telemetry.jsonl [--json]
+        [--traces [K]]
 """
 from __future__ import annotations
 
@@ -75,6 +83,54 @@ def aggregate(lines):
     return out
 
 
+def trace_summary(lines, top=10):
+    """Fold trace-linked observations into the per-trace critical-path
+    view: ``[{trace, total, spans, slowest, slowest_s, slowest_frac,
+    stages}]`` sorted by total latency, truncated to ``top``.
+
+    Total latency is the sum of ROOT-level stages (``parent == 0``) —
+    for a served request those are exactly the breakdown stages
+    (submit + queue-wait + pad + predict + fetch + deliver ≈ e2e), for a
+    training step the ``trainer.step`` span itself; nested child spans
+    must not double-count into the total but DO compete for slowest."""
+    traces = {}
+    for rec in lines:
+        if rec.get("kind") != "obs" or rec.get("trace") is None:
+            continue
+        t = traces.setdefault(rec["trace"], {"stages": [], "root_s": 0.0})
+        v = float(rec["value"])
+        t["stages"].append((rec["metric"], v))
+        if not rec.get("parent"):
+            t["root_s"] += v
+    rows = []
+    for tid, t in traces.items():
+        total = t["root_s"] or sum(v for _, v in t["stages"])
+        agg = {}
+        for name, v in t["stages"]:
+            agg[name] = agg.get(name, 0.0) + v
+        slowest = max(agg.items(), key=lambda kv: kv[1])
+        rows.append({"trace": tid, "total": total,
+                     "spans": len(t["stages"]),
+                     "slowest": slowest[0], "slowest_s": slowest[1],
+                     "slowest_frac": slowest[1] / total if total else 0.0,
+                     "stages": agg})
+    rows.sort(key=lambda r: -r["total"])
+    return rows[:top]
+
+
+def format_trace_table(rows):
+    if not rows:
+        return "(no trace-linked records — is MXTPU_TRACE on?)"
+    lines = ["%-14s %10s %6s  %-28s %10s %6s" %
+             ("Trace", "Total(ms)", "Spans", "Slowest stage", "ms", "%")]
+    for r in rows:
+        lines.append("%-14s %10.3f %6d  %-28s %10.3f %5.1f%%" %
+                     (r["trace"], r["total"] * 1e3, r["spans"],
+                      r["slowest"], r["slowest_s"] * 1e3,
+                      r["slowest_frac"] * 100))
+    return "\n".join(lines)
+
+
 def load(path):
     records = []
     with open(path) as f:
@@ -114,17 +170,34 @@ def format_table(summary):
 
 
 def main(argv):
+    argv = list(argv)
+    as_json = "--json" in argv
+    top = None
+    if "--traces" in argv:
+        top = 10
+        nxt = argv.index("--traces") + 1
+        if nxt < len(argv) and argv[nxt].isdigit():
+            # consume the count token BY INDEX: a data file that happens
+            # to be named like the number must not be dropped from paths
+            top = int(argv.pop(nxt))
     paths = [a for a in argv if not a.startswith("-")]
     if not paths or "-h" in argv or "--help" in argv:
         print(__doc__)
         return 0 if "-h" in argv or "--help" in argv else 1
-    as_json = "--json" in argv
     path = paths[0]
-    summary = aggregate(load(path))
+    records = load(path)
+    summary = aggregate(records)
+    traces = trace_summary(records, top=top) if top is not None else None
     if as_json:
-        print(json.dumps(summary, sort_keys=True))
+        out = dict(summary)
+        if traces is not None:
+            out["_traces"] = traces
+        print(json.dumps(out, sort_keys=True))
     else:
         print(format_table(summary))
+        if traces is not None:
+            print()
+            print(format_trace_table(traces))
     return 0
 
 
