@@ -814,6 +814,46 @@ def bench_serving(emit=None):
     }
 
 
+def bench_serving_decode(emit=None):
+    """Continuous-batching autoregressive decode (mxtpu/serving/decode,
+    ISSUE 11): ``tools/serve_bench.py --mode decode`` driven in-process.
+    The A/B the ROADMAP item names: continuous batching vs
+    restart-per-batch at equal cohort capacity on identical executables,
+    plus the int8 logits-parity and KV-bytes gates. ``vs_baseline`` is
+    the continuous-vs-restart tokens/s speedup when EVERY gate holds
+    (strictly > 1 continuous win, zero post-warmup compiles at
+    ``serving.decode``, zero in-loop d2h, int8 parity + <= ~half KV
+    bytes), else 0.0."""
+    if emit is None:
+        emit = _emit
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import serve_bench as sb
+
+    rec = sb.run_decode(
+        n_requests=int(os.environ.get("BENCH_DECODE_REQUESTS", "80")),
+        slots=int(os.environ.get("BENCH_DECODE_SLOTS", "8")),
+        max_new=int(os.environ.get("BENCH_DECODE_MAX_NEW", "32")),
+        emit=emit)
+    return {
+        "metric": "serving_decode",
+        "value": round(rec["continuous"]["tok_per_s"], 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(rec["speedup"], 3) if rec["ok"] else 0.0,
+        "mfu": None,
+        "hfu": None,
+        "restart_tok_per_s": round(rec["restart"]["tok_per_s"], 1),
+        "continuous_steps": rec["continuous"]["steps"],
+        "restart_steps": rec["restart"]["steps"],
+        "compiles_post_warmup": rec["continuous"]["compiles_post_warmup"],
+        "int8_tok_per_s": round(rec["int8"]["tok_per_s"], 1),
+        "prefill_logits_rel_err": round(rec["prefill_logits_rel_err"], 5),
+        "step_logits_rel_err": round(rec["step_logits_rel_err"], 5),
+        "kv_bytes_ratio": round(rec["kv_bytes_ratio"], 4),
+        "gates_ok": rec["ok"],
+    }
+
+
 def bench_multichip_resnet(emit=None):
     """Mesh-native Trainer scaling (ISSUE 7): resnet18 data-parallel over
     1..N devices through ``gluon.Trainer(mesh=...)`` with ZeRO-1 on, at a
@@ -1153,6 +1193,7 @@ CONFIGS = {
     "telemetry_overhead": bench_telemetry_overhead,
     "conv_class": bench_conv_class,
     "serving": bench_serving,
+    "serving_decode": bench_serving_decode,
     "multichip_resnet": bench_multichip_resnet,
     "input_pipeline": bench_input_pipeline,
     "sparse_linear": bench_sparse_linear,

@@ -22,6 +22,14 @@ from .registry import register
 _QMAX = 127.0
 
 
+def _f32(x):
+    # NOT jnp.float32(x): that is numpy's scalar type, whose __call__
+    # concretizes — a traced range (the serving int8 path passes scales
+    # as jit arguments so a param reload never recompiles) would raise
+    # ConcretizationTypeError. asarray casts tracers and scalars alike.
+    return jnp.asarray(x, jnp.float32)
+
+
 def _real_range(min_range, max_range):
     return jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
 
@@ -31,7 +39,7 @@ def quantize(data, min_range, max_range, out_type="int8"):
     """f32 -> int8 + (min, max) carried through (ref: quantize.cc).
     Returns [quantized, min_range, max_range] like the reference's 3-output
     convention so downstream quantized ops see the calibration range."""
-    r = _real_range(jnp.float32(min_range), jnp.float32(max_range))
+    r = _real_range(_f32(min_range), _f32(max_range))
     scale = _QMAX / r
     x = jnp.asarray(data, jnp.float32)
     q = jnp.sign(x) * jnp.minimum(jnp.abs(x) * scale + 0.5, _QMAX)
@@ -42,7 +50,7 @@ def quantize(data, min_range, max_range, out_type="int8"):
 @register("_contrib_dequantize", aliases=("dequantize",))
 def dequantize(data, min_range, max_range, out_type="float32"):
     """int8 -> f32 (ref: dequantize.cc)."""
-    r = _real_range(jnp.float32(min_range), jnp.float32(max_range))
+    r = _real_range(_f32(min_range), _f32(max_range))
     return jnp.asarray(data, jnp.float32) * (r / _QMAX)
 
 
@@ -51,11 +59,11 @@ def requantize(data, min_range, max_range, min_calib_range=None,
                max_calib_range=None):
     """int32 (accumulator) -> int8 with a narrower calibrated range
     (ref: requantize.cc). min/max_range describe the int32's real range."""
-    r32 = _real_range(jnp.float32(min_range), jnp.float32(max_range))
+    r32 = _real_range(_f32(min_range), _f32(max_range))
     real = jnp.asarray(data, jnp.float32) * (r32 / (2.0 ** 31 - 1))
     if min_calib_range is not None and max_calib_range is not None:
-        r8 = _real_range(jnp.float32(min_calib_range),
-                         jnp.float32(max_calib_range))
+        r8 = _real_range(_f32(min_calib_range),
+                         _f32(max_calib_range))
     else:
         r8 = r32
     q = jnp.sign(real) * jnp.minimum(jnp.abs(real) * (_QMAX / r8) + 0.5,
@@ -84,8 +92,8 @@ def quantized_fully_connected(data, weight, bias=None, min_data=None,
     acc = lax.dot_general(x, jnp.asarray(weight, jnp.int8),
                           (((x.ndim - 1,), (1,)), ((), ())),
                           preferred_element_type=jnp.int32)
-    sx = _real_range(jnp.float32(min_data), jnp.float32(max_data)) / _QMAX
-    sw = _real_range(jnp.float32(min_weight), jnp.float32(max_weight)) / _QMAX
+    sx = _real_range(_f32(min_data), _f32(max_data)) / _QMAX
+    sw = _real_range(_f32(min_weight), _f32(max_weight)) / _QMAX
     out = acc.astype(jnp.float32) * (sx * sw)
     if bias is not None and not no_bias:
         out = out + jnp.asarray(bias, jnp.float32)
@@ -112,8 +120,8 @@ def quantized_conv(data, weight, bias=None, min_data=None, max_data=None,
         rhs_dilation=dilate, dimension_numbers=dims,
         feature_group_count=num_group,
         preferred_element_type=jnp.int32)
-    sx = _real_range(jnp.float32(min_data), jnp.float32(max_data)) / _QMAX
-    sw = _real_range(jnp.float32(min_weight), jnp.float32(max_weight)) / _QMAX
+    sx = _real_range(_f32(min_data), _f32(max_data)) / _QMAX
+    sw = _real_range(_f32(min_weight), _f32(max_weight)) / _QMAX
     out = acc.astype(jnp.float32) * (sx * sw)
     if bias is not None and not no_bias:
         b = jnp.asarray(bias, jnp.float32)

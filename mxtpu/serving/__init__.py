@@ -16,11 +16,22 @@ The TPU-native successor to the reference's C predict API
   probes — losing replicas degrades capacity instead of hanging;
 * :class:`ModelServer` (``server``) — stdlib-threaded HTTP front
   (``/predict`` ``/healthz`` ``/metrics``) with 503 shedding, per-replica
-  health reporting, and SIGTERM graceful drain.
+  health reporting, and SIGTERM graceful drain;
+* :class:`DecodeEngine` / :class:`KVCacheAccountant` (``decode``) — the
+  LLM workload class: prefill through the bucketed Predictor, then a
+  continuous-batching decode step loop over KV-cache-carrying slots
+  (one AOT donated executable per cohort bucket, pure replay; finished
+  sequences free slots between steps, queued prompts join the running
+  cohort without a recompile), with per-replica KV-residency admission
+  and an int8 weight+KV storage path (``MXTPU_SERVE_INT8``).
 """
 from .batcher import (DeadlineExceeded, MicroBatcher, QueueFull,
                       max_batch_default, max_wait_ms_default, queue_default)
-from .engine import BucketSpec, Predictor, pad_nd
+from .decode import (DecodeEngine, DecodeFuture, DecodeModel,
+                     KVCacheAccountant, decode_max_new_default,
+                     decode_queue_default, decode_slots_default,
+                     kv_overcommit_default)
+from .engine import BucketSpec, Predictor, pad_nd, serve_int8_default
 from .replicas import (Replica, ReplicaDispatcher, ReplicaFailure,
                        ReplicaSet, breaker_backoff_max_ms_default,
                        breaker_backoff_ms_default, breaker_threshold_default,
@@ -30,6 +41,10 @@ from .server import ModelServer
 __all__ = ["BucketSpec", "Predictor", "pad_nd", "MicroBatcher",
            "QueueFull", "DeadlineExceeded", "ModelServer",
            "Replica", "ReplicaSet", "ReplicaDispatcher", "ReplicaFailure",
+           "DecodeEngine", "DecodeFuture", "DecodeModel",
+           "KVCacheAccountant", "serve_int8_default",
+           "decode_slots_default", "decode_queue_default",
+           "decode_max_new_default", "kv_overcommit_default",
            "max_batch_default", "max_wait_ms_default", "queue_default",
            "replica_count_default", "dispatch_timeout_ms_default",
            "breaker_threshold_default", "breaker_backoff_ms_default",

@@ -143,8 +143,15 @@ class MicroBatcher:
 
     def __init__(self, predictor, max_batch_size=None, max_wait_ms=None,
                  max_queue=None, clock=time.monotonic, start=True,
-                 allow_cold=False):
+                 allow_cold=False, admission_gate=None):
         self._pred = predictor
+        # optional admission hook beyond queue depth: called with the
+        # request's item count, returns a shed-reason string to refuse or
+        # None to admit — how the KVCacheAccountant makes overload shed
+        # by KV residency (decode.py:KVCacheAccountant.gate), and the
+        # seam any resource ledger (device memory, SLO predictor) plugs
+        # into without subclassing
+        self._gate = admission_gate
         self.max_batch = int(max_batch_size if max_batch_size is not None
                              else max_batch_default())
         self.max_wait_s = float(max_wait_ms if max_wait_ms is not None
@@ -213,6 +220,10 @@ class MicroBatcher:
                 if inputs[0].ndim > spec.seq_axis else 0)
         if inject("serve_overload"):
             self._shed("injected_overload")
+        if self._gate is not None:
+            reason = self._gate(n)
+            if reason:
+                self._shed(str(reason))
         now = self._clock()
         deadline = None if deadline_ms is None else now + deadline_ms / 1e3
         req = _Request(inputs, n, bucket_key, deadline, now, trace)

@@ -1,0 +1,1421 @@
+"""Continuous-batching autoregressive decode: prefill/decode split + KV slots.
+
+The Predictor/MicroBatcher stack (PR 5/8) serves single-shot inference:
+one request, one padded forward, one answer. The LLM workload class is
+different — a request is a PROMPT plus a loop of single-token steps, and
+throughput comes from keeping a decode cohort full ACROSS steps, not from
+padding one batch. The PyGraph capture/replay economics (PAPERS.md:
+arXiv:2503.19779) say exactly how to build that on a jit stack: ONE
+ahead-of-time decode executable per cohort bucket, replayed thousands of
+times, with every per-step tensor living in-executable as donated carry
+state so a step is pure replay. This module is that engine:
+
+* **Prefill/decode split** — the prompt runs through the existing
+  bucketed :class:`~mxtpu.serving.engine.Predictor` path (seq buckets,
+  pad-up, device-side slice; compiles pinned at retrace site
+  ``serving.prefill``), producing the prompt's KV cache and first token.
+  Decode then runs the continuous-batching step loop below.
+* **KV-cache slot manager** — a fixed-capacity cohort (``BucketSpec
+  (decode_slots=...)``): each slot carries one sequence's KV cache,
+  current token, position, and remaining-token budget as DONATED jit
+  carry state. Finished sequences free their slot BETWEEN steps and
+  queued prefilled sequences join the RUNNING cohort without a
+  recompile: a slot insert is a device-side ``dynamic_update_slice``
+  with a *traced* slot index, so slot identity never enters a cache key.
+* **AOT bucket replay** — ``warmup()`` compiles one step executable per
+  cohort capacity bucket and one insert executable per prefill seq
+  bucket; after warmup, the ``serving.decode`` retrace site stays at
+  that count by construction (watchdog-pinned), and each step runs at
+  the smallest capacity bucket covering the live high-water slot.
+* **Zero d2h in the decode loop** — the step dispatch runs under a
+  d2h-armed ``serving.decode`` span (asserts zero syncs, exactly like
+  ``serving.predict``); the one declared fetch per step (sampled tokens
+  + done mask, two tiny vectors) happens outside it in the
+  ``serving.fetch`` span.
+* **KV residency accounting** — a :class:`KVCacheAccountant` tracks
+  per-replica KV bytes by cohort bucket and gates admission: overload
+  sheds by *KV residency* (``serving.shed{kv_residency}``), not just
+  queue depth. The same accountant plugs into
+  :class:`~mxtpu.serving.batcher.MicroBatcher` (``admission_gate=``) and
+  :class:`~mxtpu.serving.replicas.ReplicaSet` (``attach_accountant``).
+* **int8 path** — ``MXTPU_SERVE_INT8`` stores weights (Predictor) and
+  the KV cache (here) as symmetric int8 + per-row scales through
+  ``ops/quantization.py``, roughly halving resident bytes per replica —
+  the accountant then admits ~2x the sequences at equal memory.
+
+Model contract (:class:`DecodeModel`): a ``HybridBlock`` whose
+
+* ``forward(tokens[b, s])`` returns ``(logits[b, s, V], *kv[b, s, ...])``
+  — the PREFILL, served through the Predictor machinery unchanged;
+* ``decode_step(kv, tok, pos)`` (jnp-level, traced under the same
+  ``_run_traced`` machinery, parameters via ``self.<param>.data()``)
+  takes the cohort's KV leaves ``[c, L, ...]`` *without* this step's
+  token, the current tokens ``[c]`` and cache lengths ``[c]``, and
+  returns ``(logits[c, V], new_entries)`` — the k/v rows this token
+  appends, which the ENGINE persists at ``pos`` (and quantizes, in int8
+  mode). The model never touches slot bookkeeping.
+
+Failure semantics mirror PR 8: a decode step with no answer within
+``MXTPU_SERVE_DISPATCH_TIMEOUT_MS`` trips the wedge watchdog — the stuck
+sequences' futures fail loud, their trace_ids land in a
+``flight_record("decode_wedge", ...)`` artifact, the cohort carry state
+is re-allocated, and the engine keeps serving the queue. An injected
+``decode_wedge`` fault drives the whole path sleep-free under a fake
+clock.
+"""
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import telemetry
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..resilience import inject
+from .batcher import DeadlineExceeded, QueueFull, _Future
+from .engine import _TRACE_LOCK, BucketSpec, Predictor, serve_int8_default
+from .replicas import dispatch_timeout_ms_default
+
+__all__ = ["DecodeModel", "DecodeEngine", "DecodeFuture", "KVCacheAccountant",
+           "decode_slots_default", "decode_queue_default",
+           "decode_max_new_default", "kv_overcommit_default"]
+
+_log = logging.getLogger("mxtpu.serving")
+
+
+# ------------------------------------------------------------------ policies
+def decode_slots_default():
+    """Decode-cohort capacity when no ``decode_spec`` is passed
+    (``MXTPU_DECODE_SLOTS``, default 8): the engine declares
+    ``BucketSpec.pow2(decode_slots=<this>)`` — capacity is also per-slot
+    KV bytes x slots of resident HBM, so size it to the memory budget,
+    not the offered load (the queue + accountant absorb bursts)."""
+    return int(os.environ.get("MXTPU_DECODE_SLOTS", "8"))
+
+
+def decode_queue_default():
+    """Pending-sequence admission bound (``MXTPU_DECODE_QUEUE``, default
+    256): submits beyond it shed (``QueueFull`` -> 503) instead of
+    growing time-to-first-token without bound."""
+    return int(os.environ.get("MXTPU_DECODE_QUEUE", "256"))
+
+
+def decode_max_new_default():
+    """Generation budget when a request names none
+    (``MXTPU_DECODE_MAX_NEW``, default 32); generation always also stops
+    at the engine's ``max_len`` cache bound and at ``eos_id``."""
+    return int(os.environ.get("MXTPU_DECODE_MAX_NEW", "32"))
+
+
+def kv_overcommit_default():
+    """Admitted-sequence overcommit as a multiple of KV pool capacity
+    (``MXTPU_SERVE_KV_OVERCOMMIT``, default 2.0): the accountant admits
+    (live + queued) sequences up to overcommit x capacity slots — enough
+    queue to keep slots full across completions, bounded enough that
+    time-to-first-token stays finite under overload."""
+    return float(os.environ.get("MXTPU_SERVE_KV_OVERCOMMIT", "2.0"))
+
+
+class DecodeFuture(_Future):
+    """A decode request's completion handle: ``result()`` returns the
+    generated token ids (int32 numpy, eos included when hit). Carries the
+    trace identity of the batcher futures plus ``ttft_s`` — the
+    time-to-first-token the open-loop bench curves plot."""
+
+    __slots__ = ("ttft_s",)
+
+    def __init__(self):
+        super().__init__()
+        self.ttft_s = None
+
+
+class _Sequence:
+    __slots__ = ("prompt", "max_new", "deadline", "t_enq", "trace", "future",
+                 "tokens", "slot")
+
+    def __init__(self, prompt, max_new, deadline, t_enq, trace):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.deadline = deadline
+        self.t_enq = t_enq
+        self.trace = trace
+        self.future = DecodeFuture()
+        self.tokens = []
+        self.slot = None
+
+
+class DecodeModel:
+    """Marker/contract mixin for autoregressive decode (see the module
+    docstring). Concrete models subclass both ``gluon.HybridBlock`` and
+    this, implement the prefill ``hybrid_forward`` returning
+    ``(logits[b, s, V], *kv[b, s, ...])``, and implement
+    :meth:`decode_step`. ``tools/serve_bench.py:build_decode_model`` is
+    the executable reference implementation."""
+
+    def decode_step(self, kv, tok, pos):
+        """One decode step (jnp-level, traced): ``kv`` — list of cache
+        leaves ``[c, L, ...]`` in compute dtype, WITHOUT this step's
+        token; ``tok[c]`` int32 current tokens; ``pos[c]`` int32 cache
+        lengths (this token's position). Returns ``(logits[c, V],
+        entries)`` where ``entries`` is the per-leaf list of new k/v rows
+        ``[c, ...]`` — the engine persists them at ``pos``."""
+        raise NotImplementedError
+
+
+# ----------------------------------------------------------- KV accounting
+class KVCacheAccountant:
+    """Per-replica KV residency ledger feeding admission control.
+
+    Engines (or any KV-carrying server) :meth:`register` their pool —
+    per-slot bytes x capacity slots, tagged per replica like the
+    ``serving.predict.r<i>`` retrace sites. Admission then asks
+    :meth:`would_admit`: a sequence is admitted while (live + queued)
+    slots stay under ``overcommit`` x capacity; past that the submit
+    sheds ``serving.shed{kv_residency}`` — the overload signal is *KV
+    residency*, not queue depth, so a fleet dispatcher can route by how
+    much cache memory a replica actually has left. Gauges:
+    ``serving.kv_capacity_bytes`` / ``serving.kv_resident_bytes``
+    (resident = live slots only; queued sequences hold no device bytes
+    yet). ``snapshot()`` (surfaced by ``/healthz``) reports per-tag
+    bytes plus the per-cohort-bucket byte ladder."""
+
+    def __init__(self, capacity_bytes=None, overcommit=None):
+        self._lock = threading.Lock()
+        self._pools = {}
+        self._capacity_bytes = capacity_bytes
+        self._overcommit = float(overcommit if overcommit is not None
+                                 else kv_overcommit_default())
+
+    def register(self, tag, per_slot_bytes, slots, bucket_slots=()):
+        """Declare (or re-declare) a replica's KV pool. ``bucket_slots``
+        is the cohort capacity ladder, so the snapshot can report bytes
+        by bucket."""
+        with self._lock:
+            cap = self._capacity_bytes
+            if cap is None:
+                cap = int(per_slot_bytes) * int(slots)
+            self._pools[tag] = {
+                "per_slot_bytes": int(per_slot_bytes),
+                "slots": int(slots),
+                "capacity_bytes": int(cap),
+                "live": 0, "queued": 0,
+                "bucket_bytes": {int(b): int(b) * int(per_slot_bytes)
+                                 for b in bucket_slots},
+            }
+            self._gauges_locked()
+
+    def _gauges_locked(self):
+        telemetry.gauge("serving.kv_capacity_bytes",
+                        sum(p["capacity_bytes"]
+                            for p in self._pools.values()))
+        telemetry.gauge("serving.kv_resident_bytes",
+                        sum(p["live"] * p["per_slot_bytes"]
+                            for p in self._pools.values()))
+
+    def _pool(self, tag):
+        p = self._pools.get(tag)
+        if p is None:
+            raise MXNetError("KVCacheAccountant: unregistered pool %r "
+                             "(register() at engine warmup)" % (tag,))
+        return p
+
+    def would_admit(self, tag, n=1):
+        """True while ``n`` more sequences fit the overcommit bound.
+        Unregistered tags admit (a Predictor-only replica holds no KV)."""
+        with self._lock:
+            p = self._pools.get(tag)
+            if p is None:
+                return True
+            have = p["live"] + p["queued"] + n
+            return have * p["per_slot_bytes"] <= \
+                p["capacity_bytes"] * self._overcommit
+
+    def try_admit(self, tag, n=1):
+        """Atomic check-and-admit: the overcommit test and the queued
+        increment happen under ONE lock hold, so concurrent submits
+        cannot all pass a stale check and overshoot the bound (the
+        DecodeEngine's admission path). Unregistered tags admit.
+        Returns True when admitted (the caller owes a matching
+        occupy/unqueue), False to shed."""
+        with self._lock:
+            p = self._pools.get(tag)
+            if p is None:
+                return True
+            have = p["live"] + p["queued"] + n
+            if have * p["per_slot_bytes"] > \
+                    p["capacity_bytes"] * self._overcommit:
+                return False
+            p["queued"] += n
+            return True
+
+    def unqueue(self, tag):
+        """An admitted sequence left the queue without taking a slot
+        (expired / shed / engine crash)."""
+        with self._lock:
+            p = self._pool(tag)
+            p["queued"] = max(0, p["queued"] - 1)
+
+    def occupy(self, tag):
+        """A queued sequence took a KV slot (bytes now resident)."""
+        with self._lock:
+            p = self._pool(tag)
+            p["queued"] = max(0, p["queued"] - 1)
+            p["live"] += 1
+            self._gauges_locked()
+
+    def release(self, tag):
+        """A live sequence finished; its slot's bytes are free again."""
+        with self._lock:
+            p = self._pool(tag)
+            p["live"] = max(0, p["live"] - 1)
+            self._gauges_locked()
+
+    def resident_bytes(self, tag=None):
+        """Live KV bytes for one tag (0 when unregistered) or all pools."""
+        with self._lock:
+            pools = [self._pools.get(tag)] if tag is not None \
+                else list(self._pools.values())
+            return sum(p["live"] * p["per_slot_bytes"] for p in pools
+                       if p is not None)
+
+    def gate(self, tag):
+        """An ``admission_gate=`` callable for a
+        :class:`~mxtpu.serving.batcher.MicroBatcher` guarding ``tag``'s
+        pool: returns the shed reason ``kv_residency`` when the pool is
+        over budget, None when admissible."""
+        def _gate(_n_items):
+            return None if self.would_admit(tag) else "kv_residency"
+        return _gate
+
+    def snapshot(self):
+        """JSON-serializable per-tag view (``/healthz`` surfaces this)."""
+        with self._lock:
+            out = {}
+            for tag, p in self._pools.items():
+                out[tag] = {
+                    "capacity_bytes": p["capacity_bytes"],
+                    "per_slot_bytes": p["per_slot_bytes"],
+                    "slots": p["slots"],
+                    "live": p["live"],
+                    "queued": p["queued"],
+                    "resident_bytes": p["live"] * p["per_slot_bytes"],
+                    "bucket_bytes": dict(p["bucket_bytes"]),
+                }
+            return out
+
+
+def _bcast(mask, ndim):
+    """Broadcast a [b] mask against a [b, ...] value."""
+    return mask.reshape(mask.shape + (1,) * (ndim - 1))
+
+
+def _quantize_rows(x):
+    """Per-row symmetric int8 through the quantization op: range = max|x|
+    over each row's trailing axes (degenerate rows quantize on a unit
+    grid, so all-zero rows stay exactly zero). Returns ``(q int8, r f32
+    [rows])`` — THE one KV grid rule, shared by the insert path and the
+    step write-back so the two can never desynchronize."""
+    from ..ops.registry import get_op
+    qfn = get_op("quantize").fn
+    xf = jnp.asarray(x, jnp.float32)
+    r = jnp.max(jnp.abs(xf), axis=tuple(range(1, xf.ndim))) \
+        if xf.ndim > 1 else jnp.abs(xf)
+    r = jnp.where(r > 0, r, 1.0)
+    q, _lo, _hi = qfn(xf, -_bcast(r, xf.ndim), _bcast(r, xf.ndim))
+    return q, r
+
+
+# ------------------------------------------------------------------- engine
+class DecodeEngine:
+    """The continuous-batching decode loop (see the module docstring).
+
+    ``prefill_spec`` is an ordinary seq-bucketed :class:`BucketSpec`
+    (prompts pad to their seq bucket through the Predictor);
+    ``decode_spec`` is the ``decode_slots=`` spelling (cohort capacity
+    buckets). ``start=True`` runs a background loop thread + wedge
+    monitor; ``start=False`` (tests, fake clock) drives everything
+    through :meth:`poll`. One engine owns one device's cohort — tag it
+    per replica (``replica_tag``) so the shared
+    :class:`KVCacheAccountant` ledgers match the ``serving.predict.r<i>``
+    site family."""
+
+    def __init__(self, model, prefill_spec, decode_spec=None, max_len=None,
+                 eos_id=None, example=None, warmup=True, name="decode",
+                 device=None, site="serving.decode",
+                 prefill_site="serving.prefill", int8=None,
+                 accountant=None, replica_tag="r0", max_queue=None,
+                 max_new_default=None, dispatch_timeout_ms=None,
+                 clock=time.monotonic, start=False, continuous=True):
+        if not hasattr(model, "decode_step"):
+            raise MXNetError(
+                "DecodeEngine serves DecodeModel-family blocks (got %s): "
+                "implement decode_step(kv, tok, pos) -> (logits, entries) "
+                "— docs/serving.md" % type(model).__name__)
+        if getattr(prefill_spec, "is_decode", False):
+            raise MXNetError(
+                "DecodeEngine prefill_spec is a decode-cohort spec %r — "
+                "prompts need batch x seq buckets (the Predictor path); "
+                "pass the capacity spec as decode_spec=" % (prefill_spec,))
+        if prefill_spec.seq_lens is None:
+            raise MXNetError(
+                "DecodeEngine prefill_spec declares no seq_lens: prompts "
+                "are variable-length and MUST be seq-bucketed (a prompt "
+                "past the largest bucket is refused, docs/serving.md)")
+        if decode_spec is None:
+            decode_spec = BucketSpec.pow2(decode_slots=decode_slots_default())
+        if not getattr(decode_spec, "is_decode", False):
+            raise MXNetError(
+                "DecodeEngine decode_spec must use the decode_slots= "
+                "spelling (got %r): cohort buckets are SLOT capacities, "
+                "not request batches" % (decode_spec,))
+        self._model = model
+        self._prefill_spec = prefill_spec
+        self._decode_spec = decode_spec
+        self._capacity = decode_spec.max_slots
+        self._max_new_default = int(max_new_default
+                                    if max_new_default is not None
+                                    else decode_max_new_default())
+        self._max_len = int(max_len if max_len is not None
+                            else prefill_spec.seq_lens[-1]
+                            + self._max_new_default)
+        if self._max_len < prefill_spec.seq_lens[-1] + 1:
+            raise MXNetError(
+                "DecodeEngine max_len=%d leaves no room to decode past "
+                "the largest prompt bucket (%d)"
+                % (self._max_len, prefill_spec.seq_lens[-1]))
+        self._eos = -1 if eos_id is None else int(eos_id)
+        self._name = name
+        self._site = site
+        self._int8 = serve_int8_default() if int8 is None else bool(int8)
+        self._acct = accountant
+        self._tag = replica_tag
+        self._max_queue = int(max_queue if max_queue is not None
+                              else decode_queue_default())
+        self._timeout_s = float(
+            dispatch_timeout_ms if dispatch_timeout_ms is not None
+            else dispatch_timeout_ms_default()) / 1e3
+        self._clock = clock
+        self._continuous = bool(continuous)
+        if example is None:
+            example = np.zeros((1, prefill_spec.seq_lens[0]), np.int32)
+        self._pred = Predictor(model, prefill_spec, example=example,
+                               warmup=False, name=name + ".prefill",
+                               device=device, site=prefill_site,
+                               int8=self._int8)
+        self._jits = {}            # (kind, bucket, int8, policy) -> jitted
+        self._kv_layout = None     # [(trailing_shape, dtype_str)] per leaf
+        self._vocab = None
+        self._carry = None
+        self._carry_gen = 0        # bumped by every wedge reset: a step
+        # dispatched against a superseded carry must not write back
+        self._last_logits = None   # most recent step's logits (device; the
+        # diagnostic parity hook — never fetched by the loop itself)
+        self._cond = threading.Condition()
+        self._pending = collections.deque()
+        self._slots = [None] * self._capacity
+        self._inflight_seq = None  # popped from _pending, not yet slotted
+        # (mid-prefill): drain/close must not treat the engine as empty
+        self._live = 0
+        self._step_index = 0
+        self._armed = None         # the in-flight step's watchdog entry
+        self._prefill_armed = None  # the in-flight prefill/insert's entry
+        self._cycles = 0           # loop/poll progress counter (probation)
+        self._probation = None     # (deadline, cycles-at-trip) after a wedge
+        self._closed = False
+        self._draining = False
+        self._crashed = False
+        self._thread = None
+        self._monitor = None
+        self._stop = threading.Event()
+        if warmup:
+            self.warmup()
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------ properties
+    @property
+    def capacity(self):
+        return self._capacity
+
+    @property
+    def int8(self):
+        return self._int8
+
+    @property
+    def live_slots(self):
+        with self._cond:
+            return self._live
+
+    @property
+    def pending_count(self):
+        with self._cond:
+            return len(self._pending)
+
+    @property
+    def predictor(self):
+        """The prefill Predictor (its compiles report at
+        ``serving.prefill``)."""
+        return self._pred
+
+    @property
+    def accountant(self):
+        return self._acct
+
+    def per_slot_kv_bytes(self):
+        """Resident bytes one slot's KV cache costs (int8: quantized
+        leaves + per-position scale rows) — what the accountant ledgers."""
+        if self._kv_layout is None:
+            raise MXNetError("per_slot_kv_bytes before warmup()")
+        total = 0
+        for trail, dt in self._kv_layout:
+            n = self._max_len * int(np.prod(trail, dtype=np.int64) or 1)
+            if self._int8:
+                total += n * 1 + self._max_len * 4  # int8 rows + f32 scales
+            else:
+                total += n * jnp.dtype(dt).itemsize
+        return total
+
+    # ---------------------------------------------------------------- warmup
+    def warmup(self):
+        """Settle the prefill templates, derive the KV layout from one
+        probe forward, AOT-compile every prefill bucket, every cohort
+        step bucket, and every insert bucket, and allocate the cohort
+        carry. After this, a compile at ``serving.decode`` is a served
+        stall — the watchdog (and the serve_bench gate) pins the site at
+        its post-warmup count. Idempotent."""
+        if self._kv_layout is not None:
+            return self
+        flat, _fmt, _b = self._pred.predict_flat(
+            (np.zeros((1, self._prefill_spec.seq_lens[0]), np.int32),))
+        if len(flat) < 2:
+            raise MXNetError(
+                "DecodeModel forward must return (logits, *kv_leaves); "
+                "got %d output(s) — the KV cache IS the decode state"
+                % len(flat))
+        logits = flat[0]
+        if logits._data.ndim != 3:
+            raise MXNetError(
+                "DecodeModel prefill logits must be [batch, seq, vocab], "
+                "got shape %s" % (tuple(logits._data.shape),))
+        self._vocab = int(logits._data.shape[-1])
+        layout = []
+        for i, leaf in enumerate(flat[1:]):
+            d = leaf._data
+            if d.ndim < 2 or d.shape[1] != logits._data.shape[1]:
+                raise MXNetError(
+                    "DecodeModel kv leaf %d must be [batch, seq, ...] "
+                    "(got shape %s)" % (i, tuple(d.shape)))
+            layout.append((tuple(int(x) for x in d.shape[2:]),
+                           str(d.dtype)))
+        self._kv_layout = layout
+        self._pred.warmup()
+        self._carry = self._alloc_carry()
+        # AOT: one step executable per cohort capacity bucket (replayed
+        # on the all-inactive cohort — a no-op step), one insert
+        # executable per prefill seq bucket (max_new=0 marks the warmed
+        # slot done-at-insert, so warmup leaves no live slot behind).
+        # First invocations trace the shared block (parameters bind
+        # tracers): serialize across engines like the Predictor does.
+        with _TRACE_LOCK:
+            for b in self._decode_spec.decode_slots:
+                self._carry, emitted = self._get_step_jit(b)(
+                    self._carry, self._pred._param_datas,
+                    self._pred._param_ranges)
+                jax.block_until_ready(emitted[0])
+            V = self._vocab
+            for s in self._prefill_spec.seq_lens:
+                seq_kv = [jnp.zeros((1, s) + trail, dt)
+                          for trail, dt in layout]
+                # the probe forward's ACTUAL logits dtype: a bf16 model
+                # warmed against f32 zeros would hit the cached wrapper
+                # but retrace inside jax on the first real insert — a
+                # mid-serving compile stall invisible to record_retrace
+                zl = jnp.zeros((1, s, V), logits._data.dtype)
+                self._carry, out = self._get_insert_jit(s)(
+                    self._carry, seq_kv, zl,
+                    np.int32(0), np.int32(1), np.int32(0))
+                jax.block_until_ready(out)
+        telemetry.gauge("serving.decode.buckets",
+                        len(self._decode_spec.decode_slots)
+                        + len(self._prefill_spec.seq_lens))
+        if self._acct is not None:
+            self._acct.register(self._tag, self.per_slot_kv_bytes(),
+                                self._capacity,
+                                bucket_slots=self._decode_spec.decode_slots)
+        return self
+
+    def _alloc_carry(self):
+        C, L = self._capacity, self._max_len
+        if self._int8:
+            kv = [jnp.zeros((C, L) + trail, jnp.int8)
+                  for trail, _dt in self._kv_layout]
+            scales = [jnp.ones((C, L), jnp.float32)
+                      for _ in self._kv_layout]
+        else:
+            kv = [jnp.zeros((C, L) + trail, dt)
+                  for trail, dt in self._kv_layout]
+            scales = None
+        tok = jnp.zeros((C,), jnp.int32)
+        pos = jnp.zeros((C,), jnp.int32)
+        active = jnp.zeros((C,), jnp.bool_)
+        rem = jnp.zeros((C,), jnp.int32)
+        return (kv, scales, tok, pos, active, rem)
+
+    # ------------------------------------------------------------- compiling
+    def _build_jit(self, kind, bucket, build, donate=(0,)):
+        """The one compile front door for the decode cache: every miss is
+        reported to the retrace watchdog at this engine's site
+        (``serving.decode``; graftlint's JIT_ALLOWLIST declares the cache
+        since the site name is per-instance) BEFORE the build, exactly
+        like ``Predictor._get_jit`` — post-warmup the site count stays at
+        #cohort-buckets + #insert-buckets by construction."""
+        from ..ops.registry import policy_key
+        key = (kind, bucket, self._int8, policy_key())
+        hit = self._jits.get(key)
+        if hit is not None:
+            return hit
+        telemetry.record_retrace(
+            self._site,
+            {"engine": self._name, "kind": kind, "bucket": bucket,
+             "int8": self._int8, "capacity": self._capacity,
+             "max_len": self._max_len, "policy_key": list(key[3])})
+        jitted = jax.jit(build(), donate_argnums=donate)
+        self._jits[key] = jitted
+        return jitted
+
+    def _kv_read(self, kv, scales, b):
+        """The first ``b`` slots' caches in compute dtype (int8:
+        dequantized through the quantization op, per-position scale rows
+        broadcast against the trailing dims)."""
+        if not self._int8:
+            return [leaf[:b] for leaf in kv]
+        from ..ops.registry import get_op
+        deq = get_op("dequantize").fn
+        out = []
+        for (trail, dt), q, s in zip(self._kv_layout, kv, scales):
+            rb = s[:b].reshape((b, self._max_len) + (1,) * len(trail))
+            out.append(deq(q[:b], -rb, rb).astype(dt))
+        return out
+
+    def _kv_write_rows(self, kv, scales, entries, pos_b, act_b, b):
+        """Persist this step's new k/v rows at (slot, pos) — inactive
+        slots keep their old bytes (the model's row for them is
+        garbage). int8: per-row symmetric quantization through the
+        quantization op, scale rows ledgered next to the cache."""
+        idx = jnp.arange(b)
+        new_kv, new_scales = list(kv), None if scales is None \
+            else list(scales)
+        for i, entry in enumerate(entries):
+            if self._int8:
+                q, r = _quantize_rows(entry)
+                old_q = new_kv[i][idx, pos_b]
+                old_s = new_scales[i][idx, pos_b]
+                q = jnp.where(_bcast(act_b, q.ndim), q, old_q)
+                r = jnp.where(act_b, r, old_s)
+                new_kv[i] = new_kv[i].at[idx, pos_b].set(q)
+                new_scales[i] = new_scales[i].at[idx, pos_b].set(r)
+            else:
+                leaf = new_kv[i]
+                old = leaf[idx, pos_b]
+                row = jnp.where(_bcast(act_b, entry.ndim),
+                                entry.astype(leaf.dtype), old)
+                new_kv[i] = leaf.at[idx, pos_b].set(row)
+        return new_kv, new_scales
+
+    def _get_step_jit(self, b):
+        model, pred = self._model, self._pred
+        eos, max_len = self._eos, self._max_len
+        engine = self
+
+        def build():
+            fixed_key = jax.random.PRNGKey(0)
+
+            def pure(carry, param_datas, param_ranges):
+                from ..gluon.block import _run_traced
+                kv, scales, tok, pos, active, rem = carry
+                pds = pred._traced_params(param_datas, param_ranges)
+                act_b, tok_b, pos_b = active[:b], tok[:b], pos[:b]
+                kv_b = engine._kv_read(kv, scales, b)
+
+                def body():
+                    return model.decode_step(kv_b, tok_b, pos_b)
+
+                (logits, entries), _aux = _run_traced(
+                    pred._params, pds, fixed_key, False, body)
+                next_tok = jnp.argmax(
+                    jnp.asarray(logits, jnp.float32), axis=-1).astype(
+                        jnp.int32)
+                next_tok = jnp.where(act_b, next_tok, tok_b)
+                new_pos_b = jnp.where(act_b, pos_b + 1, pos_b)
+                rem_b = jnp.where(act_b, rem[:b] - 1, rem[:b])
+                done_b = act_b & ((next_tok == eos) | (rem_b <= 0)
+                                  | (new_pos_b >= max_len))
+                kv, scales = engine._kv_write_rows(kv, scales, entries,
+                                                   pos_b, act_b, b)
+                tok = tok.at[:b].set(next_tok)
+                pos = pos.at[:b].set(new_pos_b)
+                active = active.at[:b].set(act_b & ~done_b)
+                rem = rem.at[:b].set(rem_b)
+                return ((kv, scales, tok, pos, active, rem),
+                        (next_tok, done_b, logits))
+
+            return pure
+
+        return self._build_jit("step", b, build)
+
+    def _get_insert_jit(self, s):
+        """Slot insert for prefill seq bucket ``s``: a device-side
+        ``dynamic_update_slice`` of the prompt's KV into a TRACED slot
+        index — joining the running cohort never recompiles. Also samples
+        the first token from the prefill logits at the prompt's true
+        length (and marks the slot done-at-insert when that token already
+        ends the sequence), so time-to-first-token needs no decode step."""
+        eos, max_len = self._eos, self._max_len
+        engine = self
+
+        def build():
+            def pure(carry, seq_kv, logits, slot, n, max_new):
+                kv, scales, tok, pos, active, rem = carry
+                first = jnp.argmax(jnp.asarray(logits[0, n - 1],
+                                               jnp.float32)).astype(jnp.int32)
+                done0 = (first == eos) | (max_new <= 1) | (n >= max_len)
+                for i, leaf in enumerate(seq_kv):
+                    row = leaf[0]                      # [s, *trail]
+                    if engine._int8:
+                        q, r = _quantize_rows(row)
+                        kv[i] = lax.dynamic_update_slice(
+                            kv[i], q[None],
+                            (slot,) + (0,) * (kv[i].ndim - 1))
+                        scales[i] = lax.dynamic_update_slice(
+                            scales[i], r[None], (slot, 0))
+                    else:
+                        kv[i] = lax.dynamic_update_slice(
+                            kv[i], row[None].astype(kv[i].dtype),
+                            (slot,) + (0,) * (kv[i].ndim - 1))
+                tok = tok.at[slot].set(first)
+                pos = pos.at[slot].set(n)
+                active = active.at[slot].set(~done0)
+                rem = rem.at[slot].set(max_new - 1)
+                out = jnp.stack([first, done0.astype(jnp.int32)])
+                return (kv, scales, tok, pos, active, rem), out
+
+            return pure
+
+        return self._build_jit("insert", s, build)
+
+    def compile_stats(self):
+        """The watchdog's view of this engine's decode-cache compiles."""
+        return telemetry.retrace_stats(self._site)
+
+    # ------------------------------------------------------------- admission
+    def submit(self, prompt, max_new=None, deadline_ms=None):
+        """Admit one prompt (1-d int token ids). Returns a
+        :class:`DecodeFuture` whose ``result()`` is the generated int32
+        token array; sheds :class:`QueueFull` past the queue bound or
+        the accountant's KV-residency budget."""
+        trace = telemetry.new_trace()
+        t0 = time.perf_counter()
+        with telemetry.trace_handoff(trace), \
+                telemetry.span("serving.submit"):
+            seq = self._admit(prompt, max_new, deadline_ms, trace)
+        telemetry.add_stage(trace, "serving.submit",
+                            time.perf_counter() - t0)
+        return seq.future
+
+    def _admit(self, prompt, max_new, deadline_ms, trace):
+        if self._kv_layout is None:
+            # refuse at admission like start() does: a cold engine would
+            # otherwise crash opaquely inside the insert jit on a None
+            # carry at first poll
+            raise MXNetError("submit on a cold DecodeEngine: warmup() "
+                             "first (AOT replay needs its executables "
+                             "before traffic)")
+        prompt = np.asarray(prompt)
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise MXNetError("submit: prompt must be a non-empty 1-d "
+                             "token-id array, got shape %s"
+                             % (tuple(prompt.shape),))
+        if not np.issubdtype(prompt.dtype, np.integer):
+            raise MXNetError("submit: prompt dtype %s is not integer "
+                             "token ids" % prompt.dtype)
+        prompt = prompt.astype(np.int32)
+        self._prefill_spec.seq_bucket(prompt.size)  # loud past-max refusal
+        if prompt.size >= self._max_len:
+            raise MXNetError(
+                "submit: prompt of %d tokens leaves no room to decode "
+                "within max_len=%d" % (prompt.size, self._max_len))
+        max_new = int(max_new if max_new is not None
+                      else self._max_new_default)
+        if max_new < 1:
+            raise MXNetError("submit: max_new must be >= 1, got %d"
+                             % max_new)
+        now = self._clock()
+        deadline = None if deadline_ms is None else now + deadline_ms / 1e3
+        seq = _Sequence(prompt, max_new, deadline, now, trace)
+        if trace is not None:
+            # the trace identity rides the future from ADMISSION, not
+            # delivery: a sequence failed by the wedge watchdog must be
+            # correlatable with its flight-recorder artifact
+            seq.future.trace_id = trace.trace_id
+        with self._cond:
+            if self._crashed:
+                self._shed("worker_crashed")
+            if self._draining or self._closed:
+                self._shed("draining")
+            if len(self._pending) >= self._max_queue:
+                self._shed("queue_full")
+            if self._acct is not None:
+                # atomic check-and-ledger BEFORE the append, under the
+                # admission lock: the loop thread can pop (and
+                # occupy/unqueue) the sequence the instant the lock
+                # releases, and a separate check would let concurrent
+                # submits overshoot the overcommit bound
+                if not self._acct.try_admit(self._tag):
+                    self._shed("kv_residency")
+            self._pending.append(seq)
+            telemetry.gauge("serving.queue_depth",
+                            len(self._pending))
+            self._cond.notify_all()
+        telemetry.inc("serving.requests")
+        return seq
+
+    def _shed(self, reason):
+        telemetry.inc("serving.shed", tag=reason)
+        raise QueueFull("request shed: %s" % reason)
+
+    # --------------------------------------------------------------- serving
+    def poll(self):
+        """One engine cycle NOW (wedge scan -> slot admission -> one
+        decode step) — the fake-clock test hook and the no-thread drive.
+        Returns the number of decode steps executed (0 or 1)."""
+        self._scan_wedges(self._clock())
+        self._admit_pending()
+        steps = self._step_once()
+        with self._cond:
+            self._cycles += 1
+        return steps
+
+    def _free_slot_locked(self):
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit_pending(self):
+        """Move queued prompts into free slots: prefill through the
+        bucketed Predictor, then the device-side slot insert — between
+        steps, never mid-step, and never with a recompile (the insert
+        jit's slot index is traced). The continuous-batching half of the
+        throughput story: a restart-per-batch engine
+        (``continuous=False``) only refills once the WHOLE cohort
+        drained — the idle-slot steps it burns are exactly the tokens/s
+        gap serve_bench's decode gate measures."""
+        filling = False
+        while True:
+            with self._cond:
+                if not self._pending:
+                    return
+                if not self._continuous and self._live > 0 and not filling:
+                    # restart-per-batch: a draining cohort admits nobody —
+                    # but once it fully drains, the whole next cohort
+                    # fills in one pass (filling stays True for the rest
+                    # of this call)
+                    return
+                filling = True
+                slot = self._free_slot_locked()
+                if slot is None:
+                    return
+                seq = self._pending.popleft()
+                self._inflight_seq = seq
+                telemetry.gauge("serving.queue_depth", len(self._pending))
+            try:
+                now = self._clock()
+                if seq.deadline is not None and now > seq.deadline:
+                    telemetry.inc("serving.deadline_expired")
+                    if self._acct is not None:
+                        self._acct.unqueue(self._tag)
+                    self._fail(seq, DeadlineExceeded(
+                        "deadline passed before a KV slot freed (queued "
+                        "%.1f ms)" % ((now - seq.t_enq) * 1e3)))
+                    continue
+                telemetry.add_stage(seq.trace, "serving.queue_wait",
+                                    max(0.0, now - seq.t_enq), event=True)
+                try:
+                    self._prefill_into(seq, slot)
+                except Exception as e:  # noqa: BLE001 — complete, re-raise
+                    # the popped sequence is in neither _pending nor
+                    # _slots: without failing it HERE, the crash barrier
+                    # would strand its future forever and leak its
+                    # accountant queued count
+                    if seq.slot is None and not seq.future.done():
+                        if self._acct is not None:
+                            self._acct.unqueue(self._tag)
+                        self._fail(seq, MXNetError(
+                            "prefill failed: %s: %s"
+                            % (type(e).__name__, e)))
+                    raise
+            finally:
+                with self._cond:
+                    self._inflight_seq = None
+
+    def _prefill_into(self, seq, slot):
+        """Prefill one prompt and insert its KV into ``slot``. The
+        ``serving.prefill`` stage covers the bucketed prompt forward AND
+        the insert dispatch; the first token's fetch is the
+        ``serving.fetch`` d2h that makes TTFT a delivered fact, not a
+        device promise."""
+        n = int(seq.prompt.size)
+        s_bucket = self._prefill_spec.seq_bucket(n)
+        # pad HOST-side to the seq bucket: prompts arrive as host numpy
+        # with arbitrary raw lengths, and an eager device-side pad would
+        # compile one anonymous jnp.pad executable per distinct length —
+        # exactly the shape churn the bucket discipline exists to kill
+        prompt = seq.prompt if n == s_bucket else np.pad(
+            seq.prompt, (0, s_bucket - n),
+            constant_values=self._prefill_spec.pad_value)
+        # the prefill/insert dispatch is device work on the SAME possibly-
+        # wedged device the step loop replays: bracket it with its own
+        # watchdog entry, or a wedge here would hang the loop thread with
+        # no detection at all (the step watchdog only covers steps)
+        p_entry = {"seq": seq, "deadline": self._clock() + self._timeout_s,
+                   "done": False, "abandoned": False}
+        with self._cond:
+            self._prefill_armed = p_entry
+        try:
+            with telemetry.trace_handoff(seq.trace):
+                t0 = time.perf_counter()
+                flat, _fmt, _b = self._pred.predict_flat((prompt[None, :],))
+                # numpy scalars, NOT jnp — a jnp.int32() call is an eager
+                # device op per argument, three per insert adds up
+                out, gen, superseded = self._dispatch_carry(
+                    self._get_insert_jit(s_bucket),
+                    [leaf._data for leaf in flat[1:]], flat[0]._data,
+                    np.int32(slot), np.int32(n), np.int32(seq.max_new))
+                if superseded:
+                    # a wedge reset landed mid-insert: this prompt's KV
+                    # went into the superseded carry — a wedge casualty,
+                    # failed loud like the cohort it would have joined
+                    self._fail_wedge_casualty(seq)
+                    return
+                telemetry.add_stage(seq.trace, "serving.prefill",
+                                    time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                with telemetry.span("serving.fetch", cat="sync"):
+                    first_done = NDArray(out).asnumpy()
+                telemetry.add_stage(seq.trace, "serving.fetch",
+                                    time.perf_counter() - t0)
+        finally:
+            with self._cond:
+                p_entry["done"] = True
+                if self._prefill_armed is p_entry:
+                    self._prefill_armed = None
+        if seq.future.done():
+            # a teardown (wedge trip, crash barrier, close) settled this
+            # sequence while the device answered late: delivering or
+            # touching the ledger again would double-count
+            return
+        seq.tokens.append(int(first_done[0]))
+        ttft = self._clock() - seq.t_enq
+        seq.future.ttft_s = ttft
+        telemetry.observe("serving.ttft_s", ttft)
+        telemetry.inc("serving.decode.tokens")
+        if int(first_done[1]):
+            # done at insert (eos / max_new==1): the slot was marked
+            # inactive in-executable; deliver without ever stepping
+            if self._acct is not None:
+                self._acct.unqueue(self._tag)
+            self._deliver(seq)
+            return
+        with self._cond:
+            if self._carry_gen != gen or self._closed or self._crashed \
+                    or seq.future.done():
+                # a reset/teardown landed AFTER the write-back but BEFORE
+                # this registration — or the prefill watchdog already
+                # failed this sequence: the fresh carry has
+                # active[slot]=False (or the engine/future is gone), so
+                # registering would park it forever or double-ledger it
+                register = False
+            else:
+                register = True
+                seq.slot = slot
+                self._slots[slot] = seq
+                self._live += 1
+                telemetry.gauge("serving.decode.slots", self._live)
+                if self._acct is not None:
+                    # inside the lock: a reset landing right after
+                    # registration must find the ledger already moved to
+                    # live, so its straggler release balances exactly
+                    self._acct.occupy(self._tag)
+        if not register:
+            self._fail_wedge_casualty(seq)
+            return
+
+    def _dispatch_carry(self, jitted, *args):
+        """THE wedge-safe carry dispatch protocol (one copy, shared by
+        the step and insert paths): snapshot carry + generation under the
+        lock, dispatch OUTSIDE it — on a wedged tunnel even the dispatch
+        can block (observed BENCH_r03-r05), and a blocked dispatch
+        holding ``self._cond`` would deadlock every submit and the
+        monitor's wedge scan, the exact moment it must run — then write
+        the new carry back only if no wedge reset superseded the
+        snapshot. Returns ``(emitted, gen, superseded)``; ``gen`` lets
+        the caller re-check for resets landing after its own write-back
+        (e.g. before slot registration)."""
+        with self._cond:
+            carry, gen = self._carry, self._carry_gen
+        new_carry, out = jitted(carry, *args)
+        with self._cond:
+            superseded = self._carry_gen != gen
+            if not superseded:
+                self._carry = new_carry
+        return out, gen, superseded
+
+    def _step_once(self):
+        """One decode step for the live cohort at its smallest covering
+        capacity bucket: pure replay of the AOT executable (donated
+        carry), zero d2h inside the armed ``serving.decode`` span; the
+        one declared fetch (sampled tokens + done mask) follows in
+        ``serving.fetch``; finished sequences free their slots before
+        the next admission pass."""
+        with self._cond:
+            if self._live == 0:
+                return 0
+            prev = self._armed
+            if prev is not None and not prev["done"] \
+                    and not prev["abandoned"]:
+                # a step is still in flight (a wedge in the making): a
+                # new dispatch must NOT clobber its watchdog entry — the
+                # unresolved entry would be discarded before it could
+                # trip and the wedge would be swallowed silently
+                return 0
+            hi = max(i for i, s in enumerate(self._slots)
+                     if s is not None) + 1
+            b = self._decode_spec.slot_bucket(hi)
+            live = [s for s in self._slots[:b] if s is not None]
+            idx = self._step_index
+            self._step_index += 1
+            entry = {"live": live, "idx": idx, "done": False,
+                     "abandoned": False,
+                     "deadline": self._clock() + self._timeout_s}
+            self._armed = entry
+        lead = live[0]
+        with telemetry.trace_handoff(lead.trace):
+            t0 = time.perf_counter()
+            wedged = inject("decode_wedge", idx)
+            if not wedged:
+                with telemetry.span("serving.decode", d2h=True):
+                    emitted, _gen, _sup = self._dispatch_carry(
+                        self._get_step_jit(b), self._pred._param_datas,
+                        self._pred._param_ranges)
+            dt = time.perf_counter() - t0
+            for s in live:
+                telemetry.add_stage(s.trace, "serving.decode", dt)
+            if wedged:
+                # simulated wedge: the device "never answers" — the entry
+                # stays armed and the watchdog scan (monitor thread, or
+                # the next poll under a fake clock) trips it
+                return 1
+            t0 = time.perf_counter()
+            with telemetry.span("serving.fetch", cat="sync"):
+                toks = NDArray(emitted[0]).asnumpy()
+                done = NDArray(emitted[1]).asnumpy()
+            dt = time.perf_counter() - t0
+            for s in live:
+                telemetry.add_stage(s.trace, "serving.fetch", dt)
+        with self._cond:
+            stale = entry["abandoned"]
+            entry["done"] = True
+            if self._armed is entry:
+                self._armed = None
+        if stale:
+            # the wedge watchdog already failed this cohort and reset the
+            # carry — a late answer must not resurrect freed slots, skew
+            # the replay counter, or leave superseded-carry logits in the
+            # diagnostic probe hook
+            return 1
+        self._last_logits = emitted[2]
+        telemetry.inc("serving.decode.steps")
+        self._harvest(live, toks, done)
+        return 1
+
+    def _harvest(self, live, toks, done):
+        finished = []
+        with self._cond:
+            for seq in live:
+                slot = seq.slot
+                seq.tokens.append(int(toks[slot]))
+                telemetry.inc("serving.decode.tokens")
+                if done[slot]:
+                    finished.append(seq)
+                    self._slots[slot] = None
+                    seq.slot = None
+                    self._live -= 1
+            telemetry.gauge("serving.decode.slots", self._live)
+            if finished:
+                self._cond.notify_all()
+        for seq in finished:
+            if self._acct is not None:
+                self._acct.release(self._tag)
+            self._deliver(seq)
+
+    def _deliver(self, seq):
+        done = self._clock()
+        t0 = time.perf_counter()
+        with telemetry.trace_handoff(seq.trace), \
+                telemetry.span("serving.deliver"):
+            seq.future._value = np.asarray(seq.tokens, np.int32)
+        telemetry.add_stage(seq.trace, "serving.deliver",
+                            time.perf_counter() - t0)
+        if seq.trace is not None:
+            seq.future.trace_id = seq.trace.trace_id
+            seq.future.breakdown = telemetry.trace_breakdown(seq.trace)
+            seq.future.e2e_s = done - seq.t_enq
+        seq.future._event.set()
+        telemetry.observe("serving.latency_s", done - seq.t_enq)
+
+    @staticmethod
+    def _fail(seq, error):
+        seq.future._error = error
+        seq.future._event.set()
+
+    def _fail_wedge_casualty(self, seq):
+        """Fail a mid-insert sequence whose carry was reset out from
+        under it (one copy for the write-back and registration checks —
+        the ledger call and the message must never diverge)."""
+        if seq.future.done():
+            return
+        if self._acct is not None:
+            self._acct.unqueue(self._tag)
+        self._fail(seq, DeadlineExceeded(
+            "cohort reset by the wedge watchdog during this prompt's "
+            "slot insert"))
+
+    def _collect_teardown_locked(self):
+        """Under ``self._cond``: collect EVERY unfinished sequence —
+        pending, slotted, and the popped-but-unregistered in-flight one
+        — clear the slot table and the armed entry, and return
+        ``(seqs, slotted_ids)``. One copy of the ledger-critical sweep
+        shared by the crash barrier and close(): the release-vs-unqueue
+        split and the slot-nulling must never diverge between them."""
+        dead = list(self._pending) + [s for s in self._slots
+                                      if s is not None]
+        slotted = {id(s) for s in self._slots if s is not None}
+        if self._inflight_seq is not None:
+            dead.append(self._inflight_seq)
+            self._inflight_seq = None
+        self._pending.clear()
+        for s in dead:
+            # a later scan/harvest must never see a freed sequence as
+            # still slotted (double-release, negative live count)
+            s.slot = None
+        self._slots = [None] * self._capacity
+        self._live = 0
+        if self._armed is not None:
+            self._armed["abandoned"] = True
+            self._armed = None
+        if self._prefill_armed is not None:
+            self._prefill_armed["abandoned"] = True
+            self._prefill_armed = None
+        # a late write-back / slot registration / done-at-insert from a
+        # thread that resumes after this teardown must see the carry as
+        # superseded — the sequences it would touch are failed HERE
+        self._carry_gen += 1
+        self._cond.notify_all()
+        return dead, slotted
+
+    def _fail_collected(self, dead, slotted, err):
+        for seq in dead:
+            if seq.future.done():
+                continue  # e.g. the in-flight seq a racing path handled
+            if self._acct is not None:
+                if id(seq) in slotted:
+                    self._acct.release(self._tag)
+                else:
+                    self._acct.unqueue(self._tag)
+            self._fail(seq, err)
+
+    # ------------------------------------------------------- wedge watchdog
+    def _check_probation(self, now):
+        """After a wedge trip in THREADED mode the loop thread may be
+        genuinely blocked inside the wedged device call — the one thread
+        that serves the queue. Probation gives it one full timeout window
+        to make loop progress; no progress means blocked-forever, and
+        shed-never-hang demands the crash barrier: fail the pending
+        queue loud, refuse new submits. (An injected wedge's loop thread
+        keeps cycling, so probation clears and serving resumes.)"""
+        with self._cond:
+            prob = self._probation
+            if prob is None:
+                return
+            deadline, cycles0 = prob
+            if self._cycles != cycles0:
+                self._probation = None   # loop progressed: recovered
+                return
+            if now < deadline:
+                return
+            self._probation = None
+        self._worker_crashed(RuntimeError(
+            "decode loop made no progress for %.0f ms after a wedge "
+            "trip — blocked inside the wedged device call"
+            % (self._timeout_s * 1e3)))
+
+    @staticmethod
+    def _entry_due(entry, now):
+        return entry is not None and not entry["done"] \
+            and not entry["abandoned"] and now >= entry["deadline"]
+
+    def _scan_wedges(self, now):
+        """A dispatch with no answer past the timeout is a wedged device:
+        a STEP wedge kills its slot cohort, a PREFILL/insert wedge kills
+        the in-flight prompt (and, since the same device carries the
+        cohort, everything slotted falls to the straggler sweep below).
+        Either way the stuck sequences fail LOUD (their futures raise,
+        their trace_ids land in the ``decode_wedge`` flight artifact) and
+        the carry re-allocates — the device state that never answered is
+        unrecoverable, the queue is not."""
+        self._check_probation(now)
+        with self._cond:
+            entry = self._armed
+            if self._entry_due(entry, now):
+                entry["abandoned"] = True
+                self._armed = None
+                kind, idx = "step", entry["idx"]
+                stuck = list(entry["live"])    # slotted: acct release
+                queued_stuck = []
+            else:
+                entry = self._prefill_armed
+                if not self._entry_due(entry, now):
+                    return
+                entry["abandoned"] = True
+                self._prefill_armed = None
+                kind, idx = "prefill", -1
+                stuck = []
+                queued_stuck = [entry["seq"]]  # never slotted: unqueue
+                # settle the casualty ATOMICALLY with the abandonment: a
+                # late-completing prefill on the loop thread checks
+                # future.done() under this same lock, so the ledger
+                # moves exactly once (failing it after the flight IO
+                # below would leave a window to register/deliver AND be
+                # unqueued — a double decrement)
+                seq = entry["seq"]
+                if not seq.future.done():
+                    if self._acct is not None:
+                        self._acct.unqueue(self._tag)
+                    self._fail(seq, DeadlineExceeded(
+                        "decode prefill dispatch wedged: no device "
+                        "answer within %.0f ms" % (self._timeout_s * 1e3)))
+            for seq in stuck:
+                if seq.slot is not None:
+                    self._slots[seq.slot] = None
+                    seq.slot = None
+                    self._live -= 1
+            telemetry.gauge("serving.decode.slots", self._live)
+        telemetry.inc("serving.decode.wedges")
+        _log.warning(
+            "serving: decode %s dispatch %d wedged (no answer in %.0f ms)"
+            " — failing %d stuck sequence(s), resetting the cohort carry",
+            kind, idx, self._timeout_s * 1e3,
+            len(stuck) + len(queued_stuck))
+        telemetry.flight_record(
+            "decode_wedge",
+            trace_ids=[s.trace.trace_id for s in stuck + queued_stuck
+                       if s.trace is not None],
+            extra={"kind": kind, "step": idx, "engine": self._name,
+                   "stuck": len(stuck) + len(queued_stuck),
+                   "timeout_ms": self._timeout_s * 1e3})
+        err = DeadlineExceeded(
+            "decode %s dispatch wedged: no device answer within %.0f ms"
+            % (kind, self._timeout_s * 1e3))
+        for seq in stuck:
+            telemetry.trace_mark(seq.trace, "serving.wedged")
+            if self._acct is not None:
+                self._acct.release(self._tag)
+            self._fail(seq, err)
+        for seq in queued_stuck:
+            telemetry.trace_mark(seq.trace, "serving.wedged")
+            if not seq.future.done():
+                if self._acct is not None:
+                    self._acct.unqueue(self._tag)
+                self._fail(seq, err)
+        with self._cond:
+            # the reset kills the WHOLE cohort device state: any live
+            # slot not in the armed entry (none under the single-driver
+            # model, but defensive) loses its KV too — fail it rather
+            # than leave it silently pointing at zeroed cache
+            stragglers = [s for s in self._slots if s is not None]
+            self._slots = [None] * self._capacity
+            self._live = 0
+            telemetry.gauge("serving.decode.slots", 0)
+            self._carry = self._alloc_carry()
+            self._carry_gen += 1
+            if self._thread is not None and self._thread.is_alive():
+                # threaded mode: the loop thread may be BLOCKED in the
+                # wedged device call — give it one timeout window to
+                # prove otherwise (see _check_probation)
+                self._probation = (now + self._timeout_s, self._cycles)
+            self._cond.notify_all()
+        for seq in stragglers:
+            if self._acct is not None:
+                self._acct.release(self._tag)
+            self._fail(seq, err)
+
+    # ---------------------------------------------------------------- worker
+    def start(self):
+        """Run the engine on a background loop thread + wedge monitor
+        (the threaded twin of :meth:`poll`). Returns self."""
+        if self._thread is not None:
+            return self
+        if self._kv_layout is None:
+            raise MXNetError("DecodeEngine.start on a cold engine: "
+                             "warmup() first (AOT replay needs its "
+                             "executables before traffic)")
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="mxtpu-serving-decode")
+        self._thread.start()
+        interval = max(0.005, min(0.25, self._timeout_s / 4))
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, args=(interval,), daemon=True,
+            name="mxtpu-serving-decode-monitor")
+        self._monitor.start()
+        return self
+
+    def _loop(self):
+        try:
+            while True:
+                with self._cond:
+                    while not self._pending and self._live == 0 \
+                            and not self._closed:
+                        self._cond.wait(0.25)
+                    if self._closed and not self._pending \
+                            and self._live == 0:
+                        return
+                self._admit_pending()
+                stepped = self._step_once()
+                with self._cond:
+                    # loop-progress heartbeat: what probation watches to
+                    # tell a cycling thread from one blocked in a wedged
+                    # device call
+                    self._cycles += 1
+                    if not stepped and self._live > 0:
+                        # live cohort but no step ran (unresolved armed
+                        # entry): park briefly instead of spinning until
+                        # the watchdog resolves it
+                        self._cond.wait(0.005)
+        except Exception as e:  # noqa: BLE001 — crash barrier (PR-8)
+            self._worker_crashed(e)
+
+    def _monitor_loop(self, interval):
+        while not self._stop.is_set():
+            self._scan_wedges(self._clock())
+            with self._cond:
+                if self._closed and not self._pending and self._live == 0:
+                    return
+            self._stop.wait(interval)
+
+    def _worker_crashed(self, exc):
+        """The decode loop died on an unexpected exception: fail every
+        pending and live future loud (their worker is gone) and refuse
+        new submits — the MicroBatcher crash-barrier discipline."""
+        telemetry.inc("serving.worker_crashes")
+        _log.error("serving decode loop crashed (%s: %s) — failing queued "
+                   "futures and refusing new submits",
+                   type(exc).__name__, exc)
+        err = MXNetError("serving decode loop crashed: %s: %s"
+                         % (type(exc).__name__, exc))
+        with self._cond:
+            self._crashed = True
+            dead, slotted = self._collect_teardown_locked()
+        telemetry.flight_record(
+            "worker_crash",
+            trace_ids=[s.trace.trace_id for s in dead
+                       if s.trace is not None],
+            extra={"engine": self._name,
+                   "error": "%s: %s" % (type(exc).__name__, exc)})
+        self._fail_collected(dead, slotted, err)
+
+    def drain(self, timeout=None):
+        """Stop admitting (submits shed ``draining``), finish pending +
+        live sequences. With no loop thread, outstanding work drains
+        synchronously through :meth:`poll` (deadline measured on the
+        injected clock). Returns True when empty."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        deadline = None if timeout is None else self._clock() + timeout
+        while True:
+            alive = self._thread is not None and self._thread.is_alive()
+            if not alive:
+                while self.poll():
+                    pass
+                self._admit_pending()
+            with self._cond:
+                if not self._pending and self._live == 0 \
+                        and self._inflight_seq is None:
+                    return True
+                if deadline is not None and self._clock() > deadline:
+                    return False
+                if not alive:
+                    return False
+                self._cond.wait(0.05)
+
+    def close(self, timeout=5.0):
+        """Drain, then stop the loop + monitor threads. Anything still
+        pending after the drain deadline fails loud rather than hanging
+        its callers."""
+        self.drain(timeout=timeout)
+        with self._cond:
+            self._closed = True
+            self._draining = True
+            self._cond.notify_all()
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if self._monitor is not None:
+            self._monitor.join(timeout)
+        # sweep AFTER the joins: only then can no loop iteration race the
+        # collection, and a popped-but-unregistered in-flight sequence (a
+        # loop thread killed mid-prefill) is caught too instead of
+        # leaving its future hanging forever
+        with self._cond:
+            leftovers, slotted = self._collect_teardown_locked()
+        self._fail_collected(leftovers, slotted,
+                             DeadlineExceeded("engine closed before "
+                                              "completion"))
+        return self
+
+    # ------------------------------------------------------------ diagnostics
+    def prefill_logits(self, prompt):
+        """Diagnostic: the prompt's last-position logits as numpy — the
+        int8-vs-f32 logits-parity gate's probe (serve_bench decode mode,
+        tests). NOT a serving path: it fetches device output directly."""
+        prompt = np.asarray(prompt, np.int32)
+        flat, _fmt, _b = self._pred.predict_flat((prompt[None, :],))
+        return np.asarray(flat[0]._data[0, prompt.size - 1])
+
+    def step_logits_probe(self, prompt):
+        """Diagnostic: prefill + insert into slot of a FRESH probe engine
+        state, run one decode step, and return that step's logits row —
+        the KV-path half of the int8 parity gate. Uses the engine's real
+        executables (the loop's own ``_last_logits`` output, which the
+        serving path never fetches), so the probe measures exactly what
+        production replays. Serialized against the loop: do not call
+        under live traffic."""
+        fut = self.submit(prompt, max_new=2)
+        for _ in range(64):
+            if fut.done():
+                break
+            self.poll()
+        if self._last_logits is None:
+            raise MXNetError("step_logits_probe: no decode step ran "
+                             "(prompt finished at insert?)")
+        out = np.asarray(self._last_logits[0])
+        fut.result(timeout=5.0)
+        return out

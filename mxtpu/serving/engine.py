@@ -49,7 +49,7 @@ from .. import telemetry
 from ..base import MXNetError
 from ..ndarray import NDArray
 
-__all__ = ["BucketSpec", "Predictor", "pad_nd"]
+__all__ = ["BucketSpec", "Predictor", "pad_nd", "serve_int8_default"]
 
 # Serializes the FIRST invocation of a freshly-built jit (the trace):
 # tracing runs the block body, which temporarily binds tracers into the
@@ -57,6 +57,21 @@ __all__ = ["BucketSpec", "Predictor", "pad_nd"]
 # (mxtpu/serving/replicas.py spawns one dispatch worker per replica)
 # would race on that binding. Warm-path calls never take this lock.
 _TRACE_LOCK = threading.RLock()
+
+
+def serve_int8_default():
+    """The int8 inference lever (``MXTPU_SERVE_INT8``, default 0): 1 =
+    serving stores weights (and decode KV caches) as symmetric int8 +
+    per-tensor scale, dequantized in-executable through
+    ``ops.quantization.dequantize`` — roughly half the resident bytes per
+    replica, so the KV accountant admits ~2x the sequences. Read at
+    Predictor/DecodeEngine CONSTRUCTION (host-side, not ``policy_key``):
+    the flag is baked per instance, so a mid-run env flip can never alias
+    an executable — it only affects predictors built after it."""
+    import os
+    # == "1" like every other boolean lever (MXTPU_PALLAS_CONV, ...):
+    # "false"/"off" must not silently enable quantization
+    return os.environ.get("MXTPU_SERVE_INT8", "0") == "1"
 
 
 def pad_nd(arr, batch, seq_len=None, seq_axis=1, pad_value=0):
@@ -106,32 +121,109 @@ class BucketSpec:
     the max seq bucket is refused — sequences, unlike batches, cannot be
     chunked without changing the model's semantics.
 
+    ``decode_slots`` is the third spelling (mutually exclusive with both
+    of the above): the CAPACITY buckets of a continuous-batching decode
+    cohort (:class:`~mxtpu.serving.decode.DecodeEngine`). A decode slot
+    carries KV-cache state across steps, so there is no seq axis to
+    bucket (the cache length is fixed at the engine's ``max_len``) and
+    no request batch to pad — the buckets say how many LIVE slots a step
+    executable covers. A decode spec cannot be served by a
+    :class:`Predictor` (and vice versa); both misuses refuse loudly.
+
     Guidance (docs/serving.md): powers of two up to the throughput knee
     of the model (``tools/serve_bench.py --mode sweep`` finds it);
     #buckets is also the startup compile count and the per-model
     executable-cache footprint, so keep it small (4-8 is typical).
     """
 
-    def __init__(self, batch_sizes, seq_lens=None, seq_axis=1, pad_value=0):
+    def __init__(self, batch_sizes=None, seq_lens=None, seq_axis=1,
+                 pad_value=0, decode_slots=None):
+        if decode_slots is not None:
+            # the decode-cohort spelling: capacity buckets ONLY — mixing
+            # in prefill-shape axes is a category error and must be as
+            # loud as the seq-refusal path (ISSUE 11 satellite)
+            if batch_sizes is not None:
+                raise MXNetError(
+                    "BucketSpec: decode_slots=%r cannot combine with "
+                    "batch_sizes=%r — a decode cohort's buckets ARE its "
+                    "slot capacities; prefill batch buckets belong to the "
+                    "separate prefill BucketSpec (docs/serving.md)"
+                    % (decode_slots, batch_sizes))
+            if seq_lens is not None:
+                raise MXNetError(
+                    "BucketSpec: decode_slots=%r cannot combine with "
+                    "seq_lens=%r — decode slots carry KV caches of the "
+                    "engine's fixed max_len; there is no seq axis to "
+                    "bucket (docs/serving.md)" % (decode_slots, seq_lens))
+            batch_sizes = decode_slots
+        elif batch_sizes is None:
+            raise MXNetError(
+                "BucketSpec: pass batch_sizes (a served shape set) or "
+                "decode_slots (a decode-cohort capacity set)")
         sizes = sorted({int(b) for b in batch_sizes})
         if not sizes or sizes[0] < 1:
-            raise MXNetError("BucketSpec: batch_sizes must be >= 1, got %r"
-                             % (batch_sizes,))
+            raise MXNetError("BucketSpec: %s must be >= 1, got %r"
+                             % ("decode_slots" if decode_slots is not None
+                                else "batch_sizes", batch_sizes))
         self.batch_sizes = tuple(sizes)
+        self.decode_slots = self.batch_sizes if decode_slots is not None \
+            else None
         self.seq_lens = tuple(sorted({int(s) for s in seq_lens})) \
             if seq_lens else None
         self.seq_axis = int(seq_axis)
         self.pad_value = pad_value
 
     @classmethod
-    def pow2(cls, max_batch, seq_lens=None, seq_axis=1):
-        """1, 2, 4, ... up to (and including) ``max_batch``."""
+    def pow2(cls, max_batch=None, seq_lens=None, seq_axis=1,
+             decode_slots=None):
+        """1, 2, 4, ... up to (and including) ``max_batch`` — or, with
+        ``decode_slots=n`` instead, the same ladder as decode-cohort
+        capacity buckets (``BucketSpec(decode_slots=[1, 2, ..., n])``)."""
+        if (max_batch is None) == (decode_slots is None):
+            raise MXNetError(
+                "BucketSpec.pow2: pass exactly one of max_batch (a "
+                "request-batch ladder) or decode_slots (a decode-cohort "
+                "capacity ladder), got max_batch=%r decode_slots=%r"
+                % (max_batch, decode_slots))
+        if decode_slots is not None and seq_lens is not None:
+            # same category error the constructor refuses — silently
+            # dropping the seq buckets would surface much later as a
+            # confusing spec-misuse refusal
+            raise MXNetError(
+                "BucketSpec.pow2: decode_slots=%r cannot combine with "
+                "seq_lens=%r — decode slots carry KV caches of the "
+                "engine's fixed max_len (docs/serving.md)"
+                % (decode_slots, seq_lens))
+        top = int(max_batch if max_batch is not None else decode_slots)
         sizes, b = [], 1
-        while b < int(max_batch):
+        while b < top:
             sizes.append(b)
             b *= 2
-        sizes.append(int(max_batch))
+        sizes.append(top)
+        if decode_slots is not None:
+            return cls(decode_slots=sizes)
         return cls(sizes, seq_lens=seq_lens, seq_axis=seq_axis)
+
+    @property
+    def is_decode(self):
+        """True for a decode-cohort spec (``decode_slots=`` spelling)."""
+        return self.decode_slots is not None
+
+    @property
+    def max_slots(self):
+        """Largest cohort capacity (decode specs only)."""
+        if not self.is_decode:
+            raise MXNetError("BucketSpec.max_slots on a non-decode spec "
+                             "(declare it with decode_slots=)")
+        return self.batch_sizes[-1]
+
+    def slot_bucket(self, n_live):
+        """Smallest capacity bucket >= n_live slots (decode specs only;
+        None when n_live exceeds the max capacity — the caller queues)."""
+        if not self.is_decode:
+            raise MXNetError("BucketSpec.slot_bucket on a non-decode spec "
+                             "(declare it with decode_slots=)")
+        return self.batch_bucket(n_live)
 
     @property
     def max_batch(self):
@@ -166,6 +258,8 @@ class BucketSpec:
         return len(self.batch_sizes) * len(self.seq_lens or (None,))
 
     def __repr__(self):
+        if self.is_decode:
+            return "BucketSpec(decode_slots=%s)" % (list(self.decode_slots),)
         return "BucketSpec(batch=%s%s)" % (
             list(self.batch_sizes),
             ", seq=%s@axis%d" % (list(self.seq_lens), self.seq_axis)
@@ -198,18 +292,29 @@ class Predictor:
     """
 
     def __init__(self, block, spec, example=None, warmup=False,
-                 name="predictor", device=None, site="serving.predict"):
+                 name="predictor", device=None, site="serving.predict",
+                 int8=None):
         if not hasattr(block, "_forward_eager"):
             raise MXNetError(
                 "Predictor serves HybridBlock-family models (got %s); wrap "
                 "symbols via Predictor.from_checkpoint" % type(block).__name__)
+        if getattr(spec, "is_decode", False):
+            raise MXNetError(
+                "Predictor cannot serve a decode-cohort BucketSpec "
+                "(decode_slots=%s): slot-capacity buckets describe a "
+                "continuous-batching DecodeEngine cohort, not request "
+                "shapes — declare batch_sizes/seq_lens for a Predictor "
+                "(docs/serving.md)" % (list(spec.decode_slots),))
         self._block = block
         self._spec = spec
         self._name = name
         self._device = device
         self._site = site
+        self._int8 = serve_int8_default() if int8 is None else bool(int8)
         self._params = None        # ordered list, fixed at first build
         self._param_datas = None
+        self._param_ranges = None  # per-param int8 range r (None = not quant)
+        self._param_qdtypes = None  # per-param original dtype (None = not q)
         self._templates = None     # [(trailing_shape, dtype)] per input
         self._jits = {}            # (padded shapes+dtypes, policy) -> (fn, cell)
         if example is not None:
@@ -233,16 +338,78 @@ class Predictor:
             raise MXNetError("Predictor: parameters still uninitialized "
                              "after the example forward")
         self._params = params
-        self._param_datas = self._place([p.data()._data for p in params])
+        self._snapshot_params()
         self._templates = [(tuple(a._data.shape[1:]), a._data.dtype)
                            for a in nds]
 
+    def _snapshot_params(self):
+        """Capture the parameter buffers the jits will run against —
+        int8-quantized when the lever is on (shared by _settle and
+        refresh_params, so a reload requantizes too)."""
+        datas, ranges, qdts = self._quantize_params(
+            [p.data()._data for p in self._params],
+            sticky=self._param_qdtypes)
+        self._param_datas = self._place(datas)
+        self._param_ranges = self._place(ranges)
+        self._param_qdtypes = qdts
+
+    def _quantize_params(self, datas, sticky=None):
+        """``MXTPU_SERVE_INT8`` weight storage: eligible parameter buffers
+        (floating, ndim >= 2 — the weight matrices/kernels that dominate
+        resident bytes; 1-d biases and BN stats stay exact) become
+        symmetric int8 + a per-tensor range via
+        ``ops.quantization.quantize``, and the compiled forward
+        dequantizes them in-executable with the range as a TRACED argument
+        — so ``refresh_params()`` after an in-place weight reload
+        requantizes without recompiling a single bucket. ~1/2 the resident
+        weight bytes vs bf16 (1/4 vs f32).
+
+        ``sticky`` (the previous per-param dtype list) pins each
+        parameter's eligibility after the FIRST snapshot: the
+        quantized-vs-exact split is part of every compiled bucket's
+        argument STRUCTURE, so a reload that turns a weight degenerate
+        (all-zero) must keep its int8 slot (on a unit grid — zeros
+        quantize to zeros exactly) rather than silently re-trace every
+        executable behind the retrace watchdog's back."""
+        n = len(datas)
+        if not self._int8:
+            return datas, [None] * n, [None] * n
+        from ..ops.registry import get_op
+        quantize = get_op("quantize").fn  # raw jnp-level op
+        out, ranges, qdts = [], [], []
+        for i, d in enumerate(datas):
+            if sticky is not None:
+                eligible = sticky[i] is not None
+            else:
+                eligible = d.ndim >= 2 and \
+                    jnp.issubdtype(d.dtype, jnp.floating)
+            r = float(jnp.max(jnp.abs(d))) if eligible else 0.0
+            if eligible and not (0.0 < r < float("inf")):
+                if sticky is None:
+                    # first snapshot: a degenerate tensor simply keeps
+                    # exact storage (no grid to land on)
+                    eligible = False
+                else:
+                    r = 1.0  # sticky slot: unit grid, zeros stay exact
+            if not eligible:
+                out.append(d)
+                ranges.append(None)
+                qdts.append(None)
+                continue
+            q, _lo, _hi = quantize(d, -r, r)
+            out.append(q)
+            ranges.append(jnp.asarray(r, jnp.float32))
+            qdts.append(str(d.dtype))
+        return out, ranges, qdts
+
     def _place(self, datas):
         """Commit buffers to this predictor's device (identity when no
-        device was pinned — the single-predictor PR-5 path)."""
+        device was pinned — the single-predictor PR-5 path). None entries
+        (un-quantized slots of the int8 range list) pass through."""
         if self._device is None:
             return datas
-        return [jax.device_put(d, self._device) for d in datas]
+        return [d if d is None else jax.device_put(d, self._device)
+                for d in datas]
 
     @property
     def spec(self):
@@ -262,11 +429,16 @@ class Predictor:
         """[(trailing_shape, dtype)] per input (None before settle)."""
         return self._templates
 
+    @property
+    def int8(self):
+        """True when this predictor stores weights as int8 + scale."""
+        return self._int8
+
     def refresh_params(self):
         """Re-snapshot parameter buffers (after an in-place reload) without
-        recompiling — the jits close over nothing, params are arguments."""
-        self._param_datas = self._place(
-            [p.data()._data for p in self._params])
+        recompiling — the jits close over nothing, params (and their int8
+        ranges) are arguments."""
+        self._snapshot_params()
 
     # ------------------------------------------------------------ compiling
     def _get_jit(self, shape_key):
@@ -287,14 +459,17 @@ class Predictor:
              "device": str(self._device) if self._device is not None
              else None,
              "shapes": [list(s) for s, _ in shape_key],
+             "int8": self._int8,
              "policy_key": list(key[1])})
-        block, params = self._block, self._params
+        block, params, pred = self._block, self._params, self
         fixed_key = jax.random.PRNGKey(0)  # deterministic inference: no
         # stochastic layers are live under train=False
         cell = {}
 
-        def pure(in_datas, param_datas):
+        def pure(in_datas, param_datas, param_ranges):
             from ..gluon.block import _flatten_nd, _run_traced
+
+            param_datas = pred._traced_params(param_datas, param_ranges)
 
             def body():
                 return block(*[NDArray(d) for d in in_datas])
@@ -349,9 +524,10 @@ class Predictor:
             # first invocation of this executable traces the shared block
             # (see _TRACE_LOCK): serialize across replicas' predictors
             with _TRACE_LOCK:
-                out = jitted(list(datas), self._param_datas)
+                out = jitted(list(datas), self._param_datas,
+                             self._param_ranges)
         else:
-            out = jitted(list(datas), self._param_datas)
+            out = jitted(list(datas), self._param_datas, self._param_ranges)
         return [NDArray(d) for d in out], cell
 
     def predict_flat(self, args):
@@ -432,6 +608,20 @@ class Predictor:
         flat, fmt, _ = self.predict_flat(args)
         out, _, _ = _regroup(flat, fmt)
         return out
+
+    def _traced_params(self, param_datas, param_ranges):
+        """In-trace reconstruction of compute-dtype parameter buffers
+        from the (possibly int8) stored form — shared by this predictor's
+        own pure fns and the DecodeEngine's step/insert jits (which run
+        against the same stored buffers). The range is a traced argument:
+        a ``refresh_params()`` re-quantization never recompiles."""
+        qdtypes = self._param_qdtypes or ()
+        if not any(q is not None for q in qdtypes):
+            return list(param_datas)
+        from ..ops.registry import get_op
+        deq = get_op("dequantize").fn  # raw jnp-level op
+        return [d if qdt is None else deq(d, -r, r).astype(qdt)
+                for d, r, qdt in zip(param_datas, param_ranges, qdtypes)]
 
     def compile_stats(self):
         """The watchdog's view of THIS predictor's compiles — its own

@@ -187,6 +187,7 @@ class ReplicaSet:
                                    if breaker_backoff_max_ms is not None
                                    else breaker_backoff_max_ms_default()) / 1e3
         self._lock = threading.Lock()
+        self._accountant = None   # optional KVCacheAccountant (attach_...)
         self.replicas = []
         for i, dev in enumerate(devices):
             pred = Predictor(block, spec, example=example, warmup=False,
@@ -340,19 +341,51 @@ class ReplicaSet:
                 _log.warning("serving replica %d probe failed; next probe "
                              "in %.1f s", rep.index, rep.backoff_s)
 
+    # ----------------------------------------------------- KV accountability
+    def attach_accountant(self, accountant):
+        """Attach a :class:`~mxtpu.serving.decode.KVCacheAccountant`
+        whose per-replica pools are tagged ``r<i>`` (the same family as
+        the retrace sites): ``states()`` then reports each replica's
+        resident KV bytes, and the :class:`ReplicaDispatcher` sheds
+        ``kv_residency`` when NO healthy replica has admission headroom —
+        overload is judged by cache memory, not queue depth. Returns
+        self."""
+        self._accountant = accountant
+        return self
+
+    @property
+    def accountant(self):
+        return self._accountant
+
+    def kv_admissible(self):
+        """True while at least one HEALTHY replica's KV pool admits
+        (vacuously true without an accountant)."""
+        acct = self._accountant
+        if acct is None:
+            return True
+        with self._lock:
+            tags = [r.tag for r in self.replicas if r.state == "healthy"]
+        return any(acct.would_admit(t) for t in tags)
+
     # ------------------------------------------------------------ reporting
     def states(self):
         """Per-replica health for ``/healthz`` (JSON-serializable)."""
+        acct = self._accountant
         with self._lock:
-            return [{"replica": r.index,
-                     "device": str(r.device),
-                     "state": r.state,
-                     "inflight": r.inflight,
-                     "dispatches": r.dispatches,
-                     "consecutive_failures": r.consecutive,
-                     "wedged": r.wedged,
-                     "probe_at": r.probe_at}
-                    for r in self.replicas]
+            out = [{"replica": r.index,
+                    "device": str(r.device),
+                    "state": r.state,
+                    "inflight": r.inflight,
+                    "dispatches": r.dispatches,
+                    "consecutive_failures": r.consecutive,
+                    "wedged": r.wedged,
+                    "probe_at": r.probe_at}
+                   for r in self.replicas]
+        if acct is not None:
+            for row in out:
+                row["kv_resident_bytes"] = acct.resident_bytes(
+                    "r%d" % row["replica"])
+        return out
 
 
 class ReplicaDispatcher(MicroBatcher):
@@ -410,6 +443,11 @@ class ReplicaDispatcher(MicroBatcher):
             self._maintain()
             if self._set.healthy_count() == 0:
                 self._shed("no_healthy_replica")
+        if not self._set.kv_admissible():
+            # every healthy replica's KV pool is over budget: shedding by
+            # RESIDENCY, not queue depth — an admitted sequence would only
+            # grow time-to-first-token on a replica with no cache room
+            self._shed("kv_residency")
         return super().submit(inputs, deadline_ms=deadline_ms)
 
     # --------------------------------------------------------- maintenance

@@ -251,6 +251,12 @@ def _make_handler(srv):
                     if not srv.draining and healthy < len(reps):
                         payload["status"] = ("degraded" if healthy
                                              else "unhealthy")
+                acct = getattr(getattr(srv._batcher, "replica_set", None),
+                               "accountant", None)
+                if acct is not None:
+                    # KV residency per replica pool: the signal a fleet
+                    # dispatcher routes/sheds on (docs/serving.md decode)
+                    payload["kv"] = acct.snapshot()
                 self._reply(200, payload)
             elif self.path == "/metrics":
                 accept = self.headers.get("Accept", "")

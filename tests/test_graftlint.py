@@ -238,22 +238,28 @@ def test_all_rules_ran_over_repo():
         "env-var-catalog", "metric-name-catalog"}
 
 
-def test_jit_surface_inventory_lists_all_four_caches():
-    """The inventory is ROADMAP item 5's scouting report: all four jit
-    caches (FusedUpdater, CachedOp, symbol executor, serving Predictor)
-    must appear with their retrace sites, and no site may be anonymous.
-    Since ISSUE 7 the fused_optimizer cache is ALSO the mesh-native
-    Trainer's cache — its declared key must carry the sharding component
-    (MeshPlan fingerprint + per-buffer sharding tokens), the down payment
-    on the unified compile-cache engine's key = fn + shapes + policy_key
-    + sharding. Since ISSUE 8 the serving Predictor's site is
-    per-INSTANCE (ReplicaSet members report at serving.predict.r<i>), so
-    its inventory entry resolves through the JIT_ALLOWLIST declaration —
-    which must name the per-replica caches to keep this report honest."""
+def test_jit_surface_inventory_lists_all_five_caches():
+    """The inventory is ROADMAP item 5's scouting report: all five jit
+    caches (FusedUpdater, CachedOp, symbol executor, serving Predictor,
+    serving DecodeEngine) must appear with their retrace sites, and no
+    site may be anonymous. Since ISSUE 7 the fused_optimizer cache is
+    ALSO the mesh-native Trainer's cache — its declared key must carry
+    the sharding component (MeshPlan fingerprint + per-buffer sharding
+    tokens), the down payment on the unified compile-cache engine's key
+    = fn + shapes + policy_key + sharding. Since ISSUE 8 the serving
+    Predictor's site is per-INSTANCE (ReplicaSet members report at
+    serving.predict.r<i>), so its inventory entry resolves through the
+    JIT_ALLOWLIST declaration — which must name the per-replica caches
+    to keep this report honest. Since ISSUE 11 the decode cache
+    (serving.decode — step executables per cohort-capacity bucket +
+    insert executables per prefill seq bucket) joins the same way: its
+    declaration must spell out the AOT discipline (post-warmup compiles
+    zero, donated carry)."""
     inv = _repo_result().jit_inventory
     sites = {e["retrace_site"] for e in inv}
     assert {"fused_optimizer", "cached_op", "executor",
-            "executor.backward", "serving.predict"} <= sites, sites
+            "executor.backward", "serving.predict",
+            "serving.decode"} <= sites, sites
     assert None not in sites and "<dynamic>" not in sites
     fused = [e for e in inv if e["retrace_site"] == "fused_optimizer"]
     assert fused and all(e["donation"] == "donate_argnums=(0, 2)"
@@ -271,6 +277,14 @@ def test_jit_surface_inventory_lists_all_four_caches():
     # names the serving.predict.r<i> site family and its bound
     assert "serving.predict.r" in serving["cache_key"], serving
     assert "policy_key" in serving["cache_key"], serving
+    decode = by_site["serving.decode"]
+    assert decode["file"] == "mxtpu/serving/decode.py", decode
+    assert decode["allowlisted"] is True
+    # the decode cache's contract rides the declaration: bucketed AOT
+    # replay (zero post-warmup compiles) over donated carry state
+    assert "policy_key" in decode["cache_key"], decode
+    assert "bucket" in decode["cache_key"], decode
+    assert "donated" in decode["cache_key"], decode
 
 
 # ------------------------------------------------------------------------ CLI

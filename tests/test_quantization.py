@@ -1,6 +1,15 @@
 """INT8 quantization flow (ref: src/operator/quantization/*,
 python/mxnet/contrib/quantization.py; test model
-tests/python/quantization/test_quantization.py)."""
+tests/python/quantization/test_quantization.py).
+
+ISSUE 11 grew the op layer its serving callers (Predictor int8 weights,
+DecodeEngine int8 KV) — the second half of this file pins the properties
+that path depends on: requantize round-trips, exact int8 saturation
+edges, the signed-symmetric range rule, and every op compiling under
+``jax.jit`` with its ranges as TRACED arguments (scales are executable
+*arguments*, so a weight reload requantizes without a recompile)."""
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -8,6 +17,7 @@ import mxtpu as mx
 from mxtpu import gluon
 from mxtpu.contrib import quantization as q
 from mxtpu.gluon import nn
+from mxtpu.ops.registry import get_op
 
 
 def test_quantize_dequantize_roundtrip():
@@ -118,3 +128,95 @@ def test_quantized_net_hybridizes():
     net.hybridize()
     hybrid = net(xs).asnumpy()
     np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------- ISSUE 11: op-level pins
+def test_quantize_symmetric_range_rule():
+    # the reference's signed-symmetric rule (quantize-inl.h): r =
+    # max(|min|, |max|) — an asymmetric calibration [-1, 4] quantizes on
+    # the [-4, 4] grid and REPORTS that grid back
+    xq, mn, mx_ = mx.nd.quantize(mx.nd.array([-1.0, 0.0, 4.0]), -1.0, 4.0)
+    assert float(mn.asnumpy()) == -4.0 and float(mx_.asnumpy()) == 4.0
+    np.testing.assert_array_equal(xq.asnumpy(), [-32, 0, 127])
+
+
+def test_quantize_saturation_edges_exact():
+    # at-range values land exactly on +-127; past-range clamps there; the
+    # epsilon neighborhood of zero stays zero (no off-by-half-step drift)
+    r = 2.0
+    x = mx.nd.array([-5.0, -2.0, -1e-9, 0.0, 1e-9, 2.0, 5.0])
+    qv = mx.nd.quantize(x, -r, r)[0].asnumpy()
+    np.testing.assert_array_equal(qv, [-127, -127, 0, 0, 0, 127, 127])
+
+
+def test_requantize_calibrated_round_trip():
+    # int32 accumulator -> int8 against a narrower calibrated window must
+    # agree (to one grid step) with quantizing the real values directly
+    rng = np.random.RandomState(1)
+    real = rng.uniform(-0.9, 0.9, size=(257,)).astype(np.float32)
+    R32, R8 = 4.0, 1.0
+    acc = mx.nd.array(np.round(real * (2.0 ** 31 - 1) / R32), dtype="int32")
+    qv, mn, mx_ = mx.nd.requantize(acc, -R32, R32, min_calib_range=-R8,
+                                   max_calib_range=R8)
+    assert float(mn.asnumpy()) == -R8 and float(mx_.asnumpy()) == R8
+    direct = mx.nd.quantize(mx.nd.array(real), -R8, R8)[0].asnumpy()
+    delta = np.abs(qv.asnumpy().astype(np.int32)
+                   - direct.astype(np.int32)).max()
+    assert delta <= 1, delta
+    # and values outside the calibrated window saturate exactly
+    edge = mx.nd.array(np.array([2 ** 31 - 1, -(2 ** 31 - 1)]),
+                       dtype="int32")
+    qe = mx.nd.requantize(edge, -8.0, 8.0, min_calib_range=-1.0,
+                          max_calib_range=1.0)[0].asnumpy()
+    np.testing.assert_array_equal(qe, [127, -127])
+
+
+def test_quantized_fully_connected_saturated_operands_exact():
+    # saturated int8 operands stay exact: +-127 x +-127 contractions are
+    # pure int32 integer math — the only float op is the dequant scale
+    qfc = get_op("quantized_fully_connected").fn
+    x = np.full((2, 8), 127, np.int8)
+    w = np.full((3, 8), -127, np.int8)
+    out = np.asarray(qfc(x, w, bias=None, no_bias=True, min_data=-1.0,
+                         max_data=1.0, min_weight=-2.0, max_weight=2.0))
+    expect = (127 * -127 * 8) * (1.0 / 127.0) * (2.0 / 127.0)
+    np.testing.assert_allclose(out, np.full((2, 3), expect, np.float32),
+                               rtol=1e-6)
+
+
+def test_ops_compile_with_traced_ranges():
+    """The serving int8 contract: ranges are jit ARGUMENTS. Would fail
+    with numpy-scalar-type casts (``jnp.float32(tracer)``
+    concretizes)."""
+    quantize = get_op("quantize").fn
+    dequantize = get_op("dequantize").fn
+    requantize = get_op("requantize").fn
+    qfc = get_op("quantized_fully_connected").fn
+    x = jnp.asarray(np.random.RandomState(3).randn(8, 4), jnp.float32)
+
+    @jax.jit
+    def round_trip(data, r):
+        qv, lo, hi = quantize(data, -r, r)
+        return dequantize(qv, lo, hi)
+
+    out = round_trip(x, jnp.float32(2.5))
+    assert np.abs(np.asarray(out) - np.asarray(x)).max() <= 2.5 / 127.0
+
+    @jax.jit
+    def fc(qx, qw, rx, rw):
+        return qfc(qx, qw, bias=None, no_bias=True, min_data=-rx,
+                   max_data=rx, min_weight=-rw, max_weight=rw)
+
+    qx = quantize(x, -2.5, 2.5)[0]
+    qw = quantize(x[:3], -2.5, 2.5)[0]   # [3, 4]: contracts x's last dim
+    assert np.asarray(fc(qx, qw, jnp.float32(2.5),
+                         jnp.float32(2.5))).shape == (8, 3)
+
+    @jax.jit
+    def requant(acc, r32, r8):
+        return requantize(acc, -r32, r32, min_calib_range=-r8,
+                          max_calib_range=r8)[0]
+
+    acc = jnp.asarray(np.array([2 ** 30, -(2 ** 30)], np.int32))
+    qv = np.asarray(requant(acc, jnp.float32(4.0), jnp.float32(4.0)))
+    assert qv.tolist() == [64, -64]

@@ -68,6 +68,24 @@ JIT_ALLOWLIST: Dict[Tuple[str, str], Dict[str, str]] = {
                      "mxtpu/serving/replicas.py) are each bounded by "
                      "#buckets, total compiles <= buckets x replicas",
     },
+    ("mxtpu/serving/decode.py", "_build_jit"): {
+        "site": "serving.decode",
+        "reason": "DecodeEngine._build_jit is the single compile front "
+                  "door for the decode cache (step executables per cohort "
+                  "capacity bucket + insert executables per prefill seq "
+                  "bucket); it calls telemetry.record_retrace(self._site, "
+                  "...) on every miss before jax.jit — the site name is "
+                  "per-INSTANCE (default serving.decode) so the static "
+                  "rule sees '<dynamic>' and this entry declares the base "
+                  "site for the inventory",
+        "cache_key": "(kind step|insert, cohort-capacity-or-seq bucket, "
+                     "int8 flag) + registry.policy_key — one executable "
+                     "cache per DecodeEngine instance at site "
+                     "serving.decode; post-warmup compiles are ZERO by "
+                     "construction (every bucket AOT-compiled in "
+                     "warmup()), carry state donated per step so replay "
+                     "never allocates",
+    },
     ("mxtpu/optimizer_fused.py", "_build_guarded"): {
         "site": "fused_optimizer",
         "reason": "same cache front door as _build; the guard bit and "

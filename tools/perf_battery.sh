@@ -121,6 +121,17 @@ else
   echo "replica serving skipped: single device" | tee -a "$LOG"
 fi
 
+# 5b. continuous-batching decode phase (ISSUE 11): prefill/decode split +
+#     KV-slot cohort on the tiny causal LM — continuous vs restart-per-batch
+#     tokens/s at equal capacity, int8 parity + KV-bytes gates, then the
+#     open-loop TTFT overload curve with the prefill/decode stage split.
+#     Runs on whatever platform is live (the decode loop is pure replay, so
+#     it is chip-safe: compiles all happen in one warmup block up front).
+sleep 60
+timeout 600 python tools/serve_bench.py --mode decode \
+  2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
+telemetry_report
+
 # 6. input pipeline phase (ISSUE 9): device-resident streaming reader +
 #    double-buffered prefetch-to-device vs the synchronous loop — batches/s
 #    and the data.wait fraction both ways (gate: parity + wait-frac drop;

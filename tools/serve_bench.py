@@ -26,14 +26,26 @@ line each (stamped with platform + policy_key like every bench artifact):
   and a **hang count** — futures that never completed. The acceptance
   gate: hangs == 0 through the replica loss (requests re-route, shed, or
   expire; none strand).
+* ``decode`` — ISSUE 11: the continuous-batching autoregressive decode
+  engine (``mxtpu/serving/decode.py``) on a tiny causal-attention LM.
+  Phase 1 is the acceptance A/B: continuous batching vs restart-per-
+  batch at EQUAL cohort capacity, identical workload and executables —
+  gates: strictly higher tokens/s, zero post-warmup compiles at
+  ``serving.decode``, zero d2h inside the armed decode span, int8
+  logits-parity vs f32 with the accountant reporting at most ~half the
+  KV bytes per slot. Phase 2 is the open-loop overload curve: paced
+  submits, tokens/s + time-to-first-token p50/p99 per offered QPS, with
+  the PR-10 per-stage breakdown splitting prefill from decode time.
 
 Usage::
 
-    python tools/serve_bench.py [--mode sweep,closed,open,replicas]
+    python tools/serve_bench.py [--mode sweep,closed,open,replicas,decode]
         [--requests 500] [--max-batch 8] [--dim 256] [--width 512]
         [--depth 3] [--max-wait-ms 2] [--workers 4]
         [--qps 100,300,1000] [--deadline-ms 100]
         [--replicas 0] [--kill-replica 0]
+        [--decode-requests 80] [--decode-slots 8] [--decode-max-new 32]
+        [--decode-qps 20,60,200]
 
 ``bench.py``'s ``serving`` config drives the same functions in-process,
 and ``tools/perf_battery.sh`` runs this script as its serving phase.
@@ -123,6 +135,323 @@ def build_replica_set(dim=256, width=512, depth=3, out_dim=64, max_batch=8,
 
 def _dim(pred):
     return pred.input_templates[0][0][0]
+
+
+def build_decode_model(vocab=96, dim=32, max_len=96, seed=0):
+    """The decode-bench model: a single-head causal-attention LM — the
+    executable reference for the :class:`mxtpu.serving.decode.DecodeModel`
+    contract. Prefill (``hybrid_forward``) returns ``(logits[b, s, V],
+    k[b, s, d], v[b, s, d])``; ``decode_step`` writes this token's k/v at
+    ``pos`` into its OWN attention view and returns the entries for the
+    engine to persist. Small enough that the per-step dispatch overhead
+    dominates — exactly the regime continuous batching exists for."""
+    import mxtpu as mx
+    from mxtpu.gluon import HybridBlock
+    from mxtpu.ndarray import NDArray
+    from mxtpu.serving.decode import DecodeModel
+
+    class TinyCausalLM(HybridBlock, DecodeModel):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.embed = self.params.get("embed", shape=(vocab, dim))
+                self.posemb = self.params.get("posemb",
+                                              shape=(max_len, dim))
+                self.wq = self.params.get("wq", shape=(dim, dim))
+                self.wk = self.params.get("wk", shape=(dim, dim))
+                self.wv = self.params.get("wv", shape=(dim, dim))
+                self.wo = self.params.get("wo", shape=(dim, dim))
+                self.wout = self.params.get("wout", shape=(dim, vocab))
+
+        def hybrid_forward(self, F, tokens, embed, posemb, wq, wk, wv,
+                           wo, wout):
+            import jax
+            import jax.numpy as jnp
+            t = tokens._data.astype(jnp.int32)
+            s = t.shape[1]
+            x = embed._data[t] + posemb._data[:s][None]
+            q = x @ wq._data
+            k = x @ wk._data
+            v = x @ wv._data
+            scores = jnp.einsum("bsd,btd->bst", q, k) / float(dim) ** 0.5
+            mask = jnp.tril(jnp.ones((s, s), jnp.bool_))
+            scores = jnp.where(mask[None], scores, -1e30)
+            h = jnp.einsum("bst,btd->bsd",
+                           jax.nn.softmax(scores, axis=-1), v) @ wo._data
+            logits = (x + h) @ wout._data
+            return NDArray(logits), NDArray(k), NDArray(v)
+
+        def decode_step(self, kv, tok, pos):
+            import jax
+            import jax.numpy as jnp
+            k_cache, v_cache = kv                       # [c, L, d]
+            c, L = k_cache.shape[0], k_cache.shape[1]
+            x = self.embed.data()._data[tok] \
+                + self.posemb.data()._data[pos]         # [c, d]
+            q = x @ self.wq.data()._data
+            k_new = x @ self.wk.data()._data
+            v_new = x @ self.wv.data()._data
+            idx = jnp.arange(c)
+            kf = k_cache.at[idx, pos].set(k_new)
+            vf = v_cache.at[idx, pos].set(v_new)
+            scores = jnp.einsum("cd,cld->cl", q, kf) / float(dim) ** 0.5
+            mask = jnp.arange(L)[None, :] <= pos[:, None]
+            scores = jnp.where(mask, scores, -1e30)
+            h = jnp.einsum("cl,cld->cd",
+                           jax.nn.softmax(scores, axis=-1), vf) \
+                @ self.wo.data()._data
+            logits = (x + h) @ self.wout.data()._data
+            return logits, [k_new, v_new]
+
+    net = TinyCausalLM(prefix="decodebench_")
+    # seeded init: the int8 logits-parity numbers must be a property of
+    # the quantization path, not of this run's weight draw
+    mx.random.seed(seed)
+    net.initialize(mx.init.Normal(0.5))
+    return net
+
+
+def build_decode_engine(model, slots=4, max_prompt=24, max_new=24,
+                        int8=False, continuous=True, accountant=None,
+                        start=False, clock=time.monotonic):
+    """A warmed DecodeEngine over the bench LM: prefill seq buckets up to
+    ``max_prompt``, a pow2 cohort-capacity ladder up to ``slots``, cache
+    length sized for the longest prompt + generation budget."""
+    from mxtpu.serving import BucketSpec, DecodeEngine
+
+    pspec = BucketSpec([1], seq_lens=[max(4, max_prompt // 2), max_prompt])
+    dspec = BucketSpec.pow2(decode_slots=slots)
+    return DecodeEngine(model, pspec, dspec, max_len=max_prompt + max_new,
+                        int8=int8, continuous=continuous,
+                        accountant=accountant, warmup=True, start=start,
+                        clock=clock)
+
+
+def _decode_workload(n_requests, vocab, max_prompt, max_new, seed=11):
+    """(prompt, max_new) pairs with VARIED lengths — the regime where
+    continuous batching wins: a restart-per-batch cohort burns steps on
+    slots whose sequence already finished, a continuous cohort refills
+    them between steps."""
+    rng = np.random.RandomState(seed)
+    reqs = []
+    for _ in range(n_requests):
+        prompt = rng.randint(0, vocab,
+                             size=rng.randint(3, max_prompt)).astype(np.int32)
+        # the full 2..max_new spread: restart-per-batch pays max(cohort)
+        # steps per cohort, continuous pays ~mean — the wider the spread,
+        # the bigger the idle-slot bill the gate measures
+        reqs.append((prompt, int(rng.randint(2, max_new + 1))))
+    return reqs
+
+
+def run_decode(n_requests=80, slots=8, max_new=32, vocab=256, dim=128,
+               max_prompt=48, emit=_emit):
+    """The ISSUE-11 acceptance phase: continuous batching vs
+    restart-per-batch decode at EQUAL cohort capacity, identical
+    workload, identical executables. Gates (summary line ``ok``):
+    strictly higher tokens/s continuous, ZERO post-warmup compiles at
+    ``serving.decode`` (<= #cohort-buckets by construction —
+    watchdog-pinned), zero d2h inside the armed decode span, and the
+    int8 path passing logits parity vs f32 while the accountant reports
+    about half (or less) the KV bytes per sequence."""
+    from mxtpu import telemetry
+    from mxtpu.serving import KVCacheAccountant
+
+    model = build_decode_model(vocab=vocab, dim=dim,
+                               max_len=max_prompt + max_new)
+    reqs = _decode_workload(n_requests, vocab, max_prompt, max_new)
+
+    def drive(continuous, int8=False, rounds=2):
+        # ledger KV bytes but never shed: the closed-loop burst queues the
+        # whole workload up front by design (the kv_residency shed path
+        # has its own default-overcommit coverage in tests/test_decode.py)
+        acct = KVCacheAccountant(overcommit=float(n_requests))
+        eng = build_decode_engine(model, slots=slots, max_prompt=max_prompt,
+                                  max_new=max_new, int8=int8,
+                                  continuous=continuous, accountant=acct)
+        st0 = telemetry.retrace_stats(eng._site) or {}
+        steps0 = telemetry.value("serving.decode.steps")
+        toks0 = telemetry.value("serving.decode.tokens")
+        d2h0 = telemetry.value("serving.decode.d2h")
+        best = None
+        # best-of-rounds, like run_sweep: one round on a shared host
+        # measures scheduler noise, not the replay cost the gate judges
+        # (step counts are identical per round; the compile/d2h deltas
+        # below span ALL rounds, so a lazy compile can't hide)
+        for _ in range(max(1, rounds)):
+            r_steps0 = telemetry.value("serving.decode.steps")
+            r_toks0 = telemetry.value("serving.decode.tokens")
+            t0 = time.perf_counter()
+            futs = [eng.submit(p, max_new=m) for p, m in reqs]
+            guard = 0
+            while not all(f.done() for f in futs) and guard < 100000:
+                eng.poll()
+                guard += 1
+            wall = time.perf_counter() - t0
+            outs = [f.result(timeout=5) for f in futs]
+            round_rec = {
+                "tokens": telemetry.value("serving.decode.tokens")
+                - r_toks0,
+                "steps": telemetry.value("serving.decode.steps") - r_steps0,
+                "wall_s": wall,
+                "tok_per_s": (telemetry.value("serving.decode.tokens")
+                              - r_toks0) / wall,
+                "ttft_p50_ms": round(float(np.percentile(
+                    [f.ttft_s for f in futs], 50)) * 1e3, 3),
+                "ttft_p99_ms": round(float(np.percentile(
+                    [f.ttft_s for f in futs], 99)) * 1e3, 3),
+            }
+            if best is None or round_rec["tok_per_s"] > best["tok_per_s"]:
+                best = round_rec
+        st = telemetry.retrace_stats(eng._site) or {}
+        best.update({
+            "compiles_post_warmup": st.get("compiles", 0)
+            - st0.get("compiles", 0),
+            "watchdog_trips": st.get("trips", 0) - st0.get("trips", 0),
+            "per_slot_kv_bytes": eng.per_slot_kv_bytes(),
+            "total_steps": telemetry.value("serving.decode.steps") - steps0,
+            "total_tokens": telemetry.value("serving.decode.tokens")
+            - toks0,
+            # delta like every sibling gate: a cumulative read would fail
+            # forever after any earlier in-process sync
+            "d2h": telemetry.value("serving.decode.d2h") - d2h0,
+        })
+        eng.close(timeout=5)
+        return best, outs, eng
+
+    cont, cont_outs, _ = drive(True)
+    emit({"metric": "serve_decode_continuous",
+          "value": round(cont["tok_per_s"], 1), "unit": "tokens/sec",
+          **{k: cont[k] for k in ("tokens", "steps", "ttft_p50_ms",
+                                  "ttft_p99_ms", "compiles_post_warmup",
+                                  "watchdog_trips")}})
+    rest, rest_outs, _ = drive(False)
+    emit({"metric": "serve_decode_restart",
+          "value": round(rest["tok_per_s"], 1), "unit": "tokens/sec",
+          **{k: rest[k] for k in ("tokens", "steps", "ttft_p50_ms",
+                                  "ttft_p99_ms", "compiles_post_warmup",
+                                  "watchdog_trips")}})
+    parity_tokens = all(len(a) == len(b) and (a == b).all()
+                        for a, b in zip(cont_outs, rest_outs))
+
+    # int8 phase on the SAME weights: throughput line + the logits-parity
+    # and KV-bytes gates (probes run on fresh single-purpose engines —
+    # the throughput engines are closed)
+    q, _q_outs, _ = drive(True, int8=True)
+    probe = reqs[0][0]
+    eng_f = build_decode_engine(model, slots=2, max_prompt=max_prompt,
+                                max_new=max_new)
+    eng_q = build_decode_engine(model, slots=2, max_prompt=max_prompt,
+                                max_new=max_new, int8=True)
+    lf, lq = eng_f.prefill_logits(probe), eng_q.prefill_logits(probe)
+    sf, sq = eng_f.step_logits_probe(probe), eng_q.step_logits_probe(probe)
+    prefill_err = float(np.abs(lf - lq).mean() / (np.abs(lf).mean() + 1e-9))
+    step_err = float(np.abs(sf - sq).mean() / (np.abs(sf).mean() + 1e-9))
+    kv_ratio = q["per_slot_kv_bytes"] / float(cont["per_slot_kv_bytes"])
+    eng_f.close(timeout=2)
+    eng_q.close(timeout=2)
+    int8_ok = prefill_err <= 0.05 and step_err <= 0.05 and kv_ratio <= 0.55
+    emit({"metric": "serve_decode_int8",
+          "value": round(q["tok_per_s"], 1), "unit": "tokens/sec",
+          "prefill_logits_rel_err": round(prefill_err, 5),
+          "step_logits_rel_err": round(step_err, 5),
+          "kv_bytes_per_slot_f32": cont["per_slot_kv_bytes"],
+          "kv_bytes_per_slot_int8": q["per_slot_kv_bytes"],
+          "kv_bytes_ratio": round(kv_ratio, 4),
+          # the residency dividend: sequences admissible at equal memory
+          "admit_multiplier": round(1.0 / kv_ratio, 2),
+          "int8_ok": int8_ok})
+
+    speedup = cont["tok_per_s"] / rest["tok_per_s"] \
+        if rest["tok_per_s"] > 0 else 0.0
+    ok = (cont["tok_per_s"] > rest["tok_per_s"]
+          and parity_tokens
+          and cont["compiles_post_warmup"] == 0
+          and cont["watchdog_trips"] == 0
+          and cont["d2h"] == 0 and rest["d2h"] == 0 and q["d2h"] == 0
+          and int8_ok)
+    emit({"metric": "serve_decode", "value": round(speedup, 3),
+          "unit": "continuous_vs_restart_speedup",
+          "continuous_tok_per_s": round(cont["tok_per_s"], 1),
+          "restart_tok_per_s": round(rest["tok_per_s"], 1),
+          "continuous_steps": cont["steps"],
+          "restart_steps": rest["steps"],
+          "token_parity_continuous_vs_restart": parity_tokens,
+          "compiles_post_warmup": cont["compiles_post_warmup"],
+          "decode_d2h": cont["d2h"] + rest["d2h"] + q["d2h"],
+          "ok": ok})
+    return {"ok": ok, "speedup": speedup, "continuous": cont,
+            "restart": rest, "int8": q, "prefill_logits_rel_err": prefill_err,
+            "step_logits_rel_err": step_err, "kv_bytes_ratio": kv_ratio}
+
+
+def run_decode_open(qps_list=(20.0, 60.0, 200.0), n_requests=60, slots=4,
+                    max_new=16, vocab=96, dim=32, max_prompt=24,
+                    deadline_ms=2000.0, emit=_emit):
+    """Open-loop decode overload curve: paced submits against a THREADED
+    engine, one line per offered rate — achieved tokens/s,
+    time-to-first-token p50/p99, shed rate, and the per-stage split the
+    PR-10 breakdown makes possible: prefill vs decode milliseconds per
+    request (p50), so a TTFT regression is attributable to the right
+    phase from the artifact alone."""
+    from mxtpu import telemetry
+    from mxtpu.serving import QueueFull
+
+    model = build_decode_model(vocab=vocab, dim=dim,
+                               max_len=max_prompt + max_new)
+    reqs = _decode_workload(n_requests, vocab, max_prompt, max_new, seed=23)
+    recs = []
+    for qps in qps_list:
+        eng = build_decode_engine(model, slots=slots, max_prompt=max_prompt,
+                                  max_new=max_new, start=True)
+        interval = 1.0 / float(qps)
+        futs, shed = [], 0
+        t0 = time.perf_counter()
+        for i, (p, m) in enumerate(reqs):
+            target = t0 + i * interval
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            try:
+                futs.append(eng.submit(p, max_new=m,
+                                       deadline_ms=deadline_ms))
+            except QueueFull:
+                shed += 1
+        done, expired = [], 0
+        for f in futs:
+            try:
+                toks = f.result(timeout=30)
+                done.append((f, len(toks)))
+            except Exception:  # noqa: BLE001 — DeadlineExceeded
+                expired += 1
+        wall = time.perf_counter() - t0
+        eng.close(timeout=10)
+        ttfts = [f.ttft_s for f, _n in done if f.ttft_s is not None]
+        stage = {"serving.prefill": [], "serving.decode": []}
+        for f, _n in done:
+            if f.breakdown:
+                for name in stage:
+                    if name in f.breakdown:
+                        stage[name].append(f.breakdown[name])
+        rec = {"metric": "serve_decode_qps%g" % qps, "offered_qps": qps,
+               "value": round(sum(n for _f, n in done) / wall, 1),
+               "unit": "tokens/sec",
+               "completed": len(done),
+               "shed_rate": round(shed / float(n_requests), 4),
+               "expired_rate": round(expired / float(n_requests), 4),
+               "ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3,
+                                    3) if ttfts else None,
+               "ttft_p99_ms": round(float(np.percentile(ttfts, 99)) * 1e3,
+                                    3) if ttfts else None,
+               "prefill_p50_ms": round(float(np.percentile(
+                   stage["serving.prefill"], 50)) * 1e3, 3)
+               if stage["serving.prefill"] else None,
+               "decode_p50_ms": round(float(np.percentile(
+                   stage["serving.decode"], 50)) * 1e3, 3)
+               if stage["serving.decode"] else None}
+        emit(rec)
+        recs.append(rec)
+    return recs
 
 
 def run_sweep(pred, spec, iters=50, repeats=3, emit=_emit):
@@ -412,11 +741,33 @@ def main(argv=None):
     ap.add_argument("--kill-replica", type=int, default=0,
                     help="replica quarantined mid-run by --mode replicas "
                          "(-1 = no kill)")
+    ap.add_argument("--decode-requests", type=int,
+                    default=int(os.environ.get("BENCH_DECODE_REQUESTS",
+                                               80)),
+                    help="--mode decode sequence count per phase")
+    ap.add_argument("--decode-slots", type=int,
+                    default=int(os.environ.get("BENCH_DECODE_SLOTS", 8)),
+                    help="--mode decode cohort capacity (pow2 ladder)")
+    ap.add_argument("--decode-max-new", type=int,
+                    default=int(os.environ.get("BENCH_DECODE_MAX_NEW", 32)),
+                    help="--mode decode per-sequence generation budget cap")
+    ap.add_argument("--decode-qps", default="20,60,200",
+                    help="--mode decode open-loop offered request rates")
     args = ap.parse_args(argv)
 
     modes = {m.strip() for m in args.mode.split(",") if m.strip()}
     ok = True
-    single = modes - {"replicas"}
+    if "decode" in modes:
+        rec = run_decode(n_requests=args.decode_requests,
+                         slots=args.decode_slots,
+                         max_new=args.decode_max_new)
+        ok = ok and rec["ok"]
+        run_decode_open(
+            qps_list=[float(q) for q in args.decode_qps.split(",") if q],
+            n_requests=min(args.decode_requests, 60),
+            slots=args.decode_slots,
+            max_new=min(args.decode_max_new, 16))
+    single = modes - {"replicas", "decode"}
     if single:
         pred, spec = build_predictor(dim=args.dim, width=args.width,
                                      depth=args.depth,
