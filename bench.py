@@ -66,6 +66,16 @@ def _stamp(rec):
             rec["policy_key"] = list(policy_key())
         except Exception:  # noqa: BLE001
             rec["policy_key"] = None
+    if "ledger" not in rec:
+        # ISSUE 12: every bench line carries the run's memory trajectory
+        # — executable-ledger compile totals + process-peak HBM — so a
+        # BENCH round is attributable to its compile/memory cost after
+        # the fact, exactly like platform/policy_key
+        try:
+            from mxtpu import xprof
+            rec["ledger"] = xprof.summary() if xprof.enabled() else None
+        except Exception:  # noqa: BLE001 — a dead PJRT client still stamps
+            rec["ledger"] = None
     return rec
 
 
@@ -74,14 +84,16 @@ def _emit(rec):
 
 
 def _peak_flops():
-    """Chip peak FLOP/s for the MFU denominator."""
+    """Chip peak FLOP/s for the MFU denominator — ``BENCH_PEAK_TFLOPS``
+    override first, else the ONE shared datasheet table
+    (mxtpu/perf_model.py, which bench, tools/perf_peak.py, and the
+    runtime ``perf.mfu`` gauge all read — the convention can no longer
+    fork). None on the CPU fallback: MFU is meaningless there."""
     env = os.environ.get("BENCH_PEAK_TFLOPS")
     if env:
         return float(env) * 1e12
-    import jax
-    if jax.devices()[0].platform == "cpu":
-        return None  # MFU is meaningless on the CPU fallback
-    return 197e12  # TPU v5e bf16
+    from mxtpu import perf_model
+    return perf_model.peak_flops()
 
 
 def _run(step, batch, n_items, model_flops_per_item=None):
@@ -573,10 +585,16 @@ def bench_telemetry_overhead(emit=None):
     Trainer loop — the same shapes guard_overhead measures. ISSUE 10
     adds a third mode, ``trace`` (MXTPU_TELEMETRY=1 + MXTPU_TRACE=1):
     per-step trace contexts, span-id allocation, and the flight-recorder
-    ring append, held to the SAME <1% budget. One JSON line per
-    (config, mode) plus a summary whose value is the worst overhead
-    fraction across modes (``vs_baseline`` = 0.01 / worst, so >=1.0
-    means the layer fits). BENCH_TELEMETRY_CONFIGS selects subsets.
+    ring append, held to the SAME <1% budget. ISSUE 12 adds a fourth,
+    ``xprof`` (all three levers on): the executable-observatory layer's
+    lever-gated per-step work — the wrapped-jit per-dispatch lever check
+    + call count and the Trainer's perf.mfu meter tick — same <1% budget
+    again. (The wrapper FRAME is construction-time and rides every mode;
+    what alternates is everything behind the per-call lever.) One JSON
+    line per (config, mode) plus a summary whose value is the worst
+    overhead fraction across modes (``vs_baseline`` = 0.01 / worst, so
+    >=1.0 means the layer fits). BENCH_TELEMETRY_CONFIGS selects
+    subsets.
 
     Methodology: ONE workload per config, then off/on/trace timings
     ALTERNATE over BENCH_TELEMETRY_ROUNDS rounds and each mode takes its
@@ -599,13 +617,16 @@ def bench_telemetry_overhead(emit=None):
             "BENCH_TELEMETRY_CONFIGS=%r: expected a non-empty comma list "
             "from %s"
             % (os.environ.get("BENCH_TELEMETRY_CONFIGS"), sorted(makers)))
-    # mode -> (MXTPU_TELEMETRY, MXTPU_TRACE); "1" pins trace OFF so the
-    # two levers' costs stay separately attributable
-    modes = {"0": ("0", "0"), "1": ("1", "0"), "trace": ("1", "1")}
+    # mode -> (MXTPU_TELEMETRY, MXTPU_TRACE, MXTPU_XPROF); each lever
+    # pins the previous ones so the costs stay separately attributable
+    modes = {"0": ("0", "0", "0"), "1": ("1", "0", "0"),
+             "trace": ("1", "1", "0"), "xprof": ("1", "1", "1")}
     prev = os.environ.get("MXTPU_TELEMETRY")
     prev_trace = os.environ.get("MXTPU_TRACE")
+    prev_xprof = os.environ.get("MXTPU_XPROF")
     overheads = {}
     trace_overheads = {}
+    xprof_overheads = {}
     noise = {}
     try:
         for cname in which:
@@ -614,9 +635,10 @@ def bench_telemetry_overhead(emit=None):
             sync()
             rates = {m: [] for m in modes}
             for _ in range(rounds):
-                for mode, (tel, trace) in modes.items():
+                for mode, (tel, trace, xpr) in modes.items():
                     os.environ["MXTPU_TELEMETRY"] = tel
                     os.environ["MXTPU_TRACE"] = trace
+                    os.environ["MXTPU_XPROF"] = xpr
                     t0 = time.perf_counter()
                     for _ in range(steps):
                         step_fn()
@@ -626,25 +648,30 @@ def bench_telemetry_overhead(emit=None):
             for mode in modes:
                 emit({"metric": "telemetry_overhead_%s" % cname,
                       "telemetry": {"0": "off", "1": "on",
-                                    "trace": "trace"}[mode],
+                                    "trace": "trace",
+                                    "xprof": "xprof"}[mode],
                       "value": round(med[mode], 2), "unit": "steps/sec",
                       "rounds": [round(r, 2) for r in rates[mode]]})
             overheads[cname] = med["0"] / med["1"] - 1.0
             trace_overheads[cname] = med["0"] / med["trace"] - 1.0
+            xprof_overheads[cname] = med["0"] / med["xprof"] - 1.0
             all_r = [r for rs in rates.values() for r in rs]
             noise[cname] = (max(all_r) - min(all_r)) / med["0"]
             emit({"metric": "telemetry_overhead_%s" % cname,
                   "overhead_frac": round(overheads[cname], 4),
                   "trace_overhead_frac": round(trace_overheads[cname], 4),
+                  "xprof_overhead_frac": round(xprof_overheads[cname], 4),
                   "noise_frac": round(noise[cname], 4)})
     finally:
         for var, old in (("MXTPU_TELEMETRY", prev),
-                         ("MXTPU_TRACE", prev_trace)):
+                         ("MXTPU_TRACE", prev_trace),
+                         ("MXTPU_XPROF", prev_xprof)):
             if old is None:
                 os.environ.pop(var, None)
             else:
                 os.environ[var] = old
-    worst = max(list(overheads.values()) + list(trace_overheads.values()))
+    worst = max(list(overheads.values()) + list(trace_overheads.values())
+                + list(xprof_overheads.values()))
     return {
         "metric": "telemetry_overhead",
         "value": round(worst, 4),
@@ -658,6 +685,8 @@ def bench_telemetry_overhead(emit=None):
         "per_config": {k: round(v, 4) for k, v in overheads.items()},
         "per_config_trace": {k: round(v, 4)
                              for k, v in trace_overheads.items()},
+        "per_config_xprof": {k: round(v, 4)
+                             for k, v in xprof_overheads.items()},
         "noise_frac": {k: round(v, 4) for k, v in noise.items()},
     }
 

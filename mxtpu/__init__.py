@@ -37,6 +37,8 @@ from .base import Context, MXNetError, cpu, current_context, gpu, num_gpus, tpu
 # stdlib-only, imported FIRST among the framework modules: every later
 # module (ndarray's d2h counter, the trainer's step phases) may hook it
 from . import telemetry
+from . import perf_model
+from . import xprof
 from . import autograd
 from .layout import layout
 from . import random

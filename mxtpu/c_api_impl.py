@@ -1124,17 +1124,19 @@ def get_device_count() -> int:
 
 def get_memory_information(dev_id: int) -> tuple:
     """(free, total) bytes for the device (ref MXGetGPUMemoryInformation64;
-    here PJRT memory stats — absent stats raise, they don't guess)."""
+    here PJRT memory stats — absent stats raise, they don't guess).
+    Reads through ``xprof.device_memory`` — the ONE normalizer the
+    python-API ``util.get_gpu_memory`` and the ``memory.hbm_*`` gauges
+    also use, so the C ABI can never disagree with them."""
     import jax
     devs = jax.devices()
     if dev_id >= len(devs):
         raise MXNetError("no device %d (have %d)" % (dev_id, len(devs)))
-    stats = devs[dev_id].memory_stats()
-    if not stats or "bytes_limit" not in stats:
+    from . import xprof
+    m = xprof.device_memory(devs[dev_id])
+    if not m["bytes_limit"]:
         raise MXNetError("device %d exposes no memory stats" % dev_id)
-    total = int(stats["bytes_limit"])
-    used = int(stats.get("bytes_in_use", 0))
-    return total - used, total
+    return m["bytes_free"], m["bytes_limit"]
 
 
 def notify_shutdown() -> None:

@@ -403,9 +403,8 @@ class CachedOp:
         # provenance names the policy levers active at trace time, so a
         # steady-state recompile (policy env flipped mid-run, unstable
         # input signature) is attributable from telemetry.report() alone
-        telemetry.record_retrace(
-            "cached_op", {"block": type(self._block).__name__,
-                          "train": train, "policy_key": list(key[2])})
+        prov = {"block": type(self._block).__name__,
+                "train": train, "policy_key": list(key[2])}
         block, params = self._block, self._params
         cell = {}  # out_fmt discovered at trace time
 
@@ -421,7 +420,11 @@ class CachedOp:
             cell["out_fmt"] = out_fmt
             return [o._data for o in flat_out], aux
 
-        jitted = jax.jit(pure)
+        # ONE retrace count per cache miss (the fwd/bwd pair); the forward
+        # executable rides compiled= into the xprof ledger and comes back
+        # wrapped (compile wall-time + cost/memory analyses + call count)
+        jitted = telemetry.record_retrace("cached_op", prov,
+                                          compiled=jax.jit(pure))
 
         def bwd(rng_key, in_datas, param_datas, out_cots):
             """Compiled backward: recomputes the forward inside the jit (remat —
@@ -439,7 +442,11 @@ class CachedOp:
             _, vjp_fn = jax.vjp(f, *(list(in_datas) + list(param_datas)))
             return vjp_fn(out_cots)
 
-        jitted_bwd = jax.jit(bwd)
+        # the companion backward shares the site's single retrace count —
+        # ledger-only registration so its FLOPs still feed perf.mfu
+        from .. import xprof
+        jitted_bwd = xprof.watch("cached_op", jax.jit(bwd),
+                                 dict(prov, kind="backward"))
         self._jits[key] = (jitted, jitted_bwd, cell)
         return jitted, jitted_bwd, cell
 

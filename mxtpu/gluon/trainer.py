@@ -98,6 +98,15 @@ class Trainer:
         self._kvstore_kind = kvstore
         self._kvstore = None
         self._update_on_kvstore = update_on_kvstore
+        # runtime MFU attribution (mxtpu/xprof.py): executed ledger FLOPs
+        # over wall clock vs the datasheet peak, gauged as perf.mfu every
+        # meter window — pure host bookkeeping, no device work. The mesh
+        # trainer's peak is the whole mesh's (matching bench.py's mfu).
+        from .. import xprof
+        n_dev = self._mesh.devices.size if self._mesh is not None else 1
+        self._mfu = xprof.MFUMeter(n_devices=n_dev) \
+            if xprof.enabled() else None
+        xprof.ensure_memwatch()  # live HBM gauges when MXTPU_MEMWATCH_S>0
 
     @staticmethod
     def _resolve_mesh(mesh, data_axis):
@@ -268,15 +277,30 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        from .. import resilience, xprof
         with telemetry.span("trainer.step", d2h=True, new_trace=True):
             # attach the producer-thread data events (data.wait/data.h2d
             # pended by the loader when it handed this batch over) to
             # THIS step's trace as causal links
             telemetry.link_pending()
-            with telemetry.span("trainer.step.allreduce"):
-                self._allreduce_grads()
-            with telemetry.span("trainer.step.update"):
-                self._update(ignore_stale_grad)
+            try:
+                resilience.maybe_oom()
+                with telemetry.span("trainer.step.allreduce"):
+                    self._allreduce_grads()
+                with telemetry.span("trainer.step.update"):
+                    self._update(ignore_stale_grad)
+            except Exception as e:
+                if xprof.is_oom(e):
+                    # an HBM OOM must leave an artifact, not just a dead
+                    # process: ledger + per-device memory stats dump
+                    # before the failure propagates loud
+                    ctx = telemetry.current_trace()
+                    xprof.oom_flight(
+                        "trainer.step", e,
+                        trace_ids=[ctx.trace_id] if ctx else [])
+                raise
+            if self._mfu is not None:
+                self._mfu.step()  # host bookkeeping only: perf.mfu gauge
             return self._step_verdict()
 
     def _active_updater(self):

@@ -45,10 +45,8 @@ def _compiled(subgraph_json, input_names, n_outputs):
 
     # retrace watchdog: one compile per (sub-graph, policy) — steady-state
     # recompiles here mean partition JSON churn or a mid-run policy flip
-    telemetry.record_retrace(
-        "subgraph_exec", {"inputs": list(input_names),
-                          "n_outputs": n_outputs,
-                          "policy_key": list(key[2])})
+    prov = {"inputs": list(input_names), "n_outputs": n_outputs,
+            "policy_key": list(key[2])}
 
     sym = _load_sym(subgraph_json)
     names = list(input_names)
@@ -63,7 +61,8 @@ def _compiled(subgraph_json, input_names, n_outputs):
         res = [o._data for o in outs]
         return tuple(res) if n_outputs > 1 else res[0]
 
-    fn = jax.jit(pure)
+    fn = telemetry.record_retrace("subgraph_exec", prov,
+                                  compiled=jax.jit(pure))
     _SUBGRAPH_CACHE[key] = fn
     return fn
 

@@ -897,19 +897,24 @@ class FusedUpdater(Updater):
     def _cached_jit(key, build):
         fn = _JIT_CACHE.get(key)
         if fn is None:
-            fn = build()
-            _JIT_CACHE[key] = fn
-            FUSED_STATS["compiles"] += 1
             # retrace watchdog (mxtpu/telemetry.py): every executable-cache
             # miss reports its cache-key provenance — optimizer class,
             # guard bit, param count, and the policy levers active now —
-            # so a steady-state recompile is attributable without a rerun
+            # so a steady-state recompile is attributable without a rerun.
+            # The built jit rides compiled= into the xprof ledger (compile
+            # wall-time, cost-model FLOPs, HBM footprint) and comes back
+            # wrapped — the wrapper IS what the cache holds.
             from .ops.registry import policy_key
-            telemetry.record_retrace(
+            fn = telemetry.record_retrace(
                 "fused_optimizer",
                 {"optimizer": key[0], "guard": "guard" in key,
                  "n_params": len(key[2]), "mesh": key[3] is not None,
-                 "policy_key": list(policy_key())})
+                 "policy_key": list(policy_key())},
+                compiled=build())
+            # bumped only after build() succeeded: a failed trace/compile
+            # must leave compiles == cache size == retrace count
+            FUSED_STATS["compiles"] += 1
+            _JIT_CACHE[key] = fn
         return fn
 
     def _fused_apply(self, rule, items):

@@ -290,12 +290,13 @@ class ShardedTrainStep:
         from ..ops.registry import policy_key
         # retrace watchdog: one compile per batch structure — after the
         # first step this site must stay flat (an in_fmt change means the
-        # caller reshaped its batch pytree mid-run)
-        telemetry.record_retrace(
-            "parallel.train_step",
-            {"block": type(self._block).__name__, "n_inputs": n_inputs,
-             "donate": bool(self._donate),
-             "policy_key": list(policy_key())})
+        # caller reshaped its batch pytree mid-run); recorded at the
+        # bottom of this builder where the finished jit can ride
+        # compiled= into the xprof ledger
+        retrace_prov = {
+            "block": type(self._block).__name__, "n_inputs": n_inputs,
+            "donate": bool(self._donate),
+            "policy_key": list(policy_key())}
         params, trainable = self._params, self._trainable
         block, loss_blk, forward = self._block, self._loss, self._forward
         rule, static = self._rule, self._static
@@ -364,15 +365,17 @@ class ShardedTrainStep:
             in_specs = [P(self._data_axis)] * n_inputs
         self._in_shardings = [NamedSharding(mesh, s) for s in in_specs]
         donate = (0, 1) if self._donate else ()
-        return jax.jit(
-            step,
-            in_shardings=(self._param_shardings,
-                          list(self._state_shardings),
-                          None, None, self._in_shardings),
-            out_shardings=(self._param_shardings,
-                           list(self._state_shardings),
-                           repl),
-            donate_argnums=donate)
+        return telemetry.record_retrace(
+            "parallel.train_step", retrace_prov,
+            compiled=jax.jit(
+                step,
+                in_shardings=(self._param_shardings,
+                              list(self._state_shardings),
+                              None, None, self._in_shardings),
+                out_shardings=(self._param_shardings,
+                               list(self._state_shardings),
+                               repl),
+                donate_argnums=donate))
 
     def __call__(self, *batch):
         """Run one step on a batch (``(data, label)`` by default). Returns the
@@ -426,10 +429,13 @@ class ShardedTrainStep:
         if self._jit is None or self._last_abstract is None:
             raise MXNetError("run at least one step before asking for FLOPs")
         compiled = self._jit.lower(*self._last_abstract).compile()
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
-            cost = cost[0]
-        return float(cost["flops"])
+        from .. import perf_model
+        flops = perf_model.flops_of(compiled)  # list/dict/None-proof
+        if flops is None:
+            raise MXNetError(
+                "XLA cost analysis exposes no flops for this "
+                "executable on this backend/jax version")
+        return flops
 
     @property
     def learning_rate(self):

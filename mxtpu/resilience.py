@@ -46,7 +46,7 @@ from .base import MXNetError
 __all__ = ["guard_enabled", "default_loss_scale", "ckpt_retries",
            "DynamicLossScaler", "StepHealth", "CheckpointPolicy",
            "ResilientLoop", "inject", "reset_faults", "with_retries",
-           "FAULT_STATS"]
+           "FAULT_STATS", "ResourceExhausted", "maybe_oom"]
 
 _log = logging.getLogger("mxtpu.resilience")
 
@@ -93,7 +93,10 @@ def _parse_faults(spec):
     ``replica_fail`` (serving dispatch index: the replica executing that
     dispatch raises — counts toward its circuit breaker), ``replica_wedge``
     (serving dispatch index: that dispatch never returns — the wedge
-    watchdog quarantines the replica and re-dispatches the batch once)."""
+    watchdog quarantines the replica and re-dispatches the batch once),
+    ``oom`` (occurrence index across the Trainer.step / Predictor
+    dispatch / decode-loop call sites: :func:`maybe_oom` raises a
+    :class:`ResourceExhausted` there, exercising the OOM flight path)."""
     faults = {}
     for part in spec.split(";"):
         part = part.strip()
@@ -154,6 +157,25 @@ def reset_faults():
     _FAULT_CACHE["faults"] = {}
     _FAULT_COUNTERS.clear()
     FAULT_STATS["fired"] = []
+
+
+class ResourceExhausted(RuntimeError):
+    """Injected HBM OOM (fault kind ``oom``). The message mimics jaxlib's
+    ``RESOURCE_EXHAUSTED`` prefix so every production matcher
+    (:func:`mxtpu.xprof.is_oom`) treats it exactly like the real
+    allocator failure — the OOM flight path is testable without actually
+    exhausting a device."""
+
+
+def maybe_oom(index=None):
+    """Fault-injection point for the OOM flight path (kind ``oom``):
+    raises :class:`ResourceExhausted` when ``MXTPU_FAULT_INJECT`` names
+    this occurrence. Call sites: Trainer.step, Predictor dispatch, the
+    decode loop — the places a real ``RESOURCE_EXHAUSTED`` surfaces."""
+    if inject("oom", index):
+        raise ResourceExhausted(
+            "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+            "(injected fault kind 'oom')")
 
 
 # ------------------------------------------------------------------- retries

@@ -137,12 +137,6 @@ class Kernel:
                bool(interpret), repr(in_specs), repr(out_specs))
         if key not in self._compiled:
             from . import telemetry
-            # retrace watchdog: user kernels compile once per launch
-            # signature — a shape-unstable caller shows up here by name
-            telemetry.record_retrace(
-                "rtc", {"kernel": self.name,
-                        "args": [(tuple(a.shape), str(a.dtype))
-                                 for a in args]})
             kwargs = {"out_shape": out_shape if n_out > 1 else out_shape[0],
                       "interpret": interpret}
             if grid is not None:
@@ -152,7 +146,13 @@ class Kernel:
             if out_specs is not None:
                 kwargs["out_specs"] = out_specs
             call = pl.pallas_call(self._fn, **kwargs)
-            self._compiled[key] = jax.jit(call)
+            # retrace watchdog: user kernels compile once per launch
+            # signature — a shape-unstable caller shows up here by name
+            self._compiled[key] = telemetry.record_retrace(
+                "rtc", {"kernel": self.name,
+                        "args": [(tuple(a.shape), str(a.dtype))
+                                 for a in args]},
+                compiled=jax.jit(call))
         res = self._compiled[key](*[a._data if isinstance(a, NDArray)
                                     else jnp.asarray(a) for a in args])
         if isinstance(res, (list, tuple)):

@@ -79,7 +79,7 @@ from jax import lax
 from .. import telemetry
 from ..base import MXNetError
 from ..ndarray import NDArray
-from ..resilience import inject
+from ..resilience import inject, maybe_oom
 from .batcher import DeadlineExceeded, QueueFull, _Future
 from .engine import _TRACE_LOCK, BucketSpec, Predictor, serve_int8_default
 from .replicas import dispatch_timeout_ms_default
@@ -550,6 +550,14 @@ class DecodeEngine:
             self._acct.register(self._tag, self.per_slot_kv_bytes(),
                                 self._capacity,
                                 bucket_slots=self._decode_spec.decode_slots)
+        # will-it-fit pre-flight (mxtpu/xprof.py): Σ AOT step+insert
+        # executable footprints vs the device HBM limit — warmup
+        # succeeding bucket-by-bucket does not mean every bucket's
+        # residents coexist; skipped (zero extra lowering) when the
+        # backend exposes no limit (CPU tier)
+        from .. import xprof
+        xprof.ensure_memwatch()
+        xprof.preflight(self._site)
         return self
 
     def _alloc_carry(self):
@@ -582,12 +590,12 @@ class DecodeEngine:
         hit = self._jits.get(key)
         if hit is not None:
             return hit
-        telemetry.record_retrace(
+        jitted = telemetry.record_retrace(
             self._site,
             {"engine": self._name, "kind": kind, "bucket": bucket,
              "int8": self._int8, "capacity": self._capacity,
-             "max_len": self._max_len, "policy_key": list(key[3])})
-        jitted = jax.jit(build(), donate_argnums=donate)
+             "max_len": self._max_len, "policy_key": list(key[3])},
+            compiled=jax.jit(build(), donate_argnums=donate))
         self._jits[key] = jitted
         return jitted
 
@@ -796,12 +804,30 @@ class DecodeEngine:
         """One engine cycle NOW (wedge scan -> slot admission -> one
         decode step) — the fake-clock test hook and the no-thread drive.
         Returns the number of decode steps executed (0 or 1)."""
-        self._scan_wedges(self._clock())
-        self._admit_pending()
-        steps = self._step_once()
+        try:
+            maybe_oom()  # fault kind 'oom': the decode-loop OOM site
+            self._scan_wedges(self._clock())
+            self._admit_pending()
+            steps = self._step_once()
+        except Exception as e:
+            # an HBM OOM leaves the artifact here too (the no-thread
+            # drive has no crash barrier); the raise stays loud either way
+            self._flight_if_oom(e)
+            raise
         with self._cond:
             self._cycles += 1
         return steps
+
+    def _flight_if_oom(self, exc):
+        """Flight-record a device allocator failure with the KV-cache
+        accountant's residency view attached — which cohort/bucket ate
+        the headroom is readable post-mortem."""
+        from .. import xprof
+        if xprof.is_oom(exc):
+            xprof.oom_flight(
+                "serving.decode", exc,
+                extra={"kv": self._acct.snapshot()
+                       if self._acct is not None else None})
 
     def _free_slot_locked(self):
         for i, s in enumerate(self._slots):
@@ -1298,6 +1324,7 @@ class DecodeEngine:
                             and self._live == 0:
                         return
                 self._admit_pending()
+                maybe_oom()  # fault kind 'oom': the decode-loop OOM site
                 stepped = self._step_once()
                 with self._cond:
                     # loop-progress heartbeat: what probation watches to
@@ -1310,6 +1337,10 @@ class DecodeEngine:
                         # the watchdog resolves it
                         self._cond.wait(0.005)
         except Exception as e:  # noqa: BLE001 — crash barrier (PR-8)
+            # HBM exhaustion in the decode loop: artifact (ledger +
+            # per-device memory stats + accountant view) first, then the
+            # crash barrier fails every pending future LOUD (no hangs)
+            self._flight_if_oom(e)
             self._worker_crashed(e)
 
     def _monitor_loop(self, interval):

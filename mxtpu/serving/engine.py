@@ -453,14 +453,13 @@ class Predictor:
         # here with full provenance). The site name is per-instance so a
         # ReplicaSet member reports at serving.predict.r<i>; the static
         # lint declares this cache via JIT_ALLOWLIST (docs/serving.md).
-        telemetry.record_retrace(
-            self._site,
-            {"predictor": self._name, "block": type(self._block).__name__,
-             "device": str(self._device) if self._device is not None
-             else None,
-             "shapes": [list(s) for s, _ in shape_key],
-             "int8": self._int8,
-             "policy_key": list(key[1])})
+        prov = {"predictor": self._name,
+                "block": type(self._block).__name__,
+                "device": str(self._device) if self._device is not None
+                else None,
+                "shapes": [list(s) for s, _ in shape_key],
+                "int8": self._int8,
+                "policy_key": list(key[1])}
         block, params, pred = self._block, self._params, self
         fixed_key = jax.random.PRNGKey(0)  # deterministic inference: no
         # stochastic layers are live under train=False
@@ -485,7 +484,8 @@ class Predictor:
         # free memory headroom per in-flight bucket. The CPU backend does
         # not implement donation and would warn per compile, so gate it.
         donate = (0,) if jax.default_backend() != "cpu" else ()
-        jitted = jax.jit(pure, donate_argnums=donate)
+        jitted = telemetry.record_retrace(
+            self._site, prov, compiled=jax.jit(pure, donate_argnums=donate))
         self._jits[key] = (jitted, cell)
         return jitted, cell
 
@@ -502,6 +502,14 @@ class Predictor:
             flat, _ = self._run_padded(datas)
             jax.block_until_ready([o._data for o in flat])
         telemetry.gauge("serving.buckets", len(self._spec))
+        # will-it-fit pre-flight over the freshly-warmed bucket
+        # executables (no-op on limit-less backends — zero extra
+        # lowering on the CPU tier) + the live HBM gauges
+        from .. import xprof
+        xprof.ensure_memwatch()
+        xprof.preflight(self._site,
+                        device=self._device if self._device is not None
+                        else 0)
         return self
 
     def _bucket_trailing(self, trailing, seq):
@@ -520,14 +528,29 @@ class Predictor:
         NDArrays at bucket batch, cell)."""
         shape_key = tuple((tuple(d.shape), str(d.dtype)) for d in datas)
         jitted, cell = self._get_jit(shape_key)
-        if "out_fmt" not in cell:
-            # first invocation of this executable traces the shared block
-            # (see _TRACE_LOCK): serialize across replicas' predictors
-            with _TRACE_LOCK:
+        from .. import resilience, xprof
+        try:
+            resilience.maybe_oom()
+            if "out_fmt" not in cell:
+                # first invocation of this executable traces the shared
+                # block (see _TRACE_LOCK): serialize across replicas'
+                # predictors
+                with _TRACE_LOCK:
+                    out = jitted(list(datas), self._param_datas,
+                                 self._param_ranges)
+            else:
                 out = jitted(list(datas), self._param_datas,
                              self._param_ranges)
-        else:
-            out = jitted(list(datas), self._param_datas, self._param_ranges)
+        except Exception as e:
+            if xprof.is_oom(e):
+                # HBM OOM on the predict dispatch: artifact (ledger +
+                # per-device memory stats) first, then fail LOUD — the
+                # batcher's dispatch error path completes the cohort's
+                # futures with this error, never hangs them
+                ctx = telemetry.current_trace()
+                xprof.oom_flight(self._site, e,
+                                 trace_ids=[ctx.trace_id] if ctx else [])
+            raise
         return [NDArray(d) for d in out], cell
 
     def predict_flat(self, args):

@@ -181,12 +181,10 @@ class Executor:
             # (BaseModule._pad_batch_to_bound) precisely so this site
             # stays flat through an epoch tail
             from .. import telemetry
-            telemetry.record_retrace(
-                "executor",
-                {"is_train": is_train,
-                 "inputs": [(n, tuple(feed[n].shape)) for n in names
-                            if n in getattr(self, "_input_names", ())],
-                 "policy_key": list(key[1])})
+            prov = {"is_train": is_train,
+                    "inputs": [(n, tuple(feed[n].shape)) for n in names
+                               if n in getattr(self, "_input_names", ())],
+                    "policy_key": list(key[1])}
 
             def pure(datas):
                 fd = {n: NDArray(d) for n, d in zip(names, datas)}
@@ -203,7 +201,9 @@ class Executor:
                 return ([o._data for o in outs],
                         {k: v._data for k, v in aux_updates.items()})
 
-            self._jits[key] = jax.jit(pure)
+            # compiled= -> xprof ledger; the cache holds the wrapper
+            self._jits[key] = telemetry.record_retrace(
+                "executor", prov, compiled=jax.jit(pure))
         out_datas, aux_updates = self._jits[key](
             [feed[n]._data for n in sorted(feed)])
         for k, v in aux_updates.items():
@@ -234,9 +234,7 @@ class Executor:
             (k, feed[k].shape, str(feed[k].dtype)) for k in names)
         if key not in self._jits:
             from .. import telemetry
-            telemetry.record_retrace(
-                "executor.backward",
-                {"is_train": is_train, "policy_key": list(key[2])})
+
             def bwd(datas, cots):
                 def f(diff_datas):
                     full = dict(zip(names, datas))
@@ -255,7 +253,10 @@ class Executor:
                                         for n in diff])
                 return vjp_fn(cots)[0]
 
-            self._jits[key] = jax.jit(bwd)
+            self._jits[key] = telemetry.record_retrace(
+                "executor.backward",
+                {"is_train": is_train, "policy_key": list(key[2])},
+                compiled=jax.jit(bwd))
         if out_grads is None:
             cots = [jnp.ones_like(o._data) for o in self.outputs]
         else:

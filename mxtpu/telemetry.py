@@ -216,10 +216,12 @@ def inc(name, n=1, tag=None):
         _COUNTERS[k] = _COUNTERS.get(k, 0) + n
 
 
-def gauge(name, v):
-    """Set a gauge to the latest value (last-write-wins)."""
+def gauge(name, v, tag=None):
+    """Set a gauge to the latest value (last-write-wins). ``tag`` keys a
+    labeled sub-gauge (e.g. the per-device ``memory.hbm_*_bytes{device}``
+    family) exactly like counter tags."""
     with _LOCK:
-        _GAUGES[name] = float(v)
+        _GAUGES[(name, tag)] = float(v)
 
 
 def observe(name, v):
@@ -266,7 +268,8 @@ def reset_metric(name):
     with _LOCK:
         for k in [k for k in _COUNTERS if k[0] == name]:
             del _COUNTERS[k]
-        _GAUGES.pop(name, None)
+        for k in [k for k in _GAUGES if k[0] == name]:
+            del _GAUGES[k]
         _HISTS.pop(name, None)
 
 
@@ -298,7 +301,19 @@ def snapshot():
                 counters[name] = {
                     ("_untagged" if t is None else t): v
                     for t, v in tags.items()}
-        gauges = dict(_GAUGES)
+        g_by_name = {}
+        for (name, tag), v in _GAUGES.items():
+            g_by_name.setdefault(name, {})[tag] = v
+        # same collapse rule as counters: pure-untagged gauges stay
+        # scalars (every pre-existing consumer reads them that way),
+        # tagged families become {tag: value} dicts
+        gauges = {}
+        for name, tags in g_by_name.items():
+            if set(tags) == {None}:
+                gauges[name] = tags[None]
+            else:
+                gauges[name] = {("_untagged" if t is None else t): v
+                                for t, v in tags.items()}
         hists = {}
         for name, (cnt, total, mn, mx, res) in _HISTS.items():
             vals = sorted(res)
@@ -307,8 +322,16 @@ def snapshot():
                            "p50": _quantile(vals, 0.5),
                            "p99": _quantile(vals, 0.99)}
         retrace = {site: dict(st) for site, st in _RETRACE.items()}
-    return {"counters": counters, "gauges": gauges, "histograms": hists,
+    snap = {"counters": counters, "gauges": gauges, "histograms": hists,
             "retrace": retrace}
+    # executable-ledger export (mxtpu/xprof.py): the resolve-free view —
+    # a /metrics scrape must never invoke the compiler
+    from . import xprof
+    if xprof.enabled():
+        led = xprof.ledger_snapshot()
+        if led:
+            snap["ledger"] = led
+    return snap
 
 
 def report():
@@ -343,7 +366,13 @@ def report():
         lines.append("")
         lines.append("%-38s %12s" % ("Gauge", "Value"))
         for name in sorted(snap["gauges"]):
-            lines.append("%-38s %12g" % (name, snap["gauges"][name]))
+            v = snap["gauges"][name]
+            if isinstance(v, dict):
+                for tag in sorted(v):
+                    lines.append("%-38s %12g" %
+                                 ("%s{%s}" % (name, tag), v[tag]))
+            else:
+                lines.append("%-38s %12g" % (name, v))
     if snap["retrace"]:
         lines.append("")
         lines.append("%-20s %9s %6s  %s" %
@@ -380,6 +409,8 @@ def reset():
         _TRACE_EVENTS = collections.deque(maxlen=_trace_ring_cap())
         _PENDING_LINKS.q.clear()  # the calling thread's (tests drain
         _FLIGHT["count"] = 0      # their own; other threads' are bounded)
+    from . import xprof
+    xprof.reset()  # the executable ledger rides the registry lifecycle
 
 
 # -------------------------------------------------------------------- spans
@@ -832,9 +863,18 @@ def prometheus():
         else:
             lines.append("%s %g" % (pn, v))
     for name in sorted(snap["gauges"]):
+        v = snap["gauges"][name]
         pn = _prom_name(name)
         lines.append("# TYPE %s gauge" % pn)
-        lines.append("%s %g" % (pn, snap["gauges"][name]))
+        if isinstance(v, dict):
+            for tag in sorted(v):
+                if tag == "_untagged":
+                    lines.append("%s %g" % (pn, v[tag]))
+                else:
+                    lines.append('%s{tag="%s"} %g'
+                                 % (pn, _prom_label(tag), v[tag]))
+        else:
+            lines.append("%s %g" % (pn, v))
     for name in sorted(snap["histograms"]):
         h = snap["histograms"][name]
         pn = _prom_name(name)
@@ -864,7 +904,7 @@ def d2h_count():
 
 
 # --------------------------------------------------------- retrace watchdog
-def record_retrace(site, provenance=None):
+def record_retrace(site, provenance=None, compiled=None):
     """Report one jit-cache compile at ``site`` with its cache-key
     provenance (optimizer class, ``registry.policy_key`` tuple, ...).
     Counts into ``retrace.<site>``; past :func:`retrace_budget` compiles
@@ -872,8 +912,20 @@ def record_retrace(site, provenance=None):
     ``retrace.watchdog_trips`` — a steady-state recompile means a policy
     env flipped mid-run or a cache key is unstable (shapes/hyper leaking
     into the static config), both of which silently serialize training
-    behind the compiler."""
+    behind the compiler.
+
+    ``compiled=`` (ISSUE 12) hands the freshly-built executable to the
+    :mod:`mxtpu.xprof` ledger: pass the jitted callable and CACHE THE
+    RETURN VALUE — with the observatory on it comes back wrapped for
+    first-dispatch compile timing, call counting, and lazy
+    cost/memory-analysis capture (``MXTPU_XPROF=0`` returns it
+    unchanged). Without ``compiled`` the call behaves exactly as before
+    and returns None."""
     inc("retrace." + site)
+    wrapped = None
+    if compiled is not None:
+        from . import xprof
+        wrapped = xprof.attach(site, provenance, compiled)
     budget = retrace_budget()
     with _LOCK:
         st = _RETRACE.setdefault(site,
@@ -897,7 +949,7 @@ def record_retrace(site, provenance=None):
         # pathology is a recompile every step — warning each time would
         # flood hours of logs with the message meant to make them readable
         if trips != 1 and trips % 100 != 0:
-            return
+            return wrapped
         _log.warning(
             "retrace watchdog: '%s' compiled %d times, over "
             "MXTPU_RETRACE_BUDGET=%d. Last provenance: %s. Steady-state "
@@ -905,6 +957,7 @@ def record_retrace(site, provenance=None):
             "an unstable cache key — each one stalls every step behind "
             "the compiler (docs/observability.md)",
             site, compiles, budget, provenance)
+    return wrapped
 
 
 def retrace_stats(site=None):
@@ -966,9 +1019,21 @@ def flush():
                 if tag is not None:
                     rec["tag"] = tag
                 lines_by_path.setdefault(path, []).append(rec)
-            for name, v in _GAUGES.items():
+            for (name, tag), v in _GAUGES.items():
+                rec = {"t": now, "kind": "gauge", "metric": name,
+                       "value": v}
+                if tag is not None:
+                    rec["tag"] = tag
+                lines_by_path.setdefault(path, []).append(rec)
+        # executable-ledger lines (kind="ledger", cumulative like the
+        # counters — tools/telemetry_report.py --ledger folds the last
+        # line per (site, seq) into the roofline table). Resolve-free:
+        # flush may run at interpreter exit, no compiler invocations.
+        from . import xprof
+        if xprof.enabled():
+            for e in xprof.ledger_snapshot():
                 lines_by_path.setdefault(path, []).append(
-                    {"t": now, "kind": "gauge", "metric": name, "value": v})
+                    dict(e, t=now, kind="ledger"))
     with _SINK["lock"]:
         for p, recs in lines_by_path.items():
             try:

@@ -25,18 +25,15 @@ def get_gpu_count():
 
 def get_gpu_memory(dev_id=0):
     """(free, total) accelerator memory in bytes when the backend exposes
-    it, else (0, 0)."""
-    import jax
-    try:
-        d = jax.devices()[dev_id]
-        stats = d.memory_stats() or {}
-        total = stats.get("bytes_limit", 0)
-        used = stats.get("bytes_in_use", 0)
-        if not total:  # stats dict is backend-dependent; never report
-            return 0, 0  # negative free when bytes_limit is absent
-        return max(total - used, 0), total
-    except Exception:  # noqa: BLE001
-        return 0, 0
+    it, else (0, 0). One source of truth: ``xprof.device_memory`` owns
+    the stats-key fallbacks, so this, the C-ABI
+    ``MXGetGPUMemoryInformation``, and the live ``memory.hbm_*`` gauges
+    can never disagree."""
+    from . import xprof
+    m = xprof.device_memory(dev_id)
+    if not m["bytes_limit"]:  # stats dict is backend-dependent; never
+        return 0, 0           # report negative free on a missing limit
+    return m["bytes_free"], m["bytes_limit"]
 
 
 def is_np_shape():

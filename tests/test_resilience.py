@@ -212,19 +212,23 @@ def test_guard_cache_stability_fused_and_cachedop(monkeypatch):
     assert len(net._cached_op._jits) == n0 + 1  # policy flip: ONE retrace
 
 
-@pytest.mark.parametrize("telemetry_on,trace_on",
-                         [("0", "0"), ("1", "0"), ("1", "1")])
+@pytest.mark.parametrize("telemetry_on,trace_on,xprof_on",
+                         [("0", "0", "0"), ("1", "0", "0"),
+                          ("1", "1", "0"), ("1", "1", "1")])
 def test_guarded_hot_loop_has_no_host_sync(monkeypatch, telemetry_on,
-                                           trace_on):
+                                           trace_on, xprof_on):
     """The acceptance contract: sentinel+scaler add no per-step host sync.
     After warmup, guarded Trainer.steps run under a device->host transfer
     guard that hard-fails on any fetch. Runs with the telemetry layer ON
-    too (ISSUE 4), and with causal tracing ON on top (ISSUE 10): spans,
-    trace contexts, and the flight-recorder ring are pure host
-    bookkeeping and must not introduce a single device fetch."""
+    too (ISSUE 4), with causal tracing ON on top (ISSUE 10), and with the
+    executable observatory ON on top of that (ISSUE 12): spans, trace
+    contexts, the flight-recorder ring, and the ledger's wrapped-jit call
+    counting are pure host bookkeeping and must not introduce a single
+    device fetch."""
     import jax
     monkeypatch.setenv("MXTPU_TELEMETRY", telemetry_on)
     monkeypatch.setenv("MXTPU_TRACE", trace_on)
+    monkeypatch.setenv("MXTPU_XPROF", xprof_on)
     scaler = resilience.DynamicLossScaler(init_scale=4.0)
     tr, params, rng = _make_trainer(optimizer="adam",
                                     opt_params={"learning_rate": 0.01},

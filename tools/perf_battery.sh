@@ -24,8 +24,13 @@ export MXTPU_TELEMETRY="$TELEMETRY_JSONL"
 export MXTPU_TELEMETRY_FLUSH_S=${MXTPU_TELEMETRY_FLUSH_S:-30}
 
 telemetry_report() {
+  # --ledger (ISSUE 12): the per-jit-site roofline table — cost-model
+  # intensity vs the chip ridge, memory-bound Pallas candidates ranked —
+  # dumped after every session so each battery artifact carries the
+  # standing fusion-gap report next to the latency table
   [ -s "$TELEMETRY_JSONL" ] && \
-    python tools/telemetry_report.py "$TELEMETRY_JSONL" 2>&1 | tee -a "$LOG"
+    python tools/telemetry_report.py "$TELEMETRY_JSONL" --ledger \
+      2>&1 | tee -a "$LOG"
 }
 
 # -1. trace-discipline gate (pure-AST, no jax import, no TPU session): an
@@ -91,7 +96,7 @@ sleep 60
 timeout 900 env BENCH_CONFIG=telemetry_overhead BENCH_PREFLIGHT=0 \
   python bench.py 2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
 [ -s "$TELEMETRY_JSONL" ] && \
-  python tools/telemetry_report.py "$TELEMETRY_JSONL" --traces 10 \
+  python tools/telemetry_report.py "$TELEMETRY_JSONL" --traces 10 --ledger \
     2>&1 | tee -a "$LOG"
 
 # 4. multichip scaling phase (ISSUE 7): mesh-native gluon Trainer items/s

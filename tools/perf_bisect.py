@@ -34,11 +34,13 @@ def timeit(fn, *args, steps=20):
 
 
 def flops_of(jfn, *args):
+    """XLA cost-model FLOPs via the shared version-proof accessor
+    (mxtpu/perf_model.py — list-of-dicts vs dict vs None handled there,
+    not re-derived per tool)."""
+    from mxtpu import perf_model
     c = jfn.lower(*args).compile()
-    cost = c.cost_analysis()
-    if isinstance(cost, (list, tuple)):
-        cost = cost[0]
-    return float(cost["flops"])
+    fl = perf_model.flops_of(c)
+    return fl if fl is not None else 0.0
 
 
 def main():

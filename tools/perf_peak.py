@@ -55,9 +55,15 @@ def main():
     best = max(best, probe(8192, acc=jnp.float32))
     # the honest-f32 emulation floor (PERF.md ceiling table, f32 HIGHEST row)
     probe(8192, prec="highest", dtype=jnp.float32)
-    nominal = 197.0
+    # datasheet nominal from the ONE shared table (mxtpu/perf_model.py)
+    # — the same denominator bench.py's mfu and the runtime perf.mfu
+    # gauge divide by
+    from mxtpu import perf_model
+    nominal = perf_model.nominal_tflops(d) or 197.0
     print("achievable ceiling: %.1f TFLOP/s = %.0f%% of the %.0f TFLOP/s "
-          "v5e datasheet peak" % (best, 100 * best / nominal, nominal))
+          "%s datasheet peak"
+          % (best, 100 * best / nominal, nominal,
+             getattr(d, "device_kind", "?")))
 
 
 if __name__ == "__main__":

@@ -16,10 +16,21 @@ by total latency, each with its span count and SLOWEST stage — the
 "which stage made this request/step slow" question answered from the
 artifact alone, no live repro.
 
+With the executable observatory on (``MXTPU_XPROF``, default 1), each
+flush also streams ``kind="ledger"`` lines — one per jit-site executable
+with its cost-model FLOPs/bytes, HBM footprint, and compile wall-time —
+and ``--ledger`` renders the per-site roofline table: arithmetic
+intensity vs the chip's ridge point → compute- vs memory-bound verdict,
+plus the ranked hand-kernel (Pallas) candidate list — the fusion-gap
+methodology of arXiv:2301.13062 as a standing report. The "achieved"
+column folds in the site's own span p50 where one exists (e.g.
+``serving.predict``) — an approximation (host dispatch wall time, not
+device occupancy), printed only where the span times the dispatch.
+
 Usage::
 
     python tools/telemetry_report.py telemetry.jsonl [--json]
-        [--traces [K]]
+        [--traces [K]] [--ledger]
 """
 from __future__ import annotations
 
@@ -66,7 +77,9 @@ def aggregate(lines):
                 banked += last
             counters[key] = (banked, rec["value"])
         elif kind == "gauge":
-            gauges[name] = float(rec["value"])
+            tag = rec.get("tag")
+            key = "%s{%s}" % (name, tag) if tag else name
+            gauges[key] = float(rec["value"])
     counters = {k: banked + last for k, (banked, last) in counters.items()}
     out = {}
     for name, vals in obs.items():
@@ -131,6 +144,73 @@ def format_trace_table(rows):
     return "\n".join(lines)
 
 
+def ledger_summary(lines):
+    """Fold ``kind=="ledger"`` records into per-executable roofline rows.
+
+    Ledger lines are cumulative like the counters (one batch per flush):
+    the LAST line per (site, seq) wins. Returns ``(rows, candidates)``
+    where candidates is the memory-bound shortlist ranked by executed
+    FLOPs (flops x calls) — the entries where a hand kernel buys the
+    most."""
+    entries = {}
+    obs = {}
+    for rec in lines:
+        kind = rec.get("kind")
+        if kind == "ledger" and rec.get("site") is not None:
+            entries[(rec["site"], rec.get("seq"))] = rec
+        elif kind == "obs" and rec.get("metric") is not None:
+            obs.setdefault(rec["metric"], []).append(float(rec["value"]))
+    rows = []
+    for (site, seq), e in sorted(entries.items(),
+                                 key=lambda kv: kv[0][1] or 0):
+        fl = e.get("flops")
+        row = {"site": site, "seq": seq, "calls": int(e.get("calls") or 0),
+               "compile_s": e.get("compile_s"), "flops": fl,
+               "bytes_accessed": e.get("bytes_accessed"),
+               "intensity": e.get("intensity"),
+               "critical_intensity": e.get("critical_intensity"),
+               "verdict": e.get("verdict"), "error": e.get("error")}
+        vals = obs.get(site)
+        if vals and fl:
+            vals = sorted(vals)
+            p50 = _quantile(vals, 0.5)
+            if p50:
+                row["achieved_flops_per_s"] = fl / p50
+        rows.append(row)
+    cands = [r for r in rows if r.get("verdict") == "memory"
+             and r.get("flops")]
+    cands.sort(key=lambda r: -(r["flops"] * max(r["calls"], 1)))
+    return rows, cands
+
+
+def format_ledger_table(rows, cands):
+    if not rows:
+        return ("(no ledger records — is MXTPU_XPROF on, and did the "
+                "process flush its telemetry sink?)")
+    lines = ["%-30s %7s %9s %9s %9s %8s %8s  %s" %
+             ("Site#seq", "Calls", "Compile(s)", "GFLOP", "MB-acc",
+              "FLOP/B", "Achieved", "Verdict")]
+    for r in rows:
+        ach = r.get("achieved_flops_per_s")
+        lines.append("%-30s %7d %9s %9s %9s %8s %8s  %s" % (
+            "%s#%s" % (r["site"], r["seq"]), r["calls"],
+            "%.3f" % r["compile_s"] if r.get("compile_s") else "-",
+            "%.2f" % (r["flops"] / 1e9) if r.get("flops") else "-",
+            "%.1f" % (r["bytes_accessed"] / 1e6)
+            if r.get("bytes_accessed") else "-",
+            "%.1f" % r["intensity"] if r.get("intensity") else "-",
+            "%.1fT" % (ach / 1e12) if ach else "-",
+            r.get("error") or r.get("verdict")
+            or "unknown (no chip ridge)"))
+    if cands:
+        lines.append("")
+        lines.append("Pallas candidates (memory-bound, by executed "
+                     "FLOPs): " + ", ".join(
+                         "%s#%s" % (r["site"], r["seq"])
+                         for r in cands[:8]))
+    return "\n".join(lines)
+
+
 def load(path):
     records = []
     with open(path) as f:
@@ -172,6 +252,7 @@ def format_table(summary):
 def main(argv):
     argv = list(argv)
     as_json = "--json" in argv
+    with_ledger = "--ledger" in argv
     top = None
     if "--traces" in argv:
         top = 10
@@ -188,16 +269,24 @@ def main(argv):
     records = load(path)
     summary = aggregate(records)
     traces = trace_summary(records, top=top) if top is not None else None
+    ledger = ledger_summary(records) if with_ledger else None
     if as_json:
         out = dict(summary)
         if traces is not None:
             out["_traces"] = traces
+        if ledger is not None:
+            out["_ledger"] = {"rows": ledger[0],
+                              "candidates": ["%s#%s" % (r["site"], r["seq"])
+                                             for r in ledger[1]]}
         print(json.dumps(out, sort_keys=True))
     else:
         print(format_table(summary))
         if traces is not None:
             print()
             print(format_trace_table(traces))
+        if ledger is not None:
+            print()
+            print(format_ledger_table(*ledger))
     return 0
 
 
