@@ -883,6 +883,43 @@ def bench_serving_decode(emit=None):
     }
 
 
+def bench_serving_slo(emit=None):
+    """SLO-aware serving control plane (mxtpu/serving/controller,
+    ISSUE 13): ``tools/serve_bench.py --mode slo`` driven in-process.
+    Phase 1 is the overload curve — goodput-at-SLO (completions within
+    deadline / offered) for the predictive-admission controller vs the
+    static depth-shed router at EQUAL replicas, paced open-loop at
+    multiples of calibrated capacity. Phase 2 (>= 2 devices) is the
+    kill/restore sweep: a replica is quarantined as a dead chip and the
+    controller must REPLACE it with windowed p99 recovering inside the
+    gated window, zero hung futures. ``vs_baseline`` is the goodput
+    gain at the best overload point when EVERY gate holds, else 0.0."""
+    if emit is None:
+        emit = _emit
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import serve_bench as sb
+
+    rec = sb.run_slo(
+        n_requests=int(os.environ.get("BENCH_SLO_REQUESTS", "200")),
+        emit=emit)
+    kill = rec["killrestore"]
+    return {
+        "metric": "serving_slo",
+        "value": round(max(rec["gains"]), 4),
+        "unit": "goodput_gain_at_best_point",
+        "vs_baseline": round(max(rec["gains"]), 4) if rec["ok"] else 0.0,
+        "mfu": None,
+        "hfu": None,
+        "slo_ms": round(rec["slo_ms"], 2),
+        "curve_ok": rec["curve_ok"],
+        "hangs": rec["hangs"],
+        "killrestore_ok": kill["ok"] if kill else None,
+        "p99_recovery_s": kill["value"] if kill else None,
+        "gates_ok": rec["ok"],
+    }
+
+
 def bench_multichip_resnet(emit=None):
     """Mesh-native Trainer scaling (ISSUE 7): resnet18 data-parallel over
     1..N devices through ``gluon.Trainer(mesh=...)`` with ZeRO-1 on, at a
@@ -1223,6 +1260,7 @@ CONFIGS = {
     "conv_class": bench_conv_class,
     "serving": bench_serving,
     "serving_decode": bench_serving_decode,
+    "serving_slo": bench_serving_slo,
     "multichip_resnet": bench_multichip_resnet,
     "input_pipeline": bench_input_pipeline,
     "sparse_linear": bench_sparse_linear,

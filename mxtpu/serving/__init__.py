@@ -17,6 +17,14 @@ The TPU-native successor to the reference's C predict API
 * :class:`ModelServer` (``server``) — stdlib-threaded HTTP front
   (``/predict`` ``/healthz`` ``/metrics``) with 503 shedding, per-replica
   health reporting, and SIGTERM graceful drain;
+* :class:`ServingController` (``controller``) — the SLO control plane
+  closing the observe -> decide -> act loop over the trace layer:
+  predictive admission (per-bucket latency model sheds
+  ``predicted_miss`` before the queue fills), priority classes
+  (interactive wins the coalescing slot, batch ages in and is evicted
+  first), elastic autoscaling between ``MXTPU_SERVE_MIN/MAX_REPLICAS``
+  with cooldown hysteresis, and dead-replica replacement on a fresh
+  device;
 * :class:`DecodeEngine` / :class:`KVCacheAccountant` (``decode``) — the
   LLM workload class: prefill through the bucketed Predictor, then a
   continuous-batching decode step loop over KV-cache-carrying slots
@@ -26,7 +34,11 @@ The TPU-native successor to the reference's C predict API
   and an int8 weight+KV storage path (``MXTPU_SERVE_INT8``).
 """
 from .batcher import (DeadlineExceeded, MicroBatcher, QueueFull,
-                      max_batch_default, max_wait_ms_default, queue_default)
+                      batch_aging_ms_default, max_batch_default,
+                      max_wait_ms_default, queue_default)
+from .controller import (ServingController, max_replicas_default,
+                         min_replicas_default, replace_after_ms_default,
+                         scale_cooldown_ms_default)
 from .decode import (DecodeEngine, DecodeFuture, DecodeModel,
                      KVCacheAccountant, decode_max_new_default,
                      decode_queue_default, decode_slots_default,
@@ -48,4 +60,7 @@ __all__ = ["BucketSpec", "Predictor", "pad_nd", "MicroBatcher",
            "max_batch_default", "max_wait_ms_default", "queue_default",
            "replica_count_default", "dispatch_timeout_ms_default",
            "breaker_threshold_default", "breaker_backoff_ms_default",
-           "breaker_backoff_max_ms_default"]
+           "breaker_backoff_max_ms_default",
+           "ServingController", "batch_aging_ms_default",
+           "min_replicas_default", "max_replicas_default",
+           "scale_cooldown_ms_default", "replace_after_ms_default"]

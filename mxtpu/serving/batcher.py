@@ -50,7 +50,8 @@ from ..base import MXNetError
 from ..resilience import inject
 
 __all__ = ["MicroBatcher", "QueueFull", "DeadlineExceeded",
-           "max_batch_default", "max_wait_ms_default", "queue_default"]
+           "max_batch_default", "max_wait_ms_default", "queue_default",
+           "batch_aging_ms_default", "PRIORITIES"]
 
 _log = logging.getLogger("mxtpu.serving")
 
@@ -73,6 +74,22 @@ def queue_default():
     """Admission bound in ITEMS (``MXTPU_SERVE_QUEUE``, default 256):
     beyond it submits shed (503) instead of growing tail latency."""
     return int(os.environ.get("MXTPU_SERVE_QUEUE", "256"))
+
+
+def batch_aging_ms_default():
+    """Starvation floor for the ``batch`` priority class
+    (``MXTPU_SERVE_BATCH_AGING_MS``, default 1000): batch-class requests
+    yield their coalescing slot to ``interactive`` traffic, but a batch
+    head that has waited this long dispatches ahead of fresher
+    interactive work — strict priority, never outright starvation."""
+    return float(os.environ.get("MXTPU_SERVE_BATCH_AGING_MS", "1000"))
+
+
+# the two priority classes: interactive wins the coalescing slot, batch
+# is the first to shed (evicted from the queue tail to admit interactive
+# under pressure) and dispatches only when no interactive cohort is
+# ready or its aging floor has passed
+PRIORITIES = ("interactive", "batch")
 
 
 class QueueFull(MXNetError):
@@ -115,14 +132,16 @@ class _Future:
 
 class _Request:
     __slots__ = ("inputs", "n", "bucket_key", "deadline", "t_enq", "future",
-                 "redispatched", "trace")
+                 "redispatched", "trace", "priority")
 
-    def __init__(self, inputs, n, bucket_key, deadline, t_enq, trace=None):
+    def __init__(self, inputs, n, bucket_key, deadline, t_enq, trace=None,
+                 priority="interactive"):
         self.inputs = inputs
         self.n = n
         self.bucket_key = bucket_key
         self.deadline = deadline
         self.t_enq = t_enq
+        self.priority = priority
         self.future = _Future()
         # set when a wedge-watchdog trip re-enqueues this request on a
         # healthy replica: re-dispatch happens exactly ONCE (replicas.py)
@@ -143,7 +162,8 @@ class MicroBatcher:
 
     def __init__(self, predictor, max_batch_size=None, max_wait_ms=None,
                  max_queue=None, clock=time.monotonic, start=True,
-                 allow_cold=False, admission_gate=None):
+                 allow_cold=False, admission_gate=None,
+                 batch_aging_ms=None):
         self._pred = predictor
         # optional admission hook beyond queue depth: called with the
         # request's item count, returns a shed-reason string to refuse or
@@ -152,12 +172,19 @@ class MicroBatcher:
         # seam any resource ledger (device memory, SLO predictor) plugs
         # into without subclassing
         self._gate = admission_gate
+        # the SLO control plane (controller.attach via ServingController):
+        # predictive admission consults it in _admit, delivery feeds its
+        # latency model in _deliver — None = the static depth-shed path
+        self._controller = None
         self.max_batch = int(max_batch_size if max_batch_size is not None
                              else max_batch_default())
         self.max_wait_s = float(max_wait_ms if max_wait_ms is not None
                                 else max_wait_ms_default()) / 1e3
         self.max_queue = int(max_queue if max_queue is not None
                              else queue_default())
+        self.batch_aging_s = float(
+            batch_aging_ms if batch_aging_ms is not None
+            else batch_aging_ms_default()) / 1e3
         self._clock = clock
         self._q = collections.deque()
         self._items = 0
@@ -178,10 +205,23 @@ class MicroBatcher:
             self.start()
 
     # ------------------------------------------------------------- admission
-    def submit(self, inputs, deadline_ms=None):
+    def attach_controller(self, controller):
+        """Wire the SLO control plane in (normally done by
+        ``ServingController.__init__``): admission consults
+        ``controller.admit`` (predictive shed), delivery feeds
+        ``controller.observe``, sheds/expiries feed its pressure
+        signals. Returns self."""
+        self._controller = controller
+        return self
+
+    def submit(self, inputs, deadline_ms=None, priority="interactive"):
         """Enqueue one request — ``inputs`` is an array or tuple of arrays
         sharing batch axis 0 (host numpy stays host-side until dispatch).
         Returns a future; raises :class:`QueueFull` when shed.
+        ``priority`` is the request's class (``interactive`` | ``batch``:
+        batch yields its coalescing slot to interactive traffic — up to
+        the ``MXTPU_SERVE_BATCH_AGING_MS`` starvation floor — and is the
+        first evicted under queue pressure).
 
         Each admitted request starts a causal trace here (the
         ``serving.submit`` stage covers validation + enqueue on the
@@ -193,12 +233,15 @@ class MicroBatcher:
         t0 = time.perf_counter()
         with telemetry.trace_handoff(trace), \
                 telemetry.span("serving.submit"):
-            req = self._admit(inputs, deadline_ms, trace)
+            req = self._admit(inputs, deadline_ms, trace, priority)
         telemetry.add_stage(trace, "serving.submit",
                             time.perf_counter() - t0)
         return req.future
 
-    def _admit(self, inputs, deadline_ms, trace):
+    def _admit(self, inputs, deadline_ms, trace, priority="interactive"):
+        if priority not in PRIORITIES:
+            raise MXNetError("submit: unknown priority %r (expected one "
+                             "of %s)" % (priority, "|".join(PRIORITIES)))
         if not isinstance(inputs, (tuple, list)):
             inputs = (inputs,)
         if getattr(inputs[0], "ndim", 0) < 1:
@@ -224,24 +267,101 @@ class MicroBatcher:
             reason = self._gate(n)
             if reason:
                 self._shed(str(reason))
+        if self._controller is not None:
+            # predictive admission (the SLO control plane): shed NOW when
+            # the per-bucket latency model already predicts a deadline
+            # miss — before MXTPU_SERVE_QUEUE fills. queued_ahead is an
+            # advisory snapshot; the model's backlog term only needs the
+            # order of magnitude
+            queued_ahead = sum(r.n for r in list(self._q)
+                               if r.bucket_key == bucket_key)
+            reason = self._controller.admit(
+                n, bucket_key,
+                None if deadline_ms is None else deadline_ms / 1e3,
+                priority, queued_ahead=queued_ahead)
+            if reason:
+                telemetry.trace_mark(trace, "serving.controller.shed")
+                self._shed(str(reason))
         now = self._clock()
         deadline = None if deadline_ms is None else now + deadline_ms / 1e3
-        req = _Request(inputs, n, bucket_key, deadline, now, trace)
+        req = _Request(inputs, n, bucket_key, deadline, now, trace,
+                       priority)
+        evicted, shed_reason = (), None
         with self._cond:
             if self._crashed:
                 # crash barrier: a dead worker thread can never deliver —
                 # admitting would strand this future forever
-                self._shed("worker_crashed")
-            if self._draining or self._closed:
-                self._shed("draining")
-            if self._items + n > self.max_queue:
-                self._shed("queue_full")
-            self._q.append(req)
-            self._items += n
-            telemetry.gauge("serving.queue_depth", self._items)
-            self._cond.notify()
+                shed_reason = "worker_crashed"
+            elif self._draining or self._closed:
+                shed_reason = "draining"
+            else:
+                if self._items + n > self.max_queue:
+                    # submit-time pressure: sweep already-expired entries
+                    # first — a request whose deadline passed while
+                    # queued occupies admission capacity for an answer
+                    # nobody is waiting for, and used to crowd out fresh
+                    # work until its cohort dispatched
+                    self._sweep_expired_locked(now)
+                if self._items + n > self.max_queue \
+                        and priority == "interactive":
+                    # batch is first to shed: evict the NEWEST
+                    # batch-class entries to admit interactive work
+                    # under overload
+                    evicted = self._evict_batch_locked(n)
+                if self._items + n > self.max_queue:
+                    shed_reason = "queue_full"
+                else:
+                    self._q.append(req)
+                    self._items += n
+                    telemetry.gauge("serving.queue_depth", self._items)
+                    self._cond.notify()
+        # victims complete BEFORE any shed raise: an eviction must never
+        # strand a future (and _evict_batch_locked only evicts when the
+        # eviction actually makes room, so a still-shedding interactive
+        # submit cannot drop batch work for nothing)
+        for victim in evicted:
+            self._fail(victim, QueueFull(
+                "request shed: priority_evict (batch-class entry evicted "
+                "for interactive admission)"))
+        if shed_reason is not None:
+            self._shed(shed_reason)
         telemetry.inc("serving.requests")
         return req
+
+    def _sweep_expired_locked(self, now):
+        """Drop queued requests whose deadline already passed (each
+        completes with :class:`DeadlineExceeded`, exactly as it would
+        have at dispatch) so fresh work is admitted before the depth
+        bound sheds it."""
+        for r in [r for r in self._q
+                  if r.deadline is not None and now > r.deadline]:
+            self._q.remove(r)
+            self._items -= r.n
+            self._expire(r)
+        telemetry.gauge("serving.queue_depth", self._items)
+
+    def _evict_batch_locked(self, need):
+        """Remove newest batch-class entries until ``need`` more items
+        fit. Returns the victims; the caller fails them outside the hot
+        bookkeeping. Evicts NOTHING when even a full eviction could not
+        make room — dropping batch work for an interactive submit that
+        sheds anyway would be a pure loss."""
+        evictable = sum(r.n for r in self._q if r.priority == "batch")
+        if self._items - evictable + need > self.max_queue:
+            return []
+        victims = []
+        for r in [r for r in reversed(self._q) if r.priority == "batch"]:
+            if self._items + need <= self.max_queue:
+                break
+            self._q.remove(r)
+            self._items -= r.n
+            victims.append(r)
+            telemetry.inc("serving.shed", tag="priority_evict")
+        if victims:
+            telemetry.gauge("serving.queue_depth", self._items)
+            if self._controller is not None:
+                self._controller.note_shed("priority_evict", self._clock())
+        return victims
 
     def _validate_shapes(self, inputs, spec):
         """Admission-time template check: a malformed request must be
@@ -272,28 +392,71 @@ class MicroBatcher:
 
     def _shed(self, reason):
         telemetry.inc("serving.shed", tag=reason)
+        if self._controller is not None:
+            self._controller.note_shed(reason, self._clock())
         raise QueueFull("request shed: %s" % reason)
 
     @property
     def queue_depth(self):
         return self._items
 
+    def queue_depths(self):
+        """Queued ITEMS per priority class (the /healthz controller
+        view; the untagged ``serving.queue_depth`` gauge stays the
+        total)."""
+        out = dict.fromkeys(PRIORITIES, 0)
+        with self._cond:
+            for r in self._q:
+                out[r.priority] = out.get(r.priority, 0) + r.n
+        return out
+
     @property
     def draining(self):
         return self._draining
 
     # ------------------------------------------------------------ coalescing
+    def _lead_locked(self, now):
+        """``(lead, yielded)``: the request whose cohort dispatches next
+        — strict priority (the first interactive request in FIFO order)
+        with an aging floor: a batch-class head that has waited
+        ``batch_aging_s`` takes the slot regardless, so batch yields
+        under load but never starves outright. ``yielded`` is the
+        batch-class overall head an interactive lead is jumping (the
+        caller records the yield decision iff that cohort dispatches)."""
+        first_inter = first_batch = None
+        for r in self._q:
+            if r.priority == "batch":
+                if first_batch is None:
+                    first_batch = r
+            elif first_inter is None:
+                first_inter = r
+            if first_inter is not None and first_batch is not None:
+                break
+        if first_inter is None:
+            return first_batch, None
+        if first_batch is None:
+            return first_inter, None
+        if (now - first_batch.t_enq) >= self.batch_aging_s:
+            # aging floor: batch has waited long enough to take the slot
+            return first_batch, None
+        # the batch head yields its slot to the interactive cohort; the
+        # caller records the yield ONLY when that cohort dispatches
+        yielded = first_batch if self._q[0] is first_batch else None
+        return first_inter, yielded
+
     def _gather_locked(self, now):
-        """Under the lock: the coalescing rule. Takes the head request's
-        bucket cohort in FIFO order up to ``max_batch`` items; dispatches
-        when full, when the head waited ``max_wait_s``, or when draining.
-        Returns the requests to dispatch, or None to keep waiting."""
+        """Under the lock: the coalescing rule. Takes the lead request's
+        bucket cohort in FIFO order up to ``max_batch`` items (the lead
+        is the FIFO head within the priority ladder — see
+        :meth:`_lead_locked`); dispatches when full, when the lead
+        waited ``max_wait_s``, or when draining. Returns the requests to
+        dispatch, or None to keep waiting."""
         if not self._q:
             return None
-        head = self._q[0]
+        lead, yielded = self._lead_locked(now)
         take, n = [], 0
         for r in self._q:
-            if r.bucket_key != head.bucket_key:
+            if r.bucket_key != lead.bucket_key:
                 continue  # FIFO within bucket: other cohorts keep queueing
             if n + r.n > self.max_batch:
                 break
@@ -302,7 +465,14 @@ class MicroBatcher:
             if n == self.max_batch:
                 break
         if n >= self.max_batch or self._draining or \
-                (now - head.t_enq) >= self.max_wait_s:
+                (now - lead.t_enq) >= self.max_wait_s:
+            if yielded is not None and yielded not in take:
+                # an interactive cohort is jumping the batch-class head:
+                # the yield decision, visible in telemetry and on the
+                # yielded request's own trace
+                telemetry.inc("serving.controller.decisions", tag="yield")
+                telemetry.trace_mark(yielded.trace,
+                                     "serving.controller.yield")
             for r in take:
                 self._q.remove(r)  # O(queue) but queues are bounded-small
             self._items -= n
@@ -457,11 +627,29 @@ class MicroBatcher:
                 r.future.trace_id = r.trace.trace_id
                 r.future.breakdown = telemetry.trace_breakdown(r.trace)
                 r.future.e2e_s = done - r.t_enq
+            if self._controller is not None:
+                # the observe half of the control loop: this delivery's
+                # stage breakdown trains the per-bucket latency model,
+                # and its deadline verdict feeds SLO attainment. With
+                # causal tracing OFF (MXTPU_TRACE=0) there is no
+                # breakdown — approximate the total with the
+                # enqueue->deliver interval (same injected clock) so
+                # predictive admission degrades gracefully instead of
+                # going silently inert
+                bd = r.future.breakdown
+                if not bd:
+                    bd = {"serving.queue_wait": max(0.0, done - r.t_enq)}
+                self._controller.observe(
+                    r.bucket_key, bd,
+                    hit=r.deadline is None or done <= r.deadline,
+                    now=done, n=r.n)
             r.future._event.set()
             telemetry.observe("serving.latency_s", done - r.t_enq)
 
     def _expire(self, req):
         telemetry.inc("serving.deadline_expired")
+        if self._controller is not None:
+            self._controller.note_expired(self._clock())
         self._fail(req, DeadlineExceeded(
             "deadline passed before dispatch (queued %.1f ms)"
             % ((self._clock() - req.t_enq) * 1e3)))

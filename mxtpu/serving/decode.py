@@ -286,6 +286,21 @@ class KVCacheAccountant:
             return sum(p["live"] * p["per_slot_bytes"] for p in pools
                        if p is not None)
 
+    def pressure(self):
+        """The fleet's KV-residency pressure as a 0..1+ fraction of the
+        admission bound: max over pools of (live + queued) / (overcommit
+        x capacity slots). The :class:`~mxtpu.serving.controller.
+        ServingController` reads this as a scale-up signal — a cache
+        near its residency bound sheds next, so capacity should grow
+        BEFORE the ``kv_residency`` sheds start. 0.0 with no pools."""
+        with self._lock:
+            worst = 0.0
+            for p in self._pools.values():
+                bound = self._overcommit * p["slots"]
+                if bound > 0:
+                    worst = max(worst, (p["live"] + p["queued"]) / bound)
+            return worst
+
     def gate(self, tag):
         """An ``admission_gate=`` callable for a
         :class:`~mxtpu.serving.batcher.MicroBatcher` guarding ``tag``'s

@@ -123,22 +123,29 @@ class Replica:
     """One serving replica: an AOT-warmed Predictor pinned to a device,
     plus its health state. State machine: ``healthy`` (routable) ->
     ``quarantined`` (breaker open / wedged; half-open probe scheduled at
-    ``probe_at``) -> ``probing`` (one in-flight probe) -> back."""
+    ``probe_at``) -> ``probing`` (one in-flight probe) -> back. The
+    elastic states: ``warming`` (AOT bring-up off the serving path —
+    never routed until every bucket compiled), ``retiring`` (drains its
+    in-flight work, then ``removed`` — the scale-down / replacement
+    exit)."""
 
     __slots__ = ("index", "device", "predictor", "state", "consecutive",
-                 "inflight", "dispatches", "wedged", "backoff_s", "probe_at")
+                 "inflight", "dispatches", "wedged", "backoff_s", "probe_at",
+                 "down_since")
 
-    def __init__(self, index, device, predictor, backoff_s):
+    def __init__(self, index, device, predictor, backoff_s,
+                 state="healthy"):
         self.index = index
         self.device = device
         self.predictor = predictor
-        self.state = "healthy"
+        self.state = state
         self.consecutive = 0      # consecutive dispatch failures (breaker)
         self.inflight = 0         # batches currently executing here
         self.dispatches = 0
         self.wedged = False       # a dispatch never returned
         self.backoff_s = backoff_s
         self.probe_at = None
+        self.down_since = None    # clock of the breaker open (replacement)
 
     @property
     def tag(self):
@@ -188,12 +195,19 @@ class ReplicaSet:
                                    else breaker_backoff_max_ms_default()) / 1e3
         self._lock = threading.Lock()
         self._accountant = None   # optional KVCacheAccountant (attach_...)
+        self._block = block       # elastic growth rebuilds from these
+        self._example = example
+        self._name = name
         self.replicas = []
         for i, dev in enumerate(devices):
             pred = Predictor(block, spec, example=example, warmup=False,
                              name="%s.r%d" % (name, i), device=dev,
                              site="serving.predict.r%d" % i)
             self.replicas.append(Replica(i, dev, pred, self.backoff0_s))
+        # replica indices are IDENTITIES, never positions: elastic
+        # add/remove keeps retiring a replica from invalidating another's
+        # retrace site (serving.predict.r<i>) or telemetry tag family
+        self._next_index = len(self.replicas)
         telemetry.gauge("serving.replicas", len(self.replicas))
         if warmup:
             self.warmup()
@@ -207,9 +221,11 @@ class ReplicaSet:
     @property
     def _jits(self):
         # the MicroBatcher cold-start check reads this: warm iff every
-        # replica compiled its buckets
-        if all(r.predictor._jits for r in self.replicas):
-            return self.replicas[0].predictor._jits
+        # SERVING replica compiled its buckets (a replica still in its
+        # elastic warming window is by definition not serving yet)
+        reps = [r for r in self.replicas if r.state != "warming"]
+        if reps and all(r.predictor._jits for r in reps):
+            return reps[0].predictor._jits
         return {}
 
     def warmup(self):
@@ -221,6 +237,110 @@ class ReplicaSet:
 
     def __len__(self):
         return len(self.replicas)
+
+    # ------------------------------------------------------------ elasticity
+    def _find_locked(self, index):
+        for r in self.replicas:
+            if r.index == index:
+                return r
+        raise MXNetError("ReplicaSet: no replica with index %d (live: %s)"
+                         % (index, [r.index for r in self.replicas]))
+
+    def _free_devices_locked(self):
+        used = {id(r.device) for r in self.replicas}
+        return [d for d in jax.devices() if id(d) not in used]
+
+    def free_devices(self):
+        """Visible devices no current replica (any state) is pinned to —
+        where a replacement or scale-up replica goes first."""
+        with self._lock:
+            return self._free_devices_locked()
+
+    def add_replica(self, device=None, warm=True):
+        """Grow the set by one replica (the elastic half of ROADMAP item
+        4). The new member starts in state ``warming`` — visible on
+        ``/healthz``, NEVER routed — and joins the dispatch pool only
+        after :meth:`warm_replica` AOT-compiles every bucket at its own
+        fresh retrace site ``serving.predict.r<i>`` (indices are never
+        reused, so per-replica compile budgets stay pinned at #buckets).
+        ``warm=False`` leaves the bring-up to the caller — how the
+        :class:`~mxtpu.serving.controller.ServingController` runs it on
+        a side thread, off the serving path. Returns the new replica."""
+        with self._lock:
+            idx = self._next_index
+            self._next_index += 1
+            if device is None:
+                free = self._free_devices_locked()
+                if not free:
+                    raise MXNetError(
+                        "ReplicaSet.add_replica: every visible device "
+                        "already hosts a replica — pass device= to "
+                        "double up explicitly")
+                device = free[0]
+            pred = Predictor(self._block, self.spec, example=self._example,
+                             warmup=False, name="%s.r%d" % (self._name, idx),
+                             device=device,
+                             site="serving.predict.r%d" % idx)
+            rep = Replica(idx, device, pred, self.backoff0_s,
+                          state="warming")
+            self.replicas.append(rep)
+            telemetry.gauge("serving.replicas", len(self.replicas))
+        if warm:
+            self.warm_replica(rep)
+        return rep
+
+    def warm_replica(self, rep):
+        """AOT-compile the warming replica's buckets, then flip it to
+        ``healthy`` (the moment it becomes routable). A failed warmup
+        removes the replica and re-raises — a member that cannot compile
+        must never join the pool. Returns the replica."""
+        try:
+            rep.predictor.warmup()
+        except Exception:
+            with self._lock:
+                if rep in self.replicas:
+                    self.replicas.remove(rep)
+                telemetry.gauge("serving.replicas", len(self.replicas))
+            raise
+        with self._lock:
+            if rep.state == "warming":
+                rep.state = "healthy"
+                telemetry.inc("serving.replica.joins", tag=rep.tag)
+                _log.info("serving replica %d warmed and joined the "
+                          "dispatch pool", rep.index)
+        return rep
+
+    def remove_replica(self, index):
+        """Begin removing a replica (scale-down, or the dead half of a
+        replacement): it flips to ``retiring`` — stops pulling work, is
+        never picked, is no longer probed — and leaves the set once its
+        in-flight work drains (:meth:`finalize_retiring`, the PR-8 drain
+        discipline: in-flight futures always complete). Returns the
+        replica."""
+        with self._lock:
+            rep = self._find_locked(index)
+            if rep.state != "retiring":
+                rep.state = "retiring"
+                rep.probe_at = None
+                telemetry.inc("serving.replica.retirements", tag=rep.tag)
+                _log.info("serving replica %d retiring (inflight=%d)",
+                          rep.index, rep.inflight)
+            return rep
+
+    def finalize_retiring(self):
+        """Drop retiring replicas whose in-flight work drained. Returns
+        the replicas removed this pass (dispatch workers exit on seeing
+        state ``removed``)."""
+        done = []
+        with self._lock:
+            for rep in [r for r in self.replicas
+                        if r.state == "retiring" and r.inflight == 0]:
+                rep.state = "removed"
+                self.replicas.remove(rep)
+                done.append(rep)
+            if done:
+                telemetry.gauge("serving.replicas", len(self.replicas))
+        return done
 
     # ------------------------------------------------------------- routing
     def pick(self, exclude=()):
@@ -275,7 +395,7 @@ class ReplicaSet:
         knob): quarantine a replica as if its breaker opened; it
         half-open-probes back after ``backoff_s``."""
         with self._lock:
-            rep = self.replicas[index]
+            rep = self._find_locked(index)
             if backoff_s is not None:
                 rep.backoff_s = float(backoff_s)
             if rep.state == "healthy":
@@ -287,6 +407,10 @@ class ReplicaSet:
     def _open_locked(self, rep, now):
         rep.state = "quarantined"
         rep.probe_at = now + rep.backoff_s
+        if rep.down_since is None:
+            # the CONTINUOUS-outage clock the controller's replacement
+            # bound reads: restarts only on a successful probe
+            rep.down_since = now
         telemetry.inc("serving.replica.quarantines", tag=rep.tag)
         _log.warning("serving replica %d quarantined (wedged=%s, "
                      "consecutive_failures=%d); half-open probe in %.1f s",
@@ -325,12 +449,15 @@ class ReplicaSet:
         """Half-open verdict: success closes the breaker (restore),
         failure doubles the backoff and re-quarantines."""
         with self._lock:
+            if rep.state in ("retiring", "removed"):
+                return  # written off mid-probe: a verdict cannot resurrect
             if ok:
                 rep.state = "healthy"
                 rep.wedged = False
                 rep.consecutive = 0
                 rep.backoff_s = self.backoff0_s
                 rep.probe_at = None
+                rep.down_since = None
                 telemetry.inc("serving.replica.restores", tag=rep.tag)
                 _log.info("serving replica %d restored by half-open probe",
                           rep.index)
@@ -435,7 +562,7 @@ class ReplicaDispatcher(MicroBatcher):
         with self._cond:
             self._cond.notify_all()
 
-    def submit(self, inputs, deadline_ms=None):
+    def submit(self, inputs, deadline_ms=None, priority="interactive"):
         if self._set.healthy_count() == 0:
             # give a due half-open probe the chance to restore a replica
             # before refusing (the all-down shed must not outlive the
@@ -448,7 +575,53 @@ class ReplicaDispatcher(MicroBatcher):
             # RESIDENCY, not queue depth — an admitted sequence would only
             # grow time-to-first-token on a replica with no cache room
             self._shed("kv_residency")
-        return super().submit(inputs, deadline_ms=deadline_ms)
+        return super().submit(inputs, deadline_ms=deadline_ms,
+                              priority=priority)
+
+    # ---------------------------------------------------------- elasticity
+    def add_replica(self, device=None):
+        """Grow the pool by one replica. Bring-up (AOT warmup of every
+        bucket at the new ``serving.predict.r<i>`` site) runs OFF the
+        serving path — on a side thread in threaded mode, inline under a
+        fake clock — and the replica joins dispatch only once warm; in
+        threaded mode its dedicated worker starts at that moment.
+        Returns the (possibly still warming) replica."""
+        rep = self._set.add_replica(device=device, warm=False)
+
+        def _bringup():
+            try:
+                self._set.warm_replica(rep)  # failure removes the replica
+            except Exception as e:  # noqa: BLE001 — a failed bring-up
+                # must be RECORDED, not lost on a daemon thread: the
+                # controller's warmup_failed decision is the only signal
+                # an operator gets that capacity never arrived
+                _log.exception("serving: replica %d bring-up failed",
+                               rep.index)
+                ctrl = self._controller
+                if ctrl is not None:
+                    ctrl.note_warmup_failed(e, self._clock())
+                return
+            with self._cond:
+                self._cond.notify_all()
+            if self._threads:
+                self._spawn_worker(rep)
+
+        if self._threads:
+            threading.Thread(target=_bringup, daemon=True,
+                             name="mxtpu-serving-warmup-r%d"
+                             % rep.index).start()
+        else:
+            _bringup()
+        return rep
+
+    def remove_replica(self, index):
+        """Retire a replica through the drain machinery: it stops
+        pulling work immediately, in-flight futures complete, and the
+        next maintenance pass removes it once drained."""
+        rep = self._set.remove_replica(index)
+        with self._cond:
+            self._cond.notify_all()
+        return rep
 
     # --------------------------------------------------------- maintenance
     def _maintain(self):
@@ -475,6 +648,19 @@ class ReplicaDispatcher(MicroBatcher):
         self._flush_flight()
         for rep, entry in due:
             self._probe(rep, entry)
+        self._post_maintain()
+
+    def _post_maintain(self):
+        """The elastic tail of every maintenance pass: drop retiring
+        replicas whose in-flight work drained, then give the attached
+        ServingController its control-loop tick (scale/replace decisions
+        run here — outside every lock, since a bring-up is device
+        work). Under a fake clock this is what makes ``poll()`` drive
+        the whole control plane sleep-free."""
+        self._set.finalize_retiring()
+        ctrl = self._controller
+        if ctrl is not None:
+            ctrl.tick(self._clock())
 
     def _flush_flight(self):
         """Write dumps the wedge scan deferred — NEVER under self._cond
@@ -679,6 +865,14 @@ class ReplicaDispatcher(MicroBatcher):
         return host
 
     # ---------------------------------------------------------------- worker
+    def _spawn_worker(self, rep):
+        t = threading.Thread(target=self._replica_worker, args=(rep,),
+                             daemon=True,
+                             name="mxtpu-serving-replica-%d" % rep.index)
+        self._threads.append(t)
+        t.start()
+        return t
+
     def start(self):
         if self._threads:
             return self
@@ -687,11 +881,7 @@ class ReplicaDispatcher(MicroBatcher):
                 "ReplicaDispatcher.start on a cold ReplicaSet: warmup() "
                 "every replica first")
         for rep in self._set.replicas:
-            t = threading.Thread(target=self._replica_worker, args=(rep,),
-                                 daemon=True,
-                                 name="mxtpu-serving-replica-%d" % rep.index)
-            self._threads.append(t)
-            t.start()
+            self._spawn_worker(rep)
         interval = max(0.005, min(0.25, self._timeout_s / 4))
         self._monitor = threading.Thread(
             target=self._monitor_loop, args=(interval,), daemon=True,
@@ -718,11 +908,14 @@ class ReplicaDispatcher(MicroBatcher):
                 while batch is None:
                     if self._closed and not self._q:
                         return
+                    if rep.state == "removed":
+                        return  # retired and drained: this worker is done
                     now = self._clock()
                     self._scan_wedges_locked(now)
                     if rep.state != "healthy":
-                        # quarantined/probing: park (the monitor owns the
-                        # probe schedule); bounded wait re-checks state
+                        # quarantined/probing/retiring: park (the monitor
+                        # owns probes and retirement); bounded wait
+                        # re-checks state
                         self._cond.wait(0.05)
                         continue
                     batch = self._gather_locked(now)
@@ -766,6 +959,7 @@ class ReplicaDispatcher(MicroBatcher):
                 threading.Thread(
                     target=self._probe, args=(rep, entry), daemon=True,
                     name="mxtpu-serving-probe-%d" % rep.index).start()
+            self._post_maintain()
             self._stop.wait(interval)
 
     # ------------------------------------------------------- drain / close

@@ -24,16 +24,24 @@ load-balancers health-check), not a gRPC replacement.
 
 Endpoints::
 
-    POST /predict   {"data": [[...], ...], "deadline_ms": 250}
+    POST /predict   {"data": [[...], ...], "deadline_ms": 250,
+                     "priority": "interactive"|"batch"}
                     -> 200 {"outputs": [...], "n": k, "trace_id": ...,
                             "e2e_ms": ..., "breakdown_ms": {stage: ms}}
                        (trace fields present while MXTPU_TRACE is on; the
                        stages sum to ~e2e_ms — queue wait vs pad vs device
                        vs fetch attribution per request)
-                    -> 503 shed/draining, 504 deadline, 400 bad request
+                    -> 503 shed/draining (+ a Retry-After header from the
+                       controller's predicted drain time when the SLO
+                       control plane is attached), 504 deadline, 400 bad
+                       request
     GET  /healthz   {"status": "ok"|"degraded"|"unhealthy"|"draining",
-                     "queue_depth": d, "replicas": [...]}  (replica fields
-                    only when serving through a ReplicaDispatcher)
+                     "queue_depth": d, "replicas": [...],
+                     "controller": {...}}  (replica fields only when
+                    serving through a ReplicaDispatcher; the controller
+                    block — replica target vs actual, per-class queue
+                    depths, last scale decision + reason — only with a
+                    ServingController attached)
     GET  /metrics   telemetry.snapshot() as JSON; with ``Accept:
                     text/plain`` (a stock Prometheus scraper) the same
                     registry in Prometheus text exposition format
@@ -158,18 +166,34 @@ class ModelServer:
         return self
 
     # ---------------------------------------------------------------- request
+    def _retry_after(self):
+        """503 ``Retry-After`` seconds: the attached controller's
+        predicted queue-drain time (the per-bucket latency model), 1 s
+        when serving without a control plane — a shed response always
+        tells the client WHEN to come back, never just that it failed."""
+        ctrl = getattr(self._batcher, "_controller", None)
+        if ctrl is not None:
+            try:
+                return ctrl.retry_after_s()
+            except Exception:  # noqa: BLE001 — a header, not control flow
+                pass
+        return 1
+
     def _handle_predict(self, body):
-        """Returns (status, payload-dict). Runs on the handler thread —
-        it parks on the future while the batcher coalesces."""
+        """Returns (status, payload-dict, extra-headers-or-None). Runs on
+        the handler thread — it parks on the future while the batcher
+        coalesces."""
         from ..base import MXNetError
         if self.draining:
             telemetry.inc("serving.shed", tag="draining")
-            return 503, {"error": "draining"}
+            return 503, {"error": "draining"}, \
+                {"Retry-After": str(self._retry_after())}
         raw = body.get("inputs")
         if raw is None:
             raw = [body.get("data")]
         if not raw or raw[0] is None:
-            return 400, {"error": "missing 'data' (or 'inputs') field"}
+            return 400, {"error": "missing 'data' (or 'inputs') field"}, None
+        priority = body.get("priority", "interactive")
         templates = getattr(self._batcher._pred, "input_templates", None)
         arrays = []
         for i, a in enumerate(raw):
@@ -180,7 +204,7 @@ class ModelServer:
                 arrays.append(np.asarray(a, dtype=dtype))
             except (ValueError, TypeError) as e:  # ragged/unconvertible JSON
                 return 400, {"error": "input %d not array-shaped: %s"
-                             % (i, e)}
+                             % (i, e)}, None
         try:
             # default the batcher deadline to the handler timeout: once the
             # handler answers 504 and walks away, the queued request would
@@ -189,18 +213,24 @@ class ModelServer:
             # it time out
             deadline_ms = body.get("deadline_ms", self._timeout * 1e3)
             fut = self._batcher.submit(tuple(arrays),
-                                       deadline_ms=deadline_ms)
+                                       deadline_ms=deadline_ms,
+                                       priority=priority)
             out = fut.result(timeout=self._timeout)
         except QueueFull as e:
-            return 503, {"error": str(e)}
+            # the shed path tells the client when to retry: the
+            # controller's estimated drain time (predictive model), not
+            # a bare error
+            return 503, {"error": str(e)}, \
+                {"Retry-After": str(self._retry_after())}
         except DeadlineExceeded as e:
-            return 504, {"error": str(e)}
+            return 504, {"error": str(e)}, None
         except MXNetError as e:
             # submit's request-shape refusals (empty batch, > max_batch,
-            # seq past the largest bucket): the CLIENT's fault, not a 500
-            # — monitoring treats 5xx as server faults and would page/eject
-            # a healthy instance over one misbehaving caller
-            return 400, {"error": str(e)}
+            # seq past the largest bucket, unknown priority): the
+            # CLIENT's fault, not a 500 — monitoring treats 5xx as server
+            # faults and would page/eject a healthy instance over one
+            # misbehaving caller
+            return 400, {"error": str(e)}, None
         outs = list(out) if isinstance(out, tuple) else [out]
         payload = {"outputs": [o.tolist() for o in outs],
                    "n": int(arrays[0].shape[0])}
@@ -214,7 +244,7 @@ class ModelServer:
             payload["breakdown_ms"] = {
                 k: round(v * 1e3, 4)
                 for k, v in sorted(fut.breakdown.items())}
-        return 200, payload
+        return 200, payload, None
 
 
 def _make_handler(srv):
@@ -225,11 +255,13 @@ def _make_handler(srv):
         def log_message(self, fmt, *args):  # stdout silence; debug-level log
             _log.debug("http %s", fmt % args)
 
-        def _reply(self, code, payload):
+        def _reply(self, code, payload, headers=None):
             body = json.dumps(payload, default=str).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -257,6 +289,13 @@ def _make_handler(srv):
                     # KV residency per replica pool: the signal a fleet
                     # dispatcher routes/sheds on (docs/serving.md decode)
                     payload["kv"] = acct.snapshot()
+                ctrl = getattr(srv._batcher, "_controller", None)
+                if ctrl is not None:
+                    # the control-plane view: replica target vs actual,
+                    # per-class queue depths, last scale decision +
+                    # reason — the operator's one-look answer to "what
+                    # is the autoscaler doing and why"
+                    payload["controller"] = ctrl.view()
                 self._reply(200, payload)
             elif self.path == "/metrics":
                 accept = self.headers.get("Accept", "")
@@ -289,11 +328,11 @@ def _make_handler(srv):
                 self._reply(400, {"error": "bad json: %s" % e})
                 return
             try:
-                code, payload = srv._handle_predict(body)
+                code, payload, headers = srv._handle_predict(body)
             except Exception as e:  # noqa: BLE001 — a handler crash must
                 _log.exception("predict handler failed")  # answer, not hang
-                code, payload = 500, {"error": "%s: %s"
-                                      % (type(e).__name__, e)}
-            self._reply(code, payload)
+                code, payload, headers = 500, {"error": "%s: %s"
+                                               % (type(e).__name__, e)}, None
+            self._reply(code, payload, headers)
 
     return Handler

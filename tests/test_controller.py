@@ -1,0 +1,600 @@
+"""SLO-aware serving control plane (mxtpu/serving/controller) — ISSUE 13:
+
+* predictive admission: the per-bucket latency model sheds
+  ``predicted_miss`` before the depth bound fills in a slow-bucket
+  regime, falls back to the depth bound while cold, and is fed from the
+  delivered requests' stage breakdowns;
+* priority classes: batch yields its coalescing slot to interactive up
+  to the aging floor, and is first evicted under queue pressure;
+* the submit-time expired-deadline sweep (a dead entry must not crowd
+  fresh work into a ``queue_full`` shed);
+* elastic ReplicaSet: scale-up joins only after AOT warmup (compiles
+  pinned at #buckets at the new ``serving.predict.r<i>`` site),
+  scale-down drains without failing in-flight futures, dead-replica
+  replacement end-to-end on a fresh device, cooldown hysteresis
+  suppressing flapping, KV-residency as a scale signal;
+* the HTTP surfaces: 503 ``Retry-After`` from the predicted drain time,
+  ``/healthz`` controller view;
+* the serve_bench ``--mode slo`` gates (wall-clock, marked slow).
+
+Every controller/autoscaler test runs sleep-free on an injected clock —
+the PR-8 discipline.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from mxtpu import resilience, telemetry
+from mxtpu.base import MXNetError
+from mxtpu.gluon import nn
+from mxtpu.serving import (BucketSpec, DeadlineExceeded, KVCacheAccountant,
+                           MicroBatcher, ModelServer, Predictor, QueueFull,
+                           ReplicaDispatcher, ReplicaSet, ServingController)
+
+import jax
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 3,
+    reason="controller tests need >= 3 (virtual) devices for replacement")
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for var in ("MXTPU_TELEMETRY", "MXTPU_RETRACE_BUDGET",
+                "MXTPU_FAULT_INJECT", "MXTPU_SERVE_MAX_BATCH",
+                "MXTPU_SERVE_MAX_WAIT_MS", "MXTPU_SERVE_QUEUE",
+                "MXTPU_SERVE_REPLICAS", "MXTPU_SERVE_DISPATCH_TIMEOUT_MS",
+                "MXTPU_SERVE_BREAKER_THRESHOLD",
+                "MXTPU_SERVE_BREAKER_BACKOFF_MS",
+                "MXTPU_SERVE_BREAKER_BACKOFF_MAX_MS",
+                "MXTPU_SERVE_BATCH_AGING_MS", "MXTPU_SERVE_MIN_REPLICAS",
+                "MXTPU_SERVE_MAX_REPLICAS", "MXTPU_SERVE_SCALE_COOLDOWN_MS",
+                "MXTPU_SERVE_REPLACE_AFTER_MS"):
+        monkeypatch.delenv(var, raising=False)
+    telemetry.reset()
+    resilience.reset_faults()
+    yield
+    telemetry.reset()
+    resilience.reset_faults()
+
+
+IN_DIM, OUT_DIM = 12, 4
+
+# the slow-bucket regime every predictive test trains on: the shape of a
+# real PR-10 stage breakdown (what MicroBatcher._deliver feeds through
+# controller.observe), with a service time far above the deadlines used
+SLOW_BREAKDOWN = {"serving.queue_wait": 0.05, "serving.pad": 0.01,
+                  "serving.predict": 0.19}
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _mlp():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(OUT_DIM))
+    net.initialize()
+    return net
+
+
+def _x(n, seed=0, dim=IN_DIM):
+    return np.random.RandomState(seed).randn(n, dim).astype(np.float32)
+
+
+def _rset(n=1, max_batch=4, **kw):
+    net = _mlp()
+    spec = BucketSpec.pow2(max_batch)
+    kw.setdefault("breaker_backoff_ms", 1000)
+    rs = ReplicaSet(net, spec, n=n,
+                    example=np.zeros((1, IN_DIM), np.float32),
+                    warmup=True, **kw)
+    return net, spec, rs
+
+
+def _disp(rs, clk, **kw):
+    kw.setdefault("max_batch_size", rs.spec.max_batch)
+    kw.setdefault("max_wait_ms", 5)
+    kw.setdefault("dispatch_timeout_ms", 2000)
+    return ReplicaDispatcher(rs, clock=clk, start=False, **kw)
+
+
+def _ctrl(bat, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 2)
+    kw.setdefault("scale_cooldown_ms", 1000)
+    kw.setdefault("min_samples", 4)
+    return ServingController(bat, **kw)
+
+
+def _decisions(tag):
+    return telemetry.value("serving.controller.decisions", tag=tag)
+
+
+# ------------------------------------------------------- predictive admission
+def test_predictive_shed_fires_before_depth_shed():
+    """Slow-bucket regime: the model (trained from breakdown-shaped
+    observations) predicts a miss, so the submit sheds predicted_miss
+    while the queue depth is nowhere near MXTPU_SERVE_QUEUE."""
+    _, _, rs = _rset(n=1)
+    clk = FakeClock()
+    bat = _disp(rs, clk)          # default depth bound: 256 items
+    ctrl = _ctrl(bat, max_replicas=1)
+    for _ in range(6):
+        ctrl.observe(None, SLOW_BREAKDOWN, hit=True, now=clk())
+    assert ctrl.predicted_s(None) == pytest.approx(0.25, abs=0.06)
+    assert bat.queue_depth == 0 and bat.max_queue == 256
+    with pytest.raises(QueueFull, match="predicted_miss"):
+        bat.submit(_x(1), deadline_ms=50)
+    assert telemetry.value("serving.shed", tag="predicted_miss") == 1
+    assert telemetry.value("serving.shed", tag="queue_full") == 0
+    assert _decisions("predicted_shed") == 1
+    # a feasible deadline (and a deadline-less submit) still admit
+    f1 = bat.submit(_x(1), deadline_ms=2000)
+    f2 = bat.submit(_x(1, seed=1))
+    clk.advance(0.006)
+    assert bat.poll() == 2
+    assert f1.done() and f2.done()
+
+
+def test_predictive_model_fed_from_delivery_breakdowns():
+    """Integration of the observe half: real deliveries train the model
+    through MicroBatcher._deliver (queue-wait measured on the injected
+    clock), and the attainment counters see their deadline verdicts."""
+    _, _, rs = _rset(n=1)
+    clk = FakeClock()
+    bat = _disp(rs, clk)
+    ctrl = _ctrl(bat, max_replicas=1)
+    for i in range(5):
+        f = bat.submit(_x(1, seed=i), deadline_ms=10000)
+        clk.advance(0.2)          # 200 ms of fake-clock queue wait
+        assert bat.poll() == 1
+        assert f.done()
+    now = clk()
+    m = ctrl._models[None]
+    assert m["total"].count(now) == 5
+    # the model's totals are dominated by the injected-clock queue wait
+    assert m["total"].quantile(0.9, now) >= 0.2
+    assert ctrl.view()["slo_attainment"] == 1.0
+
+
+def test_cold_model_falls_back_to_depth_bound():
+    """While the model is cold, even an absurd deadline admits — and the
+    plain depth bound still governs."""
+    _, _, rs = _rset(n=1)
+    clk = FakeClock()
+    bat = _disp(rs, clk, max_queue=4)
+    _ctrl(bat, max_replicas=1, min_samples=8)
+    f = bat.submit(_x(1), deadline_ms=1)   # cold: admitted, not predicted
+    assert telemetry.value("serving.shed", tag="predicted_miss") == 0
+    for i in range(3):
+        bat.submit(_x(1, seed=i), deadline_ms=10000)
+    with pytest.raises(QueueFull, match="queue_full"):
+        bat.submit(_x(1, seed=9), deadline_ms=10000)
+    clk.advance(0.006)
+    bat.poll()
+    with pytest.raises(DeadlineExceeded):
+        f.result(0)               # its 1 ms deadline expired at dispatch
+
+
+def test_retry_after_tracks_estimated_drain():
+    _, _, rs = _rset(n=1)
+    clk = FakeClock()
+    bat = _disp(rs, clk)
+    ctrl = _ctrl(bat, max_replicas=1)
+    assert ctrl.retry_after_s() >= 1   # empty queue: the floor
+    for _ in range(6):
+        ctrl.observe(None, SLOW_BREAKDOWN, hit=True, now=clk())
+    for i in range(8):                 # two full batches queued
+        bat.submit(_x(1, seed=i))
+    # drain estimate: depth over the observed drain rate — seconds scale
+    assert ctrl.estimate_drain_s() > 0
+    assert ctrl.retry_after_s() >= 1
+    while bat.poll():
+        clk.advance(0.006)
+
+
+# ------------------------------------------------------------ priority classes
+def _seq_batcher(clk, max_queue=None, batch_aging_ms=1000):
+    """A seq-bucketed predictor so interactive and batch work can live
+    in DIFFERENT cohorts (same-bucket traffic simply co-batches)."""
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(3, flatten=False))
+    net.initialize()
+    spec = BucketSpec((2,), seq_lens=(4, 8))
+    pred = Predictor(net, spec, example=np.zeros((1, 4, 5), np.float32),
+                     warmup=True)
+    return MicroBatcher(pred, max_batch_size=2, max_wait_ms=5, clock=clk,
+                        start=False, max_queue=max_queue,
+                        batch_aging_ms=batch_aging_ms)
+
+
+def test_batch_yields_then_aging_floor_wins():
+    """Strict-priority dequeue: a batch-class head yields its coalescing
+    slot to a fresher interactive cohort (counted as a yield decision on
+    the batch request's own trace) — until the aging floor passes, after
+    which the batch head dispatches ahead of fresh interactive work."""
+    clk = FakeClock()
+    bat = _seq_batcher(clk, batch_aging_ms=1000)
+    rng = np.random.RandomState(0)
+    xb = rng.randn(1, 7, 5).astype(np.float32)   # seq bucket 8 (batch)
+    xi = rng.randn(1, 3, 5).astype(np.float32)   # seq bucket 4
+    fb = bat.submit(xb, priority="batch")
+    fi = bat.submit(xi)
+    clk.advance(0.006)   # both past max_wait; far below the aging floor
+    assert bat.poll() == 1
+    assert fi.done() and not fb.done()           # interactive jumped
+    assert _decisions("yield") == 1
+    # past the aging floor the batch head beats fresh interactive work
+    clk.advance(1.05)
+    fi2 = bat.submit(rng.randn(1, 2, 5).astype(np.float32))
+    clk.advance(0.006)
+    assert bat.poll() == 1
+    assert fb.done() and not fi2.done()
+    assert bat.poll() == 1
+    assert fi2.done()
+    assert _decisions("yield") == 1              # aging win is not a yield
+
+
+def test_batch_evicted_first_under_queue_pressure():
+    """Queue full + an interactive arrival: the NEWEST batch-class
+    entries are evicted (shed priority_evict) to admit it; a batch
+    arrival never evicts."""
+    clk = FakeClock()
+    bat = _seq_batcher(clk, max_queue=2)
+    rng = np.random.RandomState(1)
+    xb = rng.randn(1, 7, 5).astype(np.float32)
+    fb1 = bat.submit(xb, priority="batch")
+    fb2 = bat.submit(xb, priority="batch")
+    fi = bat.submit(rng.randn(1, 3, 5).astype(np.float32))
+    with pytest.raises(QueueFull, match="priority_evict"):
+        fb2.result(0)
+    assert not fb1.done() and not fi.done()      # oldest batch survives
+    assert telemetry.value("serving.shed", tag="priority_evict") == 1
+    with pytest.raises(QueueFull, match="queue_full"):
+        bat.submit(xb, priority="batch")         # batch never evicts
+    assert telemetry.value("serving.shed", tag="priority_evict") == 1
+
+
+def test_eviction_refused_when_it_cannot_make_room():
+    """An interactive submit that would STILL shed after evicting every
+    batch entry must not drop batch work for nothing — and no evicted
+    future may ever strand (review finding: the old path raised
+    queue_full before failing the victims)."""
+    clk = FakeClock()
+    bat = _seq_batcher(clk, max_queue=2)
+    rng = np.random.RandomState(2)
+    fb = bat.submit(rng.randn(1, 7, 5).astype(np.float32),
+                    priority="batch")
+    bat.submit(rng.randn(1, 3, 5).astype(np.float32))
+    # needs 2 items of room; evicting the single batch item cannot make
+    # it fit -> shed the arrival, keep the batch work queued
+    with pytest.raises(QueueFull, match="queue_full"):
+        bat.submit(rng.randn(2, 3, 5).astype(np.float32))
+    assert not fb.done()
+    assert telemetry.value("serving.shed", tag="priority_evict") == 0
+    bat.drain(timeout=5)
+    assert fb.done()
+
+
+def test_warmup_failure_is_recorded_not_lost(monkeypatch):
+    """A replica bring-up that dies in warmup is RECORDED as a
+    warmup_failed decision (and the half-built replica leaves the set)
+    instead of dying silently."""
+    from mxtpu.serving.engine import Predictor as _P
+    _, _, rs = _rset(n=1)
+    clk = FakeClock()
+    bat = _disp(rs, clk, max_queue=8)
+    _ctrl(bat, min_samples=999, scale_cooldown_ms=0)
+    monkeypatch.setattr(_P, "warmup",
+                        lambda self: (_ for _ in ()).throw(
+                            RuntimeError("device dead at bring-up")))
+    for i in range(4):
+        bat.submit(_x(1, seed=i))
+    clk.advance(0.006)
+    bat.poll()                                 # tick -> scale_up -> boom
+    assert _decisions("scale_up") == 1
+    assert _decisions("warmup_failed") == 1
+    assert [r.index for r in rs.replicas] == [0]  # never joined
+    while bat.poll():
+        pass
+
+
+def test_predictive_model_trains_with_tracing_off(monkeypatch):
+    """MXTPU_TRACE=0 leaves no stage breakdowns — deliveries then train
+    the model on the enqueue->deliver interval, so predictive admission
+    degrades gracefully instead of going silently inert."""
+    monkeypatch.setenv("MXTPU_TRACE", "0")
+    telemetry.reset()
+    _, _, rs = _rset(n=1)
+    clk = FakeClock()
+    bat = _disp(rs, clk)
+    ctrl = _ctrl(bat, max_replicas=1, min_samples=4)
+    for i in range(5):
+        f = bat.submit(_x(1, seed=i), deadline_ms=10000)
+        clk.advance(0.2)
+        assert bat.poll() == 1
+        assert f.done() and f.breakdown is None   # tracing really off
+    now = clk()
+    m = ctrl._models[None]
+    assert m["total"].count(now) == 5
+    assert m["total"].quantile(0.9, now) >= 0.2   # the fake-clock wait
+    # at depth 0 the live bound (no service info without breakdowns)
+    # admits; once a backlog builds, the e2e-trained history predicts
+    # the miss and admission sheds
+    bat.submit(_x(1, seed=7), deadline_ms=50)
+    for i in range(3):
+        bat.submit(_x(1, seed=i))
+    with pytest.raises(QueueFull, match="predicted_miss"):
+        bat.submit(_x(1, seed=9), deadline_ms=50)
+    while bat.poll():
+        clk.advance(0.006)
+
+
+def test_unknown_priority_refused():
+    clk = FakeClock()
+    _, _, rs = _rset(n=1)
+    bat = _disp(rs, clk)
+    with pytest.raises(MXNetError, match="priority"):
+        bat.submit(_x(1), priority="best_effort")
+
+
+# -------------------------------------------------------- expired-entry sweep
+def test_expired_sweep_admits_fresh_work_before_depth_shed():
+    """ISSUE-13 satellite: an entry whose deadline passed while queued
+    is swept at submit-time pressure, so fresh work is admitted instead
+    of shed queue_full."""
+    net = _mlp()
+    pred = Predictor(net, BucketSpec.pow2(4),
+                     example=np.zeros((1, IN_DIM), np.float32), warmup=True)
+    clk = FakeClock()
+    bat = MicroBatcher(pred, max_batch_size=4, max_wait_ms=1000,
+                       max_queue=2, clock=clk, start=False)
+    f1 = bat.submit(_x(1), deadline_ms=10)
+    clk.advance(0.05)                        # f1's deadline passed queued
+    f2 = bat.submit(_x(2, seed=1))           # 1+2 > 2: sweep, then admit
+    with pytest.raises(DeadlineExceeded):
+        f1.result(0)
+    assert bat.queue_depth == 2
+    assert telemetry.value("serving.deadline_expired") == 1
+    # no expired entries left: the depth bound sheds as before
+    with pytest.raises(QueueFull, match="queue_full"):
+        bat.submit(_x(1, seed=2))
+    bat.drain(timeout=5)
+    assert f2.done()
+
+
+# ---------------------------------------------------------- elastic ReplicaSet
+def test_scale_up_joins_only_after_warmup_compiles_pinned():
+    """A warming replica is visible but NEVER routed; it joins the pool
+    only once every bucket compiled at its own fresh retrace site —
+    compiles == #buckets, watchdog-pinned."""
+    _, spec, rs = _rset(n=1)
+    rep = rs.add_replica(warm=False)
+    assert rep.state == "warming" and rep.index == 1
+    assert len(rs.replicas) == 2
+    assert rs.healthy_count() == 1
+    assert rs.pick().index == 0              # warming: never picked
+    assert telemetry.retrace_stats("serving.predict.r1") is None
+    rs.warm_replica(rep)
+    assert rep.state == "healthy" and rs.healthy_count() == 2
+    st = telemetry.retrace_stats("serving.predict.r1")
+    assert st["compiles"] == len(spec) and st["trips"] == 0
+    assert telemetry.value("serving.replica.joins", tag="r1") == 1
+    # parity: the elastic member serves the same math
+    x = _x(2, seed=3)
+    np.testing.assert_allclose(rep.predictor.predict(x).asnumpy(),
+                               rs.replicas[0].predictor.predict(x).asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_controller_scales_up_on_queue_pressure():
+    _, spec, rs = _rset(n=1)
+    clk = FakeClock()
+    bat = _disp(rs, clk, max_queue=8)
+    _ctrl(bat, min_samples=999, scale_cooldown_ms=0)
+    for i in range(4):                        # pressure 0.5 == high bar
+        bat.submit(_x(1, seed=i))
+    clk.advance(0.006)
+    bat.poll()                                # maintain -> tick -> grow
+    assert len(rs.replicas) == 2
+    assert [r.state for r in rs.replicas] == ["healthy", "healthy"]
+    assert _decisions("scale_up") == 1
+    st = telemetry.retrace_stats("serving.predict.r1")
+    assert st["compiles"] == len(spec) and st["trips"] == 0
+    snap = telemetry.snapshot()["gauges"]
+    assert snap["serving.replicas"] == 2
+    while bat.poll():
+        pass
+
+
+def test_scale_down_drains_without_failing_inflight_futures():
+    _, _, rs = _rset(n=2)
+    clk = FakeClock()
+    bat = _disp(rs, clk)
+    _ctrl(bat, min_replicas=1, max_replicas=2, scale_cooldown_ms=1000,
+          min_samples=999)
+    f1 = bat.submit(_x(2, seed=0))
+    clk.advance(0.006)
+    bat.poll()
+    assert f1.done()
+    clk.advance(1.2)                          # idle past the cooldown
+    bat.poll()                                # tick -> scale_down
+    assert _decisions("scale_down") == 1
+    assert [r.state for r in rs.replicas] == ["healthy", "retiring"]
+    # new work keeps serving on the survivor while the victim drains
+    f2 = bat.submit(_x(1, seed=1))
+    clk.advance(0.006)
+    bat.poll()                                # finalize + dispatch
+    assert f2.result(0).shape == (1, OUT_DIM)
+    assert [r.index for r in rs.replicas] == [0]
+    assert telemetry.value("serving.replica.retirements", tag="r1") == 1
+    assert telemetry.snapshot()["gauges"]["serving.replicas"] == 1
+
+
+def test_dead_replica_replacement_end_to_end():
+    """The self-healing path: a replica whose breaker stays open past
+    MXTPU_SERVE_REPLACE_AFTER_MS is replaced by a fresh AOT-warmed
+    replica on a FRESH device; the dead one retires. Sleep-free."""
+    _, spec, rs = _rset(n=2)
+    clk = FakeClock()
+    bat = _disp(rs, clk)
+    _ctrl(bat, min_replicas=2, max_replicas=2, replace_after_ms=500,
+          scale_cooldown_ms=100000, min_samples=999)
+    dead_dev = rs.replicas[0].device
+    bat.quarantine_replica(0, backoff_s=3600)  # a dead chip
+    assert rs.healthy_count() == 1
+    clk.advance(0.3)
+    bat.poll()                                 # before the bound: no-op
+    assert _decisions("replace") == 0
+    clk.advance(0.3)                           # 0.6 s down >= 0.5 s bound
+    bat.poll()                                 # tick -> replace
+    assert _decisions("replace") == 1
+    bat.poll()                                 # finalize the retired dead
+    assert [r.index for r in rs.replicas] == [1, 2]
+    assert [r.state for r in rs.replicas] == ["healthy", "healthy"]
+    assert rs.replicas[-1].device is not dead_dev  # a FRESH device
+    st = telemetry.retrace_stats("serving.predict.r2")
+    assert st["compiles"] == len(spec) and st["trips"] == 0
+    # capacity restored: traffic round-trips on the replacement pool
+    f = bat.submit(_x(2, seed=5))
+    clk.advance(0.006)
+    assert bat.poll() == 1
+    assert f.result(0).shape == (2, OUT_DIM)
+    assert telemetry.value("serving.replica.retirements", tag="r0") == 1
+
+
+def test_cooldown_hysteresis_suppresses_flapping():
+    """One pressure spike scales up exactly once; the idle scale-down
+    waits out BOTH the action cooldown and a full cooldown of idleness;
+    nothing flaps in between."""
+    _, _, rs = _rset(n=1)
+    clk = FakeClock()
+    bat = _disp(rs, clk, max_queue=8)
+    _ctrl(bat, scale_cooldown_ms=1000, min_samples=999)
+    for i in range(4):
+        bat.submit(_x(1, seed=i))
+    clk.advance(0.006)
+    bat.poll()                                 # spike -> scale_up
+    assert _decisions("scale_up") == 1
+    while bat.poll():
+        pass                                   # drain; now fully idle
+    clk.advance(0.5)
+    bat.poll()                                 # inside cooldown: nothing
+    assert _decisions("scale_up") == 1 and _decisions("scale_down") == 0
+    assert len(rs.replicas) == 2
+    clk.advance(1.1)                           # past cooldown AND idle
+    bat.poll()
+    assert _decisions("scale_down") == 1
+    bat.poll()                                 # finalize
+    assert len(rs.replicas) == 1
+    clk.advance(0.5)
+    bat.poll()                                 # floor reached: stable
+    assert _decisions("scale_down") == 1 and _decisions("scale_up") == 1
+
+
+def test_kv_residency_is_a_scale_signal():
+    """ISSUE-13 tentpole: the decode KV accountant's residency pressure
+    (live+queued vs the overcommit bound) triggers scale-up BEFORE the
+    kv_residency sheds start."""
+    _, _, rs = _rset(n=1)
+    acct = KVCacheAccountant(overcommit=2.0)
+    acct.register("r0", per_slot_bytes=64, slots=2)
+    rs.attach_accountant(acct)
+    clk = FakeClock()
+    bat = _disp(rs, clk)
+    _ctrl(bat, min_samples=999, scale_cooldown_ms=0)
+    for _ in range(4):                         # fill to the admission bound
+        assert acct.try_admit("r0")
+    assert acct.pressure() == pytest.approx(1.0)
+    clk.advance(0.01)
+    bat.poll()                                 # tick -> kv-pressure grow
+    assert _decisions("scale_up") == 1
+    assert len(rs.replicas) == 2
+
+
+# ----------------------------------------------------------------- HTTP front
+def _http(addr, path, payload=None, timeout=10):
+    import json
+    import urllib.error
+    import urllib.request
+    url = "http://%s:%d%s" % (addr[0], addr[1], path)
+    if payload is None:
+        req = urllib.request.Request(url)
+    else:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def test_retry_after_header_and_healthz_controller_view():
+    _, _, rs = _rset(n=1)
+    bat = ReplicaDispatcher(rs, max_batch_size=4, max_wait_ms=1)
+    ServingController(bat, min_replicas=1, max_replicas=1, min_samples=4)
+    srv = ModelServer(bat).start()
+    try:
+        x = _x(2, seed=5)
+        code, out, _h = _http(srv.address, "/predict", {"data": x.tolist()})
+        assert code == 200 and out["n"] == 2
+        # unknown priority is the CLIENT's fault
+        code, out, _h = _http(srv.address, "/predict",
+                              {"data": x.tolist(), "priority": "bogus"})
+        assert code == 400 and "priority" in out["error"]
+        # a named priority class round-trips
+        code, out, _h = _http(srv.address, "/predict",
+                              {"data": x.tolist(), "priority": "batch"})
+        assert code == 200
+        # the controller block on /healthz
+        code, health, _h = _http(srv.address, "/healthz")
+        assert code == 200
+        view = health["controller"]
+        assert view["replica_target"] == 1 and view["replica_actual"] == 1
+        assert view["min_replicas"] == 1 and view["max_replicas"] == 1
+        assert view["queue_depths"] == {"interactive": 0, "batch": 0}
+        assert "last_decision" in view and "estimated_drain_s" in view
+        # a shed answers 503 WITH a Retry-After derived from the model
+        srv.draining = True
+        code, out, headers = _http(srv.address, "/predict",
+                                   {"data": x.tolist()})
+        assert code == 503
+        assert int(headers["Retry-After"]) >= 1
+    finally:
+        srv.draining = False
+        srv.close()
+
+
+# ------------------------------------------------------------- bench (slow)
+@pytest.mark.slow
+def test_serve_bench_slo_gates():
+    """tools/serve_bench.py --mode slo: the controller strictly beats
+    the static depth-shed router on goodput-at-SLO on >= 1 overload
+    point, and the kill/restore sweep replaces the dead replica with
+    p99 recovering in-window and zero hung futures (wall-clock)."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import serve_bench as sb
+
+    rec = sb.run_slo(dim=64, width=64, depth=2, replicas=2,
+                     n_requests=200, qps_factors=(3.0, 8.0),
+                     recover_window_s=12.0, emit=lambda r: None)
+    assert rec["hangs"] == 0
+    assert rec["curve_ok"], rec["gains"]
+    assert rec["killrestore"]["ok"], rec["killrestore"]
+    assert rec["ok"]

@@ -66,7 +66,11 @@ JIT_ALLOWLIST: Dict[Tuple[str, str], Dict[str, str]] = {
                      "— one executable cache per Predictor instance; "
                      "per-replica caches (sites serving.predict.r<i>, "
                      "mxtpu/serving/replicas.py) are each bounded by "
-                     "#buckets, total compiles <= buckets x replicas",
+                     "#buckets, total compiles <= buckets x replicas; "
+                     "elastic members (ReplicaSet.add_replica — scale-up "
+                     "and dead-replica replacement) extend the same "
+                     "family with fresh never-reused indices, warmed "
+                     "AOT before joining dispatch",
     },
     ("mxtpu/serving/decode.py", "_build_jit"): {
         "site": "serving.decode",

@@ -137,6 +137,18 @@ timeout 600 python tools/serve_bench.py --mode decode \
   2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
 telemetry_report
 
+# 5c. SLO control-plane phase (ISSUE 13): goodput-at-SLO overload curve —
+#     predictive-admission controller vs the static depth-shed router at
+#     equal replicas (gate: the controller strictly wins >= 1 overload
+#     point) — plus the kill/restore sweep: a replica quarantined as a
+#     dead chip must be REPLACED by the autoscaler with windowed p99
+#     recovering inside the bounded window and zero hung futures (the
+#     script itself skips the kill sweep on a single device).
+sleep 60
+timeout 600 python tools/serve_bench.py --mode slo \
+  2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
+telemetry_report
+
 # 6. input pipeline phase (ISSUE 9): device-resident streaming reader +
 #    double-buffered prefetch-to-device vs the synchronous loop — batches/s
 #    and the data.wait fraction both ways (gate: parity + wait-frac drop;

@@ -26,6 +26,16 @@ line each (stamped with platform + policy_key like every bench artifact):
   and a **hang count** — futures that never completed. The acceptance
   gate: hangs == 0 through the replica loss (requests re-route, shed, or
   expire; none strand).
+* ``slo`` — ISSUE 13: the SLO control plane A/B. Phase 1 drives an
+  overload curve (paced open-loop at multiples of calibrated capacity,
+  per-request deadline = the SLO) through the static depth-shed router
+  and through the same router with a ``ServingController`` attached
+  (predictive admission; scaling pinned min == max so replicas are
+  EQUAL) — the gate is strictly higher goodput-at-SLO (completions
+  within deadline / offered) for the controller on >= 1 overload point.
+  Phase 2 (>= 2 devices) kills a replica mid-run (hour-long-backoff
+  quarantine) and gates that the controller REPLACES it and windowed
+  p99 recovers within a bounded window, with zero hung futures.
 * ``decode`` — ISSUE 11: the continuous-batching autoregressive decode
   engine (``mxtpu/serving/decode.py``) on a tiny causal-attention LM.
   Phase 1 is the acceptance A/B: continuous batching vs restart-per-
@@ -39,7 +49,7 @@ line each (stamped with platform + policy_key like every bench artifact):
 
 Usage::
 
-    python tools/serve_bench.py [--mode sweep,closed,open,replicas,decode]
+    python tools/serve_bench.py [--mode sweep,closed,open,replicas,decode,slo]
         [--requests 500] [--max-batch 8] [--dim 256] [--width 512]
         [--depth 3] [--max-wait-ms 2] [--workers 4]
         [--qps 100,300,1000] [--deadline-ms 100]
@@ -632,6 +642,309 @@ def run_open(pred, spec, qps_list=(100.0, 300.0, 1000.0), n_requests=200,
     return recs
 
 
+def _slo_point(bat, dim, qps, n_requests, slo_ms, seed=0,
+               result_timeout=30.0, priority="interactive"):
+    """One open-loop point: paced single-item submits with the SLO as
+    the per-request deadline. Returns the outcome census — ``good`` is
+    the goodput numerator (completed WITHIN the SLO)."""
+    from mxtpu.serving import DeadlineExceeded, QueueFull
+
+    rng = np.random.RandomState(seed)
+    slo_s = slo_ms / 1e3
+    futs, out = [], {"offered": n_requests, "shed": 0, "good": 0,
+                     "late": 0, "expired": 0, "errors": 0, "hangs": 0}
+    interval = 1.0 / float(qps) if qps > 0 else 0.0
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        if interval:
+            target = t0 + i * interval
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            elif i % 16 == 0:
+                # behind schedule (offered > this host can even submit):
+                # still yield the GIL periodically so the dispatch
+                # workers run — a pure submit spin on a small host would
+                # starve the very queue it is measuring
+                time.sleep(5e-4)
+        x = rng.randn(1, dim).astype(np.float32)
+        try:
+            futs.append(bat.submit(x, deadline_ms=slo_ms,
+                                   priority=priority))
+        except QueueFull:
+            out["shed"] += 1
+    lat = []
+    for fut in futs:
+        try:
+            fut.result(timeout=result_timeout)
+        except DeadlineExceeded:
+            out["expired" if fut.done() else "hangs"] += 1
+        except Exception:  # noqa: BLE001 — shed-at-dispatch etc.
+            out["errors"] += 1
+        else:
+            e2e = fut.e2e_s
+            lat.append(e2e if e2e is not None else 0.0)
+            if e2e is not None and e2e > slo_s:
+                out["late"] += 1
+            else:
+                out["good"] += 1
+    out["wall_s"] = time.perf_counter() - t0
+    out["p99_ms"] = round(float(np.percentile(
+        np.array(lat) * 1e3, 99)), 3) if lat else None
+    out["goodput"] = out["good"] / float(n_requests)
+    return out
+
+
+def run_slo(dim=128, width=256, depth=3, replicas=None, max_batch=8,
+            n_requests=200, slo_ms=None, qps_factors=(1.5, 3.0, 8.0),
+            max_wait_ms=2.0, kill=True, recover_window_s=15.0,
+            emit=_emit):
+    """ISSUE 13 acceptance: the SLO control plane vs the static
+    depth-shed router, at EQUAL replicas.
+
+    Phase 1 (overload curve): calibrate capacity with a short closed
+    burst, then drive paced open-loop points at ``qps_factors`` x
+    capacity through (a) a plain ReplicaDispatcher shedding only at the
+    depth bound and (b) the same dispatcher with a
+    :class:`ServingController` attached (predictive admission; scaling
+    pinned ``min == max`` so the comparison is capacity-neutral). The
+    queue bound is sized ~8 SLOs deep for BOTH — the static router's
+    exact production failure mode: a depth bound that does not know the
+    service rate admits work it already cannot finish in time. Gate:
+    the controller's goodput-at-SLO (completions within deadline /
+    offered) strictly beats the static router's on >= 1 overload point.
+
+    Phase 2 (kill/restore, >= 2 devices): threaded serving at ~0.5 x
+    capacity; replica 0 is quarantined with an hour-long backoff (a
+    dead chip), and the controller — ``replace_after_ms`` = 500 — must
+    REPLACE it on a fresh device. Gate: windowed p99 recovers within
+    ``recover_window_s`` of the kill, zero hung futures, healthy count
+    restored."""
+    import jax
+
+    from mxtpu.serving import ReplicaDispatcher, ServingController
+
+    n_dev = len(jax.devices())
+    if replicas is None:
+        replicas = min(2, n_dev)
+    replicas = max(1, min(replicas, n_dev))
+
+    # ---- calibration: capacity + an SLO this host can actually meet.
+    # Concurrent closed-loop clients (serial submit-and-wait measures
+    # per-request LATENCY, not the coalesced service rate the queue
+    # drains at); the first wave is dropped from the latency sample so
+    # cold-path stragglers cannot inflate the auto-SLO.
+    rset_cal, spec = build_replica_set(dim=dim, width=width, depth=depth,
+                                       max_batch=max_batch,
+                                       replicas=replicas)
+    cal = ReplicaDispatcher(rset_cal, max_batch_size=spec.max_batch,
+                            max_wait_ms=max_wait_ms, max_queue=4096)
+    lat, lock = [], threading.Lock()
+    n_workers, per_worker = 8, 40
+
+    def _cal_client(k):
+        rng = np.random.RandomState(50 + k)
+        for j in range(per_worker):
+            fut = cal.submit(rng.randn(1, dim).astype(np.float32))
+            fut.result(timeout=30)
+            if j >= 5 and fut.e2e_s is not None:
+                with lock:
+                    lat.append(fut.e2e_s)
+    threads = [threading.Thread(target=_cal_client, args=(k,))
+               for k in range(n_workers)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    capacity_rps = n_workers * per_worker / (time.perf_counter() - t0)
+    cal.close(timeout=5)
+    if slo_ms is None:
+        # ~6x the loaded median: comfortably feasible off-overload, and
+        # far shallower than the mis-sized depth bound below
+        slo_ms = float(min(150.0, max(
+            20.0, np.percentile(np.array(lat) * 1e3, 50) * 6.0)))
+    slo_s = slo_ms / 1e3
+    # the mis-sized static depth bound: ~12 SLOs of work at capacity —
+    # exactly the production failure mode (MXTPU_SERVE_QUEUE is a static
+    # item count that does not know the service rate), applied to BOTH
+    # routers; each point offers enough requests to actually fill it
+    max_queue = int(min(4096, max(64, capacity_rps * slo_s * 12)))
+    # long enough that the queue-fill TRANSIENT (which flatters the
+    # static router: its first max_queue admits ride an empty queue)
+    # is a small fraction of each point
+    n_requests = max(n_requests, 8 * max_queue)
+    emit({"metric": "serve_slo_calibration", "value": round(capacity_rps, 1),
+          "unit": "req/sec", "slo_ms": round(slo_ms, 2),
+          "max_queue": max_queue, "requests_per_point": n_requests,
+          "replicas": replicas})
+
+    # ---- phase 1: goodput-at-SLO curve, static vs controller
+    def build(router):
+        rset, _spec = build_replica_set(dim=dim, width=width, depth=depth,
+                                        max_batch=max_batch,
+                                        replicas=replicas)
+        bat = ReplicaDispatcher(rset, max_batch_size=spec.max_batch,
+                                max_wait_ms=max_wait_ms,
+                                max_queue=max_queue)
+        if router == "controller":
+            ServingController(bat, min_replicas=replicas,
+                              max_replicas=replicas, min_samples=8,
+                              quantile=0.9)
+        # identical closed-loop warm traffic for both, cycling through
+        # every batch bucket: primes each bucket's dispatch path (and
+        # the controller's latency model) past the cold-start stragglers
+        # before the measured points — a model whose window is mostly
+        # first-dispatch outliers would predict misses forever
+        rng = np.random.RandomState(7)
+        sizes = list(spec.batch_sizes)
+        for j in range(16 * len(sizes)):
+            b = sizes[j % len(sizes)]
+            bat.submit(rng.randn(b, dim).astype(np.float32)).result(
+                timeout=30)
+        return bat
+
+    curve, hangs = {}, 0
+    for router in ("static", "controller"):
+        bat = build(router)
+        curve[router] = []
+        for f in qps_factors:
+            pt = _slo_point(bat, dim, qps=capacity_rps * f,
+                            n_requests=n_requests, slo_ms=slo_ms,
+                            seed=int(100 * f))
+            hangs += pt["hangs"]
+            rec = {"metric": "serve_slo_%s_x%g" % (router, f),
+                   "value": round(pt["goodput"], 4), "unit": "goodput_at_slo",
+                   "offered_factor": f,
+                   "offered_qps": round(capacity_rps * f, 1),
+                   **{k: pt[k] for k in ("good", "late", "shed", "expired",
+                                         "errors", "hangs", "p99_ms")}}
+            emit(rec)
+            curve[router].append(pt)
+        bat.close(timeout=10)
+    gains = [c["goodput"] - s["goodput"]
+             for s, c in zip(curve["static"], curve["controller"])]
+    ok_curve = any(g > 0 for g in gains)
+
+    # ---- phase 2: kill/restore — the self-healing path
+    kill_rec = None
+    if kill and replicas >= 2:
+        kill_rec = _run_killrestore(dim, width, depth, replicas, max_batch,
+                                    spec, capacity_rps, slo_ms, max_wait_ms,
+                                    recover_window_s, emit)
+        hangs += kill_rec["hangs"]
+    ok = ok_curve and hangs == 0 and \
+        (kill_rec is None or kill_rec["ok"])
+    emit({"metric": "serve_slo", "value": round(max(gains), 4),
+          "unit": "goodput_gain_at_best_point",
+          "slo_ms": round(slo_ms, 2),
+          "goodput_static": [round(p["goodput"], 4)
+                             for p in curve["static"]],
+          "goodput_controller": [round(p["goodput"], 4)
+                                 for p in curve["controller"]],
+          "curve_ok": ok_curve, "hangs": hangs,
+          "killrestore_ok": kill_rec["ok"] if kill_rec else None,
+          "ok": ok})
+    return {"ok": ok, "curve_ok": ok_curve, "gains": gains,
+            "hangs": hangs, "slo_ms": slo_ms, "curve": curve,
+            "killrestore": kill_rec}
+
+
+def _run_killrestore(dim, width, depth, replicas, max_batch, spec,
+                     capacity_rps, slo_ms, max_wait_ms, recover_window_s,
+                     emit):
+    """Threaded kill/restore sweep: quarantine replica 0 as a dead chip
+    mid-run; the controller must replace it and windowed p99 must come
+    back within ``recover_window_s``."""
+    from mxtpu.serving import DeadlineExceeded, QueueFull, ReplicaDispatcher, \
+        ServingController
+
+    rset, _ = build_replica_set(dim=dim, width=width, depth=depth,
+                                max_batch=max_batch, replicas=replicas)
+    bat = ReplicaDispatcher(rset, max_batch_size=spec.max_batch,
+                            max_wait_ms=max_wait_ms, max_queue=4096)
+    ServingController(bat, min_replicas=replicas, max_replicas=replicas,
+                      replace_after_ms=500, scale_cooldown_ms=300,
+                      min_samples=8)
+    rng = np.random.RandomState(13)
+    qps = max(20.0, capacity_rps * 0.5)
+    interval = 1.0 / qps
+    pre_s, window_s = 2.0, 0.5
+    total_s = pre_s + recover_window_s
+    futs = []               # (submit_t_rel, future)
+    shed = 0
+    killed_at = None
+    t0 = time.perf_counter()
+    i = 0
+    while True:
+        rel = time.perf_counter() - t0
+        if rel >= total_s:
+            break
+        if killed_at is None and rel >= pre_s:
+            bat.quarantine_replica(rset.replicas[0].index, backoff_s=3600.0)
+            killed_at = rel
+        target = t0 + i * interval
+        now = time.perf_counter()
+        if target > now:
+            time.sleep(min(target - now, 0.05))
+            continue
+        i += 1
+        try:
+            futs.append((rel, bat.submit(
+                rng.randn(1, dim).astype(np.float32), deadline_ms=5000.0)))
+        except QueueFull:
+            shed += 1
+    hangs = expired = 0
+    windows = {}
+    for rel, fut in futs:
+        try:
+            fut.result(timeout=30)
+        except DeadlineExceeded:
+            if fut.done():
+                expired += 1
+            else:
+                hangs += 1
+            continue
+        except Exception:  # noqa: BLE001
+            expired += 1
+            continue
+        if fut.e2e_s is not None:
+            windows.setdefault(int(rel / window_s), []).append(fut.e2e_s)
+    healthy = sum(1 for r in rset.replicas if r.state == "healthy")
+    states = [(r.index, r.state) for r in rset.replicas]
+    bat.close(timeout=10)
+    p99 = {w: float(np.percentile(np.array(v) * 1e3, 99))
+           for w, v in sorted(windows.items()) if v}
+    pre_windows = [v for w, v in p99.items() if (w + 1) * window_s <= pre_s]
+    baseline_ms = float(np.median(pre_windows)) if pre_windows else slo_ms
+    thresh_ms = max(3.0 * baseline_ms, slo_ms)
+    recovered_in = None
+    if killed_at is not None:
+        for w in sorted(p99):
+            if w * window_s < killed_at:
+                continue
+            if p99[w] <= thresh_ms:
+                recovered_in = round(w * window_s - killed_at + window_s, 2)
+                break
+    ok = (killed_at is not None and recovered_in is not None
+          and recovered_in <= recover_window_s and hangs == 0
+          and healthy >= replicas)
+    rec = {"metric": "serve_slo_killrestore", "replicas": replicas,
+           "value": recovered_in if recovered_in is not None else -1.0,
+           "unit": "p99_recovery_seconds",
+           "killed_at_s": round(killed_at, 2) if killed_at else None,
+           "baseline_p99_ms": round(baseline_ms, 3),
+           "threshold_ms": round(thresh_ms, 3),
+           "windows_p99_ms": {("%.1fs" % (w * window_s)): round(v, 2)
+                              for w, v in p99.items()},
+           "hangs": hangs, "expired": expired, "shed": shed,
+           "healthy_final": healthy, "final_states": states,
+           "replaced": any(r.index >= replicas for r in rset.replicas),
+           "ok": ok}
+    emit(rec)
+    return rec
+
+
 def run_replicas(rset, spec, n_requests=400, workers=4, max_wait_ms=2.0,
                  kill_frac=0.5, kill_replica=0, result_timeout=60.0,
                  emit=_emit):
@@ -753,10 +1066,32 @@ def main(argv=None):
                     help="--mode decode per-sequence generation budget cap")
     ap.add_argument("--decode-qps", default="20,60,200",
                     help="--mode decode open-loop offered request rates")
+    ap.add_argument("--slo-requests", type=int, default=200,
+                    help="--mode slo requests per overload point")
+    ap.add_argument("--slo-ms", type=float, default=0.0,
+                    help="--mode slo deadline (0 = auto-calibrate to ~6x "
+                         "the calibration run's loaded median)")
+    ap.add_argument("--slo-replicas", type=int, default=0,
+                    help="--mode slo replica count for BOTH routers "
+                         "(0 = min(2, visible devices))")
+    ap.add_argument("--slo-factors", default="1.5,3,8",
+                    help="--mode slo offered-load multiples of calibrated "
+                         "capacity")
+    ap.add_argument("--slo-no-kill", action="store_true",
+                    help="--mode slo: skip the kill/restore sweep")
     args = ap.parse_args(argv)
 
     modes = {m.strip() for m in args.mode.split(",") if m.strip()}
     ok = True
+    if "slo" in modes:
+        rec = run_slo(
+            replicas=args.slo_replicas or None,
+            n_requests=args.slo_requests,
+            slo_ms=args.slo_ms or None,
+            qps_factors=tuple(float(f) for f in
+                              args.slo_factors.split(",") if f),
+            kill=not args.slo_no_kill)
+        ok = ok and rec["ok"]
     if "decode" in modes:
         rec = run_decode(n_requests=args.decode_requests,
                          slots=args.decode_slots,
@@ -767,7 +1102,7 @@ def main(argv=None):
             n_requests=min(args.decode_requests, 60),
             slots=args.decode_slots,
             max_new=min(args.decode_max_new, 16))
-    single = modes - {"replicas", "decode"}
+    single = modes - {"replicas", "decode", "slo"}
     if single:
         pred, spec = build_predictor(dim=args.dim, width=args.width,
                                      depth=args.depth,
