@@ -455,12 +455,14 @@ def bench_optimizer_step():
 
 
 def _overhead_workloads():
-    """ONE copy of the workload builders the two overhead benches
-    (``guard_overhead`` and ``telemetry_overhead``) measure — the same
-    optimizer-step and small-resnet shapes, read from the shared
-    ``BENCH_GUARD_*`` env knobs. Returns ``{name: make}`` where
-    ``make(scaler=None) -> (step_fn, sync)``; attaching a
-    DynamicLossScaler builds the guarded variant."""
+    """ONE copy of the workload builders the overhead benches
+    (``guard_overhead``, ``telemetry_overhead``, ``integrity_overhead``)
+    measure — the same optimizer-step and small-resnet shapes, read from
+    the shared ``BENCH_GUARD_*`` env knobs. Returns ``{name: make}``
+    where ``make(scaler=None) -> (step_fn, sync, trainer)``; attaching a
+    DynamicLossScaler builds the guarded variant, and the trainer rides
+    along so integrity_overhead can bracket it with the step-wedge
+    watchdog + health monitor."""
     import jax
 
     import mxtpu as mx
@@ -487,7 +489,7 @@ def _overhead_workloads():
         def sync():
             jax.block_until_ready([p.data()._data for p in params])
 
-        return (lambda: tr.step(1)), sync
+        return (lambda: tr.step(1)), sync, tr
 
     def make_resnet(scaler=None):
         from mxtpu.gluon.model_zoo import vision
@@ -515,7 +517,7 @@ def _overhead_workloads():
         def sync():
             jax.block_until_ready([p.data()._data for p in params])
 
-        return one, sync
+        return one, sync, tr
 
     return {"optimizer_step": make_opt_step, "resnet": make_resnet}
 
@@ -556,9 +558,9 @@ def bench_guard_overhead(emit=None):
             % (os.environ.get("BENCH_GUARD_CONFIGS"), sorted(makers)))
     overheads = {}
     for cname in which:
-        off_rate = _time_steps(*makers[cname](None), steps)
+        off_rate = _time_steps(*makers[cname](None)[:2], steps)
         on_rate = _time_steps(
-            *makers[cname](resilience.DynamicLossScaler()), steps)
+            *makers[cname](resilience.DynamicLossScaler())[:2], steps)
         overheads[cname] = off_rate / on_rate - 1.0
         emit({"metric": "guard_overhead_%s" % cname, "guard": "off",
               "value": round(off_rate, 2), "unit": "steps/sec"})
@@ -630,7 +632,7 @@ def bench_telemetry_overhead(emit=None):
     noise = {}
     try:
         for cname in which:
-            step_fn, sync = makers[cname](None)
+            step_fn, sync = makers[cname](None)[:2]
             step_fn()  # warmup + compile (shared: one workload, all modes)
             sync()
             rates = {m: [] for m in modes}
@@ -688,6 +690,156 @@ def bench_telemetry_overhead(emit=None):
         "per_config_xprof": {k: round(v, 4)
                              for k, v in xprof_overheads.items()},
         "noise_frac": {k: round(v, 4) for k, v in noise.items()},
+    }
+
+
+def bench_integrity_overhead(emit=None):
+    """Training-survivability stack cost (ISSUE 14): steps/s with the
+    FULL integrity stack ON — numerics sentinel + loss scaler, the
+    divergence fingerprint compiled into the donated update jit with
+    host compares at cadence, the step-wedge watchdog bracket (arm /
+    disarm + its off-thread monitor), and the TrainingHealthMonitor
+    ``after_step`` — vs the bare loop, on the same optimizer-step and
+    small-resnet shapes the other overhead benches use
+    (``BENCH_INTEGRITY_CONFIGS``). OFF and ON timing rounds ALTERNATE
+    (the telemetry_overhead methodology: a single off-then-on pair
+    measures host drift, not the stack) over ``BENCH_INTEGRITY_ROUNDS``
+    with the median per mode; each mode's workload is built AND
+    dispatched under its own ``MXTPU_DIVERGENCE_EVERY``, so both sets of
+    executables stay cached and steady-state compiles are flat — gated.
+
+    serve_bench-style gate summary: ``overhead_budget`` (worst
+    overhead_frac < 2%, the guard_overhead budget — judged on-chip; on a
+    noisy CPU host it is reported but does not fail ``ok``),
+    ``retrace_flat`` (zero compiles during the timed rounds),
+    ``divergence_checks`` (the sentinel really compared), ``no_wedges``
+    (the watchdog never tripped). ``vs_baseline`` >= 1.0 means the stack
+    fits the budget on this platform."""
+    import jax
+
+    from mxtpu import optimizer_fused as of
+    from mxtpu import resilience, telemetry
+    from mxtpu.monitor import TrainingHealthMonitor
+
+    if emit is None:
+        emit = _emit
+    which = [c.strip() for c in os.environ.get(
+        "BENCH_INTEGRITY_CONFIGS", "optimizer_step,resnet").split(",")
+        if c]
+    steps = int(os.environ.get("BENCH_GUARD_STEPS", "30"))
+    rounds = int(os.environ.get("BENCH_INTEGRITY_ROUNDS", "3"))
+    every = 8  # divergence-compare cadence inside the ON mode
+    makers = _overhead_workloads()
+    bad = [c for c in which if c not in makers]
+    if bad or not which:
+        raise RuntimeError(
+            "BENCH_INTEGRITY_CONFIGS=%r: expected a non-empty comma list "
+            "from %s"
+            % (os.environ.get("BENCH_INTEGRITY_CONFIGS"), sorted(makers)))
+    prev_div = os.environ.get("MXTPU_DIVERGENCE_EVERY")
+
+    def _set_div(on):
+        if on:
+            os.environ["MXTPU_DIVERGENCE_EVERY"] = str(every)
+        else:
+            os.environ.pop("MXTPU_DIVERGENCE_EVERY", None)
+
+    overheads, noise = {}, {}
+    wedges_before = telemetry.snapshot()["counters"].get("train.wedges", 0)
+    checks_ran = 0
+    compiles_moved = False
+    watchdogs = []
+    try:
+        for cname in which:
+            # one workload per mode, each traced under ITS policy env
+            _set_div(False)
+            off_fn, off_sync = makers[cname](None)[:2]
+            _set_div(True)
+            on_fn, on_sync, tr = makers[cname](
+                resilience.DynamicLossScaler())
+            wd = resilience.TrainStepWatchdog(
+                timeout_x=50.0, min_timeout_s=5.0).start_monitor(0.05)
+            watchdogs.append(wd)
+            tr.attach_step_watchdog(wd)
+            mon = TrainingHealthMonitor(
+                interval=every, divergence_every=every,
+                poison_streak=0).install(tr)
+
+            def on_step(fn=on_fn, m=mon):
+                fn()
+                m.after_step()
+
+            # warm both (compile under their own env), then pin compiles
+            on_step()
+            on_sync()
+            _set_div(False)
+            off_fn()
+            off_sync()
+            c0 = of.FUSED_STATS["compiles"]
+            rates = {"off": [], "on": []}
+            for _ in range(rounds):
+                for mode in ("off", "on"):
+                    _set_div(mode == "on")
+                    fn = off_fn if mode == "off" else on_step
+                    sync = off_sync if mode == "off" else on_sync
+                    t0 = time.perf_counter()
+                    for _ in range(steps):
+                        fn()
+                    sync()
+                    rates[mode].append(
+                        steps / (time.perf_counter() - t0))
+            compiles_moved |= of.FUSED_STATS["compiles"] != c0
+            checks_ran += mon._sentinel.checks
+            med = {m: float(np.median(rs)) for m, rs in rates.items()}
+            for mode in ("off", "on"):
+                emit({"metric": "integrity_overhead_%s" % cname,
+                      "integrity": mode,
+                      "value": round(med[mode], 2), "unit": "steps/sec",
+                      "rounds": [round(r, 2) for r in rates[mode]]})
+            overheads[cname] = med["off"] / med["on"] - 1.0
+            all_r = [r for rs in rates.values() for r in rs]
+            noise[cname] = (max(all_r) - min(all_r)) / med["off"]
+            emit({"metric": "integrity_overhead_%s" % cname,
+                  "overhead_frac": round(overheads[cname], 4),
+                  "noise_frac": round(noise[cname], 4)})
+    finally:
+        for wd in watchdogs:
+            wd.stop_monitor()
+        if prev_div is None:
+            os.environ.pop("MXTPU_DIVERGENCE_EVERY", None)
+        else:
+            os.environ["MXTPU_DIVERGENCE_EVERY"] = prev_div
+    worst = max(overheads.values())
+    wedges = telemetry.snapshot()["counters"].get("train.wedges", 0) \
+        - wedges_before
+    on_tpu = jax.default_backend() == "tpu"
+    fits = worst < 0.02
+    gates = {
+        "overhead_budget": bool(fits),
+        "retrace_flat": not compiles_moved,
+        "divergence_checks": checks_ran > 0,
+        "no_wedges": wedges == 0,
+    }
+    # the <2% budget is judged where it matters (the low-variance TPU
+    # tier, the guard_overhead precedent); host-tier noise reports the
+    # number without failing the gate verdict
+    ok = gates["retrace_flat"] and gates["divergence_checks"] \
+        and gates["no_wedges"] and (fits or not on_tpu)
+    return {
+        "metric": "integrity_overhead",
+        "value": round(worst, 4),
+        "unit": "overhead_frac",
+        # >=1.0 means the full survivability stack fits the 2% budget
+        "vs_baseline": round(0.02 / max(worst, 1e-4), 3)
+        if ok else 0.0,
+        "mfu": None,
+        "hfu": None,
+        "per_config": {k: round(v, 4) for k, v in overheads.items()},
+        "noise_frac": {k: round(v, 4) for k, v in noise.items()},
+        "divergence_checks": checks_ran,
+        "train_wedges": int(wedges),
+        "gates": gates,
+        "ok": bool(ok),
     }
 
 
@@ -1257,6 +1409,7 @@ CONFIGS = {
     "optimizer_step": bench_optimizer_step,
     "guard_overhead": bench_guard_overhead,
     "telemetry_overhead": bench_telemetry_overhead,
+    "integrity_overhead": bench_integrity_overhead,
     "conv_class": bench_conv_class,
     "serving": bench_serving,
     "serving_decode": bench_serving_decode,

@@ -31,7 +31,16 @@ from ..base import MXNetError
 
 __all__ = ["save_block", "load_block", "save_train_step",
            "load_train_step", "save_trainer", "load_trainer",
-           "latest_step", "load_trainer_params_into_block"]
+           "load_trainer_fallback", "latest_step",
+           "load_trainer_params_into_block", "CheckpointCorrupt"]
+
+
+class CheckpointCorrupt(MXNetError):
+    """A checkpoint step's restored bytes disagree with the checksum
+    manifest written at save time (disk corruption, a torn write, or the
+    injected fault kind ``ckpt_corrupt``). The step is tombstoned on
+    raise, so every later ``latest_step`` scan / tiered restore skips it
+    without re-reading the bytes."""
 
 
 def _param_tree(block):
@@ -117,11 +126,125 @@ def _read_meta(step_dir):
     return json.loads(p.read_text())
 
 
+# -------------------------------------------------- integrity bookkeeping
+def _crc_host(x):
+    """crc32 of an array's host bytes — THE canonical blob checksum both
+    sides of the manifest use (save computes it from the live value,
+    restore from the staged restored value; dtype/shape ride the orbax
+    tree, so bytes are the one thing left to pin)."""
+    import zlib
+
+    import numpy as np
+    arr = np.asarray(jax.device_get(x))
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def _tombstone_path(step_dir):
+    from etils import epath
+    p = epath.Path(step_dir)
+    return p.parent / (p.name + ".corrupt.json")
+
+
+def _is_tombstoned(step_dir):
+    try:
+        return _tombstone_path(step_dir).exists()
+    except Exception:  # noqa: BLE001 — unreadable backend: assume clean
+        return False
+
+
+def _write_tombstone(step_dir, reason):
+    """Mark a step known-corrupt (idempotent). The bytes stay on disk for
+    forensics; every scan from now on skips the step without re-reading
+    them, and the retention GC stops counting it as a keeper."""
+    import json
+    import time
+    try:
+        _tombstone_path(step_dir).write_text(
+            json.dumps({"reason": str(reason), "t": time.time()}))
+    except Exception:  # noqa: BLE001 — best effort: the raise still lands
+        pass
+
+
+def _clear_tombstone(step_dir):
+    try:
+        p = _tombstone_path(step_dir)
+        if p.exists():
+            p.unlink()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _finalized_steps(directory):
+    """Ascending step indices with a FINALIZED (atomically materialized)
+    orbax directory — tombstoned or not; callers filter."""
+    from etils import epath
+    d = epath.Path(str(directory))
+    steps = []
+    try:
+        for p in d.iterdir():
+            if p.name.startswith("step_") and p.is_dir():
+                try:
+                    steps.append(int(p.name[5:]))
+                except ValueError:
+                    pass
+    except Exception:  # noqa: BLE001 — missing/unreadable directory
+        return []
+    return sorted(steps)
+
+
+def _gc_steps(directory, keep):
+    """Bounded checkpoint retention (``MXTPU_CKPT_KEEP``): delete
+    finalized step dirs strictly OLDER than the newest ``keep`` intact
+    (finalized, non-tombstoned) steps. Mid-write steps (sidecar without a
+    finalized dir) are invisible here and tombstoned steps never count as
+    keepers, so the newest restorable checkpoint survives even at
+    ``keep=1`` with the latest save in flight or known-corrupt. With no
+    provably-intact keeper at all, nothing is deleted. Returns the
+    deleted step list."""
+    if not keep or keep <= 0:
+        return []
+    if jax.process_index() != 0:  # one writer deletes; 0 single-process
+        return []
+    from etils import epath
+    steps = _finalized_steps(directory)
+    intact = [s for s in steps
+              if not _is_tombstoned(_step_dir(directory, s))]
+    keepers = intact[-int(keep):]
+    if not keepers:
+        return []
+    floor = keepers[0]
+    deleted = []
+    for s in steps:
+        if s >= floor:
+            continue
+        sd = _step_dir(directory, s)
+        try:
+            epath.Path(sd).rmtree()
+        except Exception:  # noqa: BLE001 — a busy/garbled dir stays
+            continue
+        for side in (_meta_path(sd), _tombstone_path(sd)):
+            try:
+                if side.exists():
+                    side.unlink()
+            except Exception:  # noqa: BLE001
+                pass
+        deleted.append(s)
+    if deleted:
+        import logging
+        logging.getLogger("mxtpu.resilience").info(
+            "checkpoint GC: deleted steps %s (keep=%d, newest intact %s)",
+            deleted, keep, keepers[-1])
+    return deleted
+
+
 def latest_step(directory):
     """Newest RESUMABLE step in a checkpoint directory (or None): the
     ``latest.json`` pointer if its step dir finalized (async orbax
-    materializes step dirs atomically, so existence == durable), else the
-    newest finalized ``step_*`` directory. Shared by
+    materializes step dirs atomically, so existence == durable) AND is
+    not tombstoned as corrupt, else the newest finalized non-tombstoned
+    ``step_*`` directory — the cheap tiers of the integrity story (full
+    checksum verification runs inside the restore itself, see
+    :func:`load_trainer_fallback`). Shared by
     :class:`mxtpu.resilience.ResilientLoop` (training resume) and
     :meth:`mxtpu.serving.Predictor.from_trainer_checkpoint` (serving
     restore); epath-routed so gs://-style directories resolve from a
@@ -134,18 +257,11 @@ def latest_step(directory):
         candidate = int(json.loads((d / "latest.json").read_text())["step"])
     except Exception:  # missing, torn, or backend error: fall back to scan
         candidate = None
-    if candidate is not None and (d / ("step_%d" % candidate)).is_dir():
+    if candidate is not None and (d / ("step_%d" % candidate)).is_dir() \
+            and not _is_tombstoned(_step_dir(directory, candidate)):
         return candidate
-    steps = []
-    try:
-        for p in d.iterdir():
-            if p.name.startswith("step_") and p.is_dir():
-                try:
-                    steps.append(int(p.name[5:]))
-                except ValueError:
-                    pass
-    except Exception:
-        return None
+    steps = [s for s in _finalized_steps(directory)
+             if not _is_tombstoned(_step_dir(directory, s))]
     return max(steps) if steps else None
 
 
@@ -301,14 +417,26 @@ def save_trainer(trainer, directory, step=0, async_save=False, force=False):
     and the numerics guard's device step count) + the global RNG key.
     Everything :class:`mxtpu.resilience.ResilientLoop` needs for bit-exact
     resume, in one orbax step directory (finalized atomically, so a
-    present ``step_N`` dir is always durable)."""
+    present ``step_N`` dir is always durable).
+
+    Integrity (ISSUE 14): the sidecar meta carries a per-blob crc32
+    manifest (every param, the updater blob, the RNG key) computed from
+    the live values at save time; restore verifies the staged bytes
+    against it BEFORE committing anything (:func:`_restore_trainer_tree`)
+    and falls back a tier on mismatch. With ``MXTPU_CKPT_KEEP`` > 0,
+    finalized steps older than the newest N intact ones are
+    garbage-collected after the save dispatch (:func:`_gc_steps` — an
+    in-flight async step and tombstoned steps never count as keepers).
+    Fault kind ``ckpt_corrupt`` flips the saved updater blob's bytes
+    AFTER the manifest is computed, so the verification/fallback tiers
+    are exercised end-to-end."""
     import time
 
     import numpy as np
 
     from .. import random as _random
     from .. import telemetry
-    from ..resilience import inject
+    from ..resilience import ckpt_keep, inject
     if inject("ckpt_io"):
         raise OSError("injected checkpoint IO failure (MXTPU_FAULT_INJECT)")
     upd = _trainer_updater(trainer)
@@ -318,20 +446,38 @@ def save_trainer(trainer, directory, step=0, async_save=False, force=False):
     t0 = time.perf_counter()
     blob = np.frombuffer(upd.get_states(dump_optimizer=True),
                          np.uint8).copy()
+    rng_data = np.asarray(_random.get_key_data())
+    # per-blob checksum manifest: the save-time truth every restore tier
+    # verifies against (one host fetch per param, at checkpoint cadence —
+    # the save itself is already moving those bytes)
+    crc = {"p%d" % j: _crc_host(p.data()._data)
+           for j, p in enumerate(params)}
+    crc["updater"] = _crc_host(blob)
+    crc["rng"] = _crc_host(rng_data)
+    if inject("ckpt_corrupt"):
+        # flip bytes AFTER the manifest: the saved blob now disagrees
+        # with its checksum exactly like real on-disk corruption would
+        blob = blob.copy()
+        blob[:1] ^= 0xFF
     tree = {
         "params": _keyed([p.data()._data for p in params]),
-        "extra": {"updater": blob, "rng": _random.get_key_data()},
+        "extra": {"updater": blob, "rng": rng_data},
     }
     sd = _step_dir(directory, step)
     _guard_overwrite(sd, force)
     ckptr = _checkpointer(async_save)
     ckptr.save(sd, tree, force=True)
-    _write_meta(sd, {"kind": "trainer", "n_params": len(params)})
+    # a force re-save over a known-corrupt step IS a fresh checkpoint:
+    # drop the tombstone so the new bytes are restorable again
+    _clear_tombstone(sd)
+    _write_meta(sd, {"kind": "trainer", "n_params": len(params),
+                     "crc": crc})
     # save latency into the registry: for async saves this is the
     # serialize+dispatch cost training actually pays; the background
     # write's durability cost shows up in wait_until_finished callers
     telemetry.observe("checkpoint.save_s", time.perf_counter() - t0)
     telemetry.inc("checkpoint.saves")
+    _gc_steps(directory, ckpt_keep())
     return ckptr
 
 
@@ -345,11 +491,46 @@ def _check_trainer_meta(sd, params, who):
                                    len(params)))
 
 
-def _restore_trainer_tree(params, sd):
+def _verify_restored(sd, params, restored):
+    """Check every restored blob against the save-time crc manifest (a
+    checkpoint without one — pre-ISSUE-14 — verifies vacuously). On a
+    mismatch the step is tombstoned and :class:`CheckpointCorrupt`
+    raises BEFORE anything was committed, naming the bad blobs; fault
+    kind ``ckpt_corrupt`` lands here via the blob bytes
+    :func:`save_trainer` flipped after manifesting."""
+    import numpy as np
+    meta = _read_meta(sd)
+    crc = (meta or {}).get("crc")
+    if not crc:
+        return
+    bad = []
+    for j in range(len(params)):
+        k = "p%d" % j
+        if k in crc and _crc_host(restored["params"][k]) != crc[k]:
+            bad.append(k)
+    if "updater" in crc and _crc_host(
+            np.asarray(restored["extra"]["updater"])) != crc["updater"]:
+        bad.append("updater")
+    if "rng" in crc and _crc_host(
+            np.asarray(restored["extra"]["rng"])) != crc["rng"]:
+        bad.append("rng")
+    if bad:
+        _write_tombstone(sd, "checksum mismatch: %s" % ",".join(bad))
+        raise CheckpointCorrupt(
+            "checkpoint %s failed integrity verification: restored bytes "
+            "of %s disagree with the save-time checksum manifest (disk "
+            "corruption or a torn write); the step is tombstoned — "
+            "restore falls back to the next-newest intact step"
+            % (sd, ", ".join(bad)))
+
+
+def _restore_trainer_tree(params, sd, verify=True):
     """The restore core shared by :func:`load_trainer` (training resume)
     and :func:`load_trainer_params_into_block` (serving restore): read a
-    :func:`save_trainer` step, write the params back in place with their
-    live shardings, and return the full restored tree (the ``extra``
+    :func:`save_trainer` step into a STAGED tree, verify it against the
+    checksum manifest, and only then write the params back in place with
+    their live shardings — a corrupt step must never half-overwrite a
+    live trainer. Returns the full restored tree (the ``extra``
     updater/RNG blobs ride along for the caller that wants them)."""
     import orbax.checkpoint as ocp
 
@@ -369,6 +550,8 @@ def _restore_trainer_tree(params, sd):
     restored = ckptr.restore(
         sd, args=ocp.args.PyTreeRestore(restore_args=restore_args,
                                         item=targets))
+    if verify:
+        _verify_restored(sd, params, restored)
     for j, p in enumerate(params):
         p.data()._set_data(restored["params"]["p%d" % j])
     return restored
@@ -409,6 +592,41 @@ def load_trainer(trainer, directory, step=0):
         replace()
     _random.set_key_data(np.asarray(restored["extra"]["rng"]))
     return trainer
+
+
+def load_trainer_fallback(trainer, directory, logger=None):
+    """Tiered trainer restore: try finalized, non-tombstoned steps newest
+    first; a step that fails integrity verification
+    (:class:`CheckpointCorrupt` — tombstoned by the verifier) or errors
+    during restore falls back one tier, counted in
+    ``checkpoint.restore_fallbacks{reason}``. Returns the step restored
+    from, or None when the directory holds nothing restorable (fresh
+    start). Structure mismatches (param count / optimizer state shape)
+    still raise — that is a configuration error resuming older bytes
+    would only hide."""
+    import logging
+
+    from .. import telemetry
+    log = logger or logging.getLogger("mxtpu.resilience")
+    steps = [s for s in _finalized_steps(directory)
+             if not _is_tombstoned(_step_dir(directory, s))]
+    for step in reversed(steps):
+        try:
+            load_trainer(trainer, directory, step=step)
+            return step
+        except CheckpointCorrupt as e:
+            telemetry.inc("checkpoint.restore_fallbacks", tag="checksum")
+            log.warning(
+                "checkpoint step %d failed integrity verification; "
+                "falling back one tier (%s)", step, e)
+        except MXNetError:
+            raise  # structure mismatch: a config error, not corruption
+        except Exception as e:  # noqa: BLE001 — garbled step dir
+            telemetry.inc("checkpoint.restore_fallbacks", tag="error")
+            log.warning(
+                "checkpoint step %d failed to restore (%s: %s); falling "
+                "back one tier", step, type(e).__name__, e)
+    return None
 
 
 def load_trainer_params_into_block(block, directory, step=None):

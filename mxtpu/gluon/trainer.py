@@ -107,6 +107,16 @@ class Trainer:
         self._mfu = xprof.MFUMeter(n_devices=n_dev) \
             if xprof.enabled() else None
         xprof.ensure_memwatch()  # live HBM gauges when MXTPU_MEMWATCH_S>0
+        # step-wedge watchdog (ISSUE 14, mxtpu/resilience.py): with
+        # MXTPU_TRAIN_STEP_TIMEOUT_X > 0 every step dispatch is bracketed
+        # by a deadline off a rolling step-time baseline; a trip dumps
+        # flight_record("train_wedge") and fails loud. Off-thread monitor
+        # here; tests attach their own fake-clock watchdog and poll().
+        from .. import resilience as _res
+        self._step_seq = 0
+        self._step_watchdog = None
+        if _res.train_step_timeout_x() > 0:
+            self._step_watchdog = _res.TrainStepWatchdog().start_monitor()
 
     @staticmethod
     def _resolve_mesh(mesh, data_axis):
@@ -250,6 +260,20 @@ class Trainer:
     def optimizer(self):
         return self._optimizer
 
+    def attach_step_watchdog(self, watchdog):
+        """Attach a :class:`mxtpu.resilience.TrainStepWatchdog` (or detach
+        with None). The env path (``MXTPU_TRAIN_STEP_TIMEOUT_X``) builds a
+        monitor-threaded one at construction; tests attach a fake-clock
+        instance and drive :meth:`~mxtpu.resilience.TrainStepWatchdog.poll`
+        — the whole wedge matrix runs sleep-free. A replaced watchdog's
+        monitor thread is stopped (it would otherwise scan the orphan
+        until process exit)."""
+        old = self._step_watchdog
+        if old is not None and old is not watchdog:
+            old.stop_monitor()
+        self._step_watchdog = watchdog
+        return watchdog
+
     def step(self, batch_size, ignore_stale_grad=False):
         """One optimization step (ref: trainer.py:254). rescale_grad is set to
         1/batch_size on top of any user scale, like the reference.
@@ -283,6 +307,17 @@ class Trainer:
             # pended by the loader when it handed this batch over) to
             # THIS step's trace as causal links
             telemetry.link_pending()
+            # wedge-watchdog bracket: arm with THIS step's trace id so a
+            # trip's flight artifact names the wedged step's trace. Pure
+            # host bookkeeping (a clock read + list append) — the d2h==0
+            # contract holds with the watchdog attached.
+            self._step_seq += 1
+            wd = self._step_watchdog
+            entry = None
+            if wd is not None:
+                ctx = telemetry.current_trace()
+                entry = wd.arm(self._step_seq,
+                               None if ctx is None else ctx.trace_id)
             try:
                 resilience.maybe_oom()
                 with telemetry.span("trainer.step.allreduce"):
@@ -290,6 +325,11 @@ class Trainer:
                 with telemetry.span("trainer.step.update"):
                     self._update(ignore_stale_grad)
             except Exception as e:
+                if entry is not None:
+                    try:
+                        wd.disarm(entry)
+                    except resilience.TrainWedgeError:
+                        pass  # the original dispatch error stays loud
                 if xprof.is_oom(e):
                     # an HBM OOM must leave an artifact, not just a dead
                     # process: ledger + per-device memory stats dump
@@ -299,6 +339,8 @@ class Trainer:
                         "trainer.step", e,
                         trace_ids=[ctx.trace_id] if ctx else [])
                 raise
+            if entry is not None:
+                wd.disarm(entry)  # raises loud if this step tripped
             if self._mfu is not None:
                 self._mfu.step()  # host bookkeeping only: perf.mfu gauge
             return self._step_verdict()

@@ -80,14 +80,48 @@ class TrainingHealthMonitor:
     ``flush()`` materializes them in ONE batch (a single host sync, off
     the step path) and logs every skipped step. ``after_step()`` flushes
     every ``interval`` calls — the Monitor tic/toc cadence, applied to
-    training health instead of op stats."""
+    training health instead of op stats.
 
-    def __init__(self, interval=100, logger=None):
+    ISSUE 14 escalations, both off the step path:
+
+    * **Poison-batch quarantine** — ``poison_streak`` (default
+      ``MXTPU_POISON_STREAK``, 0 = off) CONSECUTIVE skipped steps stop
+      being a log line: the offending step indices (with their owning
+      trace ids, the PR-10 step-trace attribution) land in the bounded
+      ``quarantined`` ring and a ``flight_record("poison_batch")``
+      artifact, and ``on_poison`` chooses ``"raise"``
+      (:class:`~mxtpu.resilience.PoisonBatchError`, the default — eight
+      consecutive non-finite steps is data poisoning, not overflow
+      noise) vs ``"continue"`` (quarantine + keep training; the loss
+      scaler keeps backing off).
+    * **Divergence checks** — ``divergence_every`` (default
+      ``MXTPU_DIVERGENCE_EVERY``, 0 = off) ``after_step`` calls, the
+      updater's async fingerprint scalars are compared per-replica by a
+      :class:`~mxtpu.resilience.DivergenceSentinel`; a mismatch dumps
+      ``flight_record("divergence")`` and raises. One bounded fetch at
+      check cadence — the hot loop stays sync-free."""
+
+    def __init__(self, interval=100, logger=None, poison_streak=None,
+                 on_poison="raise", divergence_every=None):
+        from . import resilience
         self.interval = int(interval)
         self.logger = logger or logging.getLogger("mxtpu.resilience")
+        self.poison_streak = resilience.poison_streak() \
+            if poison_streak is None else int(poison_streak)
+        if on_poison not in ("raise", "continue"):
+            raise ValueError("on_poison must be 'raise' or 'continue', "
+                             "got %r" % (on_poison,))
+        self.on_poison = on_poison
+        self.divergence_every = resilience.divergence_every() \
+            if divergence_every is None else int(divergence_every)
+        self._sentinel = resilience.DivergenceSentinel(logger=self.logger)
         self._owner = None
         self._count = 0
+        self._skip_streak = 0   # consecutive skips across flushes
+        self._streak = []       # the streak's (step, gnorm, trace_id)s
         self.skipped = []  # [(step, grad_norm), ...] across flushes
+        import collections
+        self.quarantined = collections.deque(maxlen=64)
 
     def install(self, owner):
         """Attach to a gluon Trainer, a Module, or a raw updater. The
@@ -117,9 +151,27 @@ class TrainingHealthMonitor:
 
     def after_step(self):
         self._count += 1
+        records = []
         if self._count % self.interval == 0:
-            return self.flush()
-        return []
+            records = self.flush()
+        if self.divergence_every > 0 and \
+                self._count % self.divergence_every == 0:
+            self.check_divergence()
+        return records
+
+    def check_divergence(self):
+        """One per-replica fingerprint compare off the updater's async
+        scalars (SYNCS on two scalars — check cadence, never the step
+        path). Raises :class:`~mxtpu.resilience.DivergenceError` on a
+        replicated-buffer mismatch, after the flight artifact lands."""
+        updater = self._updater_of()
+        fp = getattr(updater, "last_fingerprint", None)
+        traces = getattr(updater, "_step_traces", None) or {}
+        last_trace = next(reversed(traces.values())) \
+            if hasattr(traces, "values") and traces else None
+        return self._sentinel.check(
+            fp, step=self._count,
+            trace_ids=[last_trace] if last_trace else [])
 
     def flush(self):
         """Materialize buffered verdicts (syncs once); returns
@@ -153,4 +205,47 @@ class TrainingHealthMonitor:
             # not step cadence)
             telemetry.gauge("resilience.loss_scale", scaler.scale_value())
         self.skipped.extend((s, g) for s, ok, g in records if not ok)
+        self._escalate_poison(updater, records)
         return records
+
+    def _escalate_poison(self, updater, records):
+        """Poison-batch quarantine: ``poison_streak`` CONSECUTIVE skips
+        (tracked across flushes, in step order) escalate from log lines
+        to a quarantine — the streak's step indices + owning trace ids
+        ring-buffered and flight-recorded, then raise or continue per
+        ``on_poison``. A good step resets the streak: the loss scaler
+        recovering after a few backoffs is normal AMP life, a sustained
+        run of non-finite steps is poisoned data."""
+        if self.poison_streak <= 0:
+            return
+        from . import resilience, telemetry
+        traces = getattr(updater, "_step_traces", None) or {}
+        for step, ok, gnorm in records:
+            if ok:
+                self._skip_streak = 0
+                self._streak = []
+                continue
+            self._streak.append((step, gnorm, traces.get(step)))
+            self._skip_streak += 1
+            if self._skip_streak < self.poison_streak:
+                continue
+            steps = [s for s, _, _ in self._streak]
+            trace_ids = [t for _, _, t in self._streak if t]
+            entry = {"steps": steps, "trace_ids": trace_ids,
+                     "grad_norms": [g for _, g, _ in self._streak]}
+            self.quarantined.append(entry)
+            telemetry.inc("resilience.poison_quarantines")
+            telemetry.flight_record("poison_batch", trace_ids=trace_ids,
+                                    extra=entry)
+            msg = ("poison-batch quarantine: %d CONSECUTIVE sentinel-"
+                   "skipped steps (%s) — this is poisoned data or a "
+                   "corrupt shard, not bf16 overflow noise; the steps' "
+                   "trace ids are in the flight artifact "
+                   "(reason=poison_batch)"
+                   % (self._skip_streak, steps))
+            self._skip_streak = 0
+            self._streak = []
+            if self.on_poison == "raise":
+                raise resilience.PoisonBatchError(msg)
+            self.logger.error("%s — continuing per on_poison='continue'",
+                              msg)

@@ -112,7 +112,18 @@ def policy_key():
             # skip-step `where` select is baked into the fused-update
             # executable, so a guard flip must recompile (exactly once);
             # the step_ok FLAG and loss-scale VALUE are traced and never do
-            os.environ.get("MXTPU_NUMERICS_GUARD", "0"))
+            os.environ.get("MXTPU_NUMERICS_GUARD", "0"),
+            # resilience.divergence_every: the divergence-sentinel
+            # fingerprint (f32 sum + i32 bitcast-fold of post-update
+            # params+state) is compiled into the SAME fused-update
+            # executable when non-zero, so an on/off flip recompiles (at
+            # most once per cached executable). Only the ON BIT is
+            # trace-time — the cadence VALUE is a host compare schedule,
+            # so it is normalized here: retuning 8 -> 16 must not
+            # invalidate every policy_key-keyed forward/serving
+            # executable that never contained the fingerprint
+            "0" if os.environ.get("MXTPU_DIVERGENCE_EVERY", "0")
+            in ("", "0") else "1")
 
 
 # canonical op name -> fn(attrs) -> int: STATIC output count for ops whose

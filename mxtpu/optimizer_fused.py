@@ -49,6 +49,7 @@ item 5's one-compile-cache engine.
 """
 from __future__ import annotations
 
+import collections
 import math
 import os
 
@@ -612,6 +613,37 @@ def _tree_where(ok, new, old):
     return jnp.where(ok, new, old)
 
 
+def _fingerprint(values):
+    """Divergence-sentinel fingerprint of a list of arrays / state trees
+    (mxtpu/resilience.py): ONE f32 sum plus ONE wrapping int32
+    bitcast-fold over every leaf — the fold catches sign flips and
+    NaN-payload corruption that a float sum can absorb (x + (-x) == 0).
+    Computed INSIDE the donated update jit from the post-update values,
+    so it is a pure function of each device's own operands: a replica
+    whose replicated buffers silently diverged computes a different copy
+    of this (replicated) output, which the host-side
+    ``DivergenceSentinel`` compares off the async scalars."""
+    fsum = jnp.float32(0.0)
+    fold = jnp.int32(0)
+
+    def add(x):
+        nonlocal fsum, fold
+        if x is None:
+            return
+        if isinstance(x, tuple):
+            for c in x:
+                add(c)
+            return
+        xf = x.astype(jnp.float32)
+        fsum = fsum + jnp.sum(xf)
+        fold = fold + jnp.sum(
+            jax.lax.bitcast_convert_type(xf, jnp.int32))
+
+    for v in values:
+        add(v)
+    return fsum, fold
+
+
 def _zero_shards(plan, zf):
     """The (shard, gather, tree-shard) constraint trio for one param under
     the plan — identity functions when the param is not ZeRO-eligible.
@@ -643,7 +675,8 @@ def _zero_shards(plan, zf):
     return shard, gather, tree_shard
 
 
-def _build(rule, static, mp_flags, out_dtypes, plan=None, zflags=None):
+def _build(rule, static, mp_flags, out_dtypes, plan=None, zflags=None,
+           emit_fp=False):
     zflags = zflags or (False,) * len(mp_flags)
 
     def fused(w_list, g_list, s_list, h_list, rescale):
@@ -669,13 +702,19 @@ def _build(rule, static, mp_flags, out_dtypes, plan=None, zflags=None):
                 nw, ns = rule.step(w, g, s, h, rescale, static)
                 new_w.append(gather(nw))
                 new_s.append(tshard(ns))
+        if emit_fp:
+            # divergence sentinel (MXTPU_DIVERGENCE_EVERY > 0): the
+            # fingerprint rides the SAME executable — emit_fp is part of
+            # the cache key and registry.policy_key, so a flip is one
+            # recompile and steady-state compiles stay flat
+            return new_w, new_s, _fingerprint(new_w + new_s)
         return new_w, new_s
 
     return jax.jit(fused, donate_argnums=(0, 2))
 
 
 def _build_guarded(rule, static, mp_flags, out_dtypes, scaler_cfg,
-                   plan=None, zflags=None):
+                   plan=None, zflags=None, emit_fp=False):
     """The guarded twin of :func:`_build`: same donated whole-model update,
     plus (inside the SAME jit, so the guard costs no extra dispatches or
     host syncs) the fused finite flag, the global grad norm, the skip-step
@@ -735,6 +774,12 @@ def _build_guarded(rule, static, mp_flags, out_dtypes, scaler_cfg,
             new_streak = jnp.where(ok & grow, 0, streak2)
         else:
             new_scale, new_streak = scale, streak
+        if emit_fp:
+            # same-executable divergence fingerprint as _build: the skip
+            # select already ran, so a skipped step fingerprints the
+            # UNTOUCHED buffers — replicas agree on skips too
+            return (new_w, new_s, (new_scale, new_streak, new_t), ok,
+                    grad_norm, _fingerprint(new_w + new_s))
         return new_w, new_s, (new_scale, new_streak, new_t), ok, grad_norm
 
     # gstate is NOT donated: the scale scalar is aliased by user code
@@ -765,9 +810,17 @@ class FusedUpdater(Updater):
         self.health = resilience.StepHealth()
         self.last_step_ok = None
         self.last_grad_norm = None
+        # divergence sentinel (MXTPU_DIVERGENCE_EVERY > 0): the latest
+        # fused step's (f32 sum, i32 fold) fingerprint as async device
+        # scalars — compared per-replica by resilience.DivergenceSentinel
+        # at check cadence, never fetched in the hot loop
+        self.last_fingerprint = None
         self._t_good = None     # device good-step count (guarded mode)
         self._noscaler_state = None  # cached (1.0, 0) scalars, never donated
         self._step_count = 0    # dispatched update_batch calls (fault index)
+        # step index -> owning trace id (bounded): the poison-batch
+        # quarantine attributes skipped steps back to their step traces
+        self._step_traces = collections.OrderedDict()
         self._plan = None       # MeshPlan (Trainer(mesh=...) sets it)
 
     def _guard_active(self):
@@ -826,6 +879,14 @@ class FusedUpdater(Updater):
         opt = self.optimizer
         step_idx = self._step_count
         self._step_count += 1
+        # step -> trace attribution (bounded): Trainer.step roots a trace
+        # per step (ISSUE 10); recording the owning id here lets the
+        # poison-batch quarantine name the offending batches' traces
+        ctx = telemetry.current_trace()
+        if ctx is not None:
+            self._step_traces[step_idx] = ctx.trace_id
+            while len(self._step_traces) > 4096:
+                self._step_traces.popitem(last=False)
         if grads and resilience.inject("nan_grad", step_idx):
             # poison ONE gradient buffer — pure data, no retrace, and it
             # flows through the exact production sentinel path
@@ -850,6 +911,7 @@ class FusedUpdater(Updater):
             self._guarded_step(rule, fused, eager, step_idx)
             return
         self.last_step_ok = None  # unguarded steps report no verdict
+        self.last_fingerprint = None  # _fused_apply re-emits when enabled
         if fused and eager and isinstance(opt, Nadam):
             # Nadam's m_schedule is ORDER-dependent host state (one multiply
             # per param update): a mixed batch must keep the exact eager
@@ -908,6 +970,7 @@ class FusedUpdater(Updater):
             fn = telemetry.record_retrace(
                 "fused_optimizer",
                 {"optimizer": key[0], "guard": "guard" in key,
+                 "divergence": "div" in key,
                  "n_params": len(key[2]), "mesh": key[3] is not None,
                  "policy_key": list(policy_key())},
                 compiled=build())
@@ -933,13 +996,23 @@ class FusedUpdater(Updater):
          specs, zflags) = self._gather_items(items, hyper_of)
         static = rule.static(opt)
         plan = self._plan
+        # divergence-sentinel bit: emitting the fingerprint changes the
+        # traced program, so it rides the cache key (and policy_key) the
+        # way the guard bit does — a cadence flip is one recompile
+        emit_fp = resilience.divergence_every() > 0
         key = (type(opt).__name__, static, specs,
-               plan.fingerprint() if plan else None)
+               plan.fingerprint() if plan else None) \
+            + (("div",) if emit_fp else ())
         fn = self._cached_jit(
             key, lambda: _build(rule, static, mp_flags, out_dtypes,
-                                plan, zflags))
-        new_w, new_s = fn(w_datas, g_datas, s_datas, hypers,
-                          float(opt.rescale_grad))
+                                plan, zflags, emit_fp))
+        out = fn(w_datas, g_datas, s_datas, hypers,
+                 float(opt.rescale_grad))
+        if emit_fp:
+            new_w, new_s, self.last_fingerprint = out
+        else:
+            new_w, new_s = out
+            self.last_fingerprint = None
         FUSED_STATS["fused_steps"] += 1
         telemetry.inc("fused_optimizer.steps")
         for (i, _, w), nw, ns in zip(items, new_w, new_s):
@@ -991,7 +1064,9 @@ class FusedUpdater(Updater):
         else:
             # all-eager guarded step: the flag must reach the host anyway
             # (it gates the eager updates); bookkeeping mirrors the in-jit
-            # rule, device math stays async
+            # rule, device math stays async. The divergence fingerprint is
+            # a fused-path feature — no stale value may survive here.
+            self.last_fingerprint = None
             ok = bool(jnp.isfinite(sq_e))  # the documented eager sync
             grad_norm = jnp.sqrt(sq_e) * (
                 jnp.float32(float(opt.rescale_grad)) / scale_used)
@@ -1033,16 +1108,24 @@ class FusedUpdater(Updater):
             items, lambda i: (float(opt._get_lr(i)), float(opt._get_wd(i))))
         static = rule.static(opt)
         plan = self._plan
-        # the guard bit + scaler policy ride the cache key: guard on/off is
-        # exactly one extra compile, flag/scale flips are zero
+        emit_fp = resilience.divergence_every() > 0
+        # the guard bit + scaler policy + divergence bit ride the cache
+        # key: each flip is exactly one extra compile, flag/scale flips
+        # are zero
         key = (type(opt).__name__, static, specs,
-               plan.fingerprint() if plan else None, "guard", scfg)
+               plan.fingerprint() if plan else None, "guard", scfg) \
+            + (("div",) if emit_fp else ())
         fn = self._cached_jit(
             key, lambda: _build_guarded(rule, static, mp_flags, out_dtypes,
-                                        scfg, plan, zflags))
-        new_w, new_s, new_gstate, ok, grad_norm = fn(
-            w_datas, g_datas, s_datas, hypers, float(opt.rescale_grad),
-            gstate, ext_sq)
+                                        scfg, plan, zflags, emit_fp))
+        out = fn(w_datas, g_datas, s_datas, hypers,
+                 float(opt.rescale_grad), gstate, ext_sq)
+        if emit_fp:
+            new_w, new_s, new_gstate, ok, grad_norm, \
+                self.last_fingerprint = out
+        else:
+            new_w, new_s, new_gstate, ok, grad_norm = out
+            self.last_fingerprint = None
         FUSED_STATS["fused_steps"] += 1
         telemetry.inc("fused_optimizer.steps")
         for (i, _, w), nw, ns in zip(items, new_w, new_s):

@@ -38,15 +38,20 @@ import collections
 import json
 import logging
 import os
+import random as _pyrandom
 import signal
 import time
 
 from .base import MXNetError
 
 __all__ = ["guard_enabled", "default_loss_scale", "ckpt_retries",
-           "DynamicLossScaler", "StepHealth", "CheckpointPolicy",
-           "ResilientLoop", "inject", "reset_faults", "with_retries",
-           "FAULT_STATS", "ResourceExhausted", "maybe_oom"]
+           "ckpt_keep", "divergence_every", "train_step_timeout_x",
+           "poison_streak", "DynamicLossScaler", "StepHealth",
+           "CheckpointPolicy", "ResilientLoop", "inject", "reset_faults",
+           "with_retries", "FAULT_STATS", "ResourceExhausted", "maybe_oom",
+           "TrainWedgeError", "TrainStepWatchdog", "DivergenceError",
+           "DivergenceSentinel", "PoisonBatchError", "SupervisorRefusal",
+           "TrainSupervisor"]
 
 _log = logging.getLogger("mxtpu.resilience")
 
@@ -74,6 +79,47 @@ def ckpt_retries():
     return int(os.environ.get("MXTPU_CKPT_RETRIES", "3"))  # graftlint: disable=policy-key-coverage
 
 
+def ckpt_keep():
+    """Checkpoint retention depth (MXTPU_CKPT_KEEP, default 0 = keep
+    everything): ``save_trainer`` garbage-collects finalized step
+    directories older than the newest N INTACT ones. A mid-write step
+    (async save not finalized) and a tombstoned (known-corrupt) step
+    never count toward the keepers, so the newest restorable checkpoint
+    survives even at N=1. Host-side IO policy — nothing traced."""
+    return int(os.environ.get("MXTPU_CKPT_KEEP", "0") or "0")  # graftlint: disable=policy-key-coverage
+
+
+def divergence_every():
+    """Cross-replica divergence-sentinel cadence (MXTPU_DIVERGENCE_EVERY,
+    default 0 = off). Non-zero compiles a cheap per-shard fingerprint of
+    the post-update params + optimizer state (f32 sum + int32
+    bitcast-fold) into the SAME donated fused-update executable — the
+    on/off bit is trace-time, so it is mirrored in ``registry.policy_key``
+    and the update-jit cache key (a flip is at most one recompile; the
+    cadence VALUE only changes how often the host compares). The compare
+    itself runs host-side off the async fingerprint scalars
+    (:class:`DivergenceSentinel` / ``TrainingHealthMonitor``), adding
+    zero hot-loop syncs."""
+    return int(os.environ.get("MXTPU_DIVERGENCE_EVERY", "0") or "0")
+
+
+def train_step_timeout_x():
+    """Step-wedge watchdog multiplier (MXTPU_TRAIN_STEP_TIMEOUT_X, default
+    0 = off): a Trainer.step still armed past ``baseline * X`` (rolling
+    median step time) trips the wedge path — flight artifact + loud
+    failure. Host-side deadline policy — nothing traced."""
+    return float(os.environ.get("MXTPU_TRAIN_STEP_TIMEOUT_X", "0") or "0")  # graftlint: disable=policy-key-coverage
+
+
+def poison_streak():
+    """Poison-batch quarantine threshold (MXTPU_POISON_STREAK, default
+    0 = off): this many CONSECUTIVE sentinel-skipped steps escalate from
+    a log line to a quarantine in ``TrainingHealthMonitor`` (bounded ring
+    of offending steps + trace ids, flight artifact, raise-or-continue
+    policy). Host-side monitor policy — nothing traced."""
+    return int(os.environ.get("MXTPU_POISON_STREAK", "0") or "0")  # graftlint: disable=policy-key-coverage
+
+
 # ----------------------------------------------------------- fault injection
 # fired: [(kind, index), ...] in firing order — tests assert the schedule
 FAULT_STATS = {"fired": []}
@@ -96,7 +142,18 @@ def _parse_faults(spec):
     watchdog quarantines the replica and re-dispatches the batch once),
     ``oom`` (occurrence index across the Trainer.step / Predictor
     dispatch / decode-loop call sites: :func:`maybe_oom` raises a
-    :class:`ResourceExhausted` there, exercising the OOM flight path)."""
+    :class:`ResourceExhausted` there, exercising the OOM flight path),
+    ``train_wedge`` (Trainer.step index: that step's watchdog entry never
+    disarms — the wedge scan trips, dumps ``flight_record("train_wedge")``
+    and fails loud), ``ckpt_corrupt`` (save-attempt index: the saved
+    updater blob's bytes are flipped AFTER the checksum manifest is
+    computed, so restore verification fails exactly like real disk
+    corruption and the tiered fallback engages), ``divergence``
+    (divergence-check index: one fetched per-replica fingerprint shard is
+    perturbed host-side, exercising the mismatch dump + raise),
+    ``supervisor_crash`` (supervisor attempt index: a clean child exit is
+    treated as a crash, driving the respawn/backoff/refusal matrix
+    without a real failing subprocess)."""
     faults = {}
     for part in spec.split(";"):
         part = part.strip()
@@ -178,15 +235,337 @@ def maybe_oom(index=None):
             "(injected fault kind 'oom')")
 
 
+# ------------------------------------------------------- step-wedge watchdog
+class TrainWedgeError(MXNetError):
+    """A Trainer.step stayed armed past its wedge deadline (a collective
+    that never completes, a dead chip under the dispatch). By the time
+    this raises, the flight artifact (``flight_record("train_wedge")`` —
+    per-thread stacks, the step's trace_id, the executable ledger and
+    per-device memory view) is already on disk."""
+
+
+class TrainStepWatchdog:
+    """Per-step wedge watchdog for the training loop — the serving
+    dispatch watchdog's discipline (mxtpu/serving/replicas.py) applied to
+    ``Trainer.step``: every step dispatch is bracketed by an armed entry
+    whose deadline derives from a ROLLING baseline of observed step
+    times (``median * timeout_x``, floored at ``min_timeout_s``), so the
+    bound tracks the workload instead of demanding a magic constant. A
+    run that wedges in a collective currently hangs forever with no
+    artifact; with the watchdog attached the trip dumps a flight record
+    and fails loud.
+
+    Drive it either way:
+
+    * ``start_monitor()`` — an off-thread scan every ``interval``; a trip
+      dumps the artifact, bumps ``train.wedges``, and poisons the
+      watchdog so the NEXT arm/disarm on the training thread raises
+      :class:`TrainWedgeError` (the monitor cannot raise into a thread
+      blocked inside a device call — if that thread never returns, the
+      artifact + log IS the loud failure, exactly the real-wedge story).
+    * ``poll()`` — synchronous scan that raises on a trip; with an
+      injected ``clock`` the whole matrix tests sleep-free in tier-1.
+
+    Fault kind ``train_wedge@step`` marks the step's entry as held (its
+    dispatch "never returns"): ``disarm`` leaves it armed, the clock
+    advances, and the scan trips — no real hang, no sleeps.
+
+    The bracket is pure host bookkeeping (a clock read and a list append
+    per step): the ``trainer.step`` d2h==0 and retrace-flat contracts
+    hold with the watchdog attached (pinned in tests)."""
+
+    def __init__(self, timeout_x=None, min_timeout_s=1.0, window=32,
+                 min_samples=3, clock=None):
+        self.timeout_x = train_step_timeout_x() if timeout_x is None \
+            else float(timeout_x)
+        self.min_timeout_s = float(min_timeout_s)
+        self.min_samples = int(min_samples)
+        self._durations = collections.deque(maxlen=int(window))
+        self._clock = time.monotonic if clock is None else clock
+        import threading
+        self._lock = threading.Lock()
+        self._entries = []
+        self._tripped = None   # first tripped entry: poisons arm/disarm
+        self._monitor = None
+        self._monitor_stop = None
+
+    # ------------------------------------------------------------- baseline
+    def baseline(self):
+        """Rolling median of completed step times (None until
+        ``min_samples`` — the first steps include compiles and must not
+        set the bound)."""
+        with self._lock:
+            if len(self._durations) < self.min_samples:
+                return None
+            vals = sorted(self._durations)
+        return vals[len(vals) // 2]
+
+    def deadline_s(self):
+        base = self.baseline()
+        if base is None or self.timeout_x <= 0:
+            return None
+        return max(base * self.timeout_x, self.min_timeout_s)
+
+    # ------------------------------------------------------------- bracket
+    def arm(self, step, trace_id=None):
+        """Arm one step's entry (call right before the dispatch). During
+        warmup (no baseline yet) the entry carries no deadline — it still
+        measures, it cannot trip."""
+        self._check_poisoned()
+        now = self._clock()
+        bound = self.deadline_s()
+        entry = {"step": int(step), "trace_id": trace_id, "t0": now,
+                 "deadline": None if bound is None else now + bound,
+                 "bound_s": bound, "tripped": False,
+                 # injected wedge: this dispatch "never returns" — disarm
+                 # leaves the entry armed for the scan to trip, sleep-free
+                 "held": inject("train_wedge", step)}
+        with self._lock:
+            self._entries.append(entry)
+        return entry
+
+    def disarm(self, entry):
+        """Close the bracket (finally-block of the step). Records the
+        observed duration into the rolling baseline; raises if this entry
+        (or the watchdog) tripped while the step ran."""
+        now = self._clock()
+        with self._lock:
+            if entry["held"]:
+                return  # simulated non-return: stays armed for the scan
+            if entry in self._entries:
+                self._entries.remove(entry)
+                if not entry["tripped"]:
+                    self._durations.append(now - entry["t0"])
+        self._check_poisoned()
+
+    # --------------------------------------------------------------- scans
+    def poll(self):
+        """Synchronous wedge scan — the fake-clock test drive (and usable
+        from any sideline thread). Raises :class:`TrainWedgeError` on a
+        trip, after the flight artifact is written."""
+        tripped = self._scan()
+        if tripped:
+            raise TrainWedgeError(self._describe(tripped[0]))
+
+    def _scan(self):
+        now = self._clock()
+        tripped = []
+        with self._lock:
+            for e in self._entries:
+                if e["deadline"] is not None and not e["tripped"] \
+                        and now > e["deadline"]:
+                    e["tripped"] = True
+                    tripped.append(e)
+            for e in tripped:
+                self._entries.remove(e)
+        for e in tripped:
+            self._trip(e, now)
+        return tripped
+
+    def _describe(self, e):
+        return ("training step %d wedged: no completion within %.3fs "
+                "(rolling baseline x %.1f); flight artifact dumped "
+                "(reason=train_wedge)"
+                % (e["step"], e["bound_s"] or -1.0, self.timeout_x))
+
+    def _trip(self, e, now):
+        from . import telemetry, xprof
+        self._tripped = e
+        telemetry.inc("train.wedges")
+        # resolve-free ledger + per-device memory: the post-mortem view
+        # of what was resident/compiled when the step stopped answering —
+        # never invoke the compiler or block on the (possibly dead)
+        # device from the trip path
+        mem = {}
+        try:
+            import jax
+            for i, d in enumerate(jax.devices()):
+                mem["d%d" % i] = xprof.device_memory(d)
+        except Exception:  # noqa: BLE001 — a wedged backend still dumps
+            pass
+        telemetry.flight_record(
+            "train_wedge",
+            trace_ids=[e["trace_id"]] if e["trace_id"] else [],
+            extra={"step": e["step"], "elapsed_s": now - e["t0"],
+                   "bound_s": e["bound_s"], "timeout_x": self.timeout_x,
+                   "baseline_s": self.baseline(),
+                   "ledger": xprof.ledger_snapshot(), "memory": mem})
+        _log.error("%s", self._describe(e))
+
+    def _check_poisoned(self):
+        e = self._tripped
+        if e is not None:
+            raise TrainWedgeError(self._describe(e))
+
+    # -------------------------------------------------------------- monitor
+    def start_monitor(self, interval_s=0.25):
+        """Off-thread wedge scan (idempotent). Real-clock deployments use
+        this; fake-clock tests drive :meth:`poll` instead. The thread
+        holds only a WEAK reference to the watchdog: a replaced/dropped
+        watchdog is collectable and its orphaned monitor exits at the
+        next tick instead of scanning a dead object forever."""
+        import threading
+        import weakref
+        if self._monitor is not None and self._monitor.is_alive():
+            return self
+        stop = threading.Event()
+        wref = weakref.ref(self)
+
+        def loop():
+            while not stop.wait(interval_s):
+                wd = wref()
+                if wd is None:
+                    return  # the watchdog was dropped: die with it
+                try:
+                    wd._scan()
+                except Exception:  # noqa: BLE001 — scan must never die
+                    _log.exception("train-wedge monitor scan failed")
+                del wd  # the loop must not pin the watchdog between ticks
+        t = threading.Thread(target=loop, daemon=True,
+                             name="mxtpu-train-wedge-monitor")
+        self._monitor = t
+        self._monitor_stop = stop
+        t.start()
+        return self
+
+    def stop_monitor(self):
+        if self._monitor_stop is not None:
+            self._monitor_stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=2.0)
+        self._monitor = None
+        self._monitor_stop = None
+
+
+# --------------------------------------------------- divergence sentinel
+class DivergenceError(MXNetError):
+    """Per-replica fingerprints of the (logically replicated) params +
+    optimizer state disagree — a silent corruption forked the fleet. The
+    flight artifact (``flight_record("divergence")``) carries every
+    replica's fingerprint view."""
+
+
+class DivergenceSentinel:
+    """Host-side comparator for the in-jit divergence fingerprint.
+
+    With ``MXTPU_DIVERGENCE_EVERY`` > 0 the fused update jit emits a
+    cheap fingerprint of the post-update params + optimizer state (one
+    f32 sum + one int32 bitcast-fold — the fold catches sign/NaN-payload
+    flips a float sum can absorb) as replicated device scalars. XLA
+    materializes a replicated output on EVERY device from that device's
+    operands, so a replica whose supposedly-replicated buffers silently
+    diverged computes a different copy. :meth:`check` fetches the
+    per-device copies off the async scalars (``addressable_shards`` —
+    the ``step_ok`` discipline: nothing in the hot loop, one bounded
+    fetch at check cadence) and compares them bitwise. ZeRO-1 keeps the
+    optimizer state as exactly ONE sharded copy per replica
+    (arXiv:2004.13336), so this is the only watcher that state has.
+
+    Fault kind ``divergence@i`` perturbs one fetched shard before the
+    compare, exercising the dump + raise tier deterministically on any
+    device count."""
+
+    def __init__(self, logger=None):
+        self._log = logger or _log
+        self.checks = 0
+
+    @staticmethod
+    def _shard_views(arr):
+        import numpy as np
+        try:
+            shards = sorted(((s.device.id, np.asarray(s.data))
+                             for s in arr.addressable_shards),
+                            key=lambda t: t[0])
+            if shards:
+                return shards
+        except Exception:  # noqa: BLE001 — not a jax.Array (eager numpy)
+            pass
+        return [(0, np.asarray(arr))]
+
+    def check(self, fingerprint, step=None, trace_ids=()):
+        """Compare every replica's copy of the fingerprint scalars; True
+        when they agree (or there is nothing to compare). SYNCS on the
+        fingerprint scalars — call at check cadence, never per step."""
+        from . import telemetry
+        if fingerprint is None:
+            return True
+        telemetry.inc("resilience.divergence_checks")
+        self.checks += 1
+        views = []  # per component: [(device_id, bytes), ...]
+        for comp in fingerprint:
+            views.append([(d, v.tobytes())
+                          for d, v in self._shard_views(comp)])
+        if inject("divergence"):
+            # a synthetic replica whose fingerprint copy disagrees —
+            # appending (not replacing) keeps the injection meaningful on
+            # a single-device tier too
+            views[-1].append((-1, b"\xde\xad\xbe\xef"))
+        ok = all(len({b for _, b in comp}) <= 1 for comp in views)
+        if ok:
+            return True
+        detail = {"step": step,
+                  "fingerprints": [[(d, b.hex()) for d, b in comp]
+                                   for comp in views]}
+        telemetry.flight_record("divergence", trace_ids=list(trace_ids),
+                                extra=detail)
+        msg = ("cross-replica divergence: per-device fingerprints of the "
+               "replicated params/optimizer state disagree%s — a silent "
+               "corruption forked the fleet; flight artifact dumped "
+               "(reason=divergence). Restore from the last intact "
+               "checkpoint." % ("" if step is None else " at check %s"
+                                % step))
+        self._log.error("%s", msg)
+        raise DivergenceError(msg)
+
+
+class PoisonBatchError(MXNetError):
+    """``MXTPU_POISON_STREAK`` consecutive sentinel-skipped steps: the
+    data (or a corrupt shard of it) is poisoning every step, not a
+    transient overflow. The quarantine ring and flight artifact carry the
+    offending step indices and their trace ids."""
+
+
 # ------------------------------------------------------------------- retries
+# Per-process jitter source: seeded from the pid so every process in a
+# fleet draws a DIFFERENT backoff sequence (the whole point of the
+# jitter), while a test passing its own seeded ``rng`` stays bit-level
+# deterministic. Resolved lazily PER PID — an import-time module global
+# would be copied into fork-started workers, handing the whole fleet one
+# identical schedule (exactly the herd the jitter exists to prevent).
+_BACKOFF = {"pid": None, "rng": None}
+
+
+def _process_rng():
+    pid = os.getpid()
+    if _BACKOFF["pid"] != pid:
+        _BACKOFF["pid"] = pid
+        _BACKOFF["rng"] = _pyrandom.Random(pid * 2654435761 + 17)
+    return _BACKOFF["rng"]
+
+
+def _next_backoff(rng, base, prev, cap):
+    """Decorrelated-jitter exponential backoff (the AWS pattern): the next
+    delay is uniform in [base, 3*prev], capped. Unlike plain exponential
+    backoff — where every client that failed at t=0 retries at exactly
+    t+base, t+3*base, ... — the draws de-synchronize a fleet whose
+    kvstore/checkpoint backend just flapped, so the retries cannot arrive
+    as a thundering herd."""
+    return min(cap, rng.uniform(base, max(base, prev * 3.0)))
+
+
 def with_retries(fn, what, retries=None, backoff=0.25, logger=None,
-                 exceptions=(Exception,), metric=None):
+                 exceptions=(Exception,), metric=None, sleeper=None,
+                 rng=None, max_backoff=None):
     """Run ``fn`` with bounded retry-with-backoff on transient failures.
 
     Used by the checkpoint driver and the kvstore's DCN reduce. Retries
-    ``retries`` times (default :func:`ckpt_retries`) with exponential
-    backoff starting at ``backoff`` seconds; the last failure re-raises so
-    hard errors stay loud.
+    ``retries`` times (default :func:`ckpt_retries`); the last failure
+    re-raises so hard errors stay loud. The first retry waits exactly
+    ``backoff`` seconds; later waits use decorrelated jitter
+    (:func:`_next_backoff`, capped at ``max_backoff``, default
+    ``64*backoff``) so fleet-wide retries against one flapping backend
+    cannot synchronize into a thundering herd. ``sleeper``/``rng`` are
+    injectable: tests run sleep-free and bit-deterministic.
 
     Every retry counts into the telemetry registry: ``retry.total``
     always, plus the caller's stable ``metric`` name (``what`` often
@@ -196,6 +575,9 @@ def with_retries(fn, what, retries=None, backoff=0.25, logger=None,
     from . import telemetry
     retries = ckpt_retries() if retries is None else int(retries)
     retries = max(0, retries)  # a negative budget must still run fn once
+    sleeper = time.sleep if sleeper is None else sleeper
+    rng = _process_rng() if rng is None else rng
+    cap = backoff * 64.0 if max_backoff is None else float(max_backoff)
     delay = backoff
     for attempt in range(retries + 1):
         try:
@@ -209,8 +591,8 @@ def with_retries(fn, what, retries=None, backoff=0.25, logger=None,
             (logger or _log).warning(
                 "%s failed (%s: %s); retry %d/%d in %.2fs", what,
                 type(e).__name__, e, attempt + 1, retries, delay)
-            time.sleep(delay)
-            delay *= 2
+            sleeper(delay)
+            delay = _next_backoff(rng, backoff, delay, cap)
 
 
 # --------------------------------------------------------------- loss scaler
@@ -557,14 +939,18 @@ class ResilientLoop:
         return ackpt.latest_step(self._policy.directory)
 
     def resume(self):
-        """Restore the newest checkpoint into the trainer (params +
+        """Restore the newest INTACT checkpoint into the trainer (params +
         optimizer + scaler + RNG, bit-exact) and return the step index to
-        continue FROM (0 on a fresh directory)."""
+        continue FROM (0 on a fresh directory). Tiered: a step whose
+        checksum manifest does not verify (or whose restore errors) is
+        tombstoned and the next-newest finalized step is tried —
+        ``checkpoint.restore_fallbacks{reason}`` counts every tier
+        crossed (``contrib.async_checkpoint.load_trainer_fallback``)."""
         from .contrib import async_checkpoint as ackpt
-        step = self.latest_step()
+        step = ackpt.load_trainer_fallback(self._trainer,
+                                           self._policy.directory)
         if step is None:
             return 0
-        ackpt.load_trainer(self._trainer, self._policy.directory, step=step)
         self._step = step + 1
         self._last_save_step = step
         self._log.info("resumed from checkpoint step %d", step)
@@ -602,3 +988,133 @@ class ResilientLoop:
                 if self.after_step(step):
                     break
         return last
+
+
+# ------------------------------------------------------ crash-resume driver
+class SupervisorRefusal(MXNetError):
+    """The supervisor will not respawn: either the same checkpoint step
+    crashed twice in a row (a deterministic poison-crash — restarting
+    replays it forever) or the crash-loop budget is spent. The message is
+    the diagnosis."""
+
+
+class TrainSupervisor:
+    """Crash-resume supervisor around a training entrypoint (the CLI
+    front door is ``tools/train_supervisor.py``).
+
+    Respawns the child on a nonzero exit with decorrelated-jitter
+    exponential backoff (:func:`_next_backoff` — a fleet of supervisors
+    must not re-stampede a recovering storage/coordinator backend) under
+    a crash-loop budget (``MXTPU_SUPERVISOR_RESTARTS``). The child is
+    expected to resume itself from the integrity-verified newest intact
+    checkpoint (``ResilientLoop.resume`` — tombstoned/corrupt steps are
+    already skipped by the tiered restore); the supervisor reads the same
+    ``latest_step`` view per attempt to DIAGNOSE: a crash at the same
+    checkpoint step as the previous crash means resuming cannot help
+    (poison-crash — a batch or code path that deterministically kills
+    the process past the numerics sentinel), so it refuses with that
+    diagnosis instead of flapping forever; crashes with checkpoint
+    progress in between are transient and respawn.
+
+    ``spawn``/``clock``/``sleeper``/``rng`` are injectable so the whole
+    loop tests sleep-free and subprocess-free in tier-1. Fault kind
+    ``supervisor_crash@attempt`` turns that attempt's clean exit into a
+    simulated crash."""
+
+    def __init__(self, argv, ckpt_dir=None, max_restarts=None,
+                 backoff_s=None, max_backoff_s=60.0, spawn=None,
+                 clock=None, sleeper=None, rng=None, logger=None):
+        self.argv = list(argv)
+        if not self.argv:
+            raise MXNetError("TrainSupervisor needs a non-empty command")
+        self.ckpt_dir = ckpt_dir
+        # host-side supervisor policy, nothing traced
+        if max_restarts is None:
+            max_restarts = os.environ.get("MXTPU_SUPERVISOR_RESTARTS", "8")  # graftlint: disable=policy-key-coverage
+        if backoff_s is None:
+            backoff_s = os.environ.get("MXTPU_SUPERVISOR_BACKOFF_S", "2.0")  # graftlint: disable=policy-key-coverage
+        self.max_restarts = int(max_restarts)
+        self.backoff_s = float(backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self._spawn = self._default_spawn if spawn is None else spawn
+        self._clock = time.monotonic if clock is None else clock
+        self._sleeper = time.sleep if sleeper is None else sleeper
+        self._rng = rng  # None -> the per-pid fleet rng, resolved at use
+        self._log = logger or _log
+        self.restarts = 0
+        self.history = []  # [(attempt, exit_code, resume_step, delay_s)]
+
+    @staticmethod
+    def _default_spawn(argv):
+        import subprocess
+        return subprocess.call(argv)
+
+    def _latest(self):
+        """The newest INTACT checkpoint step (tombstoned/unfinalized steps
+        excluded — the same view the child's tiered resume uses), or None
+        without a checkpoint directory / on a fresh one."""
+        if self.ckpt_dir is None:
+            return None
+        from .contrib import async_checkpoint as ackpt
+        try:
+            return ackpt.latest_step(self.ckpt_dir)
+        except Exception:  # noqa: BLE001 — a broken dir reads as fresh
+            return None
+
+    def run(self):
+        """Drive the child until a clean exit (returns 0) or a refusal
+        (:class:`SupervisorRefusal` with the diagnosis)."""
+        from . import telemetry
+        delay = self.backoff_s
+        prev_crash_step = ()  # sentinel: no crash observed yet
+        attempt = 0
+        while True:
+            resume_step = self._latest()
+            self._log.info(
+                "supervisor: launching attempt %d (resume step %s): %s",
+                attempt, resume_step, " ".join(self.argv))
+            rc = self._spawn(self.argv)
+            reason = "crash"
+            if rc == 0:
+                if inject("supervisor_crash", attempt):
+                    rc, reason = 1, "injected"
+                else:
+                    self._log.info("supervisor: clean exit after %d "
+                                   "restart(s)", self.restarts)
+                    return 0
+            crash_step = self._latest()
+            self.history.append((attempt, rc, crash_step, delay))
+            # the poison test needs a real progress SIGNAL: with no
+            # checkpoint dir (or before the first checkpoint ever lands)
+            # crash_step is None on every attempt — indistinguishable
+            # crashes must stay "transient" under the budget, not
+            # misdiagnose as a deterministic poison-crash after one try
+            if crash_step is not None and crash_step == prev_crash_step:
+                raise SupervisorRefusal(
+                    "the child crashed twice at checkpoint step %s with "
+                    "ZERO progress in between (exit code %d) — this is a "
+                    "deterministic poison-crash (a batch/code path that "
+                    "kills the process on replay), not a transient fault "
+                    "(those advance the checkpoint between crashes). "
+                    "Refusing to respawn: inspect the flight artifacts "
+                    "and quarantine ring for the poisoned step before "
+                    "restarting by hand." % (crash_step, rc))
+            if self.restarts >= self.max_restarts:
+                raise SupervisorRefusal(
+                    "crash-loop budget spent: %d restarts "
+                    "(MXTPU_SUPERVISOR_RESTARTS) with the child still "
+                    "dying (last exit code %d, last checkpoint step %s) "
+                    "— refusing to flap further" %
+                    (self.restarts, rc, crash_step))
+            prev_crash_step = crash_step
+            self.restarts += 1
+            attempt += 1
+            telemetry.inc("supervisor.restarts", tag=reason)
+            self._log.warning(
+                "supervisor: child exited %d (checkpoint step %s); "
+                "respawn %d/%d in %.2fs", rc, crash_step, self.restarts,
+                self.max_restarts, delay)
+            self._sleeper(delay)
+            delay = _next_backoff(self._rng or _process_rng(),
+                                  self.backoff_s, delay,
+                                  self.max_backoff_s)

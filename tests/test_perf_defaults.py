@@ -17,15 +17,19 @@ def _clean_env(monkeypatch):
                 "MXTPU_FUSED_OPTIMIZER", "MXTPU_PALLAS_CONV",
                 "MXTPU_PALLAS_CONV_INTERPRET", "MXTPU_S2D_STEM",
                 "MXTPU_NUMERICS_GUARD", "MXTPU_LOSS_SCALE",
-                "MXTPU_FAULT_INJECT", "MXTPU_CKPT_RETRIES"):
+                "MXTPU_FAULT_INJECT", "MXTPU_CKPT_RETRIES",
+                "MXTPU_DIVERGENCE_EVERY", "MXTPU_TRAIN_STEP_TIMEOUT_X",
+                "MXTPU_POISON_STREAK", "MXTPU_CKPT_KEEP"):
         monkeypatch.delenv(var, raising=False)
 
 
 def test_policy_key_defaults_are_the_measured_best():
     from mxtpu.ops.registry import policy_key
     # (conv_acc, bn_onepass, ring_flash, flash_pad_d, im2col, rnn_hoist,
-    #  pallas_conv, pallas_conv_interpret, s2d_stem, numerics_guard)
-    assert policy_key() == ("0", "1", "0", "1", "0", "1", "0", "0", "0", "0")
+    #  pallas_conv, pallas_conv_interpret, s2d_stem, numerics_guard,
+    #  divergence_every)
+    assert policy_key() == ("0", "1", "0", "1", "0", "1", "0", "0", "0",
+                            "0", "0")
 
 
 def test_read_sites_mirror_policy_key():
@@ -51,13 +55,21 @@ def test_read_sites_mirror_policy_key():
 
 def test_numerics_guard_and_loss_scale_defaults():
     """The resilience levers' env defaults, pinned like every other lever:
-    guard off, initial loss scale 2**15, 3 checkpoint retries, no faults."""
+    guard off, initial loss scale 2**15, 3 checkpoint retries, no faults,
+    and the ISSUE-14 survivability levers all opt-in (0 = off)."""
     import mxtpu.resilience as res
     assert res.guard_enabled() is False
     assert res.default_loss_scale() == 2.0 ** 15
     assert res.ckpt_retries() == 3
     assert res.DynamicLossScaler().config() == (2.0, 0.5, 2000, 2.0 ** 24,
                                                 1.0)
+    # survivability layer (ISSUE 14): every piece is opt-in — a default
+    # flipping here changes the hot path (divergence bakes into the
+    # update jit) or deletes checkpoints (keep), so it must be a decision
+    assert res.divergence_every() == 0
+    assert res.train_step_timeout_x() == 0.0
+    assert res.poison_streak() == 0
+    assert res.ckpt_keep() == 0
 
 
 def test_guard_overhead_bench_emits_the_benchline_schema(monkeypatch):

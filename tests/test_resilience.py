@@ -670,3 +670,57 @@ def test_with_retries_backs_off_and_reraises():
         raise OSError("hard failure")
     with pytest.raises(OSError, match="hard failure"):
         resilience.with_retries(hard, "t", retries=1, backoff=0.001)
+
+
+def test_with_retries_decorrelated_jitter_deterministic():
+    """ISSUE-14 satellite: the backoff is decorrelated-jittered (first
+    wait exactly ``backoff``, then uniform in [base, 3*prev], capped) off
+    an injectable sleeper+rng — a seeded run is bit-deterministic and
+    sleep-free, different seeds de-synchronize (the anti-thundering-herd
+    point), and the retry/reraise contract above is unchanged."""
+    import random
+
+    def run(seed, n_fail=5):
+        delays = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] <= n_fail:
+                raise OSError("transient")
+            return "ok"
+        out = resilience.with_retries(flaky, "t", retries=n_fail,
+                                      backoff=0.25,
+                                      sleeper=delays.append,
+                                      rng=random.Random(seed))
+        assert out == "ok"
+        return delays
+
+    a = run(7)
+    assert a[0] == 0.25  # deterministic floor for the first retry
+    for prev, d in zip(a, a[1:]):
+        assert 0.25 <= d <= max(0.25, prev * 3.0)  # decorrelated bounds
+    assert all(d <= 0.25 * 64 for d in a)          # cap
+    assert run(7) == a        # seeded => bit-deterministic
+    assert run(8) != a        # fleet members draw different schedules
+
+
+def test_new_fault_kinds_consume_once(monkeypatch):
+    """ISSUE-14 satellite: the survivability fault kinds ride inject()'s
+    consume-once-per-(kind,index) semantics like every other kind."""
+    monkeypatch.setenv(
+        "MXTPU_FAULT_INJECT",
+        "train_wedge@2;ckpt_corrupt@0;divergence@1;supervisor_crash@0")
+    assert resilience.inject("train_wedge", 1) is False
+    assert resilience.inject("train_wedge", 2) is True
+    assert resilience.inject("train_wedge", 2) is False   # consumed
+    assert resilience.inject("ckpt_corrupt") is True      # counter-indexed
+    assert resilience.inject("ckpt_corrupt") is False
+    assert resilience.inject("divergence", 0) is False
+    assert resilience.inject("divergence", 1) is True
+    assert resilience.inject("divergence", 1) is False
+    assert resilience.inject("supervisor_crash", 0) is True
+    assert resilience.inject("supervisor_crash", 0) is False
+    assert resilience.FAULT_STATS["fired"] == [
+        ("train_wedge", 2), ("ckpt_corrupt", 0), ("divergence", 1),
+        ("supervisor_crash", 0)]

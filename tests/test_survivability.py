@@ -1,0 +1,712 @@
+"""Survivable training (ISSUE 14): the training half's failure matrix —
+
+* step-wedge watchdog: a wedged Trainer.step trips a rolling-baseline
+  deadline, dumps flight_record("train_wedge") with the step's trace_id
+  + per-thread stacks + ledger/memory view, and fails LOUD — all on a
+  fake clock, sleep-free (fault kind ``train_wedge``);
+* checkpoint integrity + tiered restore: save_trainer writes a per-blob
+  crc manifest, restore verifies BEFORE committing, a corrupt newest
+  step is tombstoned and resume falls back bit-exact to the older
+  intact step (fault kind ``ckpt_corrupt``; real on-disk byte flips too);
+  retention GC never deletes the newest intact step;
+* cross-replica divergence sentinel: the fused update jit emits a
+  fingerprint compiled into the SAME executable (compiles stay flat,
+  d2h stays 0); an injected divergent shard view dumps
+  flight_record("divergence") and raises;
+* poison-batch quarantine: MXTPU_POISON_STREAK consecutive skips ring
+  the offending steps + trace ids, flight-record, and raise/continue;
+* crash-resume supervisor: jittered respawns under a budget, poison
+  (same-step-twice) refusal diagnosis — subprocess- and sleep-free.
+"""
+import glob
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import optimizer_fused as of
+from mxtpu import resilience, telemetry
+from mxtpu.contrib import async_checkpoint as ackpt
+from mxtpu.gluon.parameter import Parameter
+from mxtpu.gluon.trainer import Trainer
+from mxtpu.monitor import TrainingHealthMonitor
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for var in ("MXTPU_NUMERICS_GUARD", "MXTPU_FAULT_INJECT",
+                "MXTPU_DIVERGENCE_EVERY", "MXTPU_TRAIN_STEP_TIMEOUT_X",
+                "MXTPU_POISON_STREAK", "MXTPU_CKPT_KEEP",
+                "MXTPU_FLIGHT_DIR", "MXTPU_FLIGHT_MAX",
+                "MXTPU_SUPERVISOR_RESTARTS", "MXTPU_SUPERVISOR_BACKOFF_S"):
+        monkeypatch.delenv(var, raising=False)
+    telemetry.reset()
+    resilience.reset_faults()
+    of.reset()
+    yield
+    telemetry.reset()
+    resilience.reset_faults()
+    of.reset()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _make_trainer(n_params=3, shape=(5,), optimizer="sgd", opt_params=None,
+                  seed=0):
+    rng = np.random.RandomState(seed)
+    params = []
+    for j in range(n_params):
+        p = Parameter("sv%d" % j, shape=shape, dtype="float32")
+        p.initialize()
+        p.data()._set_data(mx.nd.array(
+            rng.uniform(-1, 1, shape).astype(np.float32))._data)
+        params.append(p)
+    opt_params = opt_params or {"learning_rate": 0.05, "momentum": 0.9}
+    tr = Trainer(params, optimizer, opt_params, kvstore=None)
+    return tr, params, rng
+
+
+def _set_grads(params, rng, scale=1.0):
+    for p in params:
+        p.grad()[:] = mx.nd.array(
+            (rng.randn(*p.shape) * scale).astype(np.float32))
+
+
+def _counter(name):
+    v = telemetry.snapshot()["counters"].get(name, 0)
+    return sum(v.values()) if isinstance(v, dict) else v
+
+
+def _artifacts(tmp_path, reason):
+    return sorted(glob.glob(os.path.join(str(tmp_path),
+                                         "flight_%s_*" % reason)))
+
+
+# ------------------------------------------------------ step-wedge watchdog
+def test_watchdog_baseline_and_deadline():
+    clk = FakeClock()
+    wd = resilience.TrainStepWatchdog(timeout_x=5.0, min_timeout_s=0.0,
+                                      min_samples=3, clock=clk)
+    assert wd.deadline_s() is None  # warmup: nothing to derive from
+    for i in range(4):
+        e = wd.arm(i)
+        assert e["deadline"] is None or i >= 3
+        clk.advance(0.1)
+        wd.disarm(e)
+    # rolling median of 0.1s durations x 5.0
+    assert wd.baseline() == pytest.approx(0.1)
+    assert wd.deadline_s() == pytest.approx(0.5)
+    # the floor guards against a too-tight baseline
+    wd2 = resilience.TrainStepWatchdog(timeout_x=5.0, min_timeout_s=2.0,
+                                       min_samples=1, clock=clk)
+    e = wd2.arm(0)
+    clk.advance(0.01)
+    wd2.disarm(e)
+    assert wd2.deadline_s() == 2.0
+
+
+def test_wedged_step_trips_dumps_and_fails_loud(tmp_path, monkeypatch):
+    """ISSUE-14 acceptance (a): a fake-clock run wedges a step — the trip
+    dumps a flight artifact carrying the step's trace_id and per-thread
+    stacks, bumps train.wedges, poll() raises, and the NEXT step on the
+    (poisoned) watchdog refuses too. Sleep-free."""
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "train_wedge@3")
+    clk = FakeClock()
+    wd = resilience.TrainStepWatchdog(timeout_x=5.0, min_timeout_s=1.0,
+                                      min_samples=1, clock=clk)
+    tr, params, rng = _make_trainer()
+    tr.attach_step_watchdog(wd)
+    for _ in range(2):  # healthy steps build the baseline
+        _set_grads(params, rng)
+        tr.step(1)
+    _set_grads(params, rng)
+    tr.step(1)  # seq 3: the injected wedge — its entry stays armed
+    upd = tr._updaters[0]
+    wedged_trace = upd._step_traces[upd._step_count - 1]
+    clk.advance(100.0)
+    with pytest.raises(resilience.TrainWedgeError, match="step 3 wedged"):
+        wd.poll()
+    assert _counter("train.wedges") == 1
+    arts = _artifacts(tmp_path, "train_wedge")
+    assert len(arts) == 1
+    snap = json.load(open(arts[0]))
+    assert snap["trace_ids"] == [wedged_trace]
+    assert snap["threads"] and any(s["stack"] for s in snap["threads"])
+    assert "ledger" in snap["extra"] and "memory" in snap["extra"]
+    assert snap["extra"]["step"] == 3
+    # the watchdog is poisoned: the training thread's next step fails loud
+    _set_grads(params, rng)
+    with pytest.raises(resilience.TrainWedgeError):
+        tr.step(1)
+
+
+def test_watchdog_monitor_lifecycle():
+    wd = resilience.TrainStepWatchdog(timeout_x=5.0)
+    assert wd.start_monitor(0.01) is wd
+    assert wd.start_monitor(0.01) is wd  # idempotent
+    assert wd._monitor is not None and wd._monitor.is_alive()
+    wd.stop_monitor()
+    assert wd._monitor is None
+
+
+def test_trainer_env_wires_watchdog(monkeypatch):
+    monkeypatch.setenv("MXTPU_TRAIN_STEP_TIMEOUT_X", "10")
+    tr, _, _ = _make_trainer()
+    assert tr._step_watchdog is not None
+    assert tr._step_watchdog.timeout_x == 10.0
+    tr._step_watchdog.stop_monitor()
+    monkeypatch.delenv("MXTPU_TRAIN_STEP_TIMEOUT_X")
+    tr2, _, _ = _make_trainer()
+    assert tr2._step_watchdog is None
+
+
+# ------------------------------------------------------ divergence sentinel
+def test_divergence_fingerprint_emitted_and_deterministic(monkeypatch):
+    monkeypatch.setenv("MXTPU_DIVERGENCE_EVERY", "1")
+
+    def run():
+        tr, params, rng = _make_trainer(seed=4)
+        for _ in range(2):
+            _set_grads(params, rng)
+            tr.step(1)
+        fp = tr._updaters[0].last_fingerprint
+        assert fp is not None
+        return (float(fp[0]), int(fp[1]))
+    a, b = run(), run()
+    assert a == b  # pure function of the (identical) training state
+    # and it moves when the state moves
+    tr, params, rng = _make_trainer(seed=4)
+    for _ in range(3):
+        _set_grads(params, rng)
+        tr.step(1)
+    fp3 = tr._updaters[0].last_fingerprint
+    assert (float(fp3[0]), int(fp3[1])) != a
+
+
+def test_divergence_check_cadence_via_monitor(monkeypatch):
+    monkeypatch.setenv("MXTPU_DIVERGENCE_EVERY", "2")
+    tr, params, rng = _make_trainer()
+    mon = TrainingHealthMonitor(interval=100).install(tr)
+    assert mon.divergence_every == 2  # env default picked up
+    for _ in range(5):
+        _set_grads(params, rng)
+        tr.step(1)
+        mon.after_step()
+    assert mon._sentinel.checks == 2  # after steps 2 and 4
+    assert _counter("resilience.divergence_checks") == 2
+
+
+def test_injected_divergence_dumps_and_raises(tmp_path, monkeypatch):
+    """ISSUE-14 acceptance (c): a divergent shard fingerprint view dumps
+    flight_record("divergence") and raises."""
+    monkeypatch.setenv("MXTPU_DIVERGENCE_EVERY", "1")
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path))
+    tr, params, rng = _make_trainer()
+    mon = TrainingHealthMonitor(interval=100).install(tr)
+    _set_grads(params, rng)
+    tr.step(1)
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "divergence@0")
+    with pytest.raises(resilience.DivergenceError, match="divergence"):
+        mon.after_step()
+    arts = _artifacts(tmp_path, "divergence")
+    assert len(arts) == 1
+    snap = json.load(open(arts[0]))
+    assert snap["extra"]["fingerprints"]  # every replica's view rides along
+
+
+def test_divergence_skipped_step_fingerprint_unchanged(monkeypatch):
+    """A sentinel-skipped step is a no-op on params AND state — its
+    fingerprint must be bit-identical to the previous step's (replicas
+    agree on skips too)."""
+    monkeypatch.setenv("MXTPU_DIVERGENCE_EVERY", "1")
+    monkeypatch.setenv("MXTPU_NUMERICS_GUARD", "1")
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "nan_grad@1")
+    tr, params, rng = _make_trainer()
+    _set_grads(params, rng)
+    tr.step(1)
+    fp0 = tr._updaters[0].last_fingerprint
+    fp0 = (float(fp0[0]), int(fp0[1]))
+    _set_grads(params, rng)
+    ok = tr.step(1)  # poisoned -> skip
+    assert bool(ok.asnumpy()) is False
+    fp1 = tr._updaters[0].last_fingerprint
+    assert (float(fp1[0]), int(fp1[1])) == fp0
+
+
+def test_divergence_flip_is_one_recompile_and_flat(monkeypatch):
+    """ISSUE-14 acceptance: flipping MXTPU_DIVERGENCE_EVERY on is at most
+    one recompile (cache key + policy key), steady-state compiles flat
+    with the sentinel ON, and guard+divergence compose."""
+    tr, params, rng = _make_trainer()
+    _set_grads(params, rng)
+    tr.step(1)
+    assert of.FUSED_STATS["compiles"] == 1
+    monkeypatch.setenv("MXTPU_DIVERGENCE_EVERY", "4")
+    _set_grads(params, rng)
+    tr.step(1)
+    assert of.FUSED_STATS["compiles"] == 2  # exactly one more
+    traces = of.FUSED_STATS["traces"]
+    for _ in range(3):
+        _set_grads(params, rng)
+        tr.step(1)
+    assert of.FUSED_STATS["traces"] == traces  # flat with the sentinel on
+    assert of.FUSED_STATS["compiles"] == 2
+    # guard on top: one more (guard bit + div bit in one key)
+    monkeypatch.setenv("MXTPU_NUMERICS_GUARD", "1")
+    _set_grads(params, rng)
+    tr.step(1)
+    assert of.FUSED_STATS["compiles"] == 3
+
+
+def test_survivability_stack_keeps_zero_host_sync(monkeypatch):
+    """ISSUE-14 acceptance: trainer.step d2h == 0 with the watchdog AND
+    the divergence sentinel enabled — the bracket is host bookkeeping,
+    the fingerprint is an async output nobody fetches in the loop."""
+    import jax
+    monkeypatch.setenv("MXTPU_DIVERGENCE_EVERY", "1")
+    monkeypatch.setenv("MXTPU_NUMERICS_GUARD", "1")
+    clk = FakeClock()
+    wd = resilience.TrainStepWatchdog(timeout_x=50.0, min_timeout_s=10.0,
+                                      min_samples=1, clock=clk)
+    tr, params, rng = _make_trainer(optimizer="adam",
+                                    opt_params={"learning_rate": 0.01})
+    tr.attach_step_watchdog(wd)
+    _set_grads(params, rng)
+    tr.step(1)  # warmup + compile
+    with jax.transfer_guard_device_to_host("disallow"):
+        for _ in range(3):
+            _set_grads(params, rng)
+            ok = tr.step(1)
+            assert ok is not None
+            clk.advance(0.01)
+    # the verdicts and fingerprint are still there, fetchable off-path
+    assert tr._updaters[0].health.ok_history()[-3:] == [True] * 3
+    assert tr._updaters[0].last_fingerprint is not None
+
+
+# -------------------------------------------- checkpoint integrity + tiers
+def _ckpt_trainer(seed=3):
+    tr, params, _ = _make_trainer(optimizer="adam",
+                                  opt_params={"learning_rate": 0.05},
+                                  seed=seed)
+    return tr, params
+
+
+def _train_and_save(tr, params, d, steps_saves):
+    rng = np.random.RandomState(17)
+    snaps = {}
+    step = 0
+    for save_at in steps_saves:
+        while step < save_at:
+            _set_grads(params, rng)
+            tr.step(1)
+            step += 1
+        ackpt.save_trainer(tr, d, step=save_at)
+        snaps[save_at] = [p.data().asnumpy().copy() for p in params]
+    return snaps
+
+
+def test_save_trainer_writes_crc_manifest(tmp_path):
+    tr, params = _ckpt_trainer()
+    _train_and_save(tr, params, str(tmp_path), [1])
+    meta = ackpt._read_meta(ackpt._step_dir(str(tmp_path), 1))
+    crc = meta["crc"]
+    assert set(crc) == {"p%d" % j for j in range(len(params))} \
+        | {"updater", "rng"}
+    assert all(isinstance(v, int) for v in crc.values())
+
+
+def test_corrupt_newest_falls_back_one_tier_bit_exact(tmp_path):
+    """ISSUE-14 acceptance (b): corrupt the newest checkpoint on disk —
+    restore falls back one tier and resumes BIT-EXACT from the older
+    step; the fallback is counted."""
+    d = str(tmp_path)
+    tr, params = _ckpt_trainer()
+    snaps = _train_and_save(tr, params, d, [1, 3])
+    # flip bytes through every file of the newest step
+    for f in glob.glob(os.path.join(d, "step_3", "**"), recursive=True):
+        if os.path.isfile(f):
+            with open(f, "r+b") as fh:
+                data = bytearray(fh.read())
+                for i in range(0, len(data), 7):
+                    data[i] ^= 0xFF
+                fh.seek(0)
+                fh.write(data)
+    tr2, params2 = _ckpt_trainer(seed=9)  # fresh process stand-in
+    step = ackpt.load_trainer_fallback(tr2, d)
+    assert step == 1
+    for a, b in zip(snaps[1], [p.data().asnumpy() for p in params2]):
+        np.testing.assert_array_equal(a, b)
+    assert _counter("checkpoint.restore_fallbacks") >= 1
+
+
+def test_ckpt_corrupt_fault_exercises_checksum_tier(tmp_path, monkeypatch):
+    """Fault kind ckpt_corrupt: the saved blob's bytes flip after the
+    manifest — verification fails (checksum reason), the step is
+    tombstoned, latest_step skips it, resume lands on the older step."""
+    d = str(tmp_path)
+    tr, params = _ckpt_trainer()
+    snaps = _train_and_save(tr, params, d, [1])
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "ckpt_corrupt@0")
+    _train_and_save(tr, params, d, [3])
+    assert resilience.FAULT_STATS["fired"] == [("ckpt_corrupt", 0)]
+    assert ackpt.latest_step(d) == 3  # not yet known-corrupt
+    tr2, params2 = _ckpt_trainer(seed=9)
+    assert ackpt.load_trainer_fallback(tr2, d) == 1
+    for a, b in zip(snaps[1], [p.data().asnumpy() for p in params2]):
+        np.testing.assert_array_equal(a, b)
+    snap = telemetry.snapshot()["counters"]["checkpoint.restore_fallbacks"]
+    assert snap == {"checksum": 1}
+    # tombstoned: every later scan skips without re-reading the bytes
+    assert os.path.exists(os.path.join(d, "step_3.corrupt.json"))
+    assert ackpt.latest_step(d) == 1
+
+
+def test_verify_happens_before_commit(tmp_path, monkeypatch):
+    """A corrupt restore must never half-overwrite the live trainer:
+    params are bit-identical to pre-restore after the refusal."""
+    d = str(tmp_path)
+    tr, params = _ckpt_trainer()
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "ckpt_corrupt@0")
+    _train_and_save(tr, params, d, [2])
+    tr2, params2 = _ckpt_trainer(seed=9)
+    before = [p.data().asnumpy().copy() for p in params2]
+    with pytest.raises(ackpt.CheckpointCorrupt):
+        ackpt.load_trainer(tr2, d, step=2)
+    for a, b in zip(before, [p.data().asnumpy() for p in params2]):
+        np.testing.assert_array_equal(a, b)
+    assert ackpt.load_trainer_fallback(tr2, d) is None  # nothing intact
+
+
+def test_resilient_loop_resume_uses_tiers(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    tr, params = _ckpt_trainer()
+    loop = resilience.ResilientLoop(
+        tr, resilience.CheckpointPolicy(d, every_steps=100))
+    rng = np.random.RandomState(0)
+    _set_grads(params, rng)
+    tr.step(1)
+    assert loop.save(1) is True
+    loop.wait_for_pending()
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "ckpt_corrupt@0")
+    _set_grads(params, rng)
+    tr.step(1)
+    assert loop.save(4) is True
+    loop.wait_for_pending()
+    monkeypatch.delenv("MXTPU_FAULT_INJECT")
+    resilience.reset_faults()
+    tr2, params2 = _ckpt_trainer(seed=9)
+    loop2 = resilience.ResilientLoop(
+        tr2, resilience.CheckpointPolicy(d, every_steps=100))
+    assert loop2.resume() == 2  # fell back from corrupt step 4 to step 1
+
+
+# ------------------------------------------------------------- retention GC
+def test_gc_retains_keep_newest_intact(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    monkeypatch.setenv("MXTPU_CKPT_KEEP", "2")
+    tr, params = _ckpt_trainer()
+    _train_and_save(tr, params, d, [1, 3, 5, 7])
+    assert ackpt._finalized_steps(d) == [5, 7]
+    assert ackpt.latest_step(d) == 7
+    # sidecars of deleted steps are gone too
+    assert not glob.glob(os.path.join(d, "step_1.*"))
+
+
+def test_gc_keep1_never_deletes_newest_intact(tmp_path, monkeypatch):
+    """Satellite: KEEP=1 with the latest save mid-write or known-corrupt
+    must keep the newest INTACT step."""
+    d = str(tmp_path)
+    tr, params = _ckpt_trainer()
+    _train_and_save(tr, params, d, [1])
+    # (a) latest is known-corrupt (tombstoned): step 3 saved corrupt,
+    # restore tombstones it, then a KEEP=1 GC pass runs on the NEXT save
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setenv("MXTPU_FAULT_INJECT", "ckpt_corrupt@0")
+        _train_and_save(tr, params, d, [3])
+    resilience.reset_faults()
+    tr2, _ = _ckpt_trainer(seed=9)
+    assert ackpt.load_trainer_fallback(tr2, d) == 1  # tombstones step 3
+    monkeypatch.setenv("MXTPU_CKPT_KEEP", "1")
+    deleted = ackpt._gc_steps(d, 1)
+    assert deleted == []  # step 1 IS the newest intact: survives
+    assert ackpt.latest_step(d) == 1
+    # (b) latest save mid-write: a sidecar with no finalized dir — the
+    # newest finalized step stays the keeper
+    ackpt._write_meta(ackpt._step_dir(d, 9), {"kind": "trainer"})
+    assert ackpt._gc_steps(d, 1) == []
+    assert ackpt.latest_step(d) == 1
+
+
+def test_force_resave_clears_tombstone_and_manifest(tmp_path, monkeypatch):
+    """Satellite: overwrite + force=True writes a FRESH manifest and
+    clears the step's tombstone — the re-saved bytes verify again."""
+    d = str(tmp_path)
+    tr, params = _ckpt_trainer()
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "ckpt_corrupt@0")
+    _train_and_save(tr, params, d, [2])
+    resilience.reset_faults()
+    monkeypatch.delenv("MXTPU_FAULT_INJECT")
+    tr2, _ = _ckpt_trainer(seed=9)
+    assert ackpt.load_trainer_fallback(tr2, d) is None  # tombstoned
+    # overwrite without force refuses (manifest or not)
+    with pytest.raises(mx.MXNetError, match="force=True"):
+        ackpt.save_trainer(tr, d, step=2)
+    ackpt.save_trainer(tr, d, step=2, force=True)
+    assert not os.path.exists(os.path.join(d, "step_2.corrupt.json"))
+    assert ackpt.latest_step(d) == 2
+    tr3, params3 = _ckpt_trainer(seed=11)
+    assert ackpt.load_trainer_fallback(tr3, d) == 2  # fresh crc verifies
+    for a, b in zip([p.data().asnumpy() for p in params],
+                    [p.data().asnumpy() for p in params3]):
+        np.testing.assert_array_equal(a, b)
+
+
+# --------------------------------------------------- poison-batch quarantine
+def _guarded_trainer(monkeypatch, streak, on_poison="raise"):
+    monkeypatch.setenv("MXTPU_NUMERICS_GUARD", "1")
+    tr, params, rng = _make_trainer()
+    mon = TrainingHealthMonitor(interval=1, poison_streak=streak,
+                                on_poison=on_poison).install(tr)
+    return tr, params, rng, mon
+
+
+def test_poison_streak_quarantines_and_raises(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "nan_grad@2,3")
+    tr, params, rng, mon = _guarded_trainer(monkeypatch, streak=2)
+    with pytest.raises(resilience.PoisonBatchError, match="2 CONSECUTIVE"):
+        for _ in range(5):
+            _set_grads(params, rng)
+            tr.step(1)
+            mon.after_step()
+    assert len(mon.quarantined) == 1
+    entry = mon.quarantined[0]
+    assert entry["steps"] == [2, 3]
+    # trace attribution: the steps' owning trace ids ride the ring + dump
+    traces = tr._updaters[0]._step_traces
+    assert entry["trace_ids"] == [traces[2], traces[3]]
+    arts = _artifacts(tmp_path, "poison_batch")
+    assert len(arts) == 1
+    assert json.load(open(arts[0]))["trace_ids"] == entry["trace_ids"]
+    assert _counter("resilience.poison_quarantines") == 1
+
+
+def test_poison_continue_policy_keeps_training(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "nan_grad@1,2")
+    tr, params, rng, mon = _guarded_trainer(monkeypatch, streak=2,
+                                            on_poison="continue")
+    for _ in range(5):
+        _set_grads(params, rng)
+        tr.step(1)
+        mon.after_step()
+    assert len(mon.quarantined) == 1  # quarantined, run continued
+    assert _counter("resilience.poison_quarantines") == 1
+
+
+def test_poison_streak_resets_on_good_step(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "nan_grad@1,3")  # broken run
+    tr, params, rng, mon = _guarded_trainer(monkeypatch, streak=2)
+    for _ in range(5):
+        _set_grads(params, rng)
+        tr.step(1)
+        mon.after_step()  # never raises: the streak broke at step 2
+    assert len(mon.quarantined) == 0
+
+
+# ------------------------------------------------------ crash-resume driver
+def test_supervisor_respawns_with_jittered_backoff():
+    delays = []
+    exits = iter([1, 1, 0])
+    ck_steps = iter([None, 0, 3, 3, 5])  # progresses between crashes
+    sup = resilience.TrainSupervisor(
+        ["train"], spawn=lambda argv: next(exits),
+        sleeper=delays.append, rng=random.Random(0),
+        backoff_s=1.0, max_restarts=5)
+    sup._latest = lambda: next(ck_steps)
+    assert sup.run() == 0
+    assert sup.restarts == 2
+    assert _counter("supervisor.restarts") == 2
+    assert delays[0] == 1.0
+    assert 1.0 <= delays[1] <= 3.0  # decorrelated jitter bounds
+    # seeded rng => deterministic schedule
+    delays2 = []
+    exits2 = iter([1, 1, 0])
+    ck2 = iter([None, 0, 3, 3, 5])
+    sup2 = resilience.TrainSupervisor(
+        ["train"], spawn=lambda argv: next(exits2),
+        sleeper=delays2.append, rng=random.Random(0),
+        backoff_s=1.0, max_restarts=5)
+    sup2._latest = lambda: next(ck2)
+    sup2.run()
+    assert delays2 == delays
+
+
+def test_supervisor_same_step_twice_refuses_with_poison_diagnosis():
+    """ISSUE-14 acceptance (d): the child crashes twice on the same
+    checkpoint step — the supervisor refuses with the poison-crash
+    diagnosis instead of flapping. Sleep-free (injected sleeper)."""
+    sup = resilience.TrainSupervisor(
+        ["train"], spawn=lambda argv: 1, sleeper=lambda s: None,
+        rng=random.Random(0), backoff_s=0.1, max_restarts=10)
+    sup._latest = lambda: 7
+    with pytest.raises(resilience.SupervisorRefusal,
+                       match="poison-crash") as e:
+        sup.run()
+    assert "step 7" in str(e.value)
+    assert sup.restarts == 1  # one respawn, then the diagnosis
+
+
+def test_supervisor_budget_refusal_and_injected_crash(monkeypatch):
+    steps = iter(range(100))  # always progressing: transient crashes
+    sup = resilience.TrainSupervisor(
+        ["train"], spawn=lambda argv: 1, sleeper=lambda s: None,
+        rng=random.Random(0), backoff_s=0.1, max_restarts=3)
+    sup._latest = lambda: next(steps)
+    with pytest.raises(resilience.SupervisorRefusal, match="crash-loop"):
+        sup.run()
+    assert sup.restarts == 3
+    # fault kind supervisor_crash: a clean exit treated as a crash
+    resilience.reset_faults()
+    telemetry.reset()
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "supervisor_crash@0")
+    steps2 = iter(range(100))
+    sup2 = resilience.TrainSupervisor(
+        ["train"], spawn=lambda argv: 0, sleeper=lambda s: None,
+        rng=random.Random(0), backoff_s=0.1, max_restarts=5)
+    sup2._latest = lambda: next(steps2)
+    assert sup2.run() == 0  # second attempt's clean exit sticks
+    assert sup2.restarts == 1
+    snap = telemetry.snapshot()["counters"]["supervisor.restarts"]
+    assert snap == {"injected": 1}
+
+
+def test_supervisor_reads_intact_checkpoint_view(tmp_path, monkeypatch):
+    """The supervisor's progress signal is the INTACT latest step — a
+    tombstoned newest checkpoint reads as the older step."""
+    d = str(tmp_path)
+    tr, params = _ckpt_trainer()
+    _train_and_save(tr, params, d, [1])
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "ckpt_corrupt@0")
+    _train_and_save(tr, params, d, [3])
+    resilience.reset_faults()
+    tr2, _ = _ckpt_trainer(seed=9)
+    ackpt.load_trainer_fallback(tr2, d)  # tombstones step 3
+    sup = resilience.TrainSupervisor(["train"], ckpt_dir=d)
+    assert sup._latest() == 1
+
+
+def test_supervisor_no_checkpoint_signal_is_transient_not_poison():
+    """Review regression: without a progress signal (no ckpt_dir, or the
+    child dies before the first checkpoint lands) crash_step is None on
+    every attempt — that must run the budget+backoff path, NOT
+    misdiagnose a deterministic poison-crash after one restart."""
+    delays = []
+    sup = resilience.TrainSupervisor(
+        ["train"], ckpt_dir=None, spawn=lambda argv: 1,
+        sleeper=delays.append, rng=random.Random(0), backoff_s=0.1,
+        max_restarts=4)
+    with pytest.raises(resilience.SupervisorRefusal, match="crash-loop"):
+        sup.run()
+    assert sup.restarts == 4 and len(delays) == 4  # budget consumed
+
+
+def test_divergence_cadence_value_not_in_policy_key(monkeypatch):
+    """Review regression: only the ON BIT of MXTPU_DIVERGENCE_EVERY is
+    trace-time — retuning the compare cadence must not invalidate every
+    policy_key-keyed forward/serving executable."""
+    from mxtpu.ops.registry import policy_key
+    monkeypatch.setenv("MXTPU_DIVERGENCE_EVERY", "8")
+    k8 = policy_key()
+    monkeypatch.setenv("MXTPU_DIVERGENCE_EVERY", "16")
+    assert policy_key() == k8  # cadence retune: same executables
+    monkeypatch.delenv("MXTPU_DIVERGENCE_EVERY")
+    assert policy_key() != k8  # the on/off flip IS a policy change
+
+
+def test_attach_step_watchdog_stops_replaced_monitor(monkeypatch):
+    """Review regression: replacing the env-built watchdog must stop its
+    monitor thread (and a dropped watchdog's monitor must not pin it)."""
+    monkeypatch.setenv("MXTPU_TRAIN_STEP_TIMEOUT_X", "10")
+    tr, _, _ = _make_trainer()
+    old = tr._step_watchdog
+    assert old._monitor is not None and old._monitor.is_alive()
+    clk = FakeClock()
+    wd = resilience.TrainStepWatchdog(timeout_x=5.0, clock=clk)
+    tr.attach_step_watchdog(wd)
+    assert old._monitor is None  # replaced => monitor stopped
+    tr.attach_step_watchdog(None)
+
+
+def test_process_rng_reseeds_per_pid(monkeypatch):
+    """Review regression: the fleet jitter rng is resolved per PID at use
+    time, so a fork-started worker draws its OWN schedule instead of a
+    copy of the parent's import-time state."""
+    a = resilience._process_rng()
+    assert resilience._process_rng() is a  # stable within a process
+    real_pid = os.getpid()
+    monkeypatch.setattr(os, "getpid", lambda: real_pid + 12345)
+    b = resilience._process_rng()
+    assert b is not a
+    monkeypatch.setattr(os, "getpid", lambda: real_pid)
+    seq_parent = [resilience._process_rng().uniform(0, 1)
+                  for _ in range(3)]
+    monkeypatch.setattr(os, "getpid", lambda: real_pid + 12345)
+    seq_child = [resilience._process_rng().uniform(0, 1)
+                 for _ in range(3)]
+    assert seq_parent != seq_child  # de-correlated schedules
+
+
+def test_supervisor_cli_clean_child(tmp_path):
+    """The CLI front door: a clean child is one spawn, exit 0."""
+    import sys
+
+    from tools import train_supervisor
+    rc = train_supervisor.main(
+        ["--ckpt-dir", str(tmp_path), "--backoff-s", "0.01", "--",
+         sys.executable, "-c", "import sys; sys.exit(0)"])
+    assert rc == 0
+
+
+# --------------------------------------------------------------- bench gate
+def test_integrity_overhead_bench_schema(monkeypatch):
+    """bench.py's integrity_overhead config emits per-(config, mode) JSON
+    lines plus a serve_bench-style gate summary — the artifact the <2%
+    survivability budget is read from."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import bench
+    assert "integrity_overhead" in bench.CONFIGS
+    monkeypatch.setenv("BENCH_GUARD_PARAMS", "4")
+    monkeypatch.setenv("BENCH_GUARD_PARAM_SIZE", "32")
+    monkeypatch.setenv("BENCH_GUARD_STEPS", "10")
+    monkeypatch.setenv("BENCH_INTEGRITY_ROUNDS", "2")
+    monkeypatch.setenv("BENCH_INTEGRITY_CONFIGS", "optimizer_step")
+    lines = []
+    rec = bench.bench_integrity_overhead(
+        emit=lambda r: lines.append(bench._stamp(r)))
+    assert {"metric", "value", "unit", "vs_baseline", "mfu", "hfu",
+            "gates", "ok"} <= set(rec)
+    assert set(rec["gates"]) == {"overhead_budget", "retrace_flat",
+                                 "divergence_checks", "no_wedges"}
+    # the stack really ran: sentinel checked, compiles flat, no wedges
+    assert rec["gates"]["retrace_flat"] is True
+    assert rec["gates"]["divergence_checks"] is True
+    assert rec["gates"]["no_wedges"] is True
+    assert rec["ok"] is True  # host tier: budget reported, not gating
+    modes = {(l.get("metric"), l.get("integrity")) for l in lines}
+    assert ("integrity_overhead_optimizer_step", "off") in modes
+    assert ("integrity_overhead_optimizer_step", "on") in modes

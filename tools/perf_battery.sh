@@ -99,6 +99,16 @@ timeout 900 env BENCH_CONFIG=telemetry_overhead BENCH_PREFLIGHT=0 \
   python tools/telemetry_report.py "$TELEMETRY_JSONL" --traces 10 --ledger \
     2>&1 | tee -a "$LOG"
 
+# 3c. training survivability overhead phase (ISSUE 14): steps/s with the
+#     full integrity stack on (step-wedge watchdog + divergence sentinel
+#     + health monitor, alternating off/on rounds) vs off — the <2%
+#     guard budget judged on-chip, with a JSON gate summary (overhead
+#     budget, retrace-flat, sentinel-really-checked, zero wedges).
+sleep 60
+timeout 900 env BENCH_CONFIG=integrity_overhead BENCH_PREFLIGHT=0 \
+  python bench.py 2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
+telemetry_report
+
 # 4. multichip scaling phase (ISSUE 7): mesh-native gluon Trainer items/s
 #    per device count (strong scaling, ZeRO-1 on). Only meaningful with
 #    >1 device; on a single chip the check below skips the session. The

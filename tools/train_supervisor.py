@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Crash-resume supervisor CLI (mxtpu.resilience.TrainSupervisor).
+
+Respawns a training entrypoint on nonzero exit with decorrelated-jitter
+exponential backoff under a crash-loop budget, and refuses with a
+diagnosis when the same checkpoint step crashes twice in a row (a
+deterministic poison-crash — respawning would replay it forever). The
+child resumes itself from the integrity-verified newest intact
+checkpoint (ResilientLoop.resume's tiered restore); pass the same
+checkpoint directory here so the supervisor can tell progress (transient
+fault) from no-progress (poison) between crashes::
+
+    python tools/train_supervisor.py --ckpt-dir /ckpt/run1 -- \
+        python train.py --ckpt-dir /ckpt/run1 ...
+
+Exit codes: 0 = the child exited cleanly; 3 = refusal (the diagnosis is
+on stderr: poison-crash or crash-loop budget spent). Knobs:
+MXTPU_SUPERVISOR_RESTARTS (crash-loop budget, default 8) and
+MXTPU_SUPERVISOR_BACKOFF_S (initial backoff, default 2.0), overridable
+by the flags below. Every respawn counts into the telemetry registry as
+``supervisor.restarts{reason}``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="respawn a training entrypoint on crashes, with "
+                    "jittered backoff, a crash-loop budget, and a "
+                    "poison-crash refusal diagnosis")
+    parser.add_argument("--ckpt-dir", default=None,
+                        help="the run's checkpoint directory (the same "
+                             "one the child resumes from) — how the "
+                             "supervisor distinguishes transient crashes "
+                             "(checkpoint advanced) from a poison-crash "
+                             "(same step twice)")
+    parser.add_argument("--max-restarts", type=int, default=None,
+                        help="crash-loop budget (default "
+                             "MXTPU_SUPERVISOR_RESTARTS, 8)")
+    parser.add_argument("--backoff-s", type=float, default=None,
+                        help="initial respawn backoff in seconds (default "
+                             "MXTPU_SUPERVISOR_BACKOFF_S, 2.0); later "
+                             "waits use decorrelated jitter")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="the training entrypoint, after `--`")
+    args = parser.parse_args(argv)
+    cmd = list(args.command)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("no training command given (append: -- <cmd> ...)")
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."))
+    from mxtpu.resilience import SupervisorRefusal, TrainSupervisor
+    sup = TrainSupervisor(cmd, ckpt_dir=args.ckpt_dir,
+                          max_restarts=args.max_restarts,
+                          backoff_s=args.backoff_s)
+    try:
+        return sup.run()
+    except SupervisorRefusal as e:
+        print("train_supervisor: REFUSING to respawn: %s" % e,
+              file=sys.stderr)
+        return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
