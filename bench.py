@@ -1072,6 +1072,41 @@ def bench_serving_slo(emit=None):
     }
 
 
+def bench_startup_time(emit=None):
+    """Persistent compile cache (mxtpu/compile_service.py, ISSUE 15):
+    cold-start vs warm-disk-cache wall time, each scenario in a FRESH
+    python process (the thing measured is process restart): (a) gluon
+    Trainer first completed step, (b) Predictor replica warmup + one
+    served request. Gates: warm compiles == 0 across every retrace site
+    (watchdog-pinned — a disk load is not a compile), warm disk_hits >
+    0, warm wall < cold wall. ``vs_baseline`` is the WORST scenario's
+    cold/warm speedup iff every gate holds, else 0.0."""
+    if emit is None:
+        emit = _emit
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import startup_bench
+
+    rec = startup_bench.run_startup(emit=emit)
+    tr = rec["scenarios"].get("trainer", {})
+    pr = rec["scenarios"].get("predictor", {})
+    return {
+        "metric": "startup_time",
+        "value": round(rec["speedup"], 3),
+        "unit": "warm_vs_cold_speedup",
+        "vs_baseline": round(rec["speedup"], 3) if rec["ok"] else 0.0,
+        "mfu": None,
+        "hfu": None,
+        "trainer_cold_s": tr.get("cold_s"),
+        "trainer_warm_s": tr.get("warm_s"),
+        "trainer_warm_compiles": tr.get("warm_compiles"),
+        "predictor_cold_s": pr.get("cold_s"),
+        "predictor_warm_s": pr.get("warm_s"),
+        "predictor_warm_compiles": pr.get("warm_compiles"),
+        "gates_ok": rec["ok"],
+    }
+
+
 def bench_multichip_resnet(emit=None):
     """Mesh-native Trainer scaling (ISSUE 7): resnet18 data-parallel over
     1..N devices through ``gluon.Trainer(mesh=...)`` with ZeRO-1 on, at a
@@ -1414,6 +1449,7 @@ CONFIGS = {
     "serving": bench_serving,
     "serving_decode": bench_serving_decode,
     "serving_slo": bench_serving_slo,
+    "startup_time": bench_startup_time,
     "multichip_resnet": bench_multichip_resnet,
     "input_pipeline": bench_input_pipeline,
     "sparse_linear": bench_sparse_linear,

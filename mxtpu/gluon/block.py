@@ -383,7 +383,7 @@ class CachedOp:
         self._block = block
         self._params = None       # ordered list, fixed at first build
         self._aux_params = None   # params that may receive aux updates
-        self._jits = {}           # (fmt_key, train) -> (jitted_fn, cell)
+        self._jits = {}  # (fmt_key, train, policy, shapes) -> (fwd, bwd, cell)
 
     def _ensure_params(self):
         if self._params is None:
@@ -394,19 +394,13 @@ class CachedOp:
             self._aux_params = plist  # any may push aux updates; XLA DCEs unused
         return True
 
-    def _get_jit(self, fmt_key, train):
-        from ..ops.registry import policy_key
-        key = (fmt_key, train, policy_key())
-        if key in self._jits:
-            return self._jits[key]
-        # retrace watchdog: every CachedOp cache miss is one compile; the
-        # provenance names the policy levers active at trace time, so a
-        # steady-state recompile (policy env flipped mid-run, unstable
-        # input signature) is attributable from telemetry.report() alone
-        prov = {"block": type(self._block).__name__,
-                "train": train, "policy_key": list(key[2])}
+    def _make_pure(self, train, cell):
+        """The traced forward: one pure function over (rng, inputs,
+        params) regrouping through ``cell``. Factored out so the
+        companion backward can rebuild it even when the forward
+        executable itself was restored from the compile service's disk
+        cache (no live closure to share)."""
         block, params = self._block, self._params
-        cell = {}  # out_fmt discovered at trace time
 
         def pure(rng_key, in_datas, param_datas):
             def body():
@@ -418,37 +412,106 @@ class CachedOp:
             out_fmt = []
             flat_out = _flatten_nd(out, out_fmt)
             cell["out_fmt"] = out_fmt
+            # output avals: the backward's cotangent example signature
+            # (persisted with the entry so a disk-warm process can AOT
+            # the backward without re-tracing the forward)
+            cell["out_specs"] = [(tuple(o._data.shape), str(o._data.dtype))
+                                 for o in flat_out]
             return [o._data for o in flat_out], aux
+
+        return pure
+
+    def _get_jit(self, fmt_key, train, rng_key, in_datas, param_datas):
+        from .. import compile_service as csvc
+        from ..ops.registry import policy_key
+        policy_key_now = policy_key()
+        # input shapes/dtypes join the key: the compile service may hold
+        # a shape-pinned AOT executable (disk-warm start), so a new
+        # input signature must be a new entry — previously jax retraced
+        # internally, invisible to the watchdog
+        shapes = tuple((tuple(d.shape), str(d.dtype)) for d in in_datas)
+        key = (fmt_key, train, policy_key_now, shapes)
+        if key in self._jits:
+            return self._jits[key]
+        # retrace watchdog: every CachedOp cache miss is one compile; the
+        # provenance names the policy levers active at trace time, so a
+        # steady-state recompile (policy env flipped mid-run, unstable
+        # input signature) is attributable from telemetry.report() alone
+        prov = {"block": type(self._block).__name__,
+                "train": train, "policy_key": list(policy_key_now)}
+        block, params = self._block, self._params
+        # stable identity for the disk digest: block class + forward
+        # source hash + parameter structure (an edited model across
+        # restarts must miss, not replay stale code)
+        struct = tuple((p.name, tuple(p._data._data.shape),
+                        str(p._data._data.dtype)) for p in params)
+        fn_id = "cached_op:%s:%s" % (type(block).__name__,
+                                     csvc.source_token(type(block)))
+        dev = csvc.device_token()
+        nonce = csvc.instance_nonce(self)
+        fkey = csvc.canonical_key(
+            site="cached_op", fn_id=fn_id,
+            signature=(fmt_key, train, shapes, struct),
+            policy=policy_key_now, device=dev, nonce=nonce)
+
+        def build():
+            cell = {"in_fmt": list(fmt_key)}
+            return jax.jit(self._make_pure(train, cell)), cell
 
         # ONE retrace count per cache miss (the fwd/bwd pair); the forward
         # executable rides compiled= into the xprof ledger and comes back
         # wrapped (compile wall-time + cost/memory analyses + call count)
-        jitted = telemetry.record_retrace("cached_op", prov,
-                                          compiled=jax.jit(pure))
+        example = csvc.concrete_args((rng_key, in_datas, param_datas))
+        entry = csvc.get_or_build(fkey, build, provenance=prov,
+                                  example_args=example)
+        jitted, cell = entry.fn, entry.meta
 
-        def bwd(rng_key, in_datas, param_datas, out_cots):
-            """Compiled backward: recomputes the forward inside the jit (remat —
-            residuals are traded for FLOPs, the HBM-bandwidth-favourable choice on
-            TPU) and applies the transpose. A separate executable because
-            linearizing *through* a jit boundary breaks for some primitives
-            (reduce_window); vjp fully inside jit is always safe."""
-            n_in = len(in_datas)
+        def build_bwd():
+            pure = self._make_pure(train, cell)
 
-            def f(*diffs):
-                outs, _aux = pure(rng_key, list(diffs[:n_in]),
-                                  list(diffs[n_in:]))
-                return outs[0] if len(outs) == 1 else tuple(outs)
+            def bwd(rng_key, in_datas, param_datas, out_cots):
+                """Compiled backward: recomputes the forward inside the jit
+                (remat — residuals are traded for FLOPs, the
+                HBM-bandwidth-favourable choice on TPU) and applies the
+                transpose. A separate executable because linearizing
+                *through* a jit boundary breaks for some primitives
+                (reduce_window); vjp fully inside jit is always safe."""
+                n_in = len(in_datas)
 
-            _, vjp_fn = jax.vjp(f, *(list(in_datas) + list(param_datas)))
-            return vjp_fn(out_cots)
+                def f(*diffs):
+                    outs, _aux = pure(rng_key, list(diffs[:n_in]),
+                                      list(diffs[n_in:]))
+                    return outs[0] if len(outs) == 1 else tuple(outs)
+
+                _, vjp_fn = jax.vjp(f, *(list(in_datas) + list(param_datas)))
+                return vjp_fn(out_cots)
+
+            return jax.jit(bwd)
 
         # the companion backward shares the site's single retrace count —
-        # ledger-only registration so its FLOPs still feed perf.mfu
-        from .. import xprof
-        jitted_bwd = xprof.watch("cached_op", jax.jit(bwd),
-                                 dict(prov, kind="backward"))
-        self._jits[key] = (jitted, jitted_bwd, cell)
-        return jitted, jitted_bwd, cell
+        # ledger-only registration so its FLOPs still feed perf.mfu. Its
+        # cotangent example comes from the forward's recorded out_specs,
+        # so the backward AOT-compiles (and persists) without waiting for
+        # the first autograd call — but only where a backward is
+        # plausible (train mode): AOT-compiling inference backwards
+        # would pay a compile nobody dispatches.
+        bkey = csvc.canonical_key(
+            site="cached_op", fn_id=fn_id,
+            signature=("bwd", fmt_key, train, shapes, struct),
+            policy=policy_key_now, device=dev, nonce=nonce)
+        bwd_example = None
+        if train and example is not None and cell \
+                and cell.get("out_specs"):
+            specs = cell["out_specs"]
+            cots = [jax.ShapeDtypeStruct(s, d) for s, d in specs]
+            bwd_example = example + (cots[0] if len(cots) == 1
+                                     else tuple(cots),)
+        bentry = csvc.get_or_build(
+            bkey, build_bwd, provenance=dict(prov, kind="backward"),
+            example_args=bwd_example, companion=True,
+            aot=True if bwd_example is not None else None)
+        self._jits[key] = (jitted, bentry.fn, cell)
+        return jitted, bentry.fn, cell
 
     def __call__(self, *args):
         if not self._ensure_params():
@@ -464,11 +527,13 @@ class CachedOp:
             # static (non-NDArray) leaves present: fall back to eager
             return self._block._forward_eager(*args)
         train = autograd.is_training()
-        jitted, jitted_bwd, cell = self._get_jit(tuple(in_fmt), train)
-        cell["in_fmt"] = in_fmt
         rng_key = _random.next_key()
         in_datas = [x._data for x in nd_in]
         param_datas = [p._data._data for p in self._params]
+        jitted, jitted_bwd, cell = self._get_jit(tuple(in_fmt), train,
+                                                 rng_key, in_datas,
+                                                 param_datas)
+        cell["in_fmt"] = in_fmt
 
         with telemetry.span("gluon.forward"):
             out_list, aux = jitted(rng_key, in_datas, param_datas)

@@ -17,8 +17,20 @@ import jax.numpy as jnp
 
 from .registry import register
 
-# subgraph_json -> (symbol, input_names) with a jitted runner
-_SUBGRAPH_CACHE = {}
+class _SymCache(dict):
+    """Parsed-symbol cache (("sym", json) -> Symbol). The jit
+    executables themselves live in :mod:`mxtpu.compile_service`;
+    ``clear()`` drops those too so a test reset forces real
+    recompiles."""
+
+    def clear(self):
+        super().clear()
+        from .. import compile_service
+        compile_service.drop(site="subgraph_exec")
+
+
+# subgraph_json -> parsed symbol; executables live in the compile service
+_SUBGRAPH_CACHE = _SymCache()
 
 
 def _load_sym(subgraph_json):
@@ -31,40 +43,52 @@ def _load_sym(subgraph_json):
 
 
 def _compiled(subgraph_json, input_names, n_outputs):
+    import hashlib
+
+    from .. import compile_service as csvc
     from .registry import policy_key
     # policy_key in the cache key: the sub-symbol executes registered ops
     # whose trace-time gates (BN one-pass, conv accumulate, ...) get baked
-    # into this executable — a lever flip must recompile, not alias
-    key = (subgraph_json, tuple(input_names), policy_key())
-    hit = _SUBGRAPH_CACHE.get(key)
+    # into this executable — a lever flip must recompile, not alias.
+    # The compile service is the cache (LRU-bounded — this dict grew
+    # without limit under partition-JSON churn). aot=False, never
+    # persisted: a partitioned region executes INSIDE an outer executor
+    # trace (tracer inputs), which a deserialized AOT executable cannot
+    # inline — the OUTER executor entry is what the disk cache persists.
+    key = csvc.canonical_key(
+        site="subgraph_exec",
+        fn_id=hashlib.sha1(
+            subgraph_json.encode("utf-8")).hexdigest()[:16],
+        signature=(tuple(input_names), int(n_outputs)),
+        policy=policy_key(), device=csvc.device_token())
+    hit = csvc.get(key)
     if hit is not None:
-        return hit
+        return hit.fn
     from ..ndarray import NDArray
     from .. import autograd
-    from .. import telemetry
 
     # retrace watchdog: one compile per (sub-graph, policy) — steady-state
     # recompiles here mean partition JSON churn or a mid-run policy flip
     prov = {"inputs": list(input_names), "n_outputs": n_outputs,
-            "policy_key": list(key[2])}
+            "policy_key": list(key.policy)}
 
     sym = _load_sym(subgraph_json)
     names = list(input_names)
 
-    def pure(*datas):
-        prev = autograd.set_recording(False)
-        try:
-            feed = {n: NDArray(d) for n, d in zip(names, datas)}
-            outs = sym._execute(feed)
-        finally:
-            autograd.set_recording(prev)
-        res = [o._data for o in outs]
-        return tuple(res) if n_outputs > 1 else res[0]
+    def build():
+        def pure(*datas):
+            prev = autograd.set_recording(False)
+            try:
+                feed = {n: NDArray(d) for n, d in zip(names, datas)}
+                outs = sym._execute(feed)
+            finally:
+                autograd.set_recording(prev)
+            res = [o._data for o in outs]
+            return tuple(res) if n_outputs > 1 else res[0]
 
-    fn = telemetry.record_retrace("subgraph_exec", prov,
-                                  compiled=jax.jit(pure))
-    _SUBGRAPH_CACHE[key] = fn
-    return fn
+        return jax.jit(pure)
+
+    return csvc.get_or_build(key, build, provenance=prov, aot=False).fn
 
 
 @register("_subgraph_exec")

@@ -81,7 +81,22 @@ def fused_enabled():
 # fallback updates
 FUSED_STATS = {"fused_steps": 0, "traces": 0, "compiles": 0,
                "eager_updates": 0}
-_JIT_CACHE = {}
+
+
+class _ServiceCacheView(dict):
+    """Hot-path L1 view over the compile service's ``fused_optimizer``
+    entries: steady-state dispatch is one plain dict hit; misses
+    resolve through :mod:`mxtpu.compile_service` (reporting, disk
+    cache, LRU). ``clear()`` drops the service entries too, so a test
+    reset forces real recompiles instead of silent service hits."""
+
+    def clear(self):
+        super().clear()
+        from . import compile_service
+        compile_service.drop(site="fused_optimizer")
+
+
+_JIT_CACHE = _ServiceCacheView()
 
 
 def cache_size():
@@ -955,28 +970,47 @@ class FusedUpdater(Updater):
         return (w_datas, g_datas, s_datas, hypers, tuple(mp_flags),
                 tuple(out_dtypes), tuple(specs), tuple(zflags))
 
-    @staticmethod
-    def _cached_jit(key, build):
+    def _cached_jit(self, key, build, example_args=None):
         fn = _JIT_CACHE.get(key)
         if fn is None:
             # retrace watchdog (mxtpu/telemetry.py): every executable-cache
             # miss reports its cache-key provenance — optimizer class,
             # guard bit, param count, and the policy levers active now —
             # so a steady-state recompile is attributable without a rerun.
-            # The built jit rides compiled= into the xprof ledger (compile
-            # wall-time, cost-model FLOPs, HBM footprint) and comes back
-            # wrapped — the wrapper IS what the cache holds.
+            # The build resolves through the compile service: the jit
+            # rides compiled= into the xprof ledger and comes back
+            # wrapped — the wrapper IS what both caches hold — and with
+            # MXTPU_COMPILE_CACHE_DIR set the executable persists, so a
+            # restarted trainer's first step loads it with zero compiles.
+            # policy participation: guard/divergence bits ride the key
+            # explicitly (they are the levers this trace consults) — the
+            # FULL policy_key must NOT join it, or every conv/BN lever
+            # flip would needlessly recompile the optimizer step.
+            from . import compile_service as csvc
             from .ops.registry import policy_key
-            fn = telemetry.record_retrace(
-                "fused_optimizer",
-                {"optimizer": key[0], "guard": "guard" in key,
-                 "divergence": "div" in key,
-                 "n_params": len(key[2]), "mesh": key[3] is not None,
-                 "policy_key": list(policy_key())},
-                compiled=build())
-            # bumped only after build() succeeded: a failed trace/compile
-            # must leave compiles == cache size == retrace count
-            FUSED_STATS["compiles"] += 1
+            plan = self._plan
+            ckey = csvc.canonical_key(
+                site="fused_optimizer", fn_id="fused:%s" % key[0],
+                signature=key,
+                sharding=plan.fingerprint() if plan is not None else None,
+                donation=(0, 2),
+                device=csvc.device_token(
+                    mesh=plan.mesh if plan is not None else None))
+            entry = csvc.get_or_build(
+                ckey, build,
+                provenance={"optimizer": key[0], "guard": "guard" in key,
+                            "divergence": "div" in key,
+                            "n_params": len(key[2]),
+                            "mesh": key[3] is not None,
+                            "policy_key": list(policy_key())},
+                example_args=csvc.concrete_args(example_args)
+                if example_args is not None else None)
+            fn = entry.fn
+            if entry.origin == "built":
+                # bumped only after build() succeeded: a failed
+                # trace/compile must leave compiles == retrace count (a
+                # disk-restored executable is a load, not a compile)
+                FUSED_STATS["compiles"] += 1
             _JIT_CACHE[key] = fn
         return fn
 
@@ -1005,7 +1039,9 @@ class FusedUpdater(Updater):
             + (("div",) if emit_fp else ())
         fn = self._cached_jit(
             key, lambda: _build(rule, static, mp_flags, out_dtypes,
-                                plan, zflags, emit_fp))
+                                plan, zflags, emit_fp),
+            example_args=(w_datas, g_datas, s_datas, hypers,
+                          float(opt.rescale_grad)))
         out = fn(w_datas, g_datas, s_datas, hypers,
                  float(opt.rescale_grad))
         if emit_fp:
@@ -1117,7 +1153,9 @@ class FusedUpdater(Updater):
             + (("div",) if emit_fp else ())
         fn = self._cached_jit(
             key, lambda: _build_guarded(rule, static, mp_flags, out_dtypes,
-                                        scfg, plan, zflags, emit_fp))
+                                        scfg, plan, zflags, emit_fp),
+            example_args=(w_datas, g_datas, s_datas, hypers,
+                          float(opt.rescale_grad), gstate, ext_sq))
         out = fn(w_datas, g_datas, s_datas, hypers,
                  float(opt.rescale_grad), gstate, ext_sq)
         if emit_fp:

@@ -93,7 +93,6 @@ class Kernel:
         self._fn = fn
         self.name = name
         self._num_outputs = num_outputs
-        self._compiled = {}
 
     def launch(self, args, out_shapes, out_dtypes=None, grid=None,
                in_specs=None, out_specs=None, interpret=None):
@@ -131,12 +130,35 @@ class Kernel:
                 "kernel %r declared num_outputs=%d but launch got %d "
                 "out_shapes" % (self.name, self._num_outputs,
                                 len(out_shapes)))
-        key = (tuple((a.shape, str(a.dtype)) for a in args),
-               tuple(tuple(s) for s in out_shapes),
-               tuple(str(d) for d in out_dtypes), repr(grid),
-               bool(interpret), repr(in_specs), repr(out_specs))
-        if key not in self._compiled:
-            from . import telemetry
+        from . import compile_service as csvc
+        # the compile service is the cache (LRU-bounded — the old
+        # per-kernel dict was unbounded under launch-signature churn),
+        # keyed by kernel source identity + the full launch signature.
+        # The source digest is memoized: getsource+sha per LAUNCH would
+        # tax the eager-loop use case this API serves
+        fn_id = getattr(self, "_fn_token", None)
+        if fn_id is None:
+            fn_id = self._fn_token = "%s:%s" % (
+                self.name, csvc.source_token(self._fn))
+        datas = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                 for a in args]
+        # a launch nested under an outer trace (tracer inputs) keys a
+        # SEPARATE plain-jit entry: an AOT executable compiled by an
+        # earlier eager launch of the same signature cannot be invoked
+        # with tracers — the variant keeps both worlds correct
+        example = csvc.concrete_args(tuple(datas))
+        key = csvc.canonical_key(
+            site="rtc",
+            fn_id=fn_id,
+            signature=(tuple((tuple(a.shape), str(a.dtype))
+                             for a in args),
+                       tuple(tuple(s) for s in out_shapes),
+                       tuple(str(d) for d in out_dtypes), repr(grid),
+                       bool(interpret), repr(in_specs), repr(out_specs))
+            + (("traced",) if example is None else ()),
+            device=csvc.device_token(), nonce=csvc.instance_nonce(self))
+
+        def build():
             kwargs = {"out_shape": out_shape if n_out > 1 else out_shape[0],
                       "interpret": interpret}
             if grid is not None:
@@ -145,16 +167,17 @@ class Kernel:
                 kwargs["in_specs"] = in_specs
             if out_specs is not None:
                 kwargs["out_specs"] = out_specs
-            call = pl.pallas_call(self._fn, **kwargs)
-            # retrace watchdog: user kernels compile once per launch
-            # signature — a shape-unstable caller shows up here by name
-            self._compiled[key] = telemetry.record_retrace(
-                "rtc", {"kernel": self.name,
-                        "args": [(tuple(a.shape), str(a.dtype))
-                                 for a in args]},
-                compiled=jax.jit(call))
-        res = self._compiled[key](*[a._data if isinstance(a, NDArray)
-                                    else jnp.asarray(a) for a in args])
+            return jax.jit(pl.pallas_call(self._fn, **kwargs))
+
+        # retrace watchdog: user kernels compile once per launch
+        # signature — a shape-unstable caller shows up here by name
+        entry = csvc.get_or_build(
+            key, build,
+            provenance=lambda: {"kernel": self.name,
+                                "args": [(tuple(a.shape), str(a.dtype))
+                                         for a in args]},
+            example_args=example)
+        res = entry.fn(*datas)
         if isinstance(res, (list, tuple)):
             return [NDArray(r) for r in res]
         return NDArray(res)

@@ -541,9 +541,10 @@ class DecodeEngine:
         # tracers): serialize across engines like the Predictor does.
         with _TRACE_LOCK:
             for b in self._decode_spec.decode_slots:
-                self._carry, emitted = self._get_step_jit(b)(
-                    self._carry, self._pred._param_datas,
-                    self._pred._param_ranges)
+                step_args = (self._carry, self._pred._param_datas,
+                             self._pred._param_ranges)
+                self._carry, emitted = self._get_step_jit(
+                    b, example_args=step_args)(*step_args)
                 jax.block_until_ready(emitted[0])
             V = self._vocab
             for s in self._prefill_spec.seq_lens:
@@ -554,9 +555,10 @@ class DecodeEngine:
                 # but retrace inside jax on the first real insert — a
                 # mid-serving compile stall invisible to record_retrace
                 zl = jnp.zeros((1, s, V), logits._data.dtype)
-                self._carry, out = self._get_insert_jit(s)(
-                    self._carry, seq_kv, zl,
-                    np.int32(0), np.int32(1), np.int32(0))
+                ins_args = (self._carry, seq_kv, zl,
+                            np.int32(0), np.int32(1), np.int32(0))
+                self._carry, out = self._get_insert_jit(
+                    s, example_args=ins_args)(*ins_args)
                 jax.block_until_ready(out)
         telemetry.gauge("serving.decode.buckets",
                         len(self._decode_spec.decode_slots)
@@ -593,26 +595,50 @@ class DecodeEngine:
         return (kv, scales, tok, pos, active, rem)
 
     # ------------------------------------------------------------- compiling
-    def _build_jit(self, kind, bucket, build, donate=(0,)):
-        """The one compile front door for the decode cache: every miss is
-        reported to the retrace watchdog at this engine's site
-        (``serving.decode``; graftlint's JIT_ALLOWLIST declares the cache
-        since the site name is per-instance) BEFORE the build, exactly
-        like ``Predictor._get_jit`` — post-warmup the site count stays at
-        #cohort-buckets + #insert-buckets by construction."""
+    def _build_jit(self, kind, bucket, build, donate=(0,),
+                   example_args=None):
+        """The one compile front door for the decode cache: every miss
+        resolves through the compile service (LRU store, disk cache,
+        centralized retrace reporting at this engine's site —
+        ``serving.decode``; graftlint's JIT_ALLOWLIST declares the cache
+        since the site name is per-instance), exactly like
+        ``Predictor._get_jit`` — post-warmup the site count stays at
+        #cohort-buckets + #insert-buckets by construction, and a
+        warm-disk restart reaches it with ZERO compiles."""
+        from .. import compile_service as csvc
         from ..ops.registry import policy_key
-        key = (kind, bucket, self._int8, policy_key())
+        pol = policy_key()
+        key = (kind, bucket, self._int8, pol)
         hit = self._jits.get(key)
         if hit is not None:
             return hit
-        jitted = telemetry.record_retrace(
-            self._site,
-            {"engine": self._name, "kind": kind, "bucket": bucket,
-             "int8": self._int8, "capacity": self._capacity,
-             "max_len": self._max_len, "policy_key": list(key[3])},
-            compiled=jax.jit(build(), donate_argnums=donate))
-        self._jits[key] = jitted
-        return jitted
+        ckey = csvc.canonical_key(
+            site=self._site,
+            fn_id="decode:%s:%s" % (type(self._model).__name__,
+                                    csvc.source_token(type(self._model))),
+            # the predictor's param structure joins the signature: two
+            # models of the same class but different widths (same
+            # kv_layout/vocab) must never alias a disk digest — a
+            # shape-mismatched restore would crash, not degrade
+            signature=(kind, bucket, self._int8, self._capacity,
+                       self._max_len, self._eos,
+                       tuple(self._kv_layout or ()), self._vocab,
+                       tuple((tuple(d.shape), str(d.dtype))
+                             for d in self._pred._param_datas)),
+            policy=pol, donation=donate,
+            device=csvc.device_token(device=self._pred.device),
+            nonce=csvc.instance_nonce(self))
+        entry = csvc.get_or_build(
+            ckey, lambda: jax.jit(build(), donate_argnums=donate),
+            provenance={"engine": self._name, "kind": kind,
+                        "bucket": bucket, "int8": self._int8,
+                        "capacity": self._capacity,
+                        "max_len": self._max_len,
+                        "policy_key": list(pol)},
+            example_args=csvc.concrete_args(example_args)
+            if example_args is not None else None)
+        self._jits[key] = entry.fn
+        return entry.fn
 
     def _kv_read(self, kv, scales, b):
         """The first ``b`` slots' caches in compute dtype (int8:
@@ -653,7 +679,7 @@ class DecodeEngine:
                 new_kv[i] = leaf.at[idx, pos_b].set(row)
         return new_kv, new_scales
 
-    def _get_step_jit(self, b):
+    def _get_step_jit(self, b, example_args=None):
         model, pred = self._model, self._pred
         eos, max_len = self._eos, self._max_len
         engine = self
@@ -692,9 +718,10 @@ class DecodeEngine:
 
             return pure
 
-        return self._build_jit("step", b, build)
+        return self._build_jit("step", b, build,
+                               example_args=example_args)
 
-    def _get_insert_jit(self, s):
+    def _get_insert_jit(self, s, example_args=None):
         """Slot insert for prefill seq bucket ``s``: a device-side
         ``dynamic_update_slice`` of the prompt's KV into a TRACED slot
         index — joining the running cohort never recompiles. Also samples
@@ -732,7 +759,8 @@ class DecodeEngine:
 
             return pure
 
-        return self._build_jit("insert", s, build)
+        return self._build_jit("insert", s, build,
+                               example_args=example_args)
 
     def compile_stats(self):
         """The watchdog's view of this engine's decode-cache compiles."""

@@ -40,8 +40,6 @@ serve exactly like they train.
 """
 from __future__ import annotations
 
-import threading
-
 import jax
 import jax.numpy as jnp
 
@@ -56,7 +54,28 @@ __all__ = ["BucketSpec", "Predictor", "pad_nd", "serve_int8_default"]
 # SHARED Parameter objects — two replicas' Predictors compiling at once
 # (mxtpu/serving/replicas.py spawns one dispatch worker per replica)
 # would race on that binding. Warm-path calls never take this lock.
-_TRACE_LOCK = threading.RLock()
+# Since the compile service this IS the service's central trace lock
+# (one process-wide python-trace discipline; replicas' identical
+# lowerings additionally dedup through the service's group path so N
+# replicas trace once, not N times serialized).
+from .. import compile_service as _csvc
+
+_TRACE_LOCK = _csvc.trace_lock()
+
+
+def _dequant_params(qdtypes, param_datas, param_ranges):
+    """In-trace reconstruction of compute-dtype parameter buffers from
+    the (possibly int8) stored form. Module-level ON PURPOSE: the
+    compile service shares ONE build closure across a ReplicaSet's
+    identical lowerings, and a closure over a predictor instance would
+    pin that replica's device buffers past its retirement. The range is
+    a traced argument: ``refresh_params()`` never recompiles."""
+    if not any(q is not None for q in qdtypes):
+        return list(param_datas)
+    from ..ops.registry import get_op
+    deq = get_op("dequantize").fn  # raw jnp-level op
+    return [d if qdt is None else deq(d, -r, r).astype(qdt)
+            for d, r, qdt in zip(param_datas, param_ranges, qdtypes)]
 
 
 def serve_int8_default():
@@ -441,9 +460,97 @@ class Predictor:
         self._snapshot_params()
 
     # ------------------------------------------------------------ compiling
-    def _get_jit(self, shape_key):
+    def _donation(self):
+        # donate the request buffers (fresh padded arrays) back to XLA —
+        # free memory headroom per in-flight bucket. The CPU backend does
+        # not implement donation and would warn per compile, so gate it.
+        return (0,) if jax.default_backend() != "cpu" else ()
+
+    def _fn_token(self):
+        """Stable block identity for the compile service: class + forward
+        source hash + parameter structure incl. the int8 split (an
+        edited model or a re-quantized storage layout across restarts
+        must miss the disk cache, never replay)."""
+        tok = getattr(self, "_fn_token_cache", None)
+        if tok is None:
+            from .. import compile_service as csvc
+            struct = tuple(
+                (p.name, tuple(d.shape), str(d.dtype), qdt)
+                for p, d, qdt in zip(self._params, self._param_datas,
+                                     self._param_qdtypes))
+            tok = "predictor:%s:%s:%s" % (
+                type(self._block).__name__,
+                csvc.source_token(type(self._block)),
+                csvc.source_token(struct)[:12])
+            self._fn_token_cache = tok
+        return tok
+
+    def _service_key(self, shape_key, pol):
+        from .. import compile_service as csvc
+        return csvc.canonical_key(
+            site=self._site, fn_id=self._fn_token(),
+            signature=(shape_key, self._int8), policy=pol,
+            donation=self._donation(),
+            device=csvc.device_token(device=self._device),
+            nonce=csvc.instance_nonce(self))
+
+    def _group_token(self, shape_key, pol):
+        """Lowering-group token: everything in the service key EXCEPT
+        site/device/nonce — a ReplicaSet's members differ only there, so
+        their buckets share one traced artifact and compile per
+        device."""
+        return ("predict", self._fn_token(), shape_key, self._int8, pol,
+                self._donation())
+
+    def _prov(self, shape_key, pol):
+        return {"predictor": self._name,
+                "block": type(self._block).__name__,
+                "device": str(self._device) if self._device is not None
+                else None,
+                "shapes": [list(s) for s, _ in shape_key],
+                "int8": self._int8,
+                "policy_key": list(pol)}
+
+    def _build_for(self, shape_key):
+        """Build closure for one bucket signature. Closes over the
+        SHARED block/params/qdtypes only — never over this predictor
+        instance — so the compile service can reuse it across a
+        ReplicaSet's identical lowerings without pinning any one
+        replica's device buffers."""
+        block, params = self._block, self._params
+        qdtypes = tuple(self._param_qdtypes or ())
+        fixed_key = jax.random.PRNGKey(0)  # deterministic inference: no
+        # stochastic layers are live under train=False
+        donate = self._donation()
+
+        def build():
+            cell = {}
+
+            def pure(in_datas, param_datas, param_ranges):
+                from ..gluon.block import _flatten_nd, _run_traced
+
+                param_datas = _dequant_params(qdtypes, param_datas,
+                                              param_ranges)
+
+                def body():
+                    return block(*[NDArray(d) for d in in_datas])
+
+                out, _aux = _run_traced(params, param_datas, fixed_key,
+                                        False, body)
+                fmt = []
+                flat = _flatten_nd(out, fmt)
+                cell["out_fmt"] = fmt
+                return [o._data for o in flat]
+
+            return jax.jit(pure, donate_argnums=donate), cell
+
+        return build
+
+    def _get_jit(self, shape_key, example_datas=None):
+        from .. import compile_service as csvc
         from ..ops.registry import policy_key
-        key = (shape_key, policy_key())
+        pol = policy_key()
+        key = (shape_key, pol)
         hit = self._jits.get(key)
         if hit is not None:
             return hit
@@ -453,53 +560,58 @@ class Predictor:
         # here with full provenance). The site name is per-instance so a
         # ReplicaSet member reports at serving.predict.r<i>; the static
         # lint declares this cache via JIT_ALLOWLIST (docs/serving.md).
-        prov = {"predictor": self._name,
-                "block": type(self._block).__name__,
-                "device": str(self._device) if self._device is not None
-                else None,
-                "shapes": [list(s) for s, _ in shape_key],
-                "int8": self._int8,
-                "policy_key": list(key[1])}
-        block, params, pred = self._block, self._params, self
-        fixed_key = jax.random.PRNGKey(0)  # deterministic inference: no
-        # stochastic layers are live under train=False
-        cell = {}
+        example = None
+        if example_datas is not None:
+            example = csvc.concrete_args(
+                (list(example_datas), self._param_datas,
+                 self._param_ranges))
+        entry = csvc.get_or_build(
+            self._service_key(shape_key, pol), self._build_for(shape_key),
+            provenance=self._prov(shape_key, pol), example_args=example,
+            group=self._group_token(shape_key, pol))
+        self._jits[key] = (entry.fn, entry.meta)
+        return self._jits[key]
 
-        def pure(in_datas, param_datas, param_ranges):
-            from ..gluon.block import _flatten_nd, _run_traced
+    def _bucket_datas(self, b, s):
+        datas = [jnp.zeros((b,) + self._bucket_trailing(t, s), dt)
+                 for t, dt in self._templates]
+        return self._place(datas)
 
-            param_datas = pred._traced_params(param_datas, param_ranges)
-
-            def body():
-                return block(*[NDArray(d) for d in in_datas])
-
-            out, _aux = _run_traced(params, param_datas, fixed_key, False,
-                                    body)
-            fmt = []
-            flat = _flatten_nd(out, fmt)
-            cell["out_fmt"] = fmt
-            return [o._data for o in flat]
-
-        # donate the request buffers (fresh padded arrays) back to XLA —
-        # free memory headroom per in-flight bucket. The CPU backend does
-        # not implement donation and would warn per compile, so gate it.
-        donate = (0,) if jax.default_backend() != "cpu" else ()
-        jitted = telemetry.record_retrace(
-            self._site, prov, compiled=jax.jit(pure, donate_argnums=donate))
-        self._jits[key] = (jitted, cell)
-        return jitted, cell
-
-    def warmup(self):
-        """AOT-compile every bucket in the spec (zero-filled template
-        inputs, one blocking call each). Returns self. Idempotent: warm
-        buckets are cache hits."""
+    def warmup_entries(self):
+        """The declared AOT warmup set: one compile-service entry per
+        bucket, group-tagged so identical replicas share the trace. A
+        ReplicaSet collects every member's entries into ONE concurrent
+        ``compile_service.warmup`` call."""
         if self._templates is None:
             raise MXNetError("Predictor.warmup needs input templates: pass "
                              "example= at construction")
+        from .. import compile_service as csvc
+        from ..ops.registry import policy_key
+        pol = policy_key()
+        entries = []
         for b, s in self._spec.buckets():
-            datas = [jnp.zeros((b,) + self._bucket_trailing(t, s), dt)
-                     for t, dt in self._templates]
-            flat, _ = self._run_padded(datas)
+            datas = self._bucket_datas(b, s)
+            shape_key = tuple((tuple(d.shape), str(d.dtype))
+                              for d in datas)
+            entries.append(csvc.WarmupEntry(
+                key=self._service_key(shape_key, pol),
+                build=self._build_for(shape_key),
+                example_args=(datas, self._param_datas,
+                              self._param_ranges),
+                provenance=self._prov(shape_key, pol),
+                group=self._group_token(shape_key, pol)))
+        return entries
+
+    def finish_warmup(self):
+        """Adopt warmed entries into the instance cache by DISPATCHING
+        each bucket once (zero-filled templates, blocking) — the
+        executables are already compiled (service hits), so these are
+        pure replays, but a model that compiles yet cannot EXECUTE on
+        this device (HBM exhausted by workspace allocation) must fail
+        here, at startup, not on the first live request. Closes with
+        the gauges and the memory pre-flight."""
+        for b, s in self._spec.buckets():
+            flat, _ = self._run_padded(self._bucket_datas(b, s))
             jax.block_until_ready([o._data for o in flat])
         telemetry.gauge("serving.buckets", len(self._spec))
         # will-it-fit pre-flight over the freshly-warmed bucket
@@ -511,6 +623,15 @@ class Predictor:
                         device=self._device if self._device is not None
                         else 0)
         return self
+
+    def warmup(self):
+        """AOT-compile every bucket in the spec through the compile
+        service — concurrent lowers/compiles on the service pool, disk
+        hits cost zero compiles. Returns self. Idempotent: warm buckets
+        are cache hits."""
+        from .. import compile_service as csvc
+        csvc.warmup(self.warmup_entries())
+        return self.finish_warmup()
 
     def _bucket_trailing(self, trailing, seq):
         if seq is None:
@@ -527,7 +648,7 @@ class Predictor:
         """Dispatch already-bucket-shaped jax arrays; returns (flat output
         NDArrays at bucket batch, cell)."""
         shape_key = tuple((tuple(d.shape), str(d.dtype)) for d in datas)
-        jitted, cell = self._get_jit(shape_key)
+        jitted, cell = self._get_jit(shape_key, example_datas=datas)
         from .. import resilience, xprof
         try:
             resilience.maybe_oom()
@@ -638,13 +759,8 @@ class Predictor:
         own pure fns and the DecodeEngine's step/insert jits (which run
         against the same stored buffers). The range is a traced argument:
         a ``refresh_params()`` re-quantization never recompiles."""
-        qdtypes = self._param_qdtypes or ()
-        if not any(q is not None for q in qdtypes):
-            return list(param_datas)
-        from ..ops.registry import get_op
-        deq = get_op("dequantize").fn  # raw jnp-level op
-        return [d if qdt is None else deq(d, -r, r).astype(qdt)
-                for d, r, qdt in zip(param_datas, param_ranges, qdtypes)]
+        return _dequant_params(tuple(self._param_qdtypes or ()),
+                               param_datas, param_ranges)
 
     def compile_stats(self):
         """The watchdog's view of THIS predictor's compiles — its own

@@ -229,10 +229,20 @@ class ReplicaSet:
         return {}
 
     def warmup(self):
-        """AOT-compile every bucket on every replica (serial — tracing
-        binds the shared block's parameters). Returns self."""
+        """AOT-compile every bucket on every replica through the compile
+        service's shared-lowering path: identical lowerings carry one
+        group token, so the shared block traces ONCE per bucket (not
+        once per replica serialized behind the trace lock) and each
+        replica's executables compile for its own device, concurrently
+        on the service pool — and load from a warm
+        ``MXTPU_COMPILE_CACHE_DIR`` with zero compiles. Returns self."""
+        from .. import compile_service as csvc
+        entries = []
         for r in self.replicas:
-            r.predictor.warmup()
+            entries.extend(r.predictor.warmup_entries())
+        csvc.warmup(entries)
+        for r in self.replicas:
+            r.predictor.finish_warmup()
         return self
 
     def __len__(self):

@@ -69,7 +69,6 @@ class Executor:
         self._aux_names = aux_names
         self._diff_names = [n for n in arg_names
                             if grad_req.get(n, "null") != "null"]
-        self._jits = {}
         self.outputs = []
         self._monitor = None
         self._replicate_warned = set()
@@ -169,23 +168,57 @@ class Executor:
             arr._set_data(jax.device_put(arr._data,
                                          NamedSharding(mesh, spec)))
 
+    def _fn_token(self):
+        """Stable function identity for the compile service: the symbol
+        graph JSON digested once per executor (the graph IS the
+        program — a code/topology edit across restarts must miss the
+        disk cache)."""
+        tok = getattr(self, "_fn_token_cache", None)
+        if tok is None:
+            import hashlib
+            tok = hashlib.sha1(
+                self._symbol.tojson().encode("utf-8")).hexdigest()[:16]
+            self._fn_token_cache = tok
+        return tok
+
+    def _device_token(self):
+        from jax.sharding import Mesh
+        from .. import compile_service as csvc
+        if isinstance(self._ctx, Mesh):
+            return csvc.device_token(mesh=self._ctx)
+        return csvc.device_token()
+
     def _run_jit(self, feed, is_train):
+        from .. import compile_service as csvc
         from ..ops.registry import policy_key
-        key = (is_train, policy_key()) + tuple(
-            (k, feed[k].shape, str(feed[k].dtype)) for k in sorted(feed))
-        if key not in self._jits:
-            sym = self._symbol
-            names = sorted(feed)
-            # retrace watchdog: every executor cache miss is one compile.
-            # Ragged final predict batches pad to the bound batch size
-            # (BaseModule._pad_batch_to_bound) precisely so this site
-            # stays flat through an epoch tail
-            from .. import telemetry
-            prov = {"is_train": is_train,
+        names = sorted(feed)
+        datas = [feed[n]._data for n in names]
+        # the compile service is the cache (LRU-bounded: this dict was
+        # previously unbounded under shape churn) — one entry per
+        # (symbol graph, train mode, feed signature, policy, device).
+        # A run nested under an outer trace (tracer feed) keys its own
+        # plain-jit variant: an AOT executable from an earlier eager run
+        # of the same signature cannot be invoked with tracers
+        example = csvc.concrete_args((datas,))
+        key = csvc.canonical_key(
+            site="executor", fn_id=self._fn_token(),
+            signature=(is_train,) + tuple(
+                (k, feed[k].shape, str(feed[k].dtype)) for k in names)
+            + (("traced",) if example is None else ()),
+            policy=policy_key(), device=self._device_token(),
+            nonce=csvc.instance_nonce(self))
+        sym = self._symbol
+        # retrace watchdog: every executor cache miss is one compile.
+        # Ragged final predict batches pad to the bound batch size
+        # (BaseModule._pad_batch_to_bound) precisely so this site
+        # stays flat through an epoch tail
+        def prov():   # lazy: materialized only on a real cache miss
+            return {"is_train": is_train,
                     "inputs": [(n, tuple(feed[n].shape)) for n in names
                                if n in getattr(self, "_input_names", ())],
-                    "policy_key": list(key[1])}
+                    "policy_key": list(key.policy)}
 
+        def build():
             def pure(datas):
                 fd = {n: NDArray(d) for n, d in zip(names, datas)}
                 prev = autograd.set_training(is_train)
@@ -201,11 +234,11 @@ class Executor:
                 return ([o._data for o in outs],
                         {k: v._data for k, v in aux_updates.items()})
 
-            # compiled= -> xprof ledger; the cache holds the wrapper
-            self._jits[key] = telemetry.record_retrace(
-                "executor", prov, compiled=jax.jit(pure))
-        out_datas, aux_updates = self._jits[key](
-            [feed[n]._data for n in sorted(feed)])
+            return jax.jit(pure)
+
+        entry = csvc.get_or_build(key, build, provenance=prov,
+                                  example_args=example)
+        out_datas, aux_updates = entry.fn(datas)
         for k, v in aux_updates.items():
             self.aux_dict[k]._set_data(v)
         return [NDArray(d) for d in out_datas]
@@ -229,12 +262,26 @@ class Executor:
             return
         sym = self._symbol
         names = sorted(feed)
+        from .. import compile_service as csvc
         from ..ops.registry import policy_key
-        key = ("bwd", is_train, policy_key()) + tuple(
-            (k, feed[k].shape, str(feed[k].dtype)) for k in names)
-        if key not in self._jits:
-            from .. import telemetry
+        if out_grads is None:
+            cots = [jnp.ones_like(o._data) for o in self.outputs]
+        else:
+            if not isinstance(out_grads, (list, tuple)):
+                out_grads = [out_grads]
+            cots = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                    for g in out_grads]
+        datas = [feed[n]._data for n in names]
+        example = csvc.concrete_args((datas, cots))
+        key = csvc.canonical_key(
+            site="executor.backward", fn_id=self._fn_token(),
+            signature=("bwd", is_train, tuple(diff)) + tuple(
+                (k, feed[k].shape, str(feed[k].dtype)) for k in names)
+            + (("traced",) if example is None else ()),
+            policy=policy_key(), device=self._device_token(),
+            nonce=csvc.instance_nonce(self))
 
+        def build():
             def bwd(datas, cots):
                 def f(diff_datas):
                     full = dict(zip(names, datas))
@@ -253,18 +300,14 @@ class Executor:
                                         for n in diff])
                 return vjp_fn(cots)[0]
 
-            self._jits[key] = telemetry.record_retrace(
-                "executor.backward",
-                {"is_train": is_train, "policy_key": list(key[2])},
-                compiled=jax.jit(bwd))
-        if out_grads is None:
-            cots = [jnp.ones_like(o._data) for o in self.outputs]
-        else:
-            if not isinstance(out_grads, (list, tuple)):
-                out_grads = [out_grads]
-            cots = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
-                    for g in out_grads]
-        grads = self._jits[key]([feed[n]._data for n in names], cots)
+            return jax.jit(bwd)
+
+        entry = csvc.get_or_build(
+            key, build,
+            provenance=lambda: {"is_train": is_train,
+                                "policy_key": list(key.policy)},
+            example_args=example)
+        grads = entry.fn(datas, cots)
         for n, g in zip(diff, grads):
             tgt = self.grad_dict.get(n)
             if tgt is None:

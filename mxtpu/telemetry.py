@@ -904,7 +904,7 @@ def d2h_count():
 
 
 # --------------------------------------------------------- retrace watchdog
-def record_retrace(site, provenance=None, compiled=None):
+def record_retrace(site, provenance=None, compiled=None, compile_s=None):
     """Report one jit-cache compile at ``site`` with its cache-key
     provenance (optimizer class, ``registry.policy_key`` tuple, ...).
     Counts into ``retrace.<site>``; past :func:`retrace_budget` compiles
@@ -920,12 +920,18 @@ def record_retrace(site, provenance=None, compiled=None):
     first-dispatch compile timing, call counting, and lazy
     cost/memory-analysis capture (``MXTPU_XPROF=0`` returns it
     unchanged). Without ``compiled`` the call behaves exactly as before
-    and returns None."""
+    and returns None.
+
+    ``compile_s=`` (the compile service's AOT path) carries an
+    explicitly-measured lower+compile wall time: the executable arrives
+    already compiled, so the wrapper must not re-time the first
+    dispatch."""
     inc("retrace." + site)
     wrapped = None
     if compiled is not None:
         from . import xprof
-        wrapped = xprof.attach(site, provenance, compiled)
+        wrapped = xprof.attach(site, provenance, compiled,
+                               compile_s=compile_s)
     budget = retrace_budget()
     with _LOCK:
         st = _RETRACE.setdefault(site,

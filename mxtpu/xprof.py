@@ -172,10 +172,13 @@ class _WatchedJit:
 
     __slots__ = ("_fn", "_entry", "_pending_first")
 
-    def __init__(self, fn, entry):
+    def __init__(self, fn, entry, pending_first=True):
         self._fn = fn
         self._entry = entry
-        self._pending_first = True
+        # pending_first=False: an AOT executable from the compile
+        # service (built explicitly or deserialized from disk) — the
+        # first dispatch is pure replay, so only call counting remains
+        self._pending_first = pending_first
 
     def __call__(self, *args, **kwargs):
         e = self._entry
@@ -214,32 +217,43 @@ def _new_entry(site, provenance):
     return entry
 
 
-def attach(site, provenance=None, compiled=None):
+def attach(site, provenance=None, compiled=None, compile_s=None):
     """Register one executable-cache miss in the ledger and return the
     callable the site should cache. ``compiled`` is either the
     freshly-built jitted callable (wrapped for first-call timing +
-    signature capture) or an already-AOT ``Compiled`` object (analyses
-    fill immediately). Off (``MXTPU_XPROF=0``) this returns ``compiled``
-    unchanged — zero added dispatch layers."""
+    signature capture) or an already-AOT ``Compiled`` object from the
+    compile service (analyses fill immediately; the wrapper keeps call
+    counting with ``compile_s`` — the service-measured lower+compile
+    wall time — recorded up front since the first dispatch is replay).
+    Off (``MXTPU_XPROF=0``) this returns ``compiled`` unchanged — zero
+    added dispatch layers."""
     if compiled is None:
         return None
     if not enabled():
         return compiled
     entry = _new_entry(site, provenance)
     if hasattr(compiled, "cost_analysis"):
-        _fill_from_compiled(entry, compiled)
-        entry["resolved"] = True
-        return compiled
+        # an AOT executable from the compile service: analyses resolve
+        # LAZILY from the handle we already hold (same discipline as the
+        # lower-at-query path — warmup must not pay a cost_analysis per
+        # bucket); the wrapper keeps call counting, and compile_s is the
+        # service-measured lower+compile wall (first dispatch is replay)
+        entry["_compiled"] = compiled
+        if compile_s is not None:
+            entry["compile_s"] = compile_s
+            telemetry.observe("compile.wall_s", compile_s)
+        return _WatchedJit(compiled, entry, pending_first=False)
     entry["_fn"] = compiled
     return _WatchedJit(compiled, entry)
 
 
-def watch(site, compiled, provenance=None):
+def watch(site, compiled, provenance=None, compile_s=None):
     """Ledger-only registration for a companion executable that shares a
     site's retrace count (e.g. CachedOp's compiled backward, reported
-    with the forward's single ``record_retrace``) — same wrap, no extra
+    with the forward's single ``record_retrace``) or a disk-restored
+    executable (a load is not a compile) — same wrap, no extra
     ``retrace.<site>`` bump."""
-    return attach(site, provenance, compiled)
+    return attach(site, provenance, compiled, compile_s=compile_s)
 
 
 def _fill_from_compiled(entry, compiled):
@@ -274,6 +288,15 @@ def _resolve_entry(entry):
 
 
 def _resolve_entry_locked(entry):
+    pre = entry.pop("_compiled", None)
+    if pre is not None:
+        # the AOT handle was captured at attach time: no re-lowering
+        try:
+            _fill_from_compiled(entry, pre)
+        except Exception as e:  # noqa: BLE001 — diagnostics degrade
+            entry["error"] = "%s: %s" % (type(e).__name__, e)
+        entry["resolved"] = True
+        return
     fn = entry.pop("_fn", None)
     spec = entry.pop("_abstract", None)
     try:

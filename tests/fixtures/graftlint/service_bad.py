@@ -1,0 +1,16 @@
+"""Known-bad fixture for the compile-service seam: the site reports its
+compiles (record_retrace) but keeps an out-of-band private cache — inside
+a service scope every jit surface must resolve through
+compile_service.get_or_build so it shares the LRU bound, the persistent
+executable cache, and AOT warmup."""
+import jax
+
+telemetry = None  # stand-in; the analyzer matches the call shape only
+_CACHE = {}
+
+
+def compile_it(fn, key):
+    if key not in _CACHE:
+        telemetry.record_retrace("fixture_site", {"key": key})
+        _CACHE[key] = jax.jit(fn)
+    return _CACHE[key]

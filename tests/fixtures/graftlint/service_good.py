@@ -1,0 +1,16 @@
+"""Known-good fixture for the compile-service seam: the cache miss
+resolves through compile_service.get_or_build with a canonical key — the
+declared site name rides the canonical_key(site=...) literal."""
+import jax
+
+compile_service = None  # stand-in; the analyzer matches the call shape
+
+
+def compile_it(fn, shapes, pol):
+    key = compile_service.canonical_key(
+        site="fixture_site", fn_id="fixture", signature=shapes, policy=pol)
+
+    def build():
+        return jax.jit(fn)
+
+    return compile_service.get_or_build(key, build).fn

@@ -131,6 +131,40 @@ def test_retrace_good_silent_and_inventoried():
     assert res.jit_inventory[0]["retrace_site"] == "fixture_site"
 
 
+def test_service_seam_out_of_band_fires():
+    """ISSUE 15: inside a service scope, a registered-but-private jit
+    cache is a finding — it must resolve through compile_service."""
+    res = findings_of("service_bad.py", "retrace-site-registration",
+                      service_scopes=("",))
+    assert len(res.findings) == 1
+    assert "compile_service" in res.findings[0].message
+    assert res.jit_inventory[0]["service"] is False
+    # still registered: the watchdog sees it, only the service seam is
+    # missing
+    assert res.jit_inventory[0]["retrace_site"] == "fixture_site"
+
+
+def test_service_seam_good_silent():
+    res = findings_of("service_good.py", "retrace-site-registration",
+                      service_scopes=("",))
+    assert res.findings == []
+    entry = res.jit_inventory[0]
+    assert entry["service"] is True
+    assert entry["retrace_site"] == "fixture_site"
+    # the canonical_key call IS the declared cache-key expression
+    assert "canonical_key" in entry["cache_key"]
+    assert "policy" in entry["cache_key"]
+
+
+def test_service_scope_gates_the_finding():
+    """Outside the declared service scopes (default: mxtpu/) the plain
+    record_retrace discipline stays sufficient — fixture trees and
+    user code keep linting as before."""
+    res = findings_of("service_bad.py", "retrace-site-registration")
+    assert res.findings == []
+    assert res.jit_inventory[0]["service"] is False
+
+
 def test_retrace_allowlist():
     allow = {("retrace_bad.py", "compile_it"):
              {"site": "elsewhere", "reason": "counted by a caller",
@@ -258,9 +292,15 @@ def test_jit_surface_inventory_lists_all_five_caches():
     inv = _repo_result().jit_inventory
     sites = {e["retrace_site"] for e in inv}
     assert {"fused_optimizer", "cached_op", "executor",
-            "executor.backward", "serving.predict",
-            "serving.decode"} <= sites, sites
+            "executor.backward", "subgraph_exec", "parallel.train_step",
+            "rtc", "serving.predict", "serving.decode"} <= sites, sites
     assert None not in sites and "<dynamic>" not in sites
+    # ISSUE 15: the unified compile service is under EVERY jit surface —
+    # an inventory entry without the service seam is an out-of-band
+    # cache (no LRU bound, no persistent executable cache, no AOT
+    # warmup) and the rule fails CI on it inside mxtpu/
+    assert all(e["service"] for e in inv), \
+        [e for e in inv if not e["service"]]
     fused = [e for e in inv if e["retrace_site"] == "fused_optimizer"]
     assert fused and all(e["donation"] == "donate_argnums=(0, 2)"
                          for e in fused)

@@ -38,6 +38,13 @@ DEFAULT_ENV_EXTRA_ROOTS: Tuple[str, ...] = ("bench.py", "tools", "tests")
 # code, and would otherwise convict themselves in the self-clean gate.
 DEFAULT_EXCLUDE: Tuple[str, ...] = ("tests/fixtures/graftlint",)
 
+# Trees where every jax.jit site must resolve through the compile
+# service (mxtpu/compile_service.py): a registered-but-out-of-band cache
+# here is a finding — it would miss the LRU bound, the persistent
+# executable cache, and AOT warmup. Fixture trees (paths outside these
+# prefixes) keep exercising the plain record_retrace discipline.
+DEFAULT_SERVICE_SCOPES: Tuple[str, ...] = ("mxtpu/",)
+
 # retrace-site-registration allowlist: (repo-relative file, enclosing
 # function of the jax.jit call) -> entry. An entry declares WHERE the
 # site's compiles are actually counted and what its cache key is, so the
@@ -46,22 +53,29 @@ DEFAULT_EXCLUDE: Tuple[str, ...] = ("tests/fixtures/graftlint",)
 JIT_ALLOWLIST: Dict[Tuple[str, str], Dict[str, str]] = {
     ("mxtpu/optimizer_fused.py", "_build"): {
         "site": "fused_optimizer",
+        "service": True,
         "reason": "FusedUpdater._cached_jit is the single cache front door "
-                  "for this builder; it calls telemetry.record_retrace on "
-                  "every executable-cache miss before invoking _build",
+                  "for this builder; every executable-cache miss resolves "
+                  "through compile_service.get_or_build (canonical key, "
+                  "retrace reporting, LRU, persistent disk cache) before "
+                  "invoking _build",
         "cache_key": "(optimizer class, static config, per-param specs "
                      "incl. sharding tokens, MeshPlan fingerprint) + "
                      "registry.policy_key — FusedUpdater._cached_jit; the "
                      "mesh-native Trainer shares this cache",
     },
-    ("mxtpu/serving/engine.py", "_get_jit"): {
+    ("mxtpu/serving/engine.py", "_build_for"): {
         "site": "serving.predict",
-        "reason": "Predictor._get_jit reports every compile itself via "
-                  "telemetry.record_retrace(self._site, ...); the site "
-                  "name is per-INSTANCE so each ReplicaSet member gets "
-                  "its own watchdog site (serving.predict.r<i>) — the "
-                  "static rule sees '<dynamic>' and this entry declares "
-                  "the base site for the inventory",
+        "service": True,
+        "reason": "Predictor._build_for only BUILDS the bucket jit; the "
+                  "cache front door is Predictor._get_jit / "
+                  "warmup_entries, which resolve every miss through "
+                  "compile_service.get_or_build with a canonical key at "
+                  "site self._site (per-INSTANCE, so each ReplicaSet "
+                  "member gets its own watchdog site "
+                  "serving.predict.r<i>) and group-dedup identical "
+                  "replica lowerings — the static rule sees no seam in "
+                  "the build closure and this entry declares it",
         "cache_key": "(bucket padded shapes+dtypes) + registry.policy_key "
                      "— one executable cache per Predictor instance; "
                      "per-replica caches (sites serving.predict.r<i>, "
@@ -92,8 +106,9 @@ JIT_ALLOWLIST: Dict[Tuple[str, str], Dict[str, str]] = {
     },
     ("mxtpu/optimizer_fused.py", "_build_guarded"): {
         "site": "fused_optimizer",
-        "reason": "same cache front door as _build; the guard bit and "
-                  "scaler_cfg join the cache key in _cached_jit",
+        "service": True,
+        "reason": "same compile-service front door as _build; the guard "
+                  "bit and scaler_cfg join the cache key in _cached_jit",
         "cache_key": "(optimizer class, static config, per-param specs "
                      "incl. sharding tokens, MeshPlan fingerprint, "
                      "guard bit, scaler_cfg) + registry.policy_key — "
@@ -116,6 +131,7 @@ class LintConfig:
     metric_doc: str = DEFAULT_METRIC_DOC
     metric_scopes: Tuple[str, ...] = DEFAULT_METRIC_SCOPES
     exclude: Tuple[str, ...] = DEFAULT_EXCLUDE
+    service_scopes: Tuple[str, ...] = DEFAULT_SERVICE_SCOPES
     jit_allowlist: Dict[Tuple[str, str], Dict[str, str]] = field(
         default_factory=lambda: dict(JIT_ALLOWLIST))
 

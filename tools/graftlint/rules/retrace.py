@@ -37,6 +37,44 @@ def _find_record_retrace(fn: ast.AST) -> Optional[str]:
     return None
 
 
+def _call_name(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _find_service_seam(fn: ast.AST) -> bool:
+    """True when ``fn`` resolves its executables through the compile
+    service (a ``compile_service.get_or_build`` / ``WarmupEntry`` /
+    ``canonical_key`` call) — the ISSUE-15 seam every jit cache must
+    speak."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and _call_name(node) in (
+                "get_or_build", "canonical_key", "WarmupEntry"):
+            return True
+    return False
+
+
+def _find_canonical_site(fn: ast.AST):
+    """First ``canonical_key(site=...)`` call in ``fn``: returns
+    (site-literal-or-'<dynamic>', unparsed call) — the call expression
+    IS the cache-key declaration of a service-routed site."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and _call_name(node) == "canonical_key":
+            for kw in node.keywords:
+                if kw.arg == "site":
+                    if isinstance(kw.value, ast.Constant) \
+                            and isinstance(kw.value.value, str):
+                        return kw.value.value, ast.unparse(node)
+                    break
+            return "<dynamic>", ast.unparse(node)
+    return None
+
+
 def _donation_of(call: ast.Call) -> Optional[str]:
     parts = []
     for kw in call.keywords:
@@ -72,27 +110,49 @@ class RetraceSiteRegistration(Rule):
                 continue
             chain = enclosing_functions(node, parents)
             site = None
+            service = False
+            ck_expr = None
             for fn in chain:
-                site = _find_record_retrace(fn)
-                if site is not None:
+                if _find_service_seam(fn):
+                    service = True
+                cs = _find_canonical_site(fn)
+                if cs is not None and site is None:
+                    site, ck_expr = cs
+                if site is None:
+                    site = _find_record_retrace(fn)
+            # the allowlist key may name ANY enclosing function (the
+            # jax.jit call often lives in a nested build closure since
+            # the compile-service migration)
+            allow = None
+            allow_name = chain[0].name if chain else "<module>"
+            for fn in chain:
+                a = self.config.jit_allowlist.get((ctx.rel, fn.name))
+                if a is not None:
+                    allow, allow_name = a, fn.name
                     break
-            enclosing_name = chain[0].name if chain else "<module>"
-            allow = self.config.jit_allowlist.get((ctx.rel, enclosing_name))
-            # a "<dynamic>" site IS registered (record_retrace runs with a
-            # computed name — e.g. the serving Predictor's per-replica
-            # serving.predict.r<i> sites), but the static name is unknown;
-            # an allowlist entry resolves it for the inventory so the
-            # scouting report never shows an anonymous cache
+            # a "<dynamic>" site IS registered (record_retrace /
+            # canonical_key runs with a computed name — e.g. the serving
+            # Predictor's per-replica serving.predict.r<i> sites), but
+            # the static name is unknown; an allowlist entry resolves it
+            # for the inventory so the scouting report never shows an
+            # anonymous cache
             unresolved = site in (None, "<dynamic>")
+            cache_key = ck_expr
+            if cache_key is None:
+                for fn in chain:
+                    cache_key = _cache_key_of(fn)
+                    if cache_key is not None:
+                        break
             entry = {
                 "file": ctx.rel,
                 "line": node.lineno,
                 "function": qualname_of(node, parents),
                 "donation": _donation_of(node),
-                "cache_key": _cache_key_of(chain[0] if chain else None),
+                "cache_key": cache_key,
                 "retrace_site": (allow["site"] if allow and unresolved
                                  else site),
                 "allowlisted": bool(allow and unresolved),
+                "service": bool(service or (allow or {}).get("service")),
             }
             if allow and unresolved and allow.get("cache_key"):
                 entry["cache_key"] = allow["cache_key"]
@@ -106,4 +166,18 @@ class RetraceSiteRegistration(Rule):
                     "tools/graftlint/config.py:JIT_ALLOWLIST naming where "
                     "its compiles are counted — unregistered sites are "
                     "invisible to the retrace watchdog"
-                    % (entry["function"], ctx.rel, enclosing_name))
+                    % (entry["function"], ctx.rel, allow_name))
+            elif not entry["service"] and any(
+                    ctx.rel.startswith(scope)
+                    for scope in self.config.service_scopes):
+                self.report(
+                    ctx, ctx.rel, node.lineno,
+                    "jax.jit site (in %s) keeps an out-of-band cache: "
+                    "every runtime jit surface must resolve through "
+                    "mxtpu/compile_service.py (get_or_build with a "
+                    "canonical_key) so it shares the LRU bound, the "
+                    "persistent executable cache, and AOT warmup — or "
+                    "declare 'service': True in its JIT_ALLOWLIST entry "
+                    "naming the front door that routes it "
+                    "(docs/compile_cache.md)"
+                    % (entry["function"],))

@@ -159,6 +159,17 @@ timeout 600 python tools/serve_bench.py --mode slo \
   2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
 telemetry_report
 
+# 5d. startup-time phase (ISSUE 15): cold-start vs warm-disk-cache wall
+#     time for a Trainer first step and a Predictor replica warmup, each
+#     in a fresh process against one MXTPU_COMPILE_CACHE_DIR (gates:
+#     warm start compiles == 0 watchdog-pinned, the disk served, and the
+#     warm wall is strictly lower; vs_baseline = worst-scenario
+#     cold/warm speedup). Host work + child processes — chip-safe.
+sleep 60
+timeout 900 env BENCH_CONFIG=startup_time BENCH_PREFLIGHT=0 \
+  python bench.py 2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
+telemetry_report
+
 # 6. input pipeline phase (ISSUE 9): device-resident streaming reader +
 #    double-buffered prefetch-to-device vs the synchronous loop — batches/s
 #    and the data.wait fraction both ways (gate: parity + wait-frac drop;
