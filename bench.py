@@ -997,13 +997,18 @@ def bench_serving(emit=None):
 
 def bench_serving_decode(emit=None):
     """Continuous-batching autoregressive decode (mxtpu/serving/decode,
-    ISSUE 11): ``tools/serve_bench.py --mode decode`` driven in-process.
-    The A/B the ROADMAP item names: continuous batching vs
-    restart-per-batch at equal cohort capacity on identical executables,
-    plus the int8 logits-parity and KV-bytes gates. ``vs_baseline`` is
-    the continuous-vs-restart tokens/s speedup when EVERY gate holds
-    (strictly > 1 continuous win, zero post-warmup compiles at
-    ``serving.decode``, zero in-loop d2h, int8 parity + <= ~half KV
+    ISSUE 11 + the ISSUE 16 paged-KV phases): ``tools/serve_bench.py
+    --mode decode`` driven in-process. The A/Bs the ROADMAP item names:
+    continuous batching vs restart-per-batch at equal cohort capacity on
+    identical executables, paged vs rowed KV at equal HBM budget
+    (admitted-residency multiplier ≥ 2×), prefix reuse under a
+    templated-prompt cohort (hit rate > 0, shared pages visible), and
+    speculative decoding (tokens/step and tokens/s win at bit-identical
+    greedy output), plus the int8 logits-parity and KV-bytes gates.
+    ``vs_baseline`` is the continuous-vs-restart tokens/s speedup when
+    EVERY gate holds (strictly > 1 continuous win, zero post-warmup
+    compiles at ``serving.decode`` AND ``serving.draft``, zero in-loop
+    d2h, token parity across every layout, int8 parity + <= ~half KV
     bytes), else 0.0."""
     if emit is None:
         emit = _emit
@@ -1031,6 +1036,13 @@ def bench_serving_decode(emit=None):
         "prefill_logits_rel_err": round(rec["prefill_logits_rel_err"], 5),
         "step_logits_rel_err": round(rec["step_logits_rel_err"], 5),
         "kv_bytes_ratio": round(rec["kv_bytes_ratio"], 4),
+        "paged_residency_x": round(rec["residency_x"], 2),
+        "paged_ab_ok": rec["ab_ok"],
+        "prefix_hit_rate": round(rec["prefix_hit_rate"], 3),
+        "prefix_ok": rec["prefix_ok"],
+        "spec_accept_rate": round(rec["accept_rate"], 3),
+        "spec_tokens_per_step": round(rec["spec_tokens_per_step"], 3),
+        "spec_ok": rec["spec_ok"],
         "gates_ok": rec["ok"],
     }
 

@@ -170,7 +170,10 @@ class MicroBatcher:
         # None to admit — how the KVCacheAccountant makes overload shed
         # by KV residency (decode.py:KVCacheAccountant.gate), and the
         # seam any resource ledger (device memory, SLO predictor) plugs
-        # into without subclassing
+        # into without subclassing. The hook is unit-agnostic on purpose:
+        # the same gate sheds by worst-case rows for a rowed KV pool and
+        # by real free-PAGE headroom for a paged one (the accountant's
+        # register() decides the unit, not this batcher)
         self._gate = admission_gate
         # the SLO control plane (controller.attach via ServingController):
         # predictive admission consults it in _admit, delivery feeds its

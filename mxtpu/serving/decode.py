@@ -86,7 +86,9 @@ from .replicas import dispatch_timeout_ms_default
 
 __all__ = ["DecodeModel", "DecodeEngine", "DecodeFuture", "KVCacheAccountant",
            "decode_slots_default", "decode_queue_default",
-           "decode_max_new_default", "kv_overcommit_default"]
+           "decode_max_new_default", "kv_overcommit_default",
+           "kv_page_tokens_default", "prefix_cache_default",
+           "spec_decode_k_default"]
 
 _log = logging.getLogger("mxtpu.serving")
 
@@ -124,6 +126,36 @@ def kv_overcommit_default():
     return float(os.environ.get("MXTPU_SERVE_KV_OVERCOMMIT", "2.0"))
 
 
+def kv_page_tokens_default():
+    """KV page size in tokens (``MXTPU_KV_PAGE_TOKENS``, default 0 =
+    rowed worst-case slots, the PR 11 layout). A power-of-two > 0 turns
+    on PAGED KV: slots carry page tables instead of ``max_len`` rows, so
+    HBM residency tracks actual tokens and finished sequences return
+    their pages to the pool between steps — the accountant then admits
+    by real free-page headroom instead of pessimistic rows."""
+    return int(os.environ.get("MXTPU_KV_PAGE_TOKENS", "0"))
+
+
+def prefix_cache_default():
+    """Prefix caching on paged KV (``MXTPU_PREFIX_CACHE``, default off):
+    full prompt-aligned pages are registered under a rolling token-chunk
+    hash and SHARED (refcounted, read-only) across prompts with the same
+    prefix — a templated-prompt cohort stores each system prompt once
+    and prefill skips straight to the first novel token."""
+    return os.environ.get("MXTPU_PREFIX_CACHE", "0") \
+        not in ("0", "", "false", "False")
+
+
+def spec_decode_k_default():
+    """Speculative-decoding draft length (``MXTPU_SPEC_DECODE_K``,
+    default 0 = off): a draft model proposes k greedy tokens per step
+    and the target executable verifies them in ONE batched pass with
+    longest-accepted-prefix commit — tokens/step rises above 1 at
+    identical target math (greedy streams are bit-identical with and
+    without speculation)."""
+    return int(os.environ.get("MXTPU_SPEC_DECODE_K", "0"))
+
+
 class DecodeFuture(_Future):
     """A decode request's completion handle: ``result()`` returns the
     generated token ids (int32 numpy, eos included when hit). Carries the
@@ -139,7 +171,7 @@ class DecodeFuture(_Future):
 
 class _Sequence:
     __slots__ = ("prompt", "max_new", "deadline", "t_enq", "trace", "future",
-                 "tokens", "slot")
+                 "tokens", "slot", "pages", "reserved", "pos")
 
     def __init__(self, prompt, max_new, deadline, t_enq, trace):
         self.prompt = prompt
@@ -150,6 +182,9 @@ class _Sequence:
         self.future = DecodeFuture()
         self.tokens = []
         self.slot = None
+        self.pages = []     # paged mode: mapped page ids, chunk order
+        self.reserved = 0   # paged mode: accountant pages still queued
+        self.pos = 0        # paged mode: host mirror of the device pos
 
 
 class DecodeModel:
@@ -167,6 +202,22 @@ class DecodeModel:
         lengths (this token's position). Returns ``(logits[c, V],
         entries)`` where ``entries`` is the per-leaf list of new k/v rows
         ``[c, ...]`` — the engine persists them at ``pos``."""
+        raise NotImplementedError
+
+    def decode_chunk(self, kv, toks, pos):
+        """OPTIONAL: score ``t`` chained tokens in ONE forward (jnp-level,
+        traced) — the speculative-verification fast path. ``toks[c, t]``
+        are the pending token followed by t-1 draft proposals; the
+        position of ``toks[:, j]`` is ``pos + j``. Attention for query j
+        spans the cache (rows ``< pos``) plus the chunk's own rows
+        ``<= j`` (causal within the chunk) — the chunk rows are NOT in
+        ``kv``. Returns ``(logits[c, t, V], entries)`` with per-leaf new
+        rows ``[c, t, ...]``; the engine persists/discards them by its
+        commit rule. Rows whose position overflows ``L`` may be garbage —
+        the engine masks them. Models that do not implement this verify
+        through ``decode_step`` chained t times (bit-identical, slower);
+        int8 engines always chain so within-chunk reads see the same
+        quantize->dequantize grid as step-at-a-time decode."""
         raise NotImplementedError
 
 
@@ -194,10 +245,14 @@ class KVCacheAccountant:
         self._overcommit = float(overcommit if overcommit is not None
                                  else kv_overcommit_default())
 
-    def register(self, tag, per_slot_bytes, slots, bucket_slots=()):
+    def register(self, tag, per_slot_bytes, slots, bucket_slots=(),
+                 page_tokens=0):
         """Declare (or re-declare) a replica's KV pool. ``bucket_slots``
         is the cohort capacity ladder, so the snapshot can report bytes
-        by bucket."""
+        by bucket. A PAGED engine registers its page pool here instead:
+        ``per_slot_bytes`` is one page's bytes, ``slots`` the pool's page
+        count, and ``page_tokens`` the page size — the same ledger then
+        admits by real free-page headroom, not worst-case rows."""
         with self._lock:
             cap = self._capacity_bytes
             if cap is None:
@@ -206,6 +261,7 @@ class KVCacheAccountant:
                 "per_slot_bytes": int(per_slot_bytes),
                 "slots": int(slots),
                 "capacity_bytes": int(cap),
+                "page_tokens": int(page_tokens),
                 "live": 0, "queued": 0,
                 "bucket_bytes": {int(b): int(b) * int(per_slot_bytes)
                                  for b in bucket_slots},
@@ -256,26 +312,29 @@ class KVCacheAccountant:
             p["queued"] += n
             return True
 
-    def unqueue(self, tag):
-        """An admitted sequence left the queue without taking a slot
-        (expired / shed / engine crash)."""
+    def unqueue(self, tag, n=1):
+        """``n`` admitted slots/pages left the queue without going
+        resident (expired / shed / engine crash / unused page
+        reservation)."""
         with self._lock:
             p = self._pool(tag)
-            p["queued"] = max(0, p["queued"] - 1)
+            p["queued"] = max(0, p["queued"] - n)
 
-    def occupy(self, tag):
-        """A queued sequence took a KV slot (bytes now resident)."""
+    def occupy(self, tag, n=1):
+        """``n`` queued slots/pages went resident (bytes now on
+        device)."""
         with self._lock:
             p = self._pool(tag)
-            p["queued"] = max(0, p["queued"] - 1)
-            p["live"] += 1
+            p["queued"] = max(0, p["queued"] - n)
+            p["live"] += n
             self._gauges_locked()
 
-    def release(self, tag):
-        """A live sequence finished; its slot's bytes are free again."""
+    def release(self, tag, n=1):
+        """``n`` resident slots/pages freed (sequence finished, page
+        refcount hit zero)."""
         with self._lock:
             p = self._pool(tag)
-            p["live"] = max(0, p["live"] - 1)
+            p["live"] = max(0, p["live"] - n)
             self._gauges_locked()
 
     def resident_bytes(self, tag=None):
@@ -319,6 +378,7 @@ class KVCacheAccountant:
                     "capacity_bytes": p["capacity_bytes"],
                     "per_slot_bytes": p["per_slot_bytes"],
                     "slots": p["slots"],
+                    "page_tokens": p.get("page_tokens", 0),
                     "live": p["live"],
                     "queued": p["queued"],
                     "resident_bytes": p["live"] * p["per_slot_bytes"],
@@ -348,6 +408,76 @@ def _quantize_rows(x):
     return q, r
 
 
+class _PrefixCache:
+    """Host-side index of SHARED read-only prompt pages (paged mode,
+    ``MXTPU_PREFIX_CACHE``): a rolling chunk hash chains page-aligned
+    token blocks, each entry pinning one pool page by refcount. Shared
+    pages are full prompt-aligned chunks and are never written — a
+    diverging suffix lives in its own private pages from the first
+    unmatched chunk on, so copy-on-write materializes at page
+    granularity with zero copies. Entries whose page nobody else
+    references are evictable (LRU) when the free list runs dry.
+    All calls run under the engine's lock."""
+
+    def __init__(self):
+        self._entries = collections.OrderedDict()  # h -> entry
+
+    def __len__(self):
+        return len(self._entries)
+
+    @staticmethod
+    def chunk_hash(parent, tokens):
+        import hashlib
+        h = hashlib.sha1()
+        h.update(parent.encode("ascii"))
+        h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
+        return h.hexdigest()
+
+    def lookup(self, prompt, pt):
+        """Longest cached page-aligned strict-prefix match: returns
+        ``(matched_chunks, [page ids])`` — matched tokens stay <= n-1 so
+        the extend path always has a novel token to prefill."""
+        n = int(prompt.size)
+        jmax = (n - 1) // pt
+        pids, h = [], ""
+        for j in range(jmax):
+            chunk = prompt[j * pt:(j + 1) * pt]
+            h = self.chunk_hash(h, chunk)
+            e = self._entries.get(h)
+            if e is None or not np.array_equal(e["tokens"], chunk):
+                break
+            self._entries.move_to_end(h)
+            pids.append(e["pid"])
+        return len(pids), pids
+
+    def put(self, h, tokens, pid):
+        """Register a full chunk's page (caller increfs the page for the
+        cache's pin). Returns False when the hash is already present (the
+        caller keeps its private copy unregistered)."""
+        if h in self._entries:
+            return False
+        self._entries[h] = {"tokens": np.array(tokens, np.int32),
+                            "pid": int(pid)}
+        self._entries.move_to_end(h)
+        return True
+
+    def evict_one(self, page_ref):
+        """Drop the least-recently-used entry whose page only the cache
+        pins (refcount 1). Returns its pid, or None."""
+        for h, e in self._entries.items():
+            if page_ref[e["pid"]] == 1:
+                del self._entries[h]
+                return e["pid"]
+        return None
+
+    def drain(self):
+        """Clear every entry (wedge reset / close — the device pages
+        they pin are gone). Returns the pinned pids."""
+        pids = [e["pid"] for e in self._entries.values()]
+        self._entries.clear()
+        return pids
+
+
 # ------------------------------------------------------------------- engine
 class DecodeEngine:
     """The continuous-batching decode loop (see the module docstring).
@@ -368,7 +498,10 @@ class DecodeEngine:
                  prefill_site="serving.prefill", int8=None,
                  accountant=None, replica_tag="r0", max_queue=None,
                  max_new_default=None, dispatch_timeout_ms=None,
-                 clock=time.monotonic, start=False, continuous=True):
+                 clock=time.monotonic, start=False, continuous=True,
+                 page_tokens=None, pool_pages=None, prefix_cache=None,
+                 draft_model=None, spec_k=None,
+                 draft_site="serving.draft"):
         if not hasattr(model, "decode_step"):
             raise MXNetError(
                 "DecodeEngine serves DecodeModel-family blocks (got %s): "
@@ -419,12 +552,80 @@ class DecodeEngine:
             else dispatch_timeout_ms_default()) / 1e3
         self._clock = clock
         self._continuous = bool(continuous)
+        # ---- paged KV / prefix reuse / speculative decoding (ISSUE 16)
+        pt = int(page_tokens if page_tokens is not None
+                 else kv_page_tokens_default())
+        if pt < 0 or (pt and (pt & (pt - 1))):
+            raise MXNetError(
+                "DecodeEngine page_tokens=%d must be 0 (rowed) or a "
+                "power of two (page-offset math is a mask/shift inside "
+                "the traced step)" % pt)
+        self._pt = pt
+        self._maxp = 0 if not pt else -(-self._max_len // pt)
+        if pool_pages is not None and not pt:
+            raise MXNetError("DecodeEngine pool_pages without "
+                             "page_tokens: the rowed layout has no pool")
+        self._pool_pages = 0 if not pt else int(
+            pool_pages if pool_pages is not None
+            else self._capacity * self._maxp)
+        if pt and self._pool_pages < self._maxp:
+            raise MXNetError(
+                "DecodeEngine pool_pages=%d cannot hold even one "
+                "max_len=%d sequence (%d pages of %d tokens)"
+                % (self._pool_pages, self._max_len, self._maxp, pt))
+        self._prefix_on = bool(prefix_cache if prefix_cache is not None
+                               else prefix_cache_default())
+        self._spec_k = int(spec_k if spec_k is not None
+                           else spec_decode_k_default())
+        if self._prefix_on and not pt:
+            raise MXNetError("DecodeEngine prefix_cache needs paged KV "
+                             "(MXTPU_KV_PAGE_TOKENS > 0): shared prompts "
+                             "are shared PAGES")
+        if self._spec_k and not pt:
+            raise MXNetError("DecodeEngine spec_k needs paged KV "
+                             "(MXTPU_KV_PAGE_TOKENS > 0)")
+        if self._spec_k and draft_model is None:
+            raise MXNetError("DecodeEngine spec_k=%d without a "
+                             "draft_model: speculation needs a proposer"
+                             % self._spec_k)
+        if self._spec_k and self._prefix_on:
+            raise MXNetError(
+                "DecodeEngine prefix_cache with spec_k: a prefix hit "
+                "skips the prefill the DRAFT cache also needs — run one "
+                "lever per engine (docs/serving.md)")
+        if draft_model is not None and not self._spec_k:
+            self._spec_k = 0
+            draft_model = None
+        if draft_model is not None and not hasattr(draft_model,
+                                                   "decode_step"):
+            raise MXNetError("DecodeEngine draft_model must be a "
+                             "DecodeModel (decode_step)")
+        self._draft_model = draft_model
+        self._draft_site = draft_site
+        self._draft_pred = None
+        self._dkv_layout = None
+        # host page-pool state (guarded by self._cond; the Condition's
+        # default RLock makes the ledger helpers re-entrant)
+        self._free_pages = []
+        self._page_ref = None
+        self._ptab = None
+        self._prefix = _PrefixCache() if self._prefix_on else None
         if example is None:
             example = np.zeros((1, prefill_spec.seq_lens[0]), np.int32)
         self._pred = Predictor(model, prefill_spec, example=example,
                                warmup=False, name=name + ".prefill",
                                device=device, site=prefill_site,
                                int8=self._int8)
+        if self._draft_model is not None:
+            # the draft Predictor exists for its param plumbing (the
+            # draft prefill itself runs fused inside the insert
+            # executables); the per-cohort draft-chain executables
+            # report at serving.draft — the site the zero-post-warmup
+            # watchdog pins
+            self._draft_pred = Predictor(
+                self._draft_model, prefill_spec, example=example,
+                warmup=False, name=name + ".draft", device=device,
+                site=self._draft_site, int8=False)
         self._jits = {}            # (kind, bucket, int8, policy) -> jitted
         self._kv_layout = None     # [(trailing_shape, dtype_str)] per leaf
         self._vocab = None
@@ -484,9 +685,29 @@ class DecodeEngine:
     def accountant(self):
         return self._acct
 
+    @property
+    def page_tokens(self):
+        """Tokens per KV page (0 = rowed worst-case layout)."""
+        return self._pt
+
+    @property
+    def pool_pages(self):
+        """Page-pool size (0 in rowed mode). Page id 0 is a scratch
+        page on top of this count — inactive-slot and overflow writes
+        land there, so the pool ids are 1..pool_pages."""
+        return self._pool_pages
+
+    @property
+    def spec_k(self):
+        """Speculative draft length (0 = plain one-token steps)."""
+        return self._spec_k
+
     def per_slot_kv_bytes(self):
         """Resident bytes one slot's KV cache costs (int8: quantized
-        leaves + per-position scale rows) — what the accountant ledgers."""
+        leaves + per-position scale rows) — what the accountant ledgers.
+        In paged mode this is the WORST-CASE cost (max_len tokens); the
+        accountant instead ledgers :meth:`page_bytes` x pages actually
+        mapped."""
         if self._kv_layout is None:
             raise MXNetError("per_slot_kv_bytes before warmup()")
         total = 0
@@ -494,6 +715,23 @@ class DecodeEngine:
             n = self._max_len * int(np.prod(trail, dtype=np.int64) or 1)
             if self._int8:
                 total += n * 1 + self._max_len * 4  # int8 rows + f32 scales
+            else:
+                total += n * jnp.dtype(dt).itemsize
+        return total
+
+    def page_bytes(self):
+        """Resident bytes one pool page costs (``page_tokens`` rows of
+        every KV leaf; int8: quantized rows + per-position scales)."""
+        if self._kv_layout is None:
+            raise MXNetError("page_bytes before warmup()")
+        if not self._pt:
+            raise MXNetError("page_bytes on a rowed engine "
+                             "(page_tokens=0)")
+        total = 0
+        for trail, dt in self._kv_layout:
+            n = self._pt * int(np.prod(trail, dtype=np.int64) or 1)
+            if self._int8:
+                total += n * 1 + self._pt * 4
             else:
                 total += n * jnp.dtype(dt).itemsize
         return total
@@ -532,19 +770,60 @@ class DecodeEngine:
                            str(d.dtype)))
         self._kv_layout = layout
         self._pred.warmup()
-        self._carry = self._alloc_carry()
+        if self._draft_pred is not None:
+            dflat, _df, _db = self._draft_pred.predict_flat(
+                (np.zeros((1, self._prefill_spec.seq_lens[0]), np.int32),))
+            if len(dflat) < 2 or dflat[0]._data.ndim != 3:
+                raise MXNetError("draft_model must follow the DecodeModel "
+                                 "prefill contract (logits, *kv_leaves)")
+            if int(dflat[0]._data.shape[-1]) != self._vocab:
+                raise MXNetError(
+                    "draft_model vocab %d != target vocab %d — the "
+                    "draft proposes TARGET token ids"
+                    % (int(dflat[0]._data.shape[-1]), self._vocab))
+            self._dkv_layout = [
+                (tuple(int(x) for x in leaf._data.shape[2:]),
+                 str(leaf._data.dtype)) for leaf in dflat[1:]]
+            # no _draft_pred.warmup(): the draft prefill runs FUSED
+            # inside the insert executables (warmed below) — the draft
+            # Predictor only supplies params and the probe above
+        with self._cond:
+            if self._pt:
+                self._reset_pool_locked()
+            self._carry = self._alloc_carry()
         # AOT: one step executable per cohort capacity bucket (replayed
-        # on the all-inactive cohort — a no-op step), one insert
-        # executable per prefill seq bucket (max_new=0 marks the warmed
-        # slot done-at-insert, so warmup leaves no live slot behind).
-        # First invocations trace the shared block (parameters bind
-        # tracers): serialize across engines like the Predictor does.
+        # on the all-inactive cohort — a no-op step; spec mode compiles
+        # the draft-chain + verify pair instead), one insert executable
+        # per prefill seq bucket (max_new=0 marks the warmed slot
+        # done-at-insert, so warmup leaves no live slot behind), and —
+        # prefix mode — one extend executable per seq bucket. First
+        # invocations trace the shared block (parameters bind tracers):
+        # serialize across engines like the Predictor does.
         with _TRACE_LOCK:
+            ptab0 = None if not self._pt else \
+                np.zeros((self._capacity, self._maxp), np.int32)
             for b in self._decode_spec.decode_slots:
-                step_args = (self._carry, self._pred._param_datas,
-                             self._pred._param_ranges)
-                self._carry, emitted = self._get_step_jit(
-                    b, example_args=step_args)(*step_args)
+                if self._spec_k:
+                    d_args = (self._carry, self._draft_pred._param_datas,
+                              self._draft_pred._param_ranges)
+                    self._carry, props = self._get_draft_jit(
+                        b, example_args=d_args)(*d_args)
+                    v_args = (self._carry, ptab0, props,
+                              self._pred._param_datas,
+                              self._pred._param_ranges)
+                    self._carry, emitted = self._get_verify_jit(
+                        b, example_args=v_args)(*v_args)
+                elif self._pt:
+                    step_args = (self._carry, ptab0,
+                                 self._pred._param_datas,
+                                 self._pred._param_ranges)
+                    self._carry, emitted = self._get_step_jit(
+                        b, example_args=step_args)(*step_args)
+                else:
+                    step_args = (self._carry, self._pred._param_datas,
+                                 self._pred._param_ranges)
+                    self._carry, emitted = self._get_step_jit(
+                        b, example_args=step_args)(*step_args)
                 jax.block_until_ready(emitted[0])
             V = self._vocab
             for s in self._prefill_spec.seq_lens:
@@ -555,18 +834,47 @@ class DecodeEngine:
                 # but retrace inside jax on the first real insert — a
                 # mid-serving compile stall invisible to record_retrace
                 zl = jnp.zeros((1, s, V), logits._data.dtype)
-                ins_args = (self._carry, seq_kv, zl,
-                            np.int32(0), np.int32(1), np.int32(0))
+                if self._pt:
+                    pages0 = np.zeros(-(-s // self._pt), np.int32)
+                    if self._spec_k:
+                        ins_args = (self._carry, seq_kv, zl,
+                                    np.zeros(s, np.int32), pages0,
+                                    np.int32(0), np.int32(1), np.int32(0),
+                                    self._draft_pred._param_datas,
+                                    self._draft_pred._param_ranges)
+                    else:
+                        ins_args = (self._carry, seq_kv, zl, pages0,
+                                    np.int32(0), np.int32(1), np.int32(0))
+                else:
+                    ins_args = (self._carry, seq_kv, zl,
+                                np.int32(0), np.int32(1), np.int32(0))
                 self._carry, out = self._get_insert_jit(
                     s, example_args=ins_args)(*ins_args)
                 jax.block_until_ready(out)
+                if self._prefix is not None:
+                    ext_args = (self._carry, np.zeros(self._maxp, np.int32),
+                                np.zeros(s, np.int32), np.int32(0),
+                                np.int32(0), np.int32(0), np.int32(0),
+                                self._pred._param_datas,
+                                self._pred._param_ranges)
+                    self._carry, out = self._get_extend_jit(
+                        s, example_args=ext_args)(*ext_args)
+                    jax.block_until_ready(out)
         telemetry.gauge("serving.decode.buckets",
                         len(self._decode_spec.decode_slots)
                         + len(self._prefill_spec.seq_lens))
         if self._acct is not None:
-            self._acct.register(self._tag, self.per_slot_kv_bytes(),
-                                self._capacity,
-                                bucket_slots=self._decode_spec.decode_slots)
+            if self._pt:
+                # page-granular ledger: one "slot" = one page, so the
+                # byte gauges and the admission bound track pages
+                # actually mapped, not worst-case rows
+                self._acct.register(self._tag, self.page_bytes(),
+                                    self._pool_pages,
+                                    page_tokens=self._pt)
+            else:
+                self._acct.register(
+                    self._tag, self.per_slot_kv_bytes(), self._capacity,
+                    bucket_slots=self._decode_spec.decode_slots)
         # will-it-fit pre-flight (mxtpu/xprof.py): Σ AOT step+insert
         # executable footprints vs the device HBM limit — warmup
         # succeeding bucket-by-bucket does not mean every bucket's
@@ -579,7 +887,21 @@ class DecodeEngine:
 
     def _alloc_carry(self):
         C, L = self._capacity, self._max_len
-        if self._int8:
+        if self._pt:
+            # paged: leaves are [pool+1, page_tokens, ...] — page id 0
+            # is the scratch page (inactive-slot writes, unmapped table
+            # entries, and clamped overflow all land there)
+            rows = (self._pool_pages + 1, self._pt)
+            if self._int8:
+                kv = [jnp.zeros(rows + trail, jnp.int8)
+                      for trail, _dt in self._kv_layout]
+                scales = [jnp.ones(rows, jnp.float32)
+                          for _ in self._kv_layout]
+            else:
+                kv = [jnp.zeros(rows + trail, dt)
+                      for trail, dt in self._kv_layout]
+                scales = None
+        elif self._int8:
             kv = [jnp.zeros((C, L) + trail, jnp.int8)
                   for trail, _dt in self._kv_layout]
             scales = [jnp.ones((C, L), jnp.float32)
@@ -592,7 +914,124 @@ class DecodeEngine:
         pos = jnp.zeros((C,), jnp.int32)
         active = jnp.zeros((C,), jnp.bool_)
         rem = jnp.zeros((C,), jnp.int32)
-        return (kv, scales, tok, pos, active, rem)
+        carry = (kv, scales, tok, pos, active, rem)
+        if self._spec_k:
+            # the draft's KV stays ROWED in compute dtype: the draft is
+            # small by design, and keeping it worst-case keeps the
+            # proposer off the page pool entirely
+            carry += ([jnp.zeros((C, L) + trail, dt)
+                       for trail, dt in self._dkv_layout],)
+        return carry
+
+    # ------------------------------------------------------ page pool (host)
+    def _reset_pool_locked(self):
+        """(Re)build the free list, refcounts, and page tables — engine
+        construction and every carry re-allocation (wedge reset, crash,
+        close): the device pages a reset zeroes must never stay mapped."""
+        P = self._pool_pages
+        self._free_pages = list(range(P, 0, -1))   # pop() -> 1, 2, ...
+        self._page_ref = np.zeros(P + 1, np.int32)
+        self._ptab = np.zeros((self._capacity, max(1, self._maxp)),
+                              np.int32)
+        self._page_gauges_locked()
+
+    def _page_gauges_locked(self):
+        if not self._pt:
+            return
+        free = len(self._free_pages)
+        telemetry.gauge("serving.kv_page_free", free)
+        telemetry.gauge("serving.kv_page_resident", self._pool_pages - free)
+        telemetry.gauge("serving.kv_page_shared",
+                        int(np.sum(self._page_ref[1:] >= 2)))
+        telemetry.gauge("serving.kv_resident_tokens",
+                        sum(s.pos for s in self._slots if s is not None))
+
+    def _take_page_locked(self, seq):
+        """Allocate one pool page for ``seq`` (ledger + refcount + map).
+        Returns the pid, or None on exhaustion — physical (free list dry
+        even after evicting cache-only pages) or ledgered (the
+        accountant's page headroom is gone and the sequence holds no
+        reservation to convert)."""
+        if seq.reserved <= 0:
+            if self._acct is not None \
+                    and not self._acct.try_admit(self._tag):
+                return None
+            seq.reserved += 1
+        if not self._free_pages and self._prefix is not None:
+            pid = self._prefix.evict_one(self._page_ref)
+            if pid is not None:
+                self._decref_locked(pid)
+        if not self._free_pages:
+            # physically dry: hand the reservation back before refusing
+            if self._acct is not None:
+                self._acct.unqueue(self._tag)
+            seq.reserved -= 1
+            return None
+        pid = self._free_pages.pop()
+        self._page_ref[pid] = 1
+        if self._acct is not None:
+            self._acct.occupy(self._tag)
+        seq.reserved -= 1
+        seq.pages.append(pid)
+        return pid
+
+    def _share_page_locked(self, seq, pid):
+        """Attach a cache-shared page to ``seq`` (refcount only — the
+        page's bytes are already ledgered live)."""
+        self._page_ref[pid] += 1
+        seq.pages.append(pid)
+
+    def _decref_locked(self, pid):
+        """Drop one reference; at zero the page returns to the free list
+        and its bytes leave the accountant's resident count."""
+        self._page_ref[pid] -= 1
+        if self._page_ref[pid] <= 0:
+            self._page_ref[pid] = 0
+            self._free_pages.append(pid)
+            if self._acct is not None:
+                self._acct.release(self._tag)
+
+    def _free_seq_ledger(self, seq, slotted):
+        """THE one teardown ledger for a sequence (normal completion,
+        done-at-insert, deadline expiry, wedge casualty, wedge scan,
+        crash barrier, close): paged mode derefs every mapped page and
+        hands back any unconverted reservation; rowed mode keeps the PR
+        11 release-vs-unqueue split. One copy, so no path can leak pool
+        pages or drive the free count negative."""
+        if self._pt:
+            with self._cond:
+                for pid in seq.pages:
+                    self._decref_locked(pid)
+                seq.pages = []
+                if seq.reserved > 0 and self._acct is not None:
+                    self._acct.unqueue(self._tag, n=seq.reserved)
+                seq.reserved = 0
+                self._page_gauges_locked()
+        elif self._acct is not None:
+            if slotted:
+                self._acct.release(self._tag)
+            else:
+                self._acct.unqueue(self._tag)
+
+    def _register_prefix_locked(self, seq, m_chunks):
+        """Publish this prompt's FULL chunks into the prefix cache (the
+        cache holds one extra reference per entry, so a published page
+        outlives its first owner). Only chunks wholly inside the prompt
+        register — the page holding the first generated token is private
+        by construction, which is what makes shared pages read-only
+        without any copy-on-write machinery."""
+        if self._prefix is None:
+            return
+        pt = self._pt
+        n = int(seq.prompt.size)
+        h = ""
+        for j in range(n // pt):
+            chunk = seq.prompt[j * pt:(j + 1) * pt]
+            h = _PrefixCache.chunk_hash(h, chunk)
+            if j >= m_chunks and j < len(seq.pages):
+                if self._prefix.put(h, chunk, seq.pages[j]):
+                    self._page_ref[seq.pages[j]] += 1
+        self._page_gauges_locked()
 
     # ------------------------------------------------------------- compiling
     def _build_jit(self, kind, bucket, build, donate=(0,),
@@ -620,11 +1059,17 @@ class DecodeEngine:
             # models of the same class but different widths (same
             # kv_layout/vocab) must never alias a disk digest — a
             # shape-mismatched restore would crash, not degrade
+            # the paged dims join the signature: a paged and a rowed
+            # engine of the same model (or two pool sizes) must never
+            # alias a disk digest — a shape-mismatched restore would
+            # crash, not degrade
             signature=(kind, bucket, self._int8, self._capacity,
                        self._max_len, self._eos,
                        tuple(self._kv_layout or ()), self._vocab,
                        tuple((tuple(d.shape), str(d.dtype))
-                             for d in self._pred._param_datas)),
+                             for d in self._pred._param_datas),
+                       self._pt, self._pool_pages, self._spec_k,
+                       tuple(self._dkv_layout or ())),
             policy=pol, donation=donate,
             device=csvc.device_token(device=self._pred.device),
             nonce=csvc.instance_nonce(self))
@@ -632,6 +1077,47 @@ class DecodeEngine:
             ckey, lambda: jax.jit(build(), donate_argnums=donate),
             provenance={"engine": self._name, "kind": kind,
                         "bucket": bucket, "int8": self._int8,
+                        "capacity": self._capacity,
+                        "max_len": self._max_len,
+                        "policy_key": list(pol)},
+            example_args=csvc.concrete_args(example_args)
+            if example_args is not None else None)
+        self._jits[key] = entry.fn
+        return entry.fn
+
+    def _build_draft_jit(self, kind, bucket, build, donate=(0,),
+                         example_args=None):
+        """The compile front door for the DRAFT-model executables
+        (speculative decoding): same compile-service seam as
+        ``_build_jit`` but reporting at the ``serving.draft`` site — the
+        sixth entry in graftlint's caches inventory, with its own
+        zero-post-warmup watchdog pin. One draft-chain executable per
+        cohort capacity bucket; the draft Predictor's prefill buckets
+        share the site."""
+        from .. import compile_service as csvc
+        from ..ops.registry import policy_key
+        pol = policy_key()
+        key = (kind, bucket, self._int8, pol)
+        hit = self._jits.get(key)
+        if hit is not None:
+            return hit
+        ckey = csvc.canonical_key(
+            site=self._draft_site,
+            fn_id="draft:%s:%s" % (type(self._draft_model).__name__,
+                                   csvc.source_token(
+                                       type(self._draft_model))),
+            signature=(kind, bucket, self._capacity, self._max_len,
+                       self._spec_k, tuple(self._dkv_layout or ()),
+                       self._vocab,
+                       tuple((tuple(d.shape), str(d.dtype))
+                             for d in self._draft_pred._param_datas)),
+            policy=pol, donation=donate,
+            device=csvc.device_token(device=self._pred.device),
+            nonce=csvc.instance_nonce(self))
+        entry = csvc.get_or_build(
+            ckey, lambda: jax.jit(build(), donate_argnums=donate),
+            provenance={"engine": self._name, "kind": kind,
+                        "bucket": bucket, "spec_k": self._spec_k,
                         "capacity": self._capacity,
                         "max_len": self._max_len,
                         "policy_key": list(pol)},
@@ -679,15 +1165,89 @@ class DecodeEngine:
                 new_kv[i] = leaf.at[idx, pos_b].set(row)
         return new_kv, new_scales
 
+    def _kv_gather(self, kv, scales, ptab_b, b):
+        """Dense ``[b, max_len, ...]`` compute-dtype views of the paged
+        pool through the slots' page tables (int8: dequantized) — the
+        traced gather that makes paging invisible to ``decode_step``.
+        Unmapped table entries read the scratch page: stale bytes, but
+        the model's position mask never attends past ``pos``."""
+        L, pt, maxp = self._max_len, self._pt, self._maxp
+        out = []
+        if not self._int8:
+            for (trail, _dt), leaf in zip(self._kv_layout, kv):
+                d = leaf[ptab_b]               # [b, maxp, pt, *trail]
+                out.append(d.reshape((b, maxp * pt) + trail)[:, :L])
+            return out
+        from ..ops.registry import get_op
+        deq = get_op("dequantize").fn
+        for (trail, dt), q, s in zip(self._kv_layout, kv, scales):
+            dq = q[ptab_b].reshape((b, maxp * pt) + trail)[:, :L]
+            rs = s[ptab_b].reshape((b, maxp * pt))[:, :L]
+            rb = rs.reshape((b, L) + (1,) * len(trail))
+            out.append(deq(dq, -rb, rb).astype(dt))
+        return out
+
+    def _kv_scatter_rows(self, kv, scales, entries, page_b, off_b, keep_b):
+        """Persist one new k/v row per slot at (page, offset); slots with
+        ``keep_b`` False redirect to the scratch page — old pool bytes
+        are never disturbed, and a page is quantized row-by-row as it
+        fills, so old pages never requantize (int8 grids match the rowed
+        engine's exactly)."""
+        pg = jnp.where(keep_b, page_b, 0)
+        new_kv = list(kv)
+        new_scales = None if scales is None else list(scales)
+        for i, entry in enumerate(entries):
+            if self._int8:
+                q, r = _quantize_rows(entry)
+                new_kv[i] = new_kv[i].at[pg, off_b].set(q)
+                new_scales[i] = new_scales[i].at[pg, off_b].set(r)
+            else:
+                new_kv[i] = new_kv[i].at[pg, off_b].set(
+                    entry.astype(new_kv[i].dtype))
+        return new_kv, new_scales
+
+    def _kv_row_update(self, kv_b, entries, idx, wp, upd):
+        """Refresh a dense gathered view with one sub-step's new rows so
+        the NEXT chained forward sees them without re-gathering the
+        pool. int8 runs the rows through the same quantize->dequantize
+        roundtrip a pool re-gather would apply, so the speculative
+        chain stays bit-identical to step-at-a-time decode."""
+        out = []
+        if not self._int8:
+            for leaf, entry in zip(kv_b, entries):
+                old = leaf[idx, wp]
+                row = jnp.where(_bcast(upd, entry.ndim),
+                                entry.astype(leaf.dtype), old)
+                out.append(leaf.at[idx, wp].set(row))
+            return out
+        from ..ops.registry import get_op
+        deq = get_op("dequantize").fn
+        for (_trail, dt), leaf, entry in zip(self._kv_layout, kv_b,
+                                             entries):
+            q, r = _quantize_rows(entry)
+            rb = _bcast(r, q.ndim)
+            row = deq(q, -rb, rb).astype(dt)
+            old = leaf[idx, wp]
+            out.append(leaf.at[idx, wp].set(
+                jnp.where(_bcast(upd, row.ndim), row, old)))
+        return out
+
+    def _page_of(self, ptab_b, idx, p):
+        """Traced page lookup for position ``p`` (clamped into the
+        table; callers mask overflow to the scratch page via keep)."""
+        chunk = jnp.minimum(p // self._pt, self._maxp - 1)
+        return ptab_b[idx, chunk]
+
     def _get_step_jit(self, b, example_args=None):
         model, pred = self._model, self._pred
         eos, max_len = self._eos, self._max_len
+        pt = self._pt
         engine = self
 
         def build():
             fixed_key = jax.random.PRNGKey(0)
 
-            def pure(carry, param_datas, param_ranges):
+            def pure_rowed(carry, param_datas, param_ranges):
                 from ..gluon.block import _run_traced
                 kv, scales, tok, pos, active, rem = carry
                 pds = pred._traced_params(param_datas, param_ranges)
@@ -716,9 +1276,273 @@ class DecodeEngine:
                 return ((kv, scales, tok, pos, active, rem),
                         (next_tok, done_b, logits))
 
-            return pure
+            def pure_paged(carry, ptab, param_datas, param_ranges):
+                from ..gluon.block import _run_traced
+                kv, scales, tok, pos, active, rem = carry[:6]
+                pds = pred._traced_params(param_datas, param_ranges)
+                act_b, tok_b, pos_b = active[:b], tok[:b], pos[:b]
+                ptab_b, idx = ptab[:b], jnp.arange(b)
+                kv_b = engine._kv_gather(kv, scales, ptab_b, b)
+
+                def body():
+                    return model.decode_step(kv_b, tok_b, pos_b)
+
+                (logits, entries), _aux = _run_traced(
+                    pred._params, pds, fixed_key, False, body)
+                next_tok = jnp.argmax(
+                    jnp.asarray(logits, jnp.float32), axis=-1).astype(
+                        jnp.int32)
+                next_tok = jnp.where(act_b, next_tok, tok_b)
+                new_pos_b = jnp.where(act_b, pos_b + 1, pos_b)
+                rem_b = jnp.where(act_b, rem[:b] - 1, rem[:b])
+                done_b = act_b & ((next_tok == eos) | (rem_b <= 0)
+                                  | (new_pos_b >= max_len))
+                keep = act_b & (pos_b < max_len)
+                page_b = engine._page_of(ptab_b, idx, pos_b)
+                kv, scales = engine._kv_scatter_rows(
+                    kv, scales, entries, page_b, pos_b % pt, keep)
+                tok = tok.at[:b].set(next_tok)
+                pos = pos.at[:b].set(new_pos_b)
+                active = active.at[:b].set(act_b & ~done_b)
+                rem = rem.at[:b].set(rem_b)
+                return ((kv, scales, tok, pos, active, rem) + carry[6:],
+                        (next_tok, done_b, logits))
+
+            return pure_paged if pt else pure_rowed
 
         return self._build_jit("step", b, build,
+                               example_args=example_args)
+
+    def _get_draft_jit(self, b, example_args=None):
+        """The speculative proposer for cohort bucket ``b``: k greedy
+        draft tokens per live slot, chained inside ONE executable over
+        the draft's rowed KV (compiles pinned at ``serving.draft``)."""
+        dmodel, dpred = self._draft_model, self._draft_pred
+        k, max_len = self._spec_k, self._max_len
+
+        def build():
+            fixed_key = jax.random.PRNGKey(0)
+
+            def pure(carry, param_datas, param_ranges):
+                from ..gluon.block import _run_traced
+                tok, pos, active = carry[2], carry[3], carry[4]
+                dkv = list(carry[6])
+                pds = dpred._traced_params(param_datas, param_ranges)
+                act_b, idx = active[:b], jnp.arange(b)
+                cur = tok[:b]
+                props = []
+                # k + 1 feeds for k proposals: the LAST feed exists only
+                # to write d_k's KV row (logits discarded, DCE'd).  On a
+                # full accept the commit's bonus token advances pos past
+                # pos+k, so without that row the draft cache keeps a
+                # permanent hole there and silently diverges after every
+                # clean macro — acceptance decays even with draft==target.
+                for j in range(k + 1):
+                    p_j = pos[:b] + j
+                    dkv_b = [leaf[:b] for leaf in dkv]
+
+                    def body(kv_b=dkv_b, c=cur, p=p_j):
+                        return dmodel.decode_step(kv_b, c, p)
+
+                    (logits, entries), _aux = _run_traced(
+                        dpred._params, pds, fixed_key, False, body)
+                    wp = jnp.minimum(p_j, max_len - 1)
+                    keep = act_b & (p_j < max_len)
+                    for i, entry in enumerate(entries):
+                        old = dkv[i][idx, wp]
+                        row = jnp.where(_bcast(keep, entry.ndim),
+                                        entry.astype(dkv[i].dtype), old)
+                        dkv[i] = dkv[i].at[idx, wp].set(row)
+                    if j < k:
+                        cur = jnp.where(act_b, jnp.argmax(
+                            jnp.asarray(logits, jnp.float32),
+                            axis=-1).astype(jnp.int32), cur)
+                        props.append(cur)
+                return (carry[:6] + (dkv,), jnp.stack(props, axis=1))
+
+            return pure
+
+        return self._build_draft_jit("draft", b, build,
+                                     example_args=example_args)
+
+    def _get_verify_jit(self, b, example_args=None):
+        """The speculative commit for cohort bucket ``b``: the TARGET
+        model ingests the pending token plus the k draft proposals in
+        one chained executable, emits greedy tokens g_1..g_{k+1}, and
+        commits the longest prefix where draft == target — truncated by
+        exactly the non-speculative stopping rule (eos / budget /
+        max_len), so the committed stream is bit-identical to plain
+        greedy decode. Rows written past the commit are stale-but-masked
+        and get overwritten when those positions are really reached.
+
+        The pool is gathered ONCE per macro-step. Models that implement
+        :meth:`DecodeModel.decode_chunk` (f32 engines only) score all
+        k+1 positions in a SINGLE causal forward; otherwise the k+1
+        forwards chain over a dense working copy refreshed row-by-row
+        (``_kv_row_update``). Either way the whole chain's rows write
+        back to the pool in one batched scatter."""
+        model, pred = self._model, self._pred
+        eos, max_len = self._eos, self._max_len
+        pt, k, maxp = self._pt, self._spec_k, self._maxp
+        base = DecodeModel.decode_chunk
+        chunked = (not self._int8) and getattr(
+            type(model), "decode_chunk", base) is not base
+        engine = self
+
+        def build():
+            fixed_key = jax.random.PRNGKey(0)
+
+            def pure(carry, ptab, props, param_datas, param_ranges):
+                from ..gluon.block import _run_traced
+                kv, scales, tok, pos, active, rem = carry[:6]
+                pds = pred._traced_params(param_datas, param_ranges)
+                act_b, tok_b, pos_b = active[:b], tok[:b], pos[:b]
+                rem_b = rem[:b]
+                ptab_b, idx = ptab[:b], jnp.arange(b)
+                kv_b = engine._kv_gather(kv, scales, ptab_b, b)
+                if chunked:
+                    ctoks = jnp.concatenate(
+                        [tok_b[:, None], props], axis=1)   # [b, k+1]
+
+                    def body(kv_j=kv_b, c=ctoks, p=pos_b):
+                        return model.decode_chunk(kv_j, c, p)
+
+                    (logits, entries), _aux = _run_traced(
+                        pred._params, pds, fixed_key, False, body)
+                    outs = jnp.argmax(
+                        jnp.asarray(logits, jnp.float32),
+                        axis=-1).astype(jnp.int32)         # [b, k+1]
+                    stacked = [
+                        e.reshape((b * (k + 1),) + tuple(e.shape[2:]))
+                        for e in entries]
+                else:
+                    cur, gs, rows = tok_b, [], []
+                    for j in range(k + 1):
+                        p_j = pos_b + j
+
+                        def body(kv_j=list(kv_b), c=cur, p=p_j):
+                            return model.decode_step(kv_j, c, p)
+
+                        (logits, entries), _aux = _run_traced(
+                            pred._params, pds, fixed_key, False, body)
+                        rows.append(entries)
+                        gs.append(jnp.argmax(
+                            jnp.asarray(logits, jnp.float32),
+                            axis=-1).astype(jnp.int32))
+                        if j < k:
+                            wp = jnp.minimum(p_j, max_len - 1)
+                            upd = act_b & (p_j < max_len)
+                            kv_b = engine._kv_row_update(
+                                kv_b, entries, idx, wp, upd)
+                            cur = props[:, j]
+                    outs = jnp.stack(gs, axis=1)          # [b, k+1]
+                    stacked = [
+                        jnp.stack([r[i] for r in rows], axis=1).reshape(
+                            (b * (k + 1),) + tuple(rows[0][i].shape[1:]))
+                        for i in range(len(rows[0]))]
+                p_all = pos_b[:, None] + jnp.arange(k + 1)[None, :]
+                keep = (act_b[:, None]
+                        & (p_all < max_len)).reshape(-1)
+                chunk = jnp.minimum(p_all // pt, maxp - 1)
+                page = jnp.take_along_axis(
+                    ptab_b, chunk, axis=1).reshape(-1)
+                off = (p_all % pt).reshape(-1)
+                kv, scales = engine._kv_scatter_rows(
+                    kv, scales, stacked, page, off, keep)
+                acc = jnp.cumprod(
+                    (props == outs[:, :k]).astype(jnp.int32), axis=1)
+                a = jnp.sum(acc, axis=1)              # accepted drafts
+                i1 = jnp.arange(k + 1)[None, :]       # token index - 1
+                stop = (outs == eos) \
+                    | ((rem_b[:, None] - (i1 + 1)) <= 0) \
+                    | ((pos_b[:, None] + i1 + 1) >= max_len)
+                within = (i1 <= a[:, None]) & act_b[:, None]
+                s_in = stop & within
+                prev = jnp.cumsum(s_in, axis=1) - s_in.astype(jnp.int32)
+                emit = within & (prev == 0)
+                counts = jnp.sum(emit.astype(jnp.int32), axis=1)
+                done_b = jnp.any(stop & emit, axis=1)
+                last = jnp.maximum(counts - 1, 0)
+                new_tok = jnp.where(act_b, outs[idx, last], tok_b)
+                new_pos = pos_b + counts
+                tok = tok.at[:b].set(new_tok)
+                pos = pos.at[:b].set(new_pos)
+                active = active.at[:b].set(act_b & ~done_b)
+                rem = rem.at[:b].set(rem_b - counts)
+                masked = jnp.where(emit, outs, -1)
+                # one packed int32 fetch for the host: [b, k+1] masked
+                # emitted tokens | counts | done — three d2h syncs per
+                # macro-step would eat the dispatch savings speculation
+                # exists to win
+                packed = jnp.concatenate(
+                    [masked, counts[:, None],
+                     done_b.astype(jnp.int32)[:, None]], axis=1)
+                return ((kv, scales, tok, pos, active, rem) + carry[6:],
+                        packed)
+
+            return pure
+
+        return self._build_jit("verify", b, build,
+                               example_args=example_args)
+
+    def _get_extend_jit(self, s, example_args=None):
+        """The prefix-hit prefill for seq bucket ``s``: the matched
+        chunks' pages are SHARED (read-only), so only the novel suffix
+        runs — a chained ``decode_step`` loop writing suffix rows into
+        the slot's private pages and emitting the first token from the
+        last prompt position. Prefill skips straight to the first novel
+        token, per ISSUE 16."""
+        model, pred = self._model, self._pred
+        eos, max_len = self._eos, self._max_len
+        pt, maxp = self._pt, self._maxp
+        engine = self
+
+        def build():
+            fixed_key = jax.random.PRNGKey(0)
+
+            def pure(carry, ptab_row, toks, m, n, slot, max_new,
+                     param_datas, param_ranges):
+                from ..gluon.block import _run_traced
+                kv, scales, tok, pos, active, rem = carry[:6]
+                pds = pred._traced_params(param_datas, param_ranges)
+
+                def step_t(t, state):
+                    kv, scales, fl = state
+                    p = m + t
+                    proc = p < n
+                    kv_b = engine._kv_gather(kv, scales, ptab_row[None], 1)
+                    cur = toks[jnp.minimum(p, s - 1)][None]
+
+                    def body(kv_j=kv_b, c=cur, pp=p[None]):
+                        return model.decode_step(kv_j, c, pp)
+
+                    (logits, entries), _aux = _run_traced(
+                        pred._params, pds, fixed_key, False, body)
+                    keep = jnp.asarray(proc & (p < max_len))[None]
+                    chunk = jnp.minimum(p // pt, maxp - 1)
+                    page = ptab_row[chunk][None]
+                    kv, scales = engine._kv_scatter_rows(
+                        kv, scales, entries, page, (p % pt)[None], keep)
+                    fl = jnp.where(p == n - 1,
+                                   jnp.asarray(logits[0], jnp.float32), fl)
+                    return (kv, scales, fl)
+
+                kv, scales, fl = lax.fori_loop(
+                    0, s, step_t,
+                    (kv, scales, jnp.zeros((engine._vocab,), jnp.float32)))
+                first = jnp.argmax(fl).astype(jnp.int32)
+                done0 = (first == eos) | (max_new <= 1) | (n >= max_len)
+                tok = tok.at[slot].set(first)
+                pos = pos.at[slot].set(n)
+                active = active.at[slot].set(~done0)
+                rem = rem.at[slot].set(max_new - 1)
+                out = jnp.stack([first, done0.astype(jnp.int32)])
+                return ((kv, scales, tok, pos, active, rem) + carry[6:],
+                        out)
+
+            return pure
+
+        return self._build_jit("extend", s, build,
                                example_args=example_args)
 
     def _get_insert_jit(self, s, example_args=None):
@@ -727,16 +1551,22 @@ class DecodeEngine:
         index — joining the running cohort never recompiles. Also samples
         the first token from the prefill logits at the prompt's true
         length (and marks the slot done-at-insert when that token already
-        ends the sequence), so time-to-first-token needs no decode step."""
+        ends the sequence), so time-to-first-token needs no decode step.
+        Paged mode instead scatters the prompt's KV page-chunk by
+        page-chunk into the TRACED page ids the host allocated (the
+        prefill -> page handoff); spec mode additionally seeds the
+        draft's rowed KV — the draft prefill runs FUSED inside this
+        executable (prompt tokens + draft params ride as traced args),
+        so admitting a request costs one insert dispatch, not a second
+        Predictor round-trip for the draft."""
         eos, max_len = self._eos, self._max_len
+        pt, spec = self._pt, bool(self._spec_k)
+        dmodel, dpred = self._draft_model, self._draft_pred
         engine = self
 
         def build():
-            def pure(carry, seq_kv, logits, slot, n, max_new):
-                kv, scales, tok, pos, active, rem = carry
-                first = jnp.argmax(jnp.asarray(logits[0, n - 1],
-                                               jnp.float32)).astype(jnp.int32)
-                done0 = (first == eos) | (max_new <= 1) | (n >= max_len)
+            fixed_key = jax.random.PRNGKey(0)
+            def write_rowed(kv, scales, seq_kv, slot):
                 for i, leaf in enumerate(seq_kv):
                     row = leaf[0]                      # [s, *trail]
                     if engine._int8:
@@ -750,14 +1580,91 @@ class DecodeEngine:
                         kv[i] = lax.dynamic_update_slice(
                             kv[i], row[None].astype(kv[i].dtype),
                             (slot,) + (0,) * (kv[i].ndim - 1))
+                return kv, scales
+
+            def write_paged(kv, scales, seq_kv, pages):
+                chunks = int(pages.shape[0])
+                pad = chunks * pt - s
+                for i, leaf in enumerate(seq_kv):
+                    row = leaf[0]                      # [s, *trail]
+                    if pad:
+                        row = jnp.pad(row, ((0, pad),)
+                                      + ((0, 0),) * (row.ndim - 1))
+                    if engine._int8:
+                        q, r = _quantize_rows(row)
+                        qc = q.reshape((chunks, pt) + q.shape[1:])
+                        rc = r.reshape((chunks, pt))
+                        for j in range(chunks):
+                            kv[i] = kv[i].at[pages[j]].set(qc[j])
+                            scales[i] = scales[i].at[pages[j]].set(rc[j])
+                    else:
+                        rc = row.astype(kv[i].dtype).reshape(
+                            (chunks, pt) + row.shape[1:])
+                        for j in range(chunks):
+                            kv[i] = kv[i].at[pages[j]].set(rc[j])
+                return kv, scales
+
+            def finish(carry_rest, tok, pos, active, rem, first, done0,
+                       slot, n, max_new):
                 tok = tok.at[slot].set(first)
                 pos = pos.at[slot].set(n)
                 active = active.at[slot].set(~done0)
                 rem = rem.at[slot].set(max_new - 1)
                 out = jnp.stack([first, done0.astype(jnp.int32)])
+                return carry_rest + (tok, pos, active, rem), out
+
+            def pure_rowed(carry, seq_kv, logits, slot, n, max_new):
+                kv, scales, tok, pos, active, rem = carry
+                first = jnp.argmax(jnp.asarray(logits[0, n - 1],
+                                               jnp.float32)).astype(jnp.int32)
+                done0 = (first == eos) | (max_new <= 1) | (n >= max_len)
+                kv, scales = write_rowed(kv, scales, seq_kv, slot)
+                (kv, scales, tok, pos, active, rem), out = finish(
+                    (kv, scales), tok, pos, active, rem, first, done0,
+                    slot, n, max_new)
                 return (kv, scales, tok, pos, active, rem), out
 
-            return pure
+            def pure_paged(carry, seq_kv, logits, pages, slot, n, max_new):
+                kv, scales, tok, pos, active, rem = carry[:6]
+                first = jnp.argmax(jnp.asarray(logits[0, n - 1],
+                                               jnp.float32)).astype(jnp.int32)
+                done0 = (first == eos) | (max_new <= 1) | (n >= max_len)
+                kv, scales = write_paged(kv, scales, seq_kv, pages)
+                (kv, scales, tok, pos, active, rem), out = finish(
+                    (kv, scales), tok, pos, active, rem, first, done0,
+                    slot, n, max_new)
+                return ((kv, scales, tok, pos, active, rem) + carry[6:],
+                        out)
+
+            def pure_spec(carry, seq_kv, logits, toks, pages, slot, n,
+                          max_new, ddatas, dranges):
+                from ..gluon.block import _run_traced
+                kv, scales, tok, pos, active, rem = carry[:6]
+                dkv = list(carry[6])
+                first = jnp.argmax(jnp.asarray(logits[0, n - 1],
+                                               jnp.float32)).astype(jnp.int32)
+                done0 = (first == eos) | (max_new <= 1) | (n >= max_len)
+                kv, scales = write_paged(kv, scales, seq_kv, pages)
+                dpds = dpred._traced_params(ddatas, dranges)
+
+                def dbody():
+                    return dmodel(NDArray(toks[None, :]))
+
+                dout, _aux = _run_traced(dpred._params, dpds, fixed_key,
+                                         False, dbody)
+                for i, leaf in enumerate(dout[1:]):
+                    row = leaf._data[0][None]          # [1, s, *trail]
+                    dkv[i] = lax.dynamic_update_slice(
+                        dkv[i], row.astype(dkv[i].dtype),
+                        (slot,) + (0,) * (dkv[i].ndim - 1))
+                (kv, scales, tok, pos, active, rem), out = finish(
+                    (kv, scales), tok, pos, active, rem, first, done0,
+                    slot, n, max_new)
+                return ((kv, scales, tok, pos, active, rem, dkv), out)
+
+            if spec:
+                return pure_spec
+            return pure_paged if pt else pure_rowed
 
         return self._build_jit("insert", s, build,
                                example_args=example_args)
@@ -828,9 +1735,15 @@ class DecodeEngine:
                 # admission lock: the loop thread can pop (and
                 # occupy/unqueue) the sequence the instant the lock
                 # releases, and a separate check would let concurrent
-                # submits overshoot the overcommit bound
-                if not self._acct.try_admit(self._tag):
+                # submits overshoot the overcommit bound. Paged mode
+                # admits by real page headroom: the prompt's pages are
+                # reserved here (exact, not worst-case rows) and decode
+                # growth draws page-by-page later.
+                need = 1 if not self._pt \
+                    else -(-min(prompt.size + 1, self._max_len) // self._pt)
+                if not self._acct.try_admit(self._tag, n=need):
                     self._shed("kv_residency")
+                seq.reserved = need if self._pt else 0
             self._pending.append(seq)
             telemetry.gauge("serving.queue_depth",
                             len(self._pending))
@@ -909,8 +1822,7 @@ class DecodeEngine:
                 now = self._clock()
                 if seq.deadline is not None and now > seq.deadline:
                     telemetry.inc("serving.deadline_expired")
-                    if self._acct is not None:
-                        self._acct.unqueue(self._tag)
+                    self._free_seq_ledger(seq, slotted=False)
                     self._fail(seq, DeadlineExceeded(
                         "deadline passed before a KV slot freed (queued "
                         "%.1f ms)" % ((now - seq.t_enq) * 1e3)))
@@ -925,8 +1837,7 @@ class DecodeEngine:
                     # would strand its future forever and leak its
                     # accountant queued count
                     if seq.slot is None and not seq.future.done():
-                        if self._acct is not None:
-                            self._acct.unqueue(self._tag)
+                        self._free_seq_ledger(seq, slotted=False)
                         self._fail(seq, MXNetError(
                             "prefill failed: %s: %s"
                             % (type(e).__name__, e)))
@@ -950,6 +1861,42 @@ class DecodeEngine:
         prompt = seq.prompt if n == s_bucket else np.pad(
             seq.prompt, (0, s_bucket - n),
             constant_values=self._prefill_spec.pad_value)
+        # paged mode: map the prompt's pages BEFORE any device work —
+        # shared prefix chunks attach by refcount (never re-prefilled,
+        # never re-stored), the rest come off the free list against this
+        # sequence's admission reservation
+        m_chunks = 0
+        if self._pt:
+            chunks = -(-n // self._pt)
+            with self._cond:
+                if self._prefix is not None:
+                    m_chunks, pids = self._prefix.lookup(seq.prompt,
+                                                         self._pt)
+                    for pid in pids:
+                        self._share_page_locked(seq, pid)
+                ok = True
+                while len(seq.pages) < chunks:
+                    if self._take_page_locked(seq) is None:
+                        ok = False
+                        break
+                if ok:
+                    self._ptab[slot, :] = 0
+                    self._ptab[slot, :len(seq.pages)] = seq.pages
+                self._page_gauges_locked()
+            if self._prefix is not None:
+                if m_chunks:
+                    telemetry.inc("serving.prefix.hits")
+                else:
+                    telemetry.inc("serving.prefix.misses")
+            if not ok:
+                # page pool exhausted at prefill: shed loud, exactly the
+                # kv_residency degradation row — never a silent park
+                telemetry.inc("serving.shed", tag="kv_residency")
+                self._free_seq_ledger(seq, slotted=False)
+                self._fail(seq, QueueFull(
+                    "request shed: kv_residency (KV page pool exhausted "
+                    "at prefill)"))
+                return
         # the prefill/insert dispatch is device work on the SAME possibly-
         # wedged device the step loop replays: bracket it with its own
         # watchdog entry, or a wedge here would hang the loop thread with
@@ -961,13 +1908,48 @@ class DecodeEngine:
         try:
             with telemetry.trace_handoff(seq.trace):
                 t0 = time.perf_counter()
-                flat, _fmt, _b = self._pred.predict_flat((prompt[None, :],))
                 # numpy scalars, NOT jnp — a jnp.int32() call is an eager
                 # device op per argument, three per insert adds up
-                out, gen, superseded = self._dispatch_carry(
-                    self._get_insert_jit(s_bucket),
-                    [leaf._data for leaf in flat[1:]], flat[0]._data,
-                    np.int32(slot), np.int32(n), np.int32(seq.max_new))
+                if m_chunks:
+                    # prefix HIT: the matched chunks already hold their
+                    # KV — skip the Predictor prefill entirely and extend
+                    # in-place from the first novel token
+                    with self._cond:
+                        ptab_row = self._ptab[slot].copy()
+                    pd, pr = self._pred.param_args()
+                    out, gen, superseded = self._dispatch_carry(
+                        self._get_extend_jit(s_bucket), ptab_row,
+                        prompt.astype(np.int32, copy=False),
+                        np.int32(m_chunks * self._pt), np.int32(n),
+                        np.int32(slot), np.int32(seq.max_new), pd, pr)
+                else:
+                    flat, _fmt, _b = self._pred.predict_flat(
+                        (prompt[None, :],))
+                    if not self._pt:
+                        out, gen, superseded = self._dispatch_carry(
+                            self._get_insert_jit(s_bucket),
+                            [leaf._data for leaf in flat[1:]],
+                            flat[0]._data, np.int32(slot), np.int32(n),
+                            np.int32(seq.max_new))
+                    else:
+                        pages_arg = np.zeros(-(-s_bucket // self._pt),
+                                             np.int32)
+                        pages_arg[:len(seq.pages)] = seq.pages
+                        if self._spec_k:
+                            out, gen, superseded = self._dispatch_carry(
+                                self._get_insert_jit(s_bucket),
+                                [leaf._data for leaf in flat[1:]],
+                                flat[0]._data,
+                                prompt.astype(np.int32, copy=False),
+                                pages_arg, np.int32(slot), np.int32(n),
+                                np.int32(seq.max_new),
+                                *self._draft_pred.param_args())
+                        else:
+                            out, gen, superseded = self._dispatch_carry(
+                                self._get_insert_jit(s_bucket),
+                                [leaf._data for leaf in flat[1:]],
+                                flat[0]._data, pages_arg, np.int32(slot),
+                                np.int32(n), np.int32(seq.max_new))
                 if superseded:
                     # a wedge reset landed mid-insert: this prompt's KV
                     # went into the superseded carry — a wedge casualty,
@@ -998,9 +1980,13 @@ class DecodeEngine:
         telemetry.inc("serving.decode.tokens")
         if int(first_done[1]):
             # done at insert (eos / max_new==1): the slot was marked
-            # inactive in-executable; deliver without ever stepping
-            if self._acct is not None:
-                self._acct.unqueue(self._tag)
+            # inactive in-executable; deliver without ever stepping —
+            # but the prompt's full chunks still publish to the prefix
+            # cache (the cache pin keeps them alive past the deref)
+            if self._pt:
+                with self._cond:
+                    self._register_prefix_locked(seq, m_chunks)
+            self._free_seq_ledger(seq, slotted=False)
             self._deliver(seq)
             return
         with self._cond:
@@ -1015,10 +2001,16 @@ class DecodeEngine:
             else:
                 register = True
                 seq.slot = slot
+                seq.pos = n
                 self._slots[slot] = seq
                 self._live += 1
                 telemetry.gauge("serving.decode.slots", self._live)
-                if self._acct is not None:
+                if self._pt:
+                    # pages moved queued->live one at a time as they were
+                    # taken; what's left is the prefix publication and the
+                    # residency gauges
+                    self._register_prefix_locked(seq, m_chunks)
+                elif self._acct is not None:
                     # inside the lock: a reset landing right after
                     # registration must find the ledger already moved to
                     # live, so its straggler release balances exactly
@@ -1065,25 +2057,82 @@ class DecodeEngine:
                 # unresolved entry would be discarded before it could
                 # trip and the wedge would be swallowed silently
                 return 0
-            hi = max(i for i, s in enumerate(self._slots)
-                     if s is not None) + 1
-            b = self._decode_spec.slot_bucket(hi)
-            live = [s for s in self._slots[:b] if s is not None]
-            idx = self._step_index
-            self._step_index += 1
-            entry = {"live": live, "idx": idx, "done": False,
-                     "abandoned": False,
-                     "deadline": self._clock() + self._timeout_s}
-            self._armed = entry
+            casualties = []
+            if self._pt:
+                # pre-step page allocation: every live sequence must have
+                # a page mapped for each position this step writes (one,
+                # or k+1 under speculation) BEFORE the dispatch — the
+                # executable only gathers/scatters through the table it
+                # is handed. Exhaustion shed a sequence loud; its table
+                # row zeroes so the zombie slot's writes land on the
+                # scratch page until the slot is re-inserted.
+                t_step = 1 + self._spec_k
+                for s in [x for x in self._slots if x is not None]:
+                    hi_chunk = min(s.pos + t_step - 1,
+                                   self._max_len - 1) // self._pt
+                    ok = True
+                    while len(s.pages) <= hi_chunk:
+                        if self._take_page_locked(s) is None:
+                            ok = False
+                            break
+                    if ok:
+                        self._ptab[s.slot, :len(s.pages)] = s.pages
+                    else:
+                        self._ptab[s.slot, :] = 0
+                        self._slots[s.slot] = None
+                        s.slot = None
+                        self._live -= 1
+                        casualties.append(s)
+                        # return the casualty's pages NOW, inside the
+                        # pass — the next lane may need only one of
+                        # them: shed the minimum, not every grower
+                        # caught behind the same dry free list
+                        self._free_seq_ledger(s, slotted=True)
+                if casualties:
+                    telemetry.gauge("serving.decode.slots", self._live)
+                    self._page_gauges_locked()
+            if self._live == 0:
+                alive = False
+            else:
+                alive = True
+                hi = max(i for i, s in enumerate(self._slots)
+                         if s is not None) + 1
+                b = self._decode_spec.slot_bucket(hi)
+                live = [s for s in self._slots[:b] if s is not None]
+                idx = self._step_index
+                self._step_index += 1
+                entry = {"live": live, "idx": idx, "done": False,
+                         "abandoned": False,
+                         "deadline": self._clock() + self._timeout_s}
+                self._armed = entry
+                ptab_snap = self._ptab.copy() if self._pt else None
+        for s in casualties:
+            # pages already came home inside the allocation pass — only
+            # the shed accounting and the loud failure happen here
+            telemetry.inc("serving.shed", tag="kv_residency")
+            self._fail(s, QueueFull(
+                "request shed: kv_residency (KV page pool exhausted "
+                "mid-decode)"))
+        if not alive:
+            return 0
         lead = live[0]
         with telemetry.trace_handoff(lead.trace):
             t0 = time.perf_counter()
             wedged = inject("decode_wedge", idx)
             if not wedged:
                 with telemetry.span("serving.decode", d2h=True):
-                    emitted, _gen, _sup = self._dispatch_carry(
-                        self._get_step_jit(b), self._pred._param_datas,
-                        self._pred._param_ranges)
+                    if self._spec_k:
+                        emitted, _gen, _sup = self._dispatch_spec(
+                            b, ptab_snap)
+                    elif self._pt:
+                        emitted, _gen, _sup = self._dispatch_carry(
+                            self._get_step_jit(b), ptab_snap,
+                            *self._pred.param_args())
+                    else:
+                        emitted, _gen, _sup = self._dispatch_carry(
+                            self._get_step_jit(b),
+                            self._pred._param_datas,
+                            self._pred._param_ranges)
             dt = time.perf_counter() - t0
             for s in live:
                 telemetry.add_stage(s.trace, "serving.decode", dt)
@@ -1094,8 +2143,15 @@ class DecodeEngine:
                 return 1
             t0 = time.perf_counter()
             with telemetry.span("serving.fetch", cat="sync"):
-                toks = NDArray(emitted[0]).asnumpy()
-                done = NDArray(emitted[1]).asnumpy()
+                if self._spec_k:
+                    packed = NDArray(emitted).asnumpy()
+                    toks = packed[:, :self._spec_k + 1]
+                    counts = packed[:, self._spec_k + 1]
+                    done = packed[:, self._spec_k + 2]
+                else:
+                    toks = NDArray(emitted[0]).asnumpy()
+                    counts = None
+                    done = NDArray(emitted[1]).asnumpy()
             dt = time.perf_counter() - t0
             for s in live:
                 telemetry.add_stage(s.trace, "serving.fetch", dt)
@@ -1110,29 +2166,69 @@ class DecodeEngine:
             # the replay counter, or leave superseded-carry logits in the
             # diagnostic probe hook
             return 1
-        self._last_logits = emitted[2]
+        if self._spec_k:
+            # accept-rate accounting: each live lane verified k proposals
+            # and committed counts-1 of them (the +1 is the free token
+            # the verify pass itself produces)
+            telemetry.inc("serving.decode.spec_proposed",
+                          self._spec_k * len(live))
+            telemetry.inc("serving.decode.spec_accepted",
+                          int(sum(max(0, int(counts[s.slot]) - 1)
+                                  for s in live)))
+            self._last_logits = None
+        else:
+            self._last_logits = emitted[2]
         telemetry.inc("serving.decode.steps")
-        self._harvest(live, toks, done)
+        self._harvest(live, toks, done, counts)
         return 1
 
-    def _harvest(self, live, toks, done):
+    def _dispatch_spec(self, b, ptab_snap):
+        """One speculative macro-step: the draft proposes k tokens
+        (rowed draft KV inside the carry), the target verifies the whole
+        chain in one paged executable — two dispatches replace k+1,
+        and the commit rule keeps the emitted stream bit-identical to
+        plain greedy. Composed INSIDE one carry write-back so a wedge
+        reset between the halves supersedes both."""
+        draft_fn = self._get_draft_jit(b)
+        verify_fn = self._get_verify_jit(b)
+
+        def composed(carry, ptab, dpd, dpr, pd, pr):
+            carry, props = draft_fn(carry, dpd, dpr)
+            return verify_fn(carry, ptab, props, pd, pr)
+
+        return self._dispatch_carry(
+            composed, ptab_snap,
+            *self._draft_pred.param_args(), *self._pred.param_args())
+
+    def _harvest(self, live, toks, done, counts=None):
         finished = []
         with self._cond:
             for seq in live:
                 slot = seq.slot
-                seq.tokens.append(int(toks[slot]))
-                telemetry.inc("serving.decode.tokens")
+                if counts is None:
+                    seq.tokens.append(int(toks[slot]))
+                    telemetry.inc("serving.decode.tokens")
+                    seq.pos += 1
+                else:
+                    c = int(counts[slot])
+                    for i in range(c):
+                        seq.tokens.append(int(toks[slot][i]))
+                    telemetry.inc("serving.decode.tokens", c)
+                    seq.pos += c
                 if done[slot]:
                     finished.append(seq)
                     self._slots[slot] = None
+                    if self._pt:
+                        self._ptab[slot, :] = 0
                     seq.slot = None
                     self._live -= 1
             telemetry.gauge("serving.decode.slots", self._live)
+            if self._pt:
+                self._page_gauges_locked()
             if finished:
                 self._cond.notify_all()
         for seq in finished:
-            if self._acct is not None:
-                self._acct.release(self._tag)
+            self._free_seq_ledger(seq, slotted=True)
             self._deliver(seq)
 
     def _deliver(self, seq):
@@ -1161,8 +2257,7 @@ class DecodeEngine:
         the ledger call and the message must never diverge)."""
         if seq.future.done():
             return
-        if self._acct is not None:
-            self._acct.unqueue(self._tag)
+        self._free_seq_ledger(seq, slotted=False)
         self._fail(seq, DeadlineExceeded(
             "cohort reset by the wedge watchdog during this prompt's "
             "slot insert"))
@@ -1197,6 +2292,15 @@ class DecodeEngine:
         # thread that resumes after this teardown must see the carry as
         # superseded — the sequences it would touch are failed HERE
         self._carry_gen += 1
+        if self._pt:
+            # the prefix cache's pins die with the cohort: the teardown
+            # invalidated the device pages they point at, and a stale
+            # entry surviving here would hand a future prompt garbage KV
+            if self._prefix is not None:
+                for pid in self._prefix.drain():
+                    self._decref_locked(pid)
+            self._ptab[:, :] = 0
+            self._page_gauges_locked()
         self._cond.notify_all()
         return dead, slotted
 
@@ -1204,11 +2308,7 @@ class DecodeEngine:
         for seq in dead:
             if seq.future.done():
                 continue  # e.g. the in-flight seq a racing path handled
-            if self._acct is not None:
-                if id(seq) in slotted:
-                    self._acct.release(self._tag)
-                else:
-                    self._acct.unqueue(self._tag)
+            self._free_seq_ledger(seq, id(seq) in slotted)
             self._fail(seq, err)
 
     # ------------------------------------------------------- wedge watchdog
@@ -1276,8 +2376,7 @@ class DecodeEngine:
                 # unqueued — a double decrement)
                 seq = entry["seq"]
                 if not seq.future.done():
-                    if self._acct is not None:
-                        self._acct.unqueue(self._tag)
+                    self._free_seq_ledger(seq, slotted=False)
                     self._fail(seq, DeadlineExceeded(
                         "decode prefill dispatch wedged: no device "
                         "answer within %.0f ms" % (self._timeout_s * 1e3)))
@@ -1305,14 +2404,12 @@ class DecodeEngine:
             % (kind, self._timeout_s * 1e3))
         for seq in stuck:
             telemetry.trace_mark(seq.trace, "serving.wedged")
-            if self._acct is not None:
-                self._acct.release(self._tag)
+            self._free_seq_ledger(seq, slotted=True)
             self._fail(seq, err)
         for seq in queued_stuck:
             telemetry.trace_mark(seq.trace, "serving.wedged")
             if not seq.future.done():
-                if self._acct is not None:
-                    self._acct.unqueue(self._tag)
+                self._free_seq_ledger(seq, slotted=False)
                 self._fail(seq, err)
         with self._cond:
             # the reset kills the WHOLE cohort device state: any live
@@ -1325,6 +2422,18 @@ class DecodeEngine:
             telemetry.gauge("serving.decode.slots", 0)
             self._carry = self._alloc_carry()
             self._carry_gen += 1
+            if self._pt:
+                # the fresh carry's pages are zeroed device-side: drop
+                # the prefix cache's pins (stale KV must never be shared
+                # into a future prompt) and unmap every table row; the
+                # stuck/straggler sequences still hold their page refs —
+                # each _free_seq_ledger below returns them, so the free
+                # list balances without a wholesale rebuild
+                if self._prefix is not None:
+                    for pid in self._prefix.drain():
+                        self._decref_locked(pid)
+                self._ptab[:, :] = 0
+                self._page_gauges_locked()
             if self._thread is not None and self._thread.is_alive():
                 # threaded mode: the loop thread may be BLOCKED in the
                 # wedged device call — give it one timeout window to
@@ -1332,8 +2441,7 @@ class DecodeEngine:
                 self._probation = (now + self._timeout_s, self._cycles)
             self._cond.notify_all()
         for seq in stragglers:
-            if self._acct is not None:
-                self._acct.release(self._tag)
+            self._free_seq_ledger(seq, slotted=True)
             self._fail(seq, err)
 
     # ---------------------------------------------------------------- worker

@@ -361,6 +361,16 @@ class Predictor:
         self._templates = [(tuple(a._data.shape[1:]), a._data.dtype)
                            for a in nds]
 
+    def param_args(self):
+        """The (param_datas, param_ranges) pair every compiled bucket
+        takes as its TRACED trailing arguments. Public seam for engines
+        that compose extra executables over this predictor's parameters
+        (the decode engine's paged prefix-extend and draft/verify jits
+        dispatch with exactly these, so ``refresh_params()`` reaches
+        them without a recompile): always pass the CURRENT pair at
+        dispatch time, never capture the buffers in a closure."""
+        return self._param_datas, self._param_ranges
+
     def _snapshot_params(self):
         """Capture the parameter buffers the jits will run against —
         int8-quantized when the lever is on (shared by _settle and

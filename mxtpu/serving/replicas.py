@@ -485,7 +485,11 @@ class ReplicaSet:
         the retrace sites): ``states()`` then reports each replica's
         resident KV bytes, and the :class:`ReplicaDispatcher` sheds
         ``kv_residency`` when NO healthy replica has admission headroom —
-        overload is judged by cache memory, not queue depth. Returns
+        overload is judged by cache memory, not queue depth. The seam is
+        unit-agnostic: a rowed decode engine registers worst-case slots,
+        a PAGED one registers its page pool (``slots`` = pages,
+        ``page_tokens`` set), and ``would_admit``/``states()`` report
+        real free-page headroom with no dispatcher change. Returns
         self."""
         self._accountant = accountant
         return self
@@ -519,9 +523,18 @@ class ReplicaSet:
                     "probe_at": r.probe_at}
                    for r in self.replicas]
         if acct is not None:
+            snap = acct.snapshot()
             for row in out:
-                row["kv_resident_bytes"] = acct.resident_bytes(
-                    "r%d" % row["replica"])
+                tag = "r%d" % row["replica"]
+                row["kv_resident_bytes"] = acct.resident_bytes(tag)
+                pool = snap.get(tag)
+                if pool is not None and pool.get("page_tokens"):
+                    # paged pools surface their page economics next to
+                    # the byte view: a fleet dispatcher can route on
+                    # free pages, not just bytes
+                    row["kv_page_tokens"] = pool["page_tokens"]
+                    row["kv_pages"] = pool["slots"]
+                    row["kv_pages_live"] = pool["live"]
         return out
 
 

@@ -67,7 +67,8 @@ import threading
 import time
 
 __all__ = ["enabled", "retrace_budget", "inc", "gauge", "observe", "value",
-           "tagged", "reset_metric", "span", "record_d2h", "d2h_count",
+           "tagged", "gauge_value", "reset_metric", "span",
+           "record_d2h", "d2h_count",
            "record_retrace", "retrace_stats", "snapshot", "report",
            "events", "flush", "jsonl_path", "reset",
            "tracing_enabled", "TraceContext", "new_trace", "current_trace",
@@ -259,6 +260,14 @@ def tagged(name):
     with _LOCK:
         return {t: v for (n, t), v in _COUNTERS.items()
                 if n == name and t is not None}
+
+
+def gauge_value(name, tag=None):
+    """Current gauge value, or None when never set (gauges are
+    last-write-wins, so unlike :func:`value` there is no meaningful
+    zero default or cross-tag sum)."""
+    with _LOCK:
+        return _GAUGES.get((name, tag))
 
 
 def reset_metric(name):

@@ -1,0 +1,443 @@
+"""Paged KV cache, prefix reuse, speculative decoding
+(mxtpu/serving/decode, ISSUE 16):
+
+* paged-vs-rowed token parity: greedy streams identical under the
+  block-pool layout (eos / max_new stopping, joiners entering a running
+  cohort) and identical to the eager full-prefix reference;
+* page lifecycle: pages allocate as sequences grow, return to the free
+  list on completion, and the next admission reuses them — page gauges
+  (`serving.kv_page_free/resident/shared`, `serving.kv_resident_tokens`)
+  track the pool;
+* pool exhaustion: admission AND mid-decode growth shed loud
+  (`QueueFull` / `serving.shed{kv_residency}`) with the survivor's
+  stream untouched and the ledger balanced after;
+* prefix cache: refcounted read-only pages under shared-then-diverging
+  prompts — hit/miss counters, shared-page gauge, cache-only pages
+  evict under pressure instead of shedding, token parity throughout;
+* speculative decoding: draft==target and divergent-draft streams both
+  bit-identical to plain greedy, strictly fewer cohort steps, accept
+  counters; int8 spec == int8 paged; k+1 committed in one macro;
+* replay discipline: ZERO post-warmup compiles at `serving.decode` AND
+  `serving.draft`, zero d2h inside the armed span;
+* teardown ledger balance from every path: wedge watchdog (fake
+  clock), crash barrier, close() — no page leaks, free list whole.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from mxtpu import resilience, telemetry
+from mxtpu.base import MXNetError
+from mxtpu.serving import (BucketSpec, DeadlineExceeded, DecodeEngine,
+                           KVCacheAccountant, QueueFull)
+
+from test_decode import (VOCAB, DIM, MAX_LEN, FakeClock,  # noqa: F401
+                         _pspec, _reference_greedy, _run_all, model)
+
+PT = 4
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for var in ("MXTPU_TELEMETRY", "MXTPU_RETRACE_BUDGET",
+                "MXTPU_FAULT_INJECT", "MXTPU_SERVE_INT8",
+                "MXTPU_KV_PAGE_TOKENS", "MXTPU_PREFIX_CACHE",
+                "MXTPU_SPEC_DECODE_K", "MXTPU_SERVE_KV_OVERCOMMIT",
+                "MXTPU_SERVE_DISPATCH_TIMEOUT_MS", "MXTPU_FLIGHT_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    telemetry.reset()
+    resilience.reset_faults()
+    yield
+    telemetry.reset()
+    resilience.reset_faults()
+
+
+def _pengine(model, slots=2, eos=None, int8=False, accountant=None,
+             clock=time.monotonic, timeout_ms=None, max_len=32,
+             page_tokens=PT, pool_pages=None, prefix=False,
+             draft_model=None, spec_k=None):
+    return DecodeEngine(model, _pspec(),
+                        BucketSpec.pow2(decode_slots=slots),
+                        max_len=max_len, eos_id=eos, int8=int8,
+                        continuous=True, accountant=accountant,
+                        clock=clock, dispatch_timeout_ms=timeout_ms,
+                        page_tokens=page_tokens, pool_pages=pool_pages,
+                        prefix_cache=prefix or None,
+                        draft_model=draft_model, spec_k=spec_k,
+                        warmup=True, start=False)
+
+
+def _poll_all(eng, futs, limit=4000):
+    """Drive to completion WITHOUT harvesting results — for workloads
+    where some futures hold a shed exception."""
+    n = 0
+    while not all(f.done() for f in futs) and n < limit:
+        eng.poll()
+        n += 1
+    assert all(f.done() for f in futs)
+
+
+def _pool_balanced(eng):
+    """Every page home, no dangling refs: the teardown-ledger invariant
+    all paths must restore."""
+    return (len(eng._free_pages) == eng._pool_pages
+            and int(eng._page_ref[1:].sum()) == 0)
+
+
+# ----------------------------------------------------- parity with rowed
+def test_paged_matches_eager_reference(model):
+    eng = _pengine(model)
+    prompt = np.array([3, 1, 4, 1, 5], np.int32)
+    out = _run_all(eng, [eng.submit(prompt, max_new=9)])[0]
+    assert out.tolist() == _reference_greedy(model, prompt, 9)
+    assert _pool_balanced(eng)
+
+
+def test_paged_equals_rowed_with_joiners(model):
+    """More requests than slots: joiners land in freed slots mid-run —
+    the paged gather/scatter step must reproduce the rowed streams
+    token for token (stopping included: eos on one, budget on rest)."""
+    rng = np.random.RandomState(3)
+    reqs = [(rng.randint(0, VOCAB, size=rng.randint(2, 9))
+             .astype(np.int32), int(rng.randint(2, 9)))
+            for _ in range(6)]
+
+    def run(page_tokens):
+        eng = DecodeEngine(model, _pspec(),
+                           BucketSpec.pow2(decode_slots=2),
+                           max_len=32, eos_id=7, continuous=True,
+                           page_tokens=page_tokens, warmup=True,
+                           start=False)
+        outs = _run_all(eng, [eng.submit(p, max_new=m) for p, m in reqs])
+        return eng, outs
+
+    peng, paged = run(PT)
+    _, rowed = run(0)
+    for a, b in zip(paged, rowed):
+        assert a.tolist() == b.tolist()
+    assert _pool_balanced(peng)
+
+
+def test_paged_eos_and_budget_stopping(model):
+    eng = _pengine(model, eos=5)
+    prompt = np.arange(4).astype(np.int32)
+    out = _run_all(eng, [eng.submit(prompt, max_new=12)])[0]
+    assert out.tolist() == _reference_greedy(model, prompt, 12, eos=5)
+    if 5 in out.tolist():
+        assert out.tolist().index(5) == len(out) - 1
+
+
+# --------------------------------------------------------- page lifecycle
+def test_page_free_and_reuse(model):
+    eng = _pengine(model, slots=2)
+    p0 = len(eng._free_pages)
+    fut = eng.submit(np.arange(6).astype(np.int32), max_new=6)
+    eng.poll()   # prefill -> slot, prompt pages mapped
+    held = p0 - len(eng._free_pages)
+    assert held >= -(-6 // PT)
+    first_pages = list(eng._slots[0].pages)
+    _run_all(eng, [fut])
+    # completion returned every page
+    assert len(eng._free_pages) == p0 and _pool_balanced(eng)
+    # the next admission draws from the same pool — pages recycle
+    fut2 = eng.submit(np.arange(6).astype(np.int32), max_new=6)
+    eng.poll()
+    assert set(eng._slots[0].pages) & set(first_pages)
+    _run_all(eng, [fut2])
+    assert _pool_balanced(eng)
+
+
+def test_page_gauges_track_pool(model):
+    eng = _pengine(model, slots=2)
+    fut = eng.submit(np.arange(5).astype(np.int32), max_new=6)
+    eng.poll()
+    free = telemetry.gauge_value("serving.kv_page_free")
+    resident = telemetry.gauge_value("serving.kv_page_resident")
+    assert resident >= 2 and free + resident == eng._pool_pages
+    assert telemetry.gauge_value("serving.kv_resident_tokens") >= 5
+    _run_all(eng, [fut])
+    assert telemetry.gauge_value("serving.kv_page_resident") == 0
+    assert telemetry.gauge_value("serving.kv_page_free") == eng._pool_pages
+    assert telemetry.gauge_value("serving.kv_resident_tokens") == 0
+
+
+# -------------------------------------------------------- pool exhaustion
+def test_pool_exhaustion_sheds_at_admission(model):
+    # pool = exactly one max_len sequence's pages: the second admission
+    # finds the free list dry mid-prefill and sheds loud
+    eng = _pengine(model, slots=2, pool_pages=32 // PT)
+    hog = eng.submit(np.arange(12).astype(np.int32), max_new=18)
+    eng.poll()
+    # grow the hog until fewer than a prompt's worth of pages remain,
+    # so the late arrival's slot insert finds the free list dry
+    n = 0
+    while len(eng._free_pages) > 2 and n < 2000:
+        eng.poll()
+        n += 1
+    shed = eng.submit(np.arange(12).astype(np.int32), max_new=4)
+    _poll_all(eng, [hog, shed])
+    with pytest.raises(QueueFull, match="kv_residency"):
+        shed.result(timeout=0)
+    assert telemetry.value("serving.shed", tag="kv_residency") >= 1
+    assert hog.result(timeout=0).tolist() == \
+        _reference_greedy(model, np.arange(12), 18)
+    assert _pool_balanced(eng)
+
+
+def test_pool_exhaustion_mid_decode_sheds_survivor_exact(model):
+    # two growing sequences against a pool that cannot hold both at
+    # full length: one sheds MID-DECODE when its next page allocation
+    # fails; the survivor's stream is untouched and the ledger balances
+    eng = _pengine(model, slots=2, pool_pages=8)
+    pa = np.arange(7).astype(np.int32)
+    pb = (np.arange(7) + 9).astype(np.int32)
+    fa = eng.submit(pa, max_new=12)
+    fb = eng.submit(pb, max_new=12)
+    _poll_all(eng, [fa, fb])
+    results = {}
+    for name, fut, prompt in (("a", fa, pa), ("b", fb, pb)):
+        try:
+            results[name] = fut.result(timeout=0)
+        except QueueFull:
+            results[name] = None
+    shed = [k for k, v in results.items() if v is None]
+    assert len(shed) == 1
+    assert telemetry.value("serving.shed", tag="kv_residency") == 1
+    survivor = "b" if shed == ["a"] else "a"
+    prompt = pb if survivor == "b" else pa
+    assert results[survivor].tolist() == \
+        _reference_greedy(model, prompt, 12)
+    assert _pool_balanced(eng)
+
+
+# ----------------------------------------------------------- prefix cache
+def test_prefix_hit_skips_and_matches(model):
+    tmpl = np.array([2, 9, 4, 11, 6, 1, 8, 3], np.int32)   # 2 full chunks
+    eng = _pengine(model, slots=2, prefix=True)
+    out1 = _run_all(eng, [eng.submit(tmpl, max_new=5)])[0]
+    assert telemetry.value("serving.prefix.misses") >= 1
+    hits0 = telemetry.value("serving.prefix.hits")
+    out2 = _run_all(eng, [eng.submit(tmpl, max_new=5)])[0]
+    assert telemetry.value("serving.prefix.hits") > hits0
+    # the hit path skipped prefill work but NOT correctness
+    ref = _reference_greedy(model, tmpl, 5)
+    assert out1.tolist() == ref and out2.tolist() == ref
+    # cache pins survive completion: pinned pages stay off the free list
+    assert len(eng._free_pages) < eng._pool_pages
+    assert int(eng._page_ref[1:].sum()) == len(eng._prefix)
+
+
+def test_prefix_refcount_shared_then_diverging(model):
+    tmpl = np.array([2, 9, 4, 11, 6, 1, 8, 3], np.int32)
+    sfx_a = np.array([40, 41], np.int32)
+    sfx_b = np.array([42, 43, 44], np.int32)
+    eng = _pengine(model, slots=2, prefix=True)
+    # publish the template's chunks
+    _run_all(eng, [eng.submit(tmpl, max_new=3)])
+    fa = eng.submit(np.concatenate([tmpl, sfx_a]), max_new=4)
+    fb = eng.submit(np.concatenate([tmpl, sfx_b]), max_new=4)
+    eng.poll()
+    eng.poll()
+    # both live: the template pages are cache-pinned AND doubly shared
+    assert (telemetry.gauge_value("serving.kv_page_shared") or 0) >= 2
+    assert int(np.sum(eng._page_ref[1:] >= 3)) >= 1
+    outs = _run_all(eng, [fa, fb])
+    assert outs[0].tolist() == _reference_greedy(
+        model, np.concatenate([tmpl, sfx_a]), 4)
+    assert outs[1].tolist() == _reference_greedy(
+        model, np.concatenate([tmpl, sfx_b]), 4)
+    # divergent suffixes never wrote a shared page: refs fall back to
+    # the cache's own pins only
+    assert int(eng._page_ref[1:].sum()) == len(eng._prefix)
+
+
+def test_prefix_cache_evicts_under_pressure_not_shed(model):
+    # fill the cache, then admit a stranger that needs the pinned pages:
+    # cache-only pages evict (LRU) instead of shedding the stranger
+    eng = _pengine(model, slots=1, prefix=True, pool_pages=8)
+    tmpl = np.array([2, 9, 4, 11, 6, 1, 8, 3], np.int32)
+    _run_all(eng, [eng.submit(tmpl, max_new=3)])
+    cached = len(eng._prefix)
+    assert cached >= 1
+    stranger = (np.arange(12) + 20).astype(np.int32)
+    # the stranger grows to 30 tokens = 8 pages — the WHOLE pool — so it
+    # can only complete if the cache's 2 pinned pages evict on demand:
+    # finishing with zero sheds IS the eviction proof
+    out = _run_all(eng, [eng.submit(stranger, max_new=18)], limit=4000)[0]
+    assert out.tolist() == _reference_greedy(model, stranger, 18)
+    assert telemetry.value("serving.shed", tag="kv_residency") == 0
+    # pins stayed consistent: every surviving entry still holds exactly
+    # its one cache reference
+    assert int(eng._page_ref[1:].sum()) == len(eng._prefix)
+
+
+# ---------------------------------------------------- speculative decoding
+def test_spec_matches_greedy_and_takes_fewer_steps(model):
+    rng = np.random.RandomState(5)
+    reqs = [(rng.randint(0, VOCAB, size=rng.randint(2, 9))
+             .astype(np.int32), 12) for _ in range(3)]
+
+    def run(spec):
+        telemetry.reset()
+        eng = _pengine(model, slots=2,
+                       draft_model=model if spec else None,
+                       spec_k=3 if spec else None)
+        outs = _run_all(eng, [eng.submit(p, max_new=m) for p, m in reqs])
+        return eng, outs, telemetry.value("serving.decode.steps")
+
+    peng, plain, steps_plain = run(False)
+    seng, spec, steps_spec = run(True)
+    for a, b in zip(plain, spec):
+        assert a.tolist() == b.tolist()
+    assert steps_spec < steps_plain
+    assert _pool_balanced(peng) and _pool_balanced(seng)
+
+
+def test_spec_accept_counters_near_perfect_selfdraft(model):
+    # draft == target: with the d_k row backfilled every macro, the only
+    # non-accepts are final-macro budget truncation
+    eng = _pengine(model, draft_model=model, spec_k=3)
+    prompt = np.array([1, 2, 3], np.int32)
+    out = _run_all(eng, [eng.submit(prompt, max_new=17)])[0]
+    assert out.tolist() == _reference_greedy(model, prompt, 17)
+    proposed = telemetry.value("serving.decode.spec_proposed")
+    accepted = telemetry.value("serving.decode.spec_accepted")
+    assert proposed > 0
+    assert accepted / proposed >= 0.75
+    assert _pool_balanced(eng)
+
+
+def test_spec_divergent_draft_still_exact(model):
+    # a draft that disagrees (different seed) costs acceptance, NEVER
+    # tokens: the commit rule truncates at the first mismatch
+    import serve_bench as sb
+    other = sb.build_decode_model(vocab=VOCAB, dim=DIM, max_len=MAX_LEN,
+                                  seed=99)
+    eng = _pengine(model, draft_model=other, spec_k=3)
+    prompt = np.array([4, 4, 2, 7], np.int32)
+    out = _run_all(eng, [eng.submit(prompt, max_new=10)])[0]
+    assert out.tolist() == _reference_greedy(model, prompt, 10)
+    proposed = telemetry.value("serving.decode.spec_proposed")
+    accepted = telemetry.value("serving.decode.spec_accepted")
+    assert 0 <= accepted < proposed
+    assert _pool_balanced(eng)
+
+
+def test_spec_int8_matches_int8_paged(model):
+    # int8 engines chain the verify through the SAME per-row quantize
+    # grids the step path writes, so int8+spec == int8 paged bit for bit
+    prompt = np.array([6, 3, 9, 1], np.int32)
+
+    def run(spec):
+        eng = _pengine(model, int8=True,
+                       draft_model=model if spec else None,
+                       spec_k=3 if spec else None)
+        return _run_all(eng, [eng.submit(prompt, max_new=10)])[0]
+
+    assert run(False).tolist() == run(True).tolist()
+
+
+def test_spec_requires_paged_and_draft(model):
+    with pytest.raises(MXNetError, match="needs paged"):
+        _pengine(model, page_tokens=0, draft_model=model, spec_k=3)
+    with pytest.raises(MXNetError, match="draft_model"):
+        _pengine(model, spec_k=3)
+    with pytest.raises(MXNetError, match="power of two"):
+        _pengine(model, page_tokens=3)
+    with pytest.raises(MXNetError, match="one "):
+        _pengine(model, prefix=True, draft_model=model, spec_k=2)
+
+
+# ------------------------------------------------------- replay discipline
+def test_zero_postwarmup_compiles_and_no_d2h_both_sites(model):
+    eng = _pengine(model, slots=2, draft_model=model, spec_k=3)
+    c0 = (telemetry.retrace_stats(eng._site) or {}).get("compiles", 0)
+    d0 = (telemetry.retrace_stats(eng._draft_site) or {}).get(
+        "compiles", 0)
+    rng = np.random.RandomState(11)
+    futs = [eng.submit(rng.randint(0, VOCAB, size=rng.randint(2, 12))
+                       .astype(np.int32), max_new=int(rng.randint(2, 11)))
+            for _ in range(5)]
+    _run_all(eng, futs)
+    assert (telemetry.retrace_stats(eng._site) or {}).get(
+        "compiles", 0) == c0
+    assert (telemetry.retrace_stats(eng._draft_site) or {}).get(
+        "compiles", 0) == d0
+    assert telemetry.value("serving.decode.d2h") == 0
+
+
+# ------------------------------------------------- teardown ledger balance
+def test_wedge_teardown_releases_pages(model, monkeypatch, tmp_path):
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "decode_wedge@1")
+    clock = FakeClock()
+    acct = KVCacheAccountant(overcommit=50.0)
+    eng = _pengine(model, slots=2, clock=clock, timeout_ms=100.0,
+                   accountant=acct)
+    stuck = [eng.submit(np.arange(3).astype(np.int32), max_new=6)
+             for _ in range(2)]
+    eng.poll()          # step 0 clean
+    eng.poll()          # step 1 wedges
+    clock.advance(0.2)
+    eng.poll()          # watchdog trips: casualties torn down
+    for f in stuck:
+        assert f.done()
+        with pytest.raises(DeadlineExceeded):
+            f.result(timeout=0)
+    # every page came home through the one teardown ledger
+    assert _pool_balanced(eng)
+    snap = acct.snapshot()["r0"]
+    assert snap["live"] == 0 and snap["queued"] == 0
+    assert acct.resident_bytes("r0") == 0
+    # and the engine still serves correctly on recycled pages
+    out = _run_all(eng, [eng.submit(np.arange(4).astype(np.int32),
+                                    max_new=3)])[0]
+    assert out.tolist() == _reference_greedy(model, np.arange(4), 3)
+    assert _pool_balanced(eng)
+
+
+def test_crash_barrier_releases_pages(model, monkeypatch):
+    acct = KVCacheAccountant(overcommit=50.0)
+    eng = _pengine(model, slots=1, accountant=acct)
+    eng.start()
+    try:
+        monkeypatch.setattr(
+            eng, "_harvest",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+        fut = eng.submit(np.arange(3).astype(np.int32), max_new=4)
+        with pytest.raises(MXNetError, match="decode loop crashed"):
+            fut.result(timeout=30.0)
+    finally:
+        eng.close(timeout=5.0)
+    assert _pool_balanced(eng)
+    snap = acct.snapshot()["r0"]
+    assert snap["live"] == 0 and snap["queued"] == 0
+    assert acct.resident_bytes("r0") == 0
+
+
+def test_close_releases_pages_and_prefix_pins(model):
+    acct = KVCacheAccountant(overcommit=50.0)
+    eng = _pengine(model, slots=2, prefix=True, accountant=acct)
+    tmpl = np.array([2, 9, 4, 11, 6, 1, 8, 3], np.int32)
+    _run_all(eng, [eng.submit(tmpl, max_new=3)])
+    assert len(eng._prefix) >= 1          # cache holds pins
+    eng.submit(np.arange(5).astype(np.int32), max_new=6)
+    eng.poll()                            # one live slot holding pages
+    eng.close(timeout=5.0)
+    assert len(eng._prefix) == 0
+    assert _pool_balanced(eng)
+    assert acct.resident_bytes("r0") == 0
+
+
+def test_env_lever_page_tokens(model, monkeypatch):
+    monkeypatch.setenv("MXTPU_KV_PAGE_TOKENS", "8")
+    eng = DecodeEngine(model, _pspec(),
+                       BucketSpec.pow2(decode_slots=2),
+                       max_len=32, warmup=True, start=False)
+    assert eng._pt == 8
+    prompt = np.arange(5).astype(np.int32)
+    out = _run_all(eng, [eng.submit(prompt, max_new=6)])[0]
+    assert out.tolist() == _reference_greedy(model, prompt, 6)
+    assert _pool_balanced(eng)

@@ -272,10 +272,11 @@ def test_all_rules_ran_over_repo():
         "env-var-catalog", "metric-name-catalog"}
 
 
-def test_jit_surface_inventory_lists_all_five_caches():
-    """The inventory is ROADMAP item 5's scouting report: all five jit
+def test_jit_surface_inventory_lists_all_six_caches():
+    """The inventory is ROADMAP item 5's scouting report: all six jit
     caches (FusedUpdater, CachedOp, symbol executor, serving Predictor,
-    serving DecodeEngine) must appear with their retrace sites, and no
+    serving DecodeEngine target family, serving DecodeEngine draft
+    family) must appear with their retrace sites, and no
     site may be anonymous. Since ISSUE 7 the fused_optimizer cache is
     ALSO the mesh-native Trainer's cache — its declared key must carry
     the sharding component (MeshPlan fingerprint + per-buffer sharding
@@ -288,12 +289,15 @@ def test_jit_surface_inventory_lists_all_five_caches():
     (serving.decode — step executables per cohort-capacity bucket +
     insert executables per prefill seq bucket) joins the same way: its
     declaration must spell out the AOT discipline (post-warmup compiles
-    zero, donated carry)."""
+    zero, donated carry). Since ISSUE 16 the speculative-decoding DRAFT
+    cache (serving.draft — k-token proposal executables per cohort
+    bucket) is the sixth entry: an out-of-band draft jit fails CI."""
     inv = _repo_result().jit_inventory
     sites = {e["retrace_site"] for e in inv}
     assert {"fused_optimizer", "cached_op", "executor",
             "executor.backward", "subgraph_exec", "parallel.train_step",
-            "rtc", "serving.predict", "serving.decode"} <= sites, sites
+            "rtc", "serving.predict", "serving.decode",
+            "serving.draft"} <= sites, sites
     assert None not in sites and "<dynamic>" not in sites
     # ISSUE 15: the unified compile service is under EVERY jit surface —
     # an inventory entry without the service seam is an out-of-band
@@ -325,6 +329,15 @@ def test_jit_surface_inventory_lists_all_five_caches():
     assert "policy_key" in decode["cache_key"], decode
     assert "bucket" in decode["cache_key"], decode
     assert "donated" in decode["cache_key"], decode
+    # ISSUE 16: the paged step family rides the same front door (page
+    # table as a traced argument, never a new executable) and the draft
+    # cache is declared at its own site with the same AOT discipline
+    assert "page_tokens" in decode["cache_key"], decode
+    draft = by_site["serving.draft"]
+    assert draft["file"] == "mxtpu/serving/decode.py", draft
+    assert draft["allowlisted"] is True
+    assert "policy_key" in draft["cache_key"], draft
+    assert "spec_k" in draft["cache_key"], draft
 
 
 # ------------------------------------------------------------------------ CLI

@@ -264,11 +264,14 @@ def test_ledger_covers_graftlint_inventory():
     Predictor(snet, BucketSpec([2]),
               example=np.zeros((1, 5), np.float32), warmup=True)
 
-    # serving.decode: a warmed tiny decode engine
+    # serving.decode + serving.draft: a warmed tiny SPECULATIVE paged
+    # engine compiles the whole six-caches inventory's serving tail —
+    # the draft site only exists when a draft model is attached
     import serve_bench as sb
     model = sb.build_decode_model(vocab=16, dim=8, max_len=16, seed=3)
     DecodeEngine(model, BucketSpec([1], seq_lens=[4]),
                  BucketSpec(decode_slots=[2]), max_len=8,
+                 page_tokens=4, draft_model=model, spec_k=2,
                  warmup=True, start=False)
 
     runtime_sites = _sites_of(xprof.ledger(resolve=False))

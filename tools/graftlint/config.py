@@ -91,18 +91,41 @@ JIT_ALLOWLIST: Dict[Tuple[str, str], Dict[str, str]] = {
         "reason": "DecodeEngine._build_jit is the single compile front "
                   "door for the decode cache (step executables per cohort "
                   "capacity bucket + insert executables per prefill seq "
-                  "bucket); it calls telemetry.record_retrace(self._site, "
+                  "bucket, and in paged mode the verify/extend family "
+                  "over the same buckets); it calls "
+                  "telemetry.record_retrace(self._site, "
                   "...) on every miss before jax.jit — the site name is "
                   "per-INSTANCE (default serving.decode) so the static "
                   "rule sees '<dynamic>' and this entry declares the base "
                   "site for the inventory",
-        "cache_key": "(kind step|insert, cohort-capacity-or-seq bucket, "
-                     "int8 flag) + registry.policy_key — one executable "
+        "cache_key": "(kind step|insert|verify|extend, "
+                     "cohort-capacity-or-seq bucket, int8 flag, "
+                     "page_tokens, pool_pages, spec_k, draft kv layout) "
+                     "+ registry.policy_key — one executable "
                      "cache per DecodeEngine instance at site "
                      "serving.decode; post-warmup compiles are ZERO by "
                      "construction (every bucket AOT-compiled in "
                      "warmup()), carry state donated per step so replay "
-                     "never allocates",
+                     "never allocates; the page table rides as a TRACED "
+                     "gather/scatter index argument, never a new shape",
+    },
+    ("mxtpu/serving/decode.py", "_build_draft_jit"): {
+        "site": "serving.draft",
+        "reason": "DecodeEngine._build_draft_jit is the compile front "
+                  "door for the speculative-decoding DRAFT executables "
+                  "(k-token proposal loop per cohort capacity bucket); "
+                  "it resolves every miss through "
+                  "compile_service.get_or_build at the engine's draft "
+                  "site (default serving.draft — per-INSTANCE, so the "
+                  "static rule sees '<dynamic>') and is AOT-warmed by "
+                  "warmup() exactly like the target-family buckets; an "
+                  "out-of-band draft jit anywhere else is a finding",
+        "cache_key": "(kind draft, cohort capacity bucket, spec_k, draft "
+                     "kv layout, vocab, draft param specs) + "
+                     "registry.policy_key — the sixth entry in the "
+                     "caches inventory; post-warmup compiles at "
+                     "serving.draft are ZERO (watchdog-pinned by the "
+                     "decode bench gate)",
     },
     ("mxtpu/optimizer_fused.py", "_build_guarded"): {
         "site": "fused_optimizer",

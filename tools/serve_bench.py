@@ -74,9 +74,24 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _paged_page_tokens_default():
+    """``BENCH_DECODE_PAGED_PAGE_TOKENS``: page size for the decode
+    bench's paged phases (pow2 tokens per page)."""
+    return int(os.environ.get("BENCH_DECODE_PAGED_PAGE_TOKENS", "4"))
+
+
+def _paged_spec_k_default():
+    """``BENCH_DECODE_PAGED_SPEC_K``: draft proposal depth for the
+    decode bench's speculative phase."""
+    return int(os.environ.get("BENCH_DECODE_PAGED_SPEC_K", "3"))
+
+
 def _stamp(rec):
     """Platform + active policy levers on every line (bench.py contract:
-    a CPU-fallback artifact must be distinguishable from a chip run)."""
+    a CPU-fallback artifact must be distinguishable from a chip run).
+    Since the paged-KV phases, the page size and speculation depth ride
+    every line too — a regression hunt must know which layout produced
+    a number without joining against the summary line."""
     try:
         import jax
         rec.setdefault("platform", jax.devices()[0].platform)
@@ -87,6 +102,8 @@ def _stamp(rec):
         rec.setdefault("policy_key", list(policy_key()))
     except Exception:  # noqa: BLE001
         rec.setdefault("policy_key", None)
+    rec.setdefault("page_tokens", _paged_page_tokens_default())
+    rec.setdefault("spec_k", _paged_spec_k_default())
     return rec
 
 
@@ -213,6 +230,39 @@ def build_decode_model(vocab=96, dim=32, max_len=96, seed=0):
             logits = (x + h) @ self.wout.data()._data
             return logits, [k_new, v_new]
 
+        def decode_chunk(self, kv, toks, pos):
+            # speculative verify fast path: all t chained tokens in one
+            # causal forward — queries attend cache rows < pos plus the
+            # chunk's own earlier rows (two-block concat softmax, so the
+            # chunk never scatters into the cache view)
+            import jax
+            import jax.numpy as jnp
+            k_cache, v_cache = kv                       # [c, L, d]
+            L, t = k_cache.shape[1], toks.shape[1]
+            p = pos[:, None] + jnp.arange(t)[None]      # [c, t]
+            wp = jnp.minimum(p, max_len - 1)
+            x = self.embed.data()._data[toks] \
+                + self.posemb.data()._data[wp]          # [c, t, d]
+            q = x @ self.wq.data()._data
+            k_new = x @ self.wk.data()._data
+            v_new = x @ self.wv.data()._data
+            sc = jnp.einsum("ctd,cld->ctl", q, k_cache) \
+                / float(dim) ** 0.5
+            sc = jnp.where(
+                jnp.arange(L)[None, None, :] < pos[:, None, None],
+                sc, -1e30)
+            sn = jnp.einsum("ctd,cud->ctu", q, k_new) \
+                / float(dim) ** 0.5
+            sn = jnp.where(jnp.tril(jnp.ones((t, t), jnp.bool_))[None],
+                           sn, -1e30)
+            attn = jax.nn.softmax(
+                jnp.concatenate([sc, sn], axis=-1), axis=-1)
+            h = (jnp.einsum("ctl,cld->ctd", attn[..., :L], v_cache)
+                 + jnp.einsum("ctu,cud->ctd", attn[..., L:], v_new)) \
+                @ self.wo.data()._data
+            logits = (x + h) @ self.wout.data()._data
+            return logits, [k_new, v_new]
+
     net = TinyCausalLM(prefix="decodebench_")
     # seeded init: the int8 logits-parity numbers must be a property of
     # the quantization path, not of this run's weight draw
@@ -223,10 +273,14 @@ def build_decode_model(vocab=96, dim=32, max_len=96, seed=0):
 
 def build_decode_engine(model, slots=4, max_prompt=24, max_new=24,
                         int8=False, continuous=True, accountant=None,
-                        start=False, clock=time.monotonic):
+                        start=False, clock=time.monotonic, page_tokens=0,
+                        pool_pages=None, prefix_cache=None,
+                        draft_model=None, spec_k=None):
     """A warmed DecodeEngine over the bench LM: prefill seq buckets up to
     ``max_prompt``, a pow2 cohort-capacity ladder up to ``slots``, cache
-    length sized for the longest prompt + generation budget."""
+    length sized for the longest prompt + generation budget.
+    ``page_tokens`` > 0 selects the paged-KV layout (with optional
+    ``pool_pages`` budget, prefix cache, or a speculative draft)."""
     from mxtpu.serving import BucketSpec, DecodeEngine
 
     pspec = BucketSpec([1], seq_lens=[max(4, max_prompt // 2), max_prompt])
@@ -234,7 +288,9 @@ def build_decode_engine(model, slots=4, max_prompt=24, max_new=24,
     return DecodeEngine(model, pspec, dspec, max_len=max_prompt + max_new,
                         int8=int8, continuous=continuous,
                         accountant=accountant, warmup=True, start=start,
-                        clock=clock)
+                        clock=clock, page_tokens=page_tokens,
+                        pool_pages=pool_pages, prefix_cache=prefix_cache,
+                        draft_model=draft_model, spec_k=spec_k)
 
 
 def _decode_workload(n_requests, vocab, max_prompt, max_new, seed=11):
@@ -255,7 +311,7 @@ def _decode_workload(n_requests, vocab, max_prompt, max_new, seed=11):
 
 
 def run_decode(n_requests=80, slots=8, max_new=32, vocab=256, dim=128,
-               max_prompt=48, emit=_emit):
+               max_prompt=48, emit=_emit, page_tokens=None, spec_k=None):
     """The ISSUE-11 acceptance phase: continuous batching vs
     restart-per-batch decode at EQUAL cohort capacity, identical
     workload, identical executables. Gates (summary line ``ok``):
@@ -271,18 +327,32 @@ def run_decode(n_requests=80, slots=8, max_new=32, vocab=256, dim=128,
                                max_len=max_prompt + max_new)
     reqs = _decode_workload(n_requests, vocab, max_prompt, max_new)
 
-    def drive(continuous, int8=False, rounds=2):
+    def drive(continuous, int8=False, rounds=2, reqs_use=None,
+              slots_use=None, page_tokens=0, pool_pages=None,
+              prefix=False, spec_k=0, track_residency=False):
         # ledger KV bytes but never shed: the closed-loop burst queues the
         # whole workload up front by design (the kv_residency shed path
         # has its own default-overcommit coverage in tests/test_decode.py)
-        acct = KVCacheAccountant(overcommit=float(n_requests))
-        eng = build_decode_engine(model, slots=slots, max_prompt=max_prompt,
+        my_reqs = reqs if reqs_use is None else reqs_use
+        acct = KVCacheAccountant(overcommit=float(n_requests) * 64)
+        eng = build_decode_engine(model,
+                                  slots=slots if slots_use is None
+                                  else slots_use,
+                                  max_prompt=max_prompt,
                                   max_new=max_new, int8=int8,
-                                  continuous=continuous, accountant=acct)
+                                  continuous=continuous, accountant=acct,
+                                  page_tokens=page_tokens,
+                                  pool_pages=pool_pages,
+                                  prefix_cache=prefix or None,
+                                  draft_model=model if spec_k else None,
+                                  spec_k=spec_k or None)
         st0 = telemetry.retrace_stats(eng._site) or {}
+        std0 = telemetry.retrace_stats(eng._draft_site) or {} \
+            if spec_k else {}
         steps0 = telemetry.value("serving.decode.steps")
         toks0 = telemetry.value("serving.decode.tokens")
         d2h0 = telemetry.value("serving.decode.d2h")
+        live_high = shared_high = 0
         best = None
         # best-of-rounds, like run_sweep: one round on a shared host
         # measures scheduler noise, not the replay cost the gate judges
@@ -292,10 +362,16 @@ def run_decode(n_requests=80, slots=8, max_new=32, vocab=256, dim=128,
             r_steps0 = telemetry.value("serving.decode.steps")
             r_toks0 = telemetry.value("serving.decode.tokens")
             t0 = time.perf_counter()
-            futs = [eng.submit(p, max_new=m) for p, m in reqs]
+            futs = [eng.submit(p, max_new=m) for p, m in my_reqs]
             guard = 0
             while not all(f.done() for f in futs) and guard < 100000:
                 eng.poll()
+                if track_residency:
+                    live_high = max(live_high, eng._live)
+                    shared_high = max(
+                        shared_high,
+                        telemetry.gauge_value("serving.kv_page_shared")
+                        or 0)
                 guard += 1
             wall = time.perf_counter() - t0
             outs = [f.result(timeout=5) for f in futs]
@@ -314,9 +390,13 @@ def run_decode(n_requests=80, slots=8, max_new=32, vocab=256, dim=128,
             if best is None or round_rec["tok_per_s"] > best["tok_per_s"]:
                 best = round_rec
         st = telemetry.retrace_stats(eng._site) or {}
+        std = telemetry.retrace_stats(eng._draft_site) or {} \
+            if spec_k else {}
         best.update({
             "compiles_post_warmup": st.get("compiles", 0)
             - st0.get("compiles", 0),
+            "draft_compiles_post_warmup": std.get("compiles", 0)
+            - std0.get("compiles", 0),
             "watchdog_trips": st.get("trips", 0) - st0.get("trips", 0),
             "per_slot_kv_bytes": eng.per_slot_kv_bytes(),
             "total_steps": telemetry.value("serving.decode.steps") - steps0,
@@ -325,6 +405,8 @@ def run_decode(n_requests=80, slots=8, max_new=32, vocab=256, dim=128,
             # delta like every sibling gate: a cumulative read would fail
             # forever after any earlier in-process sync
             "d2h": telemetry.value("serving.decode.d2h") - d2h0,
+            "live_high": live_high,
+            "shared_pages_high": shared_high,
         })
         eng.close(timeout=5)
         return best, outs, eng
@@ -372,6 +454,123 @@ def run_decode(n_requests=80, slots=8, max_new=32, vocab=256, dim=128,
           "admit_multiplier": round(1.0 / kv_ratio, 2),
           "int8_ok": int8_ok})
 
+    # ---- ISSUE-16 paged phases: A/B at equal HBM, prefix reuse, spec --
+    pt = int(page_tokens if page_tokens is not None
+             else _paged_page_tokens_default())
+    k = int(spec_k if spec_k is not None else _paged_spec_k_default())
+    max_len = max_prompt + max_new
+    rng = np.random.RandomState(29)
+    # equal-HBM A/B: the paged pool holds EXACTLY the rowed engine's
+    # bytes (slots_r worst-case rows, repaginated), and the cohort table
+    # offers as many lanes as that pool can carry at the A/B workload's
+    # worst-case footprint (+1 page of speculative-lookahead headroom) —
+    # short sequences against a long max_len is precisely the regime
+    # where rowed residency pays for pessimism and paging does not
+    slots_r = 2
+    pool_pages = slots_r * max_len // pt
+    ab_p_max, ab_g_max = 8, 8
+    pages_worst = -(-min(ab_p_max - 1 + ab_g_max, max_len) // pt) + 1
+    slots_p = min(3 * slots_r, max(slots_r, pool_pages // pages_worst))
+    ab_reqs = _decode_workload(min(n_requests, 24), vocab,
+                               max_prompt=ab_p_max, max_new=ab_g_max,
+                               seed=13)
+    row_ab, row_outs, _ = drive(True, reqs_use=ab_reqs, slots_use=slots_r,
+                                track_residency=True)
+    pag_ab, pag_outs, _ = drive(True, reqs_use=ab_reqs, slots_use=slots_p,
+                                page_tokens=pt, pool_pages=pool_pages,
+                                track_residency=True)
+    ab_parity = all(len(a) == len(b) and (a == b).all()
+                    for a, b in zip(row_outs, pag_outs))
+    residency_x = pag_ab["live_high"] / float(max(1, row_ab["live_high"]))
+    ab_ok = (residency_x >= 2.0 and ab_parity
+             and pag_ab["compiles_post_warmup"] == 0
+             and pag_ab["d2h"] == 0)
+    emit({"metric": "serve_decode_paged_ab", "value": round(residency_x, 2),
+          "unit": "residency_multiplier_at_equal_hbm",
+          "rowed_live_high": row_ab["live_high"],
+          "paged_live_high": pag_ab["live_high"],
+          "pool_pages": pool_pages,
+          "hbm_budget_bytes": slots_r * row_ab["per_slot_kv_bytes"],
+          "rowed_tok_per_s": round(row_ab["tok_per_s"], 1),
+          "paged_tok_per_s": round(pag_ab["tok_per_s"], 1),
+          "token_parity_paged_vs_rowed": ab_parity,
+          "compiles_post_warmup": pag_ab["compiles_post_warmup"],
+          "d2h": pag_ab["d2h"], "ok_ab": ab_ok})
+
+    # prefix reuse under a templated-prompt cohort: one shared system
+    # template, short novel suffixes — the hit path skips the template's
+    # prefill and shares its pages read-only
+    tmpl_len = max(1, (max_prompt // 2) // pt) * pt
+    sfx_hi = min(7, max_prompt - tmpl_len + 1)
+    tmpl = rng.randint(0, vocab, size=tmpl_len).astype(np.int32)
+    pre_reqs = [(np.concatenate([
+        tmpl, rng.randint(0, vocab,
+                          size=rng.randint(2, sfx_hi)).astype(np.int32)]),
+        int(rng.randint(2, 9))) for _ in range(min(n_requests, 16))]
+    hits0 = telemetry.value("serving.prefix.hits") or 0
+    miss0 = telemetry.value("serving.prefix.misses") or 0
+    ref_pre, ref_pre_outs, _ = drive(True, reqs_use=pre_reqs,
+                                     slots_use=slots_r)
+    pre, pre_outs, _ = drive(True, reqs_use=pre_reqs, slots_use=slots_p,
+                             page_tokens=pt, prefix=True,
+                             track_residency=True)
+    hits = (telemetry.value("serving.prefix.hits") or 0) - hits0
+    misses = (telemetry.value("serving.prefix.misses") or 0) - miss0
+    hit_rate = hits / float(max(1, hits + misses))
+    pre_parity = all(len(a) == len(b) and (a == b).all()
+                     for a, b in zip(ref_pre_outs, pre_outs))
+    prefix_ok = (hit_rate > 0 and pre["shared_pages_high"] > 0
+                 and pre_parity and pre["compiles_post_warmup"] == 0
+                 and pre["d2h"] == 0)
+    emit({"metric": "serve_decode_prefix", "value": round(hit_rate, 3),
+          "unit": "prefix_hit_rate", "prefix_hits": hits,
+          "prefix_misses": misses,
+          "shared_pages_high": pre["shared_pages_high"],
+          "token_parity_prefix_vs_rowed": pre_parity,
+          "compiles_post_warmup": pre["compiles_post_warmup"],
+          "d2h": pre["d2h"], "ok_prefix": prefix_ok})
+
+    # speculative decoding on a decode-heavy cohort: short prompts, the
+    # run's full generation budget.  Speculation pays per DECODE token
+    # (prefill is identical on both sides and speculation cannot help
+    # it), so the honest A/B drives BOTH engines — a plain paged
+    # baseline and the draft+verify pair — with the same
+    # decode-dominated request set.  draft == target, so acceptance is
+    # bounded only by per-sequence stop truncation and the tokens/step
+    # win is pure dispatch arithmetic (2 dispatches commit up to k+1
+    # tokens).
+    sp_reqs = [(rng.randint(0, vocab, size=rng.randint(3, 9))
+                .astype(np.int32), max_new)
+               for _ in range(min(n_requests, 16))]
+    sp_base, sp_base_outs, _ = drive(True, reqs_use=sp_reqs,
+                                     slots_use=slots_p, page_tokens=pt,
+                                     rounds=3)
+    prop0 = telemetry.value("serving.decode.spec_proposed") or 0
+    acc0 = telemetry.value("serving.decode.spec_accepted") or 0
+    spec, spec_outs, _ = drive(True, reqs_use=sp_reqs, slots_use=slots_p,
+                               page_tokens=pt, spec_k=k, rounds=3)
+    proposed = (telemetry.value("serving.decode.spec_proposed") or 0) - prop0
+    accepted = (telemetry.value("serving.decode.spec_accepted") or 0) - acc0
+    accept_rate = accepted / float(max(1, proposed))
+    spec_parity = all(len(a) == len(b) and (a == b).all()
+                      for a, b in zip(sp_base_outs, spec_outs))
+    spec_tps = spec["tokens"] / float(max(1, spec["steps"]))
+    pag_tps = sp_base["tokens"] / float(max(1, sp_base["steps"]))
+    spec_ok = (spec_parity and spec_tps > pag_tps
+               and spec["tok_per_s"] > sp_base["tok_per_s"]
+               and spec["compiles_post_warmup"] == 0
+               and spec["draft_compiles_post_warmup"] == 0
+               and spec["d2h"] == 0)
+    emit({"metric": "serve_decode_spec", "value": round(spec_tps, 3),
+          "unit": "tokens_per_step", "accept_rate": round(accept_rate, 3),
+          "spec_tok_per_s": round(spec["tok_per_s"], 1),
+          "paged_tok_per_s": round(sp_base["tok_per_s"], 1),
+          "paged_tokens_per_step": round(pag_tps, 3),
+          "token_parity_spec_vs_paged": spec_parity,
+          "compiles_post_warmup": spec["compiles_post_warmup"],
+          "draft_compiles_post_warmup": spec["draft_compiles_post_warmup"],
+          "d2h": spec["d2h"], "ok_spec": spec_ok})
+
     speedup = cont["tok_per_s"] / rest["tok_per_s"] \
         if rest["tok_per_s"] > 0 else 0.0
     ok = (cont["tok_per_s"] > rest["tok_per_s"]
@@ -379,7 +578,7 @@ def run_decode(n_requests=80, slots=8, max_new=32, vocab=256, dim=128,
           and cont["compiles_post_warmup"] == 0
           and cont["watchdog_trips"] == 0
           and cont["d2h"] == 0 and rest["d2h"] == 0 and q["d2h"] == 0
-          and int8_ok)
+          and int8_ok and ab_ok and prefix_ok and spec_ok)
     emit({"metric": "serve_decode", "value": round(speedup, 3),
           "unit": "continuous_vs_restart_speedup",
           "continuous_tok_per_s": round(cont["tok_per_s"], 1),
@@ -389,10 +588,18 @@ def run_decode(n_requests=80, slots=8, max_new=32, vocab=256, dim=128,
           "token_parity_continuous_vs_restart": parity_tokens,
           "compiles_post_warmup": cont["compiles_post_warmup"],
           "decode_d2h": cont["d2h"] + rest["d2h"] + q["d2h"],
+          "paged_residency_x": round(residency_x, 2),
+          "prefix_hit_rate": round(hit_rate, 3),
+          "spec_accept_rate": round(accept_rate, 3),
+          "spec_tokens_per_step": round(spec_tps, 3),
           "ok": ok})
     return {"ok": ok, "speedup": speedup, "continuous": cont,
             "restart": rest, "int8": q, "prefill_logits_rel_err": prefill_err,
-            "step_logits_rel_err": step_err, "kv_bytes_ratio": kv_ratio}
+            "step_logits_rel_err": step_err, "kv_bytes_ratio": kv_ratio,
+            "residency_x": residency_x, "ab_ok": ab_ok,
+            "prefix_hit_rate": hit_rate, "prefix_ok": prefix_ok,
+            "accept_rate": accept_rate, "spec_tokens_per_step": spec_tps,
+            "spec_ok": spec_ok}
 
 
 def run_decode_open(qps_list=(20.0, 60.0, 200.0), n_requests=60, slots=4,
