@@ -854,6 +854,65 @@ def _perf_common():
     return perf_common
 
 
+def _platform_name():
+    try:
+        import jax
+        return jax.devices()[0].platform
+    except Exception:  # noqa: BLE001 — a dead PJRT client still answers
+        return "unknown"
+
+
+def _tune_verdict(tune_rows, key):
+    """Fold per-class A/B rows into a summary verdict: None when the A/B
+    didn't run (BENCH_AUTOTUNE=0 or every search errored), else
+    any(improved) / all(not_worse)."""
+    rows = [r for r in tune_rows if r and "error" not in r]
+    if not rows:
+        return None
+    if key == "improved":
+        return any(r.get("improved") for r in rows)
+    return all(r.get(key) for r in rows)
+
+
+def _autotune_ab(emit, ptune, kernel_id, metric, sc, host_tier,
+                 host_scale=None):
+    """One autotuned-vs-default A/B line for a bench class: a bounded
+    measured search (install=False — the bench must not mutate the
+    serving table) whose default candidate is always timed first by the
+    same warmup-discarded median-of-rounds harness, so default_s/best_s
+    is a like-for-like ratio. ``not_worse`` is the gate: the tuner may
+    fail to beat the hand default but must never regress it (the best
+    candidate can only be the default itself then). The host tier
+    shrinks the problem so interpret-mode candidates stay inside the CI
+    budget — same machinery, smaller buffers."""
+    sc = dict(sc)
+    if host_tier and host_scale:
+        sc.update(host_scale)
+    try:
+        res = ptune.search(kernel_id, sc, install=False, persist=False)
+    except Exception as e:  # noqa: BLE001 — keep the sweep
+        rec = {"metric": metric + "_autotune", "impl": "autotune_ab",
+               "error": str(e)}
+        emit(rec)
+        return rec
+    rec = {"metric": metric + "_autotune", "impl": "autotune_ab",
+           "class": res["class"],
+           "default_plan": res["default_plan_id"],
+           "best_plan": res["best_plan_id"],
+           "default_ms": round(res["default_s"] * 1e3, 3),
+           "best_ms": round(res["best_s"] * 1e3, 3),
+           "value": round(res["speedup_vs_default"], 4),
+           "unit": "x_vs_default",
+           "candidates": res["candidates"], "timed": res["timed"],
+           "budget_exhausted": res["budget_exhausted"],
+           "improved": res["improved"],
+           # best is argmin over a set containing the default, so worse
+           # only by timing noise; 5% bounds that noise
+           "not_worse": res["best_s"] <= res["default_s"] * 1.05}
+    emit(rec)
+    return rec
+
+
 def bench_conv_class(emit=None):
     """Per-conv-class TFLOP/s, XLA vs the Pallas implicit-GEMM kernel
     (mxtpu/ops/pallas/conv.py) — the kernel-level numbers that previously
@@ -867,6 +926,7 @@ def bench_conv_class(emit=None):
     import jax
     import jax.numpy as jnp
     from mxtpu.ops.conv_acc import conv_fast
+    from mxtpu.ops.pallas import autotune as ptune
     from mxtpu.ops.pallas import conv as pconv
 
     pcommon = _perf_common()
@@ -878,6 +938,8 @@ def bench_conv_class(emit=None):
     dtype = (jnp.float32 if os.environ.get("BENCH_DTYPE") == "float32"
              else jnp.bfloat16)
     dn = ("NHWC", "HWIO", "NHWC")
+    do_tune = os.environ.get("BENCH_AUTOTUNE", "1") == "1"
+    host_tier = _platform_name() != "tpu"
     # (label, HW_in, Cin, Cout, k, stride); the last is the XLA control —
     # K=1024 and C_out=256 both fill the MXU, so Pallas must decline it
     classes = [
@@ -887,6 +949,7 @@ def bench_conv_class(emit=None):
         ("pw_1x1_1024to256_xla_control", 14, 1024, 256, 1, 1),
     ]
     lines = []
+    tune_rows = []
     saved = os.environ.get("MXTPU_PALLAS_CONV")
     try:
         for label, hw, cin, cout, k, s in classes:
@@ -897,6 +960,15 @@ def bench_conv_class(emit=None):
             pad = [(k // 2, k // 2), (k // 2, k // 2)]
             hw_out = (hw + 2 * (k // 2) - k) // s + 1
             fl = 2 * batch * hw_out * hw_out * cin * cout * k * k
+            # the autotuner's shape class for this (conv_fast routes the
+            # plain conv: no scale/residual epilogue)
+            sc = {"n": batch, "h": hw, "w": hw, "cin": cin, "kh": k,
+                  "kw": k, "cout": cout, "sh": s, "sw": s,
+                  "p0": k // 2, "p1": k // 2, "q0": k // 2, "q1": k // 2,
+                  "dtype": jnp.dtype(dtype).name, "scale": 0, "res": 0}
+            pid, prov = ptune.active_plan("pallas_conv", sc)
+            if pid is None:  # no tuned plan: name the hand-picked default
+                pid = ptune.plan_id_of(pconv._tune_default(sc))
             by_impl = {}
             for impl in ("xla", "pallas"):
                 os.environ["MXTPU_PALLAS_CONV"] = \
@@ -928,12 +1000,19 @@ def bench_conv_class(emit=None):
                        # 4 decimals: a CPU-fallback line must not round to
                        # a flat 0.00 (the chip numbers are 1-100 TFLOP/s)
                        "value": round(fl / dt / 1e12, 4),
-                       "unit": "TFLOP/s"}
+                       "unit": "TFLOP/s",
+                       "plan": pid, "plan_provenance": prov}
                 by_impl[impl] = dt
                 if impl == "pallas" and "xla" in by_impl:
                     rec["speedup_vs_xla"] = round(by_impl["xla"] / dt, 3)
                 emit(rec)
                 lines.append(rec)
+            if do_tune and "xla_control" not in label:
+                tune_rows.append(_autotune_ab(
+                    emit, ptune, "pallas_conv",
+                    "conv_class_%s" % label, sc, host_tier,
+                    host_scale={"n": min(batch, 2), "h": min(hw, 64),
+                                "w": min(hw, 64)}))
     finally:
         if saved is None:
             os.environ.pop("MXTPU_PALLAS_CONV", None)
@@ -950,6 +1029,131 @@ def bench_conv_class(emit=None):
         "hfu": None,
         "pallas_kernel_lines": len(pallas_lines),
         "classes": [r["metric"] for r in lines],
+        "autotune_beats_default": _tune_verdict(tune_rows, "improved"),
+        "autotune_not_worse": _tune_verdict(tune_rows, "not_worse"),
+    }
+
+
+def bench_flash_class(emit=None):
+    """Per-attention-class TFLOP/s, XLA softmax path vs the Pallas flash
+    kernel (mxtpu/ops/pallas/flash_attention.py) — conv_class's sibling
+    for the transformer hot path. One JSON line per (class, impl);
+    classes cover the decoder/encoder shapes plus an odd length the
+    block picker must still tile (768 → 384-blocks). Off-TPU the kernel
+    runs through the Pallas interpreter (MXTPU_FLASH_INTERPRET) on
+    host-scaled shapes — slower absolute numbers, but the dispatch
+    routing, plan stamping, and autotune A/B exercise the real kernel.
+    Scan-fused K-step timing with host-fetch sync; every line carries
+    the active plan id + tuned|default provenance; summary gates
+    autotuned-vs-default not-worse."""
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+    fa = importlib.import_module("mxtpu.ops.pallas.flash_attention")
+    from mxtpu.ops.pallas import autotune as ptune
+
+    pcommon = _perf_common()
+    if emit is None:
+        emit = _emit
+    k_steps = int(os.environ.get("BENCH_FLASH_STEPS", "8"))
+    dtype = (jnp.float32 if os.environ.get("BENCH_DTYPE") == "float32"
+             else jnp.bfloat16)
+    do_tune = os.environ.get("BENCH_AUTOTUNE", "1") == "1"
+    host_tier = _platform_name() != "tpu"
+    # (label, batch, heads, T, D, host_T) — host_T keeps interpret-mode
+    # lines inside the battery budget while preserving each class's
+    # tiling character (odd 768 scales to odd 384, not a power of two)
+    classes = [
+        ("dec_t512_d64", 4, 8, 512, 64, 256),
+        ("enc_t1024_d128", 2, 8, 1024, 128, 512),
+        ("odd_t768_d64", 2, 8, 768, 64, 384),
+    ]
+    causal = os.environ.get("BENCH_FLASH_CAUSAL", "0") == "1"
+    lines = []
+    tune_rows = []
+    saved = os.environ.get("MXTPU_FLASH_INTERPRET")
+    try:
+        if host_tier:
+            # off-TPU the pallas impl needs the interpreter; the xla impl
+            # path below bypasses the kernel either way
+            os.environ["MXTPU_FLASH_INTERPRET"] = "1"
+        for label, b, h, t, d, host_t in classes:
+            if host_tier:
+                b, h, t = 1, 2, host_t
+            q = jax.random.normal(jax.random.PRNGKey(0), (b, h, t, d),
+                                  dtype)
+            kk = jax.random.normal(jax.random.PRNGKey(1), (b, h, t, d),
+                                   dtype)
+            vv = jax.random.normal(jax.random.PRNGKey(2), (b, h, t, d),
+                                   dtype)
+            # 2 matmuls (scores + values), 2 FLOPs each: 4*b*h*t*tk*d
+            fl = 4 * b * h * t * t * d
+            sc = {"b": b, "h": h, "t": t, "tk": t, "d": d,
+                  "dtype": jnp.dtype(dtype).name}
+            pid, prov = ptune.active_plan("pallas_flash", sc)
+            if pid is None:  # no tuned plan: name the hand-picked default
+                pid = ptune.plan_id_of(fa._tune_default(sc))
+            by_impl = {}
+            for impl in ("xla", "pallas"):
+                fa.reset_dispatch_stats()
+                if impl == "xla":
+                    scale = 1.0 / (d ** 0.5)
+                    f = pcommon.reinject(
+                        lambda qd, kk=kk, vv=vv, scale=scale:
+                        fa._xla_attention(qd, kk, vv, causal, scale))
+                else:
+                    f = pcommon.reinject(
+                        lambda qd, kk=kk, vv=vv:
+                        fa.flash_attention(qd, kk, vv, causal))
+                try:
+                    dt = pcommon.timed_scan(f, q, K=k_steps)
+                except Exception as e:  # noqa: BLE001 — keep the sweep
+                    emit({"metric": "flash_class_%s" % label,
+                          "impl": impl, "error": str(e)})
+                    continue
+                from mxtpu import telemetry
+                if impl == "xla":
+                    used = "xla"
+                elif telemetry.value("pallas_flash.pallas"):
+                    used = "pallas"
+                else:
+                    reasons = telemetry.tagged("pallas_flash.fallback")
+                    used = ("xla_fallback(%s)"
+                            % "; ".join(sorted(reasons)) if reasons
+                            else "xla_fallback")
+                rec = {"metric": "flash_class_%s" % label, "impl": impl,
+                       "impl_used": used, "ms": round(dt * 1e3, 3),
+                       "value": round(fl / dt / 1e12, 4),
+                       "unit": "TFLOP/s",
+                       "plan": pid, "plan_provenance": prov}
+                by_impl[impl] = dt
+                if impl == "pallas" and "xla" in by_impl:
+                    rec["speedup_vs_xla"] = round(by_impl["xla"] / dt, 3)
+                emit(rec)
+                lines.append(rec)
+            if do_tune:
+                tune_rows.append(_autotune_ab(
+                    emit, ptune, "pallas_flash",
+                    "flash_class_%s" % label, sc, host_tier))
+    finally:
+        if saved is None:
+            os.environ.pop("MXTPU_FLASH_INTERPRET", None)
+        else:
+            os.environ["MXTPU_FLASH_INTERPRET"] = saved
+    pallas_lines = [r for r in lines if r.get("impl") == "pallas"
+                    and r.get("impl_used") == "pallas"]
+    return {
+        "metric": "flash_class",
+        "value": len(lines),
+        "unit": "json_lines",
+        "vs_baseline": None,
+        "mfu": None,
+        "hfu": None,
+        "pallas_kernel_lines": len(pallas_lines),
+        "classes": [r["metric"] for r in lines],
+        "autotune_beats_default": _tune_verdict(tune_rows, "improved"),
+        "autotune_not_worse": _tune_verdict(tune_rows, "not_worse"),
     }
 
 
@@ -1458,6 +1662,7 @@ CONFIGS = {
     "telemetry_overhead": bench_telemetry_overhead,
     "integrity_overhead": bench_integrity_overhead,
     "conv_class": bench_conv_class,
+    "flash_class": bench_flash_class,
     "serving": bench_serving,
     "serving_decode": bench_serving_decode,
     "serving_slo": bench_serving_slo,

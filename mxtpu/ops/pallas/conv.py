@@ -51,10 +51,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+from . import autotune
 from .flash_attention import _platform  # one platform resolver per package
 
-__all__ = ["fused_conv", "pallas_applicable", "DISPATCH_STATS",
-           "reset_dispatch_stats"]
+__all__ = ["fused_conv", "pallas_applicable", "shape_class_of",
+           "DISPATCH_STATS", "reset_dispatch_stats"]
 
 _MXU_LANES = 128
 # VMEM spend the forward kernel may plan for (input block double-buffered +
@@ -203,10 +204,51 @@ def _lane_pad(c):
     return -(-c // _MXU_LANES) * _MXU_LANES
 
 
+def _plan_vmem(bo, oh, ow, cin, cout, kh, kw, sh, sw, itm, has_scale,
+               has_residual):
+    """VMEM bytes the forward kernel plans for at row-block ``bo``: the
+    pipelined working set — double-buffered input block + the resident
+    whole-weight block (the gate allows C_out<128 at ANY C_in, so a
+    fat-C_in kernel must fall back here, not die in Mosaic) + output
+    tile (+ residual tile, + f32 conv_raw tile when the affine epilogue
+    saves it) + the f32 accumulator across the contractions. Shared by
+    trace-time _resolve and the autotuner's pre-compile feasibility
+    gate, so a tuned plan can never admit geometry _resolve would
+    reject."""
+    bo_in = bo + (kh - 1) // sh
+    ws = ow + (kw - 1) // sw
+    return (2 * sh * sw * bo_in * ws * _lane_pad(cin) * itm
+            + kh * kw * max(cin, 8) * _lane_pad(cout) * itm
+            + 2 * bo * ow * _lane_pad(cout) * itm
+            + (2 * bo * ow * _lane_pad(cout) * itm if has_residual
+               else 0)
+            + (2 * bo * ow * _lane_pad(cout) * 4 if has_scale else 0)
+            + bo * ow * _lane_pad(cout) * 4)
+
+
+def shape_class_of(x, w, cfg):
+    """The autotuner's shape class for this conv: full launch geometry +
+    dtype + the epilogue flags that change the VMEM plan. Works on
+    tracers (shape/dtype only)."""
+    n, h, wd, cin = x.shape
+    kh, kw, _, cout = w.shape
+    return {"n": int(n), "h": int(h), "w": int(wd), "cin": int(cin),
+            "kh": int(kh), "kw": int(kw), "cout": int(cout),
+            "sh": cfg.strides[0], "sw": cfg.strides[1],
+            "p0": cfg.padding[0][0], "p1": cfg.padding[0][1],
+            "q0": cfg.padding[1][0], "q1": cfg.padding[1][1],
+            "dtype": jnp.dtype(x.dtype).name,
+            "scale": int(cfg.has_scale), "res": int(cfg.has_residual)}
+
+
 def _resolve(x, w, cfg):
     """Kernel launch geometry (bo = output rows per grid step) or
     (None, reason) -> XLA fallback. Separated from the launch so tests
-    can assert routing decisions without running the kernel."""
+    can assert routing decisions without running the kernel. A tuned
+    plan (autotune.lookup) may override the hand-picked row block, but
+    only after revalidating against the SAME divisor + VMEM gates — a
+    stale or foreign artifact degrades to the default with a counted
+    drop, never a Mosaic error."""
     if _platform() != "tpu" and not _interpret():
         return None, "platform is not tpu"
     n, h, wd, cin = x.shape
@@ -215,22 +257,20 @@ def _resolve(x, w, cfg):
     (plo, phi), (qlo, qhi) = cfg.padding
     oh = _out_hw(h, plo, phi, kh, sh)
     ow = _out_hw(wd, qlo, qhi, kw, sw)
-    bo = _divisor_block(oh, max(1, _TARGET_M // ow))
-    bo_in = bo + (kh - 1) // sh
-    ws = ow + (kw - 1) // sw
     itm = jnp.dtype(x.dtype).itemsize
-    # the pipelined working set: double-buffered input block + the
-    # resident whole-weight block (the gate allows C_out<128 at ANY C_in,
-    # so a fat-C_in kernel must fall back here, not die in Mosaic) +
-    # output tile (+ residual tile, + f32 conv_raw tile when the affine
-    # epilogue saves it) + the f32 accumulator across the contractions
-    vmem = (2 * sh * sw * bo_in * ws * _lane_pad(cin) * itm
-            + kh * kw * max(cin, 8) * _lane_pad(cout) * itm
-            + 2 * bo * ow * _lane_pad(cout) * itm
-            + (2 * bo * ow * _lane_pad(cout) * itm if cfg.has_residual
-               else 0)
-            + (2 * bo * ow * _lane_pad(cout) * 4 if cfg.has_scale else 0)
-            + bo * ow * _lane_pad(cout) * 4)
+    bo = _divisor_block(oh, max(1, _TARGET_M // ow))
+    tuned = autotune.lookup("pallas_conv", shape_class_of(x, w, cfg))
+    if tuned is not None:
+        tbo = int(tuned.get("bo", 0))
+        if (1 <= tbo <= oh and oh % tbo == 0
+                and _plan_vmem(tbo, oh, ow, cin, cout, kh, kw, sh, sw,
+                               itm, cfg.has_scale, cfg.has_residual)
+                <= _VMEM_BUDGET):
+            bo = tbo
+        else:
+            autotune.plan_infeasible("pallas_conv")
+    vmem = _plan_vmem(bo, oh, ow, cin, cout, kh, kw, sh, sw, itm,
+                      cfg.has_scale, cfg.has_residual)
     if vmem > _VMEM_BUDGET:
         return None, ("VMEM budget: block needs ~%.1f MB > %.1f MB"
                       % (vmem / 2**20, _VMEM_BUDGET / 2**20))
@@ -541,3 +581,105 @@ def fused_conv(x, w, strides=(1, 1), padding=((0, 0), (0, 0)), scale=None,
                res_dtype=("" if residual is None
                           else jnp.dtype(residual.dtype).name))
     return _fused_conv_core(x, w, scale, bias, residual, cfg)
+
+
+# ------------------------------------------------------- autotune descriptor
+def _class_geom(sc):
+    """(oh, ow, itemsize) from an autotune shape-class dict."""
+    oh = _out_hw(sc["h"], sc["p0"], sc["p1"], sc["kh"], sc["sh"])
+    ow = _out_hw(sc["w"], sc["q0"], sc["q1"], sc["kw"], sc["sw"])
+    return oh, ow, jnp.dtype(sc["dtype"]).itemsize
+
+
+# candidate GEMM-M targets the space sweeps; each realizes to the largest
+# divisor row block bo <= target/ow, so the space covers "fewer, fatter
+# grid steps" through "many thin ones" around the hand-picked _TARGET_M
+_TUNE_TARGET_M = (256, 512, 1024, 2048, 4096, 8192, 16384)
+
+
+def _tune_space(sc):
+    oh, ow, _ = _class_geom(sc)
+    return [{"bo": _divisor_block(oh, max(1, tm // ow))}
+            for tm in _TUNE_TARGET_M]
+
+
+def _tune_default(sc):
+    oh, ow, _ = _class_geom(sc)
+    return {"bo": _divisor_block(oh, max(1, _TARGET_M // ow))}
+
+
+def _tune_feasible(plan, sc):
+    oh, ow, itm = _class_geom(sc)
+    bo = int(plan.get("bo", 0))
+    if not (1 <= bo <= oh and oh % bo == 0):
+        return False, "bo=%d is not a divisor of oh=%d" % (bo, oh)
+    vmem = _plan_vmem(bo, oh, ow, sc["cin"], sc["cout"], sc["kh"],
+                      sc["kw"], sc["sh"], sc["sw"], itm,
+                      bool(sc["scale"]), bool(sc["res"]))
+    if vmem > _VMEM_BUDGET:
+        return False, ("VMEM budget: bo=%d needs ~%.1f MB > %.1f MB"
+                       % (bo, vmem / 2**20, _VMEM_BUDGET / 2**20))
+    return True, None
+
+
+def _tune_runner(sc):
+    """Real buffers + a dispatch through fused_conv's public entry (the
+    timed program IS the serving program for this shape class)."""
+    import numpy as np
+    rng = np.random.default_rng(0)
+    dt = jnp.dtype(sc["dtype"])
+    x = jnp.asarray(rng.standard_normal(
+        (sc["n"], sc["h"], sc["w"], sc["cin"])), dt)
+    w = jnp.asarray(0.1 * rng.standard_normal(
+        (sc["kh"], sc["kw"], sc["cin"], sc["cout"])), dt)
+    strides = (sc["sh"], sc["sw"])
+    padding = ((sc["p0"], sc["p1"]), (sc["q0"], sc["q1"]))
+    oh, ow, _ = _class_geom(sc)
+    args = [x, w]
+    has_scale, has_res = bool(sc["scale"]), bool(sc["res"])
+    if has_scale:
+        args.append(jnp.asarray(
+            1.0 + 0.1 * rng.standard_normal(sc["cout"]), jnp.float32))
+    if has_res:
+        args.append(jnp.asarray(rng.standard_normal(
+            (sc["n"], oh, ow, sc["cout"])), dt))
+
+    def fn(*a):
+        it = iter(a)
+        xx, ww = next(it), next(it)
+        sc_v = next(it) if has_scale else None
+        rs_v = next(it) if has_res else None
+        return fused_conv(xx, ww, strides=strides, padding=padding,
+                          scale=sc_v, residual=rs_v, relu=True)
+
+    return fn, tuple(args)
+
+
+def _tune_classes(host_tier):
+    """Representative shape classes a tuning session sweeps (the bench
+    conv_class families). The host tier shrinks batch/H so interpret-mode
+    candidates stay inside the perf-battery budget; on a chip the bench
+    shapes run as-is."""
+    if host_tier:
+        geoms = [(2, 64, 3, 64, 7, 2, 3),     # stem 7x7s2
+                 (2, 28, 256, 64, 1, 1, 0),   # bottleneck pointwise
+                 (2, 28, 64, 64, 3, 1, 1)]    # stage-2 spatial
+    else:
+        geoms = [(8, 224, 3, 64, 7, 2, 3),
+                 (8, 56, 256, 64, 1, 1, 0),
+                 (8, 56, 64, 64, 3, 1, 1)]
+    return [{"n": n, "h": h, "w": h, "cin": cin, "kh": k, "kw": k,
+             "cout": cout, "sh": s, "sw": s, "p0": p, "p1": p,
+             "q0": p, "q1": p, "dtype": "float32", "scale": 1, "res": 0}
+            for (n, h, cin, cout, k, s, p) in geoms]
+
+
+autotune.register_kernel(autotune.TunableKernel(
+    kernel_id="pallas_conv",
+    space=_tune_space,
+    default=_tune_default,
+    feasible=_tune_feasible,
+    runner=_tune_runner,
+    classes=_tune_classes,
+    interpret_env="MXTPU_PALLAS_CONV_INTERPRET",
+))

@@ -17,17 +17,87 @@ Design (TPU-first):
   memory stays O(T*D), no Pallas needed since the MXU work is plain matmuls
   XLA already schedules well.
 * fallback: non-TPU platforms or non-divisible shapes use the XLA softmax
-  path with the same signature.
+  path with the same signature. Why each fallback happened is counted in
+  the reason-tagged ``pallas_flash.{pallas,xla,fallback}`` telemetry
+  family (the conv kernel's dispatch-stats discipline).
+* parity off-chip: ``MXTPU_FLASH_INTERPRET=1`` runs the kernel through
+  the Pallas interpreter, so tier-1 pins the real online-softmax kernel
+  against the XLA path on CPU without a chip (and the autotuner can
+  measure block plans on the host tier).
 """
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from . import autotune
+
 _NEG_INF = -1e30
+
+
+def _interpret():
+    """MXTPU_FLASH_INTERPRET=1 runs the kernel via the Pallas interpreter
+    on any platform — the tier-1 parity path (CPU, no chip). Trace-time,
+    so it rides policy_key like every other lever."""
+    return os.environ.get("MXTPU_FLASH_INTERPRET", "0") == "1"
+
+
+# observability: how often the hand kernel ran vs why it fell back — the
+# same dict-shaped view over the telemetry registry conv.py exposes, so
+# bench/report/JSONL read one copy of the truth.
+class _DispatchStatsView:
+    """Read-only dict-shaped view over the telemetry counters."""
+
+    _KEYS = ("pallas", "xla", "fallback_reasons")
+
+    def __getitem__(self, key):
+        from ... import telemetry
+        if key == "fallback_reasons":
+            return telemetry.tagged("pallas_flash.fallback")
+        if key not in self._KEYS:
+            raise KeyError(key)
+        return int(telemetry.value("pallas_flash." + key))
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def __iter__(self):
+        return iter(self._KEYS)
+
+    def __len__(self):
+        return len(self._KEYS)
+
+    def keys(self):
+        return list(self._KEYS)
+
+    def items(self):
+        return [(k, self[k]) for k in self._KEYS]
+
+    def __repr__(self):
+        return repr(dict(self.items()))
+
+
+DISPATCH_STATS = _DispatchStatsView()
+
+
+def reset_dispatch_stats():
+    from ... import telemetry
+    telemetry.reset_metric("pallas_flash.pallas")
+    telemetry.reset_metric("pallas_flash.xla")
+    telemetry.reset_metric("pallas_flash.fallback")
+
+
+def _count_fallback(reason):
+    from ... import telemetry
+    telemetry.inc("pallas_flash.xla")
+    telemetry.inc("pallas_flash.fallback", tag=reason)
 
 
 def _xla_attention(q, k, v, causal, scale):
@@ -127,6 +197,15 @@ def _fa_forward_pallas(q, k, v, causal, scale, block_q, block_k):
     from jax.experimental.pallas import tpu as pltpu
     kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k, n_k=n_k)
+    interpret = _interpret()
+    extra = {}
+    if not interpret:
+        # jax 0.4.37 renamed CompilerParams -> TPUCompilerParams; the
+        # interpreter needs neither (Mosaic-only hint)
+        cp = (getattr(pltpu, "CompilerParams", None)
+              or pltpu.TPUCompilerParams)
+        extra["compiler_params"] = cp(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_k),
@@ -148,8 +227,8 @@ def _fa_forward_pallas(q, k, v, causal, scale, block_q, block_k):
             pltpu.VMEM((block_q, 128), jnp.float32),  # running sum l
             pltpu.VMEM((block_q, d), jnp.float32),    # output accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+        **extra,
     )(q3, k3, v3)
     return out.reshape(b, h, t, d), lse[:, :, 0].reshape(b, h, t)
 
@@ -230,16 +309,35 @@ def _pick_block(n, want, mult):
 _warned_fallbacks = set()
 
 
+def shape_class_of(q, k):
+    """The autotuner's shape class for this attention call: problem
+    geometry + dtype. Causal is deliberately absent — the block plan is
+    launch geometry, and a plan that wins on the full score grid also
+    serves the causal-skip variant of the same shape. Works on tracers
+    (shape/dtype only)."""
+    b, h, t, d = q.shape
+    return {"b": int(b), "h": int(h), "t": int(t),
+            "tk": int(k.shape[2]), "d": int(d),
+            "dtype": jnp.dtype(q.dtype).name}
+
+
 def _resolve_blocks(q, k, block_q, block_k):
     """(block_q, block_k) for the Pallas kernel, or None → XLA fallback.
 
     On TPU the fallback is a real memory cliff (the [T, T] score matrix
     materializes in HBM), so it warns ONCE per offending shape instead of
-    silently absorbing it (VERDICT r4 weak #7)."""
+    silently absorbing it (VERDICT r4 weak #7). Every outcome is counted
+    in ``pallas_flash.{pallas,xla}`` / reason-tagged
+    ``pallas_flash.fallback``. A tuned plan (autotune.lookup) may
+    override the q/k block wants, but only after revalidating against
+    the SAME granule/divisor gates — a stale artifact degrades to the
+    defaults with a counted drop."""
     t, tk, d = q.shape[2], k.shape[2], q.shape[3]
     on_tpu = _platform() == "tpu"
+    from ... import telemetry
 
     def _fallback(reason):
+        _count_fallback(reason)
         if on_tpu:
             key = (reason, t, tk, d)
             if key not in _warned_fallbacks:
@@ -254,22 +352,32 @@ def _resolve_blocks(q, k, block_q, block_k):
                     % (reason, t, tk, d))
         return None
 
-    if not on_tpu:
-        return None  # expected off-TPU; not a cliff worth warning about
+    if not on_tpu and not _interpret():
+        # expected off-TPU; counted but not a cliff worth warning about
+        return _fallback("platform is not tpu")
     # head dims off the 128-lane granule (64 for BERT-base et al.) are
     # zero-padded to the next multiple by _pad_head_dim — scores and lse
     # are invariant to zero columns, so no fallback needed.
     # MXTPU_FLASH_PAD_D=0 restores the old fallback (perf A/B only).
-    import os
     # default mirrors the registry.policy_key entry — a bare .get() here
     # would alias unset (None) and "1" onto one compiled-cache key
     if d % 128 != 0 and os.environ.get("MXTPU_FLASH_PAD_D", "1") == "0":
         return _fallback("head dim not a multiple of 128 (padding "
                          "disabled by MXTPU_FLASH_PAD_D=0)")
+    tuned = autotune.lookup("pallas_flash", shape_class_of(q, k))
+    if tuned is not None:
+        tbq = int(tuned.get("block_q", 0))
+        tbk = int(tuned.get("block_k", 0))
+        if (_pick_block(t, tbq, 8) == tbq
+                and _pick_block(tk, tbk, 128) == tbk):
+            block_q, block_k = tbq, tbk
+        else:
+            autotune.plan_infeasible("pallas_flash")
     bq = _pick_block(t, block_q, 8)       # sublane granularity
     bk = _pick_block(tk, block_k, 128)    # lane granularity
     if bq is None or bk is None:
         return _fallback("sequence length has no TPU-tileable block")
+    telemetry.inc("pallas_flash.pallas")
     return bq, bk
 
 
@@ -375,3 +483,100 @@ def _fa_lse_bwd(causal, scale, block_q, block_k, res, cots):
 
 
 flash_attention_with_lse.defvjp(_fa_lse_fwd, _fa_lse_bwd)
+
+
+# ------------------------------------------------------- autotune descriptor
+# candidate q/k block wants the space sweeps; each realizes through
+# _pick_block (8-sublane / 128-lane granules), so every emitted plan is a
+# block pair the kernel can actually launch
+_TUNE_WANTS = (128, 256, 512, 1024, 2048)
+# VMEM the feasibility gate lets a candidate plan for (same headroom
+# philosophy as conv's _VMEM_BUDGET; flash has no serving-side VMEM gate
+# because its default blocks are bounded, but the tuner's space is not)
+_TUNE_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def _tune_space(sc):
+    plans = []
+    for wq in _TUNE_WANTS:
+        for wk in _TUNE_WANTS:
+            bq = _pick_block(sc["t"], wq, 8)
+            bk = _pick_block(sc["tk"], wk, 128)
+            if bq is not None and bk is not None:
+                plans.append({"block_q": bq, "block_k": bk})
+    return plans
+
+
+def _tune_default(sc):
+    return {"block_q": _pick_block(sc["t"], 512, 8),
+            "block_k": _pick_block(sc["tk"], 512, 128)}
+
+
+def _tune_vmem(bq, bk, d, itm):
+    dp = -(-d // 128) * 128
+    return (2 * (bq * dp + dp * bk + bk * dp) * itm  # q/kT/v blocks (dbuf)
+            + bq * bk * 4                            # score/p tile (f32)
+            + 2 * bq * 128 * 4 + bq * dp * 4         # m, l, acc scratch
+            + 2 * (bq * dp * itm + bq * 128 * 4))    # out + lse tiles
+
+
+def _tune_feasible(plan, sc):
+    bq = int(plan.get("block_q", 0))
+    bk = int(plan.get("block_k", 0))
+    if _pick_block(sc["t"], bq, 8) != bq:
+        return False, ("block_q=%d is not an 8-multiple divisor of t=%d"
+                       % (bq, sc["t"]))
+    if _pick_block(sc["tk"], bk, 128) != bk:
+        return False, ("block_k=%d is not a 128-multiple divisor of tk=%d"
+                       % (bk, sc["tk"]))
+    itm = jnp.dtype(sc["dtype"]).itemsize
+    vmem = _tune_vmem(bq, bk, sc["d"], itm)
+    if vmem > _TUNE_VMEM_BUDGET:
+        return False, ("VMEM budget: %dx%d blocks need ~%.1f MB > %.1f MB"
+                       % (bq, bk, vmem / 2**20,
+                          _TUNE_VMEM_BUDGET / 2**20))
+    return True, None
+
+
+def _tune_runner(sc):
+    """Real buffers + a dispatch through flash_attention's public entry.
+    causal=False times the full score grid — the plan also serves the
+    causal variant of the shape class (see shape_class_of)."""
+    import numpy as np
+    rng = np.random.default_rng(0)
+    dt = jnp.dtype(sc["dtype"])
+    shp_q = (sc["b"], sc["h"], sc["t"], sc["d"])
+    shp_k = (sc["b"], sc["h"], sc["tk"], sc["d"])
+    q = jnp.asarray(rng.standard_normal(shp_q), dt)
+    k = jnp.asarray(rng.standard_normal(shp_k), dt)
+    v = jnp.asarray(rng.standard_normal(shp_k), dt)
+
+    def fn(q_, k_, v_):
+        return flash_attention(q_, k_, v_, causal=False)
+
+    return fn, (q, k, v)
+
+
+def _tune_classes(host_tier):
+    """Representative shape classes a tuning session sweeps. The host
+    tier shrinks batch/heads/T so interpret-mode candidates stay inside
+    the perf-battery budget; on a chip the bench-transformer shapes run
+    as-is."""
+    if host_tier:
+        shapes = [(1, 2, 256, 256, 64), (1, 2, 512, 512, 64)]
+    else:
+        shapes = [(4, 8, 512, 512, 64), (2, 8, 1024, 1024, 128),
+                  (2, 8, 2048, 2048, 128)]
+    return [{"b": b, "h": h, "t": t, "tk": tk, "d": d, "dtype": "float32"}
+            for (b, h, t, tk, d) in shapes]
+
+
+autotune.register_kernel(autotune.TunableKernel(
+    kernel_id="pallas_flash",
+    space=_tune_space,
+    default=_tune_default,
+    feasible=_tune_feasible,
+    runner=_tune_runner,
+    classes=_tune_classes,
+    interpret_env="MXTPU_FLASH_INTERPRET",
+))

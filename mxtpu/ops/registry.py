@@ -87,6 +87,20 @@ def register(name: Optional[str] = None, aliases=(), as_method: bool = False,
     return deco
 
 
+def _autotune_plans_entry():
+    """The tuned-plan-identity component of policy_key: a digest of the
+    installed autotune plan set (pallas/autotune.policy_token). "0"
+    whenever serving is off or no plans are installed, so the lever
+    being absent changes nothing; a plan flip changes the digest, so a
+    tuned-plan change can never alias an executable traced under the
+    old block geometry (the MeshPlan discipline)."""
+    try:
+        from .pallas import autotune
+        return autotune.policy_token()
+    except Exception:  # noqa: BLE001 — policy_key must never raise
+        return "0"
+
+
 def policy_key():
     """Trace-time env policies that get BAKED INTO compiled executables
     (f32-accumulate convs, one-pass BN stats). Every jit cache keyed on
@@ -123,7 +137,13 @@ def policy_key():
             # invalidate every policy_key-keyed forward/serving
             # executable that never contained the fingerprint
             "0" if os.environ.get("MXTPU_DIVERGENCE_EVERY", "0")
-            in ("", "0") else "1")
+            in ("", "0") else "1",
+            # pallas/autotune.enabled / flash_attention._interpret —
+            # tuned-plan serving and the flash interpret path change the
+            # traced program, so both ride the key
+            os.environ.get("MXTPU_AUTOTUNE", "0"),
+            os.environ.get("MXTPU_FLASH_INTERPRET", "0"),
+            _autotune_plans_entry())
 
 
 # canonical op name -> fn(attrs) -> int: STATIC output count for ops whose

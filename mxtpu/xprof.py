@@ -139,6 +139,30 @@ def _capture(args, kwargs):
     return jax.tree_util.tree_map(leaf, (args, dict(kwargs)))
 
 
+def _shapes_of(spec_tree, limit=16):
+    """Public shape summary of a captured signature: "dtype[d1,d2,...]"
+    per array leaf, bounded. This is what the ledger streams (the
+    ``--tuning-queue`` emitter keys tuning candidates on it); the full
+    ``_Spec`` tree stays private for AOT re-lowering."""
+    import jax
+    out = []
+    for x in jax.tree_util.tree_leaves(spec_tree):
+        if isinstance(x, _Spec):
+            out.append("%s[%s]" % (jnp_name(x.dtype),
+                                   ",".join(str(d) for d in x.shape)))
+            if len(out) >= limit:
+                break
+    return out
+
+
+def jnp_name(dtype):
+    try:
+        import numpy as np
+        return np.dtype(dtype).name
+    except Exception:  # noqa: BLE001 — exotic dtypes still summarize
+        return str(dtype)
+
+
 def _to_abstract(spec_tree, with_sharding):
     import jax
 
@@ -186,6 +210,7 @@ class _WatchedJit:
             self._pending_first = False
             try:
                 e["_abstract"] = _capture(args, kwargs)
+                e["shapes"] = _shapes_of(e["_abstract"])
             except Exception:  # noqa: BLE001 — capture must never break
                 pass           # the dispatch it observes
             t0 = time.perf_counter()

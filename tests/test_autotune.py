@@ -1,0 +1,561 @@
+"""Measured Pallas block-shape autotuner (mxtpu/ops/pallas/autotune.py,
+ISSUE 17): declared plan spaces with pre-compile feasibility pruning,
+measured search with warmup-discarded median timing, persistent plan
+artifacts under MXTPU_COMPILE_CACHE_DIR with the full degradation
+matrix (every bad blob lands on the hand-picked default with a counted
+``autotune.drops{reason}``), zero warm-start searches in a fresh
+process (subprocess-pinned), plan identity riding registry.policy_key,
+and interpret-mode numerical parity of EVERY candidate plan the search
+may emit for both registered kernels."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mxtpu import telemetry
+from mxtpu.ops import registry
+from mxtpu.ops.pallas import autotune
+from mxtpu.ops.pallas import conv as pc
+# the package __init__ re-exports the flash_attention FUNCTION, which
+# shadows the submodule name — import the module explicitly
+import importlib
+fa = importlib.import_module("mxtpu.ops.pallas.flash_attention")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DN = ("NHWC", "HWIO", "NHWC")
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in ("MXTPU_AUTOTUNE", "MXTPU_AUTOTUNE_ROUNDS",
+                "MXTPU_AUTOTUNE_BUDGET_S", "MXTPU_COMPILE_CACHE_DIR",
+                "MXTPU_PALLAS_CONV", "MXTPU_PALLAS_CONV_INTERPRET",
+                "MXTPU_FLASH_INTERPRET"):
+        monkeypatch.delenv(var, raising=False)
+    autotune.reset()
+    yield
+    autotune.reset()
+
+
+def _ctr(name, tag=None):
+    return telemetry.value(name, tag=tag)
+
+
+def _conv_sc(n=1, h=8, cin=4, cout=8, k=3, s=1, p=1, dtype="float32",
+             scale=0, res=0):
+    return {"n": n, "h": h, "w": h, "cin": cin, "kh": k, "kw": k,
+            "cout": cout, "sh": s, "sw": s, "p0": p, "p1": p,
+            "q0": p, "q1": p, "dtype": dtype, "scale": scale, "res": res}
+
+
+# ------------------------------------------------------- registry & spaces
+def test_both_kernels_registered_with_full_descriptors():
+    ks = autotune.kernels()
+    assert {"pallas_conv", "pallas_flash"} <= set(ks)
+    for tk in ks.values():
+        sc = tk.classes(True)[0]
+        default = tk.default(sc)
+        ok, reason = tk.feasible(default, sc)
+        assert ok, (tk.kernel_id, reason)   # the default is always feasible
+        assert any(tk.space(sc)), tk.kernel_id
+
+
+def test_conv_feasibility_prunes_nondivisor_and_vmem_overflow():
+    sc = _conv_sc(h=8)                       # oh = 8
+    ok, reason = pc._tune_feasible({"bo": 3}, sc)
+    assert not ok and "divisor" in reason
+    big = _conv_sc(h=256, cin=128, cout=256, k=3, s=1, p=1)
+    ok, reason = pc._tune_feasible({"bo": 256}, big)
+    assert not ok and "VMEM" in reason
+
+
+def test_flash_feasibility_enforces_granules_and_vmem():
+    sc = {"b": 1, "h": 2, "t": 256, "tk": 256, "d": 64,
+          "dtype": "float32"}
+    ok, reason = fa._tune_feasible({"block_q": 100, "block_k": 128}, sc)
+    assert not ok and "block_q" in reason
+    ok, reason = fa._tune_feasible({"block_q": 128, "block_k": 100}, sc)
+    assert not ok and "block_k" in reason
+    wide = {"b": 1, "h": 2, "t": 2048, "tk": 2048, "d": 1024,
+            "dtype": "float32"}
+    ok, reason = fa._tune_feasible({"block_q": 2048, "block_k": 2048},
+                                   wide)
+    assert not ok and "VMEM" in reason
+
+
+def test_space_candidates_are_always_feasible_for_declared_classes():
+    """The space is declared REALIZED (granule-snapped divisors), so a
+    candidate the search would time can never be one feasibility (or
+    worse, Mosaic) rejects."""
+    for tk in autotune.kernels().values():
+        for sc in tk.classes(True):
+            for plan in tk.space(sc):
+                ok, reason = tk.feasible(plan, sc)
+                assert ok, (tk.kernel_id, plan, reason)
+
+
+# -------------------------------------------------------------- key material
+def test_class_token_is_order_independent_and_plan_id_stable():
+    sc = _conv_sc()
+    assert autotune.class_token(sc) == autotune.class_token(
+        dict(reversed(list(sc.items()))))
+    assert autotune.plan_id_of({"block_q": 256, "block_k": 128}) == \
+        "block_k=128,block_q=256"
+
+
+def test_forced_stack_wins_and_unwinds():
+    with autotune.forced("pallas_conv", {"bo": 4}):
+        assert autotune.lookup("pallas_conv", _conv_sc()) == {"bo": 4}
+        with autotune.forced("pallas_conv", {"bo": 2}):
+            assert autotune.lookup("pallas_conv",
+                                   _conv_sc()) == {"bo": 2}
+        assert autotune.lookup("pallas_conv", _conv_sc()) == {"bo": 4}
+    assert autotune.lookup("pallas_conv", _conv_sc()) is None
+
+
+def test_disabled_is_inert(monkeypatch, tmp_path):
+    """MXTPU_AUTOTUNE unset: installs are invisible to lookup, the
+    policy token is the constant "0", and ensure_loaded never scans."""
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR", str(tmp_path))
+    autotune.save_plan("pallas_conv", _conv_sc(), {"bo": 2})
+    autotune.install_plan("pallas_conv", _conv_sc(), {"bo": 2})
+    assert autotune.lookup("pallas_conv", _conv_sc()) is None
+    assert autotune.policy_token() == "0"
+
+
+# ---------------------------------------------------- search + persistence
+def test_search_prunes_times_and_persists_only_improvements(
+        monkeypatch, tmp_path):
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR", str(tmp_path))
+    s0 = _ctr("autotune.searches")
+    sc = _conv_sc(n=1, h=8, cin=4, cout=8)
+    res = autotune.search("pallas_conv", sc, rounds=1, budget_s=60)
+    assert _ctr("autotune.searches") == s0 + 1
+    assert res["timed"] >= 1
+    assert res["default_plan_id"] == autotune.plan_id_of(
+        pc._tune_default(sc))
+    assert res["timings"][0]["plan_id"] == res["default_plan_id"]
+    ids = [t["plan_id"] for t in res["timings"]]
+    assert len(ids) == len(set(ids))         # dedup by plan identity
+    if res["improved"]:
+        assert res["best_s"] < res["default_s"]
+        assert res["persisted"] and os.path.exists(res["persisted"])
+        assert autotune.installed()
+    else:
+        assert res["persisted"] is None
+        assert not autotune.installed()      # ties keep the default
+
+
+def test_search_budget_stops_with_best_so_far(monkeypatch):
+    res = autotune.search("pallas_conv", _conv_sc(n=1, h=8),
+                          rounds=1, budget_s=0.0, install=False,
+                          persist=False)
+    # deadline already passed: the default still timed, sweep cut short
+    assert res["timed"] >= 1
+    assert res["budget_exhausted"] or res["candidates"] == res["timed"]
+
+
+def test_persisted_plan_roundtrip_serves_from_disk(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR", str(tmp_path))
+    sc = _conv_sc(h=8)
+    path = autotune.save_plan("pallas_conv", sc, {"bo": 2},
+                              meta={"speedup": 1.5})
+    assert path and os.path.basename(path).startswith("plan_")
+    rec = json.load(open(path, encoding="utf-8"))
+    assert rec["magic"] == "MXTPU-AT"
+    assert rec["env"]["format"] == autotune.FORMAT_VERSION
+    assert rec["key"].startswith("pallas_conv|")
+    autotune.reset()                          # "fresh process"
+    monkeypatch.setenv("MXTPU_AUTOTUNE", "1")
+    h0 = _ctr("autotune.plan_hits", tag="disk")
+    assert autotune.lookup("pallas_conv", sc) == {"bo": 2}
+    assert _ctr("autotune.plan_hits", tag="disk") == h0 + 1
+    pid, prov = autotune.active_plan("pallas_conv", sc)
+    assert (pid, prov) == ("bo=2", "tuned")
+    # an unknown class misses and the gauge resets to default
+    m0 = _ctr("autotune.plan_misses")
+    assert autotune.lookup("pallas_conv", _conv_sc(h=16)) is None
+    assert _ctr("autotune.plan_misses") == m0 + 1
+
+
+def test_active_plan_reports_default_provenance_for_default_plan(
+        monkeypatch):
+    monkeypatch.setenv("MXTPU_AUTOTUNE", "1")
+    sc = _conv_sc(h=8)
+    autotune.install_plan("pallas_conv", sc, pc._tune_default(sc))
+    pid, prov = autotune.active_plan("pallas_conv", sc)
+    assert prov == "default" and pid is not None
+
+
+# --------------------------------------------------------- degradation matrix
+def _plant(tmp_path, sc, plan, kernel="pallas_conv"):
+    path = autotune.save_plan(kernel, sc, plan, root=str(tmp_path))
+    assert path
+    return path
+
+
+def _serve(monkeypatch, tmp_path):
+    autotune.reset()
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("MXTPU_AUTOTUNE", "1")
+
+
+def test_truncated_blob_drops_corrupt(monkeypatch, tmp_path):
+    sc = _conv_sc(h=8)
+    path = _plant(tmp_path, sc, {"bo": 2})
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[:len(blob) // 3])
+    _serve(monkeypatch, tmp_path)
+    d0 = _ctr("autotune.drops", tag="corrupt")
+    assert autotune.lookup("pallas_conv", sc) is None   # default, no crash
+    assert _ctr("autotune.drops", tag="corrupt") == d0 + 1
+
+
+def test_garbage_blob_drops_corrupt(monkeypatch, tmp_path):
+    sc = _conv_sc(h=8)
+    path = _plant(tmp_path, sc, {"bo": 2})
+    with open(path, "wb") as f:
+        f.write(b"not json at all \x00\xff")
+    _serve(monkeypatch, tmp_path)
+    d0 = _ctr("autotune.drops", tag="corrupt")
+    assert autotune.lookup("pallas_conv", sc) is None
+    assert _ctr("autotune.drops", tag="corrupt") == d0 + 1
+
+
+def test_ill_typed_plan_drops_corrupt(monkeypatch, tmp_path):
+    sc = _conv_sc(h=8)
+    path = _plant(tmp_path, sc, {"bo": 2})
+    rec = json.load(open(path, encoding="utf-8"))
+    rec["plan"] = [2]                         # not a dict
+    json.dump(rec, open(path, "w", encoding="utf-8"))
+    _serve(monkeypatch, tmp_path)
+    d0 = _ctr("autotune.drops", tag="corrupt")
+    assert autotune.lookup("pallas_conv", sc) is None
+    assert _ctr("autotune.drops", tag="corrupt") == d0 + 1
+
+
+def test_format_or_device_skew_drops_version_mismatch(monkeypatch,
+                                                      tmp_path):
+    sc = _conv_sc(h=8)
+    path = _plant(tmp_path, sc, {"bo": 2})
+    rec = json.load(open(path, encoding="utf-8"))
+    rec["env"] = {"format": autotune.FORMAT_VERSION + 1,
+                  "device": rec["env"]["device"]}
+    json.dump(rec, open(path, "w", encoding="utf-8"))
+    _serve(monkeypatch, tmp_path)
+    d0 = _ctr("autotune.drops", tag="version_mismatch")
+    assert autotune.lookup("pallas_conv", sc) is None
+    assert _ctr("autotune.drops", tag="version_mismatch") == d0 + 1
+
+
+def test_foreign_device_blob_drops_version_mismatch(monkeypatch,
+                                                    tmp_path):
+    sc = _conv_sc(h=8)
+    path = _plant(tmp_path, sc, {"bo": 2})
+    rec = json.load(open(path, encoding="utf-8"))
+    rec["env"] = {"format": autotune.FORMAT_VERSION,
+                  "device": "tpu/TPU v9"}
+    json.dump(rec, open(path, "w", encoding="utf-8"))
+    _serve(monkeypatch, tmp_path)
+    d0 = _ctr("autotune.drops", tag="version_mismatch")
+    assert autotune.lookup("pallas_conv", sc) is None
+    assert _ctr("autotune.drops", tag="version_mismatch") == d0 + 1
+
+
+def test_forged_rename_drops_key_mismatch(monkeypatch, tmp_path):
+    """A blob renamed onto ANOTHER class's digest is refused by the
+    in-blob key check — geometry tuned for one shape class can never be
+    served to a different one."""
+    sc_a, sc_b = _conv_sc(h=8), _conv_sc(h=16)
+    path_a = _plant(tmp_path, sc_a, {"bo": 2})
+    path_b = autotune.plan_path("pallas_conv", sc_b, root=str(tmp_path))
+    os.replace(path_a, path_b)
+    _serve(monkeypatch, tmp_path)
+    d0 = _ctr("autotune.drops", tag="key_mismatch")
+    assert autotune.lookup("pallas_conv", sc_b) is None
+    assert _ctr("autotune.drops", tag="key_mismatch") == d0 + 1
+
+
+def test_io_failure_counts_and_returns_none(monkeypatch, tmp_path):
+    target = tmp_path / "not_a_dir"
+    target.write_text("file blocks the mkdir")
+    d0 = _ctr("autotune.drops", tag="io")
+    assert autotune.save_plan("pallas_conv", _conv_sc(h=8), {"bo": 2},
+                              root=str(target / "x")) is None
+    assert _ctr("autotune.drops", tag="io") == d0 + 1
+
+
+def test_infeasible_served_plan_degrades_at_consult(monkeypatch,
+                                                    tmp_path, ):
+    """A plan that passes the blob checks but fails the kernel's OWN
+    revalidation (bo no longer divides oh) degrades to the default at
+    _resolve with autotune.drops{infeasible} — never a Mosaic error."""
+    monkeypatch.setenv("MXTPU_PALLAS_CONV_INTERPRET", "1")
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(1, 8, 8, 4), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, 4, 8) * 0.1, jnp.float32)
+    with autotune.forced("pallas_conv", {"bo": 3}):   # oh=8, 8 % 3 != 0
+        d0 = _ctr("autotune.drops", tag="infeasible")
+        out = pc.fused_conv(x, w, (1, 1), ((1, 1), (1, 1)))
+        assert _ctr("autotune.drops", tag="infeasible") >= d0 + 1
+    ref = lax.conv_general_dilated(x, w, (1, 1), ((1, 1), (1, 1)),
+                                   dimension_numbers=DN)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------------------ policy-key identity
+def test_policy_token_flips_on_install_and_registry_carries_it(
+        monkeypatch):
+    monkeypatch.setenv("MXTPU_AUTOTUNE", "1")
+    key_off = registry.policy_key()
+    t0 = autotune.policy_token()
+    assert t0 == "0"                          # empty table
+    autotune.install_plan("pallas_conv", _conv_sc(h=8), {"bo": 2})
+    t1 = autotune.policy_token()
+    assert t1 not in ("0", t0)
+    key_on = registry.policy_key()
+    assert key_off != key_on                  # the digest rides the key
+    assert t1 in key_on
+    autotune.install_plan("pallas_conv", _conv_sc(h=8), {"bo": 4})
+    assert autotune.policy_token() != t1      # plan flip -> new digest
+    # stability: same installed set, same token
+    assert autotune.policy_token() == autotune.policy_token()
+
+
+def test_warmup_preloads_plan_table(monkeypatch, tmp_path):
+    from mxtpu import compile_service as csvc
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR", str(tmp_path))
+    autotune.save_plan("pallas_conv", _conv_sc(h=8), {"bo": 2})
+    autotune.reset()
+    monkeypatch.setenv("MXTPU_AUTOTUNE", "1")
+    csvc.warmup([])                           # fleet warmup path
+    assert autotune.installed(), "warmup must preload plan artifacts"
+
+
+# --------------------------------------------- zero warm-start (subprocess)
+_CHILD = r"""
+import json, os, sys
+import numpy as np
+import jax.numpy as jnp
+from mxtpu import telemetry
+from mxtpu.ops.pallas import autotune
+from mxtpu.ops.pallas import conv as pc
+
+sc = json.loads(os.environ["AT_TEST_CLASS"])
+rng = np.random.RandomState(0)
+x = jnp.asarray(rng.randn(sc["n"], sc["h"], sc["w"], sc["cin"]),
+                jnp.float32)
+w = jnp.asarray(rng.randn(sc["kh"], sc["kw"], sc["cin"], sc["cout"]) * 0.1,
+                jnp.float32)
+out = pc.fused_conv(x, w, (sc["sh"], sc["sw"]),
+                    ((sc["p0"], sc["p1"]), (sc["q0"], sc["q1"])))
+print("AT_CHILD " + json.dumps({
+    "searches": telemetry.value("autotune.searches"),
+    "hits_disk": telemetry.value("autotune.plan_hits", tag="disk"),
+    "drops": telemetry.tagged("autotune.drops"),
+    "plan": autotune.lookup("pallas_conv", sc),
+    "pallas_dispatches": pc.DISPATCH_STATS["pallas"],
+    "checksum": float(np.asarray(out).sum()),
+}))
+"""
+
+
+def test_fresh_process_serves_tuned_plans_zero_searches(tmp_path):
+    """ISSUE-17 acceptance: a fresh process against a warm plan dir
+    serves the tuned plan with ZERO measured searches (and zero search
+    probes compiled — the searches counter is the probe account), zero
+    drops, and the identical kernel output."""
+    sc = _conv_sc(n=1, h=8, cin=4, cout=8)
+    autotune.save_plan("pallas_conv", sc, {"bo": 2}, root=str(tmp_path))
+
+    def run():
+        env = dict(os.environ, PYTHONPATH=REPO,
+                   MXTPU_COMPILE_CACHE_DIR=str(tmp_path),
+                   MXTPU_AUTOTUNE="1",
+                   MXTPU_PALLAS_CONV_INTERPRET="1",
+                   AT_TEST_CLASS=json.dumps(sc))
+        proc = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                              cwd=REPO, capture_output=True, text=True,
+                              timeout=300)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        line = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith("AT_CHILD ")][0]
+        return json.loads(line[len("AT_CHILD "):])
+
+    a, b = run(), run()
+    for r in (a, b):
+        assert r["searches"] == 0, r          # zero warm-start searches
+        assert r["hits_disk"] >= 1, r
+        assert r["drops"] in ({}, None), r
+        assert r["plan"] == {"bo": 2}, r
+        assert r["pallas_dispatches"] >= 1, r  # tuned geometry really ran
+    assert a["checksum"] == b["checksum"]      # deterministic serving
+
+
+# ------------------------------------------- candidate-plan interpret parity
+def _conv_candidates(sc):
+    tk = autotune.kernels()["pallas_conv"]
+    plans, seen = [], set()
+    for plan in [tk.default(sc)] + list(tk.space(sc)):
+        pid = autotune.plan_id_of(plan)
+        if pid in seen or not tk.feasible(plan, sc)[0]:
+            continue
+        seen.add(pid)
+        plans.append(plan)
+    return plans
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("h,k,s,p", [(25, 3, 1, 1),   # odd spatial
+                                     (47, 3, 2, 1)])  # odd + stride 2
+def test_every_conv_candidate_plan_matches_xla(monkeypatch, dtype,
+                                               h, k, s, p):
+    """Every plan the search may emit for odd/stride-2 classes runs the
+    REAL kernel (interpreter) to the XLA reference — a winning plan is
+    a fast plan, never a differently-answering one."""
+    monkeypatch.setenv("MXTPU_PALLAS_CONV_INTERPRET", "1")
+    dt = jnp.dtype(dtype)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(1, h, h, 4), dt)
+    w = jnp.asarray(rng.randn(k, k, 4, 8) * 0.1, dt)
+    pad = ((p, p), (p, p))
+    ref = lax.conv_general_dilated(
+        x.astype(jnp.float32), w.astype(jnp.float32), (s, s), pad,
+        dimension_numbers=DN)
+    sc = _conv_sc(n=1, h=h, cin=4, cout=8, k=k, s=s, p=p, dtype=dtype)
+    plans = _conv_candidates(sc)
+    assert len(plans) >= 2                    # a real space, not a point
+    tol = dict(rtol=1e-5, atol=1e-5) if dtype == "float32" \
+        else dict(rtol=2e-2, atol=2e-2)
+    for plan in plans:
+        with autotune.forced("pallas_conv", plan):
+            out = pc.fused_conv(x, w, (s, s), pad)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref), **tol)
+
+
+def _flash_candidates(sc):
+    tk = autotune.kernels()["pallas_flash"]
+    plans, seen = [], set()
+    for plan in [tk.default(sc)] + list(tk.space(sc)):
+        pid = autotune.plan_id_of(plan)
+        if pid in seen or not tk.feasible(plan, sc)[0]:
+            continue
+        seen.add(pid)
+        plans.append(plan)
+    return plans
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_every_flash_candidate_plan_matches_xla(monkeypatch, dtype):
+    monkeypatch.setenv("MXTPU_FLASH_INTERPRET", "1")
+    dt = jnp.dtype(dtype)
+    rng = np.random.RandomState(0)
+    b, h, t, d = 1, 2, 256, 64
+    q = jnp.asarray(rng.randn(b, h, t, d), dt)
+    k = jnp.asarray(rng.randn(b, h, t, d), dt)
+    v = jnp.asarray(rng.randn(b, h, t, d), dt)
+    scale = 1.0 / (d ** 0.5)
+    ref = fa._xla_attention(q.astype(jnp.float32),
+                            k.astype(jnp.float32),
+                            v.astype(jnp.float32), False, scale)
+    sc = fa.shape_class_of(q, k)
+    plans = _flash_candidates(sc)
+    assert len(plans) >= 2
+    tol = dict(rtol=2e-5, atol=2e-5) if dtype == "float32" \
+        else dict(rtol=3e-2, atol=3e-2)
+    p0 = telemetry.value("pallas_flash.pallas")
+    for plan in plans:
+        with autotune.forced("pallas_flash", plan):
+            out = fa.flash_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref), **tol)
+    assert telemetry.value("pallas_flash.pallas") >= p0 + len(plans)
+
+
+# ----------------------------------------------- flash dispatch observability
+def test_flash_dispatch_counters_mirror_conv(monkeypatch):
+    fa.reset_dispatch_stats()
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 1, 16, 8), jnp.float32)
+    # off-TPU without the interpreter: counted reason-tagged fallback
+    out = fa.flash_attention(q, q, q)
+    assert fa.DISPATCH_STATS["xla"] >= 1
+    assert fa.DISPATCH_STATS["fallback_reasons"].get(
+        "platform is not tpu", 0) >= 1
+    assert fa.DISPATCH_STATS["pallas"] == 0
+    # the interpreter path counts as a pallas dispatch
+    monkeypatch.setenv("MXTPU_FLASH_INTERPRET", "1")
+    q2 = jnp.asarray(rng.randn(1, 1, 128, 64), jnp.float32)
+    fa.flash_attention(q2, q2, q2)
+    assert fa.DISPATCH_STATS["pallas"] >= 1
+    assert out.shape == q.shape
+
+
+# ------------------------------------------------------------ bench A/B
+def test_bench_autotune_ab_record_schema(monkeypatch):
+    """bench._autotune_ab (the conv_class/flash_class tuned-vs-default
+    lines) must emit the x_vs_default schema with the not-worse gate and
+    must NOT install into the serving table. A single-candidate class
+    (oh*ow <= 256 collapses the target-M ladder) keeps it cheap: the
+    search times exactly the default."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    monkeypatch.setenv("MXTPU_PALLAS_CONV_INTERPRET", "1")
+    monkeypatch.setenv("MXTPU_AUTOTUNE_ROUNDS", "1")
+    lines = []
+    rec = bench._autotune_ab(lines.append, autotune, "pallas_conv",
+                             "conv_tiny", _conv_sc(h=8), host_tier=True)
+    assert lines == [rec] and "error" not in rec
+    assert rec["unit"] == "x_vs_default"
+    assert rec["impl"] == "autotune_ab"
+    assert rec["default_plan"] == rec["best_plan"]   # only candidate
+    assert rec["not_worse"] and not rec["improved"]
+    assert rec["timed"] == 1 and rec["candidates"] == 1
+    assert rec["value"] == pytest.approx(1.0)
+    assert autotune.installed() == {}                # install=False held
+
+
+# --------------------------------------------------- ledger -> tuning queue
+def test_tuning_queue_emitter_ranks_by_executed_flops(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import telemetry_report as tr
+    finally:
+        sys.path.pop(0)
+    cands = [
+        {"site": "trainer.step", "seq": 1, "shapes": ["f32[8,224,224,3]"],
+         "intensity": 4.0, "verdict": "memory", "calls": 100,
+         "flops": 2e9},
+        {"site": "serving.predict", "seq": 2, "shapes": None,
+         "intensity": 9.0, "verdict": "memory", "calls": 10,
+         "flops": 1e9},
+    ]
+    q = tr.tuning_queue([], cands)
+    assert q["format"] == 1
+    assert [e["site"] for e in q["queue"]] == ["trainer.step",
+                                               "serving.predict"]
+    assert q["queue"][0]["executed_gflops"] == pytest.approx(200.0)
+    assert q["queue"][0]["shapes"] == ["f32[8,224,224,3]"]
+    # the CLI consumes it: queue-ranked kernel ordering
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import autotune_session as ats
+    finally:
+        sys.path.pop(0)
+    order = ats._kernel_order(
+        [{"site": "transformer.attention"}, {"site": "resnet.conv"}],
+        {"pallas_conv": None, "pallas_flash": None})
+    assert order == ["pallas_flash", "pallas_conv"]

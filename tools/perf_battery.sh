@@ -41,6 +41,26 @@ python -m tools.graftlint mxtpu/ 2>&1 | tee -a "$LOG"
   echo "GRAFTLINT FAILED — fix findings before spending a TPU session" \
     | tee -a "$LOG"; exit 1; }
 
+# -0.5. measured block-plan tuning session (ISSUE 17, docs/autotune.md),
+#    AHEAD of the kernel benches so conv_class/flash_class and any
+#    MXTPU_AUTOTUNE=1 phase can serve the persisted plans. Pinned to the
+#    CPU host tier (interpret-mode candidates, chip-safe: zero TPU
+#    sessions burned) and wall-bounded per search. If a previous
+#    battery's ledger JSONL is still on disk, it is folded into a
+#    ranked tuning queue first (observe -> tune -> persist -> serve);
+#    a missing ledger just means registry-ordered kernels.
+export MXTPU_COMPILE_CACHE_DIR=${MXTPU_COMPILE_CACHE_DIR:-/tmp/mxtpu_compile_cache_dir}
+AUTOTUNE_QUEUE=""
+[ -s "$TELEMETRY_JSONL" ] && {
+  python tools/telemetry_report.py "$TELEMETRY_JSONL" --ledger \
+    --tuning-queue tuning_queue.json >/dev/null 2>&1 \
+    && AUTOTUNE_QUEUE="--queue tuning_queue.json"
+}
+timeout 600 env JAX_PLATFORMS=cpu \
+  MXTPU_AUTOTUNE_BUDGET_S=${MXTPU_AUTOTUNE_BUDGET_S:-20} \
+  python tools/autotune_session.py $AUTOTUNE_QUEUE --limit 8 \
+  2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
+
 # 0. is the chip alive? (90 s; bail early if wedged). This is the ONLY
 #    extra session besides the battery itself.
 timeout 90 python -c "

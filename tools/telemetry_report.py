@@ -27,10 +27,16 @@ column folds in the site's own span p50 where one exists (e.g.
 ``serving.predict``) — an approximation (host dispatch wall time, not
 device occupancy), printed only where the span times the dispatch.
 
+``--tuning-queue <json>`` (implies ``--ledger``) writes the ranked
+memory-bound candidate list as the Pallas autotuner's work order —
+site, captured argument shapes, intensity, verdict, executed FLOPs —
+which ``tools/autotune_session.py`` consumes top-down (docs/autotune.md,
+the observe → tune → persist → serve loop).
+
 Usage::
 
     python tools/telemetry_report.py telemetry.jsonl [--json]
-        [--traces [K]] [--ledger]
+        [--traces [K]] [--ledger] [--tuning-queue <json>]
 """
 from __future__ import annotations
 
@@ -169,7 +175,8 @@ def ledger_summary(lines):
                "bytes_accessed": e.get("bytes_accessed"),
                "intensity": e.get("intensity"),
                "critical_intensity": e.get("critical_intensity"),
-               "verdict": e.get("verdict"), "error": e.get("error")}
+               "verdict": e.get("verdict"), "error": e.get("error"),
+               "shapes": e.get("shapes")}
         vals = obs.get(site)
         if vals and fl:
             vals = sorted(vals)
@@ -209,6 +216,24 @@ def format_ledger_table(rows, cands):
                          "%s#%s" % (r["site"], r["seq"])
                          for r in cands[:8]))
     return "\n".join(lines)
+
+
+def tuning_queue(rows, cands):
+    """The ledger's memory-bound shortlist as the autotuner's work order:
+    ``{"format": 1, "queue": [{site, seq, shapes, intensity, verdict,
+    calls, executed_gflops}, ...]}`` ranked by executed FLOPs — the
+    order ``tools/autotune_session.py`` consumes top-down (tune where a
+    better block plan buys the most first)."""
+    queue = []
+    for r in cands:
+        queue.append({"site": r["site"], "seq": r["seq"],
+                      "shapes": r.get("shapes"),
+                      "intensity": r.get("intensity"),
+                      "verdict": r.get("verdict"),
+                      "calls": r["calls"],
+                      "executed_gflops": (r["flops"] * max(r["calls"], 1)
+                                          / 1e9)})
+    return {"format": 1, "queue": queue}
 
 
 def load(path):
@@ -261,6 +286,14 @@ def main(argv):
             # consume the count token BY INDEX: a data file that happens
             # to be named like the number must not be dropped from paths
             top = int(argv.pop(nxt))
+    queue_path = None
+    if "--tuning-queue" in argv:
+        nxt = argv.index("--tuning-queue") + 1
+        if nxt >= len(argv):
+            print("--tuning-queue needs an output path", file=sys.stderr)
+            return 1
+        queue_path = argv.pop(nxt)   # consume BY INDEX, like --traces
+        with_ledger = True           # the queue IS a ledger product
     paths = [a for a in argv if not a.startswith("-")]
     if not paths or "-h" in argv or "--help" in argv:
         print(__doc__)
@@ -270,6 +303,12 @@ def main(argv):
     summary = aggregate(records)
     traces = trace_summary(records, top=top) if top is not None else None
     ledger = ledger_summary(records) if with_ledger else None
+    if queue_path is not None:
+        q = tuning_queue(*ledger)
+        with open(queue_path, "w", encoding="utf-8") as f:
+            json.dump(q, f, sort_keys=True, indent=1)
+        print("tuning queue: %d site(s) -> %s"
+              % (len(q["queue"]), queue_path), file=sys.stderr)
     if as_json:
         out = dict(summary)
         if traces is not None:
