@@ -1323,6 +1323,40 @@ def bench_startup_time(emit=None):
     }
 
 
+def bench_fleet_resume(emit=None):
+    """Elastic fleet matrix (mxtpu/fleet.py, ISSUE 18): kill-one-host
+    tiered restore + warm elastic rejoin, every host a real subprocess
+    on the forced-CPU tier (chip-safe). Four phases — 2-host fleet with
+    ``host_loss@K`` injected, 1-host restore onto a RESHAPED mesh,
+    uninterrupted oracle, 2-host warm rejoin against the same compile
+    cache. Gates: kill detected loud (exit 41/42, nothing hung), the
+    restore resumes at K with the divergence sentinel green, post-restore
+    losses match the oracle within reduce-order tolerance, and every
+    rejoined host reaches step 1 with ZERO compiles (watchdog-pinned),
+    all executables disk-served. ``vs_baseline`` = killed-fleet wall /
+    warm-rejoin wall iff every gate holds, else 0.0."""
+    if emit is None:
+        emit = _emit
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import fleet_bench
+
+    rec = fleet_bench.run_fleet_resume(emit=emit)
+    gates = rec.get("gates", {})
+    return {
+        "metric": "fleet_resume",
+        "value": round(rec.get("rejoin_wall_s") or 0.0, 3),
+        "unit": "rejoin_wall_s",
+        "vs_baseline": rec.get("vs_baseline", 0.0) if rec.get("ok")
+        else 0.0,
+        "mfu": None,
+        "hfu": None,
+        "kill_step": rec.get("kill_step"),
+        "gates": gates,
+        "gates_ok": rec.get("ok", False),
+    }
+
+
 def bench_multichip_resnet(emit=None):
     """Mesh-native Trainer scaling (ISSUE 7): resnet18 data-parallel over
     1..N devices through ``gluon.Trainer(mesh=...)`` with ZeRO-1 on, at a
@@ -1667,6 +1701,7 @@ CONFIGS = {
     "serving_decode": bench_serving_decode,
     "serving_slo": bench_serving_slo,
     "startup_time": bench_startup_time,
+    "fleet_resume": bench_fleet_resume,
     "multichip_resnet": bench_multichip_resnet,
     "input_pipeline": bench_input_pipeline,
     "sparse_linear": bench_sparse_linear,

@@ -83,6 +83,7 @@ from . import operator
 from . import contrib
 from . import rnn
 from . import parallel
+from . import fleet
 from . import serving
 from . import rtc
 from . import libinfo

@@ -204,18 +204,35 @@ def canonical_key(site, fn_id, signature, policy=None, sharding=None,
                device, nonce)
 
 
+def _local_ordinal(d):
+    """A device's ordinal within its OWN process's device set. Global ids
+    bake the host rank into the token (host 1's only CPU device is global
+    id 1), which would stop a replacement host from warm-starting off the
+    blobs an identical peer spilled; process-local ordinals make
+    equivalent per-host placements token-equal across hosts while a
+    device-2 mesh still differs from a device-0 mesh on one host."""
+    import jax
+    try:
+        peers = [x.id for x in jax.devices()
+                 if x.process_index == d.process_index]
+        return int(d.id) - min(peers)
+    except Exception:  # noqa: BLE001 — exotic backend: raw id is a token too
+        return int(d.id)
+
+
 def device_token(device=None, mesh=None):
     """Stable placement token: backend kind + device ordinal (or the
-    mesh's device-id tuple). Executables are device-pinned — the token
-    keeps a device-2 artifact from being offered to a device-0
-    restore."""
+    mesh's device-ordinal tuple). Executables are device-pinned — the
+    token keeps a device-2 artifact from being offered to a device-0
+    restore — but pinned per host, not per fleet (see
+    :func:`_local_ordinal`)."""
     import jax
     backend = jax.default_backend()
     if mesh is not None:
-        ids = tuple(int(d.id) for d in mesh.devices.flat)
+        ids = tuple(_local_ordinal(d) for d in mesh.devices.flat)
         return "%s:mesh%s" % (backend, ids)
     if device is not None:
-        return "%s:d%d" % (backend, int(device.id))
+        return "%s:d%d" % (backend, _local_ordinal(device))
     return "%s:default" % backend
 
 
@@ -399,6 +416,34 @@ def _mark_unloadable(path):
         pass
 
 
+def _device_span(compiled):
+    """Distinct device count an executable is bound to, read off its
+    input shardings (0 when introspection fails — treated as unknown)."""
+    try:
+        import jax
+        ins, _ = compiled.input_shardings
+        devs = set()
+        for s in jax.tree_util.tree_leaves(ins):
+            devs |= set(getattr(s, "device_set", ()))
+        return len(devs)
+    except Exception:  # noqa: BLE001 — stages API moved / no inputs
+        return 0
+
+
+def _cpu_serialization_unsound(num_devices):
+    """XLA:CPU cannot round-trip multi-device executables: the
+    generated fusion symbols either fail to resolve at load ("Symbols
+    not found" — the loud case ``_known_unloadable`` already handles)
+    or, worse, resolve to the WRONG kernels and the deserialized
+    executable silently computes garbage (measured: an sgd-momentum
+    fused update over a 2-device mesh returns ~2x-scaled momentum
+    terms after a round-trip; the same build on 1 device is bit-exact).
+    Single-device CPU blobs are sound and stay served; multi-device
+    ones are refused at write AND load. TPU/GPU are unaffected."""
+    import jax
+    return jax.default_backend() == "cpu" and num_devices != 1
+
+
 def _disk_load(key):
     """Probe the disk cache for ``key``. Returns an :class:`Entry` or
     None. EVERY failure mode degrades to None (recompile) with a
@@ -426,6 +471,11 @@ def _disk_load(key):
         # digest collision or a forged rename: the executable was built
         # for a DIFFERENT canonical key (other policy/sharding/donation)
         return _drop_blob("key_mismatch", key.site, path)
+    if _cpu_serialization_unsound(rec.get("devices") or 0):
+        # a pre-guard blob (no recorded span) or a multi-device one on
+        # XLA:CPU: deserializing risks SILENT numeric corruption, not
+        # just a load error — never serve it (see the guard's docstring)
+        return _drop_blob("cpu_multidevice", key.site, path)
     try:
         from jax.experimental import serialize_executable as se
         compiled = se.deserialize_and_load(
@@ -464,11 +514,18 @@ def _disk_write(key, compiled, meta, provenance, compile_s):
         # serialization (that cost per restart is the exact churn the
         # marker exists to stop)
         return False
+    span = _device_span(compiled)
+    if _cpu_serialization_unsound(span):
+        # refuse BEFORE paying serialization: the blob would load as
+        # garbage (or not at all) on every warm start
+        telemetry.inc("compile.disk.drops", tag="cpu_multidevice")
+        return False
     try:
         from jax.experimental import serialize_executable as se
         payload, in_tree, out_tree = se.serialize(compiled)
         rec = {"magic": _MAGIC, "env": _env_material(),
                "key": key.digest_material(), "site": key.site,
+               "devices": span,
                "payload": payload, "in_tree": in_tree,
                "out_tree": out_tree, "meta": meta,
                "provenance": _json_safe(provenance),

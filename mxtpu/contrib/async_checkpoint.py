@@ -58,13 +58,58 @@ _ASYNC_CKPTR = None  # ONE shared instance: orbax's save only barriers on
 # break the "next save waits" contract and leak background threads
 
 
+def _mp_options():
+    """Orbax multiprocessing options for this process.
+
+    Orbax's save/restore barriers default to the psum-based
+    ``sync_global_devices``, which XLA:CPU cannot run across processes
+    at all ("Multiprocess computations aren't implemented on the CPU
+    backend") — a multi-process fleet on the forced-CPU tier could
+    never checkpoint. There every array is host-local anyway (the fleet
+    runs per-host local meshes, coupled through the fleet board), so
+    each process runs orbax in SINGLE-PROCESS mode: it is its own
+    primary host, its barrier set is itself, and the sync-key prefix is
+    rank-tagged so two processes touching the same step directory never
+    collide on a coordination-service barrier key. The fleet tier's
+    single-writer discipline (rank 0 saves, peers only restore behind
+    the resume board barrier) is what makes this sound. Backends with
+    global compute keep orbax's stock multi-host protocol."""
+    import orbax.checkpoint as ocp
+
+    from .. import distributed
+    if jax.process_count() <= 1 or distributed.global_compute_supported():
+        return {}
+    return {"multiprocessing_options": ocp.options.MultiprocessingOptions(
+        primary_host=jax.process_index(),
+        active_processes={jax.process_index()},
+        barrier_sync_key_prefix="mxtpu_host%d" % jax.process_index())}
+
+
+def _serializable(tree):
+    """Orbax refuses jax Arrays whose sharding spans only this host's
+    devices while the runtime has more processes ("Cannot serialize host
+    local arrays") — exactly what every array IS on the CPU fleet tier
+    (per-host local meshes). Same tier as :func:`_mp_options`: fetch
+    those leaves to host numpy, which orbax serializes without a global
+    sharding story. Values are identical (the fleet tier replicates
+    state host-to-host); single-process and global-compute backends
+    return the tree untouched, keeping sharded zero-copy saves."""
+    from .. import distributed
+    if jax.process_count() <= 1 or distributed.global_compute_supported():
+        return tree
+    import numpy as np
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, tree)
+
+
 def _checkpointer(async_save):
     import orbax.checkpoint as ocp
     if async_save:
         global _ASYNC_CKPTR
         if _ASYNC_CKPTR is None:
             import atexit
-            _ASYNC_CKPTR = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+            _ASYNC_CKPTR = ocp.AsyncCheckpointer(
+                ocp.PyTreeCheckpointHandler(), **_mp_options())
             atexit.register(_ASYNC_CKPTR.close)  # drain pending writes
         # a background write that DIED must fail the next save loudly, not
         # rot silently in the async thread: re-raise its exception here
@@ -73,7 +118,7 @@ def _checkpointer(async_save):
         if check is not None:
             check()
         return _ASYNC_CKPTR
-    return ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+    return ocp.Checkpointer(ocp.PyTreeCheckpointHandler(), **_mp_options())
 
 
 def _guard_overwrite(step_dir, force):
@@ -135,7 +180,12 @@ def _crc_host(x):
     import zlib
 
     import numpy as np
-    arr = np.asarray(jax.device_get(x))
+
+    # fleet meshes make some arrays non-fully-addressable (ZeRO shards);
+    # host_value allgathers those collectively — EVERY process runs this
+    # same manifest walk, so the collective is symmetric by construction
+    from ..parallel.mesh import host_value
+    arr = host_value(x)
     return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
 
 
@@ -466,7 +516,7 @@ def save_trainer(trainer, directory, step=0, async_save=False, force=False):
     sd = _step_dir(directory, step)
     _guard_overwrite(sd, force)
     ckptr = _checkpointer(async_save)
-    ckptr.save(sd, tree, force=True)
+    ckptr.save(sd, _serializable(tree), force=True)
     # a force re-save over a known-corrupt step IS a fresh checkpoint:
     # drop the tombstone so the new bytes are restorable again
     _clear_tombstone(sd)

@@ -29,7 +29,7 @@ import jax
 from .base import MXNetError
 
 __all__ = ["init", "is_initialized", "shutdown", "rank", "num_workers",
-           "barrier"]
+           "barrier", "global_compute_supported"]
 
 _initialized = False
 
@@ -57,6 +57,17 @@ def init(coordinator_address=None, num_processes=None, process_id=None,
     """
     global _initialized
     if _initialized:
+        return rank(), num_workers()
+    # Adopt a runtime that is already up (jax.distributed autodetection on
+    # Cloud TPU pods, or a framework that initialized before us): calling
+    # jax.distributed.initialize() again would raise, and the module flag
+    # alone cannot know about it.
+    try:
+        already = jax.distributed.is_initialized()
+    except Exception:
+        already = False
+    if already:
+        _initialized = True
         return rank(), num_workers()
     env_coord, env_n, env_id = _env_config()
     coordinator_address = coordinator_address or env_coord
@@ -115,6 +126,18 @@ def rank():
 def num_workers():
     """World size (ref: KVStore::get_group_size)."""
     return jax.process_count()
+
+
+def global_compute_supported():
+    """Whether this backend can run ONE computation spanning every
+    process's devices. XLA:CPU cannot ("Multiprocess computations aren't
+    implemented on the CPU backend"): the rendezvous service and
+    host-side collectives work there, but any jit over a process-spanning
+    mesh — including the psum behind :func:`barrier` — raises. The fleet
+    tier consults this to fall back to per-host local meshes and
+    filesystem barriers on the forced-CPU test tier; TPU/GPU fleets
+    always report True."""
+    return jax.process_count() <= 1 or jax.default_backend() != "cpu"
 
 
 def barrier(name="mxtpu_barrier"):

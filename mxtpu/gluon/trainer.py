@@ -196,8 +196,8 @@ class Trainer:
         the updater's MeshPlan — ZeRO-1 data-axis shards where dim 0
         divides, replicated otherwise. Runs once, at kvstore-init time,
         exactly where the reference bound parameters to its store."""
-        import jax
         from jax.sharding import NamedSharding, PartitionSpec
+        from ..parallel.mesh import place_global
         repl = NamedSharding(self._mesh, PartitionSpec())
         updater = self._updaters[0]
         ensure = getattr(updater, "ensure_state", None)
@@ -205,9 +205,12 @@ class Trainer:
             if param._data is None:
                 continue
             d = param.data()
-            d._set_data(jax.device_put(d._data, repl))
+            # place_global: identical device_put single-process; on a
+            # process-spanning fleet mesh it builds the replicated global
+            # array from this host's copy (device_put cannot)
+            d._set_data(place_global(d._data, repl))
             if d._grad is not None:
-                d._grad._set_data(jax.device_put(d._grad._data, repl))
+                d._grad._set_data(place_global(d._grad._data, repl))
             if ensure is not None and param.grad_req != "null":
                 ensure(i, d)
 
@@ -237,16 +240,33 @@ class Trainer:
             return arrays[0] if len(arrays) == 1 else tuple(arrays)
         import jax
         import jax.numpy as jnp
+        from ..parallel.mesh import is_multiprocess_mesh
         sh = self.batch_sharding
         n = self._mesh.shape[self._data_axis]
+        multiproc = is_multiprocess_mesh(self._mesh)
+        world = len({d.process_index for d in self._mesh.devices.flat}) \
+            if multiproc else 1
         out = []
         for a in arrays:
             d = a._data if isinstance(a, NDArray) else jnp.asarray(a)
-            if not d.shape or d.shape[0] % n:
+            global_rows = (d.shape[0] * world) if d.shape else None
+            if not d.shape or global_rows % n:
                 raise MXNetError(
                     "batch dim %s does not divide the %r mesh axis (%d)"
-                    % (d.shape[:1] or "<scalar>", self._data_axis, n))
-            out.append(NDArray(jax.device_put(d, sh)))
+                    % ((global_rows,) if d.shape else "<scalar>",
+                       self._data_axis, n))
+            if multiproc:
+                # fleet: each host holds ITS slice of the global batch
+                # (Fleet.data_shard determinism); assemble the global
+                # array from the per-host shards — device_put cannot
+                # write shards on devices this host does not address
+                import numpy as np
+                from jax.experimental import multihost_utils
+                g = multihost_utils.host_local_array_to_global_array(
+                    np.asarray(d), self._mesh, sh.spec)
+                out.append(NDArray(g))
+            else:
+                out.append(NDArray(jax.device_put(d, sh)))
         return out[0] if len(out) == 1 else tuple(out)
 
     @property

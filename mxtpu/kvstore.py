@@ -425,9 +425,15 @@ def create(name="local", mesh=None):
         if not distributed.is_initialized():
             raise MXNetError(
                 "kvstore %r needs the multi-process runtime: call "
-                "mxtpu.distributed.init() first (env bootstrap: "
-                "MXTPU_COORDINATOR/MXTPU_NUM_PROCESSES/MXTPU_PROCESS_ID or "
-                "the reference's DMLC_* names; see tools/launch.py). "
+                "mxtpu.fleet.init() (coordinated bring-up: bounded-retry "
+                "join + deadline barrier + heartbeat membership — "
+                "docs/parallelism.md) or the bare mxtpu.distributed.init() "
+                "first (env bootstrap: MXTPU_COORDINATOR/"
+                "MXTPU_NUM_PROCESSES/MXTPU_PROCESS_ID or the reference's "
+                "DMLC_* names; see tools/launch.py). The fleet path is the "
+                "parity story for the reference's dist kvstore: the ps-lite "
+                "scheduler/worker rendezvous becomes one symmetric join, "
+                "and push/pull becomes XLA collectives on the global mesh. "
                 "Refusing to silently fall back to the single-process store."
                 % name)
         return KVStore(name)
@@ -447,5 +453,7 @@ def create(name="local", mesh=None):
         raise MXNetError(
             "dist_async is deliberately unsupported on TPU (synchronous "
             "lockstep machine; no stragglers to hide — see README). "
-            "Use dist_sync")
+            "Use dist_sync after mxtpu.fleet.init() — the elastic "
+            "multi-host bring-up (docs/parallelism.md) — or pass a "
+            "multi-host mesh straight to gluon.Trainer(mesh=...).")
     raise MXNetError("unknown KVStore type %s" % name)

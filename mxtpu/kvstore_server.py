@@ -27,8 +27,10 @@ class KVStoreServer:
         raise MXNetError(
             "Parameter-server roles do not exist in the TPU runtime: "
             "distributed training is symmetric XLA collectives over "
-            "ICI/DCN (mxtpu.distributed.init + kv.create('dist_sync')). "
-            "Launch every process as a worker via tools/launch.py.")
+            "ICI/DCN — join the fleet with mxtpu.fleet.init() (elastic "
+            "bring-up + membership; docs/parallelism.md) or the bare "
+            "mxtpu.distributed.init + kv.create('dist_sync'). Launch "
+            "every process as a worker via tools/launch.py.")
 
 
 def _init_kvstore_server_module():

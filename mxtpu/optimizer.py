@@ -635,7 +635,10 @@ def _state_to_numpy(v):
     if v is None:
         return None
     if isinstance(v, NDArray):
-        return v.asnumpy()
+        # fleet meshes ZeRO-shard state across processes; asnumpy() on a
+        # non-fully-addressable array raises, so fetch collectively
+        from .parallel.mesh import host_value
+        return host_value(v._data)
     if isinstance(v, (tuple, list)):
         return tuple(_state_to_numpy(x) for x in v)
     return v

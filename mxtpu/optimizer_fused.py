@@ -521,7 +521,11 @@ def _leaf_shapes(s, acc):
 
 
 def _mesh_dev_ids(mesh):
-    return tuple(int(d.id) for d in mesh.devices.flat)
+    # process-local ordinals, not global ids: an identical per-host mesh
+    # on a replacement host must produce the same cache key as the peer
+    # that spilled the blob (compile_service.device_token rationale)
+    from . import compile_service as csvc
+    return tuple(csvc._local_ordinal(d) for d in mesh.devices.flat)
 
 
 def _shard_token(arr):
@@ -659,6 +663,37 @@ def _fingerprint(values):
     return fsum, fold
 
 
+def _portable_build():
+    """True when the fused jit must stay inside XLA:CPU's
+    serialization-safe class: no donation, no sharding constraints.
+
+    Measured on jaxlib 0.4.37 CPU: a serialized executable loaded in a
+    FRESH process silently corrupts when it declares input-output
+    aliasing (donation — wrong values from the second call on) or mixes
+    sharding-constraint custom-calls with the bitcast fingerprint
+    reduction (wrong values immediately, plus heap corruption). The
+    same HLO without donation/constraints round-trips bit-exact, and on
+    a single CPU device both are pure memory hints anyway: dropping
+    them changes no value. Only the single-device build needs this —
+    multi-device CPU executables are refused by the disk cache outright
+    (compile_service ``cpu_multidevice`` drop), so their in-process
+    donated/constrained form is never serialized; TPU/GPU keep the
+    donated, constrained build — there the aliasing is the whole point
+    of fusing the update. Local (not global) device count: on the CPU
+    fleet tier every host jits over its own local mesh, so a 2-host
+    world of 1-device hosts still builds — and disk-serves — the
+    1-device portable form."""
+    return jax.default_backend() == "cpu" and len(jax.local_devices()) == 1
+
+
+def _donation():
+    """donate_argnums for the fused update jits — () on CPU (see
+    :func:`_portable_build`), weights+states everywhere else. The same
+    tuple rides the compile-service canonical key, so a CPU blob and a
+    TPU blob of one site can never alias."""
+    return () if _portable_build() else (0, 2)
+
+
 def _zero_shards(plan, zf):
     """The (shard, gather, tree-shard) constraint trio for one param under
     the plan — identity functions when the param is not ZeRO-eligible.
@@ -669,7 +704,7 @@ def _zero_shards(plan, zf):
     when it arrives replicated from the eager autograd), run the update
     rule shard-local, then all-gather ONLY the weight; the state keeps the
     sharded layout, so its memory divides by the replica count."""
-    if plan is None or not zf:
+    if plan is None or not zf or _portable_build():
         ident = lambda x: x  # noqa: E731
         return ident, ident, ident
     sh0, repl = plan.shard0(), plan.replicated()
@@ -725,7 +760,7 @@ def _build(rule, static, mp_flags, out_dtypes, plan=None, zflags=None,
             return new_w, new_s, _fingerprint(new_w + new_s)
         return new_w, new_s
 
-    return jax.jit(fused, donate_argnums=(0, 2))
+    return jax.jit(fused, donate_argnums=_donation())
 
 
 def _build_guarded(rule, static, mp_flags, out_dtypes, scaler_cfg,
@@ -800,7 +835,7 @@ def _build_guarded(rule, static, mp_flags, out_dtypes, scaler_cfg,
     # gstate is NOT donated: the scale scalar is aliased by user code
     # (DynamicLossScaler.scale multiplies the loss by it) and by the
     # no-scaler cached constant — donating would delete a live buffer
-    return jax.jit(fused, donate_argnums=(0, 2))
+    return jax.jit(fused, donate_argnums=_donation())
 
 
 class FusedUpdater(Updater):
@@ -877,11 +912,17 @@ class FusedUpdater(Updater):
             and self._plan.zero_eligible(tuple(weight.shape), st)
         sh = self._plan.shard0() if zok else self._plan.replicated()
 
+        from .parallel.mesh import place_global
+
         def put(x):
             if x is None:
                 return
             if isinstance(x, NDArray):
-                x._set_data(jax.device_put(x._data, sh))
+                # place_global: device_put single-process; on a fleet
+                # (process-spanning) mesh it assembles the global array
+                # from this host's full copy — valid for both layouts
+                # here, since every host creates identical initial state
+                x._set_data(place_global(x._data, sh))
                 return
             for c in x:
                 put(c)
@@ -993,7 +1034,7 @@ class FusedUpdater(Updater):
                 site="fused_optimizer", fn_id="fused:%s" % key[0],
                 signature=key,
                 sharding=plan.fingerprint() if plan is not None else None,
-                donation=(0, 2),
+                donation=_donation(),
                 device=csvc.device_token(
                     mesh=plan.mesh if plan is not None else None))
             entry = csvc.get_or_build(

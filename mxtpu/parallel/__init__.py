@@ -17,12 +17,15 @@ no tensor/sequence/context parallelism) is first-class here:
   (:mod:`mxtpu.parallel.ring_attention`) rotates K/V blocks around the ring
   with ``ppermute`` while accumulating flash-style online softmax.
 """
-from .mesh import make_mesh, data_parallel_mesh
+from .mesh import (make_mesh, data_parallel_mesh, is_multiprocess_mesh,
+                   host_value, place_global)
 from .train import ShardedTrainStep, pure_forward
 from .ring_attention import ring_attention, ring_flash_attention, ring_self_attention
 from .pipeline import pipeline_apply
 from .moe import switch_ffn, shard_experts
 
-__all__ = ["make_mesh", "data_parallel_mesh", "ShardedTrainStep", "pipeline_apply", "switch_ffn", "shard_experts",
+__all__ = ["make_mesh", "data_parallel_mesh", "is_multiprocess_mesh",
+           "host_value", "place_global", "ShardedTrainStep",
+           "pipeline_apply", "switch_ffn", "shard_experts",
            "pure_forward", "ring_attention", "ring_flash_attention",
            "ring_self_attention"]

@@ -12,7 +12,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "data_parallel_mesh"]
+__all__ = ["make_mesh", "data_parallel_mesh", "is_multiprocess_mesh",
+           "host_value", "place_global"]
 
 
 def make_mesh(axes, devices=None):
@@ -60,3 +61,50 @@ def make_mesh(axes, devices=None):
 def data_parallel_mesh(devices=None, axis="data"):
     """All devices on one data axis — the KVStore `device`/`nccl` equivalent."""
     return make_mesh({axis: -1}, devices)
+
+
+def is_multiprocess_mesh(mesh):
+    """True when ``mesh`` spans devices owned by more than one process —
+    the fleet case, where plain ``jax.device_put`` / ``np.asarray`` on a
+    global array are illegal (a host can only touch its addressable
+    shards) and every placement/fetch must go through the helpers below."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def place_global(data, sharding):
+    """Place a host value onto ``sharding``, multi-process safe.
+
+    Single-process shardings take the fast path (``jax.device_put``).
+    Process-spanning shardings can't: device_put would need to write
+    shards this host does not address. There every process holds the SAME
+    host value (replicated placement and global-batch placement both
+    satisfy this in our fleet wiring) and ``make_array_from_callback``
+    builds the global array from per-shard slices of it — each host
+    materializes only the shards it owns."""
+    arr = np.asarray(data)
+    devs = getattr(sharding, "device_set", None)
+    multiproc = devs is not None and \
+        len({d.process_index for d in devs}) > 1
+    if not multiproc:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
+def host_value(arr):
+    """Fetch a global array's full value onto this host as numpy.
+
+    Fully-addressable arrays (single-process, or fully-replicated) are a
+    plain ``device_get``. A process-spanning sharded array is not —
+    ``np.asarray`` raises — so the fleet path rides
+    ``multihost_utils.process_allgather(tiled=True)``, which is itself a
+    collective: EVERY process must call it, which our callers
+    (checkpoint checksums, optimizer state dumps) do by construction."""
+    if not hasattr(arr, "sharding") or getattr(
+            arr, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(arr))
+    if getattr(arr, "is_fully_replicated", False):
+        return np.asarray(jax.device_get(
+            arr.addressable_shards[0].data))
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))

@@ -153,7 +153,16 @@ def _parse_faults(spec):
     perturbed host-side, exercising the mismatch dump + raise),
     ``supervisor_crash`` (supervisor attempt index: a clean child exit is
     treated as a crash, driving the respawn/backoff/refusal matrix
-    without a real failing subprocess)."""
+    without a real failing subprocess), ``host_loss`` (fleet training
+    step index: ``fleet.maybe_host_loss`` hard-exits the process with
+    ``EXIT_HOST_LOSS`` before that step's collective — sudden host
+    death, no cleanup), ``coordinator_loss`` (membership-check index:
+    ``FleetMembership.check`` diagnoses host 0 dead and raises loud
+    with the board, instead of the infinite collective hang a real dead
+    coordinator causes), ``rejoin_stall`` (host rank: that host stalls
+    inside ``fleet.init`` bring-up — status ``stalled``, never reaches
+    the barrier — so its peers' bring-up deadline trips with the host
+    named, then it exits ``EXIT_REJOIN_STALL``)."""
     faults = {}
     for part in spec.split(";"):
         part = part.strip()
@@ -923,7 +932,11 @@ class ResilientLoop:
             return
         os.makedirs(directory, exist_ok=True)
         path = os.path.join(directory, "latest.json")
-        tmp = path + ".tmp"
+        # pid-unique tmp: in fleet mode several hosts share the checkpoint
+        # dir, and two writers racing one ".tmp" name can rename a torn
+        # file into place — each pid stages its own and os.replace stays
+        # last-writer-wins-atomic
+        tmp = "%s.%d.tmp" % (path, os.getpid())
         with open(tmp, "w") as f:
             f.write(payload)
             f.flush()
@@ -995,7 +1008,26 @@ class SupervisorRefusal(MXNetError):
     """The supervisor will not respawn: either the same checkpoint step
     crashed twice in a row (a deterministic poison-crash — restarting
     replays it forever) or the crash-loop budget is spent. The message is
-    the diagnosis."""
+    the diagnosis. By the time this raises, a
+    ``flight_record("supervisor_refusal")`` artifact carrying the
+    diagnosis and the full restart ``history`` is on disk (see
+    :func:`_refuse`)."""
+
+
+def _refuse(diagnosis, history, logger=None):
+    """Build a :class:`SupervisorRefusal` the evidence-first way: dump a
+    ``flight_record("supervisor_refusal")`` artifact carrying the
+    diagnosis and the supervisor's full restart ``history`` BEFORE the
+    exception exists — a crash-looped fleet leaves a post-mortem
+    artifact, not just an exception string in a dead tty. Shared by
+    :class:`TrainSupervisor` and ``fleet.FleetSupervisor``; callers
+    ``raise _refuse(...)``."""
+    from . import telemetry
+    telemetry.flight_record(
+        "supervisor_refusal",
+        extra={"diagnosis": diagnosis, "history": list(history)})
+    (logger or _log).error("supervisor refusal: %s", diagnosis)
+    return SupervisorRefusal(diagnosis)
 
 
 class TrainSupervisor:
@@ -1090,7 +1122,7 @@ class TrainSupervisor:
             # crashes must stay "transient" under the budget, not
             # misdiagnose as a deterministic poison-crash after one try
             if crash_step is not None and crash_step == prev_crash_step:
-                raise SupervisorRefusal(
+                raise _refuse(
                     "the child crashed twice at checkpoint step %s with "
                     "ZERO progress in between (exit code %d) — this is a "
                     "deterministic poison-crash (a batch/code path that "
@@ -1098,14 +1130,16 @@ class TrainSupervisor:
                     "(those advance the checkpoint between crashes). "
                     "Refusing to respawn: inspect the flight artifacts "
                     "and quarantine ring for the poisoned step before "
-                    "restarting by hand." % (crash_step, rc))
+                    "restarting by hand." % (crash_step, rc),
+                    self.history, self._log)
             if self.restarts >= self.max_restarts:
-                raise SupervisorRefusal(
+                raise _refuse(
                     "crash-loop budget spent: %d restarts "
                     "(MXTPU_SUPERVISOR_RESTARTS) with the child still "
                     "dying (last exit code %d, last checkpoint step %s) "
                     "— refusing to flap further" %
-                    (self.restarts, rc, crash_step))
+                    (self.restarts, rc, crash_step),
+                    self.history, self._log)
             prev_crash_step = crash_step
             self.restarts += 1
             attempt += 1

@@ -306,7 +306,13 @@ def test_jit_surface_inventory_lists_all_six_caches():
     assert all(e["service"] for e in inv), \
         [e for e in inv if not e["service"]]
     fused = [e for e in inv if e["retrace_site"] == "fused_optimizer"]
-    assert fused and all(e["donation"] == "donate_argnums=(0, 2)"
+    # since ISSUE 18 the donation is a policy FUNCTION, not a literal:
+    # (0, 2) everywhere except the XLA:CPU portable single-device class,
+    # where serialized executables with input-output aliasing silently
+    # corrupt when loaded in a fresh process (measured, jaxlib 0.4.37) —
+    # the fleet's warm-rejoin disk cache depends on dropping donation
+    # there. The inventory must still show ONE declared discipline.
+    assert fused and all(e["donation"] == "donate_argnums=_donation()"
                          for e in fused)
     for e in fused:   # the merged mesh-trainer cache: sharding in the key
         assert "MeshPlan" in e["cache_key"], e["cache_key"]

@@ -190,6 +190,16 @@ timeout 900 env BENCH_CONFIG=startup_time BENCH_PREFLIGHT=0 \
   python bench.py 2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
 telemetry_report
 
+# 5e. elastic fleet phase (ISSUE 18): kill-one-host restore parity +
+#     warm rejoin, every host a forced-CPU subprocess (gates: loud
+#     41/42 kill detection, resume-at-K parity vs the uninterrupted
+#     oracle, divergence sentinel green, rejoin zero compiles with the
+#     disk cache serving every host). Host work + children — chip-safe.
+sleep 60
+timeout 900 env BENCH_CONFIG=fleet_resume BENCH_PREFLIGHT=0 \
+  python bench.py 2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
+telemetry_report
+
 # 6. input pipeline phase (ISSUE 9): device-resident streaming reader +
 #    double-buffered prefetch-to-device vs the synchronous loop — batches/s
 #    and the data.wait fraction both ways (gate: parity + wait-frac drop;
