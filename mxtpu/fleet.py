@@ -478,7 +478,7 @@ class Fleet:
         distributed.barrier(name)
         return None
 
-    def step_barrier(self, step, fingerprint=None):
+    def step_barrier(self, step, fingerprint=None, obs=None):
         """Per-step cross-host coupling on the board: every host must
         finish step ``step`` within the fleet collective bound or the
         survivors fail LOUD (a dead peer is diagnosed off its stale
@@ -486,16 +486,27 @@ class Fleet:
         ``fingerprint`` (the divergence sentinel's update fingerprint)
         rides the barrier payload, and a cross-host mismatch — replicas
         whose states silently diverged — trips the same wedge path: the
-        flight artifact carries every host's fingerprint. No-op without
-        a membership board."""
+        flight artifact carries every host's fingerprint. ``obs`` (a
+        dict, e.g. ``{"trace": trace_id, "stages": {...}}``) upgrades
+        the payload to the ISSUE-19 stitched form — fingerprint under
+        ``"fp"``, plus this host's step trace id, stage breakdown, and
+        barrier-arrival timestamp ``"t"`` — which fleet_obs' straggler
+        sentinel and ``telemetry_report --fleet`` consume. Without
+        ``obs`` the payload stays the bare fingerprint list (board
+        compatibility with ISSUE-18 peers). No-op without a membership
+        board."""
         if self.membership is None:
             return None
         from . import telemetry
         bound = collective_timeout_s() or bringup_timeout_s()
+        payload = None if fingerprint is None else list(fingerprint)
+        if obs is not None:
+            payload = dict(obs)
+            payload["fp"] = None if fingerprint is None else list(fingerprint)
+            payload.setdefault("t", self.membership._clock())
         try:
             fps = self.membership.barrier(
-                "step_%d" % int(step), bound,
-                payload=None if fingerprint is None else list(fingerprint))
+                "step_%d" % int(step), bound, payload=payload)
         except FleetWedgeError:
             telemetry.inc("fleet.wedges")
             telemetry.flight_record(
@@ -505,7 +516,11 @@ class Fleet:
                            "dead": self.membership.dead_hosts(),
                            "board": self.membership.describe()}})
             raise
-        got = {r: p for r, p in fps.items() if p is not None}
+        got = {}
+        for r, p in fps.items():
+            fp = p.get("fp") if isinstance(p, dict) else p
+            if fp is not None:
+                got[r] = fp
         if got:
             telemetry.inc("resilience.divergence_checks")
         if len(set(map(tuple, got.values()))) > 1:

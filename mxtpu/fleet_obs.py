@@ -1,0 +1,600 @@
+"""Fleet observability plane (ISSUE 19).
+
+Every observability surface built so far — the telemetry registry, the
+causal-trace layer, the xprof ledger, ``perf.mfu``, Prometheus
+``/metrics`` — is process-local; a 2-host fleet is two blind spots that
+happen to share a checkpoint. This module federates them over the
+ISSUE-18 fleet status board (``MXTPU_FLEET_DIR``), all host-side and
+injected-clock testable, with zero device work:
+
+* **Per-host publication** — :class:`HostObsPublisher` writes a compact,
+  bounded snapshot blob ``obs_<rank>.json`` (atomic tmp+rename beside
+  the heartbeat files): counters, gauges, histogram quantiles, the
+  resolve-free xprof ledger digest, and the last-K trace-event tail.
+  ``install()`` rides the telemetry flush hook so every sink flush —
+  including the SIGTERM/atexit final flush — also refreshes the blob.
+* **Coordinator merge** — :class:`FleetObservatory` folds all
+  ``obs_*.json`` + heartbeat files into one fleet snapshot: per-host
+  rows plus fleet aggregates (``fleet.mfu`` = ledger-FLOPs-weighted,
+  ``fleet.step_s`` p50/p99 across hosts, per-host heartbeat age), a
+  host-labeled Prometheus exposition (``host="<rank>"`` label family),
+  and a ``refresh()`` that lands the aggregates in the local registry so
+  the coordinator's existing ``/metrics`` serves the whole fleet.
+* **Sentinels** — :class:`StragglerSentinel` keeps a rolling per-host
+  baseline off the ``Fleet.step_barrier`` board payloads (stage
+  breakdown + arrival timestamps): a rank persistently slower than
+  ``MXTPU_STRAGGLER_X`` × the fleet median trips
+  ``flight_record("straggler")`` naming the rank and its dominant
+  stage; :class:`RegressionSentinel` watches one host's own rolling
+  step time for slow drift (the gap the ISSUE-14 wedge watchdog's hard
+  deadline can't see) and trips ``flight_record("step_regression")``.
+  Either trip optionally arms ONE bounded ``jax.profiler`` capture
+  window per trip reason (``MXTPU_PROFILE_ON_TRIP``), artifact beside
+  the flight record.
+
+The plane is opt-in (``MXTPU_FLEET_OBS_S``/``MXTPU_STRAGGLER_X`` both
+default off) and purely additive: an observatory that dies degrades the
+merged view to surviving hosts' blobs — training never depends on it.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import logging
+import os
+import statistics
+import threading
+
+from . import telemetry, xprof
+from .fleet import _atomic_write
+
+_log = logging.getLogger("mxtpu.fleet_obs")
+
+__all__ = [
+    "obs_interval_s", "straggler_x", "profile_on_trip",
+    "host_snapshot", "publish_obs", "HostObsPublisher",
+    "FleetObservatory", "StragglerSentinel", "RegressionSentinel",
+    "step_traces",
+]
+
+# Bounds on the published blob: the board must stay cheap to write at
+# flush cadence and cheap to re-read on every coordinator scrape.
+TRACE_TAIL = 64
+LEDGER_TOP = 16
+
+# One bounded profiler window per trip reason per process; the window is
+# a module constant (not an env lever) — trips are rare and the artifact
+# only needs to straddle a few steps.
+PROFILE_WINDOW_S = 1.0
+_PROFILE_DONE = set()
+_PROFILE_LOCK = threading.Lock()
+
+
+# ------------------------------------------------------------- policies
+def obs_interval_s():
+    """Publication cadence for the per-host obs blob, seconds; 0 (the
+    default) disables publication entirely."""
+    try:
+        return float(os.environ.get("MXTPU_FLEET_OBS_S", "0") or 0)  # graftlint: disable=policy-key-coverage
+    except ValueError:
+        return 0.0
+
+
+def straggler_x():
+    """Straggler threshold: a rank persistently slower than this factor
+    × the fleet-median step time trips the sentinel; 0 (default) = off."""
+    try:
+        return float(os.environ.get("MXTPU_STRAGGLER_X", "0") or 0)  # graftlint: disable=policy-key-coverage
+    except ValueError:
+        return 0.0
+
+
+def profile_on_trip():
+    """When truthy, a sentinel trip arms one bounded ``jax.profiler``
+    capture window per trip reason (artifact beside the flight record)."""
+    return os.environ.get("MXTPU_PROFILE_ON_TRIP", "0") != "0"  # graftlint: disable=policy-key-coverage
+
+
+# ------------------------------------------------- per-host publication
+def _ledger_digest():
+    """Resolve-free xprof view, bounded: the compile/HBM summary, the
+    executed train-site FLOPs, and the top-N sites by executed FLOPs."""
+    if not xprof.enabled():
+        return None
+    digest = {"summary": xprof.summary(),
+              "executed_flops": xprof.executed_flops(xprof.TRAIN_SITES)}
+    rows = []
+    for e in xprof.ledger_snapshot():
+        fl = e.get("flops") or 0
+        rows.append({"site": e.get("site"), "calls": e.get("calls"),
+                     "flops": fl,
+                     "executed_flops": fl * (e.get("calls") or 0)})
+    rows.sort(key=lambda r: -(r["executed_flops"] or 0))
+    digest["sites"] = rows[:LEDGER_TOP]
+    return digest
+
+
+def host_snapshot(rank, step=None):
+    """The bounded per-host blob :func:`publish_obs` writes: registry
+    aggregates + ledger digest + trace-event tail. Pure host bookkeeping;
+    never resolves an executable or touches a device."""
+    snap = telemetry.snapshot()
+    return {
+        "rank": int(rank),
+        "pid": os.getpid(),
+        "step": None if step is None else int(step),
+        "counters": snap["counters"],
+        "gauges": snap["gauges"],
+        "histograms": snap["histograms"],
+        "retrace": snap["retrace"],
+        "ledger": _ledger_digest(),
+        "trace_tail": telemetry.trace_events()[-TRACE_TAIL:],
+    }
+
+
+def publish_obs(fleet_dir, rank, step=None, t=None):
+    """Write this host's ``obs_<rank>.json`` into the fleet board
+    (atomic tmp+rename, same discipline as the heartbeat files). Errors
+    are counted, never raised — observability must not kill training."""
+    path = os.path.join(fleet_dir, "obs_%d.json" % int(rank))
+    try:
+        blob = host_snapshot(rank, step=step)
+        if t is not None:
+            blob["t"] = t
+        else:
+            import time
+            blob["t"] = time.time()
+        _atomic_write(path, json.dumps(blob))
+        telemetry.inc("fleet.obs.publishes")
+        return path
+    except Exception as e:  # pragma: no cover - defensive
+        telemetry.inc("fleet.obs.errors")
+        _log.warning("obs publish failed for rank %s: %s", rank, e)
+        return None
+
+
+class HostObsPublisher:
+    """Cadenced writer of one host's obs blob. ``maybe_publish(step)``
+    throttles to ``interval_s`` (default from ``MXTPU_FLEET_OBS_S``);
+    ``install()`` additionally registers :meth:`publish` as a telemetry
+    flush hook so the final SIGTERM/atexit flush also lands a blob —
+    exactly the window a straggler/crash postmortem needs."""
+
+    def __init__(self, fleet_dir, rank, interval_s=None, clock=None):
+        import time
+        self.fleet_dir = fleet_dir
+        self.rank = int(rank)
+        self.interval_s = (obs_interval_s() if interval_s is None
+                           else float(interval_s))
+        self._clock = clock or time.time
+        self._last = None
+        self._step = None
+        self._installed = False
+
+    @property
+    def path(self):
+        return os.path.join(self.fleet_dir, "obs_%d.json" % self.rank)
+
+    def publish(self, step=None):
+        if step is not None:
+            self._step = int(step)
+        out = publish_obs(self.fleet_dir, self.rank, step=self._step,
+                          t=self._clock())
+        self._last = self._clock()
+        return out
+
+    def maybe_publish(self, step=None):
+        """Publish if the cadence window elapsed; returns the blob path
+        or None. A non-positive interval disables the cadence path (the
+        flush hook and explicit ``publish()`` still work)."""
+        if step is not None:
+            self._step = int(step)
+        if self.interval_s <= 0:
+            return None
+        now = self._clock()
+        if self._last is not None and now - self._last < self.interval_s:
+            return None
+        return self.publish()
+
+    def install(self):
+        """Ride every telemetry flush (periodic, explicit, and the
+        atexit/SIGTERM final one)."""
+        if not self._installed:
+            telemetry.on_flush(self.publish)
+            self._installed = True
+        return self
+
+
+# ------------------------------------------------------ coordinator side
+def _median(vals):
+    return statistics.median(vals) if vals else None
+
+
+def _quantile(vals, q):
+    if not vals:
+        return None
+    vals = sorted(vals)
+    idx = min(int(q * len(vals)), len(vals) - 1)
+    return vals[idx]
+
+
+class FleetObservatory:
+    """Coordinator-side merge of every host's obs blob + heartbeat into
+    one fleet snapshot. Read-only over the board directory: a missing or
+    torn blob degrades the view to surviving hosts, never raises."""
+
+    def __init__(self, fleet_dir, num_hosts=None, clock=None):
+        import time
+        self.fleet_dir = fleet_dir
+        self.num_hosts = num_hosts
+        self._clock = clock or time.time
+
+    def blobs(self):
+        """``{rank: blob}`` for every readable ``obs_<rank>.json``."""
+        out = {}
+        for p in sorted(_glob.glob(
+                os.path.join(self.fleet_dir, "obs_*.json"))):
+            try:
+                with open(p) as f:
+                    blob = json.load(f)
+                out[int(blob["rank"])] = blob
+            except Exception:
+                continue
+        return out
+
+    def heartbeats(self):
+        """``{rank: heartbeat record}`` from the membership board."""
+        out = {}
+        for p in sorted(_glob.glob(
+                os.path.join(self.fleet_dir, "host_*.json"))):
+            try:
+                with open(p) as f:
+                    rec = json.load(f)
+                out[int(rec["rank"])] = rec
+            except Exception:
+                continue
+        return out
+
+    def merged(self):
+        """One fleet snapshot: per-host rows + fleet aggregates.
+
+        ``fleet.mfu`` is the ledger-FLOPs-weighted mean of per-host
+        ``perf.mfu`` (hosts execute different FLOPs under elastic
+        membership — an unweighted mean would let an idle host drag the
+        number); ``fleet.step_s`` p50/p99 are taken across the hosts'
+        own ``trainer.step`` medians, so a single straggler shows up in
+        the p99 without resolving anything."""
+        now = self._clock()
+        blobs = self.blobs()
+        beats = self.heartbeats()
+        hosts = {}
+        for rank in sorted(set(blobs) | set(beats)):
+            blob = blobs.get(rank) or {}
+            beat = beats.get(rank) or {}
+            gauges = blob.get("gauges") or {}
+            hists = blob.get("histograms") or {}
+            ledger = blob.get("ledger") or {}
+            mfu = gauges.get("perf.mfu")
+            if isinstance(mfu, dict):
+                mfu = mfu.get("_untagged")
+            step_h = hists.get("trainer.step") or {}
+            hosts[rank] = {
+                "rank": rank,
+                "status": beat.get("status"),
+                "step": blob.get("step", beat.get("step")),
+                "pid": blob.get("pid", beat.get("pid")),
+                "mfu": mfu,
+                "executed_flops": ledger.get("executed_flops"),
+                "step_s": {k: step_h.get(k)
+                           for k in ("count", "p50", "p99", "max")},
+                "heartbeat_age_s": (round(now - beat["t"], 3)
+                                    if beat.get("t") is not None else None),
+                "blob_age_s": (round(now - blob["t"], 3)
+                               if blob.get("t") is not None else None),
+            }
+        fl_pairs = [(h["mfu"], h["executed_flops"])
+                    for h in hosts.values() if h["mfu"] is not None]
+        if fl_pairs:
+            wsum = sum(fl or 0 for _, fl in fl_pairs)
+            if wsum > 0:
+                fleet_mfu = sum(m * (fl or 0) for m, fl in fl_pairs) / wsum
+            else:
+                fleet_mfu = sum(m for m, _ in fl_pairs) / len(fl_pairs)
+        else:
+            fleet_mfu = None
+        p50s = [h["step_s"]["p50"] for h in hosts.values()
+                if h["step_s"].get("p50") is not None]
+        up = [r for r, h in hosts.items()
+              if h["status"] not in (None, "left", "dead")]
+        return {
+            "t": now,
+            "hosts": hosts,
+            "fleet": {
+                "mfu": fleet_mfu,
+                "step_s": {"p50": _median(p50s),
+                           "p99": _quantile(p50s, 0.99)},
+                "hosts_up": len(up),
+                "hosts_seen": len(hosts),
+                "executed_flops": sum(h["executed_flops"] or 0
+                                      for h in hosts.values()),
+            },
+        }
+
+    def refresh(self):
+        """Re-merge and land the fleet aggregates in the LOCAL registry
+        (``fleet.mfu``, ``fleet.step_s{p50,p99}``, per-host heartbeat
+        ages, ``fleet.hosts_up``) so the coordinator's existing
+        ``/metrics`` and snapshot exports carry the whole fleet."""
+        m = self.merged()
+        fl = m["fleet"]
+        if fl["mfu"] is not None:
+            telemetry.gauge("fleet.mfu", fl["mfu"])
+        for q in ("p50", "p99"):
+            if fl["step_s"].get(q) is not None:
+                telemetry.gauge("fleet.step_s", fl["step_s"][q], tag=q)
+        telemetry.gauge("fleet.hosts_up", fl["hosts_up"])
+        for rank, h in m["hosts"].items():
+            if h["heartbeat_age_s"] is not None:
+                telemetry.gauge("fleet.heartbeat_age_s",
+                                h["heartbeat_age_s"],
+                                tag="host%d" % rank)
+        return m
+
+    def prometheus(self):
+        """Host-labeled exposition of every host's published counters,
+        gauges, and histogram summaries: the registry's own family names
+        with a ``host="<rank>"`` label (plus the usual ``tag`` label for
+        tagged families). Registered via
+        ``telemetry.register_prometheus_extra`` this makes one
+        coordinator ``/metrics`` scrape cover the fleet."""
+        self.refresh()
+        pn, pl = telemetry._prom_name, telemetry._prom_label
+        lines = []
+        for rank, blob in sorted(self.blobs().items()):
+            host = 'host="%s"' % pl(str(rank))
+            for kind, typ in (("counters", "counter"), ("gauges", "gauge")):
+                for name, val in sorted((blob.get(kind) or {}).items()):
+                    base = pn(name)
+                    lines.append("# TYPE %s %s" % (base, typ))
+                    if isinstance(val, dict):
+                        for tag, v in sorted(val.items()):
+                            if tag == "_untagged":
+                                lines.append("%s{%s} %s" % (base, host, v))
+                            else:
+                                lines.append('%s{%s,tag="%s"} %s'
+                                             % (base, host, pl(tag), v))
+                    else:
+                        lines.append("%s{%s} %s" % (base, host, val))
+            for name, h in sorted((blob.get("histograms") or {}).items()):
+                base = pn(name)
+                lines.append("# TYPE %s summary" % base)
+                for q in ("p50", "p99"):
+                    if h.get(q) is not None:
+                        lines.append('%s{%s,quantile="%s"} %s'
+                                     % (base, host, q[1:], h[q]))
+                lines.append("%s_sum{%s} %s" % (base, host, h.get("sum", 0)))
+                lines.append("%s_count{%s} %s"
+                             % (base, host, h.get("count", 0)))
+        return "\n".join(lines)
+
+    def install(self):
+        """Serve the fleet view from the coordinator's ``/metrics``."""
+        telemetry.register_prometheus_extra(self.prometheus)
+        return self
+
+
+# ------------------------------------------------------------- sentinels
+def _maybe_profile(reason):
+    """Arm ONE bounded profiler capture window for this trip reason (a
+    repeat trip is the same pathology; unbounded captures would be their
+    own regression). Artifact lands beside the flight records; a stop
+    timer bounds the window. No-op without ``MXTPU_PROFILE_ON_TRIP`` or
+    a flight dir; never raises."""
+    if not profile_on_trip():
+        return None
+    out_dir = telemetry.flight_dir()
+    if out_dir is None:
+        return None
+    with _PROFILE_LOCK:
+        if reason in _PROFILE_DONE:
+            return None
+        _PROFILE_DONE.add(reason)
+    out = os.path.join(out_dir, "profile_%s_%d" % (reason, os.getpid()))
+    try:
+        import jax
+        os.makedirs(out, exist_ok=True)
+        jax.profiler.start_trace(out)
+
+        def _stop():
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # pragma: no cover - defensive
+                pass
+
+        timer = threading.Timer(PROFILE_WINDOW_S, _stop)
+        timer.daemon = True
+        timer.start()
+        telemetry.inc("fleet.profile_captures", tag=str(reason))
+        return out
+    except Exception as e:  # pragma: no cover - defensive
+        _log.warning("profile-on-trip (%s) failed: %s", reason, e)
+        return None
+
+
+def _stage_time(payload):
+    """Total step seconds a board payload claims (dict payloads carry a
+    ``stages`` breakdown; legacy list payloads carry none)."""
+    if not isinstance(payload, dict):
+        return None
+    stages = payload.get("stages") or {}
+    if not stages:
+        return None
+    return sum(v for v in stages.values() if v is not None)
+
+
+class StragglerSentinel:
+    """Names the slow rank. Feed it each step's ``Fleet.step_barrier``
+    payload map; a rank above ``factor`` × the fleet-median step time for
+    ``streak`` consecutive observed steps trips
+    ``flight_record("straggler")`` with the laggard's stage breakdown,
+    dominant stage, and ledger view, and bumps
+    ``fleet.straggler_trips{host<r>}``. A recovered rank resets its
+    streak and re-arms (the trip counter stays flat until it degrades
+    again). Also gauges per-rank barrier-arrival skew."""
+
+    def __init__(self, factor=None, streak=3):
+        self.factor = straggler_x() if factor is None else float(factor)
+        self.streak = max(int(streak), 1)
+        self._streaks = {}
+        self._tripped = set()
+        self.trips = []
+
+    def observe(self, step, payloads):
+        """Returns the trip record if this observation tripped, else
+        None. ``payloads`` is ``{rank: payload}`` as returned by
+        ``Fleet.step_barrier`` — only dict payloads (obs-carrying) are
+        considered."""
+        if self.factor <= 0 or not payloads:
+            return None
+        arrivals = {r: p["t"] for r, p in payloads.items()
+                    if isinstance(p, dict) and p.get("t") is not None}
+        if arrivals:
+            first = min(arrivals.values())
+            for r, t in arrivals.items():
+                telemetry.gauge("fleet.arrival_skew_s", round(t - first, 6),
+                                tag="host%d" % r)
+        times = {r: _stage_time(p) for r, p in payloads.items()}
+        valid = [t for t in times.values() if t]
+        if len(valid) < 2:
+            return None
+        med = _median(valid)
+        trip = None
+        for r, t in sorted(times.items()):
+            if t is None:
+                continue
+            if med > 0 and t > self.factor * med:
+                self._streaks[r] = self._streaks.get(r, 0) + 1
+                if self._streaks[r] >= self.streak and r not in self._tripped:
+                    trip = self._trip(step, r, t, med, payloads[r])
+            else:
+                self._streaks[r] = 0
+                self._tripped.discard(r)
+        return trip
+
+    def _trip(self, step, rank, t, med, payload):
+        self._tripped.add(rank)
+        stages = payload.get("stages") or {}
+        dominant = (max(stages.items(), key=lambda kv: kv[1] or 0)[0]
+                    if stages else None)
+        rec = {"rank": rank, "step": step, "step_s": t,
+               "fleet_median_s": med,
+               "ratio": round(t / med, 3) if med else None,
+               "factor": self.factor, "stages": stages,
+               "dominant_stage": dominant,
+               "trace": payload.get("trace"),
+               "ledger": _ledger_digest()}
+        self.trips.append(rec)
+        telemetry.inc("fleet.straggler_trips", tag="host%d" % rank)
+        trace = payload.get("trace")
+        telemetry.flight_record(
+            "straggler", trace_ids=(trace,) if trace else (), extra=rec)
+        _maybe_profile("straggler")
+        return rec
+
+
+class RegressionSentinel:
+    """Same-host slow drift: the ISSUE-14 wedge watchdog fires on a hard
+    deadline; this fires when the rolling RECENT step-time median climbs
+    above ``factor`` × the rolling BASELINE median — a step that got 2×
+    slower but still finishes never trips the watchdog, it trips here.
+    Trips ``flight_record("step_regression")`` + ``fleet.step_regressions``
+    once per excursion (re-arms when the recent window recovers)."""
+
+    def __init__(self, factor=None, baseline_n=8, recent_n=4):
+        self.factor = straggler_x() if factor is None else float(factor)
+        self.baseline_n = max(int(baseline_n), 1)
+        self.recent_n = max(int(recent_n), 1)
+        self._hist = []
+        self._tripped = False
+        self.trips = []
+
+    def observe(self, step, dur_s):
+        """Feed one step's duration; returns the trip record or None."""
+        if self.factor <= 0 or dur_s is None:
+            return None
+        self._hist.append(float(dur_s))
+        bound = self.baseline_n + self.recent_n
+        if len(self._hist) > bound:
+            del self._hist[:-bound]
+        if len(self._hist) < bound:
+            return None
+        baseline = _median(self._hist[:-self.recent_n])
+        recent = _median(self._hist[-self.recent_n:])
+        if baseline and recent > self.factor * baseline:
+            if self._tripped:
+                return None
+            self._tripped = True
+            rec = {"step": step, "baseline_s": baseline,
+                   "recent_s": recent,
+                   "ratio": round(recent / baseline, 3),
+                   "factor": self.factor}
+            self.trips.append(rec)
+            telemetry.inc("fleet.step_regressions")
+            telemetry.flight_record("step_regression", extra=rec)
+            _maybe_profile("step_regression")
+            return rec
+        self._tripped = False
+        return None
+
+
+# ------------------------------------------------- cross-host stitching
+def step_traces(fleet_dir, limit=None):
+    """Per-step critical path off the ``barrier_step_*`` board dirs:
+    for each step, which rank arrived last, by how much, and which stage
+    of the laggard's breakdown dominated. Rows sorted by step; only
+    dict (obs-carrying) payloads contribute."""
+    rows = []
+    for d in _glob.glob(os.path.join(fleet_dir, "barrier_step_*")):
+        name = os.path.basename(d)
+        try:
+            step = int(name[len("barrier_step_"):])
+        except ValueError:
+            continue
+        payloads = {}
+        for p in _glob.glob(os.path.join(d, "host_*")):
+            try:
+                with open(p) as f:
+                    rec = json.load(f)
+                payloads[int(rec["rank"])] = rec.get("payload")
+            except Exception:
+                continue
+        arrivals = {r: pl["t"] for r, pl in payloads.items()
+                    if isinstance(pl, dict) and pl.get("t") is not None}
+        times = {r: _stage_time(pl) for r, pl in payloads.items()}
+        times = {r: t for r, t in times.items() if t is not None}
+        if arrivals:
+            last_rank = max(arrivals, key=arrivals.get)
+            skew = arrivals[last_rank] - min(arrivals.values())
+        elif times:
+            last_rank = max(times, key=times.get)
+            skew = None
+        else:
+            continue
+        pl = payloads.get(last_rank) or {}
+        stages = pl.get("stages") if isinstance(pl, dict) else None
+        dominant = (max(stages.items(), key=lambda kv: kv[1] or 0)[0]
+                    if stages else None)
+        rows.append({
+            "step": step, "ranks": len(payloads),
+            "last_rank": last_rank,
+            "skew_s": None if skew is None else round(skew, 6),
+            "step_s": times.get(last_rank),
+            "dominant_stage": dominant,
+            "trace": pl.get("trace") if isinstance(pl, dict) else None,
+            "stages": stages or {},
+        })
+    rows.sort(key=lambda r: r["step"])
+    if limit is not None:
+        rows = rows[-int(limit):]
+    return rows

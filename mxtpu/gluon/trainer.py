@@ -114,6 +114,10 @@ class Trainer:
         # here; tests attach their own fake-clock watchdog and poll().
         from .. import resilience as _res
         self._step_seq = 0
+        # last completed step's trace id + per-stage host timings, for
+        # the fleet observability board (mxtpu/fleet_obs.py)
+        self.last_step_trace = None
+        self.last_step_stages = {}
         self._step_watchdog = None
         if _res.train_step_timeout_x() > 0:
             self._step_watchdog = _res.TrainStepWatchdog().start_monitor()
@@ -340,10 +344,26 @@ class Trainer:
                                None if ctx is None else ctx.trace_id)
             try:
                 resilience.maybe_oom()
+                import time as _time
+                _t0 = _time.perf_counter()
                 with telemetry.span("trainer.step.allreduce"):
                     self._allreduce_grads()
+                _t1 = _time.perf_counter()
                 with telemetry.span("trainer.step.update"):
                     self._update(ignore_stale_grad)
+                _t2 = _time.perf_counter()
+                # fleet trace stitching (mxtpu/fleet_obs.py): fold the
+                # phase durations into the step trace's stage accumulator
+                # and pin them (plus the trace id) on the trainer, so the
+                # fleet worker can ship this host's per-stage breakdown
+                # over the step-barrier board. Host clock reads only.
+                ctx = telemetry.current_trace()
+                stages = {"trainer.step.allreduce": _t1 - _t0,
+                          "trainer.step.update": _t2 - _t1}
+                for _name, _dur in stages.items():
+                    telemetry.add_stage(ctx, _name, _dur)
+                self.last_step_trace = None if ctx is None else ctx.trace_id
+                self.last_step_stages = stages
             except Exception as e:
                 if entry is not None:
                     try:

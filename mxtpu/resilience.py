@@ -162,7 +162,11 @@ def _parse_faults(spec):
     coordinator causes), ``rejoin_stall`` (host rank: that host stalls
     inside ``fleet.init`` bring-up — status ``stalled``, never reaches
     the barrier — so its peers' bring-up deadline trips with the host
-    named, then it exits ``EXIT_REJOIN_STALL``)."""
+    named, then it exits ``EXIT_REJOIN_STALL``), ``straggler_slow``
+    (fleet training step index: tools/fleet_worker.py sleeps a fixed
+    slice before that step's barrier, attributed to ``data.wait`` — a
+    deterministic slow host for the fleet_obs straggler sentinel to
+    name)."""
     faults = {}
     for part in spec.split(";"):
         part = part.strip()
@@ -793,6 +797,17 @@ class CheckpointPolicy:
         return False
 
 
+def _sigterm_postmortem():
+    """Off-handler SIGTERM postmortem: flight-record the kill, then force
+    a final telemetry flush — the off-thread sink flusher is a daemon, so
+    a SIGTERM'd host would otherwise lose its last buffered window of
+    metrics (exactly the window a straggler/crash postmortem needs). Runs
+    on a daemon thread; the signal handler itself stays IO-free."""
+    from . import telemetry
+    telemetry.flight_record("sigterm")
+    telemetry.flush()
+
+
 class ResilientLoop:
     """Preemption-safe training driver around a gluon Trainer.
 
@@ -863,8 +878,7 @@ class ResilientLoop:
         self.preempted = True
         import threading
 
-        from . import telemetry
-        threading.Thread(target=telemetry.flight_record, args=("sigterm",),
+        threading.Thread(target=_sigterm_postmortem,
                          daemon=True, name="mxtpu-flight-sigterm").start()
 
     # ---------------------------------------------------------------- saving

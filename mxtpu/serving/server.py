@@ -143,6 +143,10 @@ class ModelServer:
         telemetry.flight_record("sigterm",
                                 extra={"queue_depth":
                                        self._batcher.queue_depth})
+        # final-flush guarantee (ISSUE 19): a SIGTERM'd server's last
+        # buffered telemetry window must reach the JSONL sink before the
+        # drain tears everything down
+        telemetry.flush()
         self.begin_drain()
 
     def begin_drain(self, timeout=None):

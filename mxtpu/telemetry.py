@@ -74,7 +74,8 @@ __all__ = ["enabled", "retrace_budget", "inc", "gauge", "observe", "value",
            "tracing_enabled", "TraceContext", "new_trace", "current_trace",
            "trace_handoff", "add_stage", "trace_mark", "link", "pend_link",
            "link_pending", "trace_breakdown", "trace_events", "trace_flows",
-           "flight_record", "flight_snapshot", "prometheus"]
+           "flight_record", "flight_snapshot", "prometheus",
+           "on_flush", "register_prometheus_extra"]
 
 _log = logging.getLogger("mxtpu.telemetry")
 
@@ -111,6 +112,12 @@ _D2H_LOCAL = _D2HLocal()
 # the off-thread timer) drains it to the file
 _SINK = {"queue": collections.deque(maxlen=1 << 20), "thread": None,
          "atexit": False, "lock": threading.Lock()}
+
+# extension points (mxtpu/fleet_obs.py rides both): flush hooks run after
+# every sink flush — periodic, explicit, AND the atexit/SIGTERM final one;
+# prometheus extras append provider output to the /metrics exposition
+_FLUSH_HOOKS = []
+_PROM_EXTRAS = []
 
 # ---- causal tracing state ----
 # current trace context (None outside any trace); contextvars are
@@ -418,6 +425,8 @@ def reset():
         _TRACE_EVENTS = collections.deque(maxlen=_trace_ring_cap())
         _PENDING_LINKS.q.clear()  # the calling thread's (tests drain
         _FLIGHT["count"] = 0      # their own; other threads' are bounded)
+    del _FLUSH_HOOKS[:]
+    del _PROM_EXTRAS[:]
     from . import xprof
     xprof.reset()  # the executable ledger rides the registry lifecycle
 
@@ -855,7 +864,19 @@ def prometheus():
     counters (tag families as a ``tag`` label), gauges, and histograms as
     summaries (``quantile`` 0.5/0.99 + ``_sum``/``_count``). The model
     server serves this on ``/metrics`` under ``Accept: text/plain`` so a
-    stock Prometheus scraper needs no sidecar."""
+    stock Prometheus scraper needs no sidecar. Registered extras (e.g. a
+    FleetObservatory's host-labeled fleet view) run FIRST — a provider
+    that refreshes registry gauges lands them in this same scrape — and
+    their output is appended after the registry's own families."""
+    extras = []
+    for fn in list(_PROM_EXTRAS):
+        try:
+            out = fn()
+        except Exception as e:  # noqa: BLE001 — a broken provider must
+            _log.warning("prometheus extra %r failed: %s", fn, e)
+            continue           # not take down the scrape
+        if out:
+            extras.append(out.rstrip("\n"))
     snap = snapshot()
     lines = []
     for name in sorted(snap["counters"]):
@@ -894,7 +915,17 @@ def prometheus():
             lines.append('%s{quantile="0.99"} %g' % (pn, h["p99"]))
         lines.append("%s_sum %g" % (pn, h["sum"]))
         lines.append("%s_count %d" % (pn, h["count"]))
+    lines.extend(extras)
     return "\n".join(lines) + "\n"
+
+
+def register_prometheus_extra(fn):
+    """Register a zero-arg provider whose text-exposition output is
+    appended to every :func:`prometheus` render (idempotent; cleared by
+    :func:`reset`). Returns ``fn``."""
+    if fn not in _PROM_EXTRAS:
+        _PROM_EXTRAS.append(fn)
+    return fn
 
 
 # -------------------------------------------------------- transfer watchdog
@@ -988,12 +1019,6 @@ def retrace_stats(site=None):
 # --------------------------------------------------------------- JSONL sink
 def _queue_line(rec, path):
     _SINK["queue"].append((path, rec))
-    if not _SINK["atexit"]:
-        with _SINK["lock"]:
-            if not _SINK["atexit"]:
-                _SINK["atexit"] = True
-                import atexit
-                atexit.register(flush)
     interval = _flush_interval()
     if interval > 0 and _SINK["thread"] is None:
         with _SINK["lock"]:
@@ -1057,3 +1082,30 @@ def flush():
                         f.write(json.dumps(rec) + "\n")
             except OSError as e:  # pragma: no cover - sink IO failure
                 _log.warning("telemetry sink write to %s failed: %s", p, e)
+    for fn in list(_FLUSH_HOOKS):
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — a broken hook must not
+            _log.warning("flush hook %r failed: %s", fn, e)  # kill a flush
+
+
+def on_flush(fn):
+    """Register a zero-arg hook to run after every :func:`flush` —
+    including the atexit/SIGTERM final one, which is how the fleet obs
+    blob (mxtpu/fleet_obs.py) captures a dying host's last window.
+    Idempotent; cleared by :func:`reset`. Returns ``fn``."""
+    if fn not in _FLUSH_HOOKS:
+        _FLUSH_HOOKS.append(fn)
+    return fn
+
+
+# Final-flush guarantee (ISSUE 19 satellite): registration used to be
+# lazy inside _queue_line, so a process that only bumped counters (never
+# queued an obs line) lost its cumulative counter/gauge lines even on a
+# CLEAN exit — and the off-thread timer is a daemon, so exit-between-
+# flushes lost the last window too. Register unconditionally at import:
+# flush() with no sink configured is a cheap no-op.
+import atexit  # noqa: E402  (deliberate: after flush is defined)
+
+atexit.register(flush)
+_SINK["atexit"] = True

@@ -56,7 +56,8 @@ def _fresh(monkeypatch):
                 "MXTPU_FLIGHT_DIR", "MXTPU_FLIGHT_MAX",
                 "MXTPU_COORDINATOR", "MXTPU_NUM_PROCESSES",
                 "MXTPU_PROCESS_ID", "MXTPU_SUPERVISOR_RESTARTS",
-                "MXTPU_SUPERVISOR_BACKOFF_S"):
+                "MXTPU_SUPERVISOR_BACKOFF_S", "MXTPU_FLEET_OBS_S",
+                "MXTPU_STRAGGLER_X", "MXTPU_PROFILE_ON_TRIP"):
         monkeypatch.delenv(var, raising=False)
     telemetry.reset()
     resilience.reset_faults()

@@ -1,0 +1,626 @@
+"""Fleet observability plane (ISSUE 19, mxtpu/fleet_obs.py):
+
+* per-host blob publication: bounded content, atomic write, fake-clock
+  cadence, riding the telemetry flush hook (incl. the final flush);
+* FleetObservatory merge: per-host rows, FLOPs-weighted ``fleet.mfu``,
+  cross-host step quantiles, heartbeat ages, host-labeled Prometheus
+  exposition through ``register_prometheus_extra``, graceful
+  degradation to surviving hosts on a torn blob;
+* straggler matrix on fake payloads: uniform fleet → no trip; one slow
+  rank → trip names the rank AND its dominant stage (latched); a
+  recovered rank keeps the trip counter flat and re-arms;
+* same-host regression sentinel: rolling-baseline drift trip, re-arm;
+* step_barrier obs payloads: dict round-trip, fingerprint extraction
+  for the divergence gate, compatibility with legacy list peers;
+* trainer stage capture with the plane on: breakdown present, d2h == 0;
+* telemetry_report: directory/glob multi-sink merge (per-file counter
+  banking, trace-id dedup) and the ``--fleet`` board rendering;
+* JSONL sink final-flush bugfix: a SIGTERM'd child (ResilientLoop
+  installed) and a clean-exit counters-only child both land their last
+  window of metrics — real subprocesses, bounded;
+* ONE bounded 2-process board-merge acceptance run (fleet_worker with
+  the plane on): both hosts' blobs on the board, observatory merges
+  both, stitched stage payloads behind every step barrier.
+
+Everything except the subprocess tests is sleep- and subprocess-free on
+fake clocks.
+"""
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mxtpu import fleet, fleet_obs, resilience, telemetry
+from mxtpu.fleet import Fleet, FleetMembership, FleetSupervisor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for var in ("MXTPU_FLEET_DIR", "MXTPU_FLEET_OBS_S",
+                "MXTPU_STRAGGLER_X", "MXTPU_PROFILE_ON_TRIP",
+                "MXTPU_FLIGHT_DIR", "MXTPU_FLIGHT_MAX",
+                "MXTPU_FAULT_INJECT", "MXTPU_TELEMETRY",
+                "MXTPU_TELEMETRY_FLUSH_S",
+                "MXTPU_FLEET_BRINGUP_TIMEOUT_S",
+                "MXTPU_FLEET_HEARTBEAT_S",
+                "MXTPU_FLEET_COLLECTIVE_TIMEOUT_S"):
+        monkeypatch.delenv(var, raising=False)
+    telemetry.reset()
+    resilience.reset_faults()
+    fleet_obs._PROFILE_DONE.clear()
+    yield
+    telemetry.reset()
+    resilience.reset_faults()
+    fleet_obs._PROFILE_DONE.clear()
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _counter(name):
+    v = telemetry.snapshot()["counters"].get(name, 0)
+    return sum(v.values()) if isinstance(v, dict) else v
+
+
+def _payloads(fast, slow=None, slow_rank=1, t0=100.0):
+    """A 2-host barrier payload map: per-rank stage dicts."""
+    out = {}
+    for r in (0, 1):
+        s = slow if (slow is not None and r == slow_rank) else fast
+        out[r] = {"fp": [1.0], "trace": "aaaa-%d" % r,
+                  "t": t0 + (s if r == slow_rank and slow else 0.0),
+                  "stages": {"trainer.step.allreduce": s * 0.25,
+                             "trainer.step.update": s * 0.25,
+                             "data.wait": s * 0.5}}
+    return out
+
+
+# --------------------------------------------------- per-host publication
+def test_publish_obs_blob_bounded(tmp_path):
+    telemetry.inc("train.batches", 5)
+    telemetry.gauge("perf.mfu", 0.42)
+    for _ in range(10):
+        telemetry.observe("trainer.step", 0.01)
+    path = fleet_obs.publish_obs(str(tmp_path), 3, step=7, t=123.0)
+    blob = json.load(open(path))
+    assert os.path.basename(path) == "obs_3.json"
+    assert blob["rank"] == 3 and blob["step"] == 7 and blob["t"] == 123.0
+    assert blob["counters"]["train.batches"] == 5
+    assert blob["gauges"]["perf.mfu"] == 0.42
+    assert blob["histograms"]["trainer.step"]["count"] == 10
+    assert len(blob["trace_tail"]) <= fleet_obs.TRACE_TAIL
+    assert _counter("fleet.obs.publishes") == 1
+    # no leftover tmp file: the write is tmp+rename
+    assert not glob.glob(str(tmp_path / "*.tmp"))
+
+
+def test_publisher_cadence_fake_clock(tmp_path):
+    clk = FakeClock()
+    pub = fleet_obs.HostObsPublisher(str(tmp_path), 0, interval_s=5.0,
+                                     clock=clk)
+    assert pub.maybe_publish(step=0)  # first call publishes
+    assert pub.maybe_publish(step=1) is None  # inside the window
+    clk.advance(5.1)
+    assert pub.maybe_publish(step=2)
+    assert json.load(open(pub.path))["step"] == 2
+    assert _counter("fleet.obs.publishes") == 2
+    # forced publish ignores the cadence
+    assert pub.publish(step=3)
+    assert json.load(open(pub.path))["step"] == 3
+
+
+def test_publisher_disabled_without_interval(tmp_path):
+    pub = fleet_obs.HostObsPublisher(str(tmp_path), 0, interval_s=0)
+    assert pub.maybe_publish(step=0) is None
+    assert not os.path.exists(pub.path)
+
+
+def test_publisher_rides_final_flush(tmp_path):
+    """install() hooks telemetry.flush — the path the atexit/SIGTERM
+    final flush takes, so a dying host's blob reflects its last window."""
+    pub = fleet_obs.HostObsPublisher(str(tmp_path), 1,
+                                     interval_s=1e9).install()
+    telemetry.inc("late.counter", 9)
+    telemetry.flush()
+    blob = json.load(open(pub.path))
+    assert blob["counters"]["late.counter"] == 9
+
+
+# ------------------------------------------------------ coordinator merge
+def _write_host_blob(board, rank, mfu, flops, step_p50, t=1000.0,
+                     step=5):
+    os.makedirs(str(board), exist_ok=True)
+    fleet._atomic_write(
+        os.path.join(str(board), "obs_%d.json" % rank),
+        json.dumps({"rank": rank, "pid": 100 + rank, "step": step, "t": t,
+                    "counters": {"train.batches": 10 * (rank + 1),
+                                 "faults.injected": {"oom": rank + 1}},
+                    "gauges": {"perf.mfu": mfu},
+                    "histograms": {"trainer.step": {
+                        "count": 5, "sum": step_p50 * 5,
+                        "mean": step_p50, "min": step_p50,
+                        "max": step_p50 * 2, "p50": step_p50,
+                        "p99": step_p50 * 2}},
+                    "ledger": {"executed_flops": flops},
+                    "trace_tail": []}))
+
+
+def test_observatory_merges_hosts_and_aggregates(tmp_path):
+    clk = FakeClock(1010.0)
+    _write_host_blob(tmp_path, 0, mfu=0.5, flops=100.0, step_p50=0.1)
+    _write_host_blob(tmp_path, 1, mfu=0.3, flops=300.0, step_p50=0.3)
+    FleetMembership(tmp_path, 0, 2, clock=lambda: 1008.0).write("up")
+    FleetMembership(tmp_path, 1, 2, clock=lambda: 1004.0).write("up")
+    m = fleet_obs.FleetObservatory(str(tmp_path), 2, clock=clk).merged()
+    assert sorted(m["hosts"]) == [0, 1]
+    assert m["hosts"][1]["mfu"] == 0.3
+    assert m["hosts"][0]["heartbeat_age_s"] == pytest.approx(2.0)
+    assert m["hosts"][1]["heartbeat_age_s"] == pytest.approx(6.0)
+    # fleet.mfu is FLOPs-weighted: (0.5*100 + 0.3*300) / 400
+    assert m["fleet"]["mfu"] == pytest.approx(0.35)
+    assert m["fleet"]["step_s"]["p50"] == pytest.approx(0.2)
+    assert m["fleet"]["hosts_up"] == 2
+    assert m["fleet"]["executed_flops"] == pytest.approx(400.0)
+
+
+def test_observatory_refresh_lands_registry_gauges(tmp_path):
+    _write_host_blob(tmp_path, 0, mfu=0.4, flops=100.0, step_p50=0.1)
+    FleetMembership(tmp_path, 0, 1, clock=lambda: 999.0).write("up")
+    obs = fleet_obs.FleetObservatory(str(tmp_path), 1,
+                                     clock=FakeClock(1000.0))
+    obs.refresh()
+    g = telemetry.snapshot()["gauges"]
+    assert g["fleet.mfu"] == pytest.approx(0.4)
+    assert g["fleet.step_s"]["p50"] == pytest.approx(0.1)
+    assert g["fleet.heartbeat_age_s"]["host0"] == pytest.approx(1.0)
+    assert g["fleet.hosts_up"] == 1
+
+
+def test_observatory_prometheus_host_labels(tmp_path):
+    _write_host_blob(tmp_path, 0, mfu=0.5, flops=100.0, step_p50=0.1)
+    _write_host_blob(tmp_path, 1, mfu=0.3, flops=300.0, step_p50=0.3)
+    fleet_obs.FleetObservatory(str(tmp_path), 2,
+                               clock=FakeClock()).install()
+    out = telemetry.prometheus()
+    # per-host families with the host label, tags preserved alongside
+    assert 'mxtpu_train_batches{host="0"} 10' in out
+    assert 'mxtpu_train_batches{host="1"} 20' in out
+    assert 'mxtpu_faults_injected{host="1",tag="oom"} 2' in out
+    assert 'mxtpu_trainer_step{host="0",quantile="50"} 0.1' in out
+    # the refresh()'s fleet aggregates land in the SAME scrape
+    assert "mxtpu_fleet_mfu 0.35" in out
+
+
+def test_observatory_degrades_to_surviving_hosts(tmp_path):
+    """A torn/garbage blob (host died mid-life) degrades the merge to
+    the surviving hosts — it never raises (resilience.md matrix row)."""
+    _write_host_blob(tmp_path, 0, mfu=0.5, flops=100.0, step_p50=0.1)
+    with open(os.path.join(str(tmp_path), "obs_1.json"), "w") as f:
+        f.write("{torn")
+    m = fleet_obs.FleetObservatory(str(tmp_path), 2,
+                                   clock=FakeClock()).merged()
+    assert sorted(m["hosts"]) == [0]
+    assert m["fleet"]["mfu"] == pytest.approx(0.5)
+
+
+# ------------------------------------------------------- straggler matrix
+def test_straggler_uniform_fleet_no_trip(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path))
+    s = fleet_obs.StragglerSentinel(factor=1.5, streak=3)
+    for step in range(8):
+        assert s.observe(step, _payloads(0.1)) is None
+    assert _counter("fleet.straggler_trips") == 0
+    assert not glob.glob(str(tmp_path / "flight_straggler_*"))
+
+
+def test_straggler_slow_rank_named_with_dominant_stage(tmp_path,
+                                                       monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path))
+    s = fleet_obs.StragglerSentinel(factor=1.5, streak=3)
+    trips = [s.observe(step, _payloads(0.1, slow=0.5)) for step in range(4)]
+    # streak=3: trips exactly at the 3rd consecutive slow observation,
+    # then latches (no re-trip while still slow)
+    assert trips[0] is None and trips[1] is None
+    assert trips[2] is not None and trips[3] is None
+    trip = trips[2]
+    assert trip["rank"] == 1 and trip["step"] == 2
+    assert trip["dominant_stage"] == "data.wait"
+    assert trip["ratio"] > 1.5
+    assert _counter("fleet.straggler_trips") == 1
+    assert telemetry.tagged("fleet.straggler_trips") == {"host1": 1}
+    arts = glob.glob(str(tmp_path / "flight_straggler_*"))
+    assert len(arts) == 1
+    extra = json.load(open(arts[0]))["extra"]
+    assert extra["rank"] == 1
+    assert extra["stages"]["data.wait"] == pytest.approx(0.25)
+    # arrival-skew gauges rode the same observations
+    skew = telemetry.snapshot()["gauges"]["fleet.arrival_skew_s"]
+    assert skew["host1"] > skew["host0"] == 0.0
+
+
+def test_straggler_recovered_rank_counter_flat():
+    s = fleet_obs.StragglerSentinel(factor=1.5, streak=2)
+    for step in range(2):
+        s.observe(step, _payloads(0.1, slow=0.5))
+    assert _counter("fleet.straggler_trips") == 1
+    # recovery: uniform again — counter stays flat...
+    for step in range(2, 8):
+        assert s.observe(step, _payloads(0.1)) is None
+    assert _counter("fleet.straggler_trips") == 1
+    # ...and the sentinel re-armed: a NEW degradation trips again
+    for step in range(8, 10):
+        s.observe(step, _payloads(0.1, slow=0.5))
+    assert _counter("fleet.straggler_trips") == 2
+
+
+def test_straggler_disabled_without_factor():
+    s = fleet_obs.StragglerSentinel(factor=0)
+    for step in range(6):
+        assert s.observe(step, _payloads(0.1, slow=9.0)) is None
+    assert _counter("fleet.straggler_trips") == 0
+
+
+def test_straggler_ignores_legacy_list_payloads():
+    s = fleet_obs.StragglerSentinel(factor=1.5, streak=1)
+    assert s.observe(0, {0: [1.0, 2.0], 1: [1.0, 2.0]}) is None
+
+
+def test_regression_sentinel_trips_on_drift(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path))
+    r = fleet_obs.RegressionSentinel(factor=1.5, baseline_n=4, recent_n=2)
+    for step in range(6):
+        assert r.observe(step, 0.1) is None  # steady: baseline fills
+    trip = None
+    for step in range(6, 9):
+        trip = r.observe(step, 0.3) or trip
+    assert trip is not None and trip["ratio"] > 1.5
+    assert _counter("fleet.step_regressions") == 1
+    assert len(glob.glob(str(tmp_path / "flight_step_regression_*"))) == 1
+    # recovery re-arms; a second drift trips again
+    for step in range(9, 30):
+        r.observe(step, 0.1)
+    for step in range(30, 40):
+        r.observe(step, 0.4)
+    assert _counter("fleet.step_regressions") == 2
+
+
+def test_profile_on_trip_one_capture_per_reason(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("MXTPU_PROFILE_ON_TRIP", "1")
+    monkeypatch.setattr(fleet_obs, "PROFILE_WINDOW_S", 0.05)
+    s = fleet_obs.StragglerSentinel(factor=1.5, streak=1)
+    s.observe(0, _payloads(0.1, slow=0.5))
+    caps = glob.glob(str(tmp_path / "profile_straggler_*"))
+    assert len(caps) == 1
+    assert _counter("fleet.profile_captures") == 1
+    # recovery + second trip: SAME reason, no second capture window
+    s.observe(1, _payloads(0.1))
+    s.observe(2, _payloads(0.1, slow=0.5))
+    assert _counter("fleet.straggler_trips") == 2
+    assert _counter("fleet.profile_captures") == 1
+    time.sleep(0.2)  # let the bounded stop-timer fire before teardown
+
+
+# ------------------------------------------------- step_barrier stitching
+def _peer_barrier_file(board, name, rank, payload):
+    bdir = os.path.join(str(board), "barrier_%s" % name)
+    os.makedirs(bdir, exist_ok=True)
+    fleet._atomic_write(os.path.join(bdir, "host_%d" % rank),
+                        json.dumps({"rank": rank, "payload": payload}))
+
+
+def test_step_barrier_obs_payload_round_trip(tmp_path):
+    clk = FakeClock()
+    board = tmp_path / "b"
+    m0 = FleetMembership(board, 0, 2, clock=clk)
+    FleetMembership(board, 1, 2, clock=clk).write("up")
+    f = Fleet(0, 2, membership=m0, fleet_dir=str(board))
+    peer = {"fp": [1.5, 2.0], "trace": "beef-7",
+            "stages": {"trainer.step.update": 0.2}, "t": 999.0}
+    _peer_barrier_file(board, "step_3", 1, peer)
+    fps = f.step_barrier(3, fingerprint=[1.5, 2.0],
+                         obs={"trace": "cafe-3",
+                              "stages": {"trainer.step.update": 0.1}})
+    assert fps[1] == peer
+    assert fps[0]["fp"] == [1.5, 2.0]
+    assert fps[0]["trace"] == "cafe-3"
+    assert fps[0]["t"] == clk.t  # barrier-arrival timestamp stamped
+    assert _counter("resilience.divergence_checks") == 1
+
+
+def test_step_barrier_obs_divergence_still_trips(tmp_path, monkeypatch):
+    art = tmp_path / "flight"
+    art.mkdir()
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(art))
+    clk = FakeClock()
+    board = tmp_path / "b"
+    m0 = FleetMembership(board, 0, 2, clock=clk)
+    FleetMembership(board, 1, 2, clock=clk).write("up")
+    f = Fleet(0, 2, membership=m0, fleet_dir=str(board))
+    _peer_barrier_file(board, "step_4", 1,
+                       {"fp": [1.5, 999.0], "stages": {}})
+    with pytest.raises(resilience.DivergenceError, match="step 4"):
+        f.step_barrier(4, fingerprint=[1.5, 2.0], obs={"stages": {}})
+    arts = glob.glob(str(art / "flight_fleet_divergence_*"))
+    assert len(arts) == 1
+
+
+def test_step_barrier_obs_interops_with_legacy_list_peer(tmp_path):
+    """An ISSUE-18 peer that still ships bare fingerprint lists agrees
+    with an obs-carrying host when the fingerprints match."""
+    clk = FakeClock()
+    board = tmp_path / "b"
+    m0 = FleetMembership(board, 0, 2, clock=clk)
+    FleetMembership(board, 1, 2, clock=clk).write("up")
+    f = Fleet(0, 2, membership=m0, fleet_dir=str(board))
+    _peer_barrier_file(board, "step_5", 1, [1.5, 2.0])
+    fps = f.step_barrier(5, fingerprint=[1.5, 2.0], obs={"stages": {}})
+    assert fps[1] == [1.5, 2.0]
+    assert fps[0]["fp"] == [1.5, 2.0]
+
+
+def test_step_traces_names_last_rank_and_stage(tmp_path):
+    for step, (t0, t1) in enumerate([(10.0, 10.3), (20.4, 20.0)]):
+        _peer_barrier_file(tmp_path, "step_%d" % step, 0,
+                           {"fp": None, "t": t0, "trace": "aa-%d" % step,
+                            "stages": {"trainer.step.update": 0.01,
+                                       "data.wait": 0.35 if step == 1
+                                       else 0.0}})
+        _peer_barrier_file(tmp_path, "step_%d" % step, 1,
+                           {"fp": None, "t": t1, "trace": "bb-%d" % step,
+                            "stages": {"trainer.step.update": 0.4
+                                       if step == 1 else 0.01}})
+    rows = fleet_obs.step_traces(str(tmp_path))
+    assert [r["step"] for r in rows] == [0, 1]
+    assert rows[0]["last_rank"] == 1  # arrived at 10.3 vs 10.0
+    assert rows[0]["skew_s"] == pytest.approx(0.3)
+    assert rows[1]["last_rank"] == 0
+    assert rows[1]["dominant_stage"] == "data.wait"
+    assert rows[1]["trace"] == "aa-1"
+
+
+# -------------------------------------------- trainer stage wiring + pins
+def test_trainer_stage_capture_plane_on_d2h_zero(monkeypatch):
+    """One real training step with every plane lever ON: the stage
+    breakdown and trace id land on the trainer, and the step stays
+    device-sync-free (d2h == 0) — the ISSUE-19 zero-device-work pin."""
+    monkeypatch.setenv("MXTPU_TELEMETRY", "1")
+    monkeypatch.setenv("MXTPU_TRACE", "1")
+    monkeypatch.setenv("MXTPU_STRAGGLER_X", "2.0")
+    import numpy as np
+
+    import mxtpu as mx
+    from mxtpu.gluon.parameter import Parameter
+    from mxtpu.gluon.trainer import Trainer
+    rng = np.random.RandomState(0)
+    params = []
+    for j in range(3):
+        p = Parameter("sp%d" % j, shape=(5,), dtype="float32")
+        p.initialize()
+        params.append(p)
+    trainer = Trainer(params, "sgd", {"learning_rate": 0.05,
+                                      "momentum": 0.9}, kvstore=None)
+    assert trainer.last_step_trace is None
+    for _ in range(3):
+        for p in params:
+            p.grad()[:] = mx.nd.array(
+                rng.randn(*p.shape).astype(np.float32))
+        trainer.step(1)
+    stages = trainer.last_step_stages
+    assert set(stages) == {"trainer.step.allreduce", "trainer.step.update"}
+    assert all(v >= 0 for v in stages.values())
+    assert trainer.last_step_trace is not None
+    assert telemetry.value("trainer.step.d2h") == 0
+
+
+# ----------------------------------------- telemetry_report multi-sink
+def _write_jsonl(path, recs):
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_report_merges_directory_of_sinks(tmp_path):
+    import telemetry_report as rep
+    # two hosts' cumulative counter streams + one duplicated trace-
+    # linked obs line (same process prefix => same (trace, span))
+    _write_jsonl(str(tmp_path / "h0.jsonl"), [
+        {"t": 1.0, "kind": "counter", "metric": "train.batches",
+         "value": 50},
+        {"t": 2.0, "kind": "counter", "metric": "train.batches",
+         "value": 100},
+        {"t": 2.0, "kind": "gauge", "metric": "perf.mfu", "value": 0.5},
+        {"t": 1.5, "kind": "obs", "metric": "trainer.step", "value": 0.1,
+         "trace": "00aa-1", "span": 7},
+    ])
+    _write_jsonl(str(tmp_path / "h1.jsonl"), [
+        {"t": 2.5, "kind": "counter", "metric": "train.batches",
+         "value": 40},
+        {"t": 3.0, "kind": "gauge", "metric": "perf.mfu", "value": 0.7},
+        {"t": 1.5, "kind": "obs", "metric": "trainer.step", "value": 0.1,
+         "trace": "00aa-1", "span": 7},              # the duplicate
+        {"t": 1.6, "kind": "obs", "metric": "trainer.step", "value": 0.3,
+         "trace": "00bb-1", "span": 9},
+    ])
+    recs = rep.load_many([str(tmp_path)])
+    summary = rep.aggregate(recs)
+    # per-file banking then sum: 100 (host 0 final) + 40 (host 1 final)
+    assert summary["train.batches"]["value"] == 140
+    # freshest gauge write wins regardless of file order
+    assert summary["perf.mfu"]["value"] == 0.7
+    # the duplicated trace-linked line folded once: 2 obs, not 3
+    assert summary["trainer.step"]["count"] == 2
+
+
+def test_report_single_file_behavior_unchanged(tmp_path):
+    import telemetry_report as rep
+    p = str(tmp_path / "one.jsonl")
+    _write_jsonl(p, [
+        {"t": 1.0, "kind": "counter", "metric": "c", "value": 10},
+        {"t": 2.0, "kind": "counter", "metric": "c", "value": 3},  # restart
+    ])
+    assert rep.aggregate(rep.load(p))["c"]["value"] == 13
+    assert rep.aggregate(rep.load_many([p]))["c"]["value"] == 13
+
+
+def test_report_fleet_cli_renders_board(tmp_path, capsys):
+    import telemetry_report as rep
+    board = tmp_path / "board"
+    _write_host_blob(board, 0, mfu=0.5, flops=100.0, step_p50=0.1)
+    _write_host_blob(board, 1, mfu=0.3, flops=300.0, step_p50=0.3)
+    _peer_barrier_file(board, "step_0", 0,
+                       {"fp": None, "t": 10.0,
+                        "stages": {"trainer.step.update": 0.01}})
+    _peer_barrier_file(board, "step_0", 1,
+                       {"fp": None, "t": 10.2,
+                        "stages": {"data.wait": 0.2}})
+    assert rep.main(["--fleet", str(board)]) == 0
+    out = capsys.readouterr().out
+    assert "Fleet:" in out and "critical path" in out
+    assert "data.wait" in out
+    # and the JSON spelling carries the merged view for machines
+    assert rep.main(["--fleet", str(board), "--json"]) == 0
+    js = json.loads(capsys.readouterr().out)
+    assert js["_fleet"]["merged"]["fleet"]["mfu"] == pytest.approx(0.35)
+    assert js["_fleet"]["steps"][0]["last_rank"] == 1
+
+
+# ------------------------------------------------- sink final-flush fixes
+_CLEAN_CHILD = """
+import os, sys
+sys.path.insert(0, %(repo)r)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MXTPU_TELEMETRY"] = %(sink)r
+os.environ["MXTPU_TELEMETRY_FLUSH_S"] = "3600"
+from mxtpu import telemetry
+telemetry.inc("child.counter", 7)
+# counters-only: nothing ever queued an obs line, so nothing but the
+# import-time atexit registration can flush this
+"""
+
+_SIGTERM_CHILD = """
+import os, sys, time
+sys.path.insert(0, %(repo)r)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MXTPU_TELEMETRY"] = %(sink)r
+os.environ["MXTPU_TELEMETRY_FLUSH_S"] = "3600"
+from mxtpu import resilience, telemetry
+loop = resilience.ResilientLoop(None, None).install()
+telemetry.inc("child.counter", 7)
+print("READY", flush=True)
+deadline = time.time() + 60
+while not loop.preempted and time.time() < deadline:
+    time.sleep(0.02)
+# handler path only: exit without reaching any explicit flush. The
+# SIGTERM postmortem thread (flight + flush) must have landed the
+# counter lines; give the daemon a beat, then die hard like a real
+# preemption would.
+time.sleep(1.0)
+os._exit(0)
+"""
+
+
+def _counter_lines(sink, metric):
+    if not os.path.exists(sink):
+        return []
+    out = []
+    with open(sink) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("kind") == "counter" and rec.get("metric") == metric:
+                out.append(rec)
+    return out
+
+
+def test_sink_clean_exit_counters_only_flushes(tmp_path):
+    """ISSUE-19 satellite bugfix: a process that only bumped counters
+    (never queued an obs line) used to lose them even on a CLEAN exit —
+    the atexit hook was registered lazily inside _queue_line. The
+    import-time registration must land the cumulative lines."""
+    sink = str(tmp_path / "clean.jsonl")
+    code = _CLEAN_CHILD % {"repo": REPO, "sink": sink}
+    proc = subprocess.run([sys.executable, "-c", code], timeout=120,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = _counter_lines(sink, "child.counter")
+    assert lines and lines[-1]["value"] == 7
+
+
+def test_sink_sigterm_flushes_final_window(tmp_path):
+    """SIGTERM between off-thread flushes: the signal path's postmortem
+    (flight + flush on a daemon thread) lands the last buffered window
+    even though the process dies via os._exit (no atexit)."""
+    sink = str(tmp_path / "killed.jsonl")
+    code = _SIGTERM_CHILD % {"repo": REPO, "sink": sink}
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    lines = _counter_lines(sink, "child.counter")
+    assert lines and lines[-1]["value"] == 7
+
+
+# ------------------------------------------- 2-process board-merge run
+@pytest.mark.multidevice
+def test_fleet_obs_two_host_board_merge_acceptance(tmp_path):
+    """ISSUE-19 acceptance, the bounded tier-1 spelling: a real 2-host
+    fleet runs with the obs plane ON — both hosts publish blobs onto
+    the board, every step barrier carries the stitched stage payload,
+    and the observatory merges the fleet into one snapshot."""
+    worker = os.path.join(REPO, "tools", "fleet_worker.py")
+    ckpt = str(tmp_path / "ckpt")
+    steps = 2
+
+    def command_for(rank, world, generation):
+        return [sys.executable, worker, "--ckpt-dir", ckpt,
+                "--steps", str(steps), "--devices", "1"]
+
+    def env_for(rank, world, generation):
+        return {"XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                "MXTPU_FLEET_COLLECTIVE_TIMEOUT_S": "30",
+                "MXTPU_FLEET_OBS_S": "0.05",
+                "MXTPU_STRAGGLER_X": "1.5"}
+
+    sup = FleetSupervisor(
+        command_for=command_for, num_hosts=2, fleet_dir=str(tmp_path / "b"),
+        timeout_s=240.0, env_for=env_for)
+    results = sup.launch_round(2, 0)
+    for rank in (0, 1):
+        rc, tail = results[rank]
+        assert rc == 0, tail[-2000:]
+    board = str(tmp_path / "b" / "gen_0")
+    blobs = sorted(os.path.basename(p)
+                   for p in glob.glob(os.path.join(board, "obs_*.json")))
+    assert blobs == ["obs_0.json", "obs_1.json"]
+    m = fleet_obs.FleetObservatory(board, 2).merged()
+    assert sorted(m["hosts"]) == [0, 1]
+    for rank in (0, 1):
+        assert m["hosts"][rank]["step_s"]["count"] == steps
+    # every step barrier carried the stitched payload on both hosts
+    rows = fleet_obs.step_traces(board)
+    assert [r["step"] for r in rows] == list(range(steps))
+    assert all(r["ranks"] == 2 and r["stages"] for r in rows)

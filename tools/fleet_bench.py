@@ -31,6 +31,14 @@ count, the mesh-RESHAPE lever):
    so the rejoin generation runs 1 device per host, warm off the blobs
    the restore/oracle phases spilled; on TPU the same gate rides the
    full-mesh blobs.
+5. **obs** — ISSUE 19's observability gate: a fresh 2-host fleet runs
+   with the fleet obs plane ON (``MXTPU_FLEET_OBS_S``,
+   ``MXTPU_STRAGGLER_X``) and rank 1 injected ``straggler_slow`` on
+   every post-warmup step. Gates: *fleet_snapshot_merged* — the
+   ``FleetObservatory`` merge over the board's ``obs_*.json`` blobs
+   covers both hosts with step-time quantiles — and
+   *straggler_tripped* — the ``flight_record("straggler")`` artifact
+   names rank 1 with ``data.wait`` dominant within 16 steps.
 
 JSON lines ride ``bench.py fleet_resume`` (tools/perf_battery.sh phase).
 Knobs: ``BENCH_FLEET_STEPS`` (default 6), ``BENCH_FLEET_KILL_STEP``
@@ -127,7 +135,9 @@ def run_fleet_resume(emit=None):
     cache_dir = os.path.join(root, "compile_cache")
     ckpt = os.path.join(root, "ckpt")
     ckpt_oracle = os.path.join(root, "ckpt_oracle")
-    for d in (cache_dir, ckpt, ckpt_oracle):
+    ckpt_obs = os.path.join(root, "ckpt_obs")
+    flight_obs = os.path.join(root, "flight_obs")
+    for d in (cache_dir, ckpt, ckpt_oracle, ckpt_obs, flight_obs):
         shutil.rmtree(d, ignore_errors=True)
         os.makedirs(d, exist_ok=True)
     summary = {"steps": steps, "kill_step": kill, "phases": {}}
@@ -208,6 +218,55 @@ def run_fleet_resume(emit=None):
               "disk_hits": [r.get("disk_hits") for r in r4],
               "rejoin_zero_compiles": zero_compiles})
 
+        # 5. observability (ISSUE 19): fresh 2-host fleet with the obs
+        # plane ON and rank 1 injected slow on every post-warmup step —
+        # the merged fleet snapshot must cover both hosts and the
+        # straggler sentinel must NAME rank 1 with its dominant stage.
+        import glob as _glob
+
+        from mxtpu import fleet_obs
+        p5 = _phase(
+            "obs", 2, ckpt_obs, steps, root, cache_dir, devices=1,
+            env_extra={"MXTPU_FLEET_OBS_S": "0.05",
+                       "MXTPU_STRAGGLER_X": "1.5",
+                       "MXTPU_FLIGHT_DIR": flight_obs},
+            env_for=lambda r, w, g:
+                {"MXTPU_FAULT_INJECT": "straggler_slow@" + ",".join(
+                    str(s) for s in range(1, steps))} if r == 1
+                else {})
+        obs_rc_ok = all(p5[r]["rc"] == 0 for r in (0, 1))
+        board = os.path.join(root, "board_obs", "gen_0")
+        merged = fleet_obs.FleetObservatory(board, 2).merged()
+        hosts = merged.get("hosts", {})
+        snapshot_merged = obs_rc_ok and all(
+            r in hosts and hosts[r]["step_s"].get("p50") is not None
+            for r in (0, 1))
+        trip = None
+        for art in sorted(_glob.glob(os.path.join(
+                flight_obs, "flight_straggler_*.json"))):
+            try:
+                with open(art) as fh:
+                    trip = (json.load(fh).get("extra") or {})
+                break
+            except ValueError:
+                continue
+        straggler_named = bool(
+            trip and trip.get("rank") == 1 and
+            trip.get("step", 1 << 30) < 16 and
+            trip.get("dominant_stage") == "data.wait")
+        summary["phases"]["obs"] = {
+            "wall_s": round(p5["wall_s"], 2),
+            "rc": {"0": p5[0]["rc"], "1": p5[1]["rc"]},
+            "hosts_merged": sorted(hosts),
+            "straggler": None if not trip else
+            {k: trip.get(k) for k in
+             ("rank", "step", "ratio", "dominant_stage")}}
+        emit({"metric": "fleet_resume", "phase": "obs",
+              "wall_s": round(p5["wall_s"], 3),
+              "fleet_snapshot_merged": snapshot_merged,
+              "straggler_tripped": straggler_named,
+              "straggler": summary["phases"]["obs"]["straggler"]})
+
         gates = {
             "kill_detected": kill_detected,
             "restore_clean": p2[0]["rc"] == 0 and restored_at == kill,
@@ -215,6 +274,8 @@ def run_fleet_resume(emit=None):
             "resume_parity": parity,
             "rejoin_zero_compiles": zero_compiles,
             "rejoin_disk_served": disk_served,
+            "fleet_snapshot_merged": snapshot_merged,
+            "straggler_tripped": straggler_named,
         }
         summary["gates"] = gates
         summary["ok"] = all(gates.values())
@@ -227,7 +288,7 @@ def run_fleet_resume(emit=None):
             # surface the failing child's tail — a gate that fails in CI
             # must carry its evidence
             for name, p in (("fleet", p1), ("restore", p2),
-                            ("oracle", p3), ("rejoin", p4)):
+                            ("oracle", p3), ("rejoin", p4), ("obs", p5)):
                 for rank in (0, 1):
                     info = p.get(rank)
                     if info and info["rc"] != 0:

@@ -91,7 +91,8 @@ def main():
     import numpy as np
 
     import mxtpu as mx
-    from mxtpu import autograd, fleet, gluon, resilience
+    from mxtpu import autograd, fleet, fleet_obs, gluon, resilience
+    from mxtpu import telemetry
     from mxtpu.gluon import nn
     from mxtpu.io.stream import shard_keys
     from mxtpu.parallel import host_value
@@ -99,6 +100,16 @@ def main():
     f = fleet.init()
     rank, world = f.rank, f.num_hosts
     mesh = f.mesh()
+
+    # fleet observability plane (ISSUE 19, mxtpu/fleet_obs.py): cadenced
+    # obs_<rank>.json publication riding the telemetry flush hook, plus
+    # the straggler/regression sentinels off the step-barrier payloads.
+    # All opt-in: MXTPU_FLEET_OBS_S / MXTPU_STRAGGLER_X default off.
+    pub = None
+    if f.fleet_dir and fleet_obs.obs_interval_s() > 0:
+        pub = fleet_obs.HostObsPublisher(f.fleet_dir, rank).install()
+    straggler = fleet_obs.StragglerSentinel() if rank == 0 else None
+    regression = fleet_obs.RegressionSentinel()
 
     # dataset: pure function of the seed (identical on every host and
     # across restarts/reshapes)
@@ -145,6 +156,13 @@ def main():
             assert [k for p in parts for k in p] == idx, \
                 "shard_keys shards no longer reassemble the global batch"
             xb, yb = trainer.shard_batch(x_all[idx], y_all[idx])
+            # straggler_slow fault: a fixed host-side stall before this
+            # step, billed to data.wait — the deterministic slow host
+            # the straggler sentinel must name
+            slow_s = 0.0
+            if resilience.inject("straggler_slow", step):
+                slow_s = 0.35
+                time.sleep(slow_s)
             entry = wd.arm(step, what="train step")
             try:
                 with autograd.record():
@@ -156,12 +174,24 @@ def main():
                 lval = float(np.mean(host_value(loss._data)))
                 # cross-host consistency gate: the step barrier carries
                 # each host's fingerprint; a dead peer or a divergent
-                # one fails this loud
-                f.step_barrier(step, fingerprint=None if fp is None
-                               else [float(x) for x in fp])
+                # one fails this loud. The obs payload stitches this
+                # host's trace id + stage breakdown + arrival timestamp
+                # into the board for the fleet critical-path view.
+                stages = dict(getattr(trainer, "last_step_stages", {}) or {})
+                if slow_s:
+                    stages["data.wait"] = stages.get("data.wait", 0.0) + slow_s
+                obs = {"trace": getattr(trainer, "last_step_trace", None),
+                       "stages": stages}
+                fps = f.step_barrier(step, fingerprint=None if fp is None
+                                     else [float(x) for x in fp], obs=obs)
+                if straggler is not None and fps:
+                    straggler.observe(step, fps)
+                regression.observe(step, sum(stages.values()) or None)
             finally:
                 wd.disarm(entry)
             losses.append(lval)
+            if pub is not None:
+                pub.maybe_publish(step)
             if rank == 0:
                 # single checkpoint writer: replicated state is
                 # identical on every host, and two processes writing
@@ -172,11 +202,18 @@ def main():
         os._exit(fleet.EXIT_FLEET_WEDGE)
 
     loop.wait_for_pending()
+    if pub is not None:
+        pub.publish()  # final blob: the completed run's full registry
     rec = {"rank": rank, "world": world, "start": start,
            "steps": args.steps, "devices": args.devices, "losses": losses,
            "divergence_checks": sentinel.checks,
            "wall_s": time.time() - t0}
     rec.update(_snapshot_counts())
+    rec["obs_publishes"] = int(telemetry.value("fleet.obs.publishes"))
+    rec["straggler_trips"] = sum(
+        (telemetry.tagged("fleet.straggler_trips") or {}).values())
+    if straggler is not None and straggler.trips:
+        rec["straggler"] = straggler.trips[-1]["rank"]
     print("RESULT " + json.dumps(rec), flush=True)
     wd.stop_monitor()
     f.leave()

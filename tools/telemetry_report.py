@@ -33,14 +33,30 @@ site, captured argument shapes, intensity, verdict, executed FLOPs —
 which ``tools/autotune_session.py`` consumes top-down (docs/autotune.md,
 the observe → tune → persist → serve loop).
 
+Multi-host runs produce one sink PER HOST: any path argument may be a
+directory (every ``*.jsonl`` inside) or a glob, and several paths are
+merged — counters fold per-file then sum, gauges take the freshest
+write, and duplicated trace-linked observations collapse on their
+``(trace, span)`` identity (trace ids carry the originating pid prefix,
+so cross-host lines never collide and true copies dedup cleanly).
+
+``--fleet <dir>`` points at a fleet board directory (``MXTPU_FLEET_DIR``
+generation dir) and renders the ISSUE-19 merged fleet view on top: the
+``FleetObservatory`` per-host/aggregate snapshot from the ``obs_*.json``
+blobs plus the per-step critical path stitched from the step-barrier
+payloads — which rank arrived last and which stage made it late.
+
 Usage::
 
-    python tools/telemetry_report.py telemetry.jsonl [--json]
+    python tools/telemetry_report.py <jsonl|dir|glob>... [--json]
         [--traces [K]] [--ledger] [--tuning-queue <json>]
+        [--fleet <board-dir>]
 """
 from __future__ import annotations
 
+import glob as _glob
 import json
+import os
 import sys
 
 
@@ -63,11 +79,15 @@ def aggregate(lines):
     single MXTPU_TELEMETRY path across the battery, benchmark_score, and
     bandwidth runs, each restarting at 0) — so they fold Prometheus-style:
     a value that DROPS marks a process restart, banking the previous
-    session's total. Gauges take the last write; observation streams get
-    count/mean/p50/p99/min/max."""
+    session's total. Multi-file merges (``load_many``) tag records with
+    their source file index ``_src``: the restart fold then runs PER
+    FILE and the per-file totals sum — two hosts' cumulative streams
+    never alias each other's banking. Gauges take the freshest write
+    (by record timestamp, stream order on ties); observation streams
+    get count/mean/p50/p99/min/max."""
     obs = {}
-    counters = {}   # key -> [banked_total, last_seen_in_session]
-    gauges = {}
+    counters = {}   # (src, key) -> [banked_total, last_seen_in_session]
+    gauges = {}     # key -> (t, value)
     for rec in lines:
         kind = rec.get("kind")
         name = rec.get("metric")
@@ -78,15 +98,23 @@ def aggregate(lines):
         elif kind == "counter":
             tag = rec.get("tag")
             key = "%s{%s}" % (name, tag) if tag else name
-            banked, last = counters.get(key, (0, 0))
+            ckey = (rec.get("_src"), key)
+            banked, last = counters.get(ckey, (0, 0))
             if rec["value"] < last:  # process restart: bank the old run
                 banked += last
-            counters[key] = (banked, rec["value"])
+            counters[ckey] = (banked, rec["value"])
         elif kind == "gauge":
             tag = rec.get("tag")
             key = "%s{%s}" % (name, tag) if tag else name
-            gauges[key] = float(rec["value"])
-    counters = {k: banked + last for k, (banked, last) in counters.items()}
+            t = rec.get("t")
+            prev = gauges.get(key)
+            if prev is None or t is None or prev[0] is None or t >= prev[0]:
+                gauges[key] = (t, float(rec["value"]))
+    totals = {}
+    for (_src, key), (banked, last) in counters.items():
+        totals[key] = totals.get(key, 0) + banked + last
+    counters = totals
+    gauges = {k: v for k, (_t, v) in gauges.items()}
     out = {}
     for name, vals in obs.items():
         vals.sort()
@@ -252,6 +280,40 @@ def load(path):
     return records
 
 
+def expand_paths(paths):
+    """Each argument may be a file, a directory (every ``*.jsonl``
+    inside), or a glob pattern; returns the flat sorted file list."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(_glob.glob(os.path.join(p, "*.jsonl"))))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    return out
+
+
+def load_many(paths):
+    """Merge several sink files: records gain a ``_src`` file index (the
+    per-file counter-banking key), and trace-linked observation lines
+    that appear in more than one file collapse on ``(trace, span,
+    metric)`` — the trace id's process prefix makes that identity
+    host-unique, so only true duplicates dedup."""
+    records = []
+    seen = set()
+    for i, path in enumerate(expand_paths(paths)):
+        for rec in load(path):
+            if rec.get("kind") == "obs" and rec.get("trace") is not None:
+                key = (rec["trace"], rec.get("span"), rec.get("metric"))
+                if key in seen:
+                    continue
+                seen.add(key)
+            rec["_src"] = i
+            records.append(rec)
+    return records
+
+
 def format_table(summary):
     lines = []
     obs = {n: s for n, s in summary.items() if s["kind"] == "obs"}
@@ -274,10 +336,61 @@ def format_table(summary):
     return "\n".join(lines) if lines else "(no telemetry records)"
 
 
+def format_fleet(merged, steps):
+    """The merged fleet view + per-step critical path as text tables."""
+    fl = merged["fleet"]
+    lines = ["Fleet: %d/%d host(s) up | mfu=%s | step p50=%s p99=%s" % (
+        fl["hosts_up"], fl["hosts_seen"],
+        "%.3f" % fl["mfu"] if fl.get("mfu") is not None else "-",
+        "%.4gs" % fl["step_s"]["p50"]
+        if fl["step_s"].get("p50") is not None else "-",
+        "%.4gs" % fl["step_s"]["p99"]
+        if fl["step_s"].get("p99") is not None else "-")]
+    lines.append("")
+    lines.append("%4s %-10s %6s %8s %12s %12s %10s" % (
+        "Rank", "Status", "Step", "MFU", "Step p50", "Step p99", "HB age"))
+    for rank in sorted(merged["hosts"]):
+        h = merged["hosts"][rank]
+        ss = h["step_s"]
+        lines.append("%4d %-10s %6s %8s %12s %12s %10s" % (
+            rank, h.get("status") or "-",
+            "-" if h.get("step") is None else h["step"],
+            "%.3f" % h["mfu"] if h.get("mfu") is not None else "-",
+            "%.4gs" % ss["p50"] if ss.get("p50") is not None else "-",
+            "%.4gs" % ss["p99"] if ss.get("p99") is not None else "-",
+            "%.1fs" % h["heartbeat_age_s"]
+            if h.get("heartbeat_age_s") is not None else "-"))
+    if steps:
+        lines.append("")
+        lines.append("Per-step critical path (who arrived last, and why):")
+        lines.append("%6s %6s %10s %10s  %-28s %-14s" % (
+            "Step", "Last", "Skew(ms)", "Step(ms)", "Dominant stage",
+            "Trace"))
+        for r in steps:
+            lines.append("%6d %6d %10s %10s  %-28s %-14s" % (
+                r["step"], r["last_rank"],
+                "%.2f" % (r["skew_s"] * 1e3)
+                if r.get("skew_s") is not None else "-",
+                "%.2f" % (r["step_s"] * 1e3)
+                if r.get("step_s") is not None else "-",
+                r.get("dominant_stage") or "-", r.get("trace") or "-"))
+    else:
+        lines.append("")
+        lines.append("(no stitched step-barrier payloads on the board)")
+    return "\n".join(lines)
+
+
 def main(argv):
     argv = list(argv)
     as_json = "--json" in argv
     with_ledger = "--ledger" in argv
+    fleet_dir = None
+    if "--fleet" in argv:
+        nxt = argv.index("--fleet") + 1
+        if nxt >= len(argv):
+            print("--fleet needs a board directory", file=sys.stderr)
+            return 1
+        fleet_dir = argv.pop(nxt)    # consume BY INDEX, like --traces
     top = None
     if "--traces" in argv:
         top = 10
@@ -295,11 +408,20 @@ def main(argv):
         queue_path = argv.pop(nxt)   # consume BY INDEX, like --traces
         with_ledger = True           # the queue IS a ledger product
     paths = [a for a in argv if not a.startswith("-")]
-    if not paths or "-h" in argv or "--help" in argv:
+    if (not paths and fleet_dir is None) or "-h" in argv or "--help" in argv:
         print(__doc__)
         return 0 if "-h" in argv or "--help" in argv else 1
-    path = paths[0]
-    records = load(path)
+    records = load_many(paths)
+    fleet_view = None
+    if fleet_dir is not None:
+        # lazy: the plain report stays stdlib-only; the fleet merge
+        # reuses the observatory itself rather than re-implementing it
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from mxtpu import fleet_obs
+        fleet_view = (
+            fleet_obs.FleetObservatory(fleet_dir).merged(),
+            fleet_obs.step_traces(fleet_dir))
     summary = aggregate(records)
     traces = trace_summary(records, top=top) if top is not None else None
     ledger = ledger_summary(records) if with_ledger else None
@@ -317,15 +439,23 @@ def main(argv):
             out["_ledger"] = {"rows": ledger[0],
                               "candidates": ["%s#%s" % (r["site"], r["seq"])
                                              for r in ledger[1]]}
+        if fleet_view is not None:
+            out["_fleet"] = {"merged": fleet_view[0],
+                             "steps": fleet_view[1]}
         print(json.dumps(out, sort_keys=True))
     else:
-        print(format_table(summary))
+        if paths:
+            print(format_table(summary))
         if traces is not None:
             print()
             print(format_trace_table(traces))
         if ledger is not None:
             print()
             print(format_ledger_table(*ledger))
+        if fleet_view is not None:
+            if paths:
+                print()
+            print(format_fleet(*fleet_view))
     return 0
 
 
