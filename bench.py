@@ -1329,6 +1329,42 @@ def bench_serving_slo(emit=None):
     }
 
 
+def bench_serving_zoo(emit=None):
+    """Multi-tenant model zoo (mxtpu/serving/zoo, ISSUE 20):
+    ``tools/serve_bench.py --mode zoo`` driven in-process. K models
+    multiplexed over a smaller device pool under skewed mixed-tenant
+    open-loop load, with a mid-run canary deploy+promote AND
+    deploy+rollback cycle. Gates: per-tenant goodput-at-SLO with
+    priority isolation, page-in compiles == 0 (evicted models return
+    disk/memory-warm), zero hung futures across the rollout, bounded
+    eviction/page-in churn. ``vs_baseline`` is the achieved goodput
+    fraction of offered load when EVERY gate holds, else 0.0."""
+    if emit is None:
+        emit = _emit
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import serve_bench as sb
+
+    rec = sb.run_zoo(emit=emit)
+    frac = min(1.0, rec["value"] / max(rec["offered_qps"], 1e-9))
+    return {
+        "metric": "serving_zoo",
+        "value": rec["value"],
+        "unit": "goodput_rps",
+        "vs_baseline": round(frac, 4) if rec["ok"] else 0.0,
+        "mfu": None,
+        "hfu": None,
+        "models": rec["models"],
+        "pageins": rec["pageins"],
+        "evictions": rec["evictions"],
+        "pagein_compiles": rec["pagein_compiles"],
+        "hangs": rec["hung"],
+        "attainment_gold": rec["attainment_gold"],
+        "attainment_free": rec["attainment_free"],
+        "gates_ok": rec["ok"],
+    }
+
+
 def bench_startup_time(emit=None):
     """Persistent compile cache (mxtpu/compile_service.py, ISSUE 15):
     cold-start vs warm-disk-cache wall time, each scenario in a FRESH
@@ -1741,6 +1777,7 @@ CONFIGS = {
     "serving": bench_serving,
     "serving_decode": bench_serving_decode,
     "serving_slo": bench_serving_slo,
+    "serving_zoo": bench_serving_zoo,
     "startup_time": bench_startup_time,
     "fleet_resume": bench_fleet_resume,
     "multichip_resnet": bench_multichip_resnet,

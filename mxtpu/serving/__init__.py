@@ -31,7 +31,14 @@ The TPU-native successor to the reference's C predict API
   (one AOT donated executable per cohort bucket, pure replay; finished
   sequences free slots between steps, queued prompts join the running
   cohort without a recompile), with per-replica KV-residency admission
-  and an int8 weight+KV storage path (``MXTPU_SERVE_INT8``).
+  and an int8 weight+KV storage path (``MXTPU_SERVE_INT8``);
+* :class:`ModelZoo` / :class:`ZooScheduler` (``zoo``) — the multi-tenant
+  serving plane: a named-models x immutable-versions registry (manifest
+  beside the compile-cache artifacts) multiplexed over one device pool
+  with HBM as the shared currency — ledger-derived resident footprints,
+  decayed demand rates, cold-model eviction + disk-warm no-compile
+  page-ins, per-tenant SLO classes, and versioned canary rollout with
+  SLO/parity auto-rollback (zero drops across promote/rollback).
 """
 from .batcher import (DeadlineExceeded, MicroBatcher, QueueFull,
                       batch_aging_ms_default, max_batch_default,
@@ -49,9 +56,19 @@ from .replicas import (Replica, ReplicaDispatcher, ReplicaFailure,
                        breaker_backoff_ms_default, breaker_threshold_default,
                        dispatch_timeout_ms_default, replica_count_default)
 from .server import ModelServer
+from .zoo import (ModelZoo, ZooScheduler, ZooVersion,
+                  zoo_canary_floor_default, zoo_canary_window_default,
+                  zoo_cold_policy_default, zoo_demand_horizon_default,
+                  zoo_hbm_budget_default, zoo_max_resident_default,
+                  zoo_pagein_queue_default, zoo_parity_tol_default)
 
 __all__ = ["BucketSpec", "Predictor", "pad_nd", "MicroBatcher",
            "QueueFull", "DeadlineExceeded", "ModelServer",
+           "ModelZoo", "ZooScheduler", "ZooVersion",
+           "zoo_max_resident_default", "zoo_hbm_budget_default",
+           "zoo_cold_policy_default", "zoo_pagein_queue_default",
+           "zoo_demand_horizon_default", "zoo_canary_floor_default",
+           "zoo_canary_window_default", "zoo_parity_tol_default",
            "Replica", "ReplicaSet", "ReplicaDispatcher", "ReplicaFailure",
            "DecodeEngine", "DecodeFuture", "DecodeModel",
            "KVCacheAccountant", "serve_int8_default",

@@ -132,16 +132,19 @@ class _Future:
 
 class _Request:
     __slots__ = ("inputs", "n", "bucket_key", "deadline", "t_enq", "future",
-                 "redispatched", "trace", "priority")
+                 "redispatched", "trace", "priority", "meta")
 
     def __init__(self, inputs, n, bucket_key, deadline, t_enq, trace=None,
-                 priority="interactive"):
+                 priority="interactive", meta=None):
         self.inputs = inputs
         self.n = n
         self.bucket_key = bucket_key
         self.deadline = deadline
         self.t_enq = t_enq
         self.priority = priority
+        # opaque attribution dict (the zoo stamps tenant/model/version);
+        # rides delivery and expiry into the controller's observe path
+        self.meta = meta
         self.future = _Future()
         # set when a wedge-watchdog trip re-enqueues this request on a
         # healthy replica: re-dispatch happens exactly ONCE (replicas.py)
@@ -217,14 +220,18 @@ class MicroBatcher:
         self._controller = controller
         return self
 
-    def submit(self, inputs, deadline_ms=None, priority="interactive"):
+    def submit(self, inputs, deadline_ms=None, priority="interactive",
+               meta=None):
         """Enqueue one request — ``inputs`` is an array or tuple of arrays
         sharing batch axis 0 (host numpy stays host-side until dispatch).
         Returns a future; raises :class:`QueueFull` when shed.
         ``priority`` is the request's class (``interactive`` | ``batch``:
         batch yields its coalescing slot to interactive traffic — up to
         the ``MXTPU_SERVE_BATCH_AGING_MS`` starvation floor — and is the
-        first evicted under queue pressure).
+        first evicted under queue pressure). ``meta`` is an opaque
+        attribution dict (the model zoo stamps ``tenant``/``model``/
+        ``version``) handed to the controller with this request's
+        delivery or expiry verdict — per-tenant SLO attainment reads it.
 
         Each admitted request starts a causal trace here (the
         ``serving.submit`` stage covers validation + enqueue on the
@@ -236,12 +243,13 @@ class MicroBatcher:
         t0 = time.perf_counter()
         with telemetry.trace_handoff(trace), \
                 telemetry.span("serving.submit"):
-            req = self._admit(inputs, deadline_ms, trace, priority)
+            req = self._admit(inputs, deadline_ms, trace, priority, meta)
         telemetry.add_stage(trace, "serving.submit",
                             time.perf_counter() - t0)
         return req.future
 
-    def _admit(self, inputs, deadline_ms, trace, priority="interactive"):
+    def _admit(self, inputs, deadline_ms, trace, priority="interactive",
+               meta=None):
         if priority not in PRIORITIES:
             raise MXNetError("submit: unknown priority %r (expected one "
                              "of %s)" % (priority, "|".join(PRIORITIES)))
@@ -288,7 +296,7 @@ class MicroBatcher:
         now = self._clock()
         deadline = None if deadline_ms is None else now + deadline_ms / 1e3
         req = _Request(inputs, n, bucket_key, deadline, now, trace,
-                       priority)
+                       priority, meta)
         evicted, shed_reason = (), None
         with self._cond:
             if self._crashed:
@@ -645,14 +653,14 @@ class MicroBatcher:
                 self._controller.observe(
                     r.bucket_key, bd,
                     hit=r.deadline is None or done <= r.deadline,
-                    now=done, n=r.n)
+                    now=done, n=r.n, meta=r.meta)
             r.future._event.set()
             telemetry.observe("serving.latency_s", done - r.t_enq)
 
     def _expire(self, req):
         telemetry.inc("serving.deadline_expired")
         if self._controller is not None:
-            self._controller.note_expired(self._clock())
+            self._controller.note_expired(self._clock(), meta=req.meta)
         self._fail(req, DeadlineExceeded(
             "deadline passed before dispatch (queued %.1f ms)"
             % ((self._clock() - req.t_enq) * 1e3)))

@@ -177,6 +177,7 @@ class ServingController:
         self._hits = 0.0           # decayed SLO attainment counters
         self._misses = 0.0
         self._sheds = 0.0          # decayed shed-event counter
+        self._tenants = {}         # tenant -> [hits, misses], same decay
         self._att_t = None         # last decay timestamp
         self._last_scale = None    # clock of the last scale action
         self._last_activity = None  # last delivery/shed/non-empty queue
@@ -193,13 +194,19 @@ class ServingController:
             self._hits *= f
             self._misses *= f
             self._sheds *= f
+            for hm in self._tenants.values():
+                hm[0] *= f
+                hm[1] *= f
         self._att_t = now
 
-    def observe(self, bucket_key, breakdown, hit, now, n=1):
+    def observe(self, bucket_key, breakdown, hit, now, n=1, meta=None):
         """One delivered (or expired) request's verdict: feed the
         per-bucket latency model from its stage breakdown, the empirical
         drain-rate window, and the decayed SLO-attainment counters.
-        Called by the batcher on delivery."""
+        Called by the batcher on delivery. ``meta`` (the request's
+        attribution dict, stamped by the zoo) routes the verdict into
+        the per-tenant goodput counters too
+        (``serving.tenant_attainment{tenant}``)."""
         total = sum(breakdown.get(k, 0.0) for k in
                     ("serving.queue_wait", "serving.pad", "serving.predict"))
         service = sum(breakdown.get(k, 0.0) for k in
@@ -220,14 +227,28 @@ class ServingController:
                 self._hits += 1.0
             else:
                 self._misses += 1.0
+            self._tenant_verdict_locked(meta, hit)
             self._last_activity = now
 
-    def note_expired(self, now):
+    def _tenant_verdict_locked(self, meta, hit):
+        tenant = (meta or {}).get("tenant")
+        if tenant is None:
+            return
+        hm = self._tenants.get(tenant)
+        if hm is None:
+            hm = self._tenants[tenant] = [0.0, 0.0]
+        hm[0 if hit else 1] += 1.0
+        telemetry.gauge("serving.tenant_attainment",
+                        hm[0] / (hm[0] + hm[1]), tag=tenant)
+
+    def note_expired(self, now, meta=None):
         """A queued request's deadline passed before dispatch — an SLO
-        miss the attainment signal must see."""
+        miss the attainment signal (and the request's tenant) must
+        see."""
         with self._lock:
             self._decay_locked(now)
             self._misses += 1.0
+            self._tenant_verdict_locked(meta, False)
             self._last_activity = now
 
     def note_shed(self, reason, now):
@@ -237,6 +258,30 @@ class ServingController:
             self._decay_locked(now)
             self._sheds += 1.0
             self._last_activity = now
+
+    def attainment(self, now=None):
+        """``(attainment, weight)``: the decayed SLO goodput fraction and
+        the decayed verdict count backing it (attainment is None below
+        one verdict of weight). The zoo's canary auto-rollback gate reads
+        this off the canary arm's controller."""
+        if now is None:
+            now = self._disp._clock()
+        with self._lock:
+            self._decay_locked(now)
+            weight = self._hits + self._misses
+            att = self._hits / weight if weight >= 1.0 else None
+        return att, weight
+
+    def tenant_attainment(self, now=None):
+        """Per-tenant decayed goodput attainment ({tenant: fraction}) —
+        the /healthz per-tenant SLO view."""
+        if now is None:
+            now = self._disp._clock()
+        with self._lock:
+            self._decay_locked(now)
+            return {t: round(hm[0] / (hm[0] + hm[1]), 4)
+                    for t, hm in self._tenants.items()
+                    if hm[0] + hm[1] >= 1.0}
 
     # -------------------------------------------------------------- admission
     def predicted_s(self, bucket_key, queued_ahead_items=0, now=None):
@@ -505,6 +550,10 @@ class ServingController:
                 "max_replicas": self.max_replicas,
                 "queue_depths": depths,
                 "slo_attainment": round(att, 4) if att is not None else None,
+                "tenant_attainment": {
+                    t: round(hm[0] / (hm[0] + hm[1]), 4)
+                    for t, hm in self._tenants.items()
+                    if hm[0] + hm[1] >= 1.0},
                 "recent_sheds": round(self._sheds, 2),
                 "estimated_drain_s": round(drain, 4),
                 "last_decision": dict(self.last_decision)

@@ -312,7 +312,7 @@ class Predictor:
 
     def __init__(self, block, spec, example=None, warmup=False,
                  name="predictor", device=None, site="serving.predict",
-                 int8=None):
+                 int8=None, co_resident=None):
         if not hasattr(block, "_forward_eager"):
             raise MXNetError(
                 "Predictor serves HybridBlock-family models (got %s); wrap "
@@ -330,6 +330,13 @@ class Predictor:
         self._device = device
         self._site = site
         self._int8 = serve_int8_default() if int8 is None else bool(int8)
+        # zero-arg callable returning bytes ALREADY resident on this
+        # device beyond this predictor's own footprint (the zoo passes
+        # its co-resident models' ledger totals) — the warmup preflight
+        # judges will-it-fit against limit minus this, so overcommit
+        # warns BEFORE a page-in OOMs, not after
+        self._co_resident = co_resident
+        self.param_version = None  # zoo version audit (refresh_params)
         self._params = None        # ordered list, fixed at first build
         self._param_datas = None
         self._param_ranges = None  # per-param int8 range r (None = not quant)
@@ -463,11 +470,17 @@ class Predictor:
         """True when this predictor stores weights as int8 + scale."""
         return self._int8
 
-    def refresh_params(self):
+    def refresh_params(self, version=None):
         """Re-snapshot parameter buffers (after an in-place reload) without
         recompiling — the jits close over nothing, params (and their int8
-        ranges) are arguments."""
+        ranges) are arguments. ``version=`` stamps the live param version
+        for audit (``zoo.active_version{model}`` is gauged by the zoo;
+        here the refresh itself is counted per site so a param swap is
+        attributable from ``telemetry.report()`` alone)."""
         self._snapshot_params()
+        if version is not None:
+            self.param_version = version
+        telemetry.inc("serving.param_refreshes", tag=self._site)
 
     # ------------------------------------------------------------ compiling
     def _donation(self):
@@ -629,9 +642,10 @@ class Predictor:
         # lowering on the CPU tier) + the live HBM gauges
         from .. import xprof
         xprof.ensure_memwatch()
+        extra = int(self._co_resident()) if self._co_resident else 0
         xprof.preflight(self._site,
                         device=self._device if self._device is not None
-                        else 0)
+                        else 0, extra_bytes=extra)
         return self
 
     def warmup(self):

@@ -585,7 +585,8 @@ class ReplicaDispatcher(MicroBatcher):
         with self._cond:
             self._cond.notify_all()
 
-    def submit(self, inputs, deadline_ms=None, priority="interactive"):
+    def submit(self, inputs, deadline_ms=None, priority="interactive",
+               meta=None):
         if self._set.healthy_count() == 0:
             # give a due half-open probe the chance to restore a replica
             # before refusing (the all-down shed must not outlive the
@@ -599,7 +600,7 @@ class ReplicaDispatcher(MicroBatcher):
             # grow time-to-first-token on a replica with no cache room
             self._shed("kv_residency")
         return super().submit(inputs, deadline_ms=deadline_ms,
-                              priority=priority)
+                              priority=priority, meta=meta)
 
     # ---------------------------------------------------------- elasticity
     def add_replica(self, device=None):

@@ -72,7 +72,13 @@ class ModelServer:
 
     def __init__(self, batcher, host="127.0.0.1", port=0,
                  request_timeout_s=30.0):
-        if isinstance(batcher, ReplicaSet):
+        from .zoo import ZooScheduler
+        self._zoo = None
+        if isinstance(batcher, ZooScheduler):
+            # multi-model front: requests route by the body's "model"
+            # field through the zoo's placement/canary machinery
+            self._zoo = batcher
+        elif isinstance(batcher, ReplicaSet):
             batcher = ReplicaDispatcher(batcher)
         elif not isinstance(batcher, MicroBatcher):
             batcher = MicroBatcher(batcher)
@@ -197,8 +203,29 @@ class ModelServer:
             raw = [body.get("data")]
         if not raw or raw[0] is None:
             return 400, {"error": "missing 'data' (or 'inputs') field"}, None
-        priority = body.get("priority", "interactive")
-        templates = getattr(self._batcher._pred, "input_templates", None)
+        model = version = None
+        if self._zoo is not None:
+            # multi-model routing: the body names the model (404 with
+            # the registry's known names — a typo'd model must read as
+            # "no such model", never as a server fault) and optionally
+            # pins a version (404 with that model's known versions)
+            reg = self._zoo.registry
+            model = body.get("model")
+            if not model:
+                return 400, {"error": "missing 'model' field",
+                             "known_models": reg.models()}, None
+            if model not in reg.models():
+                return 404, {"error": "unknown model %r" % model,
+                             "known_models": reg.models()}, None
+            version = body.get("version")
+            if version is not None and version not in reg.versions(model):
+                return 404, {"error": "unknown version %r of model %r"
+                             % (version, model),
+                             "known_versions": reg.versions(model)}, None
+            templates = self._zoo.input_templates(model)
+        else:
+            templates = getattr(self._batcher._pred, "input_templates",
+                                None)
         arrays = []
         for i, a in enumerate(raw):
             dtype = None
@@ -216,9 +243,17 @@ class ModelServer:
             # nobody is waiting for — exactly under the overload that made
             # it time out
             deadline_ms = body.get("deadline_ms", self._timeout * 1e3)
-            fut = self._batcher.submit(tuple(arrays),
+            if self._zoo is not None:
+                fut = self._zoo.submit(model, tuple(arrays),
+                                       tenant=body.get("tenant"),
                                        deadline_ms=deadline_ms,
-                                       priority=priority)
+                                       priority=body.get("priority"),
+                                       version=version)
+            else:
+                fut = self._batcher.submit(tuple(arrays),
+                                           deadline_ms=deadline_ms,
+                                           priority=body.get(
+                                               "priority", "interactive"))
             out = fut.result(timeout=self._timeout)
         except QueueFull as e:
             # the shed path tells the client when to retry: the
@@ -293,6 +328,12 @@ def _make_handler(srv):
                     # KV residency per replica pool: the signal a fleet
                     # dispatcher routes/sheds on (docs/serving.md decode)
                     payload["kv"] = acct.snapshot()
+                if srv._zoo is not None:
+                    # the zoo block: per-model residency, live versions,
+                    # canary state, per-tenant attainment — the
+                    # operator's one-look answer to "what is resident
+                    # where, and how is each tenant doing"
+                    payload["zoo"] = srv._zoo.view()
                 ctrl = getattr(srv._batcher, "_controller", None)
                 if ctrl is not None:
                     # the control-plane view: replica target vs actual,

@@ -1,0 +1,1216 @@
+"""Multi-tenant model zoo: one serving plane for N models over one pool.
+
+Every serving construct so far (Predictor, ReplicaSet, controller,
+decode engine) assumes ONE model per process; production means a *zoo*:
+many registered models, a few of them hot, multiplexed over fewer
+devices than one-model-per-replica would need. This module is the
+PAPER.md dependency-engine lesson (schedule from *observed* demand, not
+static assignment) applied at fleet granularity, with **HBM as the
+shared currency** and the steady state kept pure replay (PyGraph's
+capture/replay economics, arXiv:2503.19779) — a model swap must never
+compile on the hot path.
+
+* :class:`ModelZoo` — the registry: named models × immutable versions.
+  Each version is a checkpoint ref OR a host-side parameter snapshot,
+  plus the model's :class:`~mxtpu.serving.engine.BucketSpec` and the
+  ``ops.registry.policy_key()`` snapshot it was registered under; the
+  manifest (``zoo_manifest.json``) persists beside the compile-cache
+  artifacts so a warm-started process can enumerate what is servable
+  without touching a device.
+* :class:`ZooScheduler` — multiplexes the registry over a device pool.
+  Per-model resident cost comes from the xprof ledger
+  (:func:`mxtpu.xprof.site_footprint`: donation-adjusted params +
+  executables args, per-dispatch temps, output residents); demand from
+  a decayed per-model request rate. Placement evicts the coldest
+  resident (``zoo.evictions{model:reason}``; its queued + in-flight
+  futures complete FIRST — eviction never strands a request) and pages
+  the hot model in as a **disk-warm no-compile event** through
+  ``compile_service.warmup`` (every bucket resolves as a disk hit, so
+  ``retrace.serving.predict*`` stays 0 on a warm page-in). A request
+  for a non-resident model either queues behind a bounded page-in
+  (``MXTPU_ZOO_PAGEIN_QUEUE``) or sheds ``zoo_cold`` by policy.
+* **Per-tenant SLO classes** — a tenant maps to a priority class +
+  deadline default (the existing MicroBatcher priority machinery does
+  the rest: interactive wins the coalescing slot, batch ages in and is
+  evicted first), and every delivery's deadline verdict feeds the
+  per-model :class:`~mxtpu.serving.controller.ServingController`'s
+  per-tenant goodput-attainment counters
+  (``serving.tenant_attainment{tenant}``).
+* **Live rollout** — :meth:`ModelZoo.deploy` generalizes PR 11's
+  ``refresh_params`` to versioned canary routing: ``canary_frac`` of a
+  model's traffic routes to the new weights by a deterministic hash of
+  the request id (stable across processes — a retried request lands on
+  the same arm). The canary serves through its OWN executables at
+  ``<site>.canary`` (disk-warm where possible; its compiles are pinned
+  ≤ #buckets at its own watchdog site), while **promote** swaps the new
+  version's params into the stable Predictor via the no-recompile
+  ``refresh_params`` path — the int8 quantization-eligibility pin
+  (PR 11 stickiness) is re-asserted across the versioned swap by
+  construction. Auto-**rollback** fires when the canary's SLO
+  attainment drops under ``MXTPU_ZOO_CANARY_FLOOR`` with enough
+  verdicts in the window, or when the deploy-time output-parity probe
+  regresses past ``MXTPU_ZOO_PARITY_TOL`` (``zoo.rollbacks{reason}`` +
+  ``flight_record("canary_rollback")``). Zero requests drop across
+  promote/rollback: the retiring arm's queued + in-flight futures
+  complete before its executables are released.
+
+Deterministic fault kinds (``MXTPU_FAULT_INJECT``): ``zoo_cold`` — the
+next zoo submit sheds as if its model were cold and unpageable;
+``canary_rollback`` — the next canary gate evaluation rules regression.
+
+Everything runs on an injected clock; with ``start=False`` the whole
+placement/canary matrix is driven sleep-free through :meth:`poll`
+(tier-1 tests), with ``start=True`` each resident model gets its
+batcher worker and the zoo a monitor thread (the bench/server mode).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import math
+import os
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from .. import compile_service as csvc
+from .. import telemetry, xprof
+from ..base import MXNetError
+from ..resilience import inject
+from .batcher import DeadlineExceeded, MicroBatcher, QueueFull
+from .controller import ServingController
+from .engine import Predictor
+
+__all__ = ["ModelZoo", "ZooScheduler", "ZooVersion",
+           "zoo_max_resident_default", "zoo_hbm_budget_default",
+           "zoo_cold_policy_default", "zoo_pagein_queue_default",
+           "zoo_demand_horizon_default", "zoo_canary_floor_default",
+           "zoo_canary_window_default", "zoo_parity_tol_default"]
+
+_log = logging.getLogger("mxtpu.serving")
+
+# the retrace-site family every zoo predictor reports under: page-ins
+# are gated on this family staying compile-free off a warm disk cache
+_SITE_ROOT = "serving.predict.zoo"
+
+
+# ------------------------------------------------------------------ policies
+def zoo_max_resident_default():
+    """Count cap on co-resident models per pool device
+    (``MXTPU_ZOO_MAX_RESIDENT``, default 0 = uncapped by count — the
+    byte budget governs). The lever for backends without memory stats
+    (CPU tier) and for tests forcing paging pressure."""
+    return int(os.environ.get("MXTPU_ZOO_MAX_RESIDENT", "0"))
+
+
+def zoo_hbm_budget_default():
+    """Per-device HBM budget in bytes for zoo placement
+    (``MXTPU_ZOO_HBM_BUDGET``, default 0 = the device's reported
+    ``bytes_limit``). Placement evicts the coldest resident before a
+    page-in would push the ledger-derived resident total past it."""
+    return int(os.environ.get("MXTPU_ZOO_HBM_BUDGET", "0"))
+
+
+def zoo_cold_policy_default():
+    """What a request for a non-resident model does
+    (``MXTPU_ZOO_COLD_POLICY``: ``queue`` (default) = wait behind a
+    bounded page-in; ``shed`` = refuse immediately with
+    ``serving.shed{zoo_cold}``)."""
+    v = os.environ.get("MXTPU_ZOO_COLD_POLICY", "queue").strip().lower()
+    if v not in ("queue", "shed"):
+        raise MXNetError("MXTPU_ZOO_COLD_POLICY must be queue|shed, got %r"
+                         % v)
+    return v
+
+
+def zoo_pagein_queue_default():
+    """Bound (in requests) on the per-model queue waiting behind a
+    page-in (``MXTPU_ZOO_PAGEIN_QUEUE``, default 64): beyond it cold
+    submits shed ``zoo_cold`` even under the ``queue`` policy."""
+    return int(os.environ.get("MXTPU_ZOO_PAGEIN_QUEUE", "64"))
+
+
+def zoo_demand_horizon_default():
+    """Decay horizon (seconds) of the per-model demand rates placement
+    ranks by (``MXTPU_ZOO_DEMAND_HORIZON_S``, default 60)."""
+    return float(os.environ.get("MXTPU_ZOO_DEMAND_HORIZON_S", "60"))
+
+
+def zoo_canary_floor_default():
+    """Canary SLO-attainment gate (``MXTPU_ZOO_CANARY_FLOOR``, default
+    0.8): a canary whose decayed goodput attainment drops below this
+    (with a full verdict window) is auto-rolled-back."""
+    return float(os.environ.get("MXTPU_ZOO_CANARY_FLOOR", "0.8"))
+
+
+def zoo_canary_window_default():
+    """Minimum decayed verdict weight before the canary gate judges
+    (``MXTPU_ZOO_CANARY_WINDOW``, default 8) — a canary is never rolled
+    back on its first unlucky request."""
+    return float(os.environ.get("MXTPU_ZOO_CANARY_WINDOW", "8"))
+
+
+def zoo_parity_tol_default():
+    """Output-parity probe tolerance (``MXTPU_ZOO_PARITY_TOL``, default
+    1e-2): max absolute element difference between the stable and canary
+    outputs on the deploy's probe input before the deploy is refused as
+    a parity regression (immediate rollback)."""
+    return float(os.environ.get("MXTPU_ZOO_PARITY_TOL", "1e-2"))
+
+
+class _DecayedRate:
+    """Exponentially-decayed event rate on the injected clock — the
+    per-model demand signal placement ranks by."""
+
+    __slots__ = ("v", "t", "horizon")
+
+    def __init__(self, horizon_s):
+        self.v = 0.0
+        self.t = None
+        self.horizon = float(horizon_s)
+
+    def _decay(self, now):
+        if self.t is not None and now > self.t:
+            self.v *= math.exp(-(now - self.t) / self.horizon)
+        self.t = now
+
+    def observe(self, n, now):
+        self._decay(now)
+        self.v += float(n)
+
+    def rate(self, now):
+        self._decay(now)
+        return self.v / self.horizon
+
+
+# ------------------------------------------------------------------ registry
+class ZooVersion:
+    """One immutable version of a zoo model: a parameter source (host
+    snapshot or checkpoint ref), the BucketSpec it serves under, and the
+    policy snapshot it was registered with. ``ordinal`` is the
+    registration sequence number — what ``zoo.active_version{model}``
+    gauges (telemetry gauges are numeric; the manifest maps ordinals
+    back to names)."""
+
+    __slots__ = ("model", "version", "spec", "policy", "checkpoint",
+                 "params", "created", "ordinal")
+
+    def __init__(self, model, version, spec, policy, ordinal,
+                 params=None, checkpoint=None):
+        self.model = model
+        self.version = version
+        self.spec = spec
+        self.policy = tuple(policy) if policy is not None else ()
+        self.checkpoint = checkpoint
+        self.params = params          # {param name: host ndarray} or None
+        self.created = time.time()
+        self.ordinal = int(ordinal)
+
+    def describe(self):
+        return {"version": self.version, "ordinal": self.ordinal,
+                "created": self.created,
+                "checkpoint": self.checkpoint,
+                "policy": list(self.policy),
+                "spec": repr(self.spec),
+                "params": sorted(self.params) if self.params else None}
+
+
+class _ZooModel:
+    __slots__ = ("name", "block", "spec", "example", "versions", "active",
+                 "next_ordinal")
+
+    def __init__(self, name, block, spec, example):
+        self.name = name
+        self.block = block
+        self.spec = spec
+        self.example = example
+        self.versions = collections.OrderedDict()
+        self.active = None
+        self.next_ordinal = 0
+
+
+def _snapshot_block_params(block):
+    """Host-side copy of every parameter buffer — the immutable params
+    a version stores (versions must not alias the live mutable block)."""
+    out = {}
+    for name, p in block.collect_params().items():
+        out[name] = np.array(p.data().asnumpy(), copy=True)
+    return out
+
+
+class ModelZoo:
+    """The registry half: named models × immutable versions, manifest
+    persisted beside the compile-cache artifacts. Placement/serving is
+    :class:`ZooScheduler`'s job; :meth:`deploy` delegates to the
+    attached scheduler (and degrades to a registry-only active-version
+    flip when none is attached)."""
+
+    def __init__(self, manifest_dir=None):
+        self._models = collections.OrderedDict()
+        self._lock = threading.RLock()
+        self._manifest_dir = manifest_dir
+        self._sched = None
+
+    # ------------------------------------------------------------ registration
+    def register(self, name, block, spec, example=None, version="v1",
+                 checkpoint=None):
+        """Register a model under ``name`` with its first version (the
+        block's CURRENT parameters unless ``checkpoint`` names an
+        external ref). Model names join retrace-site/metric families, so
+        they are restricted to ``[A-Za-z0-9_-]``."""
+        if not name or not all(c.isalnum() or c in "_-" for c in name):
+            raise MXNetError("ModelZoo.register: model name %r must be "
+                             "non-empty [A-Za-z0-9_-]" % (name,))
+        with self._lock:
+            if name in self._models:
+                raise MXNetError("ModelZoo.register: model %r already "
+                                 "registered — use add_version" % name)
+            self._models[name] = _ZooModel(name, block, spec, example)
+        self.add_version(name, version, checkpoint=checkpoint)
+        return self._models[name]
+
+    def add_version(self, name, version, params=None, checkpoint=None):
+        """Add one immutable version: ``params`` (a ``{name: array}``
+        host snapshot), a ``checkpoint`` ref (loaded lazily on first
+        apply), or — with neither — a snapshot of the block's current
+        parameters. The first version becomes active."""
+        m = self._get(name)
+        with self._lock:
+            if version in m.versions:
+                raise MXNetError(
+                    "ModelZoo.add_version: %s@%s already exists — "
+                    "versions are immutable" % (name, version))
+            if params is None and checkpoint is None:
+                params = _snapshot_block_params(m.block)
+            from ..ops.registry import policy_key
+            ver = ZooVersion(name, version, m.spec, policy_key(),
+                             m.next_ordinal, params=params,
+                             checkpoint=checkpoint)
+            m.next_ordinal += 1
+            m.versions[version] = ver
+            if m.active is None:
+                m.active = version
+        self._persist_manifest()
+        return ver
+
+    def _get(self, name):
+        with self._lock:
+            m = self._models.get(name)
+        if m is None:
+            raise MXNetError("ModelZoo: unknown model %r (known: %s)"
+                             % (name, ", ".join(self.models()) or "none"))
+        return m
+
+    def models(self):
+        with self._lock:
+            return list(self._models)
+
+    def versions(self, name):
+        return list(self._get(name).versions)
+
+    def active_version(self, name):
+        return self._get(name).active
+
+    def version(self, name, version):
+        m = self._get(name)
+        with self._lock:
+            ver = m.versions.get(version)
+        if ver is None:
+            raise MXNetError(
+                "ModelZoo: unknown version %r for model %r (known: %s)"
+                % (version, name, ", ".join(m.versions)))
+        return ver
+
+    def set_active(self, name, version):
+        ver = self.version(name, version)
+        with self._lock:
+            self._get(name).active = version
+        self._persist_manifest()
+        return ver
+
+    # -------------------------------------------------------------- params
+    def apply_version(self, name, version):
+        """Load a version's parameters into the model's (shared) block —
+        the step right before a Predictor build or ``refresh_params``
+        snapshots them. Checkpoint-ref versions load (and cache) their
+        params here, on first use."""
+        m = self._get(name)
+        ver = self.version(name, version)
+        with self._lock:
+            if ver.params is None:
+                ver.params = self._load_checkpoint_params(ver)
+            pd = m.block.collect_params()
+            for pname, arr in ver.params.items():
+                if pname in pd:
+                    pd[pname].set_data(arr)
+        return ver
+
+    @staticmethod
+    def _load_checkpoint_params(ver):
+        """Resolve a checkpoint-ref version to a host param mapping
+        (``model.save_checkpoint`` naming: ``(prefix, epoch)``)."""
+        from ..model import load_checkpoint
+        prefix, epoch = ver.checkpoint
+        _sym, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        out = {}
+        for pname, arr in list(arg_params.items()) + list(aux_params.items()):
+            out[pname] = np.array(arr.asnumpy() if hasattr(arr, "asnumpy")
+                                  else arr, copy=True)
+        return out
+
+    # ------------------------------------------------------------- manifest
+    def _manifest_path(self):
+        root = self._manifest_dir or csvc.cache_dir()
+        if not root:
+            return None
+        return os.path.join(root, "zoo_manifest.json")
+
+    def _persist_manifest(self):
+        """Best-effort manifest write beside the compile-cache blobs —
+        the human/warm-start index of what is servable (per-entry
+        executables stay authoritative, exactly like the compile
+        service's own ``manifest.json``)."""
+        path = self._manifest_path()
+        if path is None:
+            return
+        with self._lock:
+            doc = {"format": 1, "models": {
+                m.name: {"active": m.active,
+                         "spec": repr(m.spec),
+                         "versions": {v: ver.describe()
+                                      for v, ver in m.versions.items()}}
+                for m in self._models.values()}}
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=1, default=repr)
+            os.replace(tmp, path)
+        except OSError:  # advisory index only
+            _log.debug("zoo manifest write failed", exc_info=True)
+
+    def manifest(self):
+        """The persisted manifest dict ({} when absent/unwritable)."""
+        path = self._manifest_path()
+        if path is None:
+            return {}
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    # --------------------------------------------------------------- rollout
+    def attach_scheduler(self, sched):
+        self._sched = sched
+        return self
+
+    def deploy(self, model, version, canary_frac=1.0, parity_example=None,
+               parity_tol=None):
+        """Roll ``version`` out for ``model``: ``canary_frac >= 1`` is a
+        direct promote (the resident Predictor adopts the new params via
+        the no-recompile ``refresh_params`` path); ``0 < canary_frac <
+        1`` starts a canary arm taking that fraction of traffic behind
+        the auto-rollback gate. Returns a status dict."""
+        if self._sched is not None:
+            return self._sched.deploy(model, version,
+                                      canary_frac=canary_frac,
+                                      parity_example=parity_example,
+                                      parity_tol=parity_tol)
+        ver = self.set_active(model, version)
+        telemetry.inc("zoo.deploys", tag=model)
+        return {"model": model, "version": version, "mode": "registry",
+                "ordinal": ver.ordinal}
+
+
+# ----------------------------------------------------------------- scheduler
+class _ZooFuture:
+    """Completion handle for a request that queued behind a page-in: it
+    BINDS to the real batcher future once the model is resident (or
+    fails with the shed/deadline verdict). ``result`` therefore waits
+    at most page-in + service; trace fields proxy through after bind."""
+
+    __slots__ = ("_event", "_inner", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._inner = None
+        self._error = None
+
+    def _bind(self, inner):
+        self._inner = inner
+        self._event.set()
+
+    def _fail(self, error):
+        self._error = error
+        self._event.set()
+
+    def done(self):
+        if not self._event.is_set():
+            return False
+        return self._error is not None or self._inner.done()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise DeadlineExceeded("no page-in within %ss" % timeout)
+        if self._error is not None:
+            raise self._error
+        return self._inner.result(timeout)
+
+    @property
+    def trace_id(self):
+        return self._inner.trace_id if self._inner is not None else None
+
+    @property
+    def breakdown(self):
+        return self._inner.breakdown if self._inner is not None else None
+
+    @property
+    def e2e_s(self):
+        return self._inner.e2e_s if self._inner is not None else None
+
+
+class _Pending:
+    __slots__ = ("inputs", "n", "deadline_ms", "priority", "meta", "t0",
+                 "future")
+
+    def __init__(self, inputs, n, deadline_ms, priority, meta, t0):
+        self.inputs = inputs
+        self.n = n
+        self.deadline_ms = deadline_ms
+        self.priority = priority
+        self.meta = meta
+        self.t0 = t0
+        self.future = _ZooFuture()
+
+
+class _Arm:
+    """One serving arm of a resident model (stable or canary): a warmed
+    Predictor + its MicroBatcher + per-arm SLO controller."""
+
+    __slots__ = ("version", "predictor", "batcher", "ctrl", "site")
+
+    def __init__(self, version, predictor, batcher, ctrl):
+        self.version = version
+        self.predictor = predictor
+        self.batcher = batcher
+        self.ctrl = ctrl
+        self.site = predictor.site
+
+
+class _Resident:
+    __slots__ = ("model", "dslot", "device", "stable", "canary",
+                 "canary_frac", "footprint", "warm_summary")
+
+    def __init__(self, model, dslot, device, stable, warm_summary):
+        self.model = model
+        self.dslot = dslot
+        self.device = device
+        self.stable = stable
+        self.canary = None
+        self.canary_frac = 0.0
+        self.footprint = 0
+        self.warm_summary = warm_summary
+
+
+class ZooScheduler:
+    """See the module docstring. ``zoo`` is the :class:`ModelZoo`;
+    ``devices`` the pool (default: every visible device). ``start=False``
+    + an injected ``clock`` keeps everything synchronous for tests
+    (:meth:`poll` drives dispatch, page-ins run inline at submit);
+    ``start=True`` starts per-model batcher workers, runs page-ins on
+    side threads, and spins the monitor that evaluates the canary
+    gate."""
+
+    def __init__(self, zoo, devices=None, clock=time.monotonic, start=True,
+                 max_resident=None, hbm_budget=None, cold_policy=None,
+                 pagein_queue=None, demand_horizon_s=None, tenants=None,
+                 controller=True, batcher_kw=None):
+        import jax
+        self._zoo = zoo
+        self._devices = list(devices) if devices else list(jax.devices())
+        if not self._devices:
+            raise MXNetError("ZooScheduler: empty device pool")
+        self._clock = clock
+        self._threaded = bool(start)
+        self.max_resident = int(max_resident if max_resident is not None
+                                else zoo_max_resident_default())
+        self.hbm_budget = int(hbm_budget if hbm_budget is not None
+                              else zoo_hbm_budget_default())
+        self.cold_policy = (cold_policy if cold_policy is not None
+                            else zoo_cold_policy_default())
+        if self.cold_policy not in ("queue", "shed"):
+            raise MXNetError("ZooScheduler: cold_policy must be "
+                             "queue|shed, got %r" % (self.cold_policy,))
+        self.pagein_queue = int(pagein_queue if pagein_queue is not None
+                                else zoo_pagein_queue_default())
+        self._horizon = float(demand_horizon_s if demand_horizon_s
+                              is not None else zoo_demand_horizon_default())
+        self._use_controller = bool(controller)
+        self._batcher_kw = dict(batcher_kw or {})
+        self._lock = threading.RLock()
+        self._residents = {}        # model -> _Resident
+        self._pending = {}          # model -> deque[_Pending]
+        self._paging = set()        # models with a page-in in flight
+        self._footprints = {}       # model -> last measured resident bytes
+        self._demand = {}           # model -> _DecayedRate
+        self._tenants = {}          # tenant -> {"priority","deadline_ms"}
+        for t, cls in (tenants or {}).items():
+            self.set_tenant(t, **cls)
+        self._rid = 0
+        self._draining = False
+        self._closed = False
+        self._monitor = None
+        self._stop = threading.Event()
+        zoo.attach_scheduler(self)
+        telemetry.gauge("zoo.resident_models", 0)
+        if self._threaded:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, daemon=True,
+                name="mxtpu-zoo-monitor")
+            self._monitor.start()
+
+    @property
+    def registry(self):
+        """The :class:`ModelZoo` this scheduler serves."""
+        return self._zoo
+
+    # --------------------------------------------------------------- tenants
+    def set_tenant(self, tenant, priority="interactive", deadline_ms=None):
+        """Declare one tenant's SLO class: its default priority class and
+        deadline. Unknown tenants serve as ``interactive`` with no
+        deadline default."""
+        from .batcher import PRIORITIES
+        if priority not in PRIORITIES:
+            raise MXNetError("set_tenant: unknown priority %r (expected "
+                             "one of %s)" % (priority, "|".join(PRIORITIES)))
+        with self._lock:
+            self._tenants[tenant] = {"priority": priority,
+                                     "deadline_ms": deadline_ms}
+        return self
+
+    def tenant_class(self, tenant):
+        with self._lock:
+            return dict(self._tenants.get(tenant)
+                        or {"priority": "interactive", "deadline_ms": None})
+
+    # ------------------------------------------------------------ submission
+    def submit(self, model, inputs, tenant=None, deadline_ms=None,
+               priority=None, request_id=None, version=None):
+        """Route one request by model name. Tenant defaults fill the
+        priority/deadline the caller left unset; ``version=`` pins the
+        request to a specific live arm (stable or canary) instead of the
+        hash route; ``request_id`` feeds the deterministic canary hash
+        (one is assigned when absent). Returns a future."""
+        m = self._zoo._get(model)  # unknown model refuses loudly
+        cls = self.tenant_class(tenant)
+        if priority is None:
+            priority = cls["priority"]
+        if deadline_ms is None:
+            deadline_ms = cls["deadline_ms"]
+        meta = {"model": model, "tenant": tenant or "default"}
+        now = self._clock()
+        with self._lock:
+            rate = self._demand.get(model)
+            if rate is None:
+                rate = self._demand[model] = _DecayedRate(self._horizon)
+            rate.observe(1, now)
+            if request_id is None:
+                self._rid += 1
+                request_id = self._rid
+            if self._draining or self._closed:
+                self._shed("draining", model)
+            if inject("zoo_cold"):
+                # deterministic cold-path fault: this submit behaves as
+                # if its model were non-resident and unpageable
+                self._shed("zoo_cold", model)
+            res = self._residents.get(model)
+        if res is None:
+            if version is not None:
+                self._zoo.version(model, version)  # unknown refuses loudly
+                if version != m.active:
+                    raise MXNetError(
+                        "ModelZoo: version %r of model %r is not live (a "
+                        "page-in would serve the active version %r)"
+                        % (version, model, m.active))
+            return self._cold_submit(m, model, inputs, deadline_ms,
+                                     priority, meta, now)
+        arm = self._pick_arm(res, version, request_id)
+        meta["version"] = arm.version
+        return arm.batcher.submit(inputs, deadline_ms=deadline_ms,
+                                  priority=priority, meta=meta)
+
+    def _shed(self, reason, model):
+        telemetry.inc("serving.shed", tag=reason)
+        raise QueueFull("request shed: %s (model %r)" % (reason, model))
+
+    def _pick_arm(self, res, version, request_id):
+        """Stable vs canary: an explicit ``version=`` pins (refusing
+        versions that are not live on an arm); otherwise the
+        deterministic request-id hash sends ``canary_frac`` of traffic
+        to the canary."""
+        canary = res.canary
+        if version is not None:
+            if version == res.stable.version:
+                return res.stable
+            if canary is not None and version == canary.version:
+                return canary
+            live = [res.stable.version] + (
+                [canary.version] if canary is not None else [])
+            raise MXNetError(
+                "ModelZoo: version %r of model %r is not live (live: %s)"
+                % (version, res.model, ", ".join(live)))
+        if canary is None or res.canary_frac <= 0.0:
+            return res.stable
+        h = zlib.crc32(str(request_id).encode("utf-8")) % 10**6
+        return canary if h < res.canary_frac * 10**6 else res.stable
+
+    def _cold_submit(self, m, model, inputs, deadline_ms, priority, meta,
+                     now):
+        if self.cold_policy == "shed":
+            self._shed("zoo_cold", model)
+        if not isinstance(inputs, (tuple, list)):
+            inputs = (inputs,)
+        n = int(getattr(inputs[0], "shape", (1,))[0] or 1)
+        p = _Pending(inputs, n, deadline_ms, priority, meta, now)
+        with self._lock:
+            pend = self._pending.setdefault(model, collections.deque())
+            if len(pend) >= self.pagein_queue:
+                # the bounded page-in queue: a cold burst past the bound
+                # sheds instead of building unserviceable backlog
+                self._shed("zoo_cold", model)
+            pend.append(p)
+            start_pagein = model not in self._paging
+            if start_pagein:
+                self._paging.add(model)
+        if start_pagein and self._threaded:
+            threading.Thread(target=self._pagein_safe, args=(model,),
+                             daemon=True,
+                             name="mxtpu-zoo-pagein-%s" % model).start()
+        # sync mode: the page-in runs at the next poll() — cold submits
+        # accumulate in the bounded pending queue exactly like requests
+        # arriving during a threaded page-in
+        return p.future
+
+    # ------------------------------------------------------------- placement
+    def _site(self, model):
+        return "%s.%s" % (_SITE_ROOT, model)
+
+    def _dev_budget(self, dslot):
+        if self.hbm_budget > 0:
+            return self.hbm_budget
+        return xprof.device_memory(self._devices[dslot])["bytes_limit"]
+
+    def _slot_load_locked(self, dslot):
+        models = [r for r in self._residents.values() if r.dslot == dslot]
+        return len(models), sum(r.footprint for r in models)
+
+    def _fits_locked(self, dslot, est_bytes):
+        count, resident = self._slot_load_locked(dslot)
+        if self.max_resident > 0 and count >= self.max_resident:
+            return False
+        budget = self._dev_budget(dslot)
+        if budget and resident + est_bytes > budget:
+            return False
+        return True
+
+    def _coldest_locked(self, dslot, now, incoming):
+        """Lowest-demand resident on ``dslot`` — the eviction victim.
+        A model with a live canary is pinned: evicting it would tear
+        down the rollout mid-evaluation, so capacity pressure routes
+        around it."""
+        cands = [r for r in self._residents.values()
+                 if r.dslot == dslot and r.model != incoming
+                 and r.canary is None]
+        if not cands:
+            return None
+        def rate(r):
+            d = self._demand.get(r.model)
+            return d.rate(now) if d is not None else 0.0
+        return min(cands, key=lambda r: (rate(r), r.model))
+
+    def _place(self, model):
+        """Pick the pool slot for ``model``, evicting cold residents
+        until it fits (HBM-currency: ledger-derived resident bytes vs
+        the per-device budget, plus the count cap). When nothing CAN be
+        evicted the least-loaded slot is used anyway — the co-residency
+        preflight then warns ``memory.overcommit`` instead of this path
+        deadlocking a page-in."""
+        with self._lock:
+            prev = self._residents.get(model)
+            if prev is not None:
+                return prev.dslot
+            # a never-measured incoming model has no ledger footprint yet:
+            # remember past measurements, else assume it is comparably
+            # sized to the current residents (their mean) so the byte
+            # budget still exerts pressure on first page-in
+            est = self._footprints.get(model, 0)
+            if not est and self._residents:
+                est = sum(r.footprint for r in self._residents.values())
+                est //= len(self._residents)
+        while True:
+            now = self._clock()
+            with self._lock:
+                slots = sorted(range(len(self._devices)),
+                               key=lambda i: self._slot_load_locked(i))
+                dslot = slots[0]
+                if self._fits_locked(dslot, est):
+                    return dslot
+                victim = self._coldest_locked(dslot, now, model)
+            if victim is None:
+                return dslot
+            self._evict(victim.model, "capacity")
+
+    def co_resident_bytes(self, model, dslot):
+        """Ledger-derived resident footprint of every OTHER zoo model on
+        the same device — what the warmup preflight adds so
+        ``memory.overcommit`` warns BEFORE a page-in OOMs (satellite:
+        co-residency-aware preflight)."""
+        with self._lock:
+            return sum(r.footprint for r in self._residents.values()
+                       if r.dslot == dslot and r.model != model)
+
+    def _build_arm(self, model, version, dslot, site):
+        """Build + disk-warm one arm: apply the version's params to the
+        shared block, snapshot them into a fresh device-pinned Predictor,
+        and resolve every bucket through ``compile_service.warmup`` —
+        off a warm cache every entry is a disk hit and the arm's retrace
+        site stays at ZERO compiles (the page-in gate)."""
+        m = self._zoo._get(model)
+        ver = self._zoo.version(model, version)
+        from ..ops.registry import policy_key
+        if ver.policy and tuple(policy_key()) != ver.policy:
+            _log.warning(
+                "zoo: model %s@%s registered under policy %s but serving "
+                "under %s — executables will rebuild for the live policy",
+                model, version, list(ver.policy), list(policy_key()))
+        with self._lock:
+            self._zoo.apply_version(model, version)
+            pred = Predictor(
+                m.block, m.spec, example=m.example, warmup=False,
+                name="zoo:%s@%s" % (model, version),
+                device=self._devices[dslot], site=site,
+                co_resident=lambda: self.co_resident_bytes(model, dslot))
+        summary = csvc.warmup(pred.warmup_entries())
+        pred.finish_warmup()
+        pred.param_version = version
+        kw = dict(self._batcher_kw)
+        kw.setdefault("max_batch_size", m.spec.max_batch)
+        batcher = MicroBatcher(pred, clock=self._clock,
+                               start=self._threaded, **kw)
+        ctrl = None
+        if self._use_controller:
+            # plain-batcher controller: predictive admission + the
+            # (per-tenant) goodput-attainment counters the canary gate
+            # and placement read — there is no ReplicaSet to scale
+            ctrl = ServingController(batcher, min_replicas=1,
+                                     max_replicas=1)
+        return _Arm(version, pred, batcher, ctrl), summary
+
+    def _pagein_safe(self, model):
+        try:
+            self._pagein(model)
+        except Exception as e:  # noqa: BLE001 — pending futures must fail
+            _log.exception("zoo: page-in of %r failed", model)
+            with self._lock:
+                self._paging.discard(model)
+                pend = self._pending.pop(model, ())
+            err = MXNetError("zoo page-in of %r failed: %s: %s"
+                             % (model, type(e).__name__, e))
+            for p in pend:
+                p.future._fail(err)
+
+    def _pagein(self, model):
+        """The disk-warm no-compile residency event: place (evicting as
+        needed), build + warm the stable arm, record the ledger-derived
+        footprint, then flush the bounded pending queue into the fresh
+        batcher."""
+        t0 = time.perf_counter()
+        m = self._zoo._get(model)
+        dslot = self._place(model)
+        version = m.active
+        arm, summary = self._build_arm(model, version, dslot,
+                                       self._site(model))
+        res = _Resident(model, dslot, self._devices[dslot], arm, summary)
+        res.footprint = int(xprof.site_footprint(self._site(model),
+                                                 family=True))
+        with self._lock:
+            self._footprints[model] = res.footprint
+            self._residents[model] = res
+            self._paging.discard(model)
+            count = len(self._residents)
+        telemetry.inc("zoo.pageins", tag=model)
+        telemetry.observe("zoo.pagein_s", time.perf_counter() - t0)
+        telemetry.gauge("zoo.resident_models", count)
+        telemetry.gauge("zoo.hbm_resident_bytes", res.footprint, tag=model)
+        telemetry.gauge("zoo.active_version",
+                        self._zoo.version(model, version).ordinal,
+                        tag=model)
+        _log.info("zoo: paged in %s@%s on device %s (disk=%d built=%d, "
+                  "footprint=%.1f MiB)", model, version, res.device,
+                  summary.get("disk", 0), summary.get("built", 0),
+                  res.footprint / 2**20)
+        self._flush_pending(model, res)
+        return res
+
+    def _flush_pending(self, model, res):
+        with self._lock:
+            pend = self._pending.pop(model, None)
+        if not pend:
+            return
+        now = self._clock()
+        for p in pend:
+            telemetry.observe("zoo.pagein_wait_s", max(0.0, now - p.t0))
+            rem = None
+            if p.deadline_ms is not None:
+                rem = p.deadline_ms - (now - p.t0) * 1e3
+                if rem <= 0:
+                    # its deadline expired during the page-in: the same
+                    # verdict it would get queued (the attainment signal
+                    # sees the miss through the controller's expiry path)
+                    telemetry.inc("serving.deadline_expired")
+                    if res.stable.ctrl is not None:
+                        res.stable.ctrl.note_expired(now, meta=p.meta)
+                    p.future._fail(DeadlineExceeded(
+                        "deadline passed during page-in of %r" % model))
+                    continue
+            try:
+                inner = res.stable.batcher.submit(
+                    p.inputs, deadline_ms=rem, priority=p.priority,
+                    meta=p.meta)
+            except (QueueFull, MXNetError) as e:
+                p.future._fail(e)
+            else:
+                p.future._bind(inner)
+
+    def _evict(self, model, reason):
+        """Page a resident model out: its queued + in-flight futures
+        complete FIRST (drain discipline — eviction never strands a
+        request), then its params/executables are released
+        (``compile_service.drop`` over the model's site family covers
+        the canary arm too)."""
+        with self._lock:
+            res = self._residents.pop(model, None)
+            if res is None:
+                return 0
+            count = len(self._residents)
+        arms = [res.stable] + ([res.canary] if res.canary else [])
+        for arm in arms:
+            # close = drain (queued + in-flight complete) + worker stop;
+            # new submits for this model already take the cold path
+            arm.batcher.close(timeout=30.0)
+        dropped = csvc.drop(site=self._site(model))
+        telemetry.inc("zoo.evictions", tag="%s:%s" % (model, reason))
+        telemetry.gauge("zoo.resident_models", count)
+        telemetry.gauge("zoo.hbm_resident_bytes", 0, tag=model)
+        _log.info("zoo: evicted %s (%s): %d executable entries released",
+                  model, reason, dropped)
+        return dropped
+
+    def evict(self, model, reason="manual"):
+        """Operational page-out (the bench's churn knob)."""
+        return self._evict(model, reason)
+
+    def ensure_resident(self, model):
+        """Synchronous page-in (warm-up helper for benches/tests): the
+        model is routable when this returns."""
+        with self._lock:
+            res = self._residents.get(model)
+            if res is not None:
+                return res
+            self._paging.add(model)
+        try:
+            return self._pagein(model)
+        finally:
+            with self._lock:
+                self._paging.discard(model)
+
+    # --------------------------------------------------------------- rollout
+    def deploy(self, model, version, canary_frac=1.0, parity_example=None,
+               parity_tol=None):
+        """See :meth:`ModelZoo.deploy`. Non-resident models just flip
+        the registry's active version (the next page-in serves it)."""
+        ver = self._zoo.version(model, version)
+        telemetry.inc("zoo.deploys", tag=model)
+        with self._lock:
+            res = self._residents.get(model)
+        if res is None:
+            self._zoo.set_active(model, version)
+            telemetry.gauge("zoo.active_version", ver.ordinal, tag=model)
+            return {"model": model, "version": version, "mode": "staged"}
+        if version == res.stable.version:
+            return {"model": model, "version": version, "mode": "noop"}
+        if canary_frac >= 1.0:
+            self._swap_stable(res, version)
+            return {"model": model, "version": version, "mode": "promoted"}
+        if canary_frac <= 0.0:
+            raise MXNetError("deploy: canary_frac must be in (0, 1] "
+                             "(got %r)" % (canary_frac,))
+        if res.canary is not None:
+            raise MXNetError(
+                "deploy: model %r already has canary %s@%s live — promote "
+                "or roll it back first" % (model, model,
+                                           res.canary.version))
+        arm, _summary = self._build_arm(model, version, res.dslot,
+                                        self._site(model) + ".canary")
+        # the canary predictor snapshotted its params — restore the
+        # shared registry block to the stable version so the block
+        # always mirrors what the registry calls active
+        self._zoo.apply_version(model, res.stable.version)
+        if parity_example is not None:
+            diff = self._parity_diff(res.stable.predictor, arm.predictor,
+                                     parity_example)
+            tol = (parity_tol if parity_tol is not None
+                   else zoo_parity_tol_default())
+            if diff > tol:
+                arm.batcher.close(timeout=5.0)
+                csvc.drop(site=arm.site)
+                self._record_rollback(model, version, "parity",
+                                      extra={"diff": diff, "tol": tol})
+                return {"model": model, "version": version,
+                        "mode": "rolled_back", "reason": "parity",
+                        "diff": diff}
+        with self._lock:
+            res.canary = arm
+            res.canary_frac = float(canary_frac)
+        telemetry.gauge("zoo.canary_frac", canary_frac, tag=model)
+        _log.info("zoo: canary %s@%s live at %.0f%% of traffic",
+                  model, version, canary_frac * 100)
+        return {"model": model, "version": version, "mode": "canary",
+                "canary_frac": canary_frac}
+
+    @staticmethod
+    def _parity_diff(stable_pred, canary_pred, example):
+        """Max absolute element difference between the two arms' outputs
+        on the probe input — the deploy-time parity gate."""
+        args = example if isinstance(example, (tuple, list)) else (example,)
+        def run(pred):
+            out = pred.predict(*args)
+            outs = out if isinstance(out, tuple) else (out,)
+            return [np.asarray(o.asnumpy()) for o in outs]
+        a, b = run(stable_pred), run(canary_pred)
+        return float(max(np.max(np.abs(x - y)) for x, y in zip(a, b)))
+
+    def _swap_stable(self, res, version):
+        """The promote path: the STABLE predictor adopts ``version``'s
+        params through ``refresh_params`` — no recompile (params are
+        traced arguments) and the int8 quantization-eligibility split
+        stays pinned (``_quantize_params(sticky=...)``) across the
+        versioned swap."""
+        ver = self._zoo.version(res.model, version)
+        with self._lock:
+            self._zoo.apply_version(res.model, version)
+            res.stable.predictor.refresh_params(version=version)
+            res.stable.version = version
+        self._zoo.set_active(res.model, version)
+        telemetry.inc("zoo.promotes", tag=res.model)
+        telemetry.gauge("zoo.active_version", ver.ordinal, tag=res.model)
+        _log.info("zoo: %s now serving version %s (in-place param swap)",
+                  res.model, version)
+
+    def promote(self, model):
+        """Promote the live canary: traffic stops routing to the arm,
+        its queued + in-flight futures complete, the stable Predictor
+        adopts the canary version via the sticky-int8 ``refresh_params``
+        swap, and the arm's executables are released. Zero drops."""
+        with self._lock:
+            res = self._residents.get(model)
+            if res is None or res.canary is None:
+                raise MXNetError("promote: model %r has no live canary"
+                                 % (model,))
+            arm = res.canary
+            res.canary_frac = 0.0   # stop routing BEFORE the drain
+        arm.batcher.close(timeout=30.0)  # in-flight futures complete
+        self._swap_stable(res, arm.version)
+        with self._lock:
+            res.canary = None
+        csvc.drop(site=arm.site)
+        telemetry.gauge("zoo.canary_frac", 0.0, tag=model)
+        return {"model": model, "version": arm.version, "mode": "promoted"}
+
+    def rollback(self, model, reason="manual"):
+        """Roll the live canary back: traffic stops routing to it, its
+        queued + in-flight futures complete on the canary weights (zero
+        drops), the arm's executables are released, and the stable
+        version keeps serving untouched."""
+        with self._lock:
+            res = self._residents.get(model)
+            if res is None or res.canary is None:
+                raise MXNetError("rollback: model %r has no live canary"
+                                 % (model,))
+            arm = res.canary
+            res.canary_frac = 0.0
+        arm.batcher.close(timeout=30.0)
+        with self._lock:
+            res.canary = None
+        csvc.drop(site=arm.site)
+        self._record_rollback(model, arm.version, reason)
+        telemetry.gauge("zoo.canary_frac", 0.0, tag=model)
+        return {"model": model, "version": arm.version,
+                "mode": "rolled_back", "reason": reason}
+
+    def _record_rollback(self, model, version, reason, extra=None):
+        telemetry.inc("zoo.rollbacks", tag=reason)
+        info = {"model": model, "version": version, "reason": reason}
+        info.update(extra or {})
+        telemetry.flight_record("canary_rollback", extra=info)
+        _log.warning("zoo: canary %s@%s rolled back (%s)",
+                     model, version, reason)
+
+    # ------------------------------------------------------------ evaluation
+    def tick(self, now=None):
+        """One control pass: evaluate every live canary's auto-rollback
+        gate (injected-fault check first, then the SLO-attainment
+        floor). Driven by :meth:`poll` under a fake clock, by the
+        monitor thread in threaded mode."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            live = [(m, r) for m, r in self._residents.items()
+                    if r.canary is not None]
+        for model, res in live:
+            arm = res.canary
+            if arm is None:
+                continue
+            if inject("canary_rollback"):
+                self.rollback(model, "injected")
+                continue
+            if arm.ctrl is None:
+                continue
+            att, weight = arm.ctrl.attainment(now)
+            if weight >= zoo_canary_window_default() and att is not None \
+                    and att < zoo_canary_floor_default():
+                self.rollback(model, "slo")
+                continue
+
+    def poll(self):
+        """Fake-clock driver: run any pending page-ins inline, one
+        dispatch attempt per live arm batcher, then a canary-gate tick.
+        Returns requests dispatched."""
+        n = 0
+        if not self._threaded:
+            with self._lock:
+                cold = [m for m in self._paging
+                        if m not in self._residents]
+            for model in cold:
+                self._pagein_safe(model)
+        with self._lock:
+            residents = list(self._residents.values())
+        for res in residents:
+            n += res.stable.batcher.poll()
+            if res.canary is not None:
+                n += res.canary.batcher.poll()
+        self.tick(self._clock())
+        return n
+
+    def _monitor_loop(self):
+        while not self._stop.wait(0.05):
+            if self._closed:
+                return
+            try:
+                self.tick(self._clock())
+            except Exception:  # noqa: BLE001 — gate errors must not kill
+                _log.exception("zoo monitor tick failed")
+
+    # ------------------------------------------------------------- reporting
+    @property
+    def queue_depth(self):
+        with self._lock:
+            residents = list(self._residents.values())
+            pending = sum(p.n for dq in self._pending.values() for p in dq)
+        depth = pending
+        for res in residents:
+            depth += res.stable.batcher.queue_depth
+            if res.canary is not None:
+                depth += res.canary.batcher.queue_depth
+        return depth
+
+    def input_templates(self, model):
+        """Input templates of the model's resident stable arm (None
+        while non-resident — the HTTP front then skips dtype coercion)."""
+        with self._lock:
+            res = self._residents.get(model)
+        return res.stable.predictor.input_templates if res else None
+
+    def view(self):
+        """The /healthz zoo block: per-model residency, live versions,
+        canary state, footprints, per-tenant attainment."""
+        now = self._clock()
+        with self._lock:
+            residents = dict(self._residents)
+            pending = {m: sum(p.n for p in dq)
+                       for m, dq in self._pending.items() if dq}
+            demand = {m: round(r.rate(now), 4)
+                      for m, r in self._demand.items()}
+        out = {"models": {}, "pending": pending, "demand": demand,
+               "devices": len(self._devices),
+               "resident_models": len(residents)}
+        for model in self._zoo.models():
+            res = residents.get(model)
+            row = {"resident": res is not None,
+                   "active_version": self._zoo.active_version(model),
+                   "versions": self._zoo.versions(model)}
+            if res is not None:
+                row.update({
+                    "device": str(res.device),
+                    "resident_bytes": res.footprint,
+                    "stable_version": res.stable.version,
+                    "queue_depth": res.stable.batcher.queue_depth,
+                    "warm_disk_hits": res.warm_summary.get("disk", 0),
+                    "warm_compiles": res.warm_summary.get("built", 0)})
+                if res.stable.ctrl is not None:
+                    att, w = res.stable.ctrl.attainment(now)
+                    row["attainment"] = round(att, 4) if att is not None \
+                        else None
+                    row["tenant_attainment"] = \
+                        res.stable.ctrl.tenant_attainment(now)
+                if res.canary is not None:
+                    c = {"version": res.canary.version,
+                         "frac": res.canary_frac,
+                         "queue_depth": res.canary.batcher.queue_depth}
+                    if res.canary.ctrl is not None:
+                        att, w = res.canary.ctrl.attainment(now)
+                        c["attainment"] = round(att, 4) \
+                            if att is not None else None
+                        c["verdict_weight"] = round(w, 2)
+                    row["canary"] = c
+            out["models"][model] = row
+        return out
+
+    # ----------------------------------------------------------- drain/close
+    def drain(self, timeout=None):
+        """Stop admitting (submits shed ``draining``), fail pending
+        page-in waiters, finish everything queued + in flight on every
+        arm. Returns True when empty — the ModelServer SIGTERM path."""
+        with self._lock:
+            self._draining = True
+            pend = {m: list(dq) for m, dq in self._pending.items()}
+            self._pending.clear()
+            residents = list(self._residents.values())
+        err = QueueFull("request shed: draining")
+        for dq in pend.values():
+            for p in dq:
+                p.future._fail(err)
+        ok = True
+        for res in residents:
+            ok = res.stable.batcher.drain(timeout=timeout) and ok
+            if res.canary is not None:
+                ok = res.canary.batcher.drain(timeout=timeout) and ok
+        return ok
+
+    def close(self, timeout=5.0):
+        self.drain(timeout=timeout)
+        with self._lock:
+            self._closed = True
+            residents = list(self._residents.values())
+        self._stop.set()
+        for res in residents:
+            res.stable.batcher.close(timeout=timeout)
+            if res.canary is not None:
+                res.canary.batcher.close(timeout=timeout)
+        if self._monitor is not None:
+            self._monitor.join(timeout)
+        return self

@@ -56,7 +56,8 @@ from . import telemetry
 __all__ = ["enabled", "memwatch_interval", "attach", "watch", "ledger",
            "ledger_snapshot", "resolve", "executed_flops", "summary",
            "device_memory", "poll_memory", "ensure_memwatch",
-           "stop_memwatch", "preflight", "is_oom", "oom_flight",
+           "stop_memwatch", "preflight", "site_footprint", "is_oom",
+           "oom_flight",
            "MFUMeter", "TRAIN_SITES", "reset"]
 
 _log = logging.getLogger("mxtpu.xprof")
@@ -507,15 +508,39 @@ def _memwatch_loop(interval, stop):
             pass           # the monitor (next interval retries)
 
 
-def preflight(site, device=0, limit=None):
+def site_footprint(site, resolve=True, family=False):
+    """A site's steady-state resident-byte estimate from its executable
+    ledger. Footprint model (shared with :func:`preflight`): arguments
+    are shared across buckets (params + request buffers — counted once
+    at the donated-savings-adjusted max), temps are per-dispatch scratch
+    (max — buckets never run concurrently), outputs (KV carries, result
+    buffers) may all stay live (Σ). ``family=True`` matches the dotted
+    prefix too (``serving.predict.zoo.m`` covers its ``.canary``
+    subsite) — what the model zoo records as a resident model's HBM
+    cost and sums into co-residency preflights."""
+    entries = ledger(None if family else site, resolve=resolve)
+    args_max = temp_max = out_sum = 0
+    for e in entries:
+        s = e.get("site")
+        if family and not (s == site or (s or "").startswith(site + ".")):
+            continue
+        if e.get("error"):
+            continue
+        args_max = max(args_max, (e.get("argument_bytes") or 0)
+                       - (e.get("donated_bytes") or 0))
+        temp_max = max(temp_max, e.get("temp_bytes") or 0)
+        out_sum += e.get("output_bytes") or 0
+    return args_max + temp_max + out_sum
+
+
+def preflight(site, device=0, limit=None, extra_bytes=0):
     """Will-it-fit pre-flight after an AOT warmup: the site's executables'
-    combined footprint vs the device HBM limit. Footprint model:
-    arguments are shared across buckets (params + request buffers —
-    counted once at the donated-savings-adjusted max), temps are
-    per-dispatch scratch (max — buckets never run concurrently), outputs
-    (KV carries, result buffers) may all stay live (Σ). Past the limit it
-    warns and bumps ``memory.overcommit{site}`` — warmup SUCCEEDING does
-    not mean steady state fits once every bucket's residents coexist.
+    combined footprint (:func:`site_footprint`) plus ``extra_bytes``
+    already committed by co-residents (the model zoo passes the summed
+    ledger footprints of the other models on the device) vs the device
+    HBM limit. Past the limit it warns and bumps
+    ``memory.overcommit{site}`` — warmup SUCCEEDING does not mean steady
+    state fits once every bucket's residents (and neighbours) coexist.
 
     Returns ``(need_bytes, limit_bytes)``; None when the limit is
     unknown and not supplied (CPU tier) — skipped WITHOUT resolving, so
@@ -526,24 +551,17 @@ def preflight(site, device=0, limit=None):
         limit = device_memory(device)["bytes_limit"]
     if not limit:
         return None
-    args_max = temp_max = out_sum = 0
-    for e in ledger(site, resolve=True):
-        if e.get("error"):
-            continue
-        args_max = max(args_max, (e.get("argument_bytes") or 0)
-                       - (e.get("donated_bytes") or 0))
-        temp_max = max(temp_max, e.get("temp_bytes") or 0)
-        out_sum += e.get("output_bytes") or 0
-    need = args_max + temp_max + out_sum
+    need = site_footprint(site, resolve=True) + int(extra_bytes or 0)
     telemetry.gauge("memory.preflight_bytes", need, tag=site)
     if need > limit:
         telemetry.inc("memory.overcommit", tag=site)
         _log.warning(
-            "memory pre-flight: site %r AOT footprint ~%.0f MiB exceeds "
-            "the %.0f MiB device limit — warmup succeeded but steady "
-            "state may RESOURCE_EXHAUST; shrink buckets/capacity or "
-            "enable int8 (docs/observability.md)",
-            site, need / 2**20, limit / 2**20)
+            "memory pre-flight: site %r AOT footprint ~%.0f MiB "
+            "(co-resident %.0f MiB included) exceeds the %.0f MiB device "
+            "limit — warmup succeeded but steady state may "
+            "RESOURCE_EXHAUST; shrink buckets/capacity, evict a "
+            "co-resident model, or enable int8 (docs/observability.md)",
+            site, need / 2**20, (extra_bytes or 0) / 2**20, limit / 2**20)
     return need, limit
 
 

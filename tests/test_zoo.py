@@ -1,0 +1,598 @@
+"""Multi-tenant model zoo (mxtpu/serving/zoo) — ISSUE 20:
+
+* cold-model policy matrix: shed vs the bounded page-in queue (waiters
+  complete after the page-in, overflow sheds ``zoo_cold``), plus the
+  deterministic ``zoo_cold`` fault hook;
+* HBM-currency placement: count caps and byte budgets evict the
+  coldest resident — whose queued + in-flight futures complete FIRST
+  (eviction never strands a request) — and the co-residency-aware
+  warmup preflight warns ``memory.overcommit`` before a page-in OOMs;
+* versioned canary rollout: deterministic hash routing, promote via the
+  no-recompile sticky-int8 ``refresh_params`` swap, SLO/injected/parity
+  auto-rollback mid-cohort with ZERO dropped or hung futures;
+* page-in as a disk-warm no-compile event (subprocess: the second
+  process's page-in is all disk hits, ``retrace.serving.predict.zoo.*``
+  stays 0);
+* per-tenant SLO classes: priority isolation under overload and the
+  per-tenant goodput-attainment counters;
+* the multi-model HTTP front: ``model``/``version`` routing, 404s with
+  the known-name lists, the /healthz zoo block.
+
+Everything except the HTTP/threaded tests runs sleep-free on an
+injected clock — the PR-8 discipline.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxtpu as mx
+from mxtpu import resilience, telemetry, xprof
+from mxtpu import compile_service as csvc
+from mxtpu.base import MXNetError
+from mxtpu.gluon import nn
+from mxtpu.serving import (BucketSpec, ModelServer, ModelZoo, QueueFull,
+                           ZooScheduler)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+IN_DIM, OUT_DIM = 6, 4
+ZOO_SITE = "serving.predict.zoo"
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for var in ("MXTPU_TELEMETRY", "MXTPU_RETRACE_BUDGET",
+                "MXTPU_FAULT_INJECT", "MXTPU_SERVE_MAX_BATCH",
+                "MXTPU_SERVE_MAX_WAIT_MS", "MXTPU_SERVE_QUEUE",
+                "MXTPU_SERVE_BATCH_AGING_MS", "MXTPU_SERVE_INT8",
+                "MXTPU_ZOO_MAX_RESIDENT", "MXTPU_ZOO_HBM_BUDGET",
+                "MXTPU_ZOO_COLD_POLICY", "MXTPU_ZOO_PAGEIN_QUEUE",
+                "MXTPU_ZOO_DEMAND_HORIZON_S", "MXTPU_ZOO_CANARY_FLOOR",
+                "MXTPU_ZOO_CANARY_WINDOW", "MXTPU_ZOO_PARITY_TOL",
+                "MXTPU_COMPILE_CACHE_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    telemetry.reset()
+    resilience.reset_faults()
+    csvc.reset()
+    yield
+    telemetry.reset()
+    resilience.reset_faults()
+    csvc.reset()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _mlp(seed=0):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"))
+        net.add(nn.Dense(OUT_DIM))
+    net.initialize()
+    net(mx.nd.array(np.full((1, IN_DIM), 1.0 + seed, np.float32)))
+    return net
+
+
+def _x(n, seed=0):
+    return np.random.RandomState(seed).randn(n, IN_DIM).astype(np.float32)
+
+
+def _zoo(models=("alpha",), manifest_dir=None):
+    zoo = ModelZoo(manifest_dir=manifest_dir)
+    spec = BucketSpec([1, 4])
+    ex = np.zeros((1, IN_DIM), np.float32)
+    for i, name in enumerate(models):
+        zoo.register(name, _mlp(seed=i), spec, example=ex)
+    return zoo
+
+
+def _sched(zoo, clk, **kw):
+    kw.setdefault("start", False)
+    kw.setdefault("devices", [jax.devices()[0]])
+    return ZooScheduler(zoo, clock=clk, **kw)
+
+
+def _drive(clk, sched, rounds=3, dt=0.006):
+    for _ in range(rounds):
+        clk.advance(dt)
+        sched.poll()
+
+
+# ------------------------------------------------------------- cold policy
+def test_cold_policy_shed():
+    clk = FakeClock()
+    sched = _sched(_zoo(), clk, cold_policy="shed")
+    with pytest.raises(QueueFull, match="zoo_cold"):
+        sched.submit("alpha", _x(1))
+    assert telemetry.value("serving.shed", tag="zoo_cold") == 1
+    assert "alpha" not in sched._residents
+
+
+def test_cold_queue_bounded_pagein_wait():
+    """The queue policy: cold submits wait behind ONE bounded page-in —
+    waiters complete once the model is resident, overflow sheds
+    ``zoo_cold`` instead of building unserviceable backlog."""
+    clk = FakeClock()
+    sched = _sched(_zoo(), clk, pagein_queue=2)
+    f1 = sched.submit("alpha", _x(1, seed=1))
+    f2 = sched.submit("alpha", _x(2, seed=2))
+    assert not f1.done() and not f2.done()
+    with pytest.raises(QueueFull, match="zoo_cold"):
+        sched.submit("alpha", _x(1, seed=3))
+    assert telemetry.value("serving.shed", tag="zoo_cold") == 1
+    _drive(clk, sched)
+    assert np.asarray(f1.result(timeout=5)).shape == (1, OUT_DIM)
+    assert np.asarray(f2.result(timeout=5)).shape == (2, OUT_DIM)
+    assert telemetry.value("zoo.pageins", tag="alpha") == 1
+    # warm now: a follow-up request routes straight to the live batcher
+    f3 = sched.submit("alpha", _x(1, seed=4))
+    _drive(clk, sched)
+    assert f3.result(timeout=5) is not None
+    assert telemetry.value("zoo.pageins", tag="alpha") == 1
+
+
+def test_zoo_cold_fault_injection(monkeypatch):
+    """``MXTPU_FAULT_INJECT=zoo_cold``: the next submit sheds as if its
+    model were cold and unpageable — exactly once."""
+    clk = FakeClock()
+    sched = _sched(_zoo(), clk)
+    sched.ensure_resident("alpha")
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "zoo_cold@0")
+    with pytest.raises(QueueFull, match="zoo_cold"):
+        sched.submit("alpha", _x(1))
+    f = sched.submit("alpha", _x(1))
+    _drive(clk, sched)
+    assert f.result(timeout=5) is not None
+
+
+def test_unknown_model_refused():
+    sched = _sched(_zoo(), FakeClock())
+    with pytest.raises(MXNetError, match="alpha"):
+        sched.submit("nope", _x(1))
+
+
+# --------------------------------------------------------------- placement
+def test_count_cap_evicts_coldest_and_never_strands():
+    """One device, max_resident=1: paging beta in evicts alpha — and
+    alpha's still-queued request completes BEFORE its executables are
+    released (eviction never strands a future)."""
+    clk = FakeClock()
+    sched = _sched(_zoo(("alpha", "beta")), clk, max_resident=1)
+    sched.ensure_resident("alpha")
+    # drive demand so beta is the hot model when placement ranks
+    fa = sched.submit("alpha", _x(1, seed=1))
+    assert not fa.done()  # queued in alpha's batcher, not yet dispatched
+    fb = sched.submit("beta", _x(2, seed=2))
+    clk.advance(0.2)  # alpha's demand decays below beta's
+    for _ in range(5):
+        sched.submit("beta", _x(1, seed=3)).__class__  # heat beta up
+        break
+    _drive(clk, sched)
+    # the eviction drained alpha first: its future delivered a result
+    assert np.asarray(fa.result(timeout=5)).shape == (1, OUT_DIM)
+    assert np.asarray(fb.result(timeout=5)).shape == (2, OUT_DIM)
+    assert "beta" in sched._residents and "alpha" not in sched._residents
+    assert telemetry.value("zoo.evictions", tag="alpha:capacity") == 1
+    assert telemetry.gauge_value("zoo.hbm_resident_bytes", tag="alpha") == 0
+    assert telemetry.gauge_value("zoo.resident_models") == 1
+
+
+def test_hbm_budget_currency_eviction():
+    """Byte-currency placement: a budget smaller than two resident
+    footprints forces the coldest model out (the ledger-derived
+    footprint is the shared currency, not a replica count)."""
+    clk = FakeClock()
+    sched = _sched(_zoo(("alpha", "beta")), clk)
+    ra = sched.ensure_resident("alpha")
+    assert ra.footprint > 0  # the ledger actually priced the model
+    sched.hbm_budget = int(ra.footprint * 1.5)  # room for ~one model
+    f = sched.submit("beta", _x(1))
+    _drive(clk, sched)
+    assert f.result(timeout=5) is not None
+    assert telemetry.value("zoo.evictions", tag="alpha:capacity") == 1
+    assert "alpha" not in sched._residents
+
+
+def test_manual_evict_completes_queued_future():
+    clk = FakeClock()
+    sched = _sched(_zoo(), clk)
+    sched.ensure_resident("alpha")
+    f = sched.submit("alpha", _x(2))
+    assert not f.done()
+    sched.evict("alpha", "manual")
+    assert np.asarray(f.result(timeout=5)).shape == (2, OUT_DIM)
+    assert telemetry.value("zoo.evictions", tag="alpha:manual") == 1
+    # the next submit takes the cold path again
+    f2 = sched.submit("alpha", _x(1))
+    _drive(clk, sched)
+    assert f2.result(timeout=5) is not None
+    assert telemetry.value("zoo.pageins", tag="alpha") == 2
+
+
+def test_co_residency_preflight_overcommit():
+    """Satellite: the warmup preflight sums co-resident footprints —
+    a limit that fits one model alone but not the neighbourhood warns
+    ``memory.overcommit{site}`` at page-in, before the OOM."""
+    clk = FakeClock()
+    sched = _sched(_zoo(("alpha", "beta")), clk)
+    ra = sched.ensure_resident("alpha")
+    site_b = ZOO_SITE + ".beta"
+    assert telemetry.value("memory.overcommit", tag=site_b) == 0
+    sched.ensure_resident("beta")
+    fp_b = xprof.site_footprint(site_b, family=True)
+    assert fp_b > 0
+    # replay the preflight with a limit between beta-alone and
+    # beta+alpha: alone fits, co-residency overcommits
+    limit = fp_b + ra.footprint // 2
+    assert xprof.preflight(site_b, limit=limit) == (fp_b, limit)
+    assert telemetry.value("memory.overcommit", tag=site_b) == 0
+    need, _ = xprof.preflight(site_b, limit=limit,
+                              extra_bytes=ra.footprint)
+    assert need == fp_b + ra.footprint > limit
+    assert telemetry.value("memory.overcommit", tag=site_b) == 1
+
+
+# ----------------------------------------------------------------- rollout
+def test_canary_hash_routing_and_promote_zero_drops():
+    clk = FakeClock()
+    zoo = _zoo()
+    sched = _sched(zoo, clk)
+    sched.ensure_resident("alpha")
+    zoo.add_version("alpha", "v2")
+    out = zoo.deploy("alpha", "v2", canary_frac=0.5)
+    assert out["mode"] == "canary"
+    res = sched._residents["alpha"]
+    futs = [sched.submit("alpha", _x(1, seed=i), request_id=i)
+            for i in range(24)]
+    # the deterministic hash split sent traffic to BOTH arms
+    assert res.stable.batcher.queue_depth > 0
+    assert res.canary.batcher.queue_depth > 0
+    # same request id -> same arm, always (stable across retries)
+    depth = res.canary.batcher.queue_depth
+    promoted = sched.promote("alpha")
+    assert promoted["mode"] == "promoted"
+    # promote drained the canary arm mid-cohort; the stable queue
+    # dispatches on the next polls — every future completes, no drops
+    _drive(clk, sched)
+    for f in futs:
+        assert np.asarray(f.result(timeout=5)).shape == (1, OUT_DIM)
+    assert res.canary is None
+    assert zoo.active_version("alpha") == "v2"
+    assert res.stable.predictor.param_version == "v2"
+    assert telemetry.value("zoo.promotes", tag="alpha") == 1
+    assert telemetry.value("serving.param_refreshes",
+                           tag=ZOO_SITE + ".alpha") == 1
+    assert depth > 0
+
+
+def test_canary_injected_rollback_mid_cohort_zero_drops(monkeypatch):
+    """``MXTPU_FAULT_INJECT=canary_rollback`` rules regression at the
+    next gate tick: queued canary-cohort futures complete on the canary
+    weights (zero drops), the stable version keeps serving."""
+    clk = FakeClock()
+    zoo = _zoo()
+    sched = _sched(zoo, clk)
+    sched.ensure_resident("alpha")
+    zoo.add_version("alpha", "v2")
+    zoo.deploy("alpha", "v2", canary_frac=0.5)
+    res = sched._residents["alpha"]
+    futs = [sched.submit("alpha", _x(1, seed=i), request_id=i)
+            for i in range(24)]
+    assert res.canary.batcher.queue_depth > 0  # mid-cohort
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "canary_rollback@0")
+    sched.tick(clk())
+    assert res.canary is None
+    _drive(clk, sched)
+    for f in futs:
+        assert np.asarray(f.result(timeout=5)).shape == (1, OUT_DIM)
+    assert zoo.active_version("alpha") == "v1"
+    assert telemetry.value("zoo.rollbacks", tag="injected") == 1
+    # post-rollback traffic all routes stable
+    f = sched.submit("alpha", _x(1), request_id=999)
+    _drive(clk, sched)
+    assert f.result(timeout=5) is not None
+
+
+def test_canary_slo_auto_rollback(monkeypatch):
+    """The attainment gate: a canary whose requests keep missing their
+    deadlines is rolled back automatically once the verdict window
+    fills."""
+    monkeypatch.setenv("MXTPU_ZOO_CANARY_WINDOW", "4")
+    monkeypatch.setenv("MXTPU_ZOO_CANARY_FLOOR", "0.8")
+    clk = FakeClock()
+    zoo = _zoo()
+    sched = _sched(zoo, clk)
+    sched.ensure_resident("alpha")
+    zoo.add_version("alpha", "v2")
+    zoo.deploy("alpha", "v2", canary_frac=0.5)
+    res = sched._residents["alpha"]
+    arm = res.canary
+    # drive misses straight into the canary arm's controller (the same
+    # verdict path an expiring queued request takes)
+    for _ in range(6):
+        arm.ctrl.note_expired(clk(), meta={"tenant": "gold"})
+    sched.tick(clk())
+    assert res.canary is None
+    assert telemetry.value("zoo.rollbacks", tag="slo") == 1
+    assert zoo.active_version("alpha") == "v1"
+
+
+def test_deploy_parity_probe_rolls_back():
+    """An output-parity regression past the tolerance refuses the deploy
+    at probe time — immediate rollback, stable untouched."""
+    clk = FakeClock()
+    zoo = _zoo()
+    sched = _sched(zoo, clk)
+    sched.ensure_resident("alpha")
+    # v2 = wildly different weights: parity probe must flag it
+    m = zoo._get("alpha")
+    bad = {name: np.asarray(p.data().asnumpy()) * 100.0 + 7.0
+           for name, p in m.block.collect_params().items()}
+    zoo.add_version("alpha", "v2", params=bad)
+    out = zoo.deploy("alpha", "v2", canary_frac=0.5,
+                     parity_example=_x(2, seed=9), parity_tol=1e-3)
+    assert out["mode"] == "rolled_back" and out["reason"] == "parity"
+    assert sched._residents["alpha"].canary is None
+    assert telemetry.value("zoo.rollbacks", tag="parity") == 1
+    assert zoo.active_version("alpha") == "v1"
+    # identical weights pass the same probe
+    zoo.add_version("alpha", "v3")
+    out = zoo.deploy("alpha", "v3", canary_frac=0.5,
+                     parity_example=_x(2, seed=9), parity_tol=1e-3)
+    assert out["mode"] == "canary"
+
+
+def test_version_pinning_and_unknown_version():
+    clk = FakeClock()
+    zoo = _zoo()
+    sched = _sched(zoo, clk)
+    sched.ensure_resident("alpha")
+    zoo.add_version("alpha", "v2")
+    zoo.deploy("alpha", "v2", canary_frac=0.3)
+    res = sched._residents["alpha"]
+    f = sched.submit("alpha", _x(1), version="v2", request_id=1)
+    assert res.canary.batcher.queue_depth == 1  # pinned past the hash
+    with pytest.raises(MXNetError, match="not live"):
+        sched.submit("alpha", _x(1), version="v9")
+    _drive(clk, sched)
+    assert f.result(timeout=5) is not None
+
+
+def test_int8_stickiness_across_versioned_swap(monkeypatch):
+    """Satellite: a canary promote on an int8 Predictor re-asserts the
+    PR-11 quantization-eligibility pin — a degenerate (all-zero) weight
+    in the new version keeps its int8 slot, the executables' argument
+    structure never changes, and zero recompiles happen."""
+    monkeypatch.setenv("MXTPU_SERVE_INT8", "1")
+    clk = FakeClock()
+    zoo = _zoo()
+    sched = _sched(zoo, clk)
+    sched.ensure_resident("alpha")
+    res = sched._residents["alpha"]
+    pred = res.stable.predictor
+    assert pred.int8
+    qd0 = list(pred._param_qdtypes)
+    assert any(q is not None for q in qd0)
+    compiles0 = telemetry.value("retrace." + ZOO_SITE + ".alpha")
+    m = zoo._get("alpha")
+    v2 = {name: np.zeros_like(p.data().asnumpy())
+          for name, p in m.block.collect_params().items()}
+    zoo.add_version("alpha", "v2", params=v2)
+    zoo.deploy("alpha", "v2")  # direct promote through refresh_params
+    assert pred.param_version == "v2"
+    assert list(pred._param_qdtypes) == qd0  # the sticky pin held
+    f = sched.submit("alpha", _x(2))
+    _drive(clk, sched)
+    np.testing.assert_allclose(np.asarray(f.result(timeout=5)), 0.0,
+                               atol=1e-6)
+    assert telemetry.value("retrace." + ZOO_SITE + ".alpha") == compiles0
+    assert telemetry.gauge_value("zoo.active_version", tag="alpha") == 1
+
+
+# ------------------------------------------------------------ tenancy/SLO
+def test_tenant_classes_and_priority_isolation():
+    """Per-tenant SLO classes under overload: the gold (interactive)
+    tenant's request evicts free (batch) work instead of shedding, and
+    every delivery/expiry verdict lands in that tenant's attainment
+    counters."""
+    clk = FakeClock()
+    sched = _sched(_zoo(), clk,
+                   batcher_kw={"max_queue": 4, "max_wait_ms": 5},
+                   tenants={"gold": {"priority": "interactive",
+                                     "deadline_ms": 500},
+                            "free": {"priority": "batch",
+                                     "deadline_ms": 500}})
+    sched.ensure_resident("alpha")
+    free_futs = [sched.submit("alpha", _x(1, seed=i), tenant="free")
+                 for i in range(4)]
+    # queue full of batch work: the gold submit evicts, never sheds
+    gold = sched.submit("alpha", _x(2, seed=9), tenant="gold")
+    assert telemetry.value("serving.shed", tag="priority_evict") >= 1
+    evicted = [f for f in free_futs if f.done()]
+    assert evicted  # newest batch entries failed with the evict verdict
+    with pytest.raises(QueueFull):
+        evicted[-1].result(timeout=0)
+    _drive(clk, sched)
+    assert np.asarray(gold.result(timeout=5)).shape == (2, OUT_DIM)
+    survivors = [f for f in free_futs if f not in evicted]
+    for f in survivors:
+        assert f.result(timeout=5) is not None
+    assert telemetry.gauge_value("serving.tenant_attainment",
+                                 tag="gold") == 1.0
+    gold2 = sched.submit("alpha", _x(1), tenant="gold")
+    _drive(clk, sched)
+    assert gold2.result(timeout=5) is not None
+    ctrl = sched._residents["alpha"].stable.ctrl
+    ta = ctrl.tenant_attainment(clk())
+    assert ta["gold"] == 1.0 and "free" in ta
+    assert "tenant_attainment" in ctrl.view()
+
+
+def test_pagein_deadline_expiry_feeds_tenant_attainment():
+    """A deadline that passes DURING the page-in fails the waiter with
+    the same verdict a queued expiry gets — and the tenant's attainment
+    sees the miss."""
+    clk = FakeClock()
+    sched = _sched(_zoo(), clk,
+                   tenants={"gold": {"priority": "interactive",
+                                     "deadline_ms": 50}})
+    f = sched.submit("alpha", _x(1), tenant="gold")
+    clk.advance(0.2)  # the page-in "takes" 200 ms on the request clock
+    sched.poll()
+    with pytest.raises(Exception, match="page-in"):
+        f.result(timeout=1)
+    assert telemetry.value("serving.deadline_expired") == 1
+    ctrl = sched._residents["alpha"].stable.ctrl
+    assert ctrl.tenant_attainment(clk()).get("gold", 1.0) == 0.0
+
+
+# ---------------------------------------------------------------- registry
+def test_registry_manifest_persisted(tmp_path):
+    zoo = _zoo(manifest_dir=str(tmp_path))
+    zoo.add_version("alpha", "v2")
+    man = zoo.manifest()
+    assert man["format"] == 1
+    row = man["models"]["alpha"]
+    assert row["active"] == "v1"
+    assert set(row["versions"]) == {"v1", "v2"}
+    assert row["versions"]["v2"]["ordinal"] == 1
+    zoo.set_active("alpha", "v2")
+    assert zoo.manifest()["models"]["alpha"]["active"] == "v2"
+
+
+def test_registry_refusals():
+    zoo = _zoo()
+    with pytest.raises(MXNetError, match="already registered"):
+        zoo.register("alpha", _mlp(), BucketSpec([1]))
+    with pytest.raises(MXNetError, match="immutable"):
+        zoo.add_version("alpha", "v1")
+    with pytest.raises(MXNetError, match="unknown version"):
+        zoo.version("alpha", "v9")
+    with pytest.raises(MXNetError, match="A-Za-z0-9"):
+        ModelZoo().register("bad name!", _mlp(), BucketSpec([1]))
+
+
+def test_drain_fails_pending_and_sheds_new():
+    clk = FakeClock()
+    sched = _sched(_zoo(), clk)
+    f = sched.submit("alpha", _x(1))  # pending behind the page-in
+    assert sched.drain(timeout=1)
+    with pytest.raises(QueueFull, match="draining"):
+        f.result(timeout=1)
+    with pytest.raises(QueueFull, match="draining"):
+        sched.submit("alpha", _x(1))
+
+
+# ------------------------------------------------- disk-warm no-compile
+_PAGEIN_CHILD = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MXTPU_COMPILE_CACHE_DIR"] = sys.argv[1]
+import numpy as np
+import mxtpu as mx
+from mxtpu import telemetry
+from mxtpu.gluon import nn
+from mxtpu.serving import BucketSpec, ModelZoo, ZooScheduler
+
+mx.random.seed(0)
+net = nn.HybridSequential()
+with net.name_scope():
+    net.add(nn.Dense(8, activation="relu"))
+    net.add(nn.Dense(4))
+net.initialize()
+net(mx.nd.array(np.ones((1, 6), np.float32)))
+
+class Clock:
+    t = 0.0
+    def __call__(self):
+        return self.t
+
+zoo = ModelZoo()
+zoo.register("m", net, BucketSpec([1, 4]),
+             example=np.zeros((1, 6), np.float32))
+sched = ZooScheduler(zoo, clock=Clock(), start=False)
+res = sched.ensure_resident("m")
+print("PAGEIN", res.warm_summary.get("disk", 0),
+      res.warm_summary.get("built", 0),
+      telemetry.value("retrace.serving.predict.zoo.m"))
+"""
+
+
+def test_pagein_zero_compiles_off_warm_disk_cache(tmp_path):
+    """Acceptance gate: a page-in off a warm compile cache is a pure
+    disk event — every bucket a disk hit, zero compiles reported at the
+    model's retrace site."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+
+    def run():
+        p = subprocess.run([sys.executable, "-c", _PAGEIN_CHILD,
+                            str(tmp_path)],
+                           env=env, cwd=REPO, capture_output=True,
+                           text=True, timeout=300)
+        assert p.returncode == 0, p.stderr[-2000:]
+        line = [ln for ln in p.stdout.splitlines()
+                if ln.startswith("PAGEIN ")][0]
+        return [int(v) for v in line.split()[1:]]
+
+    disk1, built1, compiles1 = run()
+    assert built1 == 2 and compiles1 == 2  # cold: one per bucket
+    disk2, built2, compiles2 = run()
+    assert disk2 == 2      # every bucket disk-served
+    assert built2 == 0     # zero page-in compiles
+    assert compiles2 == 0  # retrace.serving.predict.zoo.* stayed 0
+
+
+# --------------------------------------------------------------- HTTP front
+def test_server_zoo_routing_and_views():
+    """The multi-model front: /predict routes by model name, 404s
+    unknown models/versions with the known lists, /healthz carries the
+    zoo block."""
+    from tests.test_replica_serving import _http
+    zoo = _zoo(("alpha", "beta"))
+    sched = ZooScheduler(zoo, devices=[jax.devices()[0]], start=True)
+    sched.set_tenant("gold", priority="interactive", deadline_ms=2000)
+    srv = ModelServer(sched).start()
+    try:
+        x = _x(2, seed=5)
+        code, out = _http(srv.address, "/predict",
+                          {"model": "alpha", "data": x.tolist(),
+                           "tenant": "gold"})
+        assert code == 200 and out["n"] == 2
+        code, out = _http(srv.address, "/predict",
+                          {"model": "gamma", "data": x.tolist()})
+        assert code == 404
+        assert sorted(out["known_models"]) == ["alpha", "beta"]
+        code, out = _http(srv.address, "/predict",
+                          {"model": "alpha", "version": "v9",
+                           "data": x.tolist()})
+        assert code == 404 and out["known_versions"] == ["v1"]
+        code, out = _http(srv.address, "/predict", {"data": x.tolist()})
+        assert code == 400 and "model" in out["error"]
+        code, health = _http(srv.address, "/healthz")
+        assert code == 200
+        z = health["zoo"]
+        assert z["resident_models"] == 1
+        assert z["models"]["alpha"]["resident"]
+        assert z["models"]["alpha"]["stable_version"] == "v1"
+        assert not z["models"]["beta"]["resident"]
+        code, met = _http(srv.address, "/metrics")
+        assert code == 200
+        assert met["gauges"]["zoo.resident_models"] == 1
+        assert met["gauges"]["zoo.hbm_resident_bytes"]["alpha"] >= 0
+    finally:
+        srv.close(timeout=10)
+        sched.close(timeout=10)

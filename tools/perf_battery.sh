@@ -179,6 +179,18 @@ timeout 600 python tools/serve_bench.py --mode slo \
   2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
 telemetry_report
 
+# 5c2. model-zoo phase (ISSUE 20): K models multiplexed over a smaller
+#      device pool under skewed mixed-tenant load with a mid-run canary
+#      deploy+promote and deploy+rollback cycle (gates: per-tenant
+#      goodput-at-SLO with priority isolation, page-in compiles == 0 off
+#      the warm cache, zero hung futures across the rollout, bounded
+#      eviction/page-in churn). Compiles happen once in the warmup
+#      block; later page-ins are cache replays — chip-safe on any pool.
+sleep 60
+timeout 600 python tools/serve_bench.py --mode zoo \
+  2>&1 | grep --line-buffered -v WARNING | tee -a "$LOG"
+telemetry_report
+
 # 5d. startup-time phase (ISSUE 15): cold-start vs warm-disk-cache wall
 #     time for a Trainer first step and a Predictor replica warmup, each
 #     in a fresh process against one MXTPU_COMPILE_CACHE_DIR (gates:

@@ -47,9 +47,17 @@ line each (stamped with platform + policy_key like every bench artifact):
   submits, tokens/s + time-to-first-token p50/p99 per offered QPS, with
   the PR-10 per-stage breakdown splitting prefill from decode time.
 
+``--mode zoo`` (ISSUE 20) is the multi-tenant model-zoo acceptance run:
+  K models over a smaller device pool under skewed mixed-tenant load,
+  with a mid-run canary deploy+promote AND deploy+rollback cycle.
+  Gates: per-tenant goodput-at-SLO (priority isolation), page-in
+  compiles == 0 (disk/memory-warm residency), zero hung futures across
+  the rollout, bounded eviction/page-in churn.
+
 Usage::
 
-    python tools/serve_bench.py [--mode sweep,closed,open,replicas,decode,slo]
+    python tools/serve_bench.py [--mode sweep,closed,open,replicas,decode,
+                                 slo,zoo]
         [--requests 500] [--max-batch 8] [--dim 256] [--width 512]
         [--depth 3] [--max-wait-ms 2] [--workers 4]
         [--qps 100,300,1000] [--deadline-ms 100]
@@ -1240,6 +1248,217 @@ def run_replicas(rset, spec, n_requests=400, workers=4, max_wait_ms=2.0,
     return rec
 
 
+def _zoo_models_default():
+    """``BENCH_ZOO_MODELS``: distinct models registered by the zoo
+    bench (the K in "K models over one device pool")."""
+    return int(os.environ.get("BENCH_ZOO_MODELS", "4"))
+
+
+def _zoo_devices_default():
+    """``BENCH_ZOO_DEVICES``: device-pool size for the zoo bench
+    (clamped to the visible devices)."""
+    return int(os.environ.get("BENCH_ZOO_DEVICES", "2"))
+
+
+def _zoo_requests_default():
+    """``BENCH_ZOO_REQUESTS``: open-loop request count for the zoo
+    bench's mixed-tenant load phase."""
+    return int(os.environ.get("BENCH_ZOO_REQUESTS", "240"))
+
+
+def _zoo_qps_default():
+    """``BENCH_ZOO_QPS``: offered request rate for the zoo bench."""
+    return float(os.environ.get("BENCH_ZOO_QPS", "60"))
+
+
+def run_zoo(n_models=None, n_devices=None, n_requests=None, qps=None,
+            deadline_ms=2000.0, dim=64, max_resident=None, emit=_emit):
+    """The multi-tenant model-zoo acceptance run (ISSUE 20): K models
+    multiplexed over a smaller device pool (``max_resident`` per device
+    forces real paging pressure), skewed mixed-tenant open-loop load
+    (gold=interactive, free=batch), and a mid-run rollout cycle —
+    deploy a canary on the hottest model and PROMOTE it, deploy one on
+    the second model and ROLL IT BACK — while traffic is in flight.
+
+    Gates:
+
+    * per-tenant goodput-at-SLO — gold attains >= 60% and is never
+      materially worse than free (priority isolation held under churn);
+    * page-in compiles == 0 — every post-warmup page-in (and both
+      canary arm builds) is served from the compile cache: the
+      ``retrace.serving.predict.zoo.*`` counters do not move;
+    * zero hung futures — every submitted request resolves (result or
+      accounted shed), including the canary cohorts that were in flight
+      across the promote and the rollback;
+    * bounded churn — page-ins stay proportional to cold misses
+      (coalescing held: no page-in storm), evictions <= page-ins.
+    """
+    import jax
+    import mxtpu as mx
+    from mxtpu import telemetry
+    from mxtpu.gluon import nn
+    from mxtpu.serving import BucketSpec, ModelZoo, QueueFull, ZooScheduler
+
+    n_models = n_models or _zoo_models_default()
+    n_devices = n_devices or _zoo_devices_default()
+    n_requests = n_requests or _zoo_requests_default()
+    qps = qps or _zoo_qps_default()
+    devs = jax.devices()[:max(1, min(n_devices, len(jax.devices())))]
+    if max_resident is None:
+        # pool capacity 2: K models page through 2 resident slots — the
+        # paging pressure the bench exists to measure — without the
+        # capacity-1 degenerate case where the hot model itself thrashes
+        max_resident = max(1, -(-2 // len(devs)))
+    # evictions release executables (csvc.drop); the disk cache is what
+    # makes the page-in BACK a no-compile event, so give the run one
+    if not os.environ.get("MXTPU_COMPILE_CACHE_DIR"):
+        import tempfile
+        os.environ["MXTPU_COMPILE_CACHE_DIR"] = tempfile.mkdtemp(
+            prefix="zoo_bench_cache_")
+
+    zoo = ModelZoo()
+    spec = BucketSpec.pow2(8)
+    names = ["m%d" % i for i in range(n_models)]
+    example = np.zeros((1, dim), np.float32)
+    for i, name in enumerate(names):
+        net = nn.HybridSequential(prefix="zoobench%d_" % i)
+        with net.name_scope():
+            net.add(nn.Dense(64, activation="relu"))
+            net.add(nn.Dense(16))
+        net.initialize()
+        net(_as_nd(example))
+        zoo.register(name, net, spec, example=example)
+    sched = ZooScheduler(
+        zoo, devices=devs, start=True, max_resident=max_resident,
+        tenants={"gold": {"priority": "interactive",
+                          "deadline_ms": deadline_ms},
+                 "free": {"priority": "batch",
+                          "deadline_ms": deadline_ms * 2}})
+    try:
+        t0 = time.perf_counter()
+        for name in names:  # populate the compile cache once per model
+            sched.ensure_resident(name)
+        warm_s = time.perf_counter() - t0
+        sites = ["retrace.serving.predict.zoo." + n for n in names]
+        sites += [s + ".canary" for s in sites]
+        compiles0 = sum(telemetry.value(s) for s in sites)
+        emit({"metric": "zoo_warmup", "models": n_models,
+              "devices": len(devs), "pool_capacity":
+              max_resident * len(devs), "value": round(warm_s, 3),
+              "unit": "s", "compiles": compiles0})
+
+        # skewed popularity (head models hot, tail cold -> paging) and
+        # a deterministic tenant mix
+        weights = np.array([1.0 / (i + 1) ** 1.5 for i in range(n_models)])
+        weights /= weights.sum()
+        rng = np.random.RandomState(7)
+        futs, sheds, cold_targets = [], {"zoo_cold": 0, "other": 0}, 0
+        rollout = {"deploys": 0, "promotes": 0, "rollbacks": 0,
+                   "errors": 0}
+
+        def rollout_step(k):
+            try:
+                if k == n_requests // 4:
+                    zoo.add_version(names[0], "v2")
+                    sched.ensure_resident(names[0])
+                    sched.deploy(names[0], "v2", canary_frac=0.5)
+                    rollout["deploys"] += 1
+                elif k == n_requests // 2:
+                    sched.promote(names[0])
+                    rollout["promotes"] += 1
+                    zoo.add_version(names[1], "v2")
+                    sched.ensure_resident(names[1])
+                    sched.deploy(names[1], "v2", canary_frac=0.5)
+                    rollout["deploys"] += 1
+                elif k == (3 * n_requests) // 4:
+                    # regress the live canary deterministically: the
+                    # gate tick rules it a regression and the FULL
+                    # auto-rollback drain runs under live traffic
+                    os.environ["MXTPU_FAULT_INJECT"] = "canary_rollback@0"
+                    deadline = time.monotonic() + 10.0
+                    while (sched._residents[names[1]].canary is not None
+                           and time.monotonic() < deadline):
+                        time.sleep(0.01)
+                    rollout["rollbacks"] += int(
+                        telemetry.value("zoo.rollbacks", tag="injected"))
+            except Exception as e:  # noqa: BLE001 — gate counts these
+                rollout["errors"] += 1
+                emit({"metric": "zoo_rollout_error", "at": k,
+                      "error": "%s: %s" % (type(e).__name__, e)})
+
+        interval = 1.0 / qps
+        next_t = time.perf_counter()
+        t_load = time.perf_counter()
+        for k in range(n_requests):
+            now = time.perf_counter()
+            if now < next_t:
+                time.sleep(next_t - now)
+            next_t += interval
+            rollout_step(k)
+            model = names[int(rng.choice(n_models, p=weights))]
+            if model not in sched._residents:
+                cold_targets += 1
+            tenant = "gold" if rng.rand() < 0.5 else "free"
+            x = rng.randn(int(rng.randint(1, 5)), dim).astype(np.float32)
+            try:
+                futs.append((tenant, sched.submit(model, x, tenant=tenant)))
+            except QueueFull as e:
+                key = "zoo_cold" if "zoo_cold" in str(e) else "other"
+                sheds[key] += 1
+        load_s = time.perf_counter() - t_load
+
+        deadline = time.monotonic() + 60.0
+        while (any(not f.done() for _, f in futs)
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        hung = sum(1 for _, f in futs if not f.done())
+        per_tenant = {"gold": [0, 0], "free": [0, 0]}
+        for tenant, f in futs:
+            hm = per_tenant[tenant]
+            try:
+                f.result(timeout=0.001)
+                hm[0] += 1
+            except Exception:  # noqa: BLE001 — miss/shed/hang all count
+                hm[1] += 1
+        att = {t: (hm[0] / max(1, hm[0] + hm[1]))
+               for t, hm in per_tenant.items()}
+        compile_delta = sum(telemetry.value(s) for s in sites) - compiles0
+        pageins = sum(telemetry.tagged("zoo.pageins").values())
+        evictions = sum(telemetry.tagged("zoo.evictions").values())
+        churn_bound = n_models + rollout["deploys"] + \
+            rollout["promotes"] + cold_targets + sheds["zoo_cold"]
+
+        gates = {
+            "tenant_slo": att["gold"] >= 0.6
+            and att["gold"] >= att["free"] - 0.05,
+            "pagein_compiles": compile_delta == 0,
+            "no_hangs": hung == 0,
+            "bounded_churn": evictions <= pageins <= churn_bound,
+            "rollout": (rollout["errors"] == 0
+                        and rollout["promotes"] >= 1
+                        and rollout["rollbacks"] >= 1),
+        }
+        rec = {"metric": "zoo_load", "models": n_models,
+               "devices": len(devs), "requests": n_requests,
+               "offered_qps": qps,
+               "value": round(sum(hm[0] for hm in per_tenant.values())
+                              / max(load_s, 1e-9), 1),
+               "unit": "goodput_rps",
+               "attainment_gold": round(att["gold"], 4),
+               "attainment_free": round(att["free"], 4),
+               "pageins": pageins, "evictions": evictions,
+               "rollbacks": sum(
+                   telemetry.tagged("zoo.rollbacks").values()),
+               "sheds": sheds, "hung": hung,
+               "pagein_compiles": compile_delta,
+               "churn_bound": churn_bound,
+               "gates": gates, "ok": all(gates.values())}
+        emit(rec)
+        return rec
+    finally:
+        sched.close(timeout=30.0)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", default="sweep,closed,open")
@@ -1286,10 +1505,22 @@ def main(argv=None):
                          "capacity")
     ap.add_argument("--slo-no-kill", action="store_true",
                     help="--mode slo: skip the kill/restore sweep")
+    ap.add_argument("--zoo-models", type=int, default=0,
+                    help="--mode zoo model count (0 = BENCH_ZOO_MODELS)")
+    ap.add_argument("--zoo-requests", type=int, default=0,
+                    help="--mode zoo open-loop request count "
+                         "(0 = BENCH_ZOO_REQUESTS)")
+    ap.add_argument("--zoo-qps", type=float, default=0.0,
+                    help="--mode zoo offered rate (0 = BENCH_ZOO_QPS)")
     args = ap.parse_args(argv)
 
     modes = {m.strip() for m in args.mode.split(",") if m.strip()}
     ok = True
+    if "zoo" in modes:
+        rec = run_zoo(n_models=args.zoo_models or None,
+                      n_requests=args.zoo_requests or None,
+                      qps=args.zoo_qps or None)
+        ok = ok and rec["ok"]
     if "slo" in modes:
         rec = run_slo(
             replicas=args.slo_replicas or None,
@@ -1309,7 +1540,7 @@ def main(argv=None):
             n_requests=min(args.decode_requests, 60),
             slots=args.decode_slots,
             max_new=min(args.decode_max_new, 16))
-    single = modes - {"replicas", "decode", "slo"}
+    single = modes - {"replicas", "decode", "slo", "zoo"}
     if single:
         pred, spec = build_predictor(dim=args.dim, width=args.width,
                                      depth=args.depth,
